@@ -1,0 +1,173 @@
+"""Benchmark: metric update+compute µs/step on TPU vs reference TorchMetrics on CPU torch.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The workload mirrors BASELINE.md config #1/#2: a MulticlassAccuracy-style hot loop
+(stat-scores counting) on batches of 4096 predictions, 100 classes. Ours runs as a single
+jitted XLA program on the TPU chip; the baseline is the reference TorchMetrics
+implementation on CPU torch (the reference has no TPU path). ``vs_baseline`` is the
+speedup factor (baseline_time / our_time).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+BATCH = 4096
+NUM_CLASSES = 100
+STEPS = 200
+WARMUP = 10
+
+
+def bench_ours() -> float:
+    """Idiomatic TPU hot loop: the whole step-stream folds through `lax.scan` inside one
+    jitted program (metric update fused into the step, zero marginal host dispatch)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.RandomState(0)
+    # pre-staged stream of STEPS batches (leading axis = steps)
+    preds = jnp.asarray(rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (STEPS, BATCH)))
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+    @jax.jit
+    def run_epoch(state, preds, target):
+        state = metric.scan_update(state, preds, target)
+        return metric.pure_compute(state), state
+
+    value, state = run_epoch(metric.init_state(), preds, target)  # compile + warmup
+    jax.block_until_ready(value)
+
+    reps = 3
+    start = time.perf_counter()
+    for _ in range(reps):
+        value, state = run_epoch(metric.init_state(), preds, target)
+        jax.block_until_ready(value)
+    elapsed = time.perf_counter() - start
+    return elapsed / (STEPS * reps) * 1e6  # µs/step
+
+
+def _install_lightning_utilities_stub() -> None:
+    """Minimal in-memory stand-in for the reference's `lightning_utilities` dependency
+    (not installed in this image) so the baseline can be measured."""
+    import importlib
+    import importlib.util
+    import types
+    from enum import Enum
+
+    if "lightning_utilities" in sys.modules:
+        return
+
+    def package_available(name: str) -> bool:
+        try:
+            return importlib.util.find_spec(name) is not None
+        except Exception:
+            return False
+
+    class RequirementCache:
+        def __init__(self, requirement: str = "", module: str = None) -> None:
+            self.requirement = requirement
+            self.module = module
+
+        def __bool__(self) -> bool:
+            name = self.module or self.requirement.split(">")[0].split("<")[0].split("=")[0].strip()
+            try:
+                importlib.import_module(name)
+                return True
+            except Exception:
+                return False
+
+        def __str__(self) -> str:
+            return self.requirement
+
+    class StrEnum(str, Enum):
+        @classmethod
+        def from_str(cls, value, source="key"):
+            for member in cls:
+                if member.value.lower() == str(value).lower().replace("-", "_"):
+                    return member
+            raise ValueError(f"Invalid value {value!r} for {cls.__name__}")
+
+    def apply_to_collection(data, dtype, function, *args, **kwargs):
+        if isinstance(data, dtype):
+            return function(data, *args, **kwargs)
+        if isinstance(data, dict):
+            return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+        if isinstance(data, (list, tuple)):
+            return type(data)(apply_to_collection(v, dtype, function, *args, **kwargs) for v in data)
+        return data
+
+    root = types.ModuleType("lightning_utilities")
+    core = types.ModuleType("lightning_utilities.core")
+    imports_mod = types.ModuleType("lightning_utilities.core.imports")
+    enums_mod = types.ModuleType("lightning_utilities.core.enums")
+    apply_mod = types.ModuleType("lightning_utilities.core.apply_func")
+    imports_mod.package_available = package_available
+    imports_mod.RequirementCache = RequirementCache
+    imports_mod.compare_version = lambda *a, **k: True
+    enums_mod.StrEnum = StrEnum
+    apply_mod.apply_to_collection = apply_to_collection
+    root.apply_to_collection = apply_to_collection
+    root.core = core
+    core.imports = imports_mod
+    core.enums = enums_mod
+    core.apply_func = apply_mod
+    sys.modules["lightning_utilities"] = root
+    sys.modules["lightning_utilities.core"] = core
+    sys.modules["lightning_utilities.core.imports"] = imports_mod
+    sys.modules["lightning_utilities.core.enums"] = enums_mod
+    sys.modules["lightning_utilities.core.apply_func"] = apply_mod
+
+
+def bench_reference() -> float:
+    try:
+        import torch
+
+        _install_lightning_utilities_stub()
+        sys.path.insert(0, "/root/reference/src")
+        from torchmetrics.classification import MulticlassAccuracy as TorchMulticlassAccuracy
+
+        rng = np.random.RandomState(0)
+        preds = torch.from_numpy(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+        target = torch.from_numpy(rng.randint(0, NUM_CLASSES, (BATCH,)))
+
+        metric = TorchMulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+        for _ in range(WARMUP):
+            metric.update(preds, target)
+        metric.compute()
+        metric.reset()
+
+        start = time.perf_counter()
+        for _ in range(STEPS):
+            metric.update(preds, target)
+        metric.compute()
+        elapsed = time.perf_counter() - start
+        return elapsed / STEPS * 1e6
+    except Exception:
+        return float("nan")
+
+
+def main() -> None:
+    ours_us = bench_ours()
+    ref_us = bench_reference()
+    vs_baseline = (ref_us / ours_us) if (ours_us > 0 and ref_us == ref_us) else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "MulticlassAccuracy update+compute (4096x100, 200 steps)",
+                "value": round(ours_us, 2),
+                "unit": "us/step",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

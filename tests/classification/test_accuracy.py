@@ -1,0 +1,190 @@
+"""Differential tests for accuracy vs sklearn (reference pattern:
+``tests/unittests/classification/test_accuracy.py``)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    Accuracy,
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_accuracy,
+    multiclass_accuracy,
+    multilabel_accuracy,
+)
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 32, 5, 4
+rng = np.random.RandomState(42)
+
+_binary_labels = (rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_binary_probs = (rng.rand(NUM_BATCHES, BATCH_SIZE), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc_labels = (
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_mc_probs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml_inputs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS),
+    rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)),
+)
+
+
+def _sk_binary(preds, target):
+    preds = (preds > 0.5).astype(int) if preds.dtype.kind == "f" else preds
+    return sk_accuracy(target.flatten(), preds.flatten())
+
+
+def _sk_multiclass_micro(preds, target):
+    if preds.ndim == target.ndim + 1:
+        preds = preds.argmax(-1)
+    return sk_accuracy(target.flatten(), preds.flatten())
+
+
+def _sk_multiclass_macro(preds, target):
+    from sklearn.metrics import recall_score
+
+    if preds.ndim == target.ndim + 1:
+        preds = preds.argmax(-1)
+    present = np.unique(np.concatenate([target.flatten(), preds.flatten()]))
+    return recall_score(target.flatten(), preds.flatten(), labels=present, average="macro", zero_division=0)
+
+
+class TestBinaryAccuracy(MetricTester):
+    @pytest.mark.parametrize("inputs", [_binary_labels, _binary_probs])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, inputs, ddp):
+        preds, target = inputs
+        self.run_class_metric_test(preds, target, BinaryAccuracy, _sk_binary, ddp=ddp)
+
+    @pytest.mark.parametrize("inputs", [_binary_labels, _binary_probs])
+    def test_functional(self, inputs):
+        preds, target = inputs
+        self.run_functional_metric_test(preds, target, binary_accuracy, _sk_binary)
+
+    def test_jit(self):
+        preds, target = _binary_probs
+        self.run_jit_test(preds, target, BinaryAccuracy)
+
+
+class TestMulticlassAccuracy(MetricTester):
+    @pytest.mark.parametrize("inputs", [_mc_labels, _mc_probs])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_micro(self, inputs, ddp):
+        preds, target = inputs
+        self.run_class_metric_test(
+            preds,
+            target,
+            MulticlassAccuracy,
+            _sk_multiclass_micro,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro"},
+            ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("inputs", [_mc_labels, _mc_probs])
+    def test_class_macro(self, inputs):
+        preds, target = inputs
+        self.run_class_metric_test(
+            preds,
+            target,
+            MulticlassAccuracy,
+            _sk_multiclass_macro,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_functional_micro(self):
+        preds, target = _mc_probs
+        self.run_functional_metric_test(
+            preds,
+            target,
+            multiclass_accuracy,
+            _sk_multiclass_micro,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro"},
+        )
+
+    def test_ignore_index(self):
+        preds, target = _mc_labels
+        p, t = preds.flatten(), target.flatten().copy()
+        t[:10] = -1
+        import jax.numpy as jnp
+
+        res = multiclass_accuracy(
+            jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average="micro", ignore_index=-1
+        )
+        expected = sk_accuracy(t[t != -1], p[t != -1])
+        assert np.allclose(float(res), expected)
+
+    def test_top_k(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_probs
+        p, t = preds[0], target[0]
+        res = multiclass_accuracy(jnp.asarray(p), jnp.asarray(t), num_classes=NUM_CLASSES, average="micro", top_k=2)
+        top2 = np.argsort(-p, axis=-1)[:, :2]
+        expected = np.mean([t[i] in top2[i] for i in range(len(t))])
+        assert np.allclose(float(res), expected)
+
+    def test_samplewise(self):
+        import jax.numpy as jnp
+
+        rng2 = np.random.RandomState(1)
+        preds = rng2.randint(0, NUM_CLASSES, (8, 16))
+        target = rng2.randint(0, NUM_CLASSES, (8, 16))
+        res = multiclass_accuracy(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, average="micro",
+            multidim_average="samplewise",
+        )
+        expected = (preds == target).mean(-1)
+        assert np.allclose(np.asarray(res), expected)
+
+    def test_jit(self):
+        preds, target = _mc_probs
+        self.run_jit_test(preds, target, MulticlassAccuracy, {"num_classes": NUM_CLASSES})
+
+
+class TestMultilabelAccuracy(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_macro(self, ddp):
+        preds, target = _ml_inputs
+
+        def _sk(preds, target):
+            p = (preds > 0.5).astype(int)
+            return np.mean([(p[:, i] == target[:, i]).mean() for i in range(NUM_LABELS)])
+
+        self.run_class_metric_test(
+            preds,
+            target,
+            MultilabelAccuracy,
+            _sk,
+            metric_args={"num_labels": NUM_LABELS, "average": "macro"},
+            ddp=ddp,
+        )
+
+    def test_functional(self):
+        preds, target = _ml_inputs
+
+        def _sk(preds, target):
+            p = (preds > 0.5).astype(int)
+            return np.mean([(p[:, i] == target[:, i]).mean() for i in range(NUM_LABELS)])
+
+        self.run_functional_metric_test(
+            preds, target, multilabel_accuracy, _sk, metric_args={"num_labels": NUM_LABELS, "average": "macro"}
+        )
+
+
+def test_task_dispatch():
+    m = Accuracy(task="binary")
+    assert isinstance(m, BinaryAccuracy)
+    m = Accuracy(task="multiclass", num_classes=3)
+    assert isinstance(m, MulticlassAccuracy)
+    m = Accuracy(task="multilabel", num_labels=3)
+    assert isinstance(m, MultilabelAccuracy)
+    with pytest.raises(ValueError):
+        Accuracy(task="nope")

@@ -1,0 +1,39 @@
+"""Test configuration: force an 8-device virtual CPU mesh before JAX initialises.
+
+Mirrors the reference's 2-process Gloo pool trick (``tests/unittests/conftest.py``):
+distributed-correctness is validated on a single host by splitting batches over 8
+virtual devices and asserting gather-then-compute equals compute-on-all-data.
+"""
+
+import os
+import sys
+
+# must run before jax backend init; force-set (the host image pins JAX_PLATFORMS=axon)
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+NUM_DEVICES = 8
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(42)
+    yield
+
+
+@pytest.fixture(scope="session")
+def n_devices() -> int:
+    return len(jax.devices())

@@ -1,0 +1,113 @@
+"""Aggregation metric tests (analog of reference ``tests/unittests/bases/test_aggregation.py``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+def test_sum():
+    m = SumMetric()
+    m.update(jnp.array([1.0, 2.0]))
+    m.update(3.0)
+    assert float(m.compute()) == 6.0
+
+
+def test_mean_weighted():
+    m = MeanMetric()
+    m.update(jnp.array([1.0, 3.0]), weight=jnp.array([1.0, 3.0]))
+    assert float(m.compute()) == (1 + 9) / 4
+
+
+def test_max_min():
+    mx, mn = MaxMetric(), MinMetric()
+    for v in ([1.0, 5.0], [3.0]):
+        mx.update(jnp.array(v))
+        mn.update(jnp.array(v))
+    assert float(mx.compute()) == 5.0
+    assert float(mn.compute()) == 1.0
+
+
+def test_cat():
+    m = CatMetric()
+    m.update(jnp.array([1.0, 2.0]))
+    m.update(jnp.array(3.0))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_nan_error():
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.array([1.0, float("nan")]))
+
+
+@pytest.mark.parametrize("strategy", ["ignore", "warn"])
+def test_nan_masking_sum_mean(strategy):
+    s = SumMetric(nan_strategy=strategy)
+    s.update(jnp.array([1.0, float("nan"), 2.0]))
+    assert float(s.compute()) == 3.0
+    m = MeanMetric(nan_strategy=strategy)
+    m.update(jnp.array([1.0, float("nan"), 3.0]))
+    assert float(m.compute()) == 2.0
+
+
+def test_nan_masking_max_min():
+    """Regression: NaNs must not be imputed as 0 for max/min (breaks negative maxima)."""
+    mx = MaxMetric(nan_strategy="ignore")
+    mx.update(jnp.array([float("nan"), -5.0]))
+    assert float(mx.compute()) == -5.0
+    mn = MinMetric(nan_strategy="ignore")
+    mn.update(jnp.array([float("nan"), 5.0]))
+    assert float(mn.compute()) == 5.0
+
+
+def test_nan_masking_cat():
+    """Regression: NaNs are dropped, not appended as zeros."""
+    m = CatMetric(nan_strategy="ignore")
+    m.update(jnp.array([1.0, float("nan"), 2.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2])
+
+
+def test_nan_impute_float():
+    m = SumMetric(nan_strategy=-1.0)
+    m.update(jnp.array([1.0, float("nan")]))
+    assert float(m.compute()) == 0.0
+
+
+def test_invalid_nan_strategy():
+    with pytest.raises(ValueError):
+        SumMetric(nan_strategy="nope")
+
+
+def test_none_reduction_forward_merge():
+    """Regression: NONE-reduction states stack under forward's fast-path merge."""
+    from torchmetrics_tpu.core.metric import Metric
+
+    class NoneState(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", jnp.zeros(()), dist_reduce_fx=None)
+
+        def update(self, v):
+            self.x = jnp.asarray(v, dtype=jnp.float32)
+
+        def compute(self):
+            return self.x
+
+    m = NoneState()
+    m(1.0)
+    # one forward: merged state is stack([default, batch]) — same one-shot semantics as
+    # the reference (_reduce_states stacks, so repeated forwards also grow rank there)
+    st = m.metric_state["x"]
+    assert st.shape == (2,)
+    np.testing.assert_allclose(np.asarray(st), [0.0, 1.0])
+
+
+def test_top_k_zero_rejected():
+    from torchmetrics_tpu.functional.classification import multiclass_accuracy
+
+    with pytest.raises(ValueError, match="top_k"):
+        multiclass_accuracy(jnp.zeros((4, 3)), jnp.zeros((4,), dtype=jnp.int32), num_classes=3, top_k=0)

@@ -1,0 +1,113 @@
+"""Distributed sync semantics over the virtual 8-device CPU mesh.
+
+Analog of reference ``tests/unittests/bases/test_ddp.py`` with shard_map replacing Gloo.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu.parallel import Reduction, pad_dim0, sync_state
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+def _run(mesh, fn, *sharded):
+    f = shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(P("data") for _ in sharded),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(f)(*sharded)
+
+
+def test_sum_sync(mesh):
+    x = jnp.arange(8.0)
+
+    def step(xs):
+        state = {"s": jnp.sum(xs)}
+        return sync_state(state, {"s": Reduction.SUM}, axis_name="data")["s"]
+
+    assert float(_run(mesh, step, x)) == float(jnp.sum(x))
+
+
+def test_max_min_mean_sync(mesh):
+    x = jnp.arange(8.0)
+
+    def step(xs):
+        state = {"mx": jnp.max(xs), "mn": jnp.min(xs), "me": jnp.mean(xs)}
+        out = sync_state(
+            state,
+            {"mx": Reduction.MAX, "mn": Reduction.MIN, "me": Reduction.MEAN},
+            axis_name="data",
+        )
+        return out["mx"], out["mn"], out["me"]
+
+    mx, mn, me = _run(mesh, step, x)
+    assert float(mx) == 7.0
+    assert float(mn) == 0.0
+    assert float(me) == 3.5
+
+
+def test_cat_sync(mesh):
+    x = jnp.arange(16.0).reshape(16)
+
+    def step(xs):
+        state = {"c": xs * 1.0}
+        return sync_state(state, {"c": Reduction.CAT}, axis_name="data")["c"]
+
+    out = _run(mesh, step, x)
+    np.testing.assert_allclose(np.sort(np.asarray(out)), np.arange(16.0))
+
+
+def test_cat_sync_list_state(mesh):
+    x = jnp.arange(16.0)
+
+    def step(xs):
+        state = {"c": [xs[:1], xs[1:]]}  # list state: pre-catted before gather
+        return sync_state(state, {"c": Reduction.CAT}, axis_name="data")["c"]
+
+    out = _run(mesh, step, x)
+    assert out.shape == (16,)
+    np.testing.assert_allclose(np.sort(np.asarray(out)), np.arange(16.0))
+
+
+def test_pad_dim0():
+    x = jnp.arange(3.0)
+    padded, mask = pad_dim0(x, 5)
+    assert padded.shape == (5,)
+    np.testing.assert_array_equal(np.asarray(mask), [True, True, True, False, False])
+    with pytest.raises(ValueError):
+        pad_dim0(x, 2)
+
+
+def test_metric_mesh_agreement(mesh):
+    """MulticlassAccuracy over the mesh == accuracy on all data, all averages."""
+    from sklearn.metrics import accuracy_score, balanced_accuracy_score
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.RandomState(7)
+    preds = rng.randint(0, 5, size=(64,))
+    target = rng.randint(0, 5, size=(64,))
+
+    m = MulticlassAccuracy(num_classes=5, average="micro")
+
+    def step(state, p, t):
+        state = m.pure_update(state, p, t)
+        synced = m.sync_state(state, axis_name="data")
+        return m.pure_compute(synced)
+
+    f = shard_map(
+        step, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False
+    )
+    val = jax.jit(f)(m.init_state(), jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(val), accuracy_score(target, preds))

@@ -1,0 +1,210 @@
+"""Core Metric lifecycle tests (analog of reference ``tests/unittests/bases/test_metric.py``)."""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.core.metric import CompositionalMetric, Metric
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+
+class DummySum(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.sum(x)
+
+    def compute(self):
+        return self.x
+
+
+class DummyCat(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(jnp.asarray(x))
+
+    def compute(self):
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        return dim_zero_cat(self.vals)
+
+
+def test_add_state_validation():
+    m = DummySum()
+    with pytest.raises(ValueError):
+        m.add_state("bad name", jnp.zeros(()))
+    with pytest.raises(ValueError):
+        m.add_state("bad", [1, 2, 3])
+    with pytest.raises(ValueError):
+        m.add_state("bad", "str")
+
+
+def test_unknown_kwarg_rejected():
+    with pytest.raises(ValueError, match="Unexpected keyword"):
+        DummySum(not_a_kwarg=True)
+
+
+def test_update_and_compute():
+    m = DummySum()
+    m.update(jnp.array([1.0, 2.0]))
+    m.update(jnp.array(3.0))
+    assert float(m.compute()) == 6.0
+    assert m.update_count == 2
+    m.reset()
+    assert m.update_count == 0
+    assert float(m.compute()) == 0.0
+
+
+def test_compute_cache():
+    m = DummySum()
+    m.update(jnp.array(1.0))
+    v1 = m.compute()
+    v2 = m.compute()
+    assert v1 is v2  # cached object
+    m.update(jnp.array(1.0))
+    assert float(m.compute()) == 2.0
+
+
+def test_forward_fast_path_returns_batch_value_and_accumulates():
+    m = DummySum()
+    out1 = m(jnp.array(2.0))
+    out2 = m(jnp.array(3.0))
+    assert float(out1) == 2.0
+    assert float(out2) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_forward_full_state_path():
+    class FullSum(DummySum):
+        full_state_update = True
+
+    m = FullSum()
+    out1 = m(jnp.array(2.0))
+    out2 = m(jnp.array(3.0))
+    assert float(out1) == 2.0
+    assert float(out2) == 3.0
+    assert float(m.compute()) == 5.0
+
+
+def test_list_state_forward():
+    m = DummyCat()
+    out = m(jnp.array([1.0, 2.0]))
+    assert np.allclose(np.asarray(out), [1, 2])
+    m(jnp.array([3.0]))
+    assert np.allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_pickle_roundtrip():
+    m = DummySum()
+    m.update(jnp.array(5.0))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 5.0
+    m2.update(jnp.array(1.0))
+    assert float(m2.compute()) == 6.0
+    # original untouched
+    assert float(m.compute()) == 5.0
+
+
+def test_clone_independent():
+    m = DummySum()
+    m.update(jnp.array(1.0))
+    c = m.clone()
+    c.update(jnp.array(1.0))
+    assert float(m.compute()) == 1.0
+    assert float(c.compute()) == 2.0
+
+
+def test_state_dict_persistent():
+    m = DummySum()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    m.update(jnp.array(4.0))
+    sd = m.state_dict()
+    assert "x" in sd and float(sd["x"]) == 4.0
+    m2 = DummySum()
+    m2.persistent(True)
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 4.0
+
+
+def test_metric_state_property():
+    m = DummySum()
+    m.update(jnp.array(2.0))
+    assert set(m.metric_state.keys()) == {"x"}
+    assert float(m.metric_state["x"]) == 2.0
+
+
+def test_sync_not_distributed_noop():
+    m = DummySum()
+    m.update(jnp.array(1.0))
+    m.sync()  # world size 1: no-op
+    assert not m._is_synced
+    with pytest.raises(TorchMetricsUserError):
+        m.unsync()
+
+
+def test_composition():
+    a, b = DummySum(), DummySum()
+    comp = a + b
+    assert isinstance(comp, CompositionalMetric)
+    a.update(jnp.array(1.0))
+    b.update(jnp.array(2.0))
+    assert float(comp.compute()) == 3.0
+
+    scaled = 2.0 * a
+    assert float(scaled.compute()) == 2.0
+    neg = -a
+    assert float(neg.compute()) == -1.0
+    idx = DummyCat()
+    idx.update(jnp.array([1.0, 9.0]))
+    assert float(idx[1].compute()) == 9.0
+
+
+def test_composition_forward():
+    a, b = DummySum(), DummySum()
+    comp = a + b
+    out = comp(jnp.array(2.0))
+    assert float(out) == 4.0
+
+
+def test_protected_attributes():
+    m = DummySum()
+    with pytest.raises(RuntimeError):
+        m.is_differentiable = True
+
+
+def test_iteration_not_supported():
+    m = DummySum()
+    with pytest.raises(NotImplementedError):
+        iter(m)
+
+
+def test_jit_update_is_cached():
+    m = DummySum()
+    m.update(jnp.array([1.0, 2.0]))
+    first = m._jitted_update
+    m.update(jnp.array([3.0, 4.0]))
+    assert m._jitted_update is first
+    assert float(m.compute()) == 10.0
+
+
+def test_pure_functional_api():
+    m = DummySum()
+    state = m.init_state()
+    state = m.pure_update(state, jnp.array(1.0))
+    state = m.pure_update(state, jnp.array(2.0))
+    assert float(m.pure_compute(state)) == 3.0
+    # stateful shell untouched
+    assert m.update_count == 0

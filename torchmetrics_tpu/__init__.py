@@ -1,0 +1,47 @@
+"""torchmetrics_tpu — TPU-native metrics framework on JAX/XLA.
+
+A brand-new implementation of the TorchMetrics capability surface designed for TPU:
+pytree states, pure jitted update/compute transitions, and mesh-collective distributed
+sync (see ``torchmetrics_tpu.parallel``).
+"""
+
+import logging as __logging
+
+__version__ = "0.1.0.dev0"
+
+_logger = __logging.getLogger("torchmetrics_tpu")
+_logger.addHandler(__logging.StreamHandler())
+_logger.setLevel(__logging.INFO)
+
+from torchmetrics_tpu import functional  # noqa: E402
+from torchmetrics_tpu.aggregation import (  # noqa: E402
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    SumMetric,
+)
+from torchmetrics_tpu.classification import (  # noqa: E402
+    Accuracy,
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MultilabelAccuracy,
+    StatScores,
+)
+from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
+
+__all__ = [
+    "functional",
+    "Metric",
+    "CompositionalMetric",
+    "Accuracy",
+    "BinaryAccuracy",
+    "MulticlassAccuracy",
+    "MultilabelAccuracy",
+    "StatScores",
+    "CatMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "MinMetric",
+    "SumMetric",
+]

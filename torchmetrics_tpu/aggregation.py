@@ -1,0 +1,186 @@
+"""Generic aggregation metrics with NaN policy.
+
+Parity: reference ``src/torchmetrics/aggregation.py:30-727`` (``BaseAggregator``,
+``MaxMetric``, ``MinMetric``, ``SumMetric``, ``MeanMetric``, ``CatMetric``,
+``RunningMean``, ``RunningSum``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for simple aggregators over a stream of values.
+
+    ``nan_strategy``: ``'error' | 'warn' | 'ignore' | 'disable' | float`` — float imputes
+    NaNs with that value (reference ``aggregation.py:30-103``).
+    """
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Any,
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed = ("error", "warn", "ignore", "disable")
+        if not (isinstance(nan_strategy, float) or nan_strategy in allowed):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        # 'error'/'warn' need a host-side NaN check (a device sync + python raise/warn),
+        # which cannot live inside a jitted transition — run those eagerly for parity.
+        if self._jit_update_flag is None and nan_strategy in ("error", "warn"):
+            self._jit_update_flag = False
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.state_name = state_name
+
+    # what NaNs are replaced with under the masking policies — the neutral element of
+    # the aggregation (0 for sum/mean with zero weight, ∓inf for max/min)
+    _nan_fill: float = 0.0
+
+    def _cast_and_nan_check_input(self, x: Any, weight: Optional[Any] = None):
+        """Convert input to float array and apply the NaN policy."""
+        x = jnp.asarray(x, dtype=self._dtype) if not isinstance(x, jax.Array) else x.astype(self._dtype)
+        if weight is None:
+            weight = jnp.ones_like(x)
+        weight = (
+            jnp.asarray(weight, dtype=self._dtype)
+            if not isinstance(weight, jax.Array)
+            else weight.astype(self._dtype)
+        )
+        weight = jnp.broadcast_to(weight, x.shape)
+
+        nans = jnp.isnan(x)
+        nans_w = jnp.isnan(weight)
+        is_traced = isinstance(x, jax.core.Tracer) or isinstance(weight, jax.core.Tracer)
+        any_nan = (
+            bool(jnp.any(nans | nans_w)) if (not is_traced and self.nan_strategy in ("error", "warn")) else False
+        )
+        if self.nan_strategy == "error" and any_nan:
+            raise RuntimeError("Encountered `nan` values in tensor")
+        if self.nan_strategy == "warn" and any_nan:
+            rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+        if self.nan_strategy in ("ignore", "warn"):
+            # static-shape masking: NaN entries get the aggregation's neutral element and
+            # zero weight instead of dynamic removal (no jit analog of boolean filtering)
+            keep = ~(nans | nans_w)
+            x = jnp.where(keep, x, self._nan_fill)
+            weight = jnp.where(keep, weight, 0.0)
+        elif isinstance(self.nan_strategy, float):
+            x = jnp.where(nans, self.nan_strategy, x)
+            weight = jnp.where(nans_w, self.nan_strategy, weight)
+        return x.reshape(-1), weight.reshape(-1)
+
+    def update(self, value: Any) -> None:  # pragma: no cover - overridden
+        pass
+
+    def compute(self) -> Array:
+        return getattr(self, self.state_name)
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum (reference ``aggregation.py:106-168``)."""
+
+    full_state_update = True
+    higher_is_better = True
+    _nan_fill = -float("inf")
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", -jnp.inf, nan_strategy, state_name="max_value", **kwargs)
+
+    def update(self, value: Any) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.max_value = jnp.maximum(self.max_value, jnp.max(value)) if value.size else self.max_value
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum (reference ``aggregation.py:171-233``)."""
+
+    full_state_update = True
+    higher_is_better = False
+    _nan_fill = float("inf")
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.inf, nan_strategy, state_name="min_value", **kwargs)
+
+    def update(self, value: Any) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.min_value = jnp.minimum(self.min_value, jnp.min(value)) if value.size else self.min_value
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference ``aggregation.py:236-298``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.zeros(()), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Any) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        self.sum_value = self.sum_value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference ``aggregation.py:301-356``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Any) -> None:
+        value, weight = self._cast_and_nan_check_input(value)
+        if self.nan_strategy in ("ignore", "warn") and not isinstance(value, jax.core.Tracer):
+            value = value[weight > 0]  # list state updates run eagerly: dynamic filter OK
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Any:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean (reference ``aggregation.py:359-437``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.zeros(()), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, value: Any, weight: Any = 1.0) -> None:
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        self.mean_value = self.mean_value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.mean_value / self.weight
+
+
+# RunningMean / RunningSum are defined in wrappers/running.py (they subclass Running);
+# re-exported here for parity with the reference's `torchmetrics.aggregation` module.
+def __getattr__(name: str):
+    if name in ("RunningMean", "RunningSum"):
+        from torchmetrics_tpu.wrappers.running import RunningMean, RunningSum
+
+        return {"RunningMean": RunningMean, "RunningSum": RunningSum}[name]
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = ["BaseAggregator", "MaxMetric", "MinMetric", "SumMetric", "MeanMetric", "CatMetric"]

@@ -1,0 +1,26 @@
+"""Task-dispatch base for classification wrapper classes.
+
+Parity: reference ``src/torchmetrics/classification/base.py:19-33`` — calling e.g.
+``Accuracy(task="multiclass", ...)`` returns a ``MulticlassAccuracy`` via ``__new__``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu.core.metric import Metric
+
+
+class _ClassificationTaskWrapper(Metric):
+    """Base class for the wrapper classes that dispatch on ``task``."""
+
+    def __new__(cls, *args: Any, **kwargs: Any):  # noqa: D102
+        raise NotImplementedError(f"`__new__` method of {cls.__name__} should be implemented.")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update state — never reached: ``__new__`` returns a task subclass."""
+        raise NotImplementedError(f"{type(self).__name__} metric does not have an `update` method.")
+
+    def compute(self) -> None:
+        """Compute metric — never reached: ``__new__`` returns a task subclass."""
+        raise NotImplementedError(f"{type(self).__name__} metric does not have a `compute` method.")

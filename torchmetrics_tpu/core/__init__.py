@@ -1,0 +1,5 @@
+"""Core metric runtime."""
+
+from torchmetrics_tpu.core.metric import CompositionalMetric, Metric
+
+__all__ = ["Metric", "CompositionalMetric"]

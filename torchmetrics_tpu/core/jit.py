@@ -1,0 +1,91 @@
+"""JIT machinery for metric update/compute kernels.
+
+The stateful :class:`~torchmetrics_tpu.core.metric.Metric` shell routes every ``update``
+through a cached :func:`jax.jit` of the *pure* state transition. Python-scalar arguments
+(thresholds, flags, class counts, strings) are treated as **static** — they select a
+compiled variant — while array arguments are traced. This mirrors how XLA wants metric
+hot loops expressed: one compiled program per configuration, re-used across steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import numpy as np
+
+
+def _is_traced_leaf(x: Any) -> bool:
+    """Leaves traced as arrays: jax/numpy arrays (python scalars stay static)."""
+    return isinstance(x, (jax.Array, np.ndarray)) or hasattr(x, "__jax_array__") or isinstance(x, jax.core.Tracer)
+
+
+class _ArraySlot:
+    """Hashable placeholder marking an array position in the static template."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "<array>"
+
+    def __hash__(self) -> int:
+        return 0
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _ArraySlot)
+
+
+_SLOT = _ArraySlot()
+
+
+def _hashable(x: Any) -> bool:
+    try:
+        hash(x)
+        return True
+    except TypeError:
+        return False
+
+
+class StaticLeafJit:
+    """``jit`` wrapper that partitions (args, kwargs) leaves into traced arrays and
+    static Python values, caching one compiled program per static configuration.
+
+    ``fn`` must have signature ``fn(state, *args, **kwargs) -> state_or_value`` where
+    ``state`` is a pytree of arrays (always traced).
+    """
+
+    def __init__(self, fn: Callable, donate_state: bool = False):
+        self._fn = fn
+        self._donate = donate_state
+        self._cache: Dict[Any, Callable] = {}
+
+    def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template = [], []
+        for leaf in leaves:
+            if _is_traced_leaf(leaf):
+                traced.append(leaf)
+                template.append(_SLOT)
+            else:
+                if not _hashable(leaf):
+                    # unhashable static (e.g. list of strings) -> eager fallback
+                    return self._fn(state, *args, **kwargs)
+                template.append(leaf)
+        key = (treedef, tuple(template))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            fn, tmpl = self._fn, tuple(template)
+
+            def run(state, traced_leaves, _treedef=treedef, _tmpl=tmpl):
+                it = iter(traced_leaves)
+                full = [next(it) if isinstance(t, _ArraySlot) else t for t in _tmpl]
+                r_args, r_kwargs = jax.tree_util.tree_unflatten(_treedef, full)
+                return fn(state, *r_args, **r_kwargs)
+
+            jitted = jax.jit(run, donate_argnums=(0,) if self._donate else ())
+            self._cache[key] = jitted
+        return jitted(state, traced)
+
+
+def jit_with_static_leaves(fn: Callable, donate_state: bool = False) -> StaticLeafJit:
+    return StaticLeafJit(fn, donate_state=donate_state)

@@ -1,0 +1,160 @@
+"""Shared final-reduction helpers turning tp/fp/tn/fn counts into metric values.
+
+Parity: the ``_*_reduce`` helpers embedded in each reference metric file
+(e.g. ``functional/classification/accuracy.py:_accuracy_reduce``) plus
+``utilities/compute.py:_adjust_weights_safe_divide``. Centralised here: one metric = one
+closed-form on the stat-score counts, applied per class then averaged.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utils.data import safe_divide
+
+Array = jax.Array
+
+
+def _adjust_weights_safe_divide(
+    score: Array,
+    average: Optional[str],
+    multilabel: bool,
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    top_k: int = 1,
+) -> Array:
+    """Apply macro/weighted averaging over the class axis.
+
+    Semantics match reference ``utilities/compute.py:63-74``: macro averaging excludes
+    classes with no support at all (tp+fp+fn==0 for top_k=1), weighted uses support.
+    """
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(score.dtype)
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            empty = (tp + fp + fn == 0) if top_k == 1 else (tp + fn == 0)
+            weights = jnp.where(empty, 0.0, weights)
+    return safe_divide(weights * score, jnp.sum(weights, axis=-1, keepdims=True)).sum(axis=-1)
+
+
+def _accuracy_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+) -> Array:
+    if average == "binary":
+        return safe_divide(tp + tn, tp + tn + fp + fn)
+    if average == "micro":
+        tp = tp.sum(axis=0 if multidim_average == "global" else -1)
+        fn = fn.sum(axis=0 if multidim_average == "global" else -1)
+        if multilabel:
+            fp = fp.sum(axis=0 if multidim_average == "global" else -1)
+            tn = tn.sum(axis=0 if multidim_average == "global" else -1)
+            return safe_divide(tp + tn, tp + tn + fp + fn)
+        return safe_divide(tp, tp + fn)
+    score = safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else safe_divide(tp, tp + fn)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0.0,
+) -> Array:
+    different_stat = fp if stat == "precision" else fn  # this is what differs between the two
+    if average == "binary":
+        return safe_divide(tp, tp + different_stat, zero_division)
+    if average == "micro":
+        tp = tp.sum(axis=0 if multidim_average == "global" else -1)
+        different_stat = different_stat.sum(axis=0 if multidim_average == "global" else -1)
+        return safe_divide(tp, tp + different_stat, zero_division)
+    score = safe_divide(tp, tp + different_stat, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _fbeta_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    zero_division: float = 0.0,
+) -> Array:
+    beta2 = beta**2
+    if average == "binary":
+        return safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    if average == "micro":
+        sum_axis = 0 if multidim_average == "global" else -1
+        tp = tp.sum(axis=sum_axis)
+        fn = fn.sum(axis=sum_axis)
+        fp = fp.sum(axis=sum_axis)
+        return safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    fbeta_score = safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    return _adjust_weights_safe_divide(fbeta_score, average, multilabel, tp, fp, fn)
+
+
+def _specificity_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    if average == "binary":
+        return safe_divide(tn, tn + fp)
+    if average == "micro":
+        sum_axis = 0 if multidim_average == "global" else -1
+        tn = tn.sum(axis=sum_axis)
+        fp = fp.sum(axis=sum_axis)
+        return safe_divide(tn, tn + fp)
+    specificity_score = safe_divide(tn, tn + fp)
+    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn)
+
+
+def _hamming_distance_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    """1 - accuracy-like agreement (reference ``functional/classification/hamming.py``)."""
+    if average == "binary":
+        return 1 - safe_divide(tp + tn, tp + tn + fp + fn)
+    if average == "micro":
+        sum_axis = 0 if multidim_average == "global" else -1
+        tp = tp.sum(axis=sum_axis)
+        fn = fn.sum(axis=sum_axis)
+        if multilabel:
+            fp = fp.sum(axis=sum_axis)
+            tn = tn.sum(axis=sum_axis)
+            return 1 - safe_divide(tp + tn, tp + tn + fp + fn)
+        return 1 - safe_divide(tp, tp + fn)
+    score = safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else safe_divide(tp, tp + fn)
+    return 1 - _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)

@@ -1,0 +1,22 @@
+"""Distributed state synchronization over device meshes."""
+
+from torchmetrics_tpu.parallel.reductions import Reduction, class_reduce, merge_states, reduce
+from torchmetrics_tpu.parallel.sync import (
+    distributed_available,
+    gather_all_tensors,
+    pad_dim0,
+    sync_state,
+    world_size,
+)
+
+__all__ = [
+    "Reduction",
+    "class_reduce",
+    "merge_states",
+    "reduce",
+    "distributed_available",
+    "gather_all_tensors",
+    "pad_dim0",
+    "sync_state",
+    "world_size",
+]

@@ -1,0 +1,113 @@
+"""Reduction vocabulary for distributed metric-state synchronization.
+
+Parity: the reference's ``dist_reduce_fx`` strings (``metric.py:197-280``) plus the
+reduce helpers in ``utilities/distributed.py:22-88``. TPU-first: each tag maps to an XLA
+collective (``psum``/``pmax``/``pmin``/``all_gather``) on a named mesh axis, and to a pure
+pairwise *merge* used by ``forward``'s fast path and checkpoint merging.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Any, Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utils.data import dim_zero_cat, safe_divide
+
+Array = jax.Array
+
+
+class Reduction(str, Enum):
+    """How a state participates in cross-device sync and pairwise merge."""
+
+    SUM = "sum"
+    MEAN = "mean"
+    MAX = "max"
+    MIN = "min"
+    CAT = "cat"
+    NONE = "none"
+
+    @classmethod
+    def from_arg(cls, fx: Union[str, Callable, None]) -> "Reduction":
+        if fx is None:
+            return cls.NONE
+        if isinstance(fx, Reduction):
+            return fx
+        if isinstance(fx, str):
+            try:
+                return cls(fx)
+            except ValueError as err:
+                raise ValueError(
+                    f"`dist_reduce_fx` must be one of {[m.value for m in cls]} or a callable, got {fx!r}"
+                ) from err
+        if callable(fx):
+            # Custom callables get CAT semantics (gather, then user-reduce) like the reference.
+            return cls.CAT
+        raise ValueError(f"Unsupported `dist_reduce_fx`: {fx!r}")
+
+
+def merge_states(old: Any, new: Any, reduction: Reduction, old_count, new_count, custom_fn: Optional[Callable] = None) -> Any:
+    """Pairwise-merge two state values under ``reduction``.
+
+    This is the O(1) combine used by ``forward``'s fast path; semantics match the
+    reference's ``_reduce_states`` (``metric.py:401-433``): custom callables reduce a
+    stack of [old, new]; NONE stacks tensors / flattens lists.
+    """
+    if custom_fn is not None and reduction == Reduction.CAT and not isinstance(old, list):
+        return custom_fn(jnp.stack([old, new]))
+    if reduction == Reduction.SUM:
+        return old + new
+    if reduction == Reduction.MEAN:
+        total = old_count + new_count
+        return safe_divide(old * old_count + new * new_count, total)
+    if reduction == Reduction.MAX:
+        return jnp.maximum(old, new)
+    if reduction == Reduction.MIN:
+        return jnp.minimum(old, new)
+    if reduction == Reduction.CAT:
+        if not isinstance(old, list) and not isinstance(new, list):
+            return jnp.concatenate([jnp.atleast_1d(old), jnp.atleast_1d(new)])
+        old_list = old if isinstance(old, list) else [old]
+        new_list = new if isinstance(new, list) else [new]
+        return old_list + new_list
+    if reduction == Reduction.NONE:
+        if isinstance(old, list) or isinstance(new, list):
+            old_list = old if isinstance(old, list) else [old]
+            new_list = new if isinstance(new, list) else [new]
+            return old_list + new_list
+        return jnp.stack([old, new])
+    raise ValueError(f"Unknown reduction {reduction}")
+
+
+def reduce(x: Array, reduction: str = "elementwise_mean") -> Array:
+    """Reduce a tensor by ``'elementwise_mean' | 'sum' | 'none'``.
+
+    Parity: reference ``utilities/distributed.py:22-46``.
+    """
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction in ("none", None):
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Per-class fraction reduce: ``'micro' | 'macro' | 'weighted' | 'none'``.
+
+    Parity: reference ``utilities/distributed.py:49-88``.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = safe_divide(jnp.sum(num), jnp.sum(denom)) if class_reduction == "micro" else safe_divide(num, denom)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * safe_divide(weights, jnp.sum(weights)))
+    if class_reduction in ("none", None):
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")

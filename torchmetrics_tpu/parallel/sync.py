@@ -1,0 +1,150 @@
+"""Cross-device / cross-host synchronization of metric states.
+
+Parity: reference ``src/torchmetrics/utilities/distributed.py:91-147``
+(``gather_all_tensors`` over ``torch.distributed.all_gather``) and
+``Metric._sync_dist`` (``metric.py:435-474``). TPU-native redesign:
+
+- **Inside SPMD** (``shard_map`` / ``pmap`` over a :class:`jax.sharding.Mesh`): sync is a
+  *pure function* ``sync_state(state, reductions, axis_name=...)`` lowering to XLA
+  collectives on the ICI/DCN mesh — ``psum`` / ``pmax`` / ``pmin`` / ``pmean`` /
+  ``all_gather``. No barrier is needed: XLA programs are globally scheduled.
+- **Eager multi-host** (``jax.distributed``): falls back to
+  ``multihost_utils.process_allgather`` per leaf, then applies the same reductions.
+- **Single process, no axis**: identity.
+
+Unlike the reference's pad-to-max-then-trim for ragged ``cat`` states (which has no
+dynamic-shape equivalent under jit), SPMD CAT requires equal per-shard shapes; ragged
+data uses :func:`pad_dim0` + a validity-mask convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchmetrics_tpu.parallel.reductions import Reduction
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def distributed_available() -> bool:
+    """True when more than one JAX process participates (multi-host)."""
+    try:
+        return jax.process_count() > 1
+    except Exception:  # backend not initialised
+        return False
+
+
+def world_size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def pad_dim0(x: Array, capacity: int, fill_value=0) -> tuple[Array, Array]:
+    """Pad ``x`` along dim 0 to ``capacity``; returns (padded, validity_mask).
+
+    Static-shape replacement for the reference's pad-to-max ragged gather
+    (``utilities/distributed.py:135-147``): pad + mask instead of pad + trim.
+    """
+    n = x.shape[0]
+    if n > capacity:
+        raise ValueError(f"Cannot pad dim0 of length {n} to smaller capacity {capacity}")
+    pad_width = [(0, capacity - n)] + [(0, 0)] * (x.ndim - 1)
+    padded = jnp.pad(x, pad_width, constant_values=fill_value)
+    mask = jnp.arange(capacity) < n
+    return padded, mask
+
+
+def _sync_leaf_in_axis(x: Array, reduction: Reduction, axis_name: str) -> Array:
+    if reduction == Reduction.SUM:
+        return lax.psum(x, axis_name)
+    if reduction == Reduction.MEAN:
+        return lax.pmean(x, axis_name)
+    if reduction == Reduction.MAX:
+        return lax.pmax(x, axis_name)
+    if reduction == Reduction.MIN:
+        return lax.pmin(x, axis_name)
+    if reduction == Reduction.CAT:
+        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    if reduction == Reduction.NONE:
+        return x
+    raise ValueError(f"Unknown reduction {reduction}")
+
+
+def _sync_leaf_multihost(x: Array, reduction: Reduction) -> Array:
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(x, tiled=False)  # [world, ...]
+    if reduction == Reduction.SUM:
+        return jnp.sum(gathered, axis=0)
+    if reduction == Reduction.MEAN:
+        return jnp.mean(gathered, axis=0)
+    if reduction == Reduction.MAX:
+        return jnp.max(gathered, axis=0)
+    if reduction == Reduction.MIN:
+        return jnp.min(gathered, axis=0)
+    if reduction == Reduction.CAT:
+        return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
+    if reduction == Reduction.NONE:
+        return x
+    raise ValueError(f"Unknown reduction {reduction}")
+
+
+def sync_state(
+    state: Mapping[str, Any],
+    reductions: Mapping[str, Reduction],
+    axis_name: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Synchronize a metric-state dict across devices/hosts.
+
+    Pure function: never mutates ``state`` (so the reference's ``unsync`` restore dance,
+    ``metric.py:551-571``, is unnecessary — the caller keeps its local state).
+
+    Args:
+        state: dict of state name -> array or list-of-arrays (list states are
+            concatenated along dim 0 before the collective, as the reference pre-cats
+            "cat" list states, ``metric.py:440-441``).
+        reductions: dict of state name -> :class:`Reduction`.
+        axis_name: mesh axis to reduce over; must be inside ``shard_map``/``pmap`` if
+            given. When ``None``, multi-host eager sync is used if available, else
+            identity.
+    """
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        red = Reduction(reductions.get(name, Reduction.NONE))
+        if isinstance(value, list):
+            if not value:
+                out[name] = value
+                continue
+            value = dim_zero_cat(value)
+        if axis_name is not None:
+            out[name] = _sync_leaf_in_axis(value, red, axis_name)
+        elif distributed_available():
+            out[name] = _sync_leaf_multihost(value, red)
+        else:
+            out[name] = value
+    return out
+
+
+def gather_all_tensors(x: Array, axis_name: Optional[str] = None) -> List[Array]:
+    """All-gather ``x`` across the sync group, returning a list of per-member values.
+
+    Parity shim for reference ``utilities/distributed.py:91-147``. Inside SPMD the
+    per-member shapes are necessarily equal (static shapes); ragged data should be
+    padded+masked by the caller via :func:`pad_dim0`.
+    """
+    if axis_name is not None:
+        stacked = lax.all_gather(x, axis_name, axis=0)  # [axis_size, ...]
+        return [stacked[i] for i in range(stacked.shape[0])]
+    if distributed_available():
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(x, tiled=False)
+        return [gathered[i] for i in range(gathered.shape[0])]
+    return [x]

@@ -1,0 +1,35 @@
+"""Utility layer: reductions, validation, enums, flags, plotting, logging."""
+
+from torchmetrics_tpu.utils.checks import _check_same_shape, check_forward_full_state_property
+from torchmetrics_tpu.utils.data import (
+    _bincount,
+    _cumsum,
+    _flexible_bincount,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    safe_divide,
+    select_topk,
+    to_onehot,
+)
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "check_forward_full_state_property",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "safe_divide",
+    "select_topk",
+    "to_onehot",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+    "TorchMetricsUserError",
+    "TorchMetricsUserWarning",
+]

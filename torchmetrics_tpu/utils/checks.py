@@ -1,0 +1,120 @@
+"""Input validation helpers shared across domains.
+
+Parity: reference ``src/torchmetrics/utilities/checks.py`` (796 LoC). Host-side (not
+jittable) checks that run once per ``update`` call on shapes/dtypes — static properties
+under jit, so they never trigger recompilation or device sync.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _check_same_shape(preds, target) -> None:
+    """Raise if ``preds`` and ``target`` have different shapes."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _is_floating(x) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _is_integral(x) -> bool:
+    dt = jnp.asarray(x).dtype
+    return jnp.issubdtype(dt, jnp.integer) or jnp.issubdtype(dt, jnp.bool_)
+
+
+def _check_valid_prob_dtype(preds) -> None:
+    if not _is_floating(preds):
+        raise ValueError(f"Expected floating point predictions, got dtype {preds.dtype}.")
+
+
+def _host_value(x):
+    """Pull a (small) array to host. Explicit device sync point — use sparingly."""
+    return np.asarray(x)
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare: int = 10,
+    reps: int = 5,
+) -> None:
+    """Empirically check if ``full_state_update=False`` gives the same result as ``True``.
+
+    Parity: reference ``utilities/checks.py:636``. Prints timing for both paths and
+    asserts result equality, so metric authors can set the class attribute safely.
+    """
+    import time
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartialState(metric_class):
+        full_state_update = False
+
+    m_full = FullState(**init_args)
+    m_part = PartialState(**init_args)
+
+    res_full, res_part = None, None
+    for _ in range(num_update_to_compare):
+        res_full = m_full(**input_args)
+        res_part = m_part(**input_args)
+
+    equal = jax.tree_util.tree_all(
+        jax.tree_util.tree_map(lambda a, b: bool(jnp.allclose(a, b)), res_full, res_part)
+    )
+    if not equal:
+        raise RuntimeError(
+            "The metric gives different results with `full_state_update=True` vs `False`;"
+            " it must keep `full_state_update=True`."
+        )
+
+    def _time(m):
+        start = time.perf_counter()
+        for _ in range(reps):
+            for _ in range(num_update_to_compare):
+                m(**input_args)
+            m.reset()
+        return (time.perf_counter() - start) / reps
+
+    t_full, t_part = _time(FullState(**init_args)), _time(PartialState(**init_args))
+    print(f"Full state for {num_update_to_compare} steps took: {t_full}")  # noqa: T201
+    print(f"Partial state for {num_update_to_compare} steps took: {t_part}")  # noqa: T201
+    print("Recommended setting `full_state_update=False`")  # noqa: T201
+
+
+def _try_proceed_with_timeout(fn, timeout: int = 25) -> bool:
+    """Run ``fn`` in a daemon thread with a timeout; True on success.
+
+    Parity: reference ``utilities/checks.py:766`` — guards slow model downloads in
+    doctests/CI.
+    """
+    import threading
+
+    result = {"ok": False}
+
+    def _target():
+        try:
+            fn()
+            result["ok"] = True
+        except Exception:
+            result["ok"] = False
+
+    thread = threading.Thread(target=_target, daemon=True)
+    thread.start()
+    thread.join(timeout)
+    return result["ok"] and not thread.is_alive()

@@ -1,0 +1,43 @@
+"""Optional-dependency feature flags.
+
+Parity: reference ``src/torchmetrics/utilities/imports.py:22-67``. The reference gates
+40+ optional backends; here the heavy metrics run on Flax models in-process, so the flag
+set is smaller — external flags remain for test-reference packages and host-callback
+metrics (PESQ/STOI-style) that have no TPU-native equivalent.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import shutil
+import sys
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_JAX_AVAILABLE = _package_available("jax")
+_FLAX_AVAILABLE = _package_available("flax")
+_MATPLOTLIB_AVAILABLE = _package_available("matplotlib")
+_SCIPY_AVAILABLE = _package_available("scipy")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_NLTK_AVAILABLE = _package_available("nltk")
+_REGEX_AVAILABLE = _package_available("regex")
+_TORCH_AVAILABLE = _package_available("torch")  # CPU torch: only for weight conversion
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_GAMMATONE_AVAILABLE = _package_available("gammatone")
+_ONNXRUNTIME_AVAILABLE = _package_available("onnxruntime")
+_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")
+_TORCHVISION_AVAILABLE = _package_available("torchvision")
+_SENTENCEPIECE_AVAILABLE = _package_available("sentencepiece")
+_MECAB_AVAILABLE = _package_available("MeCab")
+_IPADIC_AVAILABLE = _package_available("ipadic")
+
+_PYTHON_GREATER_EQUAL_3_11 = sys.version_info >= (3, 11)
+_LATEX_AVAILABLE = shutil.which("latex") is not None

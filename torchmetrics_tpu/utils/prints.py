@@ -1,0 +1,79 @@
+"""Rank-zero logging helpers.
+
+Parity: reference ``src/torchmetrics/utilities/prints.py:22-73``. In JAX's
+single-controller model "rank" maps to :func:`jax.process_index`; on a single host every
+call site is rank zero.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("torchmetrics_tpu")
+
+
+def _get_rank() -> int:
+    # Cheap probe that works before/without jax.distributed being initialised.
+    for env in ("JAX_PROCESS_INDEX", "RANK", "LOCAL_RANK"):
+        if env in os.environ:
+            try:
+                return int(os.environ[env])
+            except ValueError:
+                pass
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process index 0."""
+
+    @wraps(fn)
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        if _get_rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
+    kwargs.setdefault("stacklevel", 5)
+    warnings.warn(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, *args: Any, **kwargs: Any) -> None:
+    log.info(message, *args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, *args: Any, **kwargs: Any) -> None:
+    log.debug(message, *args, **kwargs)
+
+
+def _deprecated_root_import_class(name: str, domain: str) -> None:
+    rank_zero_warn(
+        f"Importing `{name}` from `torchmetrics_tpu` was deprecated; import it from"
+        f" `torchmetrics_tpu.{domain}` instead.",
+        DeprecationWarning,
+    )
+
+
+def _deprecated_root_import_func(name: str, domain: str) -> None:
+    rank_zero_warn(
+        f"Importing `{name}` from `torchmetrics_tpu.functional` was deprecated; import it from"
+        f" `torchmetrics_tpu.functional.{domain}` instead.",
+        DeprecationWarning,
+    )
+
+
+rank_zero_warn_once = partial(rank_zero_warn)
