@@ -1,0 +1,341 @@
+"""Differential tests for confusion-matrix-derived metrics vs sklearn.
+
+Covers ConfusionMatrix, CohenKappa, JaccardIndex, MatthewsCorrCoef, ExactMatch.
+Reference pattern: ``tests/unittests/classification/test_{confusion_matrix,cohen_kappa,
+jaccard,matthews_corrcoef,exact_match}.py``.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    CohenKappa,
+    ConfusionMatrix,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassExactMatch,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelConfusionMatrix,
+    MultilabelJaccardIndex,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_cohen_kappa,
+    binary_confusion_matrix,
+    binary_jaccard_index,
+    binary_matthews_corrcoef,
+    multiclass_cohen_kappa,
+    multiclass_confusion_matrix,
+    multiclass_exact_match,
+    multiclass_jaccard_index,
+    multiclass_matthews_corrcoef,
+    multilabel_confusion_matrix,
+    multilabel_exact_match,
+    multilabel_jaccard_index,
+    multilabel_matthews_corrcoef,
+)
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 32, 5, 4
+rng = np.random.RandomState(11)
+
+_binary_probs = (rng.rand(NUM_BATCHES, BATCH_SIZE), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc_probs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml_inputs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS),
+    rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)),
+)
+
+
+def _threshold(preds):
+    return (preds > 0.5).astype(int) if preds.dtype.kind == "f" else preds
+
+
+def _argmax(preds, target):
+    return preds.argmax(-1) if preds.ndim == target.ndim + 1 else preds
+
+
+class TestConfusionMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryConfusionMatrix,
+            lambda p, t: sk_confusion_matrix(t.flatten(), _threshold(p).flatten(), labels=[0, 1]), ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    def test_multiclass_class(self, normalize):
+        preds, target = _mc_probs
+
+        def _sk(p, t):
+            return sk_confusion_matrix(
+                t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES)), normalize=normalize
+            )
+
+        self.run_class_metric_test(
+            preds, target, MulticlassConfusionMatrix, _sk,
+            metric_args={"num_classes": NUM_CLASSES, "normalize": normalize},
+        )
+
+    def test_multilabel_class(self):
+        from sklearn.metrics import multilabel_confusion_matrix as sk_ml_confmat
+
+        preds, target = _ml_inputs
+        self.run_class_metric_test(
+            preds, target, MultilabelConfusionMatrix,
+            lambda p, t: sk_ml_confmat(t.reshape(-1, NUM_LABELS), _threshold(p).reshape(-1, NUM_LABELS)),
+            metric_args={"num_labels": NUM_LABELS},
+        )
+
+    def test_functionals(self):
+        preds, target = _binary_probs
+        self.run_functional_metric_test(
+            preds, target, binary_confusion_matrix,
+            lambda p, t: sk_confusion_matrix(t.flatten(), _threshold(p).flatten(), labels=[0, 1]),
+        )
+        preds, target = _mc_probs
+        self.run_functional_metric_test(
+            preds, target, multiclass_confusion_matrix,
+            lambda p, t: sk_confusion_matrix(t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_ignore_index(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_probs
+        p, t = _argmax(preds[0], target[0]), target[0].copy()
+        t[:8] = -1
+        res = multiclass_confusion_matrix(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, ignore_index=-1)
+        expected = sk_confusion_matrix(t[t != -1], np.asarray(p)[t != -1], labels=list(range(NUM_CLASSES)))
+        np.testing.assert_allclose(np.asarray(res), expected)
+
+    def test_jit(self):
+        preds, target = _mc_probs
+        self.run_jit_test(preds, target, MulticlassConfusionMatrix, {"num_classes": NUM_CLASSES})
+
+
+class TestCohenKappa(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryCohenKappa,
+            lambda p, t: sk_cohen_kappa(t.flatten(), _threshold(p).flatten()), ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_multiclass_class(self, weights):
+        preds, target = _mc_probs
+        self.run_class_metric_test(
+            preds, target, MulticlassCohenKappa,
+            lambda p, t: sk_cohen_kappa(t.flatten(), _argmax(p, t).flatten(), weights=weights,
+                                        labels=list(range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES, "weights": weights},
+        )
+
+    def test_functionals(self):
+        preds, target = _binary_probs
+        self.run_functional_metric_test(
+            preds, target, binary_cohen_kappa,
+            lambda p, t: sk_cohen_kappa(t.flatten(), _threshold(p).flatten()),
+        )
+        preds, target = _mc_probs
+        self.run_functional_metric_test(
+            preds, target, multiclass_cohen_kappa,
+            lambda p, t: sk_cohen_kappa(t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestJaccard(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryJaccardIndex,
+            lambda p, t: sk_jaccard(t.flatten(), _threshold(p).flatten(), zero_division=0), ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_multiclass_class(self, average):
+        preds, target = _mc_probs
+
+        def _sk(p, t):
+            return sk_jaccard(t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES)),
+                              average=average, zero_division=0)
+
+        self.run_class_metric_test(
+            preds, target, MulticlassJaccardIndex, _sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", None])
+    def test_multilabel_class(self, average):
+        preds, target = _ml_inputs
+
+        def _sk(p, t):
+            return sk_jaccard(t.reshape(-1, NUM_LABELS), _threshold(p).reshape(-1, NUM_LABELS),
+                              average=average, zero_division=0)
+
+        self.run_class_metric_test(
+            preds, target, MultilabelJaccardIndex, _sk,
+            metric_args={"num_labels": NUM_LABELS, "average": average},
+        )
+
+    def test_functionals(self):
+        preds, target = _binary_probs
+        self.run_functional_metric_test(
+            preds, target, binary_jaccard_index,
+            lambda p, t: sk_jaccard(t.flatten(), _threshold(p).flatten(), zero_division=0),
+        )
+        preds, target = _mc_probs
+        self.run_functional_metric_test(
+            preds, target, multiclass_jaccard_index,
+            lambda p, t: sk_jaccard(t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES)),
+                                    average="macro", zero_division=0),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+        preds, target = _ml_inputs
+        self.run_functional_metric_test(
+            preds, target, multilabel_jaccard_index,
+            lambda p, t: sk_jaccard(t.reshape(-1, NUM_LABELS), _threshold(p).reshape(-1, NUM_LABELS),
+                                    average="macro", zero_division=0),
+            metric_args={"num_labels": NUM_LABELS},
+        )
+
+
+class TestMatthews(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryMatthewsCorrCoef,
+            lambda p, t: sk_matthews(t.flatten(), _threshold(p).flatten()), ddp=ddp,
+        )
+
+    def test_multiclass_class(self):
+        preds, target = _mc_probs
+        self.run_class_metric_test(
+            preds, target, MulticlassMatthewsCorrCoef,
+            lambda p, t: sk_matthews(t.flatten(), _argmax(p, t).flatten()),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_functionals(self):
+        preds, target = _binary_probs
+        self.run_functional_metric_test(
+            preds, target, binary_matthews_corrcoef,
+            lambda p, t: sk_matthews(t.flatten(), _threshold(p).flatten()),
+        )
+        preds, target = _mc_probs
+        self.run_functional_metric_test(
+            preds, target, multiclass_matthews_corrcoef,
+            lambda p, t: sk_matthews(t.flatten(), _argmax(p, t).flatten()),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_multilabel_functional(self):
+        import jax.numpy as jnp
+
+        preds, target = _ml_inputs
+        p, t = _threshold(preds[0]), target[0]
+        res = multilabel_matthews_corrcoef(jnp.asarray(p), jnp.asarray(t), NUM_LABELS)
+        # reference semantics: MCC of the summed per-label 2x2 confusion matrices
+        assert np.isfinite(float(res))
+
+    def test_degenerate_cases(self):
+        import jax.numpy as jnp
+
+        # perfect constant predictor → 1.0 (reference matthews_corrcoef.py:47-52)
+        assert float(binary_matthews_corrcoef(jnp.asarray([1, 1, 1, 1]), jnp.asarray([1, 1, 1, 1]))) == 1.0
+        # fully inverted degenerate predictor → -1.0
+        assert float(binary_matthews_corrcoef(jnp.asarray([1, 1, 1, 1]), jnp.asarray([0, 0, 0, 0]))) == -1.0
+
+
+class TestExactMatch(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_multiclass_class(self, ddp):
+        rng2 = np.random.RandomState(3)
+        preds = rng2.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 8))
+        target = rng2.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 8))
+        self.run_class_metric_test(
+            preds, target, MulticlassExactMatch,
+            lambda p, t: (p == t).all(-1).mean(),
+            metric_args={"num_classes": NUM_CLASSES}, ddp=ddp,
+        )
+
+    def test_multiclass_functional(self):
+        import jax.numpy as jnp
+
+        rng2 = np.random.RandomState(4)
+        preds = rng2.randint(0, NUM_CLASSES, (BATCH_SIZE, 8))
+        target = rng2.randint(0, NUM_CLASSES, (BATCH_SIZE, 8))
+        res = multiclass_exact_match(jnp.asarray(preds), jnp.asarray(target), NUM_CLASSES)
+        np.testing.assert_allclose(float(res), (preds == target).all(-1).mean())
+
+    def test_multilabel_functional(self):
+        import jax.numpy as jnp
+
+        preds, target = _ml_inputs
+        p, t = preds[0], target[0]
+        res = multilabel_exact_match(jnp.asarray(p), jnp.asarray(t), NUM_LABELS)
+        expected = (_threshold(p) == t).all(-1).mean()
+        np.testing.assert_allclose(float(res), expected)
+
+
+def test_task_dispatch():
+    assert isinstance(ConfusionMatrix(task="binary"), BinaryConfusionMatrix)
+    assert isinstance(CohenKappa(task="multiclass", num_classes=3), MulticlassCohenKappa)
+    assert isinstance(JaccardIndex(task="multilabel", num_labels=3), MultilabelJaccardIndex)
+    assert isinstance(MatthewsCorrCoef(task="binary"), BinaryMatthewsCorrCoef)
+
+
+def test_multilabel_exact_match_samplewise_varied_batches():
+    """Regression: samplewise totals must accumulate across different batch sizes."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MultilabelExactMatch
+
+    rng2 = np.random.RandomState(5)
+    m = MultilabelExactMatch(num_labels=3, multidim_average="samplewise")
+    b1p, b1t = rng2.randint(0, 2, (4, 3, 2)), rng2.randint(0, 2, (4, 3, 2))
+    b2p, b2t = rng2.randint(0, 2, (2, 3, 2)), rng2.randint(0, 2, (2, 3, 2))
+    m.update(jnp.asarray(b1p), jnp.asarray(b1t))
+    m.update(jnp.asarray(b2p), jnp.asarray(b2t))
+    res = np.asarray(m.compute())
+    expected = np.concatenate([
+        (b1p == b1t).all(1).mean(-1),
+        (b2p == b2t).all(1).mean(-1),
+    ])
+    np.testing.assert_allclose(res, expected)
+
+
+def test_multiclass_roc_macro_average():
+    """Regression: average='macro' must return one interpolated mean curve."""
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification import multiclass_roc
+
+    rng2 = np.random.RandomState(6)
+    preds = rng2.rand(64, 3).astype(np.float32)
+    preds /= preds.sum(-1, keepdims=True)
+    target = rng2.randint(0, 3, 64)
+    fpr, tpr, thres = multiclass_roc(jnp.asarray(preds), jnp.asarray(target), 3, thresholds=20, average="macro")
+    assert fpr.ndim == 1 and tpr.ndim == 1
+    assert fpr.shape == tpr.shape == (3 * 20,)
+    assert np.all(np.diff(np.asarray(fpr)) >= 0)

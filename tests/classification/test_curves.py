@@ -1,0 +1,263 @@
+"""Differential tests for the threshold-curve family vs sklearn.
+
+Covers PrecisionRecallCurve, ROC, AUROC, AveragePrecision in unbinned (exact sklearn)
+and binned (TPU-native) modes. Reference pattern:
+``tests/unittests/classification/test_{precision_recall_curve,roc,auroc,
+average_precision}.py``.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_auc_score as sk_auroc
+from sklearn.metrics import roc_curve as sk_roc
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    AUROC,
+    AveragePrecision,
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+    PrecisionRecallCurve,
+    ROC,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_auroc,
+    binary_average_precision,
+    binary_precision_recall_curve,
+    binary_roc,
+    multiclass_auroc,
+    multiclass_average_precision,
+    multiclass_precision_recall_curve,
+    multiclass_roc,
+    multilabel_auroc,
+    multilabel_average_precision,
+    multilabel_roc,
+)
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 64, 5, 4
+rng = np.random.RandomState(13)
+
+_binary_inputs = (rng.rand(NUM_BATCHES, BATCH_SIZE), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc_inputs = (
+    np.exp(rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_mc_inputs = (_mc_inputs[0] / _mc_inputs[0].sum(-1, keepdims=True), _mc_inputs[1])
+_ml_inputs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS),
+    rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)),
+)
+
+
+class TestBinaryCurves(MetricTester):
+    def test_prc_unbinned_functional(self):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        p, t = preds.flatten(), target.flatten()
+        precision, recall, thres = binary_precision_recall_curve(jnp.asarray(p), jnp.asarray(t))
+        sk_p, sk_r, sk_t = sk_prc(t, p)
+        np.testing.assert_allclose(np.asarray(precision), sk_p, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(recall), sk_r, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(thres), sk_t, atol=1e-5)
+
+    def test_roc_unbinned_functional(self):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        p, t = preds.flatten(), target.flatten()
+        fpr, tpr, _ = binary_roc(jnp.asarray(p), jnp.asarray(t))
+        sk_fpr, sk_tpr, _ = sk_roc(t, p, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-5)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_class_binned(self, ddp):
+        preds, target = _binary_inputs
+        self.run_class_metric_test(
+            preds, target, BinaryAUROC,
+            lambda p, t: sk_auroc(t.flatten(), p.flatten()),
+            metric_args={"thresholds": 500}, ddp=ddp, atol=1e-2,
+        )
+
+    def test_auroc_class_unbinned(self):
+        preds, target = _binary_inputs
+        self.run_class_metric_test(
+            preds, target, BinaryAUROC,
+            lambda p, t: sk_auroc(t.flatten(), p.flatten()),
+        )
+
+    def test_auroc_functional(self):
+        preds, target = _binary_inputs
+        self.run_functional_metric_test(
+            preds, target, binary_auroc, lambda p, t: sk_auroc(t.flatten(), p.flatten())
+        )
+
+    def test_auroc_max_fpr(self):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        p, t = preds.flatten(), target.flatten()
+        res = binary_auroc(jnp.asarray(p), jnp.asarray(t), max_fpr=0.4)
+        np.testing.assert_allclose(float(res), sk_auroc(t, p, max_fpr=0.4), atol=1e-5)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_ap_class_unbinned(self, ddp):
+        preds, target = _binary_inputs
+        self.run_class_metric_test(
+            preds, target, BinaryAveragePrecision,
+            lambda p, t: sk_ap(t.flatten(), p.flatten()), ddp=ddp,
+        )
+
+    def test_ap_binned_close(self):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        p, t = preds.flatten(), target.flatten()
+        res = binary_average_precision(jnp.asarray(p), jnp.asarray(t), thresholds=1000)
+        np.testing.assert_allclose(float(res), sk_ap(t, p), atol=5e-3)
+
+    def test_prc_class_binned_state_shape(self):
+        import jax.numpy as jnp
+
+        m = BinaryPrecisionRecallCurve(thresholds=10)
+        m.update(jnp.asarray(_binary_inputs[0][0]), jnp.asarray(_binary_inputs[1][0]))
+        assert m.confmat.shape == (10, 2, 2)
+        # every threshold row sums to the number of (valid) samples
+        assert np.all(np.asarray(m.confmat).sum(axis=(1, 2)) == BATCH_SIZE)
+
+
+class TestMulticlassCurves(MetricTester):
+    @pytest.mark.parametrize("average", ["macro", "weighted", None])
+    def test_auroc_functional(self, average):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        p = preds.reshape(-1, NUM_CLASSES)
+        t = target.flatten()
+        res = multiclass_auroc(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, average=average)
+        expected = sk_auroc(t, p, multi_class="ovr", average=average if average else None, labels=list(range(NUM_CLASSES)))
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_class_binned(self, ddp):
+        preds, target = _mc_inputs
+        self.run_class_metric_test(
+            preds, target, MulticlassAUROC,
+            lambda p, t: sk_auroc(t.flatten(), p.reshape(-1, NUM_CLASSES), multi_class="ovr",
+                                  labels=list(range(NUM_CLASSES))),
+            metric_args={"num_classes": NUM_CLASSES, "thresholds": 500}, ddp=ddp, atol=1e-2,
+        )
+
+    @pytest.mark.parametrize("average", ["macro", "weighted", None])
+    def test_ap_functional(self, average):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        p = preds.reshape(-1, NUM_CLASSES)
+        t = target.flatten()
+        res = multiclass_average_precision(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, average=average)
+        t_oh = np.eye(NUM_CLASSES)[t]
+        expected = sk_ap(t_oh, p, average=average if average else None)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+    def test_ap_class_unbinned(self):
+        preds, target = _mc_inputs
+        self.run_class_metric_test(
+            preds, target, MulticlassAveragePrecision,
+            lambda p, t: sk_ap(np.eye(NUM_CLASSES)[t.flatten()], p.reshape(-1, NUM_CLASSES), average="macro"),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_roc_unbinned(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        p = preds.reshape(-1, NUM_CLASSES)
+        t = target.flatten()
+        fprs, tprs, _ = multiclass_roc(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES)
+        for c in range(NUM_CLASSES):
+            sk_fpr, sk_tpr, _ = sk_roc((t == c).astype(int), p[:, c], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fprs[c]), sk_fpr, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(tprs[c]), sk_tpr, atol=1e-5)
+
+    def test_prc_binned_shapes(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        precision, recall, thres = multiclass_precision_recall_curve(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), NUM_CLASSES, thresholds=10
+        )
+        assert precision.shape == (NUM_CLASSES, 11)
+        assert recall.shape == (NUM_CLASSES, 11)
+        assert thres.shape == (10,)
+
+
+class TestMultilabelCurves(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_auroc_functional(self, average):
+        import jax.numpy as jnp
+
+        preds, target = _ml_inputs
+        p = preds.reshape(-1, NUM_LABELS)
+        t = target.reshape(-1, NUM_LABELS)
+        res = multilabel_auroc(jnp.asarray(p), jnp.asarray(t), NUM_LABELS, average=average)
+        expected = sk_auroc(t, p, average=average if average else None)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_auroc_class_binned(self, ddp):
+        preds, target = _ml_inputs
+        self.run_class_metric_test(
+            preds, target, MultilabelAUROC,
+            lambda p, t: sk_auroc(t.reshape(-1, NUM_LABELS), p.reshape(-1, NUM_LABELS), average="macro"),
+            metric_args={"num_labels": NUM_LABELS, "thresholds": 500}, ddp=ddp, atol=1e-2,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", None])
+    def test_ap_functional(self, average):
+        import jax.numpy as jnp
+
+        preds, target = _ml_inputs
+        p = preds.reshape(-1, NUM_LABELS)
+        t = target.reshape(-1, NUM_LABELS)
+        res = multilabel_average_precision(jnp.asarray(p), jnp.asarray(t), NUM_LABELS, average=average)
+        expected = sk_ap(t, p, average=average if average else None)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+
+    def test_roc_unbinned(self):
+        import jax.numpy as jnp
+
+        preds, target = _ml_inputs
+        p = preds.reshape(-1, NUM_LABELS)
+        t = target.reshape(-1, NUM_LABELS)
+        fprs, tprs, _ = multilabel_roc(jnp.asarray(p), jnp.asarray(t), NUM_LABELS)
+        for ll in range(NUM_LABELS):
+            sk_fpr, sk_tpr, _ = sk_roc(t[:, ll], p[:, ll], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fprs[ll]), sk_fpr, atol=1e-5)
+            np.testing.assert_allclose(np.asarray(tprs[ll]), sk_tpr, atol=1e-5)
+
+
+def test_task_dispatch():
+    assert isinstance(AUROC(task="binary"), BinaryAUROC)
+    assert isinstance(AveragePrecision(task="binary"), BinaryAveragePrecision)
+    assert isinstance(ROC(task="binary"), BinaryROC)
+    assert isinstance(PrecisionRecallCurve(task="binary"), BinaryPrecisionRecallCurve)
+
+
+def test_ignore_index():
+    import jax.numpy as jnp
+
+    preds, target = _binary_inputs
+    p, t = preds.flatten(), target.flatten().copy()
+    t[:20] = -1
+    res = binary_auroc(jnp.asarray(p), jnp.asarray(t), ignore_index=-1)
+    expected = sk_auroc(t[t != -1], p[t != -1])
+    np.testing.assert_allclose(float(res), expected, atol=1e-5)

@@ -1,0 +1,269 @@
+"""Differential tests for precision/recall/F-beta/specificity/hamming vs sklearn.
+
+Reference pattern: ``tests/unittests/classification/test_{precision_recall,f_beta,
+specificity,hamming_distance}.py``.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    BinaryFBetaScore,
+    BinaryHammingDistance,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    F1Score,
+    FBetaScore,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelF1Score,
+    MultilabelPrecision,
+    Precision,
+    Recall,
+    Specificity,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_f1_score,
+    binary_hamming_distance,
+    binary_precision,
+    binary_recall,
+    binary_specificity,
+    multiclass_f1_score,
+    multiclass_precision,
+    multiclass_recall,
+    multiclass_specificity,
+    multilabel_f1_score,
+    multilabel_precision,
+    multilabel_recall,
+)
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 32, 5, 4
+rng = np.random.RandomState(7)
+
+_binary_probs = (rng.rand(NUM_BATCHES, BATCH_SIZE), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc_probs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_ml_inputs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS),
+    rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)),
+)
+
+
+def _threshold(preds):
+    return (preds > 0.5).astype(int) if preds.dtype.kind == "f" else preds
+
+
+def _argmax(preds, target):
+    return preds.argmax(-1) if preds.ndim == target.ndim + 1 else preds
+
+
+class TestBinary(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_precision_class(self, ddp):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryPrecision,
+            lambda p, t: sk_precision(t.flatten(), _threshold(p).flatten(), zero_division=0), ddp=ddp,
+        )
+
+    def test_recall_class(self):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryRecall,
+            lambda p, t: sk_recall(t.flatten(), _threshold(p).flatten(), zero_division=0),
+        )
+
+    def test_fbeta_class(self):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryFBetaScore,
+            lambda p, t: sk_fbeta(t.flatten(), _threshold(p).flatten(), beta=2.0, zero_division=0),
+            metric_args={"beta": 2.0},
+        )
+
+    def test_specificity_class(self):
+        preds, target = _binary_probs
+
+        def _sk_spec(p, t):
+            p = _threshold(p).flatten()
+            t = t.flatten()
+            tn = ((p == 0) & (t == 0)).sum()
+            fp = ((p == 1) & (t == 0)).sum()
+            return tn / (tn + fp)
+
+        self.run_class_metric_test(preds, target, BinarySpecificity, _sk_spec)
+
+    def test_hamming_class(self):
+        preds, target = _binary_probs
+        self.run_class_metric_test(
+            preds, target, BinaryHammingDistance,
+            lambda p, t: (np.asarray(_threshold(p)).flatten() != t.flatten()).mean(),
+        )
+
+    def test_functionals(self):
+        preds, target = _binary_probs
+        self.run_functional_metric_test(
+            preds, target, binary_precision,
+            lambda p, t: sk_precision(t.flatten(), _threshold(p).flatten(), zero_division=0),
+        )
+        self.run_functional_metric_test(
+            preds, target, binary_recall,
+            lambda p, t: sk_recall(t.flatten(), _threshold(p).flatten(), zero_division=0),
+        )
+        self.run_functional_metric_test(
+            preds, target, binary_f1_score,
+            lambda p, t: sk_fbeta(t.flatten(), _threshold(p).flatten(), beta=1.0, zero_division=0),
+        )
+        self.run_functional_metric_test(
+            preds, target, binary_hamming_distance,
+            lambda p, t: (np.asarray(_threshold(p)).flatten() != t.flatten()).mean(),
+        )
+        self.run_functional_metric_test(
+            preds, target, binary_specificity,
+            lambda p, t: sk_recall(1 - t.flatten(), 1 - _threshold(p).flatten(), zero_division=0),
+        )
+
+
+class TestMulticlass(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_precision_class(self, average, ddp):
+        preds, target = _mc_probs
+
+        def _sk(p, t):
+            return sk_precision(
+                t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES)),
+                average=average, zero_division=0,
+            )
+
+        self.run_class_metric_test(
+            preds, target, MulticlassPrecision, _sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": average}, ddp=ddp,
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_recall_class(self, average):
+        preds, target = _mc_probs
+
+        def _sk(p, t):
+            return sk_recall(
+                t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES)),
+                average=average, zero_division=0,
+            )
+
+        self.run_class_metric_test(
+            preds, target, MulticlassRecall, _sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+    def test_f1_class(self, average):
+        preds, target = _mc_probs
+
+        def _sk(p, t):
+            return sk_fbeta(
+                t.flatten(), _argmax(p, t).flatten(), beta=1.0, labels=list(range(NUM_CLASSES)),
+                average=average, zero_division=0,
+            )
+
+        self.run_class_metric_test(
+            preds, target, MulticlassF1Score, _sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_specificity_functional(self):
+        preds, target = _mc_probs
+
+        def _sk(p, t):
+            p = _argmax(p, t).flatten()
+            t = t.flatten()
+            scores = []
+            for c in range(NUM_CLASSES):
+                tn = ((p != c) & (t != c)).sum()
+                fp = ((p == c) & (t != c)).sum()
+                scores.append(tn / (tn + fp))
+            return np.mean(scores)
+
+        self.run_functional_metric_test(
+            preds, target, multiclass_specificity, _sk,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_functionals(self):
+        preds, target = _mc_probs
+        for fn, sk_fn in [
+            (multiclass_precision, sk_precision),
+            (multiclass_recall, sk_recall),
+        ]:
+            self.run_functional_metric_test(
+                preds, target, fn,
+                lambda p, t, _s=sk_fn: _s(
+                    t.flatten(), _argmax(p, t).flatten(), labels=list(range(NUM_CLASSES)),
+                    average="macro", zero_division=0,
+                ),
+                metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+            )
+        self.run_functional_metric_test(
+            preds, target, multiclass_f1_score,
+            lambda p, t: sk_fbeta(
+                t.flatten(), _argmax(p, t).flatten(), beta=1.0, labels=list(range(NUM_CLASSES)),
+                average="macro", zero_division=0,
+            ),
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_jit(self):
+        preds, target = _mc_probs
+        self.run_jit_test(preds, target, MulticlassPrecision, {"num_classes": NUM_CLASSES})
+
+
+class TestMultilabel(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_precision_class(self, average, ddp):
+        preds, target = _ml_inputs
+
+        def _sk(p, t):
+            return sk_precision(t.reshape(-1, NUM_LABELS), _threshold(p).reshape(-1, NUM_LABELS),
+                                average=average, zero_division=0)
+
+        self.run_class_metric_test(
+            preds, target, MultilabelPrecision, _sk,
+            metric_args={"num_labels": NUM_LABELS, "average": average}, ddp=ddp,
+        )
+
+    def test_functionals(self):
+        preds, target = _ml_inputs
+        self.run_functional_metric_test(
+            preds, target, multilabel_precision,
+            lambda p, t: sk_precision(t, _threshold(p), average="macro", zero_division=0),
+            metric_args={"num_labels": NUM_LABELS, "average": "macro"},
+        )
+        self.run_functional_metric_test(
+            preds, target, multilabel_recall,
+            lambda p, t: sk_recall(t, _threshold(p), average="macro", zero_division=0),
+            metric_args={"num_labels": NUM_LABELS, "average": "macro"},
+        )
+        self.run_functional_metric_test(
+            preds, target, multilabel_f1_score,
+            lambda p, t: sk_fbeta(t, _threshold(p), beta=1.0, average="macro", zero_division=0),
+            metric_args={"num_labels": NUM_LABELS, "average": "macro"},
+        )
+
+
+def test_task_dispatch():
+    assert isinstance(Precision(task="binary"), BinaryPrecision)
+    assert isinstance(Recall(task="binary"), BinaryRecall)
+    assert isinstance(F1Score(task="multiclass", num_classes=3), MulticlassF1Score)
+    assert isinstance(FBetaScore(task="multilabel", num_labels=3, beta=0.5), MultilabelF1Score.__bases__[0])
+    assert isinstance(Specificity(task="binary"), BinarySpecificity)
+    with pytest.raises(ValueError):
+        Precision(task="nope")

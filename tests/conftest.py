@@ -17,6 +17,20 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
+
+# The host image's sitecustomize registers an 'axon' (tunneled TPU) PJRT plugin at
+# interpreter startup and pins JAX_PLATFORMS=axon *before* this conftest runs, so the
+# env-var overrides above may come too late. Force the config and deregister the axon
+# factory so tests always run on the 8-device virtual CPU mesh (and never hang on a
+# stuck tunnel).
+jax.config.update("jax_platforms", "cpu")
+try:  # noqa: SIM105
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -21,27 +21,18 @@ from torchmetrics_tpu.aggregation import (  # noqa: E402
     MinMetric,
     SumMetric,
 )
-from torchmetrics_tpu.classification import (  # noqa: E402
-    Accuracy,
-    BinaryAccuracy,
-    MulticlassAccuracy,
-    MultilabelAccuracy,
-    StatScores,
-)
+from torchmetrics_tpu.classification import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 
 __all__ = [
     "functional",
     "Metric",
     "CompositionalMetric",
-    "Accuracy",
-    "BinaryAccuracy",
-    "MulticlassAccuracy",
-    "MultilabelAccuracy",
-    "StatScores",
     "CatMetric",
     "MaxMetric",
     "MeanMetric",
     "MinMetric",
     "SumMetric",
+    *_classification_all,
 ]

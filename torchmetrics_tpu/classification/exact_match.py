@@ -1,0 +1,212 @@
+"""Exact match module classes.
+
+Parity: reference ``src/torchmetrics/classification/exact_match.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.classification.exact_match import (
+    _exact_match_reduce,
+    _multiclass_exact_match_update,
+    _multilabel_exact_match_update,
+)
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoBinary
+
+Array = jax.Array
+
+
+class _AbstractExactMatch(Metric):
+    """Shared correct/total states (scalar for global, ragged for samplewise)."""
+
+    correct: Any
+    total: Any
+
+    def _create_state(self, multidim_average: str) -> None:
+        if multidim_average == "global":
+            self.add_state("correct", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        else:
+            # samplewise: both per-sample counts accumulate as ragged "cat" lists so
+            # batches of different sizes concatenate correctly
+            self.add_state("correct", [], dist_reduce_fx="cat")
+            self.add_state("total", [], dist_reduce_fx="cat")
+
+    def _update_state(self, correct: Array, total: Array) -> None:
+        if isinstance(self.correct, list):
+            self.correct.append(correct)
+            self.total.append(jnp.broadcast_to(total, correct.shape))
+        else:
+            self.correct = self.correct + correct
+            self.total = self.total + total
+
+    def _final_state(self):
+        correct = dim_zero_cat(self.correct) if isinstance(self.correct, list) else self.correct
+        total = dim_zero_cat(self.total) if isinstance(self.total, list) else self.total
+        return correct, total
+
+
+class MulticlassExactMatch(_AbstractExactMatch):
+    r"""Exact match for multidim multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassExactMatch
+        >>> target = jnp.array([[0, 1], [2, 1]])
+        >>> preds = jnp.array([[0, 1], [2, 2]])
+        >>> metric = MulticlassExactMatch(num_classes=3)
+        >>> metric(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        self.num_classes = num_classes
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate exact-match counts."""
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(
+                preds, target, self.num_classes, self.multidim_average, self.ignore_index
+            )
+        preds, target = _multiclass_stat_scores_format(preds, target, 1)
+        correct, total = _multiclass_exact_match_update(preds, target, self.multidim_average, self.ignore_index)
+        self._update_state(correct, total)
+
+    def compute(self) -> Array:
+        """Compute the exact-match fraction."""
+        correct, total = self._final_state()
+        return _exact_match_reduce(correct, total)
+
+
+class MultilabelExactMatch(_AbstractExactMatch):
+    r"""Exact match for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelExactMatch
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> metric = MultilabelExactMatch(num_labels=3)
+        >>> metric(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate exact-match counts."""
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(
+                preds, target, self.num_labels, self.multidim_average, self.ignore_index
+            )
+        preds, target, valid = _multilabel_stat_scores_format(
+            preds, target, self.num_labels, self.threshold, self.ignore_index
+        )
+        correct, total = _multilabel_exact_match_update(
+            preds, target, valid, self.num_labels, self.multidim_average
+        )
+        self._update_state(correct, total)
+
+    def compute(self) -> Array:
+        """Compute the exact-match fraction."""
+        correct, total = self._final_state()
+        return _exact_match_reduce(correct, total)
+
+
+class ExactMatch(_ClassificationTaskWrapper):
+    r"""Task-dispatch wrapper for exact match (multiclass / multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import ExactMatch
+        >>> target = jnp.array([[0, 1], [2, 1]])
+        >>> preds = jnp.array([[0, 1], [2, 2]])
+        >>> metric = ExactMatch(task="multiclass", num_classes=3)
+        >>> metric(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTaskNoBinary.from_str(task)
+        kwargs.update({
+            "multidim_average": multidim_average,
+            "ignore_index": ignore_index,
+            "validate_args": validate_args,
+        })
+        if task == ClassificationTaskNoBinary.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassExactMatch(num_classes, **kwargs)
+        if task == ClassificationTaskNoBinary.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelExactMatch(num_labels, threshold, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

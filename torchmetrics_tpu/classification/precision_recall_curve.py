@@ -1,0 +1,353 @@
+"""PrecisionRecallCurve module classes — the shared state engine for the whole
+threshold-curve family (ROC, AUROC, AveragePrecision, *@fixed-X subclass these).
+
+Parity: reference ``src/torchmetrics/classification/precision_recall_curve.py``
+(binned ``confmat`` state vs unbinned growing ``preds``/``target`` lists,
+``precision_recall_curve.py:154-160``).
+
+TPU-native: binned mode (pass ``thresholds``) keeps a static-shape confusion accumulator
+— jit-able update, ``psum``-able sync, O(T) memory. Unbinned mode stores ragged lists on
+host like the reference (exact sklearn numerics, eager compute).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _drop_invalid(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+    """Eagerly drop masked elements before appending to unbinned list states.
+
+    Under tracing (pure SPMD path) nothing is dropped — the downstream jit-safe curve
+    compute carries the validity mask as zero-weight segments instead.
+    """
+    if isinstance(valid, jax.core.Tracer):
+        return preds, target
+    if bool(jnp.all(valid)):
+        return preds, target
+    keep = jnp.nonzero(valid)[0]
+    return preds[keep], target[keep]
+
+
+class BinaryPrecisionRecallCurve(Metric):
+    r"""Binary precision-recall curve.
+
+    With ``thresholds`` set (the TPU-native default use), state is a static ``[T, 2, 2]``
+    confusion accumulator; otherwise raw scores accumulate in ragged lists.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> metric = BinaryPrecisionRecallCurve(thresholds=5)
+        >>> precision, recall, thresholds = metric(preds, target)
+        >>> recall
+        Array([1. , 1. , 0.5, 0.5, 0. , 0. ], dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = None
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+        else:
+            self.register_threshold_buffer(thresholds)
+            self.add_state(
+                "confmat", jnp.zeros((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+            )
+
+    def register_threshold_buffer(self, thresholds: Array) -> None:
+        self.thresholds = thresholds
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate scores (unbinned) or the threshold-binned confusion counts."""
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+        preds, target, valid, _ = _binary_precision_recall_curve_format(
+            preds, target, None, self.ignore_index
+        )
+        if self.thresholds is None:
+            preds, target = _drop_invalid(preds, target, valid)
+            self.preds.append(preds)
+            self.target.append(target)
+        else:
+            self.confmat = self.confmat + _binary_precision_recall_curve_update(
+                preds, target, valid, self.thresholds
+            )
+
+    def _curve_state(self):
+        if self.thresholds is None:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return (preds, target, jnp.ones_like(target, dtype=jnp.bool_))
+        return self.confmat
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """(precision, recall, thresholds)."""
+        return _binary_precision_recall_curve_compute(self._curve_state(), self.thresholds)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Array] = None, ax: Any = None):
+        """Plot the curve."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class MulticlassPrecisionRecallCurve(Metric):
+    r"""Multiclass (one-vs-rest) precision-recall curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+        >>> preds = jnp.array([[0.75, 0.05, 0.05], [0.05, 0.75, 0.05], [0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 2])
+        >>> metric = MulticlassPrecisionRecallCurve(num_classes=3, thresholds=5)
+        >>> precision, recall, thresholds = metric(preds, target)
+        >>> precision.shape
+        (3, 6)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = None
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+        else:
+            self.thresholds = thresholds
+            shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
+            self.add_state("confmat", jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate scores or binned confusion counts."""
+        if self.validate_args:
+            _multiclass_precision_recall_curve_tensor_validation(
+                preds, target, self.num_classes, self.ignore_index
+            )
+        preds, target, valid, _ = _multiclass_precision_recall_curve_format(
+            preds, target, self.num_classes, None, self.ignore_index, self.average
+        )
+        if self.thresholds is None:
+            preds, target = _drop_invalid(preds, target, valid)
+            self.preds.append(preds)
+            self.target.append(target)
+        elif self.average == "micro":
+            self.confmat = self.confmat + _binary_precision_recall_curve_update(
+                preds, target, valid, self.thresholds
+            )
+        else:
+            self.confmat = self.confmat + _multiclass_precision_recall_curve_update(
+                preds, target, valid, self.num_classes, self.thresholds
+            )
+
+    def _curve_state(self):
+        if self.thresholds is None:
+            preds = dim_zero_cat(self.preds)
+            target = dim_zero_cat(self.target)
+            return (preds, target, jnp.ones(target.shape[0], dtype=jnp.bool_))
+        return self.confmat
+
+    def compute(self):
+        """(precision, recall, thresholds) per class."""
+        state = self._curve_state()
+        if self.average == "micro":
+            return _binary_precision_recall_curve_compute(state, self.thresholds)
+        return _multiclass_precision_recall_curve_compute(state, self.num_classes, self.thresholds, self.average)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Array] = None, ax: Any = None):
+        """Plot the curves."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class MultilabelPrecisionRecallCurve(Metric):
+    r"""Per-label precision-recall curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelPrecisionRecallCurve
+        >>> preds = jnp.array([[0.75, 0.05], [0.05, 0.75]])
+        >>> target = jnp.array([[1, 0], [0, 1]])
+        >>> metric = MultilabelPrecisionRecallCurve(num_labels=2, thresholds=5)
+        >>> precision, recall, thresholds = metric(preds, target)
+        >>> precision.shape
+        (2, 6)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update: bool = False
+
+    preds: List[Array]
+    target: List[Array]
+    valid: List[Array]
+    confmat: Array
+
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+
+        thresholds = _adjust_threshold_arg(thresholds)
+        if thresholds is None:
+            self.thresholds = None
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("valid", [], dist_reduce_fx="cat")
+        else:
+            self.thresholds = thresholds
+            self.add_state(
+                "confmat", jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+            )
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate scores or binned confusion counts."""
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(
+                preds, target, self.num_labels, self.ignore_index
+            )
+        preds, target, valid, _ = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, None, self.ignore_index
+        )
+        if self.thresholds is None:
+            self.preds.append(preds)
+            self.target.append(target)
+            self.valid.append(valid)
+        else:
+            self.confmat = self.confmat + _multilabel_precision_recall_curve_update(
+                preds, target, valid, self.num_labels, self.thresholds
+            )
+
+    def _curve_state(self):
+        if self.thresholds is None:
+            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
+        return self.confmat
+
+    def compute(self):
+        """(precision, recall, thresholds) per label."""
+        return _multilabel_precision_recall_curve_compute(
+            self._curve_state(), self.num_labels, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Array] = None, ax: Any = None):
+        """Plot the curves."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"))
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    r"""Task-dispatch wrapper for the precision-recall curve."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

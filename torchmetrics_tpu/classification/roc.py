@@ -1,0 +1,134 @@
+"""ROC module classes (share state with PrecisionRecallCurve).
+
+Parity: reference ``src/torchmetrics/classification/roc.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.classification.precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    r"""Binary ROC curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryROC
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> metric = BinaryROC(thresholds=5)
+        >>> fpr, tpr, thresholds = metric(preds, target)
+        >>> tpr
+        Array([0. , 0.5, 0.5, 1. , 1. ], dtype=float32)
+    """
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """(fpr, tpr, thresholds)."""
+        return _binary_roc_compute(self._curve_state(), self.thresholds)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Array] = None, ax: Any = None):
+        """Plot the ROC curve."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    r"""Multiclass one-vs-rest ROC curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassROC
+        >>> preds = jnp.array([[0.75, 0.05, 0.05], [0.05, 0.75, 0.05], [0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 2])
+        >>> metric = MulticlassROC(num_classes=3, thresholds=5)
+        >>> fpr, tpr, thresholds = metric(preds, target)
+        >>> tpr.shape
+        (3, 5)
+    """
+
+    def compute(self):
+        """(fpr, tpr, thresholds) per class."""
+        state = self._curve_state()
+        if self.average == "micro":
+            return _binary_roc_compute(state, self.thresholds)
+        return _multiclass_roc_compute(state, self.num_classes, self.thresholds, self.average)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Array] = None, ax: Any = None):
+        """Plot the ROC curves."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    r"""Per-label ROC curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelROC
+        >>> preds = jnp.array([[0.75, 0.05], [0.05, 0.75]])
+        >>> target = jnp.array([[1, 0], [0, 1]])
+        >>> metric = MultilabelROC(num_labels=2, thresholds=5)
+        >>> fpr, tpr, thresholds = metric(preds, target)
+        >>> fpr.shape
+        (2, 5)
+    """
+
+    def compute(self):
+        """(fpr, tpr, thresholds) per label."""
+        return _multilabel_roc_compute(self._curve_state(), self.num_labels, self.thresholds, self.ignore_index)
+
+    def plot(self, curve: Optional[Tuple] = None, score: Optional[Array] = None, ax: Any = None):
+        """Plot the ROC curves."""
+        from torchmetrics_tpu.utils.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("False positive rate", "True positive rate"))
+
+
+class ROC(_ClassificationTaskWrapper):
+    r"""Task-dispatch wrapper for ROC."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        thresholds: Union[int, Sequence[float], Array, None] = None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryROC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassROC(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelROC(num_labels, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -1,23 +1,7 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional.classification import (
-    accuracy,
-    binary_accuracy,
-    multiclass_accuracy,
-    multilabel_accuracy,
-    binary_stat_scores,
-    multiclass_stat_scores,
-    multilabel_stat_scores,
-    stat_scores,
-)
+from torchmetrics_tpu.functional import classification
+from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 
-__all__ = [
-    "accuracy",
-    "binary_accuracy",
-    "multiclass_accuracy",
-    "multilabel_accuracy",
-    "binary_stat_scores",
-    "multiclass_stat_scores",
-    "multilabel_stat_scores",
-    "stat_scores",
-]
+__all__ = ["classification", *_classification_all]

@@ -1,0 +1,263 @@
+"""AUROC: binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/auroc.py``.
+Derives from the ROC curve state; binned mode integrates on device with the trapezoidal
+rule (a single fused reduce under jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.compute import _auc_compute_without_check
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _validate_average_arg(average: Optional[str], allowed=("macro", "weighted", "none", None)) -> None:
+    if average not in allowed:
+        raise ValueError(f"Expected argument `average` to be one of {allowed} but got {average}")
+
+
+def _binary_auroc_arg_validation(
+    max_fpr: Optional[float] = None,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+
+
+def _binary_auroc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    thresholds: Optional[Array],
+    max_fpr: Optional[float] = None,
+    pos_label: int = 1,
+) -> Array:
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
+    if max_fpr is None:
+        return _auc_compute_without_check(fpr, tpr, 1.0)
+    # partial AUC up to max_fpr with McClish standardization (reference auroc.py)
+    fpr_c = jnp.concatenate([fpr, jnp.asarray([max_fpr], dtype=fpr.dtype)])
+    tpr_c = jnp.concatenate([tpr, jnp.interp(jnp.asarray([max_fpr]), fpr, tpr)])
+    order = jnp.argsort(fpr_c)
+    fpr_c, tpr_c = fpr_c[order], tpr_c[order]
+    mask = fpr_c <= max_fpr
+    # integrate only the masked prefix: zero out increments beyond max_fpr
+    dx = jnp.diff(fpr_c)
+    ym = (tpr_c[1:] + tpr_c[:-1]) / 2
+    seg_ok = mask[1:]
+    partial_auc = jnp.sum(jnp.where(seg_ok, dx * ym, 0.0))
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return (0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))).astype(jnp.float32)
+
+
+def binary_auroc(
+    preds: Array,
+    target: Array,
+    max_fpr: Optional[float] = None,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Area under the ROC curve for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_auroc
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> binary_auroc(preds, target)
+        Array(0.75, dtype=float32)
+    """
+    if validate_args:
+        _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    return _binary_auroc_compute(state, thresholds, max_fpr)
+
+
+def _reduce_auroc(
+    fpr: Union[Array, list],
+    tpr: Union[Array, list],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    """Per-class trapz + macro/weighted/none reduction."""
+    if isinstance(fpr, jax.Array) and fpr.ndim == 2:
+        res = jax.vmap(lambda f, t: _auc_compute_without_check(f, t, 1.0))(fpr, tpr)
+    else:
+        res = jnp.stack([_auc_compute_without_check(f, t, 1.0) for f, t in zip(fpr, tpr)])
+    if average in (None, "none"):
+        return res
+    if not isinstance(res, jax.core.Tracer) and bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            "AUROC score for one or more classes was `nan`. Ignoring these classes in average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.sum(jnp.where(idx, res, 0.0)) / jnp.sum(idx)
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, weights, 0.0)
+        weights = safe_divide(weights, jnp.sum(weights))
+        return jnp.sum(jnp.where(idx, res * weights, 0.0))
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _multiclass_auroc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = "macro",
+) -> Array:
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(state, jax.Array) and thresholds is not None:
+        weights = state[0, :, 1, :].sum(axis=-1).astype(jnp.float32)  # per-class support
+    else:
+        _, target, valid = state
+        keep = valid
+        weights = jnp.stack(
+            [jnp.sum((target == c) & keep).astype(jnp.float32) for c in range(num_classes)]
+        )
+    return _reduce_auroc(fpr, tpr, average, weights)
+
+
+def multiclass_auroc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """AUROC for multiclass tasks (one-vs-rest).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_auroc
+        >>> preds = jnp.array([[0.75, 0.05, 0.05], [0.05, 0.75, 0.05], [0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 2])
+        >>> multiclass_auroc(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _validate_average_arg(average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    return _multiclass_auroc_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_auroc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+) -> Array:
+    if average == "micro":
+        if isinstance(state, jax.Array) and thresholds is not None:
+            return _binary_auroc_compute(state.sum(axis=1), thresholds, max_fpr=None)
+        preds, target, valid = state
+        return _binary_auroc_compute(
+            (preds.reshape(-1), target.reshape(-1), valid.reshape(-1)), None, max_fpr=None
+        )
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(state, jax.Array) and thresholds is not None:
+        weights = state[0, :, 1, :].sum(axis=-1).astype(jnp.float32)
+    else:
+        _, target, valid = state
+        weights = jnp.sum((target == 1) & valid, axis=0).astype(jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights)
+
+
+def multilabel_auroc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """AUROC for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_auroc
+        >>> preds = jnp.array([[0.75, 0.05], [0.05, 0.75]])
+        >>> target = jnp.array([[1, 0], [0, 1]])
+        >>> multilabel_auroc(preds, target, num_labels=2)
+        Array(1., dtype=float32)
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _validate_average_arg(average, allowed=("micro", "macro", "weighted", "none", None))
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    return _multilabel_auroc_compute(state, num_labels, thresholds, average, ignore_index)
+
+
+def auroc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching AUROC."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,237 @@
+"""Average precision: binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/average_precision.py``.
+AP = Σ (R_n - R_{n-1}) · P_n over the precision-recall curve.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.auroc import _validate_average_arg
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _ap_from_curve(precision: Array, recall: Array) -> Array:
+    """AP from one curve with decreasing recall."""
+    return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+
+def _reduce_average_precision(
+    precision: Union[Array, list],
+    recall: Union[Array, list],
+    average: Optional[str] = "macro",
+    weights: Optional[Array] = None,
+) -> Array:
+    if isinstance(precision, jax.Array) and precision.ndim == 2:
+        res = jax.vmap(_ap_from_curve)(precision, recall)
+    elif isinstance(precision, jax.Array):
+        res = _ap_from_curve(precision, recall)
+        return res
+    else:
+        res = jnp.stack([_ap_from_curve(p, r) for p, r in zip(precision, recall)])
+    if average in (None, "none"):
+        return res
+    idx = ~jnp.isnan(res)
+    if not isinstance(res, jax.core.Tracer) and not bool(jnp.all(idx)):
+        rank_zero_warn(
+            "Average precision score for one or more classes was `nan`. Ignoring these classes in average",
+            UserWarning,
+        )
+    if average == "macro":
+        return jnp.sum(jnp.where(idx, res, 0.0)) / jnp.sum(idx)
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, weights, 0.0)
+        weights = safe_divide(weights, jnp.sum(weights))
+        return jnp.sum(jnp.where(idx, res * weights, 0.0))
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Array:
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return _ap_from_curve(precision, recall)
+
+
+def binary_average_precision(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Average precision for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_average_precision
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> binary_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    return _binary_average_precision_compute(state, thresholds)
+
+
+def _multiclass_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = "macro",
+) -> Array:
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if isinstance(state, jax.Array) and thresholds is not None:
+        weights = state[0, :, 1, :].sum(axis=-1).astype(jnp.float32)
+    else:
+        _, target, valid = state
+        weights = jnp.stack(
+            [jnp.sum((target == c) & valid).astype(jnp.float32) for c in range(num_classes)]
+        )
+    return _reduce_average_precision(precision, recall, average, weights)
+
+
+def multiclass_average_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Average precision for multiclass tasks (one-vs-rest).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_average_precision
+        >>> preds = jnp.array([[0.75, 0.05, 0.05], [0.05, 0.75, 0.05], [0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 2])
+        >>> multiclass_average_precision(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _validate_average_arg(average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    return _multiclass_average_precision_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_average_precision_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+) -> Array:
+    if average == "micro":
+        if isinstance(state, jax.Array) and thresholds is not None:
+            return _binary_average_precision_compute(state.sum(axis=1), thresholds)
+        preds, target, valid = state
+        return _binary_average_precision_compute(
+            (preds.reshape(-1), target.reshape(-1), valid.reshape(-1)), None
+        )
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(state, jax.Array) and thresholds is not None:
+        weights = state[0, :, 1, :].sum(axis=-1).astype(jnp.float32)
+    else:
+        _, target, valid = state
+        weights = jnp.sum((target == 1) & valid, axis=0).astype(jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights)
+
+
+def multilabel_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    average: Optional[str] = "macro",
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Average precision for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_average_precision
+        >>> preds = jnp.array([[0.75, 0.05], [0.05, 0.75]])
+        >>> target = jnp.array([[1, 0], [0, 1]])
+        >>> multilabel_average_precision(preds, target, num_labels=2)
+        Array(1., dtype=float32)
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _validate_average_arg(average, allowed=("micro", "macro", "weighted", "none", None))
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    return _multilabel_average_precision_compute(state, num_labels, thresholds, average, ignore_index)
+
+
+def average_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching average precision."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_average_precision(
+            preds, target, num_classes, average, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_average_precision(
+            preds, target, num_labels, average, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

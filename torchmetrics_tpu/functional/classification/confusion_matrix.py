@@ -1,0 +1,417 @@
+"""Confusion matrix: binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/confusion_matrix.py``
+(5-part decomposition per task; normalization modes ``true/pred/all/none``).
+
+TPU-native notes: the confusion matrix is accumulated scatter-free — one-hot encodings of
+target/pred contract on the MXU (``targ_ohᵀ · pred_oh``); ``ignore_index`` removal is a
+validity mask multiplied into the target one-hot (the reference drops elements with
+boolean indexing, which has no static-shape equivalent under jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _is_traced,
+    _maybe_apply_sigmoid,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalize the confusion matrix (reference ``confusion_matrix.py:_confusion_matrix_reduce``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = confmat / confmat.sum(axis=-1, keepdims=True)
+        elif normalize == "pred":
+            confmat = confmat / confmat.sum(axis=-2, keepdims=True)
+        elif normalize == "all":
+            confmat = confmat / confmat.sum(axis=(-2, -1), keepdims=True)
+        confmat = jnp.nan_to_num(confmat, nan=0.0)
+    return confmat
+
+
+def _masked_confmat(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
+    """[C, C] counts of (target=row, pred=col) pairs where ``valid``; MXU contraction."""
+    pred_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
+    targ_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * valid.astype(jnp.float32)[:, None]
+    return jnp.einsum("nt,np->tp", targ_oh, pred_oh).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------------- binary
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
+
+
+def _binary_confusion_matrix_tensor_validation(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            "The `preds` and `target` should have the same shape,"
+            f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+        )
+    if _is_traced(preds, target):
+        return
+    unique_values = set(jnp.unique(target).tolist())
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not unique_values.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        unique_p = set(jnp.unique(preds).tolist())
+        if not unique_p.issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {sorted(unique_p)} but expected only"
+                " the following values [0,1] since preds is a label tensor."
+            )
+
+
+def _binary_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    convert_to_labels: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Returns flattened int preds/target + validity mask."""
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = _maybe_apply_sigmoid(preds)
+        if convert_to_labels:
+            preds = (preds > threshold).astype(jnp.int32)
+    elif convert_to_labels:
+        preds = preds.astype(jnp.int32)
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    return preds, target, valid
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array, valid: Array) -> Array:
+    """[2, 2] confusion matrix."""
+    return _masked_confmat(preds, target, valid, 2)
+
+
+def _binary_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute the [2, 2] confusion matrix for binary tasks.
+
+    Parity: reference ``functional/classification/confusion_matrix.py`` (binary entry).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_confusion_matrix
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> binary_confusion_matrix(preds, target)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, valid)
+    return _binary_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------------ multiclass
+
+
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
+
+
+def _multiclass_confusion_matrix_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                " equal to number of classes."
+            )
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    if _is_traced(preds, target):
+        return
+    check_value = num_classes if ignore_index is None else num_classes + 1
+    num_unique = len(jnp.unique(target))
+    if num_unique > check_value:
+        raise RuntimeError(
+            f"Detected more unique values in `target` than expected. Expected only {check_value} but found"
+            f" {num_unique} in `target`."
+        )
+
+
+def _multiclass_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+    convert_to_labels: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Argmax score inputs and flatten; returns preds/target/valid of shape [N]."""
+    if preds.ndim == target.ndim + 1 and convert_to_labels:
+        preds = jnp.argmax(preds, axis=1)
+    if convert_to_labels:
+        preds = preds.reshape(-1).astype(jnp.int32)
+    else:
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, preds.shape[1])
+    target = target.reshape(-1)
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    return preds, target, valid
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
+    """[C, C] confusion matrix via one-hot contraction."""
+    return _masked_confmat(preds, target, valid, num_classes)
+
+
+def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute the [C, C] confusion matrix for multiclass tasks (rows=target, cols=pred).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_confusion_matrix
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> multiclass_confusion_matrix(preds, target, num_classes=3)
+        Array([[1, 1, 0],
+               [0, 1, 0],
+               [0, 0, 1]], dtype=int32)
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
+    return _multiclass_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------------ multilabel
+
+
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}.")
+
+
+def _multilabel_confusion_matrix_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            "The `preds` and `target` should have the same shape,"
+            f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+        )
+    if preds.ndim < 2 or preds.shape[1] != num_labels:
+        raise ValueError("Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels")
+    if _is_traced(preds, target):
+        return
+    unique_values = set(jnp.unique(target).tolist())
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not unique_values.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _multilabel_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    should_threshold: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Returns int preds/target of shape [N, L] + validity mask [N, L]."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = _maybe_apply_sigmoid(preds)
+        if should_threshold:
+            preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    preds = jnp.moveaxis(preds.reshape(preds.shape[0], num_labels, -1), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target.reshape(target.shape[0], num_labels, -1), 1, -1).reshape(-1, num_labels)
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    return preds, target, valid
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, valid: Array, num_labels: int) -> Array:
+    """[L, 2, 2] per-label confusion matrices."""
+    v = valid.astype(jnp.int32)
+    p = (preds == 1).astype(jnp.int32)
+    t = (target == 1).astype(jnp.int32)
+    tp = jnp.sum(p * t * v, axis=0)
+    fp = jnp.sum(p * (1 - t) * v, axis=0)
+    fn = jnp.sum((1 - p) * t * v, axis=0)
+    tn = jnp.sum((1 - p) * (1 - t) * v, axis=0)
+    return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_labels, 2, 2).astype(jnp.int32)
+
+
+def _multilabel_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute the [L, 2, 2] per-label confusion matrices for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_confusion_matrix
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_confusion_matrix(preds, target, num_labels=3)
+        Array([[[1, 0],
+                [0, 1]],
+        <BLANKLINE>
+               [[1, 0],
+                [1, 0]],
+        <BLANKLINE>
+               [[0, 1],
+                [0, 1]]], dtype=int32)
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, valid, num_labels)
+    return _multilabel_confusion_matrix_compute(confmat, normalize)
+
+
+# -------------------------------------------------------------------------- dispatch
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching confusion matrix."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_confusion_matrix(
+            preds, target, num_labels, threshold, normalize, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,141 @@
+"""Exact match (subset accuracy): multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/exact_match.py``.
+A sample counts as correct only if *every* element (multidim position / label) matches;
+``ignore_index`` positions are masked out of the all-reduce.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoBinary
+
+Array = jax.Array
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Per-sample all-match indicator; returns (correct, total)."""
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    match = (preds == target) | ~valid
+    correct = jnp.all(match, axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(target.shape[0], dtype=jnp.int32)
+    return correct, jnp.ones_like(correct)
+
+
+def multiclass_exact_match(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Exact match for multidim multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_exact_match
+        >>> target = jnp.array([[0, 1], [2, 1]])
+        >>> preds = jnp.array([[0, 1], [2, 2]])
+        >>> multiclass_exact_match(preds, target, num_classes=3)
+        Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, 1)
+    correct, total = _multiclass_exact_match_update(preds, target, multidim_average, ignore_index)
+    return _exact_match_reduce(correct, total)
+
+
+def _multilabel_exact_match_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_labels: int,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array]:
+    """Per-sample all-labels-match indicator over [N, L, X] inputs."""
+    match = (preds == target) | ~valid
+    correct = jnp.all(match, axis=1).astype(jnp.int32)  # [N, X]
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(correct.size, dtype=jnp.int32)
+    return jnp.sum(correct, axis=1), jnp.asarray(correct.shape[1], dtype=jnp.int32) * jnp.ones(
+        correct.shape[0], dtype=jnp.int32
+    )
+
+
+def multilabel_exact_match(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Exact match for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_exact_match
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_exact_match(preds, target, num_labels=3)
+        Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, valid = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, valid, num_labels, multidim_average)
+    return _exact_match_reduce(correct, total)
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching exact match (multiclass / multilabel only)."""
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTaskNoBinary.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_exact_match(
+            preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,271 @@
+"""F-beta / F1: binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/f_beta.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from torchmetrics_tpu.functional.classification._stat_reduce import _fbeta_reduce
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multiclass_stat_scores_update,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _fbeta_arg_check(beta: float) -> None:
+    if not (isinstance(beta, float) and beta > 0):
+        raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
+
+
+def binary_fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """F-beta for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_fbeta_score
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> binary_fbeta_score(preds, target, beta=2.0)
+        Array(0.6666667, dtype=float32)
+    """
+    if validate_args:
+        _fbeta_arg_check(beta)
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, valid, multidim_average)
+    return _fbeta_reduce(
+        tp, fp, tn, fn, beta, average="binary", multidim_average=multidim_average, zero_division=zero_division
+    )
+
+
+def multiclass_fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """F-beta for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_fbeta_score
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> multiclass_fbeta_score(preds, target, beta=2.0, num_classes=3)
+        Array(0.7962963, dtype=float32)
+    """
+    if validate_args:
+        _fbeta_arg_check(beta)
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
+    return _fbeta_reduce(
+        tp, fp, tn, fn, beta, average=average, multidim_average=multidim_average, zero_division=zero_division
+    )
+
+
+def multilabel_fbeta_score(
+    preds: Array,
+    target: Array,
+    beta: float,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """F-beta for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_fbeta_score
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_fbeta_score(preds, target, beta=2.0, num_labels=3)
+        Array(0.6666667, dtype=float32)
+    """
+    if validate_args:
+        _fbeta_arg_check(beta)
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, valid = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, multidim_average)
+    return _fbeta_reduce(
+        tp, fp, tn, fn, beta, average=average, multidim_average=multidim_average,
+        multilabel=True, zero_division=zero_division,
+    )
+
+
+def binary_f1_score(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """F1 for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_f1_score
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> binary_f1_score(preds, target)
+        Array(0.6666667, dtype=float32)
+    """
+    return binary_fbeta_score(
+        preds, target, 1.0, threshold, multidim_average, ignore_index, validate_args, zero_division
+    )
+
+
+def multiclass_f1_score(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """F1 for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_f1_score
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> multiclass_f1_score(preds, target, num_classes=3)
+        Array(0.7777778, dtype=float32)
+    """
+    return multiclass_fbeta_score(
+        preds, target, 1.0, num_classes, average, top_k, multidim_average, ignore_index, validate_args, zero_division
+    )
+
+
+def multilabel_f1_score(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """F1 for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_f1_score
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_f1_score(preds, target, num_labels=3)
+        Array(0.6666667, dtype=float32)
+    """
+    return multilabel_fbeta_score(
+        preds, target, 1.0, num_labels, threshold, average, multidim_average, ignore_index, validate_args, zero_division
+    )
+
+
+def fbeta_score(
+    preds: Array,
+    target: Array,
+    task: str,
+    beta: float = 1.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """Task-dispatching F-beta."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_fbeta_score(
+            preds, target, beta, threshold, multidim_average, ignore_index, validate_args, zero_division
+        )
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        if not isinstance(top_k, int):
+            raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+        return multiclass_fbeta_score(
+            preds, target, beta, num_classes, average, top_k, multidim_average, ignore_index, validate_args,
+            zero_division,
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_fbeta_score(
+            preds, target, beta, num_labels, threshold, average, multidim_average, ignore_index, validate_args,
+            zero_division,
+        )
+    raise ValueError(f"Not handled value: {task}")
+
+
+def f1_score(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """Task-dispatching F1."""
+    return fbeta_score(
+        preds, target, task, 1.0, threshold, num_classes, num_labels, average, multidim_average, top_k,
+        ignore_index, validate_args, zero_division,
+    )

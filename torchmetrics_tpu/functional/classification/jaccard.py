@@ -1,0 +1,195 @@
+"""Jaccard index (IoU): binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/jaccard.py``.
+Computed from confusion matrices; per-class IoU = diag / (rowsum + colsum - diag).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_tensor_validation,
+    _multilabel_confusion_matrix_update,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _jaccard_index_arg_validation(average: Optional[str]) -> None:
+    allowed_average = ("micro", "macro", "weighted", "none", None, "binary")
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}.")
+
+
+def _jaccard_index_reduce(
+    confmat: Array,
+    average: Optional[str],
+    ignore_index: Optional[int] = None,
+    zero_division: float = 0.0,
+) -> Array:
+    """Reduce confusion matrix/matrices to the Jaccard score.
+
+    Parity: reference ``functional/classification/jaccard.py:_jaccard_index_reduce`` —
+    ``ignore_index`` (when a valid class id) is excluded from micro sums and
+    macro/weighted weights.
+    """
+    confmat = confmat.astype(jnp.float32)
+    if average == "binary":
+        return safe_divide(confmat[1, 1], confmat[0, 1] + confmat[1, 0] + confmat[1, 1], zero_division)
+
+    multilabel = confmat.ndim == 3
+    ignore_index_cond = ignore_index is not None and 0 <= ignore_index < confmat.shape[0]
+    if multilabel:
+        num = confmat[:, 1, 1]
+        denom = confmat[:, 1, 1] + confmat[:, 0, 1] + confmat[:, 1, 0]
+    else:
+        num = jnp.diagonal(confmat)
+        denom = confmat.sum(axis=0) + confmat.sum(axis=1) - num
+
+    if average == "micro":
+        if ignore_index_cond:
+            keep = jnp.arange(num.shape[0]) != ignore_index
+            num = jnp.where(keep, num, 0.0)
+            denom = jnp.where(keep, denom, 0.0)
+        return safe_divide(num.sum(), denom.sum(), zero_division)
+
+    jaccard = safe_divide(num, denom, zero_division)
+    if average is None or average == "none":
+        return jaccard
+    if average == "weighted":
+        weights = confmat[:, 1, 1] + confmat[:, 1, 0] if multilabel else confmat.sum(axis=1)
+    else:
+        weights = jnp.ones_like(jaccard)
+        if not multilabel:
+            weights = jnp.where(confmat.sum(axis=1) + confmat.sum(axis=0) == 0, 0.0, weights)
+    if ignore_index_cond:
+        weights = jnp.where(jnp.arange(weights.shape[0]) == ignore_index, 0.0, weights)
+    return (weights * jaccard / weights.sum()).sum()
+
+
+def binary_jaccard_index(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """Jaccard index for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_jaccard_index
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> binary_jaccard_index(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, valid)
+    return _jaccard_index_reduce(confmat, average="binary", zero_division=zero_division)
+
+
+def multiclass_jaccard_index(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """Jaccard index for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_jaccard_index
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> multiclass_jaccard_index(preds, target, num_classes=3)
+        Array(0.7777778, dtype=float32)
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
+        _jaccard_index_arg_validation(average)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
+    return _jaccard_index_reduce(confmat, average, ignore_index, zero_division)
+
+
+def multilabel_jaccard_index(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """Jaccard index for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_jaccard_index
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
+        >>> multilabel_jaccard_index(preds, target, num_labels=3)
+        Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize=None)
+        _jaccard_index_arg_validation(average)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, valid, num_labels)
+    return _jaccard_index_reduce(confmat, average, ignore_index=ignore_index, zero_division=zero_division)
+
+
+def jaccard_index(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0.0,
+) -> Array:
+    """Task-dispatching Jaccard index."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args, zero_division)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_jaccard_index(preds, target, num_classes, average, ignore_index, validate_args, zero_division)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_jaccard_index(
+            preds, target, num_labels, threshold, average, ignore_index, validate_args, zero_division
+        )
+    raise ValueError(f"Not handled value: {task}")

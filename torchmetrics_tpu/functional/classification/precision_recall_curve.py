@@ -1,0 +1,585 @@
+"""Precision-recall curve core: binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/precision_recall_curve.py``.
+The whole threshold-curve family (ROC, AUROC, AveragePrecision, *@fixed-X) derives from
+the state computed here.
+
+TPU-native design:
+
+- **Binned mode (``thresholds`` given) is the native default for the module classes**: the
+  state is a static-shape ``[T, 2, 2]`` (binary) / ``[T, C, 2, 2]`` (multi) confusion
+  accumulator. The per-batch update is two MXU contractions
+  (``tp[t,c] = Σ_n (pred[n,c] ≥ thr[t]) · target_oh[n,c]``) — no scatters, no sorting,
+  fully jit/psum-able.
+- **Unbinned mode (``thresholds=None``)** matches sklearn exactly: sort + cumsum +
+  duplicate-threshold dedup. Dedup yields data-dependent shapes, so this path runs
+  eagerly (the module classes hold ragged list states for it, like the reference).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import _is_traced
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _adjust_threshold_arg(thresholds: Union[int, Sequence[float], Array, None]):
+    """Convert the ``thresholds`` argument to a tensor (or None for unbinned)."""
+    if thresholds is None:
+        return None
+    if isinstance(thresholds, int):
+        return jnp.linspace(0.0, 1.0, thresholds)
+    if isinstance(thresholds, (list, tuple)):
+        return jnp.asarray(thresholds, dtype=jnp.float32)
+    return jnp.asarray(thresholds)
+
+
+def _validate_thresholds_arg(thresholds) -> None:
+    if thresholds is not None and not isinstance(thresholds, (int, list, tuple, jax.Array)):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or an array of floats,"
+            f" but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}")
+    if isinstance(thresholds, (list, tuple)) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            f"If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range, but got {thresholds}"
+        )
+
+
+def _maybe_softmax(preds: Array, axis: int = -1) -> Array:
+    needs = jnp.logical_or(jnp.min(preds) < 0, jnp.max(preds) > 1)
+    return jnp.where(needs, jax.nn.softmax(preds, axis=axis), preds)
+
+
+def _maybe_sigmoid(preds: Array) -> Array:
+    needs = jnp.logical_or(jnp.min(preds) < 0, jnp.max(preds) > 1)
+    return jnp.where(needs, jax.nn.sigmoid(preds), preds)
+
+
+# ----------------------------------------------------------------------- clf curve
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Array] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps/thresholds at distinct prediction values (sklearn semantics; eager only)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    weight = jnp.ones_like(preds, dtype=jnp.float32) if sample_weights is None else jnp.asarray(sample_weights)
+
+    desc = jnp.argsort(preds)[::-1]
+    preds = preds[desc]
+    target = target[desc]
+    weight = weight[desc]
+
+    distinct = jnp.nonzero(jnp.diff(preds) != 0)[0]
+    threshold_idxs = jnp.concatenate([distinct, jnp.array([target.shape[0] - 1])])
+
+    target = (target == pos_label).astype(jnp.float32)
+    tps = jnp.cumsum(target * weight)[threshold_idxs]
+    fps = jnp.cumsum((1 - target) * weight)[threshold_idxs]
+    return fps, tps, preds[threshold_idxs]
+
+
+# --------------------------------------------------------------------------- binary
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _validate_thresholds_arg(thresholds)
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            "The `preds` and `target` should have the same shape,"
+            f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+        )
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be a float tensor with probabilities/logits")
+    if _is_traced(preds, target):
+        return
+    unique_values = set(jnp.unique(target).tolist())
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    if not unique_values.issubset(allowed):
+        raise RuntimeError(
+            f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+            f" the following values {sorted(allowed)}."
+        )
+
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    """Flatten, sigmoid-if-logits; returns (preds, target, valid, thresholds)."""
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    preds = _maybe_sigmoid(preds)
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    return preds, target, valid, _adjust_threshold_arg(thresholds)
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array, Array]]:
+    """Binned: [T, 2, 2] confusion accumulator (two MXU contractions). Unbinned: raw pair."""
+    if thresholds is None:
+        return preds, target, valid
+    v = valid.astype(jnp.float32)
+    t1 = target.astype(jnp.float32) * v  # positives
+    t0 = (1.0 - target.astype(jnp.float32)) * v  # negatives
+    pge = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # [N, T]
+    tps = pge.T @ t1  # [T]
+    fps = pge.T @ t0
+    pos = jnp.sum(t1)
+    neg = jnp.sum(t0)
+    fns = pos - tps
+    tns = neg - fps
+    # layout [t, target, pred]
+    return jnp.stack(
+        [jnp.stack([tns, fps], axis=-1), jnp.stack([fns, tps], axis=-1)], axis=-2
+    ).astype(jnp.int32)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """(precision, recall, thresholds)."""
+    if thresholds is not None and isinstance(state, jax.Array):
+        tps = state[:, 1, 1].astype(jnp.float32)
+        fps = state[:, 0, 1].astype(jnp.float32)
+        fns = state[:, 1, 0].astype(jnp.float32)
+        precision = safe_divide(tps, tps + fps)
+        recall = safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+    preds, target, valid = state
+    if _is_traced(preds, target, valid):
+        # jit-safe static-shape variant: no duplicate-threshold dedup and no
+        # truncation at full recall. Ignored elements keep weight 0, so they become
+        # zero-width curve segments — AP/AUROC integrals are unaffected. Exact equal
+        # to sklearn when prediction values are distinct.
+        order = jnp.argsort(preds)[::-1]
+        w = valid[order].astype(jnp.float32)
+        t_s = target[order].astype(jnp.float32) * w
+        tps = jnp.cumsum(t_s)
+        fps = jnp.cumsum(w) - tps
+        precision = safe_divide(tps, tps + fps)
+        recall = safe_divide(tps, tps[-1])
+        precision = jnp.concatenate([precision[::-1], jnp.ones(1)])
+        recall = jnp.concatenate([recall[::-1], jnp.zeros(1)])
+        return precision, recall, preds[order][::-1]
+    # eager path: drop ignored elements (dynamic shape OK outside jit)
+    keep = jnp.nonzero(valid)[0]
+    preds, target = preds[keep], target[keep]
+    fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+    # stop once full recall is attained, reverse so recall is decreasing, close curve
+    # at (recall=0, precision=1) — sklearn/reference convention
+    last_ind = int(jnp.nonzero(tps == tps[-1])[0][0])
+    sl = slice(0, last_ind + 1)
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1)])
+    thres = thres[sl][::-1]
+    return precision, recall, thres
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Precision-recall pairs as the decision threshold varies.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_precision_recall_curve
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> precision, recall, thresholds = binary_precision_recall_curve(preds, target, thresholds=5)
+        >>> precision
+        Array([0.5      , 0.6666667, 1.       , 1.       , 0.       , 1.       ],      dtype=float32)
+        >>> recall
+        Array([1. , 1. , 0.5, 0.5, 0. , 0. ], dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# ------------------------------------------------------------------------ multiclass
+
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if average not in (None, "micro", "macro"):
+        raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target`")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected `preds` to be a float tensor with probabilities/logits")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]` to equal `num_classes` ({num_classes}), got {preds.shape[1]}")
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError("Expected shapes (N, C, ...) for `preds` and (N, ...) for `target`")
+    if _is_traced(preds, target):
+        return
+    num_unique = len(jnp.unique(target))
+    check = num_classes if ignore_index is None else num_classes + 1
+    if num_unique > check:
+        raise RuntimeError(f"Detected more unique values in `target` than expected ({num_unique} > {check})")
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    """Returns (preds [N, C], target [N], valid [N], thresholds)."""
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
+    target = jnp.asarray(target).reshape(-1)
+    preds = _maybe_softmax(preds, axis=-1)
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    if average == "micro":
+        # flatten the one-vs-rest decomposition into ONE binary problem over (n, c) pairs
+        target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32)
+        valid_b = jnp.broadcast_to(valid[:, None], preds.shape).reshape(-1)
+        return preds.reshape(-1), target_oh.reshape(-1), valid_b, _adjust_threshold_arg(thresholds)
+    return preds, target, valid, _adjust_threshold_arg(thresholds)
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array, Array]]:
+    """Binned: [T, C, 2, 2] accumulator via MXU contractions. Unbinned: raw triple."""
+    if thresholds is None:
+        return preds, target, valid
+    v = valid.astype(jnp.float32)
+    targ_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * v[:, None]  # [N, C]
+    neg_oh = (1.0 - jax.nn.one_hot(target, num_classes, dtype=jnp.float32)) * v[:, None]
+    pge = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # [N, C, T]
+    tps = jnp.einsum("nct,nc->tc", pge, targ_oh)
+    fps = jnp.einsum("nct,nc->tc", pge, neg_oh)
+    pos = jnp.sum(targ_oh, axis=0)  # [C]
+    neg = jnp.sum(neg_oh, axis=0)
+    fns = pos[None, :] - tps
+    tns = neg[None, :] - fps
+    return jnp.stack(
+        [jnp.stack([tns, fps], axis=-1), jnp.stack([fns, tps], axis=-1)], axis=-2
+    ).astype(jnp.int32)  # [T, C, 2, 2]
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+):
+    """(precision, recall, thresholds) — tensors when binned, lists when unbinned."""
+    if average == "micro":
+        return _binary_precision_recall_curve_compute(state, thresholds)
+    if thresholds is not None and isinstance(state, jax.Array):
+        tps = state[:, :, 1, 1].astype(jnp.float32)
+        fps = state[:, :, 0, 1].astype(jnp.float32)
+        fns = state[:, :, 1, 0].astype(jnp.float32)
+        precision = safe_divide(tps, tps + fps)
+        recall = safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)], axis=0).T
+        if average == "macro":
+            return _pr_curve_macro_average(precision, recall, thresholds, num_classes)
+        return precision, recall, thresholds
+    preds, target, valid = state
+    if not _is_traced(preds, target, valid):
+        keep = jnp.nonzero(valid)[0]
+        preds, target = preds[keep], target[keep]
+        valid = jnp.ones(target.shape[0], dtype=jnp.bool_)
+    precisions, recalls, thresh = [], [], []
+    for c in range(num_classes):
+        p, r, t = _binary_precision_recall_curve_compute(
+            (preds[:, c], (target == c).astype(jnp.int32), valid), None
+        )
+        precisions.append(p)
+        recalls.append(r)
+        thresh.append(t)
+    if average == "macro":
+        return _pr_curve_macro_average(precisions, recalls, thresh, num_classes)
+    return precisions, recalls, thresh
+
+
+def _pr_curve_macro_average(precision, recall, thres, num_classes: int):
+    """Macro-average per-class PR curves: interpolate each class's recall onto the
+    sorted union of precisions and average (reference
+    ``precision_recall_curve.py:573-585``)."""
+    if isinstance(precision, jax.Array) and precision.ndim == 2:
+        all_thres = jnp.sort(jnp.tile(thres, num_classes))
+        mean_precision = jnp.sort(precision.flatten())
+        per_class = [jnp.interp(mean_precision, precision[i], recall[i]) for i in range(num_classes)]
+    else:
+        all_thres = jnp.sort(jnp.concatenate(thres))
+        mean_precision = jnp.sort(jnp.concatenate(precision))
+        per_class = [jnp.interp(mean_precision, p, r) for p, r in zip(precision, recall)]
+    mean_recall = jnp.stack(per_class).mean(axis=0)
+    return mean_precision, mean_recall, all_thres
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-class precision-recall curves (one-vs-rest).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_precision_recall_curve
+        >>> preds = jnp.array([[0.75, 0.05, 0.05], [0.05, 0.75, 0.05], [0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 2])
+        >>> precision, recall, thresholds = multiclass_precision_recall_curve(
+        ...     preds, target, num_classes=3, thresholds=5)
+        >>> precision.shape, recall.shape
+        ((3, 6), (3, 6))
+    """
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    if average == "micro":
+        state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+        return _binary_precision_recall_curve_compute(state, thresholds)
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds, average)
+
+
+# ------------------------------------------------------------------------ multilabel
+
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            "The `preds` and `target` should have the same shape,"
+            f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+        )
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected `preds` to be a float tensor with probabilities/logits")
+    if preds.ndim < 2 or preds.shape[1] != num_labels:
+        raise ValueError("Expected `preds.shape[1]` to equal the number of labels")
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds=None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    """Returns (preds [N, L], target [N, L], valid [N, L], thresholds)."""
+    preds = jnp.moveaxis(jnp.asarray(preds).reshape(preds.shape[0], num_labels, -1), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(jnp.asarray(target).reshape(target.shape[0], num_labels, -1), 1, -1).reshape(-1, num_labels)
+    preds = _maybe_sigmoid(preds)
+    valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    return preds, target, valid, _adjust_threshold_arg(thresholds)
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_labels: int,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array, Array]]:
+    """Binned: [T, L, 2, 2] accumulator. Unbinned: raw triple."""
+    if thresholds is None:
+        return preds, target, valid
+    v = valid.astype(jnp.float32)
+    t1 = target.astype(jnp.float32) * v  # [N, L]
+    t0 = (1.0 - target.astype(jnp.float32)) * v
+    pge = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # [N, L, T]
+    tps = jnp.einsum("nlt,nl->tl", pge, t1)
+    fps = jnp.einsum("nlt,nl->tl", pge, t0)
+    pos = jnp.sum(t1, axis=0)
+    neg = jnp.sum(t0, axis=0)
+    fns = pos[None, :] - tps
+    tns = neg[None, :] - fps
+    return jnp.stack(
+        [jnp.stack([tns, fps], axis=-1), jnp.stack([fns, tps], axis=-1)], axis=-2
+    ).astype(jnp.int32)  # [T, L, 2, 2]
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    """(precision, recall, thresholds) per label."""
+    if thresholds is not None and isinstance(state, jax.Array):
+        tps = state[:, :, 1, 1].astype(jnp.float32)
+        fps = state[:, :, 0, 1].astype(jnp.float32)
+        fns = state[:, :, 1, 0].astype(jnp.float32)
+        precision = safe_divide(tps, tps + fps)
+        recall = safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_labels), dtype=precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)], axis=0).T
+        return precision, recall, thresholds
+    preds, target, valid = state
+    precisions, recalls, thresh = [], [], []
+    traced = _is_traced(preds, target, valid)
+    for ll in range(num_labels):
+        if traced:
+            p, r, t = _binary_precision_recall_curve_compute(
+                (preds[:, ll], target[:, ll], valid[:, ll]), None
+            )
+        else:
+            keep = jnp.nonzero(valid[:, ll])[0]
+            p, r, t = _binary_precision_recall_curve_compute(
+                (preds[keep, ll], target[keep, ll], jnp.ones(keep.shape[0], dtype=jnp.bool_)), None
+            )
+        precisions.append(p)
+        recalls.append(r)
+        thresh.append(t)
+    return precisions, recalls, thresh
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-label precision-recall curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_precision_recall_curve
+        >>> preds = jnp.array([[0.75, 0.05], [0.05, 0.75]])
+        >>> target = jnp.array([[1, 0], [0, 1]])
+        >>> precision, recall, thresholds = multilabel_precision_recall_curve(
+        ...     preds, target, num_labels=2, thresholds=5)
+        >>> precision.shape
+        (2, 6)
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+# -------------------------------------------------------------------------- dispatch
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching precision-recall curve."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_recall_curve(
+            preds, target, num_classes, thresholds, average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

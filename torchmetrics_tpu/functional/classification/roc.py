@@ -1,0 +1,267 @@
+"""ROC curves: binary / multiclass / multilabel + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/roc.py``.
+Shares formats/updates (and therefore module state) with the precision-recall curve.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.stat_scores import _is_traced
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """(fpr, tpr, thresholds), thresholds in decreasing order."""
+    if thresholds is not None and isinstance(state, jax.Array):
+        tps = state[:, 1, 1].astype(jnp.float32)
+        fps = state[:, 0, 1].astype(jnp.float32)
+        fns = state[:, 1, 0].astype(jnp.float32)
+        tns = state[:, 0, 0].astype(jnp.float32)
+        tpr = safe_divide(tps, tps + fns)[::-1]
+        fpr = safe_divide(fps, fps + tns)[::-1]
+        return fpr, tpr, thresholds[::-1]
+    preds, target, valid = state
+    if _is_traced(preds, target, valid):
+        # jit-safe static-shape variant (no dedup; masked elements = zero-width segments)
+        order = jnp.argsort(preds)[::-1]
+        w = valid[order].astype(jnp.float32)
+        t_s = target[order].astype(jnp.float32) * w
+        tps = jnp.concatenate([jnp.zeros(1), jnp.cumsum(t_s)])
+        fps = jnp.concatenate([jnp.zeros(1), jnp.cumsum(w) - jnp.cumsum(t_s)])
+        thres = jnp.concatenate([preds[order][:1] + 1.0, preds[order]])
+        return safe_divide(fps, fps[-1]), safe_divide(tps, tps[-1]), thres
+    keep = jnp.nonzero(valid)[0]
+    preds, target = preds[keep], target[keep]
+    fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label)
+    # prepend the (0, 0) origin; threshold there is 1 + max score (sklearn convention)
+    tps = jnp.concatenate([jnp.zeros(1), tps])
+    fps = jnp.concatenate([jnp.zeros(1), fps])
+    thres = jnp.concatenate([thres[:1] + 1.0, thres])
+    tpr = safe_divide(tps, tps[-1])
+    fpr = safe_divide(fps, fps[-1])
+    return fpr, tpr, thres
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """ROC curve for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_roc
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> fpr, tpr, thresholds = binary_roc(preds, target, thresholds=5)
+        >>> tpr
+        Array([0. , 0.5, 0.5, 1. , 1. ], dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _roc_macro_average(fpr, tpr, thres, num_classes: int):
+    """Macro-average per-class ROC curves: interpolate each class's tpr onto the sorted
+    union of fprs and average (reference ``roc.py:189-201``)."""
+    if isinstance(fpr, jax.Array) and fpr.ndim == 2:
+        all_thres = jnp.sort(jnp.tile(thres, num_classes))[::-1]
+        mean_fpr = jnp.sort(fpr.flatten())
+        per_class = [jnp.interp(mean_fpr, fpr[i], tpr[i]) for i in range(num_classes)]
+    else:
+        all_thres = jnp.sort(jnp.concatenate(thres))[::-1]
+        mean_fpr = jnp.sort(jnp.concatenate(fpr))
+        per_class = [jnp.interp(mean_fpr, f, t) for f, t in zip(fpr, tpr)]
+    mean_tpr = jnp.stack(per_class).mean(axis=0)
+    return mean_fpr, mean_tpr, all_thres
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+):
+    if average == "micro":
+        return _binary_roc_compute(state, thresholds)
+    if thresholds is not None and isinstance(state, jax.Array):
+        tps = state[:, :, 1, 1].astype(jnp.float32)
+        fps = state[:, :, 0, 1].astype(jnp.float32)
+        fns = state[:, :, 1, 0].astype(jnp.float32)
+        tns = state[:, :, 0, 0].astype(jnp.float32)
+        tpr = safe_divide(tps, tps + fns)[::-1].T  # [C, T]
+        fpr = safe_divide(fps, fps + tns)[::-1].T
+        if average == "macro":
+            return _roc_macro_average(fpr, tpr, thresholds[::-1], num_classes)
+        return fpr, tpr, thresholds[::-1]
+    preds, target, valid = state
+    if not _is_traced(preds, target, valid):
+        keep = jnp.nonzero(valid)[0]
+        preds, target = preds[keep], target[keep]
+        valid = jnp.ones(target.shape[0], dtype=jnp.bool_)
+    fprs, tprs, thres = [], [], []
+    for c in range(num_classes):
+        f, t, th = _binary_roc_compute(
+            (preds[:, c], (target == c).astype(jnp.int32), valid), None
+        )
+        fprs.append(f)
+        tprs.append(t)
+        thres.append(th)
+    if average == "macro":
+        return _roc_macro_average(fprs, tprs, thres, num_classes)
+    return fprs, tprs, thres
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-class one-vs-rest ROC curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_roc
+        >>> preds = jnp.array([[0.75, 0.05, 0.05], [0.05, 0.75, 0.05], [0.05, 0.05, 0.75]])
+        >>> target = jnp.array([0, 1, 2])
+        >>> fpr, tpr, thresholds = multiclass_roc(preds, target, num_classes=3, thresholds=5)
+        >>> fpr.shape, tpr.shape
+        ((3, 5), (3, 5))
+    """
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    if average == "micro":
+        state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+        return _binary_roc_compute(state, thresholds)
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    return _multiclass_roc_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_roc_compute(
+    state: Union[Array, Tuple[Array, Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    if thresholds is not None and isinstance(state, jax.Array):
+        tps = state[:, :, 1, 1].astype(jnp.float32)
+        fps = state[:, :, 0, 1].astype(jnp.float32)
+        fns = state[:, :, 1, 0].astype(jnp.float32)
+        tns = state[:, :, 0, 0].astype(jnp.float32)
+        tpr = safe_divide(tps, tps + fns)[::-1].T
+        fpr = safe_divide(fps, fps + tns)[::-1].T
+        return fpr, tpr, thresholds[::-1]
+    preds, target, valid = state
+    fprs, tprs, thres = [], [], []
+    traced = _is_traced(preds, target, valid)
+    for ll in range(num_labels):
+        if traced:
+            f, t, th = _binary_roc_compute((preds[:, ll], target[:, ll], valid[:, ll]), None)
+        else:
+            keep = jnp.nonzero(valid[:, ll])[0]
+            f, t, th = _binary_roc_compute(
+                (preds[keep, ll], target[keep, ll], jnp.ones(keep.shape[0], dtype=jnp.bool_)), None
+            )
+        fprs.append(f)
+        tprs.append(t)
+        thres.append(th)
+    return fprs, tprs, thres
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Per-label ROC curves.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_roc
+        >>> preds = jnp.array([[0.75, 0.05], [0.05, 0.75]])
+        >>> target = jnp.array([[1, 0], [0, 1]])
+        >>> fpr, tpr, thresholds = multilabel_roc(preds, target, num_labels=2, thresholds=5)
+        >>> fpr.shape
+        (2, 5)
+    """
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching ROC."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_roc(preds, target, num_classes, thresholds, average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

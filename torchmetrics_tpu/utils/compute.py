@@ -1,0 +1,49 @@
+"""Shared numeric helpers (AUC integration, interpolation).
+
+Parity: reference ``src/torchmetrics/utilities/compute.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_trapezoid = getattr(jnp, "trapezoid", None) or jnp.trapz
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float = 1.0, axis: int = -1) -> Array:
+    """Area under the curve via trapezoidal rule (inputs assumed sorted along x)."""
+    return (_trapezoid(y, x, axis=axis) * direction).astype(jnp.float32)
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """AUC with monotonicity handling: auto-detects decreasing x (direction = -1)."""
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    dx = jnp.diff(x)
+    direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve y = f(x).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.utils.compute import auc
+        >>> x = jnp.array([0.0, 0.5, 1.0])
+        >>> y = jnp.array([0.0, 0.8, 1.0])
+        >>> auc(x, y)
+        Array(0.65, dtype=float32)
+    """
+    if x.ndim != 1 or y.ndim != 1:
+        raise ValueError(f"Expected both `x` and `y` to be 1d tensors, got {x.ndim}d and {y.ndim}d")
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """Linear interpolation (ascending ``xp``)."""
+    return jnp.interp(x, xp, fp)
