@@ -1,0 +1,256 @@
+"""Differential tests for operating-point metrics, calibration error, hinge loss, and
+multilabel ranking metrics.
+
+Reference pattern: ``tests/unittests/classification/test_{recall_fixed_precision,
+specificity_sensitivity,calibration_error,hinge,ranking}.py``.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import coverage_error as sk_coverage
+from sklearn.metrics import hinge_loss as sk_hinge
+from sklearn.metrics import label_ranking_average_precision_score as sk_lrap
+from sklearn.metrics import label_ranking_loss as sk_rloss
+from sklearn.metrics import precision_recall_curve as sk_prc
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import (
+    BinaryCalibrationError,
+    BinaryHingeLoss,
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity,
+    CalibrationError,
+    HingeLoss,
+    MulticlassCalibrationError,
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+    RecallAtFixedPrecision,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_calibration_error,
+    binary_hinge_loss,
+    binary_recall_at_fixed_precision,
+    multiclass_calibration_error,
+    multiclass_hinge_loss,
+    multiclass_recall_at_fixed_precision,
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 4, 64, 5, 4
+rng = np.random.RandomState(17)
+
+_binary_inputs = (rng.rand(NUM_BATCHES, BATCH_SIZE), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+_mc_inputs = (
+    np.exp(rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+_mc_inputs = (_mc_inputs[0] / _mc_inputs[0].sum(-1, keepdims=True), _mc_inputs[1])
+_ml_inputs = (
+    rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS),
+    rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS)),
+)
+
+
+def _sk_recall_at_precision(p, t, min_precision):
+    precision, recall, thresholds = sk_prc(t.flatten(), p.flatten())
+    feasible = [(r, th) for prec, r, th in zip(precision[:-1], recall[:-1], thresholds) if prec >= min_precision]
+    return max((r for r, _ in feasible), default=0.0)
+
+
+class TestFixedOperatingPoint(MetricTester):
+    @pytest.mark.parametrize("min_precision", [0.3, 0.6, 0.9])
+    def test_binary_recall_at_precision_unbinned(self, min_precision):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        p, t = preds.flatten(), target.flatten()
+        recall, thr = binary_recall_at_fixed_precision(jnp.asarray(p), jnp.asarray(t), min_precision)
+        np.testing.assert_allclose(float(recall), _sk_recall_at_precision(p, t, min_precision), atol=1e-5)
+
+    def test_binary_recall_at_precision_class(self):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        m = BinaryRecallAtFixedPrecision(min_precision=0.5, thresholds=1000)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        recall, thr = m.compute()
+        expected = _sk_recall_at_precision(preds.flatten(), target.flatten(), 0.5)
+        np.testing.assert_allclose(float(recall), expected, atol=5e-3)
+
+    def test_binary_precision_at_recall_threshold(self):
+        import jax.numpy as jnp
+
+        m = BinaryPrecisionAtFixedRecall(min_recall=0.5)
+        preds, target = _binary_inputs
+        precision, thr = m(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        assert 0 <= float(precision) <= 1
+        assert 0 <= float(thr) <= 1
+
+    def test_spec_at_sens_and_sens_at_spec(self):
+        import jax.numpy as jnp
+
+        preds, target = _binary_inputs
+        p, t = jnp.asarray(preds.flatten()), jnp.asarray(target.flatten())
+        spec, thr1 = BinarySpecificityAtSensitivity(min_sensitivity=0.5)(p, t)
+        sens, thr2 = BinarySensitivityAtSpecificity(min_specificity=0.5)(p, t)
+        # verify the returned thresholds actually achieve the floors (float32: the
+        # metric computes in f32, so thresholding must use the same precision)
+        pn, tn = preds.flatten().astype(np.float32), target.flatten()
+        hard1 = (pn >= float(thr1)).astype(int)
+        tpr1 = ((hard1 == 1) & (tn == 1)).sum() / (tn == 1).sum()
+        assert tpr1 >= 0.5 - 1e-6
+        spec_check = ((hard1 == 0) & (tn == 0)).sum() / (tn == 0).sum()
+        np.testing.assert_allclose(float(spec), spec_check, atol=1e-6)
+        hard2 = (pn >= float(thr2)).astype(int)
+        spec2 = ((hard2 == 0) & (tn == 0)).sum() / (tn == 0).sum()
+        assert spec2 >= 0.5 - 1e-6
+
+    def test_multiclass_shapes(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        recall, thr = multiclass_recall_at_fixed_precision(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), NUM_CLASSES, 0.5, thresholds=100
+        )
+        assert recall.shape == thr.shape == (NUM_CLASSES,)
+
+    def test_task_dispatch(self):
+        assert isinstance(RecallAtFixedPrecision(task="binary", min_precision=0.5), BinaryRecallAtFixedPrecision)
+
+
+class TestCalibrationError(MetricTester):
+    @staticmethod
+    def _sk_ece(p, t, n_bins=15, norm="l1"):
+        p, t = p.flatten(), t.flatten()
+        conf = np.where(p > 0.5, p, 1 - p)
+        acc = ((p > 0.5).astype(int) == t).astype(float)
+        bins = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
+        ece, mx = 0.0, 0.0
+        for b in range(n_bins):
+            mask = bins == b
+            if not mask.any():
+                continue
+            gap = abs(acc[mask].mean() - conf[mask].mean())
+            prop = mask.mean()
+            ece += gap * prop if norm == "l1" else (gap**2) * prop
+            mx = max(mx, gap)
+        if norm == "max":
+            return mx
+        return np.sqrt(ece) if norm == "l2" else ece
+
+    @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, norm, ddp):
+        preds, target = _binary_inputs
+        self.run_class_metric_test(
+            preds, target, BinaryCalibrationError,
+            lambda p, t: self._sk_ece(p, t, norm=norm),
+            metric_args={"norm": norm}, ddp=ddp,
+        )
+
+    def test_binary_functional(self):
+        preds, target = _binary_inputs
+        self.run_functional_metric_test(
+            preds, target, binary_calibration_error, lambda p, t: self._sk_ece(p, t)
+        )
+
+    def test_multiclass(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        p, t = preds.reshape(-1, NUM_CLASSES), target.flatten()
+        res = multiclass_calibration_error(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, n_bins=10)
+        conf = p.max(-1)
+        acc = (p.argmax(-1) == t).astype(float)
+        bins = np.clip((conf * 10).astype(int), 0, 9)
+        expected = sum(
+            abs(acc[bins == b].mean() - conf[bins == b].mean()) * (bins == b).mean()
+            for b in range(10) if (bins == b).any()
+        )
+        np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+    def test_task_dispatch(self):
+        assert isinstance(CalibrationError(task="binary"), BinaryCalibrationError)
+        assert isinstance(CalibrationError(task="multiclass", num_classes=3), MulticlassCalibrationError)
+
+
+class TestHingeLoss(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, ddp):
+        preds, target = _binary_inputs
+
+        def _sk(p, t):
+            return sk_hinge(t.flatten(), p.flatten() * 2 - 1) * 0 + np.mean(
+                np.maximum(1 - (t.flatten() * 2 - 1) * p.flatten(), 0)
+            )
+
+        self.run_class_metric_test(preds, target, BinaryHingeLoss, _sk, ddp=ddp)
+
+    def test_binary_functional(self):
+        preds, target = _binary_inputs
+        self.run_functional_metric_test(
+            preds, target, binary_hinge_loss,
+            lambda p, t: np.mean(np.maximum(1 - (t.flatten() * 2 - 1) * p.flatten(), 0)),
+        )
+
+    def test_multiclass_crammer_singer(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        p, t = preds.reshape(-1, NUM_CLASSES), target.flatten()
+        res = multiclass_hinge_loss(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES)
+        expected = sk_hinge(t, p, labels=list(range(NUM_CLASSES)))
+        np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+    def test_multiclass_one_vs_all_shape(self):
+        import jax.numpy as jnp
+
+        preds, target = _mc_inputs
+        res = multiclass_hinge_loss(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), NUM_CLASSES, multiclass_mode="one-vs-all"
+        )
+        assert res.shape == (NUM_CLASSES,)
+
+    def test_task_dispatch(self):
+        assert isinstance(HingeLoss(task="binary"), BinaryHingeLoss)
+
+
+class TestRanking(MetricTester):
+    @pytest.mark.parametrize(
+        ("metric_class", "functional", "sk_fn"),
+        [
+            (MultilabelCoverageError, multilabel_coverage_error, sk_coverage),
+            (MultilabelRankingAveragePrecision, multilabel_ranking_average_precision, sk_lrap),
+            (MultilabelRankingLoss, multilabel_ranking_loss, sk_rloss),
+        ],
+    )
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, metric_class, functional, sk_fn, ddp):
+        preds, target = _ml_inputs
+        self.run_class_metric_test(
+            preds, target, metric_class,
+            lambda p, t: sk_fn(t.reshape(-1, NUM_LABELS), p.reshape(-1, NUM_LABELS)),
+            metric_args={"num_labels": NUM_LABELS}, ddp=ddp,
+        )
+
+    @pytest.mark.parametrize(
+        ("functional", "sk_fn"),
+        [
+            (multilabel_coverage_error, sk_coverage),
+            (multilabel_ranking_average_precision, sk_lrap),
+            (multilabel_ranking_loss, sk_rloss),
+        ],
+    )
+    def test_functional(self, functional, sk_fn):
+        preds, target = _ml_inputs
+        self.run_functional_metric_test(
+            preds, target, functional,
+            lambda p, t: sk_fn(t.reshape(-1, NUM_LABELS), p.reshape(-1, NUM_LABELS)),
+            metric_args={"num_labels": NUM_LABELS},
+        )

@@ -1,0 +1,140 @@
+"""Tests for Dice and group-fairness metrics.
+
+Reference pattern: ``tests/unittests/classification/test_{dice,group_fairness}.py``.
+"""
+
+import numpy as np
+import pytest
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.classification import BinaryFairness, BinaryGroupStatRates, Dice
+from torchmetrics_tpu.functional.classification import (
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    dice,
+    equal_opportunity,
+)
+
+rng = np.random.RandomState(21)
+
+
+class TestDice(MetricTester):
+    def test_binary_micro_equals_f1(self):
+        import jax.numpy as jnp
+
+        from sklearn.metrics import f1_score as sk_f1
+
+        preds = rng.rand(128)
+        target = rng.randint(0, 2, 128)
+        res = dice(jnp.asarray(preds), jnp.asarray(target))
+        expected = sk_f1(target, (preds > 0.5).astype(int))
+        np.testing.assert_allclose(float(res), expected, atol=1e-6)
+
+    def test_multiclass_micro(self):
+        import jax.numpy as jnp
+
+        preds = rng.randint(0, 4, 64)
+        target = rng.randint(0, 4, 64)
+        res = dice(jnp.asarray(preds), jnp.asarray(target), num_classes=4)
+        tp = (preds == target).sum()
+        wrong = (preds != target).sum()
+        np.testing.assert_allclose(float(res), 2 * tp / (2 * tp + 2 * wrong), atol=1e-6)
+
+    def test_macro(self):
+        import jax.numpy as jnp
+
+        from sklearn.metrics import f1_score as sk_f1
+
+        preds = rng.randint(0, 4, 256)
+        target = rng.randint(0, 4, 256)
+        res = dice(jnp.asarray(preds), jnp.asarray(target), num_classes=4, average="macro")
+        # per-class dice == per-class f1 (one-vs-rest)
+        expected = sk_f1(target, preds, labels=list(range(4)), average="macro", zero_division=0)
+        np.testing.assert_allclose(float(res), expected, atol=1e-6)
+
+    def test_class_accumulation(self):
+        import jax.numpy as jnp
+
+        m = Dice(average="micro")
+        p1, t1 = rng.randint(0, 3, 32), rng.randint(0, 3, 32)
+        p2, t2 = rng.randint(0, 3, 32), rng.randint(0, 3, 32)
+        m.update(jnp.asarray(p1), jnp.asarray(t1))
+        m.update(jnp.asarray(p2), jnp.asarray(t2))
+        p_all, t_all = np.concatenate([p1, p2]), np.concatenate([t1, t2])
+        tp = (p_all == t_all).sum()
+        w = (p_all != t_all).sum()
+        np.testing.assert_allclose(float(m.compute()), 2 * tp / (2 * tp + 2 * w), atol=1e-6)
+
+
+class TestGroupFairness(MetricTester):
+    def _data(self):
+        preds = rng.rand(256)
+        target = rng.randint(0, 2, 256)
+        groups = rng.randint(0, 3, 256)
+        return preds, target, groups
+
+    def test_stat_rates(self):
+        import jax.numpy as jnp
+
+        preds, target, groups = self._data()
+        res = binary_groups_stat_rates(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups), num_groups=3)
+        hard = (preds > 0.5).astype(int)
+        for g in range(3):
+            m = groups == g
+            n = m.sum()
+            expected = np.array([
+                ((hard == 1) & (target == 1) & m).sum(),
+                ((hard == 1) & (target == 0) & m).sum(),
+                ((hard == 0) & (target == 0) & m).sum(),
+                ((hard == 0) & (target == 1) & m).sum(),
+            ]) / n
+            np.testing.assert_allclose(np.asarray(res[f"group_{g}"]), expected, atol=1e-6)
+
+    def test_demographic_parity(self):
+        import jax.numpy as jnp
+
+        preds, target, groups = self._data()
+        res = demographic_parity(jnp.asarray(preds), jnp.asarray(groups))
+        hard = (preds > 0.5).astype(int)
+        rates = np.array([hard[groups == g].mean() for g in range(3)])
+        expected = rates.min() / rates.max()
+        np.testing.assert_allclose(float(next(iter(res.values()))), expected, atol=1e-6)
+
+    def test_equal_opportunity(self):
+        import jax.numpy as jnp
+
+        preds, target, groups = self._data()
+        res = equal_opportunity(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups))
+        hard = (preds > 0.5).astype(int)
+        tprs = np.array([
+            ((hard == 1) & (target == 1) & (groups == g)).sum() / ((target == 1) & (groups == g)).sum()
+            for g in range(3)
+        ])
+        expected = tprs.min() / tprs.max()
+        np.testing.assert_allclose(float(next(iter(res.values()))), expected, atol=1e-6)
+
+    def test_class_metrics(self):
+        import jax.numpy as jnp
+
+        preds, target, groups = self._data()
+        m = BinaryGroupStatRates(num_groups=3)
+        m.update(jnp.asarray(preds[:128]), jnp.asarray(target[:128]), jnp.asarray(groups[:128]))
+        m.update(jnp.asarray(preds[128:]), jnp.asarray(target[128:]), jnp.asarray(groups[128:]))
+        res = m.compute()
+        full = binary_groups_stat_rates(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups), num_groups=3)
+        for k in res:
+            np.testing.assert_allclose(np.asarray(res[k]), np.asarray(full[k]), atol=1e-6)
+
+        f = BinaryFairness(num_groups=3)
+        f.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups))
+        out = f.compute()
+        assert any(k.startswith("DP_") for k in out)
+        assert any(k.startswith("EO_") for k in out)
+
+    def test_functional_binary_fairness(self):
+        import jax.numpy as jnp
+
+        preds, target, groups = self._data()
+        out = binary_fairness(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups), task="all")
+        assert len(out) == 2

@@ -112,7 +112,7 @@ class Accuracy(_ClassificationTaskWrapper):
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> accuracy = Accuracy(task="multiclass", num_classes=3)
         >>> accuracy(preds, target)
-        Array(0.8333334, dtype=float32)
+        Array(0.75, dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

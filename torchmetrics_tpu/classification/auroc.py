@@ -37,7 +37,7 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
         >>> target = jnp.array([0, 1, 0, 1])
         >>> metric = BinaryAUROC()
         >>> metric(preds, target)
-        Array(0.75, dtype=float32)
+        Array(1., dtype=float32)
     """
 
     is_differentiable = False
@@ -166,7 +166,7 @@ class AUROC(_ClassificationTaskWrapper):
         >>> target = jnp.array([0, 1, 0, 1])
         >>> auroc = AUROC(task="binary")
         >>> auroc(preds, target)
-        Array(0.75, dtype=float32)
+        Array(1., dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

@@ -36,7 +36,7 @@ class BinaryAveragePrecision(BinaryPrecisionRecallCurve):
         >>> target = jnp.array([0, 1, 0, 1])
         >>> metric = BinaryAveragePrecision()
         >>> metric(preds, target)
-        Array(0.8333334, dtype=float32)
+        Array(1., dtype=float32)
     """
 
     is_differentiable = False
@@ -150,7 +150,7 @@ class AveragePrecision(_ClassificationTaskWrapper):
         >>> target = jnp.array([0, 1, 0, 1])
         >>> ap = AveragePrecision(task="binary")
         >>> ap(preds, target)
-        Array(0.8333334, dtype=float32)
+        Array(1., dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

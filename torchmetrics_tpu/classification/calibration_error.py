@@ -1,0 +1,168 @@
+"""Calibration error module classes.
+
+Parity: reference ``src/torchmetrics/classification/calibration_error.py``.
+State is a static ``[3, n_bins]`` per-bin accumulator (Σconf, Σacc, count) — see the
+functional module for why this is lossless vs the reference's raw lists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.classification.calibration_error import (
+    _binary_calibration_error_update,
+    _binning_update,
+    _calibration_error_arg_validation,
+    _ce_compute_from_bins,
+    _multiclass_calibration_error_update,
+)
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+class BinaryCalibrationError(Metric):
+    r"""Binary expected calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryCalibrationError
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> metric = BinaryCalibrationError(n_bins=2, norm='l1')
+        >>> metric(preds, target)
+        Array(0.29000002, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    bins: Array
+
+    def __init__(
+        self,
+        n_bins: int = 15,
+        norm: str = "l1",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _calibration_error_arg_validation(n_bins, norm, ignore_index)
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("bins", jnp.zeros((3, n_bins), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-bin confidence/accuracy sums."""
+        if self.validate_args:
+            _binary_confusion_matrix_tensor_validation(preds, target, self.ignore_index)
+        preds, target, valid = _binary_confusion_matrix_format(
+            preds, target, threshold=0.5, ignore_index=self.ignore_index, convert_to_labels=False
+        )
+        confidences, accuracies, valid = _binary_calibration_error_update(preds, target, valid)
+        self.bins = self.bins + _binning_update(confidences, accuracies, valid, self.n_bins)
+
+    def compute(self) -> Array:
+        """ECE under the configured norm."""
+        return _ce_compute_from_bins(self.bins, self.norm)
+
+
+class MulticlassCalibrationError(Metric):
+    r"""Multiclass expected calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassCalibrationError
+        >>> preds = jnp.array([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        >>> target = jnp.array([0, 1, 2, 0])
+        >>> metric = MulticlassCalibrationError(num_classes=3, n_bins=3, norm='l1')
+        >>> metric(preds, target)
+        Array(0.19999999, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    bins: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        n_bins: int = 15,
+        norm: str = "l1",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _calibration_error_arg_validation(n_bins, norm, ignore_index)
+            if not isinstance(num_classes, int) or num_classes < 2:
+                raise ValueError(
+                    f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}"
+                )
+        self.num_classes = num_classes
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("bins", jnp.zeros((3, n_bins), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-bin confidence/accuracy sums."""
+        if self.validate_args:
+            _multiclass_confusion_matrix_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds, target, valid = _multiclass_confusion_matrix_format(
+            preds, target, self.ignore_index, convert_to_labels=False
+        )
+        confidences, accuracies, valid = _multiclass_calibration_error_update(preds, target, valid)
+        self.bins = self.bins + _binning_update(confidences, accuracies, valid, self.n_bins)
+
+    def compute(self) -> Array:
+        """ECE under the configured norm."""
+        return _ce_compute_from_bins(self.bins, self.norm)
+
+
+class CalibrationError(_ClassificationTaskWrapper):
+    r"""Task-dispatch wrapper for calibration error (binary / multiclass)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        n_bins: int = 15,
+        norm: str = "l1",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"n_bins": n_bins, "norm": norm, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCalibrationError(**kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCalibrationError(num_classes, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -68,7 +68,7 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> metric = MulticlassCohenKappa(num_classes=3)
         >>> metric(preds, target)
-        Array(0.6363637, dtype=float32)
+        Array(0.6363636, dtype=float32)
     """
 
     is_differentiable = False

@@ -139,7 +139,7 @@ class MultilabelFBetaScore(MultilabelStatScores):
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> metric = MultilabelFBetaScore(beta=2.0, num_labels=3)
         >>> metric(preds, target)
-        Array(0.6666667, dtype=float32)
+        Array(0.6111111, dtype=float32)
     """
 
     is_differentiable = False
@@ -265,7 +265,7 @@ class MultilabelF1Score(MultilabelFBetaScore):
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> metric = MultilabelF1Score(num_labels=3)
         >>> metric(preds, target)
-        Array(0.6666667, dtype=float32)
+        Array(0.5555556, dtype=float32)
     """
 
     def __init__(

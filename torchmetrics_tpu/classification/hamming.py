@@ -56,7 +56,7 @@ class MulticlassHammingDistance(MulticlassStatScores):
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> metric = MulticlassHammingDistance(num_classes=3)
         >>> metric(preds, target)
-        Array(0.16666667, dtype=float32)
+        Array(0.16666663, dtype=float32)
     """
 
     is_differentiable = False
@@ -82,7 +82,7 @@ class MultilabelHammingDistance(MultilabelStatScores):
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> metric = MultilabelHammingDistance(num_labels=3)
         >>> metric(preds, target)
-        Array(0.33333334, dtype=float32)
+        Array(0.3333333, dtype=float32)
     """
 
     is_differentiable = False

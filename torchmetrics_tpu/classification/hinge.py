@@ -1,0 +1,175 @@
+"""Hinge loss module classes.
+
+Parity: reference ``src/torchmetrics/classification/hinge.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from torchmetrics_tpu.functional.classification.hinge import (
+    _binary_hinge_loss_update,
+    _hinge_loss_arg_validation,
+    _multiclass_hinge_loss_update,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+class BinaryHingeLoss(Metric):
+    r"""Binary hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryHingeLoss
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> metric = BinaryHingeLoss()
+        >>> metric(preds, target)
+        Array(0.69, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    measures: Array
+    total: Array
+
+    def __init__(
+        self,
+        squared: bool = False,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _hinge_loss_arg_validation(squared, ignore_index)
+        self.squared = squared
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measures", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate hinge-loss sums."""
+        if self.validate_args:
+            _binary_confusion_matrix_tensor_validation(preds, target, self.ignore_index)
+        preds, target, valid = _binary_confusion_matrix_format(
+            preds, target, threshold=0.5, ignore_index=self.ignore_index, convert_to_labels=False
+        )
+        measures, total = _binary_hinge_loss_update(preds, target, valid, self.squared)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Mean hinge loss."""
+        return safe_divide(self.measures, self.total)
+
+
+class MulticlassHingeLoss(Metric):
+    r"""Multiclass hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassHingeLoss
+        >>> preds = jnp.array([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        >>> target = jnp.array([0, 1, 2, 0])
+        >>> metric = MulticlassHingeLoss(num_classes=3)
+        >>> metric(preds, target)
+        Array(0.9125, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    measures: Array
+    total: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _hinge_loss_arg_validation(squared, ignore_index)
+            if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+                raise ValueError(
+                    f"Expected argument `multiclass_mode` to be one of ('crammer-singer', 'one-vs-all'),"
+                    f" but got {multiclass_mode}."
+                )
+        self.num_classes = num_classes
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        default = (
+            jnp.zeros((), dtype=jnp.float32)
+            if multiclass_mode == "crammer-singer"
+            else jnp.zeros(num_classes, dtype=jnp.float32)
+        )
+        self.add_state("measures", default, dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate hinge-loss sums."""
+        if self.validate_args:
+            _multiclass_confusion_matrix_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds, target, valid = _multiclass_confusion_matrix_format(
+            preds, target, self.ignore_index, convert_to_labels=False
+        )
+        measures, total = _multiclass_hinge_loss_update(
+            preds, target, valid, self.num_classes, self.squared, self.multiclass_mode
+        )
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Mean hinge loss (per class for one-vs-all mode)."""
+        return safe_divide(self.measures, self.total)
+
+
+class HingeLoss(_ClassificationTaskWrapper):
+    r"""Task-dispatch wrapper for hinge loss (binary / multiclass)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        num_classes: Optional[int] = None,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryHingeLoss(squared, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassHingeLoss(num_classes, squared, multiclass_mode, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

@@ -69,7 +69,7 @@ class MulticlassJaccardIndex(MulticlassConfusionMatrix):
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> metric = MulticlassJaccardIndex(num_classes=3)
         >>> metric(preds, target)
-        Array(0.7777778, dtype=float32)
+        Array(0.6666667, dtype=float32)
     """
 
     is_differentiable = False

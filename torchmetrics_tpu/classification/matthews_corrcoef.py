@@ -31,7 +31,7 @@ class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> metric = BinaryMatthewsCorrCoef()
         >>> metric(preds, target)
-        Array(0.5773503, dtype=float32)
+        Array(0.57735026, dtype=float32)
     """
 
     is_differentiable = False
@@ -131,7 +131,7 @@ class MatthewsCorrCoef(_ClassificationTaskWrapper):
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> metric = MatthewsCorrCoef(task="binary")
         >>> metric(preds, target)
-        Array(0.5773503, dtype=float32)
+        Array(0.57735026, dtype=float32)
     """
 
     def __new__(  # type: ignore[misc]

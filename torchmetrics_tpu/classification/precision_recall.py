@@ -98,7 +98,7 @@ class MultilabelPrecision(MultilabelStatScores):
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> metric = MultilabelPrecision(num_labels=3)
         >>> metric(preds, target)
-        Array(0.33333334, dtype=float32)
+        Array(0.5, dtype=float32)
     """
 
     is_differentiable = False

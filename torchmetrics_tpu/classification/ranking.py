@@ -1,0 +1,122 @@
+"""Multilabel ranking module classes.
+
+Parity: reference ``src/torchmetrics/classification/ranking.py``.
+Each keeps (Σ measure, n) scalar states — psum-able sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_format,
+)
+from torchmetrics_tpu.functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+
+Array = jax.Array
+
+
+class _AbstractRanking(Metric):
+    """Shared (measure, total) states + formatted update driver."""
+
+    is_differentiable = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    measure: Array
+    total: Array
+
+    _update_fn = None  # set by subclass
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args and (not isinstance(num_labels, int) or num_labels < 2):
+            raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the ranking measure over the batch."""
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target, valid, _ = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, None, self.ignore_index
+        )
+        measure, total = type(self)._update_fn(preds, target, valid)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """Mean measure over all samples."""
+        return safe_divide(self.measure, self.total)
+
+
+class MultilabelCoverageError(_AbstractRanking):
+    r"""Multilabel coverage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelCoverageError
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.55, 0.75], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1], [1, 1, 1]])
+        >>> metric = MultilabelCoverageError(num_labels=3)
+        >>> metric(preds, target)
+        Array(1.75, dtype=float32)
+    """
+
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_AbstractRanking):
+    r"""Multilabel label-ranking average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelRankingAveragePrecision
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.55, 0.75], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1], [1, 1, 1]])
+        >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+        >>> metric(preds, target)
+        Array(1., dtype=float32)
+    """
+
+    higher_is_better = True
+    plot_upper_bound: float = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_AbstractRanking):
+    r"""Multilabel ranking loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelRankingLoss
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.55, 0.75], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1], [1, 1, 1]])
+        >>> metric = MultilabelRankingLoss(num_labels=3)
+        >>> metric(preds, target)
+        Array(0., dtype=float32)
+    """
+
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

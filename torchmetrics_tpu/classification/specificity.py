@@ -56,7 +56,7 @@ class MulticlassSpecificity(MulticlassStatScores):
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> metric = MulticlassSpecificity(num_classes=3)
         >>> metric(preds, target)
-        Array(0.8888889, dtype=float32)
+        Array(0.88888896, dtype=float32)
     """
 
     is_differentiable = False

@@ -95,7 +95,7 @@ def binary_auroc(
         >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
         >>> target = jnp.array([0, 1, 0, 1])
         >>> binary_auroc(preds, target)
-        Array(0.75, dtype=float32)
+        Array(1., dtype=float32)
     """
     if validate_args:
         _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)

@@ -95,7 +95,7 @@ def binary_average_precision(
         >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
         >>> target = jnp.array([0, 1, 0, 1])
         >>> binary_average_precision(preds, target)
-        Array(0.8333334, dtype=float32)
+        Array(1., dtype=float32)
     """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)

@@ -1,0 +1,172 @@
+"""Calibration error (ECE): binary / multiclass + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/calibration_error.py``.
+
+TPU-native design: the reference accumulates raw confidence/accuracy lists and bins at
+compute; since the bin boundaries are fixed at construction, binning commutes with
+accumulation — so the module state here is a static ``[3, n_bins]`` accumulator
+(Σconf, Σacc, count per bin), jit-able and psum-able, with identical results.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import _maybe_softmax
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+def _calibration_error_arg_validation(
+    n_bins: int,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    allowed_norm = ("l1", "l2", "max")
+    if norm not in allowed_norm:
+        raise ValueError(f"Expected argument `norm` to be one of {allowed_norm}, but got {norm}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binning_update(confidences: Array, accuracies: Array, valid: Array, n_bins: int) -> Array:
+    """[3, n_bins] per-bin (Σconf, Σacc, count) — scatter-free via one-hot matmul.
+
+    Bins are right-closed ``(i/n, (i+1)/n]`` with 0 clamped into bin 0, matching the
+    reference's ``bucketize(..., right=True) - 1`` + clamp (``calibration_error.py``).
+    """
+    v = valid.astype(jnp.float32)
+    bin_idx = jnp.clip(jnp.ceil(confidences * n_bins).astype(jnp.int32) - 1, 0, n_bins - 1)
+    oh = jax.nn.one_hot(bin_idx, n_bins, dtype=jnp.float32) * v[:, None]  # [N, B]
+    conf_sum = oh.T @ confidences.astype(jnp.float32)
+    acc_sum = oh.T @ accuracies.astype(jnp.float32)
+    count = oh.sum(axis=0)
+    return jnp.stack([conf_sum, acc_sum, count])
+
+
+def _ce_compute_from_bins(bins: Array, norm: str = "l1") -> Array:
+    """ECE from the [3, n_bins] accumulator."""
+    conf_sum, acc_sum, count = bins[0], bins[1], bins[2]
+    total = jnp.sum(count)
+    prop = safe_divide(count, total)
+    conf_bin = safe_divide(conf_sum, count)
+    acc_bin = safe_divide(acc_sum, count)
+    gap = jnp.abs(acc_bin - conf_bin)
+    if norm == "l1":
+        return jnp.sum(gap * prop)
+    if norm == "max":
+        return jnp.max(jnp.where(count > 0, gap, 0.0))
+    if norm == "l2":
+        ce = jnp.sum(gap**2 * prop)
+        return jnp.sqrt(jnp.maximum(ce, 0.0))
+    raise ValueError(f"Argument `norm` expected to be one of 'l1', 'l2', 'max' but got {norm}")
+
+
+def _binary_calibration_error_update(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
+    """(confidences, accuracies, valid) — raw positive-class probability vs target,
+    matching the reference (``calibration_error.py``: confidences, accuracies = preds,
+    target)."""
+    return preds.astype(jnp.float32), target.astype(jnp.float32), valid
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Expected calibration error for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_calibration_error
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> binary_calibration_error(preds, target, n_bins=2, norm='l1')
+        Array(0.29000002, dtype=float32)
+    """
+    if validate_args:
+        _calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(
+        preds, target, threshold=0.5, ignore_index=ignore_index, convert_to_labels=False
+    )
+    confidences, accuracies, valid = _binary_calibration_error_update(preds, target, valid)
+    bins = _binning_update(confidences, accuracies, valid, n_bins)
+    return _ce_compute_from_bins(bins, norm)
+
+
+def _multiclass_calibration_error_update(
+    preds: Array, target: Array, valid: Array
+) -> Tuple[Array, Array, Array]:
+    """Confidence = max softmax probability; accuracy = argmax == target."""
+    preds = _maybe_softmax(preds, axis=-1)
+    confidences = jnp.max(preds, axis=-1).astype(jnp.float32)
+    accuracies = (jnp.argmax(preds, axis=-1).astype(jnp.int32) == target).astype(jnp.float32)
+    return confidences, accuracies, valid
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Expected calibration error for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_calibration_error
+        >>> preds = jnp.array([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        >>> target = jnp.array([0, 1, 2, 0])
+        >>> multiclass_calibration_error(preds, target, num_classes=3, n_bins=3, norm='l1')
+        Array(0.19999999, dtype=float32)
+    """
+    if validate_args:
+        _calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(
+        preds, target, ignore_index, convert_to_labels=False
+    )
+    confidences, accuracies, valid = _multiclass_calibration_error_update(preds, target, valid)
+    bins = _binning_update(confidences, accuracies, valid, n_bins)
+    return _ce_compute_from_bins(bins, norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching calibration error (binary / multiclass)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

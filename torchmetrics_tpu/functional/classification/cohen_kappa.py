@@ -95,7 +95,7 @@ def multiclass_cohen_kappa(
         >>> target = jnp.array([2, 1, 0, 0])
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> multiclass_cohen_kappa(preds, target, num_classes=3)
-        Array(0.6363637, dtype=float32)
+        Array(0.6363636, dtype=float32)
     """
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)

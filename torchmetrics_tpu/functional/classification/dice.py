@@ -1,0 +1,148 @@
+"""Dice score (legacy classification metric).
+
+Parity: reference ``src/torchmetrics/functional/classification/dice.py`` — the one
+metric still on the reference's legacy input-inference engine. This implementation keeps
+the public semantics (``average`` in micro/macro/samples/none, ``ignore_index``,
+``threshold``, ``top_k``) on top of the modern one-hot counting engine:
+
+- input mode is inferred from shapes/dtypes exactly like the legacy
+  ``_input_format_classification`` (binary probs/labels, multiclass probs/labels,
+  multilabel probs),
+- binary inputs count only the positive class (legacy ``reduce='micro'``+binary mode),
+- macro excludes classes with no tp+fp+fn support (legacy ``_dice_compute`` cond).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import _maybe_apply_sigmoid
+from torchmetrics_tpu.utils.data import safe_divide, select_topk
+
+Array = jax.Array
+
+
+def _dice_format_onehot(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+) -> Tuple[Array, Array, bool]:
+    """Convert any legacy input mode to one-hot [N, C, X] pairs; returns (p, t, binary)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    binary = False
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == target.ndim + 1:
+        # multiclass probabilities [N, C, ...]
+        num_classes = num_classes or preds.shape[1]
+        if top_k and top_k > 1:
+            p_oh = select_topk(preds.reshape(preds.shape[0], preds.shape[1], -1), topk=top_k, dim=1)
+        else:
+            p_oh = jax.nn.one_hot(jnp.argmax(preds, axis=1), num_classes, dtype=jnp.int32, axis=1)
+            p_oh = p_oh.reshape(p_oh.shape[0], num_classes, -1)
+        t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32, axis=1).reshape(
+            target.shape[0], num_classes, -1
+        )
+        return p_oh, t_oh, binary
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        # binary (or multilabel) probabilities, same shape as target
+        preds = (_maybe_apply_sigmoid(preds) > threshold).astype(jnp.int32)
+        binary = preds.ndim == 1 or (num_classes in (None, 1, 2) and preds.ndim <= 2 and preds.shape == target.shape)
+    int_max = None if isinstance(preds, jax.core.Tracer) else int(max(int(jnp.max(preds)), int(jnp.max(target))))
+    if num_classes is None:
+        num_classes = 2 if (binary or (int_max is not None and int_max <= 1)) else (int_max or 1) + 1
+    if num_classes <= 2 and preds.shape == target.shape and (int_max is None or int_max <= 1):
+        # binary labels: count only the positive class
+        p = preds.reshape(preds.shape[0], 1, -1).astype(jnp.int32)
+        t = target.reshape(target.shape[0], 1, -1).astype(jnp.int32)
+        return p, t, True
+    p_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.int32, axis=1).reshape(preds.shape[0], num_classes, -1)
+    t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32, axis=1).reshape(target.shape[0], num_classes, -1)
+    return p_oh, t_oh, False
+
+
+def _dice_update(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    samplewise: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """(tp, fp, fn): [C] (global) or [N, C] (samplewise) from one-hot pairs."""
+    p_oh, t_oh, binary = _dice_format_onehot(preds, target, threshold, top_k, num_classes)
+    dims = (0, 2) if not samplewise else (2,)
+    tp = jnp.sum((p_oh == 1) & (t_oh == 1), axis=dims).astype(jnp.float32)
+    fp = jnp.sum((p_oh == 1) & (t_oh == 0), axis=dims).astype(jnp.float32)
+    fn = jnp.sum((p_oh == 0) & (t_oh == 1), axis=dims).astype(jnp.float32)
+    if ignore_index is not None and not binary:
+        keep = jnp.arange(tp.shape[-1]) != ignore_index
+        tp = jnp.where(keep, tp, 0.0) if tp.ndim == 1 else jnp.where(keep[None, :], tp, 0.0)
+        fp = jnp.where(keep, fp, 0.0) if fp.ndim == 1 else jnp.where(keep[None, :], fp, 0.0)
+        fn = jnp.where(keep, fn, 0.0) if fn.ndim == 1 else jnp.where(keep[None, :], fn, 0.0)
+    return tp, fp, fn
+
+
+def _dice_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str] = "micro",
+    zero_division: float = 0.0,
+) -> Array:
+    numerator = 2 * tp
+    denominator = 2 * tp + fp + fn
+    if average == "micro":
+        return safe_divide(numerator.sum(axis=-1), denominator.sum(axis=-1), zero_division)
+    scores = safe_divide(numerator, denominator, zero_division)
+    if average == "macro":
+        present = (tp + fp + fn) > 0
+        return safe_divide(jnp.sum(jnp.where(present, scores, 0.0), axis=-1), jnp.sum(present, axis=-1))
+    if average == "samples":
+        # caller passes samplewise [N, C] counts; per-sample micro then mean
+        per_sample = safe_divide(numerator.sum(axis=-1), denominator.sum(axis=-1), zero_division)
+        return per_sample.mean()
+    return scores  # 'none'
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice score: ``2·tp / (2·tp + fp + fn)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import dice
+        >>> preds = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> dice(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    samplewise = average == "samples" or mdmc_average == "samplewise"
+    tp, fp, fn = _dice_update(
+        preds, target, threshold, ignore_index, top_k, num_classes, samplewise=samplewise
+    )
+    if average == "weighted":
+        scores = safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
+        weights = tp + fn
+        return safe_divide(jnp.sum(scores * weights, axis=-1), jnp.sum(weights, axis=-1))
+    res = _dice_compute(tp, fp, fn, average, zero_division)
+    if mdmc_average == "samplewise" and average != "samples" and res.ndim >= 1:
+        res = res.mean(axis=0)
+    return res

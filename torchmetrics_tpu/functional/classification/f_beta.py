@@ -120,7 +120,7 @@ def multilabel_fbeta_score(
         >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> multilabel_fbeta_score(preds, target, beta=2.0, num_labels=3)
-        Array(0.6666667, dtype=float32)
+        Array(0.6111111, dtype=float32)
     """
     if validate_args:
         _fbeta_arg_check(beta)
@@ -203,7 +203,7 @@ def multilabel_f1_score(
         >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> multilabel_f1_score(preds, target, num_labels=3)
-        Array(0.6666667, dtype=float32)
+        Array(0.5555556, dtype=float32)
     """
     return multilabel_fbeta_score(
         preds, target, 1.0, num_labels, threshold, average, multidim_average, ignore_index, validate_args, zero_division

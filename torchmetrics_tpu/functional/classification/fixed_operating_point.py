@@ -1,0 +1,558 @@
+"""Operating-point metrics on the threshold-curve state:
+
+- ``*_recall_at_fixed_precision``  (reference ``functional/classification/recall_fixed_precision.py``)
+- ``*_precision_at_fixed_recall``  (reference ``functional/classification/precision_fixed_recall.py``)
+- ``*_specificity_at_sensitivity`` (reference ``functional/classification/specificity_sensitivity.py``)
+- ``*_sensitivity_at_specificity`` (reference ``functional/classification/sensitivity_specificity.py``)
+
+Each finds the best achievable value of one quantity subject to a floor on the other,
+plus the threshold achieving it. All selection logic is branchless ``where``/``max`` —
+jit-safe on binned curve states.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from torchmetrics_tpu.utils.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _best_subject_to(
+    value: Array, constraint: Array, floor: float, thresholds: Array, no_solution_threshold: float = 1e6
+) -> Tuple[Array, Array]:
+    """(max value s.t. constraint >= floor, threshold at that point); (0, 1e6) if none.
+
+    Tie-breaking follows the reference's ``_lexargmax`` (``recall_fixed_precision.py:40``):
+    maximize value, then constraint, then threshold — implemented branchlessly so it
+    stays jit-safe and vectorizes over leading (class/label) axes. Curve arrays may
+    carry one more point than ``thresholds`` (the synthetic endpoint); the extra point
+    is excluded from selection like the reference.
+    """
+    n = min(thresholds.shape[0], value.shape[-1])
+    value_t, constraint_t, thr_t = value[..., :n], constraint[..., :n], thresholds[:n]
+    feasible = constraint_t >= floor
+    masked_v = jnp.where(feasible, value_t, -jnp.inf)
+    best = jnp.max(masked_v, axis=-1)
+    tie1 = feasible & (value_t == best[..., None])
+    best_c = jnp.max(jnp.where(tie1, constraint_t, -jnp.inf), axis=-1)
+    tie2 = tie1 & (constraint_t == best_c[..., None])
+    thr = jnp.max(jnp.where(tie2, thr_t, -jnp.inf), axis=-1)
+    any_feasible = jnp.any(feasible, axis=-1)
+    best = jnp.where(any_feasible, best, 0.0)
+    # reference: a best value of 0 reports the sentinel threshold even when feasible
+    thr = jnp.where(any_feasible & (best != 0.0), thr, no_solution_threshold)
+    return best.astype(jnp.float32), thr.astype(jnp.float32)
+
+
+def _validate_floor(name: str, v: float) -> None:
+    if not isinstance(v, (int, float)) or not (0 <= v <= 1):
+        raise ValueError(f"Expected argument `{name}` to be a float in the [0,1] range, but got {v}")
+
+
+# ------------------------------------------------------------- recall @ precision
+
+
+def _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision: float):
+    precision, recall, thres = _binary_precision_recall_curve_compute(state, thresholds)
+    return _best_subject_to(recall, precision, min_precision, thres)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest recall with precision at least ``min_precision`` (+ the threshold).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_recall_at_fixed_precision
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> binary_recall_at_fixed_precision(preds, target, min_precision=0.5)
+        (Array(1., dtype=float32), Array(0.4, dtype=float32))
+    """
+    if validate_args:
+        _validate_floor("min_precision", min_precision)
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision)
+
+
+def _multi_curve_best(precisions, recalls, thresholds, floor, swap=False):
+    """Apply `_best_subject_to` per class/label for tensor or list curve outputs.
+
+    Tensor curves ([C, T(+1)]) vectorize through one fused select (no per-class trace
+    unrolling); ragged unbinned lists fall back to a python loop.
+    """
+    if isinstance(precisions, jax.Array) and precisions.ndim == 2:
+        v_curve, c_curve = (precisions, recalls) if swap else (recalls, precisions)
+        thr = thresholds[0] if isinstance(thresholds, (list, tuple)) else thresholds
+        return _best_subject_to(v_curve, c_curve, floor, thr)
+    vals, thrs = [], []
+    for p, r, t in zip(precisions, recalls, thresholds):
+        v_curve, c_curve = (p, r) if swap else (r, p)
+        v, th = _best_subject_to(v_curve, c_curve, floor, t)
+        vals.append(v)
+        thrs.append(th)
+    return jnp.stack(vals), jnp.stack(thrs)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest recall with precision >= ``min_precision``."""
+    if validate_args:
+        _validate_floor("min_precision", min_precision)
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    precision, recall, thres = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _multi_curve_best(precision, recall, thres, min_precision)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest recall with precision >= ``min_precision``."""
+    if validate_args:
+        _validate_floor("min_precision", min_precision)
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    precision, recall, thres = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    return _multi_curve_best(precision, recall, thres, min_precision)
+
+
+def recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_precision: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching recall@fixed-precision."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_recall_at_fixed_precision(
+            preds, target, min_precision, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_recall_at_fixed_precision(
+            preds, target, num_classes, min_precision, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_recall_at_fixed_precision(
+            preds, target, num_labels, min_precision, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")
+
+
+# ------------------------------------------------------------- precision @ recall
+
+
+def binary_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    min_recall: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest precision with recall at least ``min_recall`` (+ the threshold).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_precision_at_fixed_recall
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> binary_precision_at_fixed_recall(preds, target, min_recall=0.5)
+        (Array(1., dtype=float32), Array(0.4, dtype=float32))
+    """
+    if validate_args:
+        _validate_floor("min_recall", min_recall)
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    precision, recall, thres = _binary_precision_recall_curve_compute(state, thresholds)
+    return _best_subject_to(precision, recall, min_recall, thres)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_recall: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest precision with recall >= ``min_recall``."""
+    if validate_args:
+        _validate_floor("min_recall", min_recall)
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    precision, recall, thres = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _multi_curve_best(precision, recall, thres, min_recall, swap=True)
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_recall: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest precision with recall >= ``min_recall``."""
+    if validate_args:
+        _validate_floor("min_recall", min_recall)
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    precision, recall, thres = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    return _multi_curve_best(precision, recall, thres, min_recall, swap=True)
+
+
+def precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_recall: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching precision@fixed-recall."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_at_fixed_recall(preds, target, min_recall, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_at_fixed_recall(
+            preds, target, num_classes, min_recall, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_at_fixed_recall(
+            preds, target, num_labels, min_recall, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")
+
+
+# ------------------------------------------------------ specificity @ sensitivity
+
+
+def _spec_at_sens_from_roc(fpr, tpr, thres, min_sensitivity: float):
+    specificity = 1.0 - fpr
+    return _best_subject_to(specificity, tpr, min_sensitivity, thres)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity with sensitivity (TPR) at least ``min_sensitivity``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_specificity_at_sensitivity
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> binary_specificity_at_sensitivity(preds, target, min_sensitivity=0.5)
+        (Array(1., dtype=float32), Array(0.8, dtype=float32))
+    """
+    if validate_args:
+        _validate_floor("min_sensitivity", min_sensitivity)
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    fpr, tpr, thres = _binary_roc_compute(state, thresholds)
+    return _spec_at_sens_from_roc(fpr, tpr, thres, min_sensitivity)
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest specificity with sensitivity >= ``min_sensitivity``."""
+    if validate_args:
+        _validate_floor("min_sensitivity", min_sensitivity)
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(fpr, jax.Array) and fpr.ndim == 2:
+        return _multi_curve_best([1.0 - fpr[i] for i in range(num_classes)],
+                                 [tpr[i] for i in range(num_classes)],
+                                 [thres] * num_classes, min_sensitivity, swap=True)
+    return _multi_curve_best([1.0 - f for f in fpr], tpr, thres, min_sensitivity, swap=True)
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest specificity with sensitivity >= ``min_sensitivity``."""
+    if validate_args:
+        _validate_floor("min_sensitivity", min_sensitivity)
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(fpr, jax.Array) and fpr.ndim == 2:
+        return _multi_curve_best([1.0 - fpr[i] for i in range(num_labels)],
+                                 [tpr[i] for i in range(num_labels)],
+                                 [thres] * num_labels, min_sensitivity, swap=True)
+    return _multi_curve_best([1.0 - f for f in fpr], tpr, thres, min_sensitivity, swap=True)
+
+
+def specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_sensitivity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching specificity@sensitivity."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_specificity_at_sensitivity(
+            preds, target, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_specificity_at_sensitivity(
+            preds, target, num_classes, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_specificity_at_sensitivity(
+            preds, target, num_labels, min_sensitivity, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")
+
+
+# ------------------------------------------------------ sensitivity @ specificity
+
+
+def binary_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    min_specificity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest sensitivity (TPR) with specificity at least ``min_specificity``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_sensitivity_at_specificity
+        >>> preds = jnp.array([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.array([0, 1, 0, 1])
+        >>> binary_sensitivity_at_specificity(preds, target, min_specificity=0.5)
+        (Array(1., dtype=float32), Array(0.4, dtype=float32))
+    """
+    if validate_args:
+        _validate_floor("min_specificity", min_specificity)
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, valid, thresholds = _binary_precision_recall_curve_format(
+        preds, target, thresholds, ignore_index
+    )
+    state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
+    fpr, tpr, thres = _binary_roc_compute(state, thresholds)
+    return _best_subject_to(tpr, 1.0 - fpr, min_specificity, thres)
+
+
+def multiclass_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_specificity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest sensitivity with specificity >= ``min_specificity``."""
+    if validate_args:
+        _validate_floor("min_specificity", min_specificity)
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
+    fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(fpr, jax.Array) and fpr.ndim == 2:
+        return _multi_curve_best([tpr[i] for i in range(num_classes)],
+                                 [1.0 - fpr[i] for i in range(num_classes)],
+                                 [thres] * num_classes, min_specificity, swap=True)
+    return _multi_curve_best(tpr, [1.0 - f for f in fpr], thres, min_specificity, swap=True)
+
+
+def multilabel_sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_specificity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest sensitivity with specificity >= ``min_specificity``."""
+    if validate_args:
+        _validate_floor("min_specificity", min_specificity)
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
+    fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(fpr, jax.Array) and fpr.ndim == 2:
+        return _multi_curve_best([tpr[i] for i in range(num_labels)],
+                                 [1.0 - fpr[i] for i in range(num_labels)],
+                                 [thres] * num_labels, min_specificity, swap=True)
+    return _multi_curve_best(tpr, [1.0 - f for f in fpr], thres, min_specificity, swap=True)
+
+
+def sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_specificity: float,
+    thresholds: Union[int, Sequence[float], Array, None] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task-dispatching sensitivity@specificity."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_sensitivity_at_specificity(
+            preds, target, min_specificity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_sensitivity_at_specificity(
+            preds, target, num_classes, min_specificity, thresholds, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_sensitivity_at_specificity(
+            preds, target, num_labels, min_specificity, thresholds, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

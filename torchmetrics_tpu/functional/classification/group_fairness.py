@@ -1,0 +1,192 @@
+"""Group fairness: per-group stat rates, demographic parity, equal opportunity.
+
+Parity: reference ``src/torchmetrics/functional/classification/group_fairness.py``.
+Per-group tp/fp/tn/fn counting is one masked one-hot contraction over the group axis —
+scatter-free, jit-safe. The ratio metrics' result *keys* embed the arg-min/arg-max group
+ids, so final dict assembly runs on host (like the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _is_traced,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+
+Array = jax.Array
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    if _is_traced(groups):
+        return
+    if jnp.max(groups) > num_groups - 1 or jnp.min(groups) < 0:
+        raise ValueError(f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the specified number of groups {num_groups}.")
+
+
+def _groups_format(groups: Array) -> Array:
+    return jnp.asarray(groups).reshape(-1).astype(jnp.int32)
+
+
+def _binary_groups_stat_scores_update(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    valid: Array,
+    num_groups: int,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-group (tp, fp, tn, fn), each [G] — one-hot group contraction on the MXU."""
+    g_oh = jax.nn.one_hot(groups, num_groups, dtype=jnp.float32) * valid.reshape(-1).astype(jnp.float32)[:, None]
+    p = preds.reshape(-1).astype(jnp.float32)
+    t = target.reshape(-1).astype(jnp.float32)
+    tp = g_oh.T @ (p * t)
+    fp = g_oh.T @ (p * (1 - t))
+    fn = g_oh.T @ ((1 - p) * t)
+    tn = g_oh.T @ ((1 - p) * (1 - t))
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _groups_stat_rates(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """[G, 4] rates: each group's (tp, fp, tn, fn) / group support."""
+    stats = jnp.stack([tp, fp, tn, fn], axis=-1).astype(jnp.float32)
+    support = stats.sum(axis=-1, keepdims=True)
+    return safe_divide(stats, support)
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Per-group tp/fp/tn/fn rates for binary classification.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_groups_stat_rates
+        >>> preds = jnp.array([0.1, 0.9, 0.6, 0.3])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> groups = jnp.array([0, 0, 1, 1])
+        >>> binary_groups_stat_rates(preds, target, groups, num_groups=2)
+        {'group_0': Array([0.5, 0. , 0.5, 0. ], dtype=float32), 'group_1': Array([0.5, 0. , 0.5, 0. ], dtype=float32)}
+    """
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    groups = _groups_format(groups)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_groups_stat_scores_update(preds, target, groups, valid, num_groups)
+    rates = _groups_stat_rates(tp, fp, tn, fn)
+    return {f"group_{g}": rates[g] for g in range(num_groups)}
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """min/max positivity-rate ratio; key embeds the extreme groups' ids."""
+    pos_rates = safe_divide(tp + fp, tp + fp + tn + fn)
+    min_g = int(jnp.argmin(pos_rates))
+    max_g = int(jnp.argmax(pos_rates))
+    return {f"DP_{min_g}_{max_g}": safe_divide(pos_rates[min_g], pos_rates[max_g])}
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity: ratio of lowest to highest group positivity rate.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import demographic_parity
+        >>> preds = jnp.array([0.1, 0.9, 0.6, 0.3])
+        >>> groups = jnp.array([0, 0, 1, 1])
+        >>> demographic_parity(preds, groups)
+        {'DP_0_0': Array(1., dtype=float32)}
+    """
+    groups = _groups_format(groups)
+    num_groups = int(jnp.max(groups)) + 1
+    target = jnp.zeros_like(groups)
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_groups_stat_scores_update(preds, target, groups, valid, num_groups)
+    return _compute_binary_demographic_parity(tp, fp, tn, fn)
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    """min/max true-positive-rate ratio; key embeds the extreme groups' ids."""
+    tpr = safe_divide(tp, tp + fn)
+    min_g = int(jnp.argmin(tpr))
+    max_g = int(jnp.argmax(tpr))
+    return {f"EO_{min_g}_{max_g}": safe_divide(tpr[min_g], tpr[max_g])}
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Equal opportunity: ratio of lowest to highest group true-positive rate.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import equal_opportunity
+        >>> preds = jnp.array([0.1, 0.9, 0.6, 0.3])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> groups = jnp.array([0, 0, 1, 1])
+        >>> equal_opportunity(preds, target, groups)
+        {'EO_0_0': Array(1., dtype=float32)}
+    """
+    groups = _groups_format(groups)
+    num_groups = int(jnp.max(groups)) + 1
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_groups_stat_scores_update(preds, target, groups, valid, num_groups)
+    return _compute_binary_equal_opportunity(tp, fp, tn, fn)
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity, per ``task``.
+
+    ``task``: ``'demographic_parity' | 'equal_opportunity' | 'all'``.
+    """
+    if task not in ("demographic_parity", "equal_opportunity", "all"):
+        raise ValueError(
+            f"Expected argument `task` to either be 'demographic_parity', 'equal_opportunity' or 'all' but got {task}."
+        )
+    if task == "demographic_parity":
+        return demographic_parity(preds, groups, threshold, ignore_index, validate_args)
+    if task == "equal_opportunity":
+        return equal_opportunity(preds, target, groups, threshold, ignore_index, validate_args)
+    return {
+        **demographic_parity(preds, groups, threshold, ignore_index, validate_args),
+        **equal_opportunity(preds, target, groups, threshold, ignore_index, validate_args),
+    }

@@ -73,7 +73,7 @@ def multiclass_hamming_distance(
         >>> target = jnp.array([2, 1, 0, 0])
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> multiclass_hamming_distance(preds, target, num_classes=3)
-        Array(0.16666667, dtype=float32)
+        Array(0.16666663, dtype=float32)
     """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
@@ -103,7 +103,7 @@ def multilabel_hamming_distance(
         >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> multilabel_hamming_distance(preds, target, num_labels=3)
-        Array(0.33333334, dtype=float32)
+        Array(0.3333333, dtype=float32)
     """
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)

@@ -1,0 +1,156 @@
+"""Hinge loss: binary / multiclass + task dispatch.
+
+Parity: reference ``src/torchmetrics/functional/classification/hinge.py``
+(``squared`` option; multiclass modes ``crammer-singer`` / ``one-vs-all``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import _maybe_softmax
+from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+def _hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_update(
+    preds: Array, target: Array, valid: Array, squared: bool
+) -> Tuple[Array, Array]:
+    """(Σ losses, n): target mapped to ±1, margin = 1 - t·p."""
+    target_pm = target.astype(jnp.float32) * 2.0 - 1.0
+    margin = 1.0 - target_pm * preds.astype(jnp.float32)
+    losses = jnp.maximum(margin, 0.0)
+    if squared:
+        losses = losses**2
+    v = valid.astype(jnp.float32)
+    return jnp.sum(losses * v), jnp.sum(v)
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Mean hinge loss for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_hinge_loss
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> binary_hinge_loss(preds, target)
+        Array(0.69, dtype=float32)
+    """
+    if validate_args:
+        _hinge_loss_arg_validation(squared, ignore_index)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(
+        preds, target, threshold=0.5, ignore_index=ignore_index, convert_to_labels=False
+    )
+    measures, total = _binary_hinge_loss_update(preds, target, valid, squared)
+    return safe_divide(measures, total)
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_classes: int,
+    squared: bool,
+    multiclass_mode: str,
+) -> Tuple[Array, Array]:
+    """(Σ losses [scalar or C], n)."""
+    preds = _maybe_softmax(preds, axis=-1).astype(jnp.float32)
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32)
+    v = valid.astype(jnp.float32)
+    if multiclass_mode == "crammer-singer":
+        margin = jnp.sum(preds * target_oh, axis=-1) - jnp.max(
+            jnp.where(target_oh == 1, -jnp.inf, preds), axis=-1
+        )
+        losses = jnp.maximum(1.0 - margin, 0.0)
+        if squared:
+            losses = losses**2
+        return jnp.sum(losses * v), jnp.sum(v)
+    # one-vs-all: per-class binary hinge on ±1 targets
+    target_pm = target_oh * 2.0 - 1.0
+    losses = jnp.maximum(1.0 - target_pm * preds, 0.0)
+    if squared:
+        losses = losses**2
+    return jnp.sum(losses * v[:, None], axis=0), jnp.sum(v)
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Mean hinge loss for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_hinge_loss
+        >>> preds = jnp.array([[0.25, 0.20, 0.55], [0.55, 0.05, 0.40], [0.10, 0.30, 0.60], [0.90, 0.05, 0.05]])
+        >>> target = jnp.array([0, 1, 2, 0])
+        >>> multiclass_hinge_loss(preds, target, num_classes=3)
+        Array(0.9125, dtype=float32)
+    """
+    if validate_args:
+        _hinge_loss_arg_validation(squared, ignore_index)
+        if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+            raise ValueError(
+                f"Expected argument `multiclass_mode` to be one of ('crammer-singer', 'one-vs-all'),"
+                f" but got {multiclass_mode}."
+            )
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(
+        preds, target, ignore_index, convert_to_labels=False
+    )
+    measures, total = _multiclass_hinge_loss_update(preds, target, valid, num_classes, squared, multiclass_mode)
+    return safe_divide(measures, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching hinge loss (binary / multiclass)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(
+            preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

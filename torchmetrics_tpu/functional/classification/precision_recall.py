@@ -112,7 +112,7 @@ def multilabel_precision(
         >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
         >>> preds = jnp.array([[0, 0, 1], [1, 0, 1]])
         >>> multilabel_precision(preds, target, num_labels=3)
-        Array(0.33333334, dtype=float32)
+        Array(0.5, dtype=float32)
     """
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)

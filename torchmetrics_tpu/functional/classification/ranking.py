@@ -1,0 +1,154 @@
+"""Multilabel ranking metrics: coverage error, ranking average precision, ranking loss.
+
+Parity: reference ``src/torchmetrics/functional/classification/ranking.py``.
+All three are O(N·L²) broadcast-compare formulations (no sorting) that map onto the VPU
+and stay jit-safe; ``ignore_index`` positions are masked out of both counts and ranks.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+)
+from torchmetrics_tpu.utils.data import safe_divide
+
+Array = jax.Array
+
+
+def _multilabel_ranking_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+
+
+def _rank_data_ge(preds: Array, valid: Array) -> Array:
+    """rank[n, l] = #{k valid: preds[n,k] >= preds[n,l]} — dense >= rank per row."""
+    ge = (preds[:, None, :] >= preds[:, :, None]) & valid[:, None, :]  # [N, L(k ge), L(l)]
+    return jnp.sum(ge, axis=-1)
+
+
+def _multilabel_coverage_error_update(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+    """Σ per-sample coverage, n — coverage = #labels scored ≥ the lowest relevant score."""
+    rel = (target == 1) & valid
+    # lowest relevant score per sample (+inf when none relevant → coverage 0)
+    min_rel = jnp.min(jnp.where(rel, preds, jnp.inf), axis=-1)
+    coverage = jnp.sum((preds >= min_rel[:, None]) & valid, axis=-1).astype(jnp.float32)
+    coverage = jnp.where(jnp.any(rel, axis=-1), coverage, 0.0)
+    return jnp.sum(coverage), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def multilabel_coverage_error(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """How far down the ranking one must go to cover all relevant labels (sklearn
+    ``coverage_error`` semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_coverage_error
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.55, 0.75], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1], [1, 1, 1]])
+        >>> multilabel_coverage_error(preds, target, num_labels=3)
+        Array(1.75, dtype=float32)
+    """
+    if validate_args:
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, _ = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, None, ignore_index
+    )
+    measure, total = _multilabel_coverage_error_update(preds, target, valid)
+    return safe_divide(measure, total)
+
+
+def _multilabel_ranking_average_precision_update(
+    preds: Array, target: Array, valid: Array
+) -> Tuple[Array, Array]:
+    """Σ per-sample LRAP, n."""
+    rel = ((target == 1) & valid).astype(jnp.float32)  # [N, L]
+    # ge[n, l, k] = preds[n, k] >= preds[n, l] and k valid
+    ge = (preds[:, :, None] <= preds[:, None, :]) & valid[:, None, :]
+    # rank of label l = #{k: score_k >= score_l}
+    rank = jnp.sum(ge, axis=-1).astype(jnp.float32)  # [N, L]
+    # relevant-rank of label l = #{k relevant: score_k >= score_l}
+    rel_rank = jnp.einsum("nlk,nk->nl", ge.astype(jnp.float32), rel)
+    per_label = safe_divide(rel_rank, rank) * rel
+    n_rel = jnp.sum(rel, axis=-1)
+    score = safe_divide(jnp.sum(per_label, axis=-1), n_rel)
+    score = jnp.where(n_rel > 0, score, 1.0)
+    return jnp.sum(score), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def multilabel_ranking_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label-ranking average precision (sklearn ``label_ranking_average_precision_score``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_ranking_average_precision
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.55, 0.75], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1], [1, 1, 1]])
+        >>> multilabel_ranking_average_precision(preds, target, num_labels=3)
+        Array(1., dtype=float32)
+    """
+    if validate_args:
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, _ = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, None, ignore_index
+    )
+    measure, total = _multilabel_ranking_average_precision_update(preds, target, valid)
+    return safe_divide(measure, total)
+
+
+def _multilabel_ranking_loss_update(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+    """Σ per-sample ranking loss, n — fraction of mis-ordered (relevant, irrelevant) pairs."""
+    rel = ((target == 1) & valid).astype(jnp.float32)
+    irr = ((target == 0) & valid).astype(jnp.float32)
+    # pair (l relevant, k irrelevant) is mis-ordered when score_l <= score_k
+    mis = (preds[:, :, None] <= preds[:, None, :]).astype(jnp.float32)  # [N, l, k]
+    bad = jnp.einsum("nl,nlk,nk->n", rel, mis, irr)
+    n_rel = jnp.sum(rel, axis=-1)
+    n_irr = jnp.sum(irr, axis=-1)
+    denom = n_rel * n_irr
+    loss = jnp.where(denom > 0, bad / jnp.where(denom > 0, denom, 1.0), 0.0)
+    return jnp.sum(loss), jnp.asarray(preds.shape[0], dtype=jnp.float32)
+
+
+def multilabel_ranking_loss(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label-ranking loss (sklearn ``label_ranking_loss`` semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_ranking_loss
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.55, 0.75], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1], [1, 1, 1]])
+        >>> multilabel_ranking_loss(preds, target, num_labels=3)
+        Array(0., dtype=float32)
+    """
+    if validate_args:
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid, _ = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, None, ignore_index
+    )
+    measure, total = _multilabel_ranking_loss_update(preds, target, valid)
+    return safe_divide(measure, total)

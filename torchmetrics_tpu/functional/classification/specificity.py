@@ -73,7 +73,7 @@ def multiclass_specificity(
         >>> target = jnp.array([2, 1, 0, 0])
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> multiclass_specificity(preds, target, num_classes=3)
-        Array(0.8888889, dtype=float32)
+        Array(0.88888896, dtype=float32)
     """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
