@@ -1,0 +1,37 @@
+"""Force JAX onto a virtual multi-device CPU mesh, despite the pinned TPU plugin.
+
+The host image pins ``JAX_PLATFORMS=axon`` (a tunneled TPU PJRT plugin) via
+sitecustomize; when the tunnel is wedged, backend init hangs forever. Tests, the
+multichip dryrun, and the bench CPU fallback all need the same recipe: set the env
+vars before JAX initialises, force the config, and deregister the axon factory so
+nothing can touch the tunnel. Shared here so the recipe lives in exactly one place
+(used by ``tests/conftest.py``, ``__graft_entry__.py``, ``bench.py``).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    """Pin this process to an ``n_devices`` virtual CPU mesh.
+
+    Must be called before the JAX backend initialises to take full effect; callers
+    that may run after init should verify ``len(jax.devices())`` themselves.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in xla_flags:
+        os.environ["XLA_FLAGS"] = (
+            xla_flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass

@@ -127,9 +127,13 @@ class TestFixedOperatingPoint(MetricTester):
 class TestCalibrationError(MetricTester):
     @staticmethod
     def _sk_ece(p, t, n_bins=15, norm="l1"):
+        # Binary ECE per the reference semantics (calibration_error.py:136-138 in the
+        # upstream library, matching netcal): confidences are the positive-class
+        # probabilities and accuracies are the binary targets — NOT the top-label
+        # formulation (which applies only to multiclass).
         p, t = p.flatten(), t.flatten()
-        conf = np.where(p > 0.5, p, 1 - p)
-        acc = ((p > 0.5).astype(int) == t).astype(float)
+        conf = p.astype(float)
+        acc = t.astype(float)
         bins = np.clip((conf * n_bins).astype(int), 0, n_bins - 1)
         ece, mx = 0.0, 0.0
         for b in range(n_bins):

@@ -8,28 +8,16 @@ virtual devices and asserting gather-then-compute equals compute-on-all-data.
 import os
 import sys
 
-# must run before jax backend init; force-set (the host image pins JAX_PLATFORMS=axon)
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# must run before jax backend init; the host image pins JAX_PLATFORMS=axon (tunneled
+# TPU) via sitecustomize — force the 8-device virtual CPU mesh so tests never hang on
+# a stuck tunnel
+from _jax_cpu_force import force_cpu  # noqa: E402
+
+force_cpu(8)
+
 import jax  # noqa: E402
-
-# The host image's sitecustomize registers an 'axon' (tunneled TPU) PJRT plugin at
-# interpreter startup and pins JAX_PLATFORMS=axon *before* this conftest runs, so the
-# env-var overrides above may come too late. Force the config and deregister the axon
-# factory so tests always run on the 8-device virtual CPU mesh (and never hang on a
-# stuck tunnel).
-jax.config.update("jax_platforms", "cpu")
-try:  # noqa: SIM105
-    import jax._src.xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
