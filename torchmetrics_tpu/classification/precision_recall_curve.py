@@ -44,18 +44,17 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 Array = jax.Array
 
 
-def _drop_invalid(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
+def _filter_or_mask(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
     """Eagerly drop masked elements before appending to unbinned list states.
 
-    Under tracing (pure SPMD path) nothing is dropped — the downstream jit-safe curve
-    compute carries the validity mask as zero-weight segments instead.
+    Under jit tracing nothing can be dropped (dynamic shapes) — the validity mask is
+    kept as a list state instead, and the curve computes treat masked samples as
+    zero-weight segments.
     """
-    if isinstance(valid, jax.core.Tracer):
-        return preds, target
-    if bool(jnp.all(valid)):
-        return preds, target
+    if isinstance(valid, jax.core.Tracer) or bool(jnp.all(valid)):
+        return preds, target, valid
     keep = jnp.nonzero(valid)[0]
-    return preds[keep], target[keep]
+    return preds[keep], target[keep], valid[keep]
 
 
 class BinaryPrecisionRecallCurve(Metric):
@@ -81,6 +80,7 @@ class BinaryPrecisionRecallCurve(Metric):
 
     preds: List[Array]
     target: List[Array]
+    valid: List[Array]
     confmat: Array
 
     def __init__(
@@ -101,6 +101,7 @@ class BinaryPrecisionRecallCurve(Metric):
             self.thresholds = None
             self.add_state("preds", [], dist_reduce_fx="cat")
             self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("valid", [], dist_reduce_fx="cat")
         else:
             self.register_threshold_buffer(thresholds)
             self.add_state(
@@ -118,9 +119,10 @@ class BinaryPrecisionRecallCurve(Metric):
             preds, target, None, self.ignore_index
         )
         if self.thresholds is None:
-            preds, target = _drop_invalid(preds, target, valid)
+            preds, target, valid = _filter_or_mask(preds, target, valid)
             self.preds.append(preds)
             self.target.append(target)
+            self.valid.append(valid)
         else:
             self.confmat = self.confmat + _binary_precision_recall_curve_update(
                 preds, target, valid, self.thresholds
@@ -128,9 +130,7 @@ class BinaryPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
-            preds = dim_zero_cat(self.preds)
-            target = dim_zero_cat(self.target)
-            return (preds, target, jnp.ones_like(target, dtype=jnp.bool_))
+            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
         return self.confmat
 
     def compute(self) -> Tuple[Array, Array, Array]:
@@ -165,6 +165,7 @@ class MulticlassPrecisionRecallCurve(Metric):
 
     preds: List[Array]
     target: List[Array]
+    valid: List[Array]
     confmat: Array
 
     def __init__(
@@ -189,6 +190,7 @@ class MulticlassPrecisionRecallCurve(Metric):
             self.thresholds = None
             self.add_state("preds", [], dist_reduce_fx="cat")
             self.add_state("target", [], dist_reduce_fx="cat")
+            self.add_state("valid", [], dist_reduce_fx="cat")
         else:
             self.thresholds = thresholds
             shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
@@ -204,9 +206,10 @@ class MulticlassPrecisionRecallCurve(Metric):
             preds, target, self.num_classes, None, self.ignore_index, self.average
         )
         if self.thresholds is None:
-            preds, target = _drop_invalid(preds, target, valid)
+            preds, target, valid = _filter_or_mask(preds, target, valid)
             self.preds.append(preds)
             self.target.append(target)
+            self.valid.append(valid)
         elif self.average == "micro":
             self.confmat = self.confmat + _binary_precision_recall_curve_update(
                 preds, target, valid, self.thresholds
@@ -218,9 +221,7 @@ class MulticlassPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
-            preds = dim_zero_cat(self.preds)
-            target = dim_zero_cat(self.target)
-            return (preds, target, jnp.ones(target.shape[0], dtype=jnp.bool_))
+            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
         return self.confmat
 
     def compute(self):

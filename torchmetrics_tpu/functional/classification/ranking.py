@@ -27,12 +27,6 @@ def _multilabel_ranking_tensor_validation(
     _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
 
 
-def _rank_data_ge(preds: Array, valid: Array) -> Array:
-    """rank[n, l] = #{k valid: preds[n,k] >= preds[n,l]} — dense >= rank per row."""
-    ge = (preds[:, None, :] >= preds[:, :, None]) & valid[:, None, :]  # [N, L(k ge), L(l)]
-    return jnp.sum(ge, axis=-1)
-
-
 def _multilabel_coverage_error_update(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array]:
     """Σ per-sample coverage, n — coverage = #labels scored ≥ the lowest relevant score."""
     rel = (target == 1) & valid

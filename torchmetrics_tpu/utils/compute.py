@@ -19,11 +19,21 @@ def _auc_compute_without_check(x: Array, y: Array, direction: float = 1.0, axis:
 
 
 def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
-    """AUC with monotonicity handling: auto-detects decreasing x (direction = -1)."""
+    """AUC with monotonicity handling: auto-detects decreasing x (direction = -1).
+
+    Non-monotonic ``x`` with ``reorder=False`` raises eagerly (like the reference,
+    ``utilities/compute.py``); under jit tracing the check is skipped and ascending
+    order is assumed.
+    """
     if reorder:
         order = jnp.argsort(x)
         x, y = x[order], y[order]
     dx = jnp.diff(x)
+    if not reorder and not isinstance(dx, jax.core.Tracer) and dx.size:
+        if not (bool(jnp.all(dx <= 0)) or bool(jnp.all(dx >= 0))):
+            raise ValueError(
+                "The `x` array is neither increasing or decreasing. Try setting the reorder argument to `True`."
+            )
     direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
     return _auc_compute_without_check(x, y, direction)
 

@@ -166,21 +166,3 @@ def safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
     zero_mask = denom == 0
     out = num / jnp.where(zero_mask, 1, denom)
     return jnp.where(zero_mask, jnp.asarray(zero_division, dtype=dtype), out)
-
-
-def interp(x: Array, xp: Array, fp: Array) -> Array:
-    """1-D linear interpolation (jit-safe)."""
-    return jnp.interp(x, xp, fp)
-
-
-def _auc_compute(x: Array, y: Array, direction: Optional[float] = None) -> Array:
-    """Trapezoidal area under curve, handling descending x by sign flip.
-
-    Parity: reference ``utilities/compute.py:_auc_compute_without_check``.
-    """
-    dx = jnp.diff(x)
-    if direction is None:
-        # runtime direction: all dx <=0 -> -1 else +1 (computed via sign of total change)
-        direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
-    trapz = jnp.sum((y[:-1] + y[1:]) / 2.0 * dx)
-    return trapz * direction
