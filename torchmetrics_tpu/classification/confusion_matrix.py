@@ -73,6 +73,9 @@ class BinaryConfusionMatrix(Metric):
         self.validate_args = validate_args
         self.add_state("confmat", jnp.zeros((2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
 
+    def _compute_group_params(self):
+        return (self.threshold, self.ignore_index)
+
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate the batch confusion matrix."""
         if self.validate_args:
@@ -129,6 +132,9 @@ class MulticlassConfusionMatrix(Metric):
         self.normalize = normalize
         self.validate_args = validate_args
         self.add_state("confmat", jnp.zeros((num_classes, num_classes), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _compute_group_params(self):
+        return (self.num_classes, self.ignore_index)
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate the batch confusion matrix."""
@@ -193,6 +199,9 @@ class MultilabelConfusionMatrix(Metric):
         self.normalize = normalize
         self.validate_args = validate_args
         self.add_state("confmat", jnp.zeros((num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _compute_group_params(self):
+        return (self.num_labels, self.threshold, self.ignore_index)
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate the batch confusion matrices."""

@@ -44,6 +44,11 @@ from torchmetrics_tpu.utils.enums import ClassificationTask
 Array = jax.Array
 
 
+def _thresholds_key(thresholds) -> Optional[tuple]:
+    """Hashable form of the thresholds buffer for the static compute-group key."""
+    return None if thresholds is None else tuple(np.asarray(thresholds).tolist())
+
+
 def _filter_or_mask(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
     """Eagerly drop masked elements before appending to unbinned list states.
 
@@ -110,6 +115,9 @@ class BinaryPrecisionRecallCurve(Metric):
 
     def register_threshold_buffer(self, thresholds: Array) -> None:
         self.thresholds = thresholds
+
+    def _compute_group_params(self):
+        return (_thresholds_key(self.thresholds), self.ignore_index)
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores (unbinned) or the threshold-binned confusion counts."""
@@ -195,6 +203,10 @@ class MulticlassPrecisionRecallCurve(Metric):
             self.thresholds = thresholds
             shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
             self.add_state("confmat", jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _compute_group_params(self):
+        # micro-average changes the accumulated state itself (flattened binary confmat)
+        return (self.num_classes, _thresholds_key(self.thresholds), self.ignore_index, self.average == "micro")
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores or binned confusion counts."""
@@ -288,6 +300,9 @@ class MultilabelPrecisionRecallCurve(Metric):
             self.add_state(
                 "confmat", jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
             )
+
+    def _compute_group_params(self):
+        return (self.num_labels, _thresholds_key(self.thresholds), self.ignore_index)
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores or binned confusion counts."""

@@ -119,6 +119,9 @@ class BinaryStatScores(_AbstractStatScores):
         self.validate_args = validate_args
         self._create_state(size=1, multidim_average=multidim_average)
 
+    def _compute_group_params(self):
+        return (self.threshold, self.multidim_average, self.ignore_index)
+
     def update(self, preds: Array, target: Array) -> None:
         """Update tp/fp/tn/fn with a batch."""
         if self.validate_args:
@@ -172,6 +175,11 @@ class MulticlassStatScores(_AbstractStatScores):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         self._create_state(size=num_classes, multidim_average=multidim_average)
+
+    def _compute_group_params(self):
+        # `average` only affects compute (states are always per-class), so metrics
+        # differing only in average share one group
+        return (self.num_classes, self.top_k, self.multidim_average, self.ignore_index)
 
     def update(self, preds: Array, target: Array) -> None:
         """Update tp/fp/tn/fn with a batch."""
@@ -230,6 +238,9 @@ class MultilabelStatScores(_AbstractStatScores):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         self._create_state(size=num_labels, multidim_average=multidim_average)
+
+    def _compute_group_params(self):
+        return (self.num_labels, self.threshold, self.multidim_average, self.ignore_index)
 
     def update(self, preds: Array, target: Array) -> None:
         """Update tp/fp/tn/fn with a batch."""

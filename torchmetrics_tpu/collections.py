@@ -1,0 +1,485 @@
+"""``MetricCollection`` — many metrics, one call, shared state where possible.
+
+Parity: reference ``src/torchmetrics/collections.py:34-673`` (compute-group merging at
+``:238-317``).
+
+TPU-native redesign of compute groups:
+
+- The reference discovers groups *empirically*: after the first update it runs an O(n²)
+  pairwise ``allclose`` over all metric states and merges metrics whose states came out
+  equal (``collections.py:238-297``). Here state specs and update transitions are
+  *declared* (``Metric._compute_group_key``: identity of the inherited ``update``
+  function + declared state spec + update-relevant ctor args), so groups are decided
+  **statically at construction** — no warm-up update, no runtime compares, and even the
+  very first ``update`` call only runs group leaders.
+- Because metric states are immutable jax Arrays, "state aliasing" between group
+  members is always safe: members hold references to the leader's state arrays, and
+  any direct ``update`` on a member simply rebinds its own dict without corrupting the
+  leader. The reference's ``copy_state`` / ``_state_is_copy`` machinery
+  (``collections.py:299-317``) is therefore unnecessary; the kwarg is accepted for API
+  compatibility and ignored.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.core.metric import Metric, _squeeze_if_scalar
+from torchmetrics_tpu.utils.data import _flatten_dict
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricCollection:
+    """Chain metrics with the same call pattern into one object.
+
+    Args:
+        metrics: a single ``Metric``, a list/tuple of metrics (keyed by class name),
+            or a dict mapping names to metrics. ``MetricCollection`` values are
+            flattened into this collection.
+        additional_metrics: more metrics when ``metrics`` is a single one or a sequence.
+        prefix: string prepended to every key of the output dict.
+        postfix: string appended to every key of the output dict.
+        compute_groups: ``True`` (default) enables static compute-group dedup;
+            ``False`` disables; a list of lists of metric names sets groups explicitly.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import (
+        ...     MulticlassAccuracy, MulticlassPrecision, MulticlassRecall)
+        >>> target = jnp.array([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.array([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([MulticlassAccuracy(num_classes=3, average='micro'),
+        ...                             MulticlassPrecision(num_classes=3, average='macro'),
+        ...                             MulticlassRecall(num_classes=3, average='macro')])
+        >>> metrics.update(preds, target)
+        >>> sorted(metrics.compute())
+        ['MulticlassAccuracy', 'MulticlassPrecision', 'MulticlassRecall']
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, "MetricCollection", Sequence[Any], Dict[str, Any]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------------- construction
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def add_metrics(
+        self,
+        metrics: Union[Metric, "MetricCollection", Sequence[Any], Dict[str, Any]],
+        *additional_metrics: Metric,
+    ) -> None:
+        """Add new metrics to the collection."""
+        if isinstance(metrics, (Metric, MetricCollection)):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, (str, bytes)):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                sel = metrics if isinstance(m, (Metric, MetricCollection)) else remain
+                sel.append(m)
+            if remain:
+                rank_zero_warn(
+                    f"You have passed extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passed extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v._from_collection_prefix = metric.prefix
+                        v._from_collection_postfix = metric.postfix
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = type(metric).__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        v._from_collection_prefix = metric.prefix
+                        v._from_collection_postfix = metric.postfix
+                        self._modules[k] = v
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected `Metric`, `MetricCollection` or"
+                f" `dict`/`sequence` of the previous, but got {metrics}"
+            )
+
+        self._init_compute_groups()
+
+    def _init_compute_groups(self) -> None:
+        """Decide compute groups statically from declared state specs.
+
+        User-provided group lists are validated and trusted; otherwise metrics whose
+        ``_compute_group_key`` match share a group, and ungroupable metrics (key None)
+        stand alone.
+        """
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = dict(enumerate(self._enable_compute_groups))
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the"
+                            f" collection. Please make sure that {self._enable_compute_groups} matches"
+                            f" {list(self._modules)}"
+                        )
+            grouped = {name for members in self._groups.values() for name in members}
+            next_idx = len(self._groups)
+            for name in self._modules:
+                if name not in grouped:
+                    self._groups[next_idx] = [name]
+                    next_idx += 1
+            return
+
+        self._groups = {}
+        if self._enable_compute_groups is False:
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+            return
+
+        by_key: Dict[tuple, List[str]] = {}
+        singles: List[List[str]] = []
+        for name, metric in self._modules.items():
+            # only group metrics with no accumulated history: a metric added (or
+            # cloned) mid-stream must not silently inherit a leader's state
+            key = metric._compute_group_key() if metric._update_count == 0 else None
+            if key is None:
+                singles.append([name])
+            else:
+                by_key.setdefault(key, []).append(name)
+        self._groups = dict(enumerate(list(by_key.values()) + singles))
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """The current compute groups."""
+        return self._groups
+
+    # ------------------------------------------------------------------ update/compute
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update every compute-group leader; members alias the leader's state.
+
+        Positional args go to every metric; kwargs are filtered per metric signature.
+        Because groups are static, even the first call only updates leaders (the
+        reference needs one full per-metric warm-up update first,
+        ``collections.py:227-236``).
+        """
+        for name, m in self._modules.items():
+            m._computed = None
+        for members in self._groups.values():
+            m0 = self._modules[members[0]]
+            m0.update(*args, **m0._filter_kwargs(**kwargs))
+        self._sync_group_states()
+
+    def _sync_group_states(self) -> None:
+        """Point members at the leader's (immutable) state arrays.
+
+        Array states are immutable so sharing is always safe; list states are mutable
+        python lists, so members get a shallow copy (the arrays inside are shared) —
+        a direct ``update`` on a member then appends to its own list only.
+        """
+        for members in self._groups.values():
+            m0 = self._modules[members[0]]
+            for name in members[1:]:
+                mi = self._modules[name]
+                for state in m0._defaults:
+                    v = m0._state_values[state]
+                    mi._state_values[state] = list(v) if isinstance(v, list) else v
+                mi._update_count = m0._update_count
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call ``forward`` on every metric, returning the flat result dict."""
+        for m in self._modules.values():
+            m._computed = None  # skipped group members never see the new batch otherwise
+        res = self._compute_and_reduce("forward", *args, **kwargs)
+        self._sync_group_states()
+        return res
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute every metric, returning the flat result dict."""
+        return self._compute_and_reduce("compute")
+
+    def _compute_and_reduce(self, method_name: str, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Run ``compute``/``forward`` per metric and flatten dict-valued results.
+
+        Parity: reference ``collections.py:319-368``.
+        """
+        if method_name not in ("compute", "forward"):
+            raise ValueError(f"method_name should be either 'compute' or 'forward', but got {method_name}")
+
+        result = {}
+        if method_name == "compute":
+            result = self._compute_groupwise()
+        else:
+            for k, m in self._modules.items():
+                if self._group_leaders_only_forward(k):
+                    continue
+                result[k] = m(*args, **m._filter_kwargs(**kwargs))
+
+        if method_name == "forward":
+            # members of a group share the leader's batch value via compute-equality:
+            # run their compute on the leader's batch state
+            result = self._fill_group_member_forward(result, *args, **kwargs)
+
+        _, duplicates = _flatten_dict(result)
+
+        flattened_results = {}
+        for k, m in self._modules.items():
+            res = result[k]
+            if isinstance(res, dict):
+                for key, v in res.items():
+                    if duplicates:
+                        stripped_k = k
+                        key = f"{stripped_k}_{key}"
+                    cp = getattr(m, "_from_collection_prefix", None)
+                    cpost = getattr(m, "_from_collection_postfix", None)
+                    if cp:
+                        key = f"{cp}{key}"
+                    if cpost:
+                        key = f"{key}{cpost}"
+                    flattened_results[key] = v
+            else:
+                flattened_results[k] = res
+        return {self._set_name(k): v for k, v in flattened_results.items()}
+
+    def _compute_groupwise(self) -> Dict[str, Any]:
+        """Compute every metric, syncing each multi-member group's shared state ONCE.
+
+        Members of a group hold (aliases of) the leader's state, so letting each
+        member run its own distributed sync would repeat the identical collective
+        ``len(group)`` times. Instead the leader syncs, members compute against the
+        leader's synced state with their own sync suppressed, and local states are
+        restored afterwards.
+        """
+        result: Dict[str, Any] = {}
+        for members in self._groups.values():
+            m0 = self._modules[members[0]]
+            if len(members) == 1:
+                result[members[0]] = m0.compute()
+                continue
+            m0.sync(dist_sync_fn=m0.dist_sync_fn, should_sync=m0._to_sync)
+            try:
+                self._sync_group_states()  # members see the leader's (synced) state
+                for name in members:
+                    mi = self._modules[name]
+                    saved_to_sync = mi._to_sync
+                    mi._to_sync = False
+                    try:
+                        result[name] = mi.compute()
+                    finally:
+                        mi._to_sync = saved_to_sync
+            finally:
+                if m0._is_synced:
+                    m0.unsync()
+                    self._sync_group_states()  # restore members to the local state
+        return {k: result[k] for k in self._modules}
+
+    def _group_leaders_only_forward(self, name: str) -> bool:
+        """Whether ``name``'s forward can be derived from its group leader's.
+
+        Safe only for fast-path metrics: with ``full_state_update`` or
+        ``dist_sync_on_step`` the batch value depends on more than the batch state, so
+        those members run their own forward.
+        """
+        for members in self._groups.values():
+            if len(members) > 1 and name in members[1:]:
+                m = self._modules[name]
+                if m.full_state_update or m.full_state_update is None or m.dist_sync_on_step:
+                    return False
+                return True
+        return False
+
+    def _fill_group_member_forward(self, result: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Derive member batch values from the leader's post-forward batch state.
+
+        The leader's ``forward`` merged the batch into global state; the member's
+        batch value equals its ``compute`` on the batch-only state, which we obtain by
+        re-running the leader's pure update on a fresh state (one extra jitted update
+        per *group*, not per member — still cheaper than per-metric forwards).
+        """
+        ordered: Dict[str, Any] = {}
+        batch_states: Dict[int, Any] = {}  # gid -> batch-only state (computed lazily)
+        group_of = {name: gid for gid, members in self._groups.items() for name in members}
+        for k in self._modules:
+            if k in result:
+                ordered[k] = result[k]
+                continue
+            gid = group_of[k]
+            if gid not in batch_states:
+                m0 = self._modules[self._groups[gid][0]]
+                try:
+                    batch_states[gid] = m0.pure_update(m0.init_state(), *args, **m0._filter_kwargs(**kwargs))
+                except Exception:
+                    batch_states[gid] = None
+            mi = self._modules[k]
+            state = batch_states[gid]
+            if state is None:
+                ordered[k] = mi(*args, **mi._filter_kwargs(**kwargs))
+            else:
+                # same post-processing the leader's value got via _wrapped_compute
+                ordered[k] = _squeeze_if_scalar(mi.pure_compute(state))
+        return ordered
+
+    # ------------------------------------------------------------------- dict protocol
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def _to_renamed_ordered_dict(self) -> "OrderedDict[str, Metric]":
+        od: "OrderedDict[str, Metric]" = OrderedDict()
+        for k, v in self._modules.items():
+            od[self._set_name(k)] = v
+        return od
+
+    def __iter__(self) -> Iterator[Hashable]:
+        return iter(self.keys())
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules or key in self._to_renamed_ordered_dict()
+
+    def keys(self, keep_base: bool = False) -> Iterable[Hashable]:
+        """Keys, with prefix/postfix applied unless ``keep_base``."""
+        if keep_base:
+            return self._modules.keys()
+        return self._to_renamed_ordered_dict().keys()
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        """(key, metric) pairs. ``copy_state`` is accepted for API parity and ignored
+        (immutable states make aliasing always safe)."""
+        if keep_base:
+            return self._modules.items()
+        return self._to_renamed_ordered_dict().items()
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        """Metrics. ``copy_state`` accepted for parity, ignored."""
+        return self._modules.values()
+
+    def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
+        if self.prefix and key.startswith(self.prefix):
+            key = key[len(self.prefix):]
+        if self.postfix and key.endswith(self.postfix):
+            key = key[: -len(self.postfix)]
+        return self._modules[key]
+
+    def __setitem__(self, key: str, value: Metric) -> None:
+        if not isinstance(value, Metric):
+            raise ValueError(f"Value {value} is not an instance of `Metric`")
+        self._modules[key] = value
+        self._init_compute_groups()
+
+    # ---------------------------------------------------------------------- lifecycle
+
+    def reset(self) -> None:
+        """Reset every metric."""
+        for m in self._modules.values():
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Deep copy, optionally overriding prefix/postfix."""
+        mc = deepcopy(self)
+        if prefix is not None:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix is not None:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        """Toggle state persistence on every metric."""
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Serialize persistent states of all metrics, keyed by metric name."""
+        destination: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            m.state_dict(destination, prefix=f"{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        """Restore states saved by :meth:`state_dict`."""
+        for name, m in self._modules.items():
+            m.load_state_dict(state_dict, prefix=f"{name}.", strict=strict)
+
+    def set_dtype(self, dst_type) -> "MetricCollection":
+        """Cast floating states of every metric."""
+        for m in self._modules.values():
+            m.set_dtype(dst_type)
+        return self
+
+    def to_device(self, device) -> "MetricCollection":
+        """Move every metric's states to ``device``."""
+        for m in self._modules.values():
+            m.to_device(device)
+        return self
+
+    # --------------------------------------------------------------------------- misc
+
+    def plot(self, val: Any = None, ax: Any = None, together: bool = False):
+        """Plot each metric (or all together on one axis)."""
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        if together:
+            return plot_single_or_multi_val(val if val is not None else self.compute(), ax=ax)
+        vals = val if val is not None else self.compute()
+        return [m.plot(vals.get(self._set_name(k)), ax=ax) for k, m in self._modules.items()]
+
+    def __repr__(self) -> str:
+        repr_str = type(self).__name__ + "("
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix},"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix},"
+        for name, m in self._modules.items():
+            repr_str += f"\n  {name}: {type(m).__name__}"
+        return repr_str + "\n)"

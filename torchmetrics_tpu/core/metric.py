@@ -206,6 +206,39 @@ class Metric(ABC):
         """Current values of all registered states (reference ``metric.py:192-195``)."""
         return dict(self._state_values)
 
+    # ------------------------------------------------------------------ compute groups
+
+    def _compute_group_params(self) -> Optional[tuple]:
+        """Hashable tuple of the constructor args that determine the update transition,
+        or None when the metric cannot be statically grouped.
+
+        Metric families whose subclasses share an inherited ``update`` (stat-scores,
+        threshold curves, confusion matrices, ...) override this; together with the
+        identity of the ``update`` function and the declared state spec it forms the
+        static compute-group key — the TPU redesign of the reference's post-first-update
+        O(n²) allclose pass (``collections.py:238-317``): state specs are declared, so
+        group equality is decidable at construction time.
+        """
+        return None
+
+    def _compute_group_key(self) -> Optional[tuple]:
+        """Static compute-group key: metrics with equal keys share their update."""
+        params = self._compute_group_params()
+        if params is None:
+            return None
+        fn = getattr(self._update_impl, "__func__", self._update_impl)
+        spec = tuple(
+            sorted(
+                (
+                    name,
+                    "list" if isinstance(d, list) else (tuple(np.shape(d)), str(np.asarray(d).dtype)),
+                    str(self._reductions[name]),
+                )
+                for name, d in self._defaults.items()
+            )
+        )
+        return (fn.__module__, fn.__qualname__, spec, params)
+
     @property
     def update_called(self) -> bool:
         return self._update_count > 0
