@@ -1,0 +1,312 @@
+"""Wrapper-metric tests — analog of reference ``tests/unittests/wrappers/``."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassPrecision,
+)
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.wrappers import (
+    BinaryTargetTransformer,
+    BootStrapper,
+    ClasswiseWrapper,
+    LambdaInputTransformer,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+    RunningMean,
+    RunningSum,
+)
+
+NUM_CLASSES = 5
+
+
+class TestRunning:
+    def test_running_sum_window(self):
+        metric = Running(SumMetric(), window=3)
+        for i in range(6):
+            metric.update(jnp.array([float(i)]))
+        assert float(metric.compute()) == 3 + 4 + 5
+
+    def test_running_forward_returns_batch_value(self):
+        metric = Running(SumMetric(), window=3)
+        vals = [float(metric(jnp.array([float(i)]))) for i in range(6)]
+        assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+        assert float(metric.compute()) == 12.0
+
+    def test_running_mean(self):
+        metric = RunningMean(window=3)
+        for i in range(6):
+            metric(jnp.array([float(i)]))
+        assert float(metric.compute()) == 4.0
+
+    def test_running_sum_aggregation_alias(self):
+        from torchmetrics_tpu.aggregation import RunningSum as AggRunningSum
+
+        metric = AggRunningSum(window=2)
+        for i in range(4):
+            metric.update(jnp.array([float(i)]))
+        assert float(metric.compute()) == 2 + 3
+
+    def test_running_partial_window(self):
+        metric = RunningMean(window=5)
+        metric.update(jnp.array([2.0]))
+        metric.update(jnp.array([4.0]))
+        assert float(metric.compute()) == 3.0
+
+    def test_running_rejects_full_state_update(self):
+        from torchmetrics_tpu.aggregation import MaxMetric
+
+        with pytest.raises(ValueError, match="full_state_update"):
+            Running(MaxMetric(), window=3)
+
+    def test_running_reset(self):
+        metric = RunningSum(window=3)
+        metric.update(jnp.array([5.0]))
+        metric.reset()
+        metric.update(jnp.array([1.0]))
+        assert float(metric.compute()) == 1.0
+
+    def test_running_stat_scores_metric(self):
+        """Running works for any full_state_update=False metric, not just aggregators."""
+        rng = np.random.RandomState(0)
+        metric = Running(BinaryAccuracy(), window=2)
+        batches = [(jnp.asarray(rng.rand(8)), jnp.asarray(rng.randint(0, 2, 8))) for _ in range(4)]
+        for p, t in batches:
+            metric.update(p, t)
+        # window covers last two batches
+        ref = BinaryAccuracy()
+        for p, t in batches[-2:]:
+            ref.update(p, t)
+        np.testing.assert_allclose(np.asarray(metric.compute()), np.asarray(ref.compute()), rtol=1e-6)
+
+
+class TestBootStrapper:
+    def test_output_keys(self):
+        np.random.seed(42)
+        boot = BootStrapper(MulticlassAccuracy(NUM_CLASSES, average="micro"), num_bootstraps=10, raw=True, quantile=0.5)
+        rng = np.random.RandomState(0)
+        boot.update(jnp.asarray(rng.rand(50, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, 50)))
+        out = boot.compute()
+        assert set(out) == {"mean", "std", "quantile", "raw"}
+        assert out["raw"].shape == (10,)
+
+    def test_mean_close_to_point_estimate(self):
+        np.random.seed(42)
+        boot = BootStrapper(MulticlassAccuracy(NUM_CLASSES, average="micro"), num_bootstraps=50)
+        rng = np.random.RandomState(1)
+        p = jnp.asarray(rng.rand(512, NUM_CLASSES))
+        t = jnp.asarray(rng.randint(0, NUM_CLASSES, 512))
+        boot.update(p, t)
+        point = MulticlassAccuracy(NUM_CLASSES, average="micro")
+        point.update(p, t)
+        assert abs(float(boot.compute()["mean"]) - float(point.compute())) < 0.05
+
+    def test_forward_accumulates(self):
+        np.random.seed(0)
+        boot = BootStrapper(MulticlassAccuracy(NUM_CLASSES, average="micro"), num_bootstraps=4)
+        rng = np.random.RandomState(2)
+        for _ in range(3):
+            out = boot(jnp.asarray(rng.rand(32, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, 32)))
+            assert "mean" in out
+        assert all(m.update_count == 3 for m in boot.metrics)
+
+    def test_multinomial_strategy(self):
+        np.random.seed(0)
+        boot = BootStrapper(BinaryAccuracy(), num_bootstraps=5, sampling_strategy="multinomial")
+        boot.update(jnp.asarray(np.random.rand(20)), jnp.asarray(np.random.randint(0, 2, 20)))
+        assert "mean" in boot.compute()
+
+    def test_bad_strategy_raises(self):
+        with pytest.raises(ValueError, match="sampling_strategy"):
+            BootStrapper(BinaryAccuracy(), sampling_strategy="bogus")
+
+
+class TestClasswiseWrapper:
+    def test_keys_default(self):
+        metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        rng = np.random.RandomState(0)
+        out = metric(jnp.asarray(rng.rand(10, 3)), jnp.asarray(rng.randint(0, 3, 10)))
+        assert set(out) == {"multiclassaccuracy_0", "multiclassaccuracy_1", "multiclassaccuracy_2"}
+
+    def test_labels(self):
+        metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=2, average=None), labels=["cat", "dog"])
+        rng = np.random.RandomState(0)
+        metric.update(jnp.asarray(rng.rand(10, 2)), jnp.asarray(rng.randint(0, 2, 10)))
+        assert set(metric.compute()) == {"multiclassaccuracy_cat", "multiclassaccuracy_dog"}
+
+    def test_values_match_unwrapped(self):
+        rng = np.random.RandomState(0)
+        p, t = jnp.asarray(rng.rand(32, 3)), jnp.asarray(rng.randint(0, 3, 32))
+        wrapped = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        plain = MulticlassAccuracy(num_classes=3, average=None)
+        wrapped.update(p, t)
+        plain.update(p, t)
+        out = wrapped.compute()
+        ref = np.asarray(plain.compute())
+        for i in range(3):
+            np.testing.assert_allclose(np.asarray(out[f"multiclassaccuracy_{i}"]), ref[i], rtol=1e-6)
+
+    def test_in_collection(self):
+        col = MetricCollection({"cw": ClasswiseWrapper(MulticlassAccuracy(num_classes=2, average=None))})
+        rng = np.random.RandomState(0)
+        col.update(jnp.asarray(rng.rand(10, 2)), jnp.asarray(rng.randint(0, 2, 10)))
+        res = col.compute()
+        assert any("multiclassaccuracy" in k for k in res)
+
+
+class TestMinMax:
+    def test_tracks_extrema(self):
+        base = MeanMetric()
+        mm = MinMaxMetric(base)
+        mm.update(jnp.array([1.0]))
+        out1 = mm.compute()
+        assert float(out1["raw"]) == 1.0 and float(out1["min"]) == 1.0 and float(out1["max"]) == 1.0
+        mm.update(jnp.array([5.0]))
+        out2 = mm.compute()
+        assert float(out2["raw"]) == 3.0
+        assert float(out2["max"]) == 3.0 and float(out2["min"]) == 1.0
+
+    def test_forward_accumulates(self):
+        mm = MinMaxMetric(BinaryAccuracy())
+        p1, t1 = jnp.array([1.0, 1.0]), jnp.array([0, 1])
+        p2, t2 = jnp.array([0.9, 0.1]), jnp.array([0, 0])
+        out = mm(p1, t1)
+        assert float(out["raw"]) == 0.5
+        mm(p2, t2)
+        # global state covers both batches
+        assert abs(float(mm.compute()["raw"]) - 0.5) < 1e-6
+
+    def test_non_scalar_raises(self):
+        mm = MinMaxMetric(MulticlassAccuracy(3, average=None))
+        rng = np.random.RandomState(0)
+        mm.update(jnp.asarray(rng.rand(10, 3)), jnp.asarray(rng.randint(0, 3, 10)))
+        with pytest.raises(RuntimeError, match="scalar"):
+            mm.compute()
+
+
+class TestMultioutput:
+    def test_r2_like_two_outputs(self):
+        # use MeanMetric per output as a simple stand-in
+        target = jnp.array([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+        wrapper = MultioutputWrapper(MeanMetric(), 2)
+        wrapper.update(target)
+        out = np.asarray(wrapper.compute())
+        np.testing.assert_allclose(out, np.asarray(target).mean(axis=0), rtol=1e-6)
+
+    def test_remove_nans(self):
+        target = jnp.array([[1.0, 2.0], [jnp.nan, 4.0], [3.0, 6.0]])
+        wrapper = MultioutputWrapper(MeanMetric(nan_strategy="error"), 2)
+        wrapper.update(target)
+        out = np.asarray(wrapper.compute())
+        np.testing.assert_allclose(out, [2.0, 4.0], rtol=1e-6)
+
+    def test_forward(self):
+        wrapper = MultioutputWrapper(MeanMetric(), 2)
+        out = wrapper(jnp.array([[1.0, 2.0], [3.0, 4.0]]))
+        np.testing.assert_allclose(np.asarray(out), [2.0, 3.0], rtol=1e-6)
+
+
+class TestMultitask:
+    def test_update_compute(self):
+        metrics = MultitaskWrapper({
+            "cls": BinaryAccuracy(),
+            "agg": MeanMetric(),
+        })
+        metrics.update(
+            {"cls": jnp.array([0, 0, 1]), "agg": jnp.array([3.0, 5.0, 2.5])},
+            {"cls": jnp.array([0, 1, 0]), "agg": jnp.array([0.0, 0.0, 0.0])},
+        )
+        res = metrics.compute()
+        assert set(res) == {"cls", "agg"}
+        assert abs(float(res["cls"]) - 1 / 3) < 1e-6
+
+    def test_key_mismatch_raises(self):
+        metrics = MultitaskWrapper({"a": BinaryAccuracy()})
+        with pytest.raises(ValueError, match="same keys"):
+            metrics.update({"b": jnp.array([1])}, {"b": jnp.array([1])})
+
+    def test_nested_collection(self):
+        metrics = MultitaskWrapper({
+            "cls": MetricCollection([MulticlassAccuracy(3), MulticlassPrecision(3)]),
+        })
+        rng = np.random.RandomState(0)
+        metrics.update(
+            {"cls": jnp.asarray(rng.rand(10, 3))},
+            {"cls": jnp.asarray(rng.randint(0, 3, 10))},
+        )
+        res = metrics.compute()
+        assert "MulticlassAccuracy" in res["cls"]
+
+    def test_clone_prefix(self):
+        metrics = MultitaskWrapper({"t": BinaryAccuracy()})
+        c = metrics.clone(prefix="val_")
+        c.update({"t": jnp.array([0, 1])}, {"t": jnp.array([0, 1])})
+        assert set(c.compute()) == {"val_t"}
+
+
+class TestTracker:
+    def test_best_metric_single(self):
+        tracker = MetricTracker(MulticlassAccuracy(NUM_CLASSES, average="micro"))
+        rng = np.random.RandomState(0)
+        for _ in range(4):
+            tracker.increment()
+            tracker.update(jnp.asarray(rng.rand(64, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, 64)))
+        all_vals = np.asarray(tracker.compute_all())
+        assert all_vals.shape == (4,)
+        best, step = tracker.best_metric(return_step=True)
+        assert best == pytest.approx(float(all_vals.max()))
+        assert step == int(all_vals.argmax())
+
+    def test_collection_tracking(self):
+        tracker = MetricTracker(
+            MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)]),
+            maximize=[True, True],
+        )
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            tracker.increment()
+            tracker.update(jnp.asarray(rng.rand(64, NUM_CLASSES)), jnp.asarray(rng.randint(0, NUM_CLASSES, 64)))
+        res = tracker.compute_all()
+        assert res["MulticlassAccuracy"].shape == (3,)
+        best, steps = tracker.best_metric(return_step=True)
+        assert set(best) == {"MulticlassAccuracy", "MulticlassPrecision"}
+
+    def test_update_before_increment_raises(self):
+        tracker = MetricTracker(BinaryAccuracy())
+        with pytest.raises(ValueError, match="increment"):
+            tracker.update(jnp.array([1]), jnp.array([1]))
+
+
+class TestTransformations:
+    def test_lambda_transform(self):
+        preds = jnp.array([0.9, 0.2])
+        target = jnp.array([0, 1])
+        metric = LambdaInputTransformer(BinaryAccuracy(), lambda p: 1 - p)
+        metric.update(preds, target)
+        assert float(metric.compute()) == 1.0
+
+    def test_binary_target_transform(self):
+        metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=0.5)
+        metric.update(jnp.array([0.9, 0.2]), jnp.array([0.8, 0.3]))
+        assert float(metric.compute()) == 1.0
+
+    def test_forward_path(self):
+        metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=0.5)
+        out = metric(jnp.array([0.9, 0.2]), jnp.array([0.8, 0.3]))
+        assert float(out) == 1.0
+
+    def test_bad_types_raise(self):
+        with pytest.raises(TypeError):
+            LambdaInputTransformer(BinaryAccuracy(), transform_pred="not-callable")
+        with pytest.raises(TypeError):
+            BinaryTargetTransformer(BinaryAccuracy(), threshold="nope")
+        with pytest.raises(TypeError):
+            BinaryTargetTransformer("not-a-metric")

@@ -25,6 +25,16 @@ from torchmetrics_tpu.classification import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
+from torchmetrics_tpu.wrappers import (  # noqa: E402
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+from torchmetrics_tpu.wrappers.running import RunningMean, RunningSum  # noqa: E402
 
 __all__ = [
     "functional",
@@ -36,5 +46,14 @@ __all__ = [
     "MeanMetric",
     "MinMetric",
     "SumMetric",
+    "RunningMean",
+    "RunningSum",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "Running",
     *_classification_all,
 ]

@@ -183,4 +183,13 @@ def __getattr__(name: str):
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
-__all__ = ["BaseAggregator", "MaxMetric", "MinMetric", "SumMetric", "MeanMetric", "CatMetric"]
+__all__ = [
+    "BaseAggregator",
+    "MaxMetric",
+    "MinMetric",
+    "SumMetric",
+    "MeanMetric",
+    "CatMetric",
+    "RunningMean",
+    "RunningSum",
+]

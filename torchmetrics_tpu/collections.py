@@ -275,11 +275,17 @@ class MetricCollection:
             res = result[k]
             if isinstance(res, dict):
                 for key, v in res.items():
-                    if duplicates:
-                        stripped_k = k
-                        key = f"{stripped_k}_{key}"
                     cp = getattr(m, "_from_collection_prefix", None)
                     cpost = getattr(m, "_from_collection_postfix", None)
+                    if duplicates:
+                        # strip the nested collection's own affixes from the module
+                        # name so they are not applied twice below
+                        stripped_k = k
+                        if cp:
+                            stripped_k = stripped_k.replace(cp, "")
+                        if cpost:
+                            stripped_k = stripped_k.replace(cpost, "")
+                        key = f"{stripped_k}_{key}"
                     if cp:
                         key = f"{cp}{key}"
                     if cpost:
@@ -344,6 +350,8 @@ class MetricCollection:
         re-running the leader's pure update on a fresh state (one extra jitted update
         per *group*, not per member — still cheaper than per-metric forwards).
         """
+        from torchmetrics_tpu.core.jit import jit_with_static_leaves
+
         ordered: Dict[str, Any] = {}
         batch_states: Dict[int, Any] = {}  # gid -> batch-only state (computed lazily)
         group_of = {name: gid for gid, members in self._groups.items() for name in members}
@@ -354,17 +362,18 @@ class MetricCollection:
             gid = group_of[k]
             if gid not in batch_states:
                 m0 = self._modules[self._groups[gid][0]]
-                try:
-                    batch_states[gid] = m0.pure_update(m0.init_state(), *args, **m0._filter_kwargs(**kwargs))
-                except Exception:
-                    batch_states[gid] = None
+                filtered = m0._filter_kwargs(**kwargs)
+                if m0._jit_enabled():
+                    # reuse (or build) the leader's compiled update so the per-batch
+                    # cost stays one cached XLA dispatch, not an eager op-by-op walk
+                    if m0._jitted_update is None:
+                        m0._jitted_update = jit_with_static_leaves(m0.pure_update)
+                    batch_states[gid] = m0._jitted_update(m0.init_state(), *args, **filtered)
+                else:
+                    batch_states[gid] = m0.pure_update(m0.init_state(), *args, **filtered)
             mi = self._modules[k]
-            state = batch_states[gid]
-            if state is None:
-                ordered[k] = mi(*args, **mi._filter_kwargs(**kwargs))
-            else:
-                # same post-processing the leader's value got via _wrapped_compute
-                ordered[k] = _squeeze_if_scalar(mi.pure_compute(state))
+            # same post-processing the leader's value got via _wrapped_compute
+            ordered[k] = _squeeze_if_scalar(mi.pure_compute(batch_states[gid]))
         return ordered
 
     # ------------------------------------------------------------------- dict protocol

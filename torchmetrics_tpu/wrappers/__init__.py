@@ -1,0 +1,36 @@
+"""Wrapper metrics: composition utilities around any ``Metric``.
+
+Parity: reference ``src/torchmetrics/wrappers/__init__.py`` (11 exported classes).
+"""
+
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+from torchmetrics_tpu.wrappers.bootstrapping import BootStrapper
+from torchmetrics_tpu.wrappers.classwise import ClasswiseWrapper
+from torchmetrics_tpu.wrappers.feature_share import FeatureShare
+from torchmetrics_tpu.wrappers.minmax import MinMaxMetric
+from torchmetrics_tpu.wrappers.multioutput import MultioutputWrapper
+from torchmetrics_tpu.wrappers.multitask import MultitaskWrapper
+from torchmetrics_tpu.wrappers.running import Running, RunningMean, RunningSum
+from torchmetrics_tpu.wrappers.tracker import MetricTracker
+from torchmetrics_tpu.wrappers.transformations import (
+    BinaryTargetTransformer,
+    LambdaInputTransformer,
+    MetricInputTransformer,
+)
+
+__all__ = [
+    "WrapperMetric",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "FeatureShare",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "MetricTracker",
+    "Running",
+    "RunningMean",
+    "RunningSum",
+    "MetricInputTransformer",
+    "LambdaInputTransformer",
+    "BinaryTargetTransformer",
+]

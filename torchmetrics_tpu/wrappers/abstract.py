@@ -1,0 +1,47 @@
+"""Wrapper-metric base class.
+
+Parity: reference ``src/torchmetrics/wrappers/abstract.py:19-42`` (``WrapperMetric``
+disables its own sync/wrapping; the wrapped metric handles all of it).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+
+Array = jax.Array
+
+
+def apply_to_arrays(data: Any, fn: Callable[[Array], Any]) -> Any:
+    """Apply ``fn`` to every jax array in a nested tuple/list/dict collection."""
+    if isinstance(data, (jax.Array, jnp.ndarray)):
+        return fn(data)
+    if isinstance(data, dict):
+        return {k: apply_to_arrays(v, fn) for k, v in data.items()}
+    if isinstance(data, (list, tuple)):
+        return type(data)(apply_to_arrays(v, fn) for v in data)
+    return data
+
+
+class WrapperMetric(Metric):
+    """Base class for metrics that wrap another metric and forward all calls to it.
+
+    All synchronization is the wrapped metric's job: this class's own sync is a no-op,
+    and its update never routes through the jit dispatcher (delegated updates mutate
+    the child's state, which is not a pure transition of the wrapper's own pytree).
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
+        """No-op: the wrapped metric syncs itself."""
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Each wrapper defines its own forward."""
+        raise NotImplementedError

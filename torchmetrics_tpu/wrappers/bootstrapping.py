@@ -1,0 +1,172 @@
+"""Bootstrap resampling wrapper.
+
+Parity: reference ``src/torchmetrics/wrappers/bootstrapping.py:55-219``.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric, apply_to_arrays
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson") -> np.ndarray:
+    """Index vector that resamples ``size`` rows with replacement.
+
+    Sampling runs on host (numpy) — it only produces gather indices; the actual
+    gathers execute on device. ``'poisson'`` draws per-sample inclusion counts from
+    Poisson(1) (approximates the bootstrap for large n); ``'multinomial'`` draws
+    uniformly with replacement.
+    """
+    if sampling_strategy == "poisson":
+        n = np.random.poisson(1.0, size=size)
+        return np.repeat(np.arange(size), n)
+    if sampling_strategy == "multinomial":
+        return np.random.randint(0, size, size=size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    r"""Turn any metric into a bootstrapped estimate with confidence statistics.
+
+    Keeps ``num_bootstraps`` copies of the base metric; every ``update``/``forward``
+    resamples the batch (with replacement) along dim 0 independently per copy.
+
+    Args:
+        base_metric: the metric to bootstrap.
+        num_bootstraps: number of resampled copies.
+        mean: include the bootstrap mean in the output dict.
+        std: include the bootstrap standard deviation.
+        quantile: optionally include this quantile (float or array of floats).
+        raw: include all bootstrap values.
+        sampling_strategy: ``'poisson'`` or ``'multinomial'``.
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import BootStrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> np.random.seed(123)
+        >>> bootstrap = BootStrapper(MulticlassAccuracy(num_classes=5, average='micro'), num_bootstraps=20)
+        >>> bootstrap.update(jnp.asarray(np.random.randint(5, size=20)), jnp.asarray(np.random.randint(5, size=20)))
+        >>> sorted(bootstrap.compute())
+        ['mean', 'std']
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+
+    def _input_size(self, *args: Any, **kwargs: Any) -> int:
+        sizes: list = []
+        apply_to_arrays(args, lambda a: sizes.append(a.shape[0]) or a)
+        if not sizes:
+            apply_to_arrays(kwargs, lambda a: sizes.append(a.shape[0]) or a)
+        if not sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        return sizes[0]
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch along dim 0 for each bootstrap copy and update it."""
+        size = self._input_size(*args, **kwargs)
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, sampling_strategy=self.sampling_strategy)
+            if sample_idx.size == 0:
+                continue
+            idx_dev = jnp.asarray(sample_idx)
+            new_args = apply_to_arrays(args, lambda a: jnp.take(a, idx_dev, axis=0))
+            new_kwargs = apply_to_arrays(kwargs, lambda a: jnp.take(a, idx_dev, axis=0))
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Accumulate (resampled) and return the batch-level bootstrap stats.
+
+        Unlike the reference (which routes through the full-state forward and resets
+        the copies, keeping only the last batch), each copy's own ``forward`` runs, so
+        global accumulation is preserved while batch-level stats are returned.
+        """
+        size = self._input_size(*args, **kwargs)
+        vals = []
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, sampling_strategy=self.sampling_strategy)
+            if sample_idx.size == 0:
+                continue
+            idx_dev = jnp.asarray(sample_idx)
+            new_args = apply_to_arrays(args, lambda a: jnp.take(a, idx_dev, axis=0))
+            new_kwargs = apply_to_arrays(kwargs, lambda a: jnp.take(a, idx_dev, axis=0))
+            vals.append(jnp.asarray(self.metrics[idx](*new_args, **new_kwargs)))
+        self._computed = None
+        self._update_count += 1
+        if not vals:
+            # every poisson resample came out empty (likely batch size 1): there is no
+            # defined batch-level statistic — report NaNs rather than crashing
+            nan = jnp.asarray(float("nan"))
+            out = {}
+            if self.mean:
+                out["mean"] = nan
+            if self.std:
+                out["std"] = nan
+            if self.quantile is not None:
+                out["quantile"] = nan
+            if self.raw:
+                out["raw"] = jnp.zeros((0,))
+            return out
+        return self._stats_dict(jnp.stack(vals, axis=0))
+
+    def _stats_dict(self, computed_vals: Array) -> Dict[str, Array]:
+        output_dict: Dict[str, Array] = {}
+        if self.mean:
+            output_dict["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output_dict["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def compute(self) -> Dict[str, Array]:
+        """Bootstrap statistics dict with keys among ``mean``/``std``/``quantile``/``raw``."""
+        return self._stats_dict(jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0))
+
+    def reset(self) -> None:
+        """Reset all bootstrap copies."""
+        for m in self.metrics:
+            m.reset()
+        super().reset()

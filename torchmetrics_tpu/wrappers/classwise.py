@@ -1,0 +1,89 @@
+"""Classwise output-splitting wrapper.
+
+Parity: reference ``src/torchmetrics/wrappers/classwise.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class ClasswiseWrapper(WrapperMetric):
+    """Split a per-class metric result into a ``{name: scalar}`` dict.
+
+    Args:
+        metric: base metric returning a per-class vector (e.g. ``average=None``).
+        labels: optional class names (defaults to indices).
+        prefix: key prefix (default ``<metricname>_``).
+        postfix: key postfix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import ClasswiseWrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> preds = jnp.array([[0.8, 0.1, 0.1], [0.1, 0.8, 0.1]])
+        >>> target = jnp.array([0, 1])
+        >>> sorted(metric(preds, target))
+        ['multiclassaccuracy_0', 'multiclassaccuracy_1', 'multiclassaccuracy_2']
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        labels: Optional[List[str]] = None,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
+        self.metric = metric
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.labels = labels
+        if prefix is not None and not isinstance(prefix, str):
+            raise ValueError(f"Expected argument `prefix` to either be `None` or a string but got {prefix}")
+        self._prefix = prefix
+        if postfix is not None and not isinstance(postfix, str):
+            raise ValueError(f"Expected argument `postfix` to either be `None` or a string but got {postfix}")
+        self._postfix = postfix
+        self._update_count = 1
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.metric._filter_kwargs(**kwargs)
+
+    def _convert_output(self, x: Array) -> Dict[str, Any]:
+        if not self._prefix and not self._postfix:
+            prefix = f"{type(self.metric).__name__.lower()}_"
+            postfix = ""
+        else:
+            prefix = self._prefix or ""
+            postfix = self._postfix or ""
+        if self.labels is None:
+            return {f"{prefix}{i}{postfix}": val for i, val in enumerate(x)}
+        return {f"{prefix}{lab}{postfix}": val for lab, val in zip(self.labels, x)}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Batch value as a classwise dict, accumulating global state."""
+        return self._convert_output(self.metric(*args, **kwargs))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the wrapped metric."""
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Classwise dict of the wrapped metric's result."""
+        return self._convert_output(self.metric.compute())
+
+    def reset(self) -> None:
+        """Reset the wrapped metric (and this wrapper's compute cache)."""
+        super().reset()
+        self.metric.reset()

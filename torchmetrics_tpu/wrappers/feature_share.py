@@ -1,0 +1,95 @@
+"""Feature-sharing collection for model-based metrics.
+
+Parity: reference ``src/torchmetrics/wrappers/feature_share.py:26-127``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+
+class NetworkCache:
+    """Memoizing proxy around a feature-extractor callable.
+
+    Different metrics in a :class:`FeatureShare` call the same backbone on the same
+    batch; caching input→output pairs means the expensive forward runs once per batch
+    instead of once per metric. Keys are the object ids of the input arrays; each
+    cache entry keeps strong references to its key objects, so an id can never be
+    recycled by a new array while its entry is alive (jax arrays are immutable, so a
+    live id uniquely identifies its contents).
+    """
+
+    def __init__(self, network: Any, max_size: int = 100) -> None:
+        self.max_size = max_size
+        self.network = network
+        # key -> (args, kwargs, output); the stored inputs pin the ids in the key
+        self._cache: "dict[tuple, tuple]" = {}
+
+    def _key(self, args: tuple, kwargs: dict) -> tuple:
+        return tuple(id(a) for a in args) + tuple((k, id(v)) for k, v in sorted(kwargs.items()))
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        key = self._key(args, kwargs)
+        if key in self._cache:
+            return self._cache[key][2]
+        out = self.network(*args, **kwargs)
+        if len(self._cache) >= self.max_size:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = (args, kwargs, out)
+        return out
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.__dict__["network"], name)
+
+
+class FeatureShare(MetricCollection):
+    """MetricCollection that shares one cached feature extractor across its metrics.
+
+    Each member metric must expose a ``feature_network`` attribute naming the
+    attribute that holds its backbone; the first member's backbone (wrapped in a
+    :class:`NetworkCache`) replaces every member's.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        max_cache_size: Optional[int] = None,
+    ) -> None:
+        # compute groups off: sharing happens at the network level instead
+        super().__init__(metrics=metrics, compute_groups=False)
+
+        if max_cache_size is None:
+            max_cache_size = len(self)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+
+        try:
+            first_net = next(iter(self.values()))
+            network_to_share = getattr(first_net, first_net.feature_network)
+        except AttributeError as err:
+            raise AttributeError(
+                "Tried to extract the network to share from the first metric, but it did not have a"
+                " `feature_network` attribute. Please make sure that the metric has an attribute with that"
+                " name, else it cannot be shared."
+            ) from err
+        cached_net = NetworkCache(network_to_share, max_size=max_cache_size)
+
+        for metric_name, metric in self.items():
+            if not hasattr(metric, "feature_network"):
+                raise AttributeError(
+                    "Tried to set the cached network to all metrics, but one of the metrics did not have a"
+                    " `feature_network` attribute. Please make sure that all metrics have a attribute with"
+                    f" that name, else it cannot be shared. Failed on metric {metric_name}."
+                )
+            if getattr(metric, metric.feature_network) is not network_to_share:
+                rank_zero_warn(
+                    f"The network to share between the metrics is not the same for all metrics."
+                    f" Metric {metric_name} has a different network than the first metric."
+                    " This may lead to unexpected behavior.",
+                    UserWarning,
+                )
+            setattr(metric, metric.feature_network, cached_net)

@@ -1,0 +1,90 @@
+"""Min/max tracking wrapper.
+
+Parity: reference ``src/torchmetrics/wrappers/minmax.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the min and max of a scalar metric across an experiment.
+
+    ``compute`` returns ``{"raw": current, "min": lowest seen, "max": highest seen}``;
+    the extrema update on every ``compute`` call.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MinMaxMetric
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> _ = metric(jnp.array([1.0, 1.0]), jnp.array([0, 1]))
+        >>> sorted(metric.compute())
+        ['max', 'min', 'raw']
+    """
+
+    full_state_update = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        # registered states: survive state_dict round-trips and set_dtype/to_device
+        self.add_state("min_val", jnp.asarray(float("inf")), dist_reduce_fx="min")
+        self.add_state("max_val", jnp.asarray(float("-inf")), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the wrapped metric."""
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Current value plus running min/max (extrema update here)."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        val = jnp.asarray(val)
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Batch-level value dict; extrema track batch values seen through forward.
+
+        The wrapped metric's own ``forward`` runs, so global accumulation is
+        preserved (the reference resets the child through the full-state path and
+        keeps only the last batch).
+        """
+        val = jnp.asarray(self._base_metric(*args, **kwargs))
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        self._computed = None
+        self._update_count += 1
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        """Reset extrema (state defaults) and the wrapped metric."""
+        super().reset()
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, (jax.Array, np.ndarray)):
+            return np.asarray(val).size == 1
+        return False

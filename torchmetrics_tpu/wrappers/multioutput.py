@@ -1,0 +1,124 @@
+"""Multioutput wrapper: one metric copy per output dimension.
+
+Parity: reference ``src/torchmetrics/wrappers/multioutput.py``.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric, apply_to_arrays
+
+Array = jax.Array
+
+
+def _get_nan_indices(*arrays: Array) -> Array:
+    """Boolean mask of rows containing any NaN in any of the given arrays."""
+    if len(arrays) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = arrays[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=bool)
+    for a in arrays:
+        flat = a.reshape(len(a), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(flat), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Compute one metric per output dimension for metrics lacking multioutput support.
+
+    ``compute`` stacks the per-output results into shape ``(num_outputs, ...)``.
+    ``remove_nans`` drops rows that contain NaN in any input (per output, host-side —
+    dynamic shapes keep this wrapper on the eager path).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MultioutputWrapper
+        >>> from torchmetrics_tpu.regression import R2Score
+        >>> target = jnp.array([[0.5, 1.0], [-1.0, 1.0], [7.0, -6.0]])
+        >>> preds = jnp.array([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0]])
+        >>> r2score = MultioutputWrapper(R2Score(), 2)
+        >>> r2score(preds, target).round(4)
+        Array([0.9654, 0.9082], dtype=float32)
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+    ) -> None:
+        super().__init__()
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array) -> List[Tuple[list, dict]]:
+        """Slice args/kwargs per output (and optionally strip NaN rows)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            def pick(a, i=i):
+                return jnp.take(a, jnp.asarray([i]), axis=self.output_dim)
+
+            selected_args = list(apply_to_arrays(args, pick))
+            selected_kwargs = apply_to_arrays(kwargs, pick)
+            if self.remove_nans:
+                all_arrays = [a for a in selected_args if isinstance(a, jax.Array)] + [
+                    v for v in selected_kwargs.values() if isinstance(v, jax.Array)
+                ]
+                nan_idxs = np.asarray(_get_nan_indices(*all_arrays))
+                keep = ~nan_idxs
+                selected_args = [a[keep] if isinstance(a, jax.Array) else a for a in selected_args]
+                selected_kwargs = {
+                    k: (v[keep] if isinstance(v, jax.Array) else v) for k, v in selected_kwargs.items()
+                }
+            if self.squeeze_outputs:
+                dim = self.output_dim
+
+                def squeeze(a, dim=dim):
+                    return jnp.squeeze(a, axis=dim)
+
+                selected_args = [squeeze(a) if isinstance(a, jax.Array) else a for a in selected_args]
+                selected_kwargs = {
+                    k: (squeeze(v) if isinstance(v, jax.Array) else v) for k, v in selected_kwargs.items()
+                }
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each per-output metric with its slice."""
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        """Stack per-output results: shape ``(num_outputs, ...)``."""
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Per-output forward values, stacked."""
+        reshaped = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped)
+        ]
+        if any(r is None for r in results):
+            return None
+        return jnp.stack([jnp.asarray(r) for r in results], 0)
+
+    def reset(self) -> None:
+        """Reset all per-output metrics."""
+        for m in self.metrics:
+            m.reset()
+        super().reset()

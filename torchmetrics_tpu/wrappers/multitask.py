@@ -1,0 +1,127 @@
+"""Multitask wrapper: different metrics for different tasks, one call.
+
+Parity: reference ``src/torchmetrics/wrappers/multitask.py``.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Iterable, Optional, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MultitaskWrapper(WrapperMetric):
+    """Route per-task preds/targets dicts to per-task metrics.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MultitaskWrapper
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metrics = MultitaskWrapper({
+        ...     "Classification": BinaryAccuracy(),
+        ...     "Regression": MeanSquaredError(),
+        ... })
+        >>> metrics.update(
+        ...     {"Classification": jnp.array([0, 0, 1]), "Regression": jnp.array([3.0, 5.0, 2.5])},
+        ...     {"Classification": jnp.array([0, 1, 0]), "Regression": jnp.array([2.5, 5.0, 4.0])},
+        ... )
+        >>> sorted(metrics.compute())
+        ['Classification', 'Regression']
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        task_metrics: Dict[str, Union[Metric, MetricCollection]],
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        self._check_task_metrics_type(task_metrics)
+        super().__init__()
+        self.task_metrics = dict(task_metrics)
+        self._prefix = prefix or ""
+        self._postfix = postfix or ""
+
+    @staticmethod
+    def _check_task_metrics_type(task_metrics: Dict[str, Any]) -> None:
+        if not isinstance(task_metrics, dict):
+            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+        for metric in task_metrics.values():
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise TypeError(
+                    "Expected each task's metric to be a Metric or a MetricCollection. "
+                    f"Found a metric of type {type(metric)}"
+                )
+
+    def items(self, flatten: bool = True) -> Iterable[Tuple[str, Any]]:
+        """(task_name, metric) pairs; collections are flattened when ``flatten``."""
+        for task_name, metric in self.task_metrics.items():
+            if flatten and isinstance(metric, MetricCollection):
+                for sub_name, sub_metric in metric.items():
+                    yield f"{task_name}_{sub_name}", sub_metric
+            else:
+                yield task_name, metric
+
+    def keys(self, flatten: bool = True) -> Iterable[str]:
+        """Task (or flattened sub-metric) names."""
+        for name, _ in self.items(flatten=flatten):
+            yield name
+
+    def values(self, flatten: bool = True) -> Iterable[Any]:
+        """Metrics (flattened out of collections when ``flatten``)."""
+        for _, metric in self.items(flatten=flatten):
+            yield metric
+
+    def _check_keys(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        if not (self.task_metrics.keys() == task_preds.keys() == task_targets.keys()):
+            raise ValueError(
+                "Expected arguments `task_preds` and `task_targets` to have the same keys as the wrapped"
+                f" `task_metrics`. Found task_preds.keys() = {task_preds.keys()},"
+                f" task_targets.keys() = {task_targets.keys()}"
+                f" and self.task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+
+    def update(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        """Update each task's metric with its pred/target."""
+        self._check_keys(task_preds, task_targets)
+        for task_name, metric in self.task_metrics.items():
+            metric.update(task_preds[task_name], task_targets[task_name])
+
+    def compute(self) -> Dict[str, Any]:
+        """Per-task results dict."""
+        return {self._set_name(name): metric.compute() for name, metric in self.task_metrics.items()}
+
+    def forward(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-task batch values, accumulating global state."""
+        self._check_keys(task_preds, task_targets)
+        return {
+            self._set_name(name): metric(task_preds[name], task_targets[name])
+            for name, metric in self.task_metrics.items()
+        }
+
+    def _set_name(self, base: str) -> str:
+        return f"{self._prefix}{base}{self._postfix}"
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
+        """Deep copy, optionally overriding prefix/postfix."""
+        mt = deepcopy(self)
+        if prefix is not None:
+            mt._prefix = prefix
+        if postfix is not None:
+            mt._postfix = postfix
+        return mt
+
+    def reset(self) -> None:
+        """Reset all task metrics."""
+        for metric in self.task_metrics.values():
+            metric.reset()
+        super().reset()

@@ -1,0 +1,133 @@
+"""Running-window wrapper.
+
+Parity: reference ``src/torchmetrics/wrappers/running.py:83-115`` (window-size ring of
+duplicated base-metric states) and the ``RunningMean``/``RunningSum`` aggregators
+(reference ``aggregation.py:616-727``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Union
+
+import jax
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.core.metric import Metric, _squeeze_if_scalar
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class Running(WrapperMetric):
+    """Compute a metric over a running window of the last ``window`` batches.
+
+    Keeps ``window`` copies of the base metric's state (a ring buffer of state
+    pytrees); ``compute`` folds them with the metric's pairwise merge. ``forward``
+    still returns the current-batch value; call ``compute`` for the running value.
+    Only works with ``full_state_update=False`` metrics.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import Running
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = Running(SumMetric(), window=3)
+        >>> for i in range(6):
+        ...     _ = metric(jnp.array([float(i)]))
+        >>> float(metric.compute())  # 3 + 4 + 5
+        12.0
+    """
+
+    def __init__(self, base_metric: Metric, window: int = 5) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `metric` to be an instance of `Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        if base_metric.full_state_update is not False:
+            raise ValueError(
+                f"Expected attribute `full_state_update` set to `False` but got {base_metric.full_state_update}"
+            )
+        self.base_metric = base_metric
+        self.window = window
+        self._num_vals_seen = 0
+
+        for key in base_metric._defaults:
+            for i in range(window):
+                self.add_state(
+                    name=f"{key}_{i}",
+                    default=base_metric._defaults[key],
+                    dist_reduce_fx=base_metric._reductions[key],
+                )
+
+    def _store_slot(self) -> None:
+        slot = self._num_vals_seen % self.window
+        for key in self.base_metric._defaults:
+            self._state_values[f"{key}_{slot}"] = self.base_metric._state_values[key]
+        self.base_metric.reset()
+        self._num_vals_seen += 1
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the base metric, capture its state into the ring, reset it."""
+        self.base_metric.update(*args, **kwargs)
+        self._store_slot()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward to the base metric (current-batch value), then capture state."""
+        res = self.base_metric.forward(*args, **kwargs)
+        self._store_slot()
+        self._computed = None
+        self._update_count += 1
+        return res
+
+    def compute(self) -> Any:
+        """Fold the window's state ring through the metric's pairwise merge."""
+        base = self.base_metric
+        state = base._fresh_state()
+        count = 0
+        for i in range(self.window):
+            slot = {key: self._state_values[f"{key}_{i}"] for key in base._defaults}
+            state = base._reduce_states(state, slot, count)
+            count += 1
+        return _squeeze_if_scalar(base.pure_compute(state))
+
+    def reset(self) -> None:
+        """Reset the ring and the base metric."""
+        super().reset()
+        self.base_metric.reset()
+        self._num_vals_seen = 0
+
+
+class RunningMean(Running):
+    """Mean over a running window of values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import RunningMean
+        >>> metric = RunningMean(window=3)
+        >>> for i in range(6):
+        ...     _ = metric(jnp.array([float(i)]))
+        >>> float(metric.compute())  # mean(3, 4, 5)
+        4.0
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=MeanMetric(nan_strategy=nan_strategy, **kwargs), window=window)
+
+
+class RunningSum(Running):
+    """Sum over a running window of values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import RunningSum
+        >>> metric = RunningSum(window=3)
+        >>> for i in range(6):
+        ...     _ = metric(jnp.array([float(i)]))
+        >>> float(metric.compute())
+        12.0
+    """
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__(base_metric=SumMetric(nan_strategy=nan_strategy, **kwargs), window=window)

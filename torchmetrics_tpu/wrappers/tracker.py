@@ -1,0 +1,177 @@
+"""Metric history tracker across steps/epochs.
+
+Parity: reference ``src/torchmetrics/wrappers/tracker.py:31-311``.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class MetricTracker:
+    """Track a metric (or collection) over multiple increments (e.g. epochs).
+
+    Call :meth:`increment` at the start of each tracked period; ``update``/``forward``/
+    ``compute`` hit the latest copy. :meth:`compute_all` stacks every period's result;
+    :meth:`best_metric` returns the best value (and optionally which step).
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MetricTracker
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> tracker = MetricTracker(MulticlassAccuracy(num_classes=10))
+        >>> rng = np.random.RandomState(0)
+        >>> for epoch in range(3):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray(rng.rand(100, 10)), jnp.asarray(rng.randint(10, size=100)))
+        >>> tracker.compute_all().shape
+        (3,)
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                f"Metric arg need to be an instance of a `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if maximize is not None:
+            if not isinstance(maximize, (bool, list)):
+                raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+            if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+                raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+            if isinstance(metric, Metric) and not isinstance(maximize, bool):
+                raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._increments: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of times the tracker has been incremented."""
+        return len(self._increments)
+
+    def __len__(self) -> int:
+        return len(self._increments)
+
+    def __getitem__(self, idx: int) -> Union[Metric, MetricCollection]:
+        return self._increments[idx]
+
+    def increment(self) -> None:
+        """Start tracking a new (fresh) copy of the base metric."""
+        self._increment_called = True
+        self._increments.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Forward on the current increment."""
+        self._check_for_increment("forward")
+        return self._increments[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update the current increment."""
+        self._check_for_increment("update")
+        self._increments[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Compute the current increment."""
+        self._check_for_increment("compute")
+        return self._increments[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Stack all increments' results (dict of stacks for collections)."""
+        self._check_for_increment("compute_all")
+        res = [m.compute() for m in self._increments]
+        try:
+            if isinstance(res[0], dict):
+                keys = res[0].keys()
+                return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+            if isinstance(res[0], (list, tuple)):
+                return jnp.stack([jnp.stack([jnp.asarray(v) for v in r], axis=0) for r in res], 0)
+            return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+        except (TypeError, ValueError):
+            return res
+
+    def reset(self) -> None:
+        """Reset the current increment."""
+        if self._increments:
+            self._increments[-1].reset()
+
+    def reset_all(self) -> None:
+        """Reset every increment."""
+        for m in self._increments:
+            m.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Any, Tuple[Any, Any]]:
+        """Best value across increments (per key for collections).
+
+        With ``maximize=None`` or on stacking failure returns ``None`` (and warns).
+        """
+        if self.maximize is None:
+            rank_zero_warn(
+                "No `maximize` argument was provided, so the best metric cannot be determined. Returning None.",
+                UserWarning,
+            )
+            if isinstance(self._base_metric, Metric):
+                return (None, None) if return_step else None
+            keys = list(self.compute_all())
+            none_d = {k: None for k in keys}
+            return (none_d, dict(none_d)) if return_step else none_d
+        if isinstance(self._base_metric, Metric):
+            fn = np.argmax if self.maximize else np.argmin
+            try:
+                vals = np.asarray(self.compute_all())
+                idx = int(fn(vals, 0))
+                if return_step:
+                    return float(vals[idx]), idx
+                return float(vals[idx])
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    "this is probably due to the 'compute' method of the metric returning something "
+                    "that is not a single tensor.",
+                    UserWarning,
+                )
+                if return_step:
+                    return None, None
+                return None
+        else:
+            res = self.compute_all()
+            maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+            value: Dict[str, Optional[float]] = {}
+            idx: Dict[str, Optional[int]] = {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    fn = np.argmax if maximize[i] else np.argmin
+                    vals = np.asarray(v)
+                    best = int(fn(vals, 0))
+                    value[k], idx[k] = float(vals[best]), best
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{error} this is probably due to the 'compute' method of the metric returning something "
+                        "that is not a single tensor.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")

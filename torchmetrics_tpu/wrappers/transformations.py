@@ -1,0 +1,121 @@
+"""Input-transforming wrappers.
+
+Parity: reference ``src/torchmetrics/wrappers/transformations.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+class MetricInputTransformer(WrapperMetric):
+    """Base class: transform inputs, then forward everything to the wrapped metric."""
+
+    def __init__(self, wrapped_metric: Union[Metric, MetricCollection], **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(wrapped_metric, (Metric, MetricCollection)):
+            raise TypeError(
+                f"Expected wrapped metric to be an instance of `Metric` or `MetricCollection`"
+                f" but received {wrapped_metric}"
+            )
+        self.wrapped_metric = wrapped_metric
+
+    def transform_pred(self, pred: Array) -> Array:
+        """Transformation applied to predictions (identity by default)."""
+        return pred
+
+    def transform_target(self, target: Array) -> Array:
+        """Transformation applied to targets (identity by default)."""
+        return target
+
+    def _wrap_transform(self, *args: Array) -> Tuple[Array, ...]:
+        if len(args) == 1:
+            return (self.transform_pred(args[0]),)
+        if len(args) == 2:
+            return self.transform_pred(args[0]), self.transform_target(args[1])
+        return self.transform_pred(args[0]), self.transform_target(args[1]), *args[2:]
+
+    def update(self, *args: Array, **kwargs: Any) -> None:
+        """Transform, then update the wrapped metric."""
+        args = self._wrap_transform(*args)
+        self.wrapped_metric.update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        """Compute the wrapped metric."""
+        return self.wrapped_metric.compute()
+
+    def forward(self, *args: Array, **kwargs: Any) -> Any:
+        """Transform, then forward the wrapped metric."""
+        args = self._wrap_transform(*args)
+        return self.wrapped_metric.forward(*args, **kwargs)
+
+    def reset(self) -> None:
+        """Reset the wrapped metric (and this wrapper's compute cache)."""
+        super().reset()
+        self.wrapped_metric.reset()
+
+
+class LambdaInputTransformer(MetricInputTransformer):
+    """Transform inputs with user-provided functions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import LambdaInputTransformer
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> preds = jnp.array([0.9, 0.2])
+        >>> target = jnp.array([0, 1])
+        >>> metric = LambdaInputTransformer(BinaryAccuracy(), lambda p: 1 - p)
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        1.0
+    """
+
+    def __init__(
+        self,
+        wrapped_metric: Union[Metric, MetricCollection],
+        transform_pred: Optional[Callable[[Array], Array]] = None,
+        transform_target: Optional[Callable[[Array], Array]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(wrapped_metric, **kwargs)
+        if transform_pred is not None:
+            if not callable(transform_pred):
+                raise TypeError(f"Expected `transform_pred` to be a Callable but received {transform_pred}")
+            self.transform_pred = transform_pred  # type: ignore[method-assign]
+        if transform_target is not None:
+            if not callable(transform_target):
+                raise TypeError(f"Expected `transform_target` to be a Callable but received {transform_target}")
+            self.transform_target = transform_target  # type: ignore[method-assign]
+
+
+class BinaryTargetTransformer(MetricInputTransformer):
+    """Binarize continuous targets at ``threshold`` before updating the metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import BinaryTargetTransformer
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=0.5)
+        >>> metric.update(jnp.array([0.9, 0.2]), jnp.array([0.8, 0.3]))
+        >>> float(metric.compute())
+        1.0
+    """
+
+    def __init__(self, wrapped_metric: Union[Metric, MetricCollection], threshold: float = 0, **kwargs: Any) -> None:
+        super().__init__(wrapped_metric, **kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise TypeError(f"Expected `threshold` to be of type `int` or `float` but received `{threshold}`")
+        self.threshold = threshold
+
+    def transform_target(self, target: Array) -> Array:
+        """Cast targets to {0, 1} via ``target > threshold`` (dtype preserved)."""
+        return (target > self.threshold).astype(target.dtype)
