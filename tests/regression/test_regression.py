@@ -1,0 +1,522 @@
+"""Regression metric tests: sklearn/scipy differential + 8-device mesh agreement.
+
+Analog of reference ``tests/unittests/regression/``.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+from scipy.stats import kendalltau, pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_explained_variance,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.functional.regression import (
+    concordance_corrcoef,
+    cosine_similarity,
+    critical_success_index,
+    explained_variance,
+    kendall_rank_corrcoef,
+    kl_divergence,
+    log_cosh_error,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    minkowski_distance,
+    pearson_corrcoef,
+    r2_score,
+    relative_squared_error,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from torchmetrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    CriticalSuccessIndex,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    PearsonCorrCoef,
+    R2Score,
+    RelativeSquaredError,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+
+_rng = np.random.RandomState(42)
+_single = (
+    _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    _rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+_positive = (
+    _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1,
+    _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.1,
+)
+_multi = (
+    _rng.randn(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float32),
+    _rng.randn(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float32),
+)
+
+
+class TestMSE(MetricTester):
+    @pytest.mark.parametrize("squared", [True, False])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, squared, ddp):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, MeanSquaredError,
+            lambda p, t: sk_mse(t.flatten(), p.flatten()) ** (1.0 if squared else 0.5),
+            metric_args={"squared": squared}, ddp=ddp,
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, mean_squared_error, lambda p, t: sk_mse(t.flatten(), p.flatten())
+        )
+
+    def test_multioutput(self):
+        preds, target = _multi
+        metric = MeanSquaredError(num_outputs=3)
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        p = preds.reshape(-1, 3)
+        t = target.reshape(-1, 3)
+        np.testing.assert_allclose(
+            np.asarray(metric.compute()), sk_mse(t, p, multioutput="raw_values"), rtol=1e-5, atol=1e-5
+        )
+
+    def test_jit(self):
+        preds, target = _single
+        self.run_jit_test(preds, target, MeanSquaredError)
+
+
+class TestMAE(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, MeanAbsoluteError, lambda p, t: sk_mae(t.flatten(), p.flatten()), ddp=ddp
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, mean_absolute_error, lambda p, t: sk_mae(t.flatten(), p.flatten())
+        )
+
+
+class TestMAPE(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _positive
+        self.run_class_metric_test(
+            preds, target, MeanAbsolutePercentageError,
+            lambda p, t: sk_mape(t.flatten(), p.flatten()), ddp=ddp, atol=1e-4,
+        )
+
+    def test_functional(self):
+        preds, target = _positive
+        self.run_functional_metric_test(
+            preds, target, mean_absolute_percentage_error, lambda p, t: sk_mape(t.flatten(), p.flatten()),
+            atol=1e-4,
+        )
+
+
+def _np_smape(p, t):
+    p, t = p.flatten(), t.flatten()
+    return np.mean(2 * np.abs(p - t) / np.clip(np.abs(t) + np.abs(p), 1.17e-6, None))
+
+
+def _np_wmape(p, t):
+    p, t = p.flatten(), t.flatten()
+    return np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+
+
+class TestSMAPEWMAPE(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_smape(self, ddp):
+        preds, target = _positive
+        self.run_class_metric_test(preds, target, SymmetricMeanAbsolutePercentageError, _np_smape, ddp=ddp)
+
+    def test_smape_functional(self):
+        preds, target = _positive
+        self.run_functional_metric_test(preds, target, symmetric_mean_absolute_percentage_error, _np_smape)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_wmape(self, ddp):
+        preds, target = _positive
+        self.run_class_metric_test(preds, target, WeightedMeanAbsolutePercentageError, _np_wmape, ddp=ddp)
+
+    def test_wmape_functional(self):
+        preds, target = _positive
+        self.run_functional_metric_test(preds, target, weighted_mean_absolute_percentage_error, _np_wmape)
+
+
+class TestMSLE(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _positive
+        self.run_class_metric_test(
+            preds, target, MeanSquaredLogError, lambda p, t: sk_msle(t.flatten(), p.flatten()), ddp=ddp
+        )
+
+    def test_functional(self):
+        preds, target = _positive
+        self.run_functional_metric_test(
+            preds, target, mean_squared_log_error, lambda p, t: sk_msle(t.flatten(), p.flatten())
+        )
+
+
+class TestMinkowski(MetricTester):
+    @pytest.mark.parametrize("p", [1, 2, 3.5])
+    def test_class(self, p):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, MinkowskiDistance,
+            lambda pr, t: np.power(np.sum(np.abs(pr - t) ** p), 1 / p),
+            metric_args={"p": p}, check_batch=False, atol=1e-4,
+        )
+
+    def test_invalid_p(self):
+        from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+        with pytest.raises(TorchMetricsUserError, match="`p`"):
+            MinkowskiDistance(p=0.5)
+
+
+class TestLogCosh(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+
+        def _ref(p, t):
+            d = p.flatten() - t.flatten()
+            return np.mean(np.log(np.cosh(d)))
+
+        self.run_class_metric_test(preds, target, LogCoshError, _ref, ddp=ddp, atol=1e-4)
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, log_cosh_error,
+            lambda p, t: np.mean(np.log(np.cosh(p.flatten() - t.flatten()))), atol=1e-4,
+        )
+
+
+class TestTweedie(MetricTester):
+    @pytest.mark.parametrize("power", [0, 1, 1.5, 2])
+    def test_class(self, power):
+        preds, target = _positive
+        self.run_class_metric_test(
+            preds, target, TweedieDevianceScore,
+            lambda p, t: sk_tweedie(t.flatten(), p.flatten(), power=power),
+            metric_args={"power": power}, atol=1e-4,
+        )
+
+    def test_functional(self):
+        preds, target = _positive
+        self.run_functional_metric_test(
+            preds, target, tweedie_deviance_score,
+            lambda p, t: sk_tweedie(t.flatten(), p.flatten(), power=0), atol=1e-4,
+        )
+
+    def test_invalid_power(self):
+        with pytest.raises(ValueError, match="power"):
+            TweedieDevianceScore(power=0.5)
+
+
+class TestR2(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, R2Score, lambda p, t: sk_r2(t.flatten(), p.flatten()), ddp=ddp
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(preds, target, r2_score, lambda p, t: sk_r2(t.flatten(), p.flatten()))
+
+    @pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+    def test_multioutput(self, multioutput):
+        preds, target = _multi
+        metric = R2Score(num_outputs=3, multioutput=multioutput)
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        p = preds.reshape(-1, 3)
+        t = target.reshape(-1, 3)
+        np.testing.assert_allclose(
+            np.asarray(metric.compute()), sk_r2(t, p, multioutput=multioutput), rtol=1e-4, atol=1e-4
+        )
+
+    def test_adjusted(self):
+        preds, target = _single
+        p, t = preds.flatten(), target.flatten()
+        res = r2_score(jnp.asarray(p), jnp.asarray(t), adjusted=5)
+        n = p.size
+        expected = 1 - (1 - sk_r2(t, p)) * (n - 1) / (n - 5 - 1)
+        np.testing.assert_allclose(float(res), expected, atol=1e-5)
+
+
+class TestExplainedVariance(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, ExplainedVariance, lambda p, t: sk_explained_variance(t.flatten(), p.flatten()), ddp=ddp
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, explained_variance, lambda p, t: sk_explained_variance(t.flatten(), p.flatten())
+        )
+
+
+class TestRSE(MetricTester):
+    @pytest.mark.parametrize("squared", [True, False])
+    def test_class(self, squared):
+        preds, target = _single
+
+        def _ref(p, t):
+            p, t = p.flatten(), t.flatten()
+            rse = np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2)
+            return rse if squared else np.sqrt(rse)
+
+        self.run_class_metric_test(
+            preds, target, RelativeSquaredError, _ref, metric_args={"squared": squared}, check_batch=True
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, relative_squared_error,
+            lambda p, t: np.sum((t.flatten() - p.flatten()) ** 2) / np.sum((t.flatten() - t.mean()) ** 2),
+        )
+
+
+class TestPearson(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, PearsonCorrCoef,
+            lambda p, t: pearsonr(t.flatten(), p.flatten())[0], ddp=ddp, check_batch=True, atol=1e-4,
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, pearson_corrcoef, lambda p, t: pearsonr(t.flatten(), p.flatten())[0], atol=1e-4
+        )
+
+    def test_multioutput(self):
+        preds, target = _multi
+        metric = PearsonCorrCoef(num_outputs=3)
+        for i in range(NUM_BATCHES):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        p = preds.reshape(-1, 3)
+        t = target.reshape(-1, 3)
+        expected = [pearsonr(t[:, i], p[:, i])[0] for i in range(3)]
+        np.testing.assert_allclose(np.asarray(metric.compute()), expected, atol=1e-4)
+
+    def test_final_aggregation_matches_single_stream(self):
+        """Chan parallel merge of per-device states == single-stream result."""
+        from torchmetrics_tpu.functional.regression.correlation import _final_aggregation
+
+        rng = np.random.RandomState(0)
+        chunks = [rng.randn(2, 16).astype(np.float32) for _ in range(4)]
+        states = []
+        for c in chunks:
+            m = PearsonCorrCoef()
+            m.update(jnp.asarray(c[0]), jnp.asarray(c[1]))
+            s = m.metric_state
+            states.append([s["mean_x"], s["mean_y"], s["var_x"], s["var_y"], s["corr_xy"], s["n_total"]])
+        stacked = [jnp.stack([st[i] for st in states]) for i in range(6)]
+        _, _, var_x, var_y, corr_xy, nb = _final_aggregation(*stacked)
+        from torchmetrics_tpu.functional.regression.correlation import _pearson_corrcoef_compute
+
+        merged = float(_pearson_corrcoef_compute(var_x, var_y, corr_xy, nb))
+        p_all = np.concatenate([c[0] for c in chunks])
+        t_all = np.concatenate([c[1] for c in chunks])
+        np.testing.assert_allclose(merged, pearsonr(t_all, p_all)[0], atol=1e-4)
+
+
+class TestConcordance(MetricTester):
+    @staticmethod
+    def _ref_ccc(p, t):
+        p, t = p.flatten(), t.flatten()
+        r = pearsonr(t, p)[0]
+        return 2 * r * p.std(ddof=1) * t.std(ddof=1) / (p.var(ddof=1) + t.var(ddof=1) + (p.mean() - t.mean()) ** 2)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+        self.run_class_metric_test(preds, target, ConcordanceCorrCoef, self._ref_ccc, ddp=ddp, atol=1e-4)
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(preds, target, concordance_corrcoef, self._ref_ccc, atol=1e-4)
+
+
+class TestSpearman(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, SpearmanCorrCoef,
+            lambda p, t: spearmanr(t.flatten(), p.flatten())[0], ddp=ddp, atol=1e-4,
+        )
+
+    def test_functional(self):
+        preds, target = _single
+        self.run_functional_metric_test(
+            preds, target, spearman_corrcoef, lambda p, t: spearmanr(t.flatten(), p.flatten())[0], atol=1e-4
+        )
+
+    def test_with_ties(self):
+        p = jnp.array([1.0, 2.0, 2.0, 3.0, 1.0, 4.0])
+        t = jnp.array([2.0, 2.0, 3.0, 3.0, 1.0, 5.0])
+        res = float(spearman_corrcoef(p, t))
+        expected = spearmanr(np.asarray(t), np.asarray(p))[0]
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+class TestKendall(MetricTester):
+    @pytest.mark.parametrize("variant", ["b", "c"])
+    def test_class(self, variant):
+        preds, target = _single
+        self.run_class_metric_test(
+            preds, target, KendallRankCorrCoef,
+            lambda p, t: kendalltau(t.flatten(), p.flatten(), variant=variant)[0],
+            metric_args={"variant": variant}, atol=1e-4,
+        )
+
+    def test_functional_with_ties(self):
+        rng = np.random.RandomState(1)
+        p = rng.randint(0, 10, 50).astype(np.float32)
+        t = rng.randint(0, 10, 50).astype(np.float32)
+        res = float(kendall_rank_corrcoef(jnp.asarray(p), jnp.asarray(t)))
+        np.testing.assert_allclose(res, kendalltau(t, p, variant="b")[0], atol=1e-5)
+
+    def test_p_value(self):
+        rng = np.random.RandomState(2)
+        p = rng.randn(60).astype(np.float32)
+        t = (0.5 * p + 0.5 * rng.randn(60)).astype(np.float32)
+        tau, pv = kendall_rank_corrcoef(jnp.asarray(p), jnp.asarray(t), t_test=True)
+        ref_tau, ref_pv = kendalltau(t, p, variant="b")
+        np.testing.assert_allclose(float(tau), ref_tau, atol=1e-4)
+        np.testing.assert_allclose(float(pv), ref_pv, atol=2e-2)  # normal approx vs exact
+
+
+class TestCosineSimilarity(MetricTester):
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_class(self, reduction):
+        rng = np.random.RandomState(5)
+        preds = rng.randn(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+        target = rng.randn(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+
+        def _ref(p, t):
+            p2 = p.reshape(-1, p.shape[-1])
+            t2 = t.reshape(-1, t.shape[-1])
+            sim = np.sum(p2 * t2, -1) / (np.linalg.norm(p2, axis=-1) * np.linalg.norm(t2, axis=-1))
+            if reduction == "mean":
+                return sim.mean()
+            if reduction == "sum":
+                return sim.sum()
+            return sim
+
+        self.run_class_metric_test(
+            preds, target, CosineSimilarity, _ref, metric_args={"reduction": reduction}, check_batch=True, atol=1e-4
+        )
+
+
+class TestKLDivergence(MetricTester):
+    @pytest.mark.parametrize("log_prob", [False, True])
+    def test_class(self, log_prob):
+        rng = np.random.RandomState(6)
+        p = rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32) + 0.05
+        q = rng.rand(NUM_BATCHES, BATCH_SIZE, 5).astype(np.float32) + 0.05
+        p /= p.sum(-1, keepdims=True)
+        q /= q.sum(-1, keepdims=True)
+        if log_prob:
+            p_in, q_in = np.log(p), np.log(q)
+        else:
+            p_in, q_in = p, q
+
+        def _ref(pi, qi):
+            if log_prob:
+                pp, qq = np.exp(pi), np.exp(qi)
+            else:
+                pp, qq = pi / pi.sum(-1, keepdims=True), qi / qi.sum(-1, keepdims=True)
+            return np.mean(np.sum(pp * np.log(pp / qq), -1))
+
+        self.run_class_metric_test(
+            p_in, q_in, KLDivergence, _ref, metric_args={"log_prob": log_prob}, check_batch=True, atol=1e-4
+        )
+
+    def test_reduction_none(self):
+        rng = np.random.RandomState(7)
+        p = rng.rand(8, 4).astype(np.float32) + 0.1
+        q = rng.rand(8, 4).astype(np.float32) + 0.1
+        res = kl_divergence(jnp.asarray(p), jnp.asarray(q), reduction="none")
+        assert res.shape == (8,)
+
+
+class TestCSI(MetricTester):
+    def test_class(self):
+        preds, target = _positive
+
+        def _ref(p, t):
+            pb, tb = p.flatten() >= 0.5, t.flatten() >= 0.5
+            hits = (pb & tb).sum()
+            misses = (~pb & tb).sum()
+            fa = (pb & ~tb).sum()
+            return hits / (hits + misses + fa)
+
+        self.run_class_metric_test(preds, target, CriticalSuccessIndex, _ref, metric_args={"threshold": 0.5})
+
+    def test_keep_sequence_dim(self):
+        rng = np.random.RandomState(8)
+        p = jnp.asarray(rng.rand(4, 8))
+        t = jnp.asarray(rng.rand(4, 8))
+        res = critical_success_index(p, t, 0.5, keep_sequence_dim=0)
+        assert res.shape == (4,)
+
+
+class TestRegressionCollection:
+    def test_compute_groups_with_collection(self):
+        """R2 and RSE share the same update → one static compute group."""
+        from torchmetrics_tpu import MetricCollection
+
+        col = MetricCollection([R2Score(), RelativeSquaredError()])
+        assert len(col.compute_groups) == 1
+        rng = np.random.RandomState(9)
+        p, t = jnp.asarray(rng.randn(64)), jnp.asarray(rng.randn(64))
+        col.update(p, t)
+        res = col.compute()
+        np.testing.assert_allclose(
+            float(res["R2Score"]), sk_r2(np.asarray(t), np.asarray(p)), atol=1e-4
+        )

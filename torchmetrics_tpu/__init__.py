@@ -23,6 +23,8 @@ from torchmetrics_tpu.aggregation import (  # noqa: E402
 )
 from torchmetrics_tpu.classification import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
+from torchmetrics_tpu.regression import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.regression import __all__ as _regression_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from torchmetrics_tpu.wrappers import (  # noqa: E402
@@ -56,4 +58,5 @@ __all__ = [
     "MultitaskWrapper",
     "Running",
     *_classification_all,
+    *_regression_all,
 ]

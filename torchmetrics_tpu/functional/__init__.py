@@ -1,7 +1,9 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import classification
+from torchmetrics_tpu.functional import classification, regression
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 
-__all__ = ["classification", *_classification_all]
+__all__ = ["classification", "regression", *_classification_all, *_regression_all]

@@ -1,0 +1,258 @@
+"""Sum/count error metrics: MSE, MAE, MAPE, SMAPE, WMAPE, MSLE, Minkowski, LogCosh.
+
+Parity: reference ``src/torchmetrics/functional/regression/{mse,mae,mape,
+symmetric_mape,wmape,log_mse,minkowski,log_cosh}.py``. All updates are single fused
+elementwise+reduce XLA programs (VPU-bound, jit-safe, psum-able sum states).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs, _unsqueeze_tensors
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+_EPSILON = 1.17e-06
+
+
+# --------------------------------------------------------------------------- MSE
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    """Σ(p−t)² (per output when ``num_outputs > 1``) and the observation count."""
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = preds - target
+    return jnp.sum(diff * diff, axis=0), target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Union[int, Array], squared: bool = True) -> Array:
+    mse = sum_squared_error / num_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """Mean squared error (RMSE when ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_squared_error
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> mean_squared_error(x, y)
+        Array(0.25, dtype=float32)
+    """
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, num_obs, squared)
+
+
+# --------------------------------------------------------------------------- MAE
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), preds.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_abs_error / num_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_absolute_error
+        >>> mean_absolute_error(jnp.array([0., 1, 2, 3]), jnp.array([0., 1, 2, 2]))
+        Array(0.25, dtype=float32)
+    """
+    sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, num_obs)
+
+
+# -------------------------------------------------------------------------- MAPE
+
+def _mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    """Σ|p−t|/max(|t|, ε) and the observation count."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_absolute_percentage_error
+        >>> mean_absolute_percentage_error(jnp.array([1., 2, 3]), jnp.array([1., 4, 3])).round(4)
+        Array(0.1667, dtype=float32)
+    """
+    s, n = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(s, n)
+
+
+# ------------------------------------------------------------------------- SMAPE
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = _EPSILON
+) -> Tuple[Array, int]:
+    """2·Σ|p−t|/max(|t|+|p|, ε) and the observation count."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * jnp.sum(abs_per_error), target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Symmetric mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import symmetric_mean_absolute_percentage_error
+        >>> symmetric_mean_absolute_percentage_error(jnp.array([1., 2, 3]), jnp.array([1., 4, 3])).round(4)
+        Array(0.2222, dtype=float32)
+    """
+    s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(s, n)
+
+
+# ------------------------------------------------------------------------- WMAPE
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Σ|p−t| and Σ|t|."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def _weighted_mean_absolute_percentage_error_compute(
+    sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPSILON
+) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Weighted mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import weighted_mean_absolute_percentage_error
+        >>> weighted_mean_absolute_percentage_error(jnp.array([1., 2, 3]), jnp.array([1., 4, 3])).round(4)
+        Array(0.25, dtype=float32)
+    """
+    s, scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(s, scale)
+
+
+# -------------------------------------------------------------------------- MSLE
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    """Σ(log1p(p)−log1p(t))² and the observation count."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = jnp.log1p(preds) - jnp.log1p(target)
+    return jnp.sum(diff * diff), target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, num_obs) -> Array:
+    return sum_squared_log_error / num_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_squared_log_error
+        >>> mean_squared_log_error(jnp.array([0.5, 1, 2, 8]), jnp.array([0.5, 1, 2, 8]))
+        Array(0., dtype=float32)
+    """
+    s, n = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(s, n)
+
+
+# --------------------------------------------------------------------- Minkowski
+
+def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
+    """Σ|p−t|^p."""
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    preds = preds.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+    return jnp.sum(jnp.power(jnp.abs(preds - targets), p))
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return jnp.power(distance, 1.0 / p)
+
+
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Minkowski distance of order ``p``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import minkowski_distance
+        >>> minkowski_distance(jnp.array([0., 1, 2, 3]), jnp.array([0., 2, 3, 1]), p=5).round(4)
+        Array(2.0244, dtype=float32)
+    """
+    distance = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(distance, p)
+
+
+# ----------------------------------------------------------------------- LogCosh
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    """Σ log(cosh(p−t)) per output, computed via the numerically stable logaddexp form."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(preds.astype(jnp.float32), target.astype(jnp.float32))
+    diff = preds - target
+    # log(cosh(x)) = logaddexp(x, -x) - log(2): stable for large |x| (exp would overflow)
+    sum_log_cosh_error = jnp.squeeze(jnp.sum(jnp.logaddexp(diff, -diff) - jnp.log(2.0), axis=0))
+    return sum_log_cosh_error, jnp.asarray(target.shape[0])
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs: Array) -> Array:
+    return sum_log_cosh_error / num_obs
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """LogCosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import log_cosh_error
+        >>> log_cosh_error(jnp.array([3.0, 5.0, 2.5, 7.0]), jnp.array([2.5, 5.0, 4.0, 8.0])).round(4)
+        Array(0.3523, dtype=float32)
+    """
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return _log_cosh_error_compute(s, n)

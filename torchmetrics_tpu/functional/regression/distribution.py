@@ -1,0 +1,217 @@
+"""Distribution-flavored regression metrics: Tweedie deviance, KL divergence, CSI,
+cosine similarity.
+
+Parity: reference ``src/torchmetrics/functional/regression/{tweedie_deviance,
+kl_divergence,csi,cosine_similarity}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.data import safe_divide
+
+Array = jax.Array
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """x·log(y) with the x == 0 → 0 convention."""
+    return jnp.where(x == 0, 0.0, x * jnp.log(jnp.where(x == 0, 1.0, y)))
+
+
+# ---------------------------------------------------------------------- Tweedie
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Σ deviance(p, t; power) and the observation count.
+
+    Domain violations raise eagerly; under jit tracing the checks are skipped (the
+    validation is data-dependent and cannot run in a compiled program).
+    """
+    _check_same_shape(preds, targets)
+    preds = preds.astype(jnp.float32)
+    targets = targets.astype(jnp.float32)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    traced = isinstance(preds, jax.core.Tracer) or isinstance(targets, jax.core.Tracer)
+
+    if power == 0:
+        deviance_score = jnp.power(targets - preds, 2)
+    elif power == 1:
+        if not traced and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0))):
+            raise ValueError(
+                f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative."
+            )
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        if not traced and (bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0))):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        deviance_score = 2 * (jnp.log(preds / targets) + targets / preds - 1)
+    else:
+        if not traced:
+            if power < 0:
+                if bool(jnp.any(preds <= 0)):
+                    raise ValueError(f"For power={power}, 'preds' has to be strictly positive.")
+            elif 1 < power < 2:
+                if bool(jnp.any(preds <= 0)) or bool(jnp.any(targets < 0)):
+                    raise ValueError(
+                        f"For power={power}, 'targets' has to be strictly positive and 'preds' cannot be negative."
+                    )
+            else:
+                if bool(jnp.any(preds <= 0)) or bool(jnp.any(targets <= 0)):
+                    raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+        term_1 = jnp.power(jnp.maximum(targets, 0.0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score for the given ``power``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import tweedie_deviance_score
+        >>> targets = jnp.array([1.0, 2.0, 3.0, 4.0])
+        >>> preds = jnp.array([4.0, 3.0, 2.0, 1.0])
+        >>> tweedie_deviance_score(preds, targets, power=2).round(4)
+        Array(1.2083, dtype=float32)
+    """
+    s, n = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(s, n)
+
+
+# -------------------------------------------------------------------------- KLD
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    """Per-sample KL(p‖q) over the last axis; returns ([N] measures, N)."""
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+    p = p.astype(jnp.float32)
+    q = q.astype(jnp.float32)
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / p.sum(axis=-1, keepdims=True)
+        q = q / q.sum(axis=-1, keepdims=True)
+        measures = _safe_xlogy(p, p / q).sum(axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total: Union[int, Array], reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return measures.sum()
+    if reduction == "mean":
+        return measures.sum() / total
+    if reduction in ("none", None):
+        return measures
+    return measures / total
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """KL divergence D_KL(p‖q) between batched distributions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import kl_divergence
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> kl_divergence(p, q).round(4)
+        Array(0.0853, dtype=float32)
+    """
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)
+
+
+# -------------------------------------------------------------------------- CSI
+
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Binarize at ``threshold``; count hits / misses / false alarms."""
+    _check_same_shape(preds, target)
+    if keep_sequence_dim is None:
+        sum_dims = None
+    elif not 0 <= keep_sequence_dim < preds.ndim:
+        raise ValueError(f"Expected keep_sequence dim to be in range [0, {preds.ndim}] but got {keep_sequence_dim}")
+    else:
+        sum_dims = tuple(i for i in range(preds.ndim) if i != keep_sequence_dim)
+
+    preds_bin = preds >= threshold
+    target_bin = target >= threshold
+    hits = jnp.sum(preds_bin & target_bin, axis=sum_dims).astype(jnp.int32)
+    misses = jnp.sum(~preds_bin & target_bin, axis=sum_dims).astype(jnp.int32)
+    false_alarms = jnp.sum(preds_bin & ~target_bin, axis=sum_dims).astype(jnp.int32)
+    return hits, misses, false_alarms
+
+
+def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Array) -> Array:
+    return safe_divide(hits, hits + misses + false_alarms)
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: Optional[int] = None
+) -> Array:
+    """Critical success index (threat score).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import critical_success_index
+        >>> critical_success_index(jnp.array([0.8, 0.3, 0.6]), jnp.array([0.9, 0.2, 0.7]), 0.5)
+        Array(1., dtype=float32)
+    """
+    hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _critical_success_index_compute(hits, misses, false_alarms)
+
+
+# ------------------------------------------------------------------ cosine sim
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError(
+            "Expected input to cosine similarity to be 2D tensors of shape `[N,D]` where `N` is the number of"
+            f" samples and `D` is the number of dimensions, but got tensor of shape {preds.shape}"
+        )
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot = jnp.sum(preds * target, axis=-1)
+    denom = jnp.linalg.norm(preds, axis=-1) * jnp.linalg.norm(target, axis=-1)
+    sim = dot / denom
+    if reduction == "sum":
+        return sim.sum()
+    if reduction == "mean":
+        return sim.mean()
+    if reduction in ("none", None):
+        return sim
+    raise ValueError(f"Expected reduction to be one of `['sum', 'mean', 'none', None]` but got {reduction}")
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Row-wise cosine similarity, reduced by ``reduction``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import cosine_similarity
+        >>> target = jnp.array([[1., 2, 3, 4], [1, 2, 3, 4]])
+        >>> preds = jnp.array([[1., 2, 3, 4], [-1, -2, -3, -4]])
+        >>> cosine_similarity(preds, target, 'none')
+        Array([ 1., -1.], dtype=float32)
+    """
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

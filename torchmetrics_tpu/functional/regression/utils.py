@@ -1,0 +1,33 @@
+"""Shared regression helpers.
+
+Parity: reference ``src/torchmetrics/functional/regression/utils.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+
+Array = jax.Array
+
+
+def _check_data_shape_to_num_outputs(
+    preds: Array, target: Array, num_outputs: int, allow_1d_reshape: bool = False
+) -> None:
+    """Check that predictions and target have the correct shape for ``num_outputs``."""
+    if preds.ndim > 2:
+        raise ValueError(f"Expected both predictions and target to be either 1- or 2-dimensional tensors, but got {preds.ndim}.")
+    cond1 = False
+    if not allow_1d_reshape:
+        cond1 = num_outputs == 1 and preds.ndim == 2 and preds.shape[1] != 1
+    cond2 = num_outputs > 1 and (preds.ndim < 2 or num_outputs != preds.shape[1])
+    if cond1 or cond2:
+        raise ValueError(
+            f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs}"
+            f" and {preds.shape[1] if preds.ndim > 1 else 1}."
+        )
+
+
+def _unsqueeze_tensors(preds: Array, target: Array):
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]

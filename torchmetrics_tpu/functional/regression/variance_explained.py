@@ -1,0 +1,203 @@
+"""Variance-ratio metrics: R², explained variance, relative squared error.
+
+Parity: reference ``src/torchmetrics/functional/regression/{r2,explained_variance,
+rse}.py``. Boolean-mask assignments become ``jnp.where`` selects (jit-safe).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    """Returns (Σt², Σt, Σ(t−p)², n) per output."""
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            "Expected both prediction and target to be 1D or 2D tensors,"
+            f" but received tensors with dimension {preds.shape}"
+        )
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = target - preds
+    rss = jnp.sum(residual * residual, axis=0)
+    return sum_squared_obs, sum_obs, rss, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs: Union[int, Array],
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R² from accumulated sums; supports adjusted R² and multioutput aggregation."""
+    if not isinstance(num_obs, jax.core.Tracer) and int(num_obs) < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+
+    cond_rss = ~jnp.isclose(rss, jnp.zeros_like(rss), atol=1e-4)
+    cond_tss = ~jnp.isclose(tss, jnp.zeros_like(tss), atol=1e-4)
+    cond = cond_rss & cond_tss
+
+    raw_scores = jnp.ones_like(rss)
+    raw_scores = jnp.where(cond, 1 - rss / jnp.where(cond_tss, tss, 1.0), raw_scores)
+    raw_scores = jnp.where(cond_rss & ~cond_tss, 0.0, raw_scores)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+
+    if adjusted != 0:
+        if not isinstance(num_obs, jax.core.Tracer) and adjusted > int(num_obs) - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif not isinstance(num_obs, jax.core.Tracer) and adjusted == int(num_obs) - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R² (coefficient of determination).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import r2_score
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> r2_score(preds, target).round(4)
+        Array(0.9486, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, num_obs, adjusted, multioutput)
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    """Returns (n, Σ(t−p), Σ(t−p)², Σt, Σt²) per output."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    diff = target - preds
+    return (
+        preds.shape[0],
+        jnp.sum(diff, axis=0),
+        jnp.sum(diff * diff, axis=0),
+        jnp.sum(target, axis=0),
+        jnp.sum(target * target, axis=0),
+    )
+
+
+def _explained_variance_compute(
+    num_obs: Union[int, Array],
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Explained variance from accumulated sums."""
+    diff_avg = sum_error / num_obs
+    numerator = sum_squared_error / num_obs - diff_avg * diff_avg
+    target_avg = sum_target / num_obs
+    denominator = sum_squared_target / num_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.ones_like(diff_avg)
+    output_scores = jnp.where(valid_score, 1.0 - numerator / jnp.where(nonzero_denominator, denominator, 1.0), output_scores)
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`."
+        f" Received {multioutput}."
+    )
+
+
+def explained_variance(
+    preds: Array,
+    target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import explained_variance
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> explained_variance(preds, target).round(4)
+        Array(0.9572, dtype=float32)
+    """
+    return _explained_variance_compute(*_explained_variance_update(preds, target), multioutput)
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    sum_squared_error: Array,
+    num_obs: Union[int, Array],
+    squared: bool = True,
+) -> Array:
+    """RSE (or its root) from R²-style accumulated sums; mean over outputs."""
+    epsilon = jnp.finfo(jnp.asarray(sum_squared_error).dtype).eps
+    rse = sum_squared_error / jnp.clip(sum_squared_obs - sum_obs * sum_obs / num_obs, min=epsilon)
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Relative squared error (RRSE when ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import relative_squared_error
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0., 2], [-1, 2], [8, -5]])
+        >>> relative_squared_error(preds, target).round(4)
+        Array(0.0632, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared=squared)

@@ -27,6 +27,9 @@ class Reduction(str, Enum):
     MAX = "max"
     MIN = "min"
     CAT = "cat"
+    # stack per-member states along a new leading axis; the metric's compute merges
+    # them itself (e.g. Pearson's exact parallel-variance aggregation)
+    GATHER = "gather"
     NONE = "none"
 
     @classmethod
@@ -72,7 +75,7 @@ def merge_states(old: Any, new: Any, reduction: Reduction, old_count, new_count,
         old_list = old if isinstance(old, list) else [old]
         new_list = new if isinstance(new, list) else [new]
         return old_list + new_list
-    if reduction == Reduction.NONE:
+    if reduction in (Reduction.NONE, Reduction.GATHER):
         if isinstance(old, list) or isinstance(new, list):
             old_list = old if isinstance(old, list) else [old]
             new_list = new if isinstance(new, list) else [new]

@@ -72,6 +72,8 @@ def _sync_leaf_in_axis(x: Array, reduction: Reduction, axis_name: str) -> Array:
         return lax.pmin(x, axis_name)
     if reduction == Reduction.CAT:
         return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    if reduction == Reduction.GATHER:
+        return lax.all_gather(x, axis_name, axis=0, tiled=False)  # [world, ...]
     if reduction == Reduction.NONE:
         return x
     raise ValueError(f"Unknown reduction {reduction}")
@@ -91,6 +93,8 @@ def _sync_leaf_multihost(x: Array, reduction: Reduction) -> Array:
         return jnp.min(gathered, axis=0)
     if reduction == Reduction.CAT:
         return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
+    if reduction == Reduction.GATHER:
+        return gathered  # [world, ...]
     if reduction == Reduction.NONE:
         return x
     raise ValueError(f"Unknown reduction {reduction}")

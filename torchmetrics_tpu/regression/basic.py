@@ -1,0 +1,544 @@
+"""Sum/count regression metric modules.
+
+Parity: reference ``src/torchmetrics/regression/{mse,mae,mape,symmetric_mape,wmape,
+log_mse,minkowski,log_cosh,tweedie_deviance,csi,kl_divergence,cosine_similarity}.py``.
+All are jit-able scalar (or per-output) sum states with ``psum`` sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.regression.basic_errors import (
+    _log_cosh_error_compute,
+    _log_cosh_error_update,
+    _mean_absolute_error_compute,
+    _mean_absolute_error_update,
+    _mean_absolute_percentage_error_compute,
+    _mean_absolute_percentage_error_update,
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+    _minkowski_distance_compute,
+    _minkowski_distance_update,
+    _symmetric_mean_absolute_percentage_error_compute,
+    _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from torchmetrics_tpu.functional.regression.distribution import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+    _critical_success_index_compute,
+    _critical_success_index_update,
+    _kld_compute,
+    _kld_update,
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+class MeanSquaredError(Metric):
+    r"""Mean squared error (RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> metric = MeanSquaredError()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7]))
+        Array(0.875, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_squared_error: Array
+    total: Array
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_squared_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared errors."""
+        sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """MSE (or RMSE)."""
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, self.squared)
+
+
+class MeanAbsoluteError(Metric):
+    r"""Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsoluteError
+        >>> metric = MeanAbsoluteError()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7]))
+        Array(0.75, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_abs_error: Array
+    total: Array
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate absolute errors."""
+        sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """MAE."""
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)
+
+
+class MeanAbsolutePercentageError(Metric):
+    r"""Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsolutePercentageError
+        >>> metric = MeanAbsolutePercentageError()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.2667, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_abs_per_error: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate relative absolute errors."""
+        sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """MAPE."""
+        return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    r"""Symmetric mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import SymmetricMeanAbsolutePercentageError
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.5898, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 2.0
+
+    sum_abs_per_error: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate symmetric relative absolute errors."""
+        sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """SMAPE."""
+        return _symmetric_mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    r"""Weighted mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import WeightedMeanAbsolutePercentageError
+        >>> metric = WeightedMeanAbsolutePercentageError()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.1538, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_abs_error: Array
+    sum_scale: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_scale", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate |error| and |target| sums."""
+        sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.sum_scale = self.sum_scale + sum_scale
+
+    def compute(self) -> Array:
+        """WMAPE."""
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)
+
+
+class MeanSquaredLogError(Metric):
+    r"""Mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredLogError
+        >>> metric = MeanSquaredLogError()
+        >>> metric(jnp.array([0.5, 1, 2, 8]), jnp.array([1., 1, 2, 8])).round(4)
+        Array(0.0397, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_squared_log_error: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared log errors."""
+        sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """MSLE."""
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)
+
+
+class MinkowskiDistance(Metric):
+    r"""Minkowski distance of order ``p``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3)
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(1.1017, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    minkowski_dist_sum: Array
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Accumulate p-th power errors."""
+        self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(preds, targets, self.p)
+
+    def compute(self) -> Array:
+        """Minkowski distance."""
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)
+
+
+class LogCoshError(Metric):
+    r"""LogCosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import LogCoshError
+        >>> metric = LogCoshError()
+        >>> metric(jnp.array([3.0, 5.0, 2.5, 7.0]), jnp.array([2.5, 5.0, 4.0, 8.0])).round(4)
+        Array(0.3523, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_log_cosh_error: Array
+    total: Array
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", jnp.zeros(num_outputs).squeeze(), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate log-cosh errors."""
+        sum_log_cosh_error, num_obs = _log_cosh_error_update(preds, target, self.num_outputs)
+        self.sum_log_cosh_error = self.sum_log_cosh_error + sum_log_cosh_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """LogCosh error."""
+        return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)
+
+
+class TweedieDevianceScore(Metric):
+    r"""Tweedie deviance score for a given ``power``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import TweedieDevianceScore
+        >>> metric = TweedieDevianceScore(power=2)
+        >>> metric(jnp.array([4.0, 3.0, 2.0, 1.0]), jnp.array([1.0, 2.0, 3.0, 4.0])).round(4)
+        Array(1.2083, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    sum_deviance_score: Array
+    num_observations: Array
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        """Accumulate deviance scores."""
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        """Deviance score."""
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)
+
+
+class CriticalSuccessIndex(Metric):
+    r"""Critical success index (threat score) over thresholded values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import CriticalSuccessIndex
+        >>> metric = CriticalSuccessIndex(0.5)
+        >>> metric(jnp.array([0.8, 0.3, 0.6]), jnp.array([0.9, 0.2, 0.7]))
+        Array(1., dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    hits: Array
+    misses: Array
+    false_alarms: Array
+    hits_list: List[Array]
+    misses_list: List[Array]
+    false_alarms_list: List[Array]
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise ValueError(f"Expected argument `threshold` to be a float but got {threshold}")
+        self.threshold = float(threshold)
+        if keep_sequence_dim is not None and (not isinstance(keep_sequence_dim, int) or keep_sequence_dim < 0):
+            raise ValueError(f"Expected argument `keep_sequence_dim` to be a non-negative integer but got {keep_sequence_dim}")
+        self.keep_sequence_dim = keep_sequence_dim
+
+        if keep_sequence_dim is None:
+            self.add_state("hits", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("misses", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("false_alarms", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("hits_list", [], dist_reduce_fx="cat")
+            self.add_state("misses_list", [], dist_reduce_fx="cat")
+            self.add_state("false_alarms_list", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate hit/miss/false-alarm counts."""
+        hits, misses, false_alarms = _critical_success_index_update(
+            preds, target, self.threshold, self.keep_sequence_dim
+        )
+        if self.keep_sequence_dim is None:
+            self.hits = self.hits + hits
+            self.misses = self.misses + misses
+            self.false_alarms = self.false_alarms + false_alarms
+        else:
+            self.hits_list.append(hits)
+            self.misses_list.append(misses)
+            self.false_alarms_list.append(false_alarms)
+
+    def compute(self) -> Array:
+        """CSI."""
+        if self.keep_sequence_dim is None:
+            hits, misses, false_alarms = self.hits, self.misses, self.false_alarms
+        else:
+            hits = dim_zero_cat(self.hits_list)
+            misses = dim_zero_cat(self.misses_list)
+            false_alarms = dim_zero_cat(self.false_alarms_list)
+        return _critical_success_index_compute(hits, misses, false_alarms)
+
+
+class KLDivergence(Metric):
+    r"""KL divergence D_KL(p‖q).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import KLDivergence
+        >>> metric = KLDivergence()
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> metric(p, q).round(4)
+        Array(0.0853, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    measures: Union[Array, List[Array]]
+    total: Array
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument to be a bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ["mean", "sum", "none", None]
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        """Accumulate per-sample divergences (or their sum)."""
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + measures.sum()
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        """KL divergence under the chosen reduction."""
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.measures)
+        value = self.measures
+        return value / self.total if self.reduction == "mean" else value
+
+    def _compute_group_params(self):
+        return (self.log_prob, self.reduction in ("mean", "sum"))
+
+
+class CosineSimilarity(Metric):
+    r"""Cosine similarity between predictions and targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import CosineSimilarity
+        >>> metric = CosineSimilarity(reduction='mean')
+        >>> target = jnp.array([[1., 2, 3, 4], [1, 2, 3, 4]])
+        >>> preds = jnp.array([[1., 2, 3, 4], [-1, -2, -3, -4]])
+        >>> metric(preds, target)
+        Array(0., dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Store batch rows (cosine reduces at compute)."""
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Cosine similarity under the chosen reduction."""
+        return _cosine_similarity_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.reduction)
+
+    def _compute_group_params(self):
+        return ()

@@ -1,0 +1,210 @@
+"""Correlation metric modules: Pearson, Concordance, Spearman, Kendall.
+
+Parity: reference ``src/torchmetrics/regression/{pearson,concordance,spearman,
+kendall}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.regression.correlation import (
+    _ALLOWED_ALTERNATIVES,
+    _ALLOWED_VARIANTS,
+    _concordance_corrcoef_compute,
+    _final_aggregation,
+    _kendall_corrcoef_compute,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+    _spearman_corrcoef_compute,
+    _spearman_corrcoef_update,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    r"""Pearson correlation coefficient with exact streaming parallel-merge states.
+
+    States are running mean/var/cov per output; cross-device sync gathers the
+    per-device states and merges them with the Chan parallel-variance formula
+    (:func:`_final_aggregation`) — numerically exact, no sample storage.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> metric = PearsonCorrCoef()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.9849, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True  # running means: update depends on prior state
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    mean_x: Array
+    mean_y: Array
+    var_x: Array
+    var_y: Array
+    corr_xy: Array
+    n_total: Array
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) and num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
+            self.add_state(name, jnp.zeros(self.num_outputs), dist_reduce_fx="gather")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Fold the batch into the running mean/var/cov states."""
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target,
+            self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total,
+            self.num_outputs,
+        )
+
+    def _aggregated(self):
+        if self.mean_x.ndim > 1:  # gathered [world, d] states: exact parallel merge
+            return _final_aggregation(self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total)
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        """Pearson r (merging per-device states when synced)."""
+        _, _, var_x, var_y, corr_xy, n_total = self._aggregated()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    r"""Lin's concordance correlation coefficient (shares Pearson's states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ConcordanceCorrCoef
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.9777, dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """Concordance correlation."""
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._aggregated()
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)
+
+
+class SpearmanCorrCoef(Metric):
+    r"""Spearman rank correlation (tie-averaged ranks at compute time).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import SpearmanCorrCoef
+        >>> metric = SpearmanCorrCoef()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7]))
+        Array(1., dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) and num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Store the batch (ranking is global, so it happens at compute)."""
+        preds, target = _spearman_corrcoef_update(
+            preds.astype(jnp.float32), target.astype(jnp.float32), self.num_outputs
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """Spearman rho."""
+        return _spearman_corrcoef_compute(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+    def _compute_group_params(self):
+        return (self.num_outputs,)
+
+
+class KendallRankCorrCoef(Metric):
+    r"""Kendall rank correlation (tau-a/b/c), optionally with the z-test p-value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import KendallRankCorrCoef
+        >>> metric = KendallRankCorrCoef()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 1])).round(4)
+        Array(0.3333, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in _ALLOWED_VARIANTS:
+            raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant!r}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        if t_test and alternative not in _ALLOWED_ALTERNATIVES:
+            raise ValueError(
+                f"Argument `alternative` is expected to be one of {_ALLOWED_ALTERNATIVES}, but got {alternative!r}"
+            )
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.variant = variant
+        self.alternative = alternative if t_test else None
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Store the batch (pair counting is global, so it happens at compute)."""
+        if self.num_outputs == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        self.preds.append(preds.astype(jnp.float32))
+        self.target.append(target.astype(jnp.float32))
+
+    def compute(self):
+        """Kendall tau (and the p-value when ``t_test``)."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        tau, p_value = _kendall_corrcoef_compute(preds, target, self.variant, self.alternative)
+        if p_value is not None:
+            return tau, p_value
+        return tau
+
+    def _compute_group_params(self):
+        return (self.num_outputs,)

@@ -1,0 +1,180 @@
+"""Variance-ratio metric modules: R², ExplainedVariance, RelativeSquaredError.
+
+Parity: reference ``src/torchmetrics/regression/{r2,explained_variance,rse}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.regression.variance_explained import (
+    _explained_variance_compute,
+    _explained_variance_update,
+    _r2_score_compute,
+    _r2_score_update,
+    _relative_squared_error_compute,
+)
+
+Array = jax.Array
+
+_ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
+
+
+class R2Score(Metric):
+    r"""R² (coefficient of determination), with adjusted and multioutput modes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import R2Score
+        >>> metric = R2Score()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.9486, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    sum_squared_error: Array
+    sum_error: Array
+    residual: Array
+    total: Array
+
+    def __init__(self, num_outputs: int = 1, adjusted: int = 0, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        if multioutput not in _ALLOWED_MULTIOUTPUT:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {_ALLOWED_MULTIOUTPUT}"
+            )
+        self.multioutput = multioutput
+        self.add_state("sum_squared_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate Σt², Σt, and the residual sum of squares."""
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """R² score."""
+        return _r2_score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )
+
+    def _compute_group_params(self):
+        return (self.num_outputs,)
+
+
+class ExplainedVariance(Metric):
+    r"""Explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ExplainedVariance
+        >>> metric = ExplainedVariance()
+        >>> metric(jnp.array([2.5, 0.0, 2, 8]), jnp.array([3., -0.5, 2, 7])).round(4)
+        Array(0.9572, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    num_obs: Array
+    sum_error: Array
+    sum_squared_error: Array
+    sum_target: Array
+    sum_squared_target: Array
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if multioutput not in _ALLOWED_MULTIOUTPUT:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {_ALLOWED_MULTIOUTPUT}")
+        self.multioutput = multioutput
+        self.add_state("sum_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_target", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_obs", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate error/target first and second moments."""
+        num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+        self.num_obs = self.num_obs + num_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> Array:
+        """Explained variance."""
+        return _explained_variance_compute(
+            self.num_obs, self.sum_error, self.sum_squared_error, self.sum_target, self.sum_squared_target,
+            self.multioutput,
+        )
+
+
+class RelativeSquaredError(Metric):
+    r"""Relative squared error (RRSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import RelativeSquaredError
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0., 2], [-1, 2], [8, -5]])
+        >>> metric = RelativeSquaredError(num_outputs=2)
+        >>> metric(preds, target).round(4)
+        Array(0.0632, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    sum_squared_error: Array
+    sum_error: Array
+    residual: Array
+    total: Array
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        self.add_state("sum_squared_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate R²-style sums."""
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        """RSE (or its root)."""
+        return _relative_squared_error_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.squared
+        )
+
+    def _compute_group_params(self):
+        return (self.num_outputs,)
