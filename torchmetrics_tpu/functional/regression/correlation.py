@@ -221,6 +221,11 @@ def spearman_corrcoef(preds: Array, target: Array) -> Array:
         >>> spearman_corrcoef(preds, target)
         Array(1., dtype=float32)
     """
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
+        raise TypeError(
+            "Expected `preds` and `target` both to be floating point tensors, but got"
+            f" {preds.dtype} and {target.dtype}"
+        )
     num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
     preds, target = _spearman_corrcoef_update(
         preds.astype(jnp.float32), target.astype(jnp.float32), num_outputs

@@ -107,11 +107,8 @@ class MeanAbsoluteError(Metric):
     sum_abs_error: Array
     total: Array
 
-    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+    def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not (isinstance(num_outputs, int) and num_outputs > 0):
-            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
-        self.num_outputs = num_outputs
         self.add_state("sum_abs_error", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
 

@@ -58,8 +58,8 @@ class PearsonCorrCoef(Metric):
 
     def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not isinstance(num_outputs, int) and num_outputs < 1:
-            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         for name in ("mean_x", "mean_y", "var_x", "var_y", "corr_xy", "n_total"):
             self.add_state(name, jnp.zeros(self.num_outputs), dist_reduce_fx="gather")
@@ -122,17 +122,15 @@ class SpearmanCorrCoef(Metric):
 
     def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        if not isinstance(num_outputs, int) and num_outputs < 1:
-            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError(f"Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("preds", [], dist_reduce_fx="cat")
         self.add_state("target", [], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
         """Store the batch (ranking is global, so it happens at compute)."""
-        preds, target = _spearman_corrcoef_update(
-            preds.astype(jnp.float32), target.astype(jnp.float32), self.num_outputs
-        )
+        preds, target = _spearman_corrcoef_update(preds, target, self.num_outputs)
         self.preds.append(preds)
         self.target.append(target)
 

@@ -24,7 +24,39 @@ Array = jax.Array
 _ALLOWED_MULTIOUTPUT = ("raw_values", "uniform_average", "variance_weighted")
 
 
-class R2Score(Metric):
+class _SquaredSumsMetric(Metric):
+    """Shared Σt²/Σt/RSS/count accumulator behind R² and RSE.
+
+    Sharing the ``update`` implementation is what lets MetricCollection's *static*
+    compute-group scheme merge the two (the group key is the update function identity +
+    state spec, ``core/metric.py:224``, replacing the reference's runtime allclose pass,
+    ``collections.py:238-317``).
+    """
+
+    sum_squared_error: Array
+    sum_error: Array
+    residual: Array
+    total: Array
+
+    def _add_squared_sums_states(self) -> None:
+        self.add_state("sum_squared_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate Σt², Σt, and the residual sum of squares."""
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + rss
+        self.total = self.total + num_obs
+
+    def _compute_group_params(self):
+        return (self.num_outputs,)
+
+
+class R2Score(_SquaredSumsMetric):
     r"""R² (coefficient of determination), with adjusted and multioutput modes.
 
     Example:
@@ -41,11 +73,6 @@ class R2Score(Metric):
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 
-    sum_squared_error: Array
-    sum_error: Array
-    residual: Array
-    total: Array
-
     def __init__(self, num_outputs: int = 1, adjusted: int = 0, multioutput: str = "uniform_average", **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.num_outputs = num_outputs
@@ -57,27 +84,13 @@ class R2Score(Metric):
                 f"Invalid input to argument `multioutput`. Choose one of the following: {_ALLOWED_MULTIOUTPUT}"
             )
         self.multioutput = multioutput
-        self.add_state("sum_squared_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
-        self.add_state("sum_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
-        self.add_state("residual", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
-
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate Σt², Σt, and the residual sum of squares."""
-        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
-        self.sum_error = self.sum_error + sum_obs
-        self.residual = self.residual + rss
-        self.total = self.total + num_obs
+        self._add_squared_sums_states()
 
     def compute(self) -> Array:
         """R² score."""
         return _r2_score_compute(
             self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
         )
-
-    def _compute_group_params(self):
-        return (self.num_outputs,)
 
 
 class ExplainedVariance(Metric):
@@ -131,7 +144,7 @@ class ExplainedVariance(Metric):
         )
 
 
-class RelativeSquaredError(Metric):
+class RelativeSquaredError(_SquaredSumsMetric):
     r"""Relative squared error (RRSE with ``squared=False``).
 
     Example:
@@ -148,33 +161,14 @@ class RelativeSquaredError(Metric):
     higher_is_better = False
     full_state_update = False
 
-    sum_squared_error: Array
-    sum_error: Array
-    residual: Array
-    total: Array
-
     def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.num_outputs = num_outputs
         self.squared = squared
-        self.add_state("sum_squared_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
-        self.add_state("sum_error", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
-        self.add_state("residual", jnp.zeros(self.num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
-
-    def update(self, preds: Array, target: Array) -> None:
-        """Accumulate R²-style sums."""
-        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
-        self.sum_error = self.sum_error + sum_obs
-        self.residual = self.residual + rss
-        self.total = self.total + num_obs
+        self._add_squared_sums_states()
 
     def compute(self) -> Array:
         """RSE (or its root)."""
         return _relative_squared_error_compute(
             self.sum_squared_error, self.sum_error, self.residual, self.total, self.squared
         )
-
-    def _compute_group_params(self):
-        return (self.num_outputs,)
