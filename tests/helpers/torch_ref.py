@@ -1,0 +1,24 @@
+"""Golden-reference access: import the upstream TorchMetrics from /root/reference.
+
+Domains without an sklearn/scipy analog (image, text, ...) diff against the actual
+reference implementation running on CPU torch, via the same ``lightning_utilities``
+stub the benchmark uses.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_REF_PATH = "/root/reference/src"
+
+
+def reference_torchmetrics():
+    """Import (and cache) the reference torchmetrics package."""
+    from bench import _install_lightning_utilities_stub
+
+    _install_lightning_utilities_stub()
+    if _REF_PATH not in sys.path:
+        sys.path.insert(0, _REF_PATH)
+    import torchmetrics
+
+    return torchmetrics

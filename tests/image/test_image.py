@@ -1,0 +1,520 @@
+"""Image metric tests: differential vs the upstream reference on CPU torch + mesh sync.
+
+Analog of reference ``tests/unittests/image/`` — the golden reference is the actual
+upstream implementation (no sklearn analog exists for these metrics).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import MetricTester, _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+import torchmetrics.functional.image as ref_f  # noqa: E402
+
+from torchmetrics_tpu.functional.image import (  # noqa: E402
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    peak_signal_noise_ratio_with_blocked_effect,
+    relative_average_spectral_error,
+    root_mean_squared_error_using_sliding_window,
+    spatial_correlation_coefficient,
+    spatial_distortion_index,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+    visual_information_fidelity,
+)
+from torchmetrics_tpu.image import (  # noqa: E402
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+    QualityWithNoReference,
+    RelativeAverageSpectralError,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpatialCorrelationCoefficient,
+    SpatialDistortionIndex,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+
+NUM_BATCHES = 2
+BATCH = 4
+
+
+def _img_batches(c=3, h=32, w=32, seed=42):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(NUM_BATCHES, BATCH, c, h, w).astype(np.float32)
+    target = rng.rand(NUM_BATCHES, BATCH, c, h, w).astype(np.float32)
+    return preds, target
+
+
+class TestSSIM(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("gaussian_kernel", [True, False])
+    def test_functional(self, gaussian_kernel):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=structural_similarity_index_measure,
+            reference_metric=lambda p, t: ref_f.structural_similarity_index_measure(
+                torch.tensor(p), torch.tensor(t), gaussian_kernel=gaussian_kernel, data_range=1.0
+            ).numpy(),
+            metric_args={"gaussian_kernel": gaussian_kernel, "data_range": 1.0},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _img_batches()
+        self.run_class_metric_test(
+            preds,
+            target,
+            metric_class=StructuralSimilarityIndexMeasure,
+            reference_metric=lambda p, t: ref_f.structural_similarity_index_measure(
+                torch.tensor(p), torch.tensor(t), data_range=1.0
+            ).numpy(),
+            metric_args={"data_range": 1.0},
+            ddp=ddp,
+        )
+
+    def test_3d(self):
+        rng = np.random.RandomState(7)
+        p = rng.rand(2, 1, 16, 16, 16).astype(np.float32)
+        t = rng.rand(2, 1, 16, 16, 16).astype(np.float32)
+        res = structural_similarity_index_measure(jnp.asarray(p), jnp.asarray(t), data_range=1.0)
+        ref = ref_f.structural_similarity_index_measure(torch.tensor(p), torch.tensor(t), data_range=1.0)
+        _assert_allclose(res, ref.numpy(), atol=1e-4)
+
+    def test_full_image_and_contrast(self):
+        preds, target = _img_batches()
+        p, t = jnp.asarray(preds[0]), jnp.asarray(target[0])
+        sim, img = structural_similarity_index_measure(p, t, data_range=1.0, return_full_image=True)
+        rsim, rimg = ref_f.structural_similarity_index_measure(
+            torch.tensor(preds[0]), torch.tensor(target[0]), data_range=1.0, return_full_image=True
+        )
+        _assert_allclose(sim, rsim.numpy(), atol=1e-4)
+        _assert_allclose(img, rimg.numpy(), atol=1e-4)
+
+
+class TestMSSSIM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches(h=180, w=180)
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=multiscale_structural_similarity_index_measure,
+            reference_metric=lambda p, t: ref_f.multiscale_structural_similarity_index_measure(
+                torch.tensor(p), torch.tensor(t), data_range=1.0
+            ).numpy(),
+            metric_args={"data_range": 1.0},
+        )
+
+    def test_class(self):
+        preds, target = _img_batches(h=180, w=180)
+        self.run_class_metric_test(
+            preds,
+            target,
+            metric_class=MultiScaleStructuralSimilarityIndexMeasure,
+            reference_metric=lambda p, t: ref_f.multiscale_structural_similarity_index_measure(
+                torch.tensor(p), torch.tensor(t), data_range=1.0
+            ).numpy(),
+            metric_args={"data_range": 1.0},
+        )
+
+
+class TestPSNR(MetricTester):
+    @pytest.mark.parametrize("data_range", [None, 1.0])
+    def test_functional(self, data_range):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=peak_signal_noise_ratio,
+            reference_metric=lambda p, t: ref_f.peak_signal_noise_ratio(
+                torch.tensor(p), torch.tensor(t), data_range=data_range
+            ).numpy(),
+            metric_args={"data_range": data_range},
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _img_batches()
+        ref_metric = tm_ref.PeakSignalNoiseRatio(data_range=1.0)
+
+        def _ref(p, t):
+            m = tm_ref.PeakSignalNoiseRatio(data_range=1.0)
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds,
+            target,
+            metric_class=PeakSignalNoiseRatio,
+            reference_metric=_ref,
+            metric_args={"data_range": 1.0},
+            ddp=ddp,
+        )
+
+    def test_dim(self):
+        preds, target = _img_batches()
+        res = peak_signal_noise_ratio(
+            jnp.asarray(preds[0]), jnp.asarray(target[0]), data_range=1.0, dim=(1, 2, 3)
+        )
+        ref = ref_f.peak_signal_noise_ratio(
+            torch.tensor(preds[0]), torch.tensor(target[0]), data_range=1.0, dim=(1, 2, 3)
+        )
+        _assert_allclose(res, ref.numpy(), atol=1e-4)
+
+    def test_module_data_range_none(self):
+        preds, target = _img_batches()
+        ours = PeakSignalNoiseRatio()
+        theirs = tm_ref.PeakSignalNoiseRatio()
+        for i in range(NUM_BATCHES):
+            ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            theirs.update(torch.tensor(preds[i]), torch.tensor(target[i]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+
+class TestPSNRB(MetricTester):
+    def test_functional(self):
+        preds, target = _img_batches(c=1)
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=peak_signal_noise_ratio_with_blocked_effect,
+            reference_metric=lambda p, t: ref_f.peak_signal_noise_ratio_with_blocked_effect(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    def test_class(self):
+        preds, target = _img_batches(c=1)
+        ours = PeakSignalNoiseRatioWithBlockedEffect()
+        theirs = tm_ref.image.PeakSignalNoiseRatioWithBlockedEffect()
+        for i in range(NUM_BATCHES):
+            ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            theirs.update(torch.tensor(preds[i]), torch.tensor(target[i]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+
+class TestUQI(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=universal_image_quality_index,
+            reference_metric=lambda p, t: ref_f.universal_image_quality_index(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.UniversalImageQualityIndex()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=UniversalImageQualityIndex, reference_metric=_ref, ddp=ddp
+        )
+
+
+class TestSAM(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=spectral_angle_mapper,
+            reference_metric=lambda p, t: ref_f.spectral_angle_mapper(torch.tensor(p), torch.tensor(t)).numpy(),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.SpectralAngleMapper()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=SpectralAngleMapper, reference_metric=_ref, ddp=ddp
+        )
+
+
+class TestERGAS(MetricTester):
+    atol = 1e-3
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=error_relative_global_dimensionless_synthesis,
+            reference_metric=lambda p, t: ref_f.error_relative_global_dimensionless_synthesis(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    def test_class(self):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.ErrorRelativeGlobalDimensionlessSynthesis()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=ErrorRelativeGlobalDimensionlessSynthesis, reference_metric=_ref
+        )
+
+
+class TestSCC(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=spatial_correlation_coefficient,
+            reference_metric=lambda p, t: ref_f.spatial_correlation_coefficient(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.image.SpatialCorrelationCoefficient()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=SpatialCorrelationCoefficient, reference_metric=_ref, ddp=ddp
+        )
+
+
+class TestVIF(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches(h=48, w=48)
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=visual_information_fidelity,
+            reference_metric=lambda p, t: ref_f.visual_information_fidelity(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    def test_class(self):
+        preds, target = _img_batches(h=48, w=48)
+
+        def _ref(p, t):
+            m = tm_ref.image.VisualInformationFidelity()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=VisualInformationFidelity, reference_metric=_ref
+        )
+
+
+class TestTV(MetricTester):
+    atol = 1e-2  # f32 sum over many pixels
+
+    def test_functional(self):
+        preds, _ = _img_batches()
+        for i in range(NUM_BATCHES):
+            res = total_variation(jnp.asarray(preds[i]))
+            ref = ref_f.total_variation(torch.tensor(preds[i]))
+            _assert_allclose(res, ref.numpy(), atol=self.atol)
+
+    @pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+    def test_class(self, reduction):
+        preds, _ = _img_batches()
+        ours = TotalVariation(reduction=reduction)
+        theirs = tm_ref.TotalVariation(reduction=reduction)
+        for i in range(NUM_BATCHES):
+            ours.update(jnp.asarray(preds[i]))
+            theirs.update(torch.tensor(preds[i]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=self.atol)
+
+
+class TestRMSESW(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=root_mean_squared_error_using_sliding_window,
+            reference_metric=lambda p, t: ref_f.root_mean_squared_error_using_sliding_window(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.image.RootMeanSquaredErrorUsingSlidingWindow()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds,
+            target,
+            metric_class=RootMeanSquaredErrorUsingSlidingWindow,
+            reference_metric=_ref,
+            ddp=ddp,
+        )
+
+
+class TestRASE(MetricTester):
+    atol = 1e-2
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=relative_average_spectral_error,
+            reference_metric=lambda p, t: ref_f.relative_average_spectral_error(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+            atol=1e-2,
+        )
+
+    def test_class(self):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.RelativeAverageSpectralError()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=RelativeAverageSpectralError, reference_metric=_ref, atol=1e-2
+        )
+
+
+class TestDLambda(MetricTester):
+    atol = 1e-4
+
+    def test_functional(self):
+        preds, target = _img_batches()
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=spectral_distortion_index,
+            reference_metric=lambda p, t: ref_f.spectral_distortion_index(
+                torch.tensor(p), torch.tensor(t)
+            ).numpy(),
+        )
+
+    def test_class(self):
+        preds, target = _img_batches()
+
+        def _ref(p, t):
+            m = tm_ref.SpectralDistortionIndex()
+            return m(torch.tensor(p), torch.tensor(t)).numpy()
+
+        self.run_class_metric_test(
+            preds, target, metric_class=SpectralDistortionIndex, reference_metric=_ref
+        )
+
+
+class TestDS:
+    """D_s against the reference with `pan_lr` provided (torchvision isn't installed,
+    so the reference's own degrade-resize path is unavailable as a golden)."""
+
+    def test_with_pan_lr(self):
+        rng = np.random.RandomState(42)
+        preds = rng.rand(4, 3, 32, 32).astype(np.float32)
+        ms = rng.rand(4, 3, 16, 16).astype(np.float32)
+        pan = rng.rand(4, 3, 32, 32).astype(np.float32)
+        pan_lr = rng.rand(4, 3, 16, 16).astype(np.float32)
+        res = spatial_distortion_index(
+            jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), jnp.asarray(pan_lr)
+        )
+        ref = ref_f.spatial_distortion_index(
+            torch.tensor(preds), torch.tensor(ms), torch.tensor(pan), torch.tensor(pan_lr)
+        )
+        _assert_allclose(res, ref.numpy(), atol=1e-4)
+
+    def test_module(self):
+        rng = np.random.RandomState(42)
+        preds = rng.rand(4, 3, 32, 32).astype(np.float32)
+        ms = rng.rand(4, 3, 16, 16).astype(np.float32)
+        pan = rng.rand(4, 3, 32, 32).astype(np.float32)
+        pan_lr = rng.rand(4, 3, 16, 16).astype(np.float32)
+        m = SpatialDistortionIndex()
+        m.update(jnp.asarray(preds), {"ms": jnp.asarray(ms), "pan": jnp.asarray(pan), "pan_lr": jnp.asarray(pan_lr)})
+        ref = ref_f.spatial_distortion_index(
+            torch.tensor(preds), torch.tensor(ms), torch.tensor(pan), torch.tensor(pan_lr)
+        )
+        _assert_allclose(m.compute(), ref.numpy(), atol=1e-4)
+
+    def test_no_pan_lr_runs(self):
+        rng = np.random.RandomState(0)
+        preds = jnp.asarray(rng.rand(2, 3, 32, 32).astype(np.float32))
+        ms = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+        pan = jnp.asarray(rng.rand(2, 3, 32, 32).astype(np.float32))
+        val = spatial_distortion_index(preds, ms, pan)
+        assert 0.0 <= float(val) <= 1.0
+
+
+class TestQNR:
+    def test_module(self):
+        rng = np.random.RandomState(42)
+        preds = rng.rand(4, 3, 32, 32).astype(np.float32)
+        ms = rng.rand(4, 3, 16, 16).astype(np.float32)
+        pan = rng.rand(4, 3, 32, 32).astype(np.float32)
+        pan_lr = rng.rand(4, 3, 16, 16).astype(np.float32)
+        m = QualityWithNoReference()
+        m.update(jnp.asarray(preds), {"ms": jnp.asarray(ms), "pan": jnp.asarray(pan), "pan_lr": jnp.asarray(pan_lr)})
+        ref = ref_f.quality_with_no_reference(
+            torch.tensor(preds), torch.tensor(ms), torch.tensor(pan), torch.tensor(pan_lr)
+        )
+        _assert_allclose(m.compute(), ref.numpy(), atol=1e-4)
+
+
+class TestImageGradients:
+    def test_matches_reference(self):
+        rng = np.random.RandomState(42)
+        img = rng.rand(4, 3, 16, 16).astype(np.float32)
+        dy, dx = image_gradients(jnp.asarray(img))
+        rdy, rdx = ref_f.image_gradients(torch.tensor(img))
+        _assert_allclose(dy, rdy.numpy(), atol=1e-6)
+        _assert_allclose(dx, rdx.numpy(), atol=1e-6)
+
+    def test_raises(self):
+        with pytest.raises(RuntimeError, match="The `img` expects a 4D tensor"):
+            image_gradients(jnp.zeros((5, 5)))

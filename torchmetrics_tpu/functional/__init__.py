@@ -1,9 +1,18 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import classification, regression
+from torchmetrics_tpu.functional import classification, image, regression
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 
-__all__ = ["classification", "regression", *_classification_all, *_regression_all]
+__all__ = [
+    "classification",
+    "image",
+    "regression",
+    *_classification_all,
+    *_image_all,
+    *_regression_all,
+]

@@ -1,0 +1,44 @@
+"""Functional image metrics.
+
+Parity: reference ``src/torchmetrics/functional/image/__init__.py`` (the analytic
+subset; LPIPS/perceptual-path-length are model-based and live with the extractor
+metrics).
+"""
+
+from torchmetrics_tpu.functional.image.d_lambda import spectral_distortion_index
+from torchmetrics_tpu.functional.image.d_s import spatial_distortion_index
+from torchmetrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
+from torchmetrics_tpu.functional.image.gradients import image_gradients
+from torchmetrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from torchmetrics_tpu.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect
+from torchmetrics_tpu.functional.image.qnr import quality_with_no_reference
+from torchmetrics_tpu.functional.image.rase import relative_average_spectral_error
+from torchmetrics_tpu.functional.image.rmse_sw import root_mean_squared_error_using_sliding_window
+from torchmetrics_tpu.functional.image.sam import spectral_angle_mapper
+from torchmetrics_tpu.functional.image.scc import spatial_correlation_coefficient
+from torchmetrics_tpu.functional.image.ssim import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from torchmetrics_tpu.functional.image.tv import total_variation
+from torchmetrics_tpu.functional.image.uqi import universal_image_quality_index
+from torchmetrics_tpu.functional.image.vif import visual_information_fidelity
+
+__all__ = [
+    "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
+    "multiscale_structural_similarity_index_measure",
+    "peak_signal_noise_ratio",
+    "peak_signal_noise_ratio_with_blocked_effect",
+    "quality_with_no_reference",
+    "relative_average_spectral_error",
+    "root_mean_squared_error_using_sliding_window",
+    "spatial_correlation_coefficient",
+    "spatial_distortion_index",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "structural_similarity_index_measure",
+    "total_variation",
+    "universal_image_quality_index",
+    "visual_information_fidelity",
+]

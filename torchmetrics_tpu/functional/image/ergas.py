@@ -1,0 +1,76 @@
+"""ERGAS — relative dimensionless global error in synthesis.
+
+Parity: reference ``src/torchmetrics/functional/image/ergas.py`` (update ``:25-44``,
+compute ``:47-84``, public fn ``:87-139``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.utils import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _ergas_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate BxCxHxW inputs."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ergas_compute(
+    preds: Array,
+    target: Array,
+    ratio: float = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS from per-band RMSE relative to per-band target means."""
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+
+    ergas_score = 100 / ratio * jnp.sqrt(jnp.sum(jnp.square(rmse_per_band / mean_target), axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: float = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Compute the ERGAS pan-sharpening quality metric.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import (
+        ...     error_relative_global_dimensionless_synthesis)
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> error_relative_global_dimensionless_synthesis(preds, target).round(2)
+        Array(8.33, dtype=float32)
+    """
+    preds, target = _ergas_update(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

@@ -1,0 +1,47 @@
+"""Image gradients (dy, dx) via one-step finite differences.
+
+Parity: reference ``src/torchmetrics/functional/image/gradients.py:20-80``.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    """Require a 4D NCHW tensor."""
+    if not hasattr(img, "ndim"):
+        raise TypeError(f"The `img` expects an array but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Forward differences along H and W, zero-padded at the far edge."""
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Compute (dy, dx) gradient images of an ``(N, C, H, W)`` batch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import image_gradients
+        >>> image = jnp.arange(0, 1*1*5*5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :2, :2]
+        Array([[5., 5.],
+               [5., 5.]], dtype=float32)
+    """
+    img = jnp.asarray(img)
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -1,0 +1,96 @@
+"""Peak signal-to-noise ratio.
+
+Parity: reference ``src/torchmetrics/functional/image/psnr.py`` (update ``:59-89``,
+compute ``:23-56``, public fn ``:92-171``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.utils import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """PSNR from accumulated squared error / observation count."""
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    """Sum of squared error and observation count, optionally over a dim subset."""
+    if dim is None:
+        diff = preds - target
+        sum_squared_error = jnp.sum(diff * diff)
+        num_obs = jnp.asarray(target.size)
+        return sum_squared_error, num_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        num_obs = jnp.asarray(target.size)
+    else:
+        num_obs = jnp.asarray(
+            jnp.prod(jnp.asarray([target.shape[d] for d in dim_list]))
+        )
+        num_obs = jnp.broadcast_to(num_obs, sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """Compute the peak signal-to-noise ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import peak_signal_noise_ratio
+        >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> peak_signal_noise_ratio(preds, target).round(4)
+        Array(2.5527, dtype=float32)
+    """
+    if dim is None and reduction != "elementwise_mean":
+        from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+    preds = jnp.asarray(preds, dtype=jnp.promote_types(jnp.asarray(preds).dtype, jnp.float32))
+    target = jnp.asarray(target, dtype=preds.dtype)
+    _check_same_shape(preds, target)
+
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range_t = target.max() - target.min()
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range_t = jnp.asarray(float(data_range[1] - data_range[0]))
+    else:
+        data_range_t = jnp.asarray(float(data_range))
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range_t, base=base, reduction=reduction)

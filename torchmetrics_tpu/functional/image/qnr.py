@@ -1,0 +1,48 @@
+"""Quality with no reference (QNR).
+
+Parity: reference ``src/torchmetrics/functional/image/qnr.py:28-83`` —
+``(1 - D_lambda)^alpha * (1 - D_s)^beta``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from torchmetrics_tpu.functional.image.d_lambda import spectral_distortion_index
+from torchmetrics_tpu.functional.image.d_s import spatial_distortion_index
+
+Array = jax.Array
+
+
+def quality_with_no_reference(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    alpha: float = 1,
+    beta: float = 1,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Compute QNR, the combined no-reference pan-sharpening quality score.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import quality_with_no_reference
+        >>> k1, k2, k3 = jax.random.split(jax.random.PRNGKey(42), 3)
+        >>> preds = jax.random.uniform(k1, (16, 3, 32, 32))
+        >>> ms = jax.random.uniform(k2, (16, 3, 16, 16))
+        >>> pan = jax.random.uniform(k3, (16, 3, 32, 32))
+        >>> float(quality_with_no_reference(preds, ms, pan)) > 0.8
+        True
+    """
+    if not isinstance(alpha, (int, float)) or alpha < 0:
+        raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+    if not isinstance(beta, (int, float)) or beta < 0:
+        raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+    d_lambda = spectral_distortion_index(preds, ms, norm_order, reduction)
+    d_s = spatial_distortion_index(preds, ms, pan, pan_lr, norm_order, window_size, reduction)
+    return (1 - d_lambda) ** alpha * (1 - d_s) ** beta

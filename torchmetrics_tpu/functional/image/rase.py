@@ -1,0 +1,69 @@
+"""Relative average spectral error.
+
+Parity: reference ``src/torchmetrics/functional/image/rase.py`` (update ``:24-47``,
+compute ``:50-69``, public fn ``:72-104``).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+from torchmetrics_tpu.functional.image.utils import _uniform_filter
+
+Array = jax.Array
+
+
+def _rase_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_map: Array,
+    target_sum: Array,
+    total_images: Array,
+) -> Tuple[Array, Array, Array]:
+    """Accumulate the RMSE map and windowed target mean over the batch."""
+    _, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images
+    )
+    target_sum = target_sum + jnp.sum(_uniform_filter(target, window_size) / (window_size**2), axis=0)
+    return rmse_map, target_sum, total_images
+
+
+def _rase_compute(rmse_map: Array, target_sum: Array, total_images: Array, window_size: int) -> Array:
+    """RASE from the accumulated RMSE map and target means."""
+    _, rmse_map = _rmse_sw_compute(rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images)
+    target_mean = target_sum / total_images
+    target_mean = target_mean.mean(axis=0)  # mean over image channels
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(jnp.square(rmse_map), axis=0))
+    crop = round(window_size / 2)
+    return jnp.mean(rase_map[crop:-crop, crop:-crop])
+
+
+def relative_average_spectral_error(preds: Array, target: Array, window_size: int = 8) -> Array:
+    """Compute the relative average spectral error.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import relative_average_spectral_error
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(22))
+        >>> preds = jax.random.uniform(k1, (4, 3, 16, 16))
+        >>> target = jax.random.uniform(k2, (4, 3, 16, 16))
+        >>> float(relative_average_spectral_error(preds, target)) > 0
+        True
+    """
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    img_shape = target.shape[1:]
+    rmse_map = jnp.zeros(img_shape, dtype=target.dtype)
+    target_sum = jnp.zeros(img_shape, dtype=target.dtype)
+    total_images = jnp.asarray(0.0)
+    rmse_map, target_sum, total_images = _rase_update(
+        preds, target, window_size, rmse_map, target_sum, total_images
+    )
+    return _rase_compute(rmse_map, target_sum, total_images, window_size)

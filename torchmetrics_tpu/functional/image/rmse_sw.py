@@ -1,0 +1,95 @@
+"""Sliding-window RMSE.
+
+Parity: reference ``src/torchmetrics/functional/image/rmse_sw.py`` (update ``:24-90``,
+compute ``:93-110``, public fn ``:113-150``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.utils import _uniform_filter
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _rmse_sw_checks(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array]:
+    """Validate BxCxHxW inputs and window size."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            f"Expected `preds` and `target` to have the same data type. But got {preds.dtype} and {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. But got {preds.shape}.")
+    if round(window_size / 2) >= target.shape[2] or round(window_size / 2) >= target.shape[3]:
+        raise ValueError(
+            f"Parameter `round(window_size / 2)` is expected to be smaller than"
+            f" {min(target.shape[2], target.shape[3])} but got {round(window_size / 2)}."
+        )
+    return preds, target
+
+
+def _rmse_sw_update(
+    preds: Array,
+    target: Array,
+    window_size: int,
+    rmse_val_sum: Optional[Array],
+    rmse_map: Optional[Array],
+    total_images: Optional[Array],
+) -> Tuple[Optional[Array], Array, Array]:
+    """Accumulate the per-batch RMSE-map (and optionally the windowed RMSE sum)."""
+    preds, target = _rmse_sw_checks(preds, target, window_size)
+
+    batch = jnp.asarray(target.shape[0], dtype=jnp.float32)
+    total_images = batch if total_images is None else total_images + batch
+
+    error = jnp.square(target - preds)
+    error = _uniform_filter(error, window_size)
+    batch_rmse_map = jnp.sqrt(error)
+    crop = round(window_size / 2)
+
+    batch_rmse_val = batch_rmse_map[:, :, crop:-crop, crop:-crop].sum(axis=0).mean()
+    new_rmse_val_sum = batch_rmse_val if rmse_val_sum is None else rmse_val_sum + batch_rmse_val
+    new_rmse_map = batch_rmse_map.sum(axis=0) if rmse_map is None else rmse_map + batch_rmse_map.sum(axis=0)
+    return new_rmse_val_sum, new_rmse_map, total_images
+
+
+def _rmse_sw_compute(
+    rmse_val_sum: Optional[Array], rmse_map: Array, total_images: Array
+) -> Tuple[Optional[Array], Array]:
+    """Final mean over images for both the scalar RMSE and the RMSE map."""
+    rmse = rmse_val_sum / total_images if rmse_val_sum is not None else None
+    return rmse, rmse_map / total_images
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array, target: Array, window_size: int = 8, return_rmse_map: bool = False
+) -> Union[Optional[Array], Tuple[Optional[Array], Array]]:
+    """Compute RMSE over a sliding window.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import (
+        ...     root_mean_squared_error_using_sliding_window)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(22))
+        >>> preds = jax.random.uniform(k1, (4, 3, 16, 16))
+        >>> target = jax.random.uniform(k2, (4, 3, 16, 16))
+        >>> float(root_mean_squared_error_using_sliding_window(preds, target)) > 0
+        True
+    """
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    rmse_val_sum, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+    )
+    rmse, rmse_map = _rmse_sw_compute(rmse_val_sum, rmse_map, total_images)
+    if return_rmse_map:
+        return rmse, rmse_map
+    return rmse

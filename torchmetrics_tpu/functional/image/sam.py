@@ -1,0 +1,73 @@
+"""Spectral angle mapper.
+
+Parity: reference ``src/torchmetrics/functional/image/sam.py`` (update ``:25-50``,
+compute ``:53-82``, public fn ``:85-134``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.utils import reduce
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _sam_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Validate multi-band BxCxHxW inputs (C > 1)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_compute(
+    preds: Array,
+    target: Array,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Per-pixel spectral angle between prediction and target band vectors."""
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(
+    preds: Array,
+    target: Array,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Compute the spectral angle mapper score.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import spectral_angle_mapper
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.uniform(key1, (16, 3, 16, 16))
+        >>> target = jax.random.uniform(key2, (16, 3, 16, 16))
+        >>> float(spectral_angle_mapper(preds, target)) > 0
+        True
+    """
+    preds, target = _sam_update(preds, target)
+    return _sam_compute(preds, target, reduction)

@@ -1,0 +1,103 @@
+"""Pixel-based visual information fidelity (VIF-p).
+
+Parity: reference ``src/torchmetrics/functional/image/vif.py`` (gaussian filter
+``:21-30``, per-channel 4-scale loop ``:33-86``, public fn ``:89-120``).
+
+The 4-scale pyramid is statically unrolled; every mask-assignment in the reference
+becomes a branchless ``jnp.where`` so the whole metric jit-compiles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.utils import _conv2d
+
+Array = jax.Array
+
+
+def _vif_filter(win_size: int, sigma: float, dtype) -> Array:
+    """2D gaussian window of size ``win_size`` (not separable-normalised per-axis)."""
+    coords = jnp.arange(win_size, dtype=dtype) - (win_size - 1) / 2
+    g = jnp.square(coords)
+    g = jnp.exp(-(g[None, :] + g[:, None]) / (2.0 * sigma**2))
+    return g / jnp.sum(g)
+
+
+def _vif_per_channel(preds: Array, target: Array, sigma_n_sq: float) -> Array:
+    dtype = preds.dtype
+    preds = preds[:, None]  # (B, 1, H, W)
+    target = target[:, None]
+    eps = jnp.asarray(1e-10, dtype=dtype)
+    sigma_n_sq = jnp.asarray(sigma_n_sq, dtype=dtype)
+
+    preds_vif = jnp.zeros((1,), dtype=dtype)
+    target_vif = jnp.zeros((1,), dtype=dtype)
+    for scale in range(4):
+        n = int(2.0 ** (4 - scale) + 1)
+        kernel = _vif_filter(n, n / 5, dtype)[None, None, :]
+
+        if scale > 0:
+            target = _conv2d(target, kernel)[:, :, ::2, ::2]
+            preds = _conv2d(preds, kernel)[:, :, ::2, ::2]
+
+        mu_target = _conv2d(target, kernel)
+        mu_preds = _conv2d(preds, kernel)
+        mu_target_sq = mu_target**2
+        mu_preds_sq = mu_preds**2
+        mu_target_preds = mu_target * mu_preds
+
+        sigma_target_sq = jnp.clip(_conv2d(target**2, kernel) - mu_target_sq, min=0.0)
+        sigma_preds_sq = jnp.clip(_conv2d(preds**2, kernel) - mu_preds_sq, min=0.0)
+        sigma_target_preds = _conv2d(target * preds, kernel) - mu_target_preds
+
+        g = sigma_target_preds / (sigma_target_sq + eps)
+        sigma_v_sq = sigma_preds_sq - g * sigma_target_preds
+
+        mask = sigma_target_sq < eps
+        g = jnp.where(mask, 0.0, g)
+        sigma_v_sq = jnp.where(mask, sigma_preds_sq, sigma_v_sq)
+        sigma_target_sq = jnp.where(mask, 0.0, sigma_target_sq)
+
+        mask = sigma_preds_sq < eps
+        g = jnp.where(mask, 0.0, g)
+        sigma_v_sq = jnp.where(mask, 0.0, sigma_v_sq)
+
+        mask = g < 0
+        sigma_v_sq = jnp.where(mask, sigma_preds_sq, sigma_v_sq)
+        g = jnp.where(mask, 0.0, g)
+        sigma_v_sq = jnp.clip(sigma_v_sq, min=eps)
+
+        preds_vif_scale = jnp.log10(1.0 + (g**2.0) * sigma_target_sq / (sigma_v_sq + sigma_n_sq))
+        preds_vif = preds_vif + jnp.sum(preds_vif_scale, axis=(1, 2, 3))
+        target_vif = target_vif + jnp.sum(jnp.log10(1.0 + sigma_target_sq / sigma_n_sq), axis=(1, 2, 3))
+    return preds_vif / target_vif
+
+
+def visual_information_fidelity(preds: Array, target: Array, sigma_n_sq: float = 2.0) -> Array:
+    """Compute pixel-based visual information fidelity.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import visual_information_fidelity
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.uniform(k1, (2, 1, 41, 41))
+        >>> target = jax.random.uniform(k2, (2, 1, 41, 41))
+        >>> float(visual_information_fidelity(preds, target)) > 0
+        True
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target, dtype=preds.dtype)
+    if preds.shape[-1] < 41 or preds.shape[-2] < 41:
+        raise ValueError(
+            f"Invalid size of preds. Expected at least 41x41, but got {preds.shape[-1]}x{preds.shape[-2]}!"
+        )
+    if target.shape[-1] < 41 or target.shape[-2] < 41:
+        raise ValueError(
+            f"Invalid size of target. Expected at least 41x41, but got {target.shape[-1]}x{target.shape[-2]}!"
+        )
+    per_channel = [
+        _vif_per_channel(preds[:, i], target[:, i], sigma_n_sq) for i in range(preds.shape[1])
+    ]
+    return jnp.mean(jnp.concatenate(per_channel))

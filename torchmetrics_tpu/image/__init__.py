@@ -1,0 +1,47 @@
+"""Image metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/image/__init__.py`` (the analytic subset; the
+model-based FID/KID/IS/MIFID/LPIPS/PPL family is added with the Flax Inception stack).
+"""
+
+from torchmetrics_tpu.image.pansharpening import (
+    QualityWithNoReference,
+    SpatialDistortionIndex,
+    SpectralDistortionIndex,
+)
+from torchmetrics_tpu.image.psnr import (
+    PeakSignalNoiseRatio,
+    PeakSignalNoiseRatioWithBlockedEffect,
+)
+from torchmetrics_tpu.image.quality import (
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    RelativeAverageSpectralError,
+    RootMeanSquaredErrorUsingSlidingWindow,
+    SpatialCorrelationCoefficient,
+    SpectralAngleMapper,
+    TotalVariation,
+    UniversalImageQualityIndex,
+    VisualInformationFidelity,
+)
+from torchmetrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
+    "QualityWithNoReference",
+    "RelativeAverageSpectralError",
+    "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpatialCorrelationCoefficient",
+    "SpatialDistortionIndex",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+    "VisualInformationFidelity",
+]

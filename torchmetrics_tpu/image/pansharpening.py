@@ -1,0 +1,230 @@
+"""Pan-sharpening quality modules: D_lambda, D_s, QNR.
+
+Parity: reference ``src/torchmetrics/image/{d_lambda,d_s,qnr}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.image.d_lambda import (
+    _spectral_distortion_index_compute,
+    _spectral_distortion_index_update,
+)
+from torchmetrics_tpu.functional.image.d_s import (
+    _spatial_distortion_index_compute,
+    _spatial_distortion_index_update,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpectralDistortionIndex(Metric):
+    r"""Spectral distortion index (D_lambda).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import SpectralDistortionIndex
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.uniform(k1, (16, 3, 16, 16))
+        >>> target = jax.random.uniform(k2, (16, 3, 16, 16))
+        >>> sdi = SpectralDistortionIndex()
+        >>> float(sdi(preds, target)) < 0.2
+        True
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Store batch inputs (the UQI matrices need the whole epoch)."""
+        preds, target = _spectral_distortion_index_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """D_lambda over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spectral_distortion_index_compute(preds, target, self.p, self.reduction)
+
+
+class SpatialDistortionIndex(Metric):
+    r"""Spatial distortion index (D_s).
+
+    ``target`` is a dict with keys ``ms``, ``pan`` and optionally ``pan_lr``.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import SpatialDistortionIndex
+        >>> k1, k2, k3 = jax.random.split(jax.random.PRNGKey(42), 3)
+        >>> preds = jax.random.uniform(k1, (16, 3, 32, 32))
+        >>> target = {
+        ...     "ms": jax.random.uniform(k2, (16, 3, 16, 16)),
+        ...     "pan": jax.random.uniform(k3, (16, 3, 32, 32)),
+        ... }
+        >>> sdi = SpatialDistortionIndex()
+        >>> float(sdi(preds, target)) < 0.2
+        True
+    """
+
+    higher_is_better = False
+    is_differentiable = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(norm_order, int) or norm_order <= 0:
+            raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+        self.norm_order = norm_order
+        if not isinstance(window_size, int) or window_size <= 0:
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+        self.window_size = window_size
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("ms", [], dist_reduce_fx="cat")
+        self.add_state("pan", [], dist_reduce_fx="cat")
+        self.add_state("pan_lr", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Dict[str, Array]) -> None:
+        """Store the pan-sharpening quadruple for epoch-end evaluation."""
+        if "ms" not in target:
+            raise ValueError(f"Expected `target` to have key `ms`. Got target: {target.keys()}.")
+        if "pan" not in target:
+            raise ValueError(f"Expected `target` to have key `pan`. Got target: {target.keys()}.")
+        ms = target["ms"]
+        pan = target["pan"]
+        pan_lr = target.get("pan_lr")
+        preds, ms, pan, pan_lr = _spatial_distortion_index_update(preds, ms, pan, pan_lr)
+        self.preds.append(preds)
+        self.ms.append(ms)
+        self.pan.append(pan)
+        if pan_lr is not None:
+            self.pan_lr.append(pan_lr)
+
+    def compute(self) -> Array:
+        """D_s over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        ms = dim_zero_cat(self.ms)
+        pan = dim_zero_cat(self.pan)
+        pan_lr = dim_zero_cat(self.pan_lr) if len(self.pan_lr) > 0 else None
+        return _spatial_distortion_index_compute(
+            preds, ms, pan, pan_lr, self.norm_order, self.window_size, self.reduction
+        )
+
+
+class QualityWithNoReference(Metric):
+    r"""Quality with no reference (QNR).
+
+    ``target`` is a dict with keys ``ms``, ``pan`` and optionally ``pan_lr``.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import QualityWithNoReference
+        >>> k1, k2, k3 = jax.random.split(jax.random.PRNGKey(42), 3)
+        >>> preds = jax.random.uniform(k1, (16, 3, 32, 32))
+        >>> target = {
+        ...     "ms": jax.random.uniform(k2, (16, 3, 16, 16)),
+        ...     "pan": jax.random.uniform(k3, (16, 3, 32, 32)),
+        ... }
+        >>> qnr = QualityWithNoReference()
+        >>> float(qnr(preds, target)) > 0.8
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        alpha: float = 1,
+        beta: float = 1,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(alpha, (int, float)) or alpha < 0:
+            raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+        self.alpha = alpha
+        if not isinstance(beta, (int, float)) or beta < 0:
+            raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+        self.beta = beta
+        if not isinstance(norm_order, int) or norm_order <= 0:
+            raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+        self.norm_order = norm_order
+        if not isinstance(window_size, int) or window_size <= 0:
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+        self.window_size = window_size
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("ms", [], dist_reduce_fx="cat")
+        self.add_state("pan", [], dist_reduce_fx="cat")
+        self.add_state("pan_lr", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Dict[str, Array]) -> None:
+        """Store the pan-sharpening quadruple for epoch-end evaluation."""
+        if "ms" not in target:
+            raise ValueError(f"Expected `target` to have key `ms`. Got target: {target.keys()}.")
+        if "pan" not in target:
+            raise ValueError(f"Expected `target` to have key `pan`. Got target: {target.keys()}.")
+        ms = target["ms"]
+        pan = target["pan"]
+        pan_lr = target.get("pan_lr")
+        preds, ms = _spectral_distortion_index_update(preds, ms)
+        preds, ms, pan, pan_lr = _spatial_distortion_index_update(preds, ms, pan, pan_lr)
+        self.preds.append(preds)
+        self.ms.append(ms)
+        self.pan.append(pan)
+        if pan_lr is not None:
+            self.pan_lr.append(pan_lr)
+
+    def compute(self) -> Array:
+        """QNR over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        ms = dim_zero_cat(self.ms)
+        pan = dim_zero_cat(self.pan)
+        pan_lr = dim_zero_cat(self.pan_lr) if len(self.pan_lr) > 0 else None
+        d_lambda = _spectral_distortion_index_compute(preds, ms, self.norm_order, self.reduction)
+        d_s = _spatial_distortion_index_compute(
+            preds, ms, pan, pan_lr, self.norm_order, self.window_size, self.reduction
+        )
+        return (1 - d_lambda) ** self.alpha * (1 - d_s) ** self.beta

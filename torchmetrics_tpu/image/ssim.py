@@ -1,0 +1,221 @@
+"""SSIM / MS-SSIM metric modules.
+
+Parity: reference ``src/torchmetrics/image/ssim.py`` (SSIM ``:30-218``, MS-SSIM
+``:220-442``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.image.ssim import (
+    _multiscale_ssim_update,
+    _ssim_check_inputs,
+    _ssim_update,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+_VALID_REDUCTION = ("elementwise_mean", "sum", "none", None)
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    r"""Structural similarity index measure.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (3, 3, 64, 64))
+        >>> target = preds * 0.75
+        >>> ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> float(ssim(preds, target)) > 0.9
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if reduction not in _VALID_REDUCTION:
+            raise ValueError(f"Argument `reduction` must be one of {_VALID_REDUCTION}, but got {reduction}")
+
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        if return_contrast_sensitivity or return_full_image:
+            self.add_state("image_return", [], dist_reduce_fx="cat")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-image similarities (or their sum)."""
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity_pack = _ssim_update(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.return_full_image,
+            self.return_contrast_sensitivity,
+        )
+        if isinstance(similarity_pack, tuple):
+            similarity, image = similarity_pack
+        else:
+            similarity = similarity_pack
+
+        if self.return_contrast_sensitivity or self.return_full_image:
+            self.image_return.append(image)
+
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + similarity.sum()
+            self.total = self.total + preds.shape[0]
+        else:
+            self.similarity.append(similarity)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """SSIM over accumulated state."""
+        if self.reduction == "elementwise_mean":
+            similarity = self.similarity / self.total
+        elif self.reduction == "sum":
+            similarity = self.similarity
+        else:
+            similarity = dim_zero_cat(self.similarity)
+
+        if self.return_contrast_sensitivity or self.return_full_image:
+            return similarity, dim_zero_cat(self.image_return)
+        return similarity
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    r"""Multi-scale structural similarity index measure.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (3, 3, 256, 256))
+        >>> target = preds * 0.75
+        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> float(ms_ssim(preds, target)) > 0.9
+        True
+    """
+
+    higher_is_better = True
+    is_differentiable = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if reduction not in _VALID_REDUCTION:
+            raise ValueError(f"Argument `reduction` must be one of {_VALID_REDUCTION}, but got {reduction}")
+
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        if not isinstance(kernel_size, (Sequence, int)):
+            raise ValueError(
+                f"Argument `kernel_size` expected to be an sequence or an int, or a single int. Got {kernel_size}"
+            )
+        if isinstance(kernel_size, Sequence) and (
+            len(kernel_size) not in (2, 3) or not all(isinstance(ks, int) for ks in kernel_size)
+        ):
+            raise ValueError(
+                "Argument `kernel_size` expected to be an sequence of size 2 or 3 where each element is an int, "
+                f"or a single int. Got {kernel_size}"
+            )
+        if not isinstance(betas, tuple):
+            raise ValueError("Argument `betas` is expected to be of a type tuple.")
+        if not all(isinstance(beta, float) for beta in betas):
+            raise ValueError("Argument `betas` is expected to be a tuple of floats.")
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-image MS-SSIM (or its sum)."""
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity = _multiscale_ssim_update(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.betas,
+            self.normalize,
+        )
+        if self.reduction in ("none", None):
+            self.similarity.append(similarity)
+        else:
+            self.similarity = self.similarity + similarity.sum()
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        """MS-SSIM over accumulated state."""
+        if self.reduction in ("none", None):
+            return dim_zero_cat(self.similarity)
+        if self.reduction == "sum":
+            return self.similarity
+        return self.similarity / self.total
