@@ -1,0 +1,252 @@
+"""Text metric tests: differential vs the upstream reference + mesh sync for counter states.
+
+Analog of reference ``tests/unittests/text/``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+import torchmetrics.functional.text as ref_f  # noqa: E402
+
+import torchmetrics_tpu.functional.text as ours_f  # noqa: E402
+from torchmetrics_tpu.text import (  # noqa: E402
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+BATCH_1 = (
+    ["this is the prediction", "there is an other sample"],
+    ["this is the reference", "there is another one"],
+)
+BATCH_2 = (
+    ["hello world how are you", "the weather is cold"],
+    ["hello there how are you", "the weather was warm"],
+)
+
+CORPUS_PREDS = ["the cat is on the mat", "a dog walks in the park"]
+CORPUS_TARGET = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["a dog walks in the park at night"],
+]
+
+
+@pytest.mark.parametrize(
+    ("ours_cls", "ref_name"),
+    [
+        (WordErrorRate, "WordErrorRate"),
+        (CharErrorRate, "CharErrorRate"),
+        (MatchErrorRate, "MatchErrorRate"),
+        (WordInfoLost, "WordInfoLost"),
+        (WordInfoPreserved, "WordInfoPreserved"),
+    ],
+)
+def test_error_rate_modules(ours_cls, ref_name):
+    ref_cls = getattr(tm_ref.text, ref_name)
+    ours = ours_cls()
+    theirs = ref_cls()
+    for preds, target in (BATCH_1, BATCH_2):
+        batch_ours = ours(preds, target)
+        batch_theirs = theirs(preds, target)
+        _assert_allclose(batch_ours, batch_theirs.numpy(), atol=1e-5)
+    _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-5)
+
+
+def test_edit_distance_module():
+    ours = EditDistance()
+    theirs = tm_ref.text.EditDistance()
+    for preds, target in (BATCH_1, BATCH_2):
+        ours.update(preds, target)
+        theirs.update(preds, target)
+    _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_edit_distance_reductions(reduction):
+    res = ours_f.edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction=reduction)
+    ref = ref_f.edit_distance(["rain", "lnaguaeg"], ["shine", "language"], reduction=reduction)
+    _assert_allclose(res, ref.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("smooth", [False, True])
+@pytest.mark.parametrize("n_gram", [2, 4])
+def test_bleu(smooth, n_gram):
+    ours = BLEUScore(n_gram=n_gram, smooth=smooth)
+    theirs = tm_ref.text.BLEUScore(n_gram=n_gram, smooth=smooth)
+    ours.update(CORPUS_PREDS, CORPUS_TARGET)
+    theirs.update(CORPUS_PREDS, CORPUS_TARGET)
+    _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu(tokenize, lowercase):
+    ours = SacreBLEUScore(tokenize=tokenize, lowercase=lowercase)
+    theirs = tm_ref.text.SacreBLEUScore(tokenize=tokenize, lowercase=lowercase)
+    ours.update(CORPUS_PREDS, CORPUS_TARGET)
+    theirs.update(CORPUS_PREDS, CORPUS_TARGET)
+    _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("n_word_order", [0, 2])
+@pytest.mark.parametrize("whitespace", [False, True])
+def test_chrf(n_word_order, whitespace):
+    ours = CHRFScore(n_word_order=n_word_order, whitespace=whitespace)
+    theirs = tm_ref.text.CHRFScore(n_word_order=n_word_order, whitespace=whitespace)
+    ours.update(CORPUS_PREDS, CORPUS_TARGET)
+    theirs.update(CORPUS_PREDS, CORPUS_TARGET)
+    _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-5)
+
+
+def test_chrf_sentence_level():
+    ours = CHRFScore(return_sentence_level_score=True)
+    theirs = tm_ref.text.CHRFScore(return_sentence_level_score=True)
+    ours.update(CORPUS_PREDS, CORPUS_TARGET)
+    theirs.update(CORPUS_PREDS, CORPUS_TARGET)
+    o_corpus, o_sent = ours.compute()
+    r_corpus, r_sent = theirs.compute()
+    _assert_allclose(o_corpus, r_corpus.numpy(), atol=1e-5)
+    _assert_allclose(o_sent, r_sent.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+def test_ter(normalize):
+    ours = TranslationEditRate(normalize=normalize)
+    theirs = tm_ref.text.TranslationEditRate(normalize=normalize)
+    ours.update(CORPUS_PREDS, CORPUS_TARGET)
+    theirs.update(CORPUS_PREDS, CORPUS_TARGET)
+    _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-5)
+
+
+def test_eed():
+    ours = ExtendedEditDistance(return_sentence_level_score=True)
+    theirs = tm_ref.text.ExtendedEditDistance(return_sentence_level_score=True)
+    ours.update(BATCH_1[0], BATCH_1[1])
+    theirs.update(BATCH_1[0], BATCH_1[1])
+    o_avg, o_sent = ours.compute()
+    r_avg, r_sent = theirs.compute()
+    _assert_allclose(o_avg, r_avg.numpy(), atol=1e-5)
+    _assert_allclose(o_sent, r_sent.numpy(), atol=1e-5)
+
+
+def test_rouge():
+    keys = ("rouge1", "rouge2", "rougeL")
+    ours = ROUGEScore(rouge_keys=keys)
+    theirs = tm_ref.text.ROUGEScore(rouge_keys=keys)
+    preds = ["My name is John", "The cat sat on the mat"]
+    target = ["Is your name John", "The cat lay on the mat"]
+    ours.update(preds, target)
+    theirs.update(preds, target)
+    o = ours.compute()
+    r = theirs.compute()
+    for k in r:
+        _assert_allclose(o[k], r[k].numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+def test_rouge_multi_reference(accumulate):
+    keys = ("rouge1", "rougeL")
+    res = ours_f.rouge_score(
+        CORPUS_PREDS, CORPUS_TARGET, rouge_keys=keys, accumulate=accumulate
+    )
+    ref = ref_f.rouge_score(CORPUS_PREDS, CORPUS_TARGET, rouge_keys=keys, accumulate=accumulate)
+    for k in ref:
+        _assert_allclose(res[k], ref[k].numpy(), atol=1e-5)
+
+
+def test_squad():
+    preds = [{"prediction_text": "1976", "id": "1"}, {"prediction_text": "a test", "id": "2"}]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "1"},
+        {"answers": {"answer_start": [1], "text": ["this is a test", "another answer"]}, "id": "2"},
+    ]
+    ours = SQuAD()
+    theirs = tm_ref.text.SQuAD()
+    ours.update(preds, target)
+    theirs.update(preds, target)
+    o = ours.compute()
+    r = theirs.compute()
+    _assert_allclose(o["exact_match"], r["exact_match"].numpy(), atol=1e-5)
+    _assert_allclose(o["f1"], r["f1"].numpy(), atol=1e-5)
+
+
+class TestPerplexity:
+    @pytest.mark.parametrize("ignore_index", [None, 2])
+    def test_against_reference(self, ignore_index):
+        rng = np.random.RandomState(22)
+        preds = rng.rand(2, 2, 8, 5).astype(np.float32)
+        target = rng.randint(0, 5, (2, 2, 8))
+        ours = Perplexity(ignore_index=ignore_index)
+        theirs = tm_ref.text.Perplexity(ignore_index=ignore_index)
+        for i in range(2):
+            ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            theirs.update(torch.tensor(preds[i]), torch.tensor(target[i]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-3)
+
+    def test_mesh_distributed(self):
+        """Perplexity counter states sync with psum over the 8-device mesh."""
+        import jax
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.RandomState(0)
+        n_dev = len(jax.devices())
+        preds = rng.rand(n_dev * 2, 8, 5).astype(np.float32)
+        target = rng.randint(0, 5, (n_dev * 2, 8))
+
+        metric = Perplexity()
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+
+        def shard_step(state, p, t):
+            state = metric.pure_update(state, p, t)
+            synced = metric.sync_state(state, axis_name="data")
+            return metric.pure_compute(synced)
+
+        f = shard_map(
+            shard_step, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False
+        )
+        value = jax.jit(f)(metric.init_state(), jnp.asarray(preds), jnp.asarray(target))
+
+        eager = Perplexity()
+        eager.update(jnp.asarray(preds), jnp.asarray(target))
+        _assert_allclose(value, eager.compute(), atol=1e-4)
+
+    def test_raises_on_bad_shapes(self):
+        with pytest.raises(ValueError, match="expected to have 3 dimensions"):
+            ours_f.perplexity(jnp.zeros((2, 8)), jnp.zeros((2, 8), dtype=jnp.int32))
+
+
+def test_module_sum_states_merge_across_updates():
+    """Counter states keep accumulating across batches exactly like one big batch."""
+    ours_incremental = WordErrorRate()
+    for preds, target in (BATCH_1, BATCH_2):
+        ours_incremental.update(preds, target)
+    ours_single = WordErrorRate()
+    ours_single.update(BATCH_1[0] + BATCH_2[0], BATCH_1[1] + BATCH_2[1])
+    _assert_allclose(ours_incremental.compute(), ours_single.compute(), atol=1e-6)
+
+
+def test_wer_forward_matches_functional():
+    wer = WordErrorRate()
+    val = wer(BATCH_1[0], BATCH_1[1])
+    _assert_allclose(val, ours_f.word_error_rate(BATCH_1[0], BATCH_1[1]), atol=1e-6)

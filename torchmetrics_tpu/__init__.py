@@ -27,6 +27,8 @@ from torchmetrics_tpu.regression import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.regression import __all__ as _regression_all  # noqa: E402
 from torchmetrics_tpu.image import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.image import __all__ as _image_all  # noqa: E402
+from torchmetrics_tpu.text import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.text import __all__ as _text_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from torchmetrics_tpu.wrappers import (  # noqa: E402
@@ -62,4 +64,5 @@ __all__ = [
     *_classification_all,
     *_image_all,
     *_regression_all,
+    *_text_all,
 ]

@@ -1,18 +1,22 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import classification, image, regression
+from torchmetrics_tpu.functional import classification, image, regression, text
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = [
     "classification",
     "image",
     "regression",
+    "text",
     *_classification_all,
     *_image_all,
     *_regression_all,
+    *_text_all,
 ]

@@ -1,0 +1,37 @@
+"""Functional text metrics.
+
+Parity: reference ``src/torchmetrics/functional/text/__init__.py`` (BERTScore/InfoLM
+are model-based and ship with the Flax extractor stack).
+"""
+
+from torchmetrics_tpu.functional.text.bleu import bleu_score
+from torchmetrics_tpu.functional.text.cer import char_error_rate
+from torchmetrics_tpu.functional.text.chrf import chrf_score
+from torchmetrics_tpu.functional.text.edit import edit_distance
+from torchmetrics_tpu.functional.text.eed import extended_edit_distance
+from torchmetrics_tpu.functional.text.mer import match_error_rate
+from torchmetrics_tpu.functional.text.perplexity import perplexity
+from torchmetrics_tpu.functional.text.rouge import rouge_score
+from torchmetrics_tpu.functional.text.sacre_bleu import sacre_bleu_score
+from torchmetrics_tpu.functional.text.squad import squad
+from torchmetrics_tpu.functional.text.ter import translation_edit_rate
+from torchmetrics_tpu.functional.text.wer import word_error_rate
+from torchmetrics_tpu.functional.text.wil import word_information_lost
+from torchmetrics_tpu.functional.text.wip import word_information_preserved
+
+__all__ = [
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "edit_distance",
+    "extended_edit_distance",
+    "match_error_rate",
+    "perplexity",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

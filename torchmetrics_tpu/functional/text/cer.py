@@ -1,0 +1,51 @@
+"""Character error rate.
+
+Parity: reference ``src/torchmetrics/functional/text/cer.py:23-88``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _cer_update(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> Tuple[Array, Array]:
+    """Character-level edit operations and reference char count for the batch."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        errors += _edit_distance(list(pred), list(tgt))
+        total += len(tgt)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    """CER = errors / reference chars."""
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Compute the character error rate of transcriptions.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import char_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> char_error_rate(preds=preds, target=target).round(4)
+        Array(0.3415, dtype=float32)
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

@@ -1,0 +1,53 @@
+"""Match error rate.
+
+Parity: reference ``src/torchmetrics/functional/text/mer.py:23-91``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _mer_update(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> Tuple[Array, Array]:
+    """Edit operations and max(len(ref), len(pred)) word totals for the batch."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    """MER = errors / total."""
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Compute the match error rate of transcriptions.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import match_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> match_error_rate(preds=preds, target=target).round(4)
+        Array(0.4444, dtype=float32)
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

@@ -1,0 +1,53 @@
+"""Word error rate.
+
+Parity: reference ``src/torchmetrics/functional/text/wer.py:23-88``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance
+
+Array = jax.Array
+
+
+def _wer_update(
+    preds: Union[str, List[str]],
+    target: Union[str, List[str]],
+) -> Tuple[Array, Array]:
+    """Word-level edit operations and reference word count for the batch."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += len(tgt_tokens)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    """WER = errors / reference words."""
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Compute the word error rate of transcriptions.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import word_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_error_rate(preds=preds, target=target)
+        Array(0.5, dtype=float32)
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

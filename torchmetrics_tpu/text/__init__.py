@@ -1,0 +1,38 @@
+"""Text metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/text/__init__.py`` (BERTScore/InfoLM are
+model-based and ship with the Flax extractor stack).
+"""
+
+from torchmetrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
+from torchmetrics_tpu.text.chrf import CHRFScore
+from torchmetrics_tpu.text.eed import ExtendedEditDistance
+from torchmetrics_tpu.text.error_rates import (
+    CharErrorRate,
+    EditDistance,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+from torchmetrics_tpu.text.perplexity import Perplexity
+from torchmetrics_tpu.text.rouge import ROUGEScore
+from torchmetrics_tpu.text.squad import SQuAD
+from torchmetrics_tpu.text.ter import TranslationEditRate
+
+__all__ = [
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "EditDistance",
+    "ExtendedEditDistance",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

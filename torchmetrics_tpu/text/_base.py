@@ -1,0 +1,20 @@
+"""Shared base for string-input text metrics."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu.core.metric import Metric
+
+
+class _TextMetric(Metric):
+    """Metric whose update consumes python strings.
+
+    String tokenization cannot trace, so the jitted-update dispatch
+    (``core/metric.py:335``) is disabled; the accumulated *counter states* are still
+    device arrays and sync with mesh collectives like any other metric.
+    """
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)

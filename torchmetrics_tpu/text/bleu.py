@@ -1,0 +1,127 @@
+"""BLEU / SacreBLEU metric modules.
+
+Parity: reference ``src/torchmetrics/text/bleu.py:30-163`` and
+``src/torchmetrics/text/sacre_bleu.py:38-169``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from torchmetrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from torchmetrics_tpu.text._base import _TextMetric
+
+Array = jax.Array
+
+
+class BLEUScore(_TextMetric):
+    r"""BLEU score of machine-translated text against references.
+
+    Example:
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu = BLEUScore()
+        >>> bleu(preds, target).round(4)
+        Array(0.7598, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    preds_len: Array
+    target_len: Array
+    numerator: Array
+    denominator: Array
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+
+        self.add_state("preds_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    _tokenizer = staticmethod(_tokenize_fn)
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        """Accumulate clipped n-gram counts for the batch."""
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+
+        numerator = np.asarray(self.numerator).copy()
+        denominator = np.asarray(self.denominator).copy()
+        preds_len, target_len = _bleu_score_update(
+            preds_, target_, numerator, denominator, 0.0, 0.0, self.n_gram, self._tokenizer
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = jnp.asarray(numerator)
+        self.denominator = jnp.asarray(denominator)
+
+    def compute(self) -> Array:
+        """BLEU over accumulated corpus statistics."""
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.weights, self.smooth
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    r"""SacreBLEU score with the sacrebleu tokenizer family.
+
+    Example:
+        >>> from torchmetrics_tpu.text import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> sacre_bleu(preds, target).round(4)
+        Array(0.7598, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        self._tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        """Accumulate clipped n-gram counts with sacrebleu tokenization."""
+        numerator = np.asarray(self.numerator).copy()
+        denominator = np.asarray(self.denominator).copy()
+        preds_len, target_len = _bleu_score_update(
+            preds, target, numerator, denominator, 0.0, 0.0, self.n_gram, self._tokenizer
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = jnp.asarray(numerator)
+        self.denominator = jnp.asarray(denominator)

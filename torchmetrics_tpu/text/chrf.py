@@ -1,0 +1,123 @@
+"""CHRFScore module.
+
+Parity: reference ``src/torchmetrics/text/chrf.py:38-228``; the reference's 6×N scalar
+states collapse into six fixed-shape per-order vectors (psum-able over the mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from torchmetrics_tpu.text._base import _TextMetric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(_TextMetric):
+    r"""chrF/chrF++ score of machine-translated text against references.
+
+    Example:
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> chrf = CHRFScore()
+        >>> chrf(preds, target).round(4)
+        Array(0.8640, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        self.n_char_order = n_char_order
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        self.n_word_order = n_word_order
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+
+        for prefix in ("total_preds", "total_target", "total_matching"):
+            self.add_state(f"{prefix}_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+            self.add_state(f"{prefix}_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        """Accumulate the six per-order n-gram total vectors."""
+        import numpy as np
+
+        sentence_scores: Optional[List[float]] = [] if self.return_sentence_level_score else None
+        (
+            total_preds_char,
+            total_preds_word,
+            total_target_char,
+            total_target_word,
+            total_matching_char,
+            total_matching_word,
+            sentence_scores,
+        ) = _chrf_score_update(
+            preds,
+            target,
+            np.asarray(self.total_preds_char_n_grams, dtype=np.float64),
+            np.asarray(self.total_preds_word_n_grams, dtype=np.float64),
+            np.asarray(self.total_target_char_n_grams, dtype=np.float64),
+            np.asarray(self.total_target_word_n_grams, dtype=np.float64),
+            np.asarray(self.total_matching_char_n_grams, dtype=np.float64),
+            np.asarray(self.total_matching_word_n_grams, dtype=np.float64),
+            self.n_char_order,
+            self.n_word_order,
+            self.n_order,
+            self.beta,
+            self.lowercase,
+            self.whitespace,
+            sentence_scores,
+        )
+        self.total_preds_char_n_grams = jnp.asarray(total_preds_char, dtype=jnp.float32)
+        self.total_preds_word_n_grams = jnp.asarray(total_preds_word, dtype=jnp.float32)
+        self.total_target_char_n_grams = jnp.asarray(total_target_char, dtype=jnp.float32)
+        self.total_target_word_n_grams = jnp.asarray(total_target_word, dtype=jnp.float32)
+        self.total_matching_char_n_grams = jnp.asarray(total_matching_char, dtype=jnp.float32)
+        self.total_matching_word_n_grams = jnp.asarray(total_matching_word, dtype=jnp.float32)
+        if sentence_scores is not None:
+            self.sentence_chrf_score.append(jnp.asarray(sentence_scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Corpus chrF over accumulated state."""
+        chrf = _chrf_score_compute(
+            self.total_preds_char_n_grams,
+            self.total_preds_word_n_grams,
+            self.total_target_char_n_grams,
+            self.total_target_word_n_grams,
+            self.total_matching_char_n_grams,
+            self.total_matching_word_n_grams,
+            self.n_order,
+            self.beta,
+        )
+        if self.return_sentence_level_score:
+            return chrf, dim_zero_cat(self.sentence_chrf_score)
+        return chrf

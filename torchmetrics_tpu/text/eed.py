@@ -1,0 +1,80 @@
+"""ExtendedEditDistance module.
+
+Parity: reference ``src/torchmetrics/text/eed.py:28-164``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from torchmetrics_tpu.text._base import _TextMetric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ExtendedEditDistance(_TextMetric):
+    r"""Extended edit distance of machine-translated text against references.
+
+    Example:
+        >>> from torchmetrics_tpu.text import ExtendedEditDistance
+        >>> preds = ["this is the prediction", "here is an other sample"]
+        >>> target = ["this is the reference", "here is another one"]
+        >>> eed = ExtendedEditDistance()
+        >>> eed(preds=preds, target=target).round(4)
+        Array(0.3078, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param_name, param in zip(["alpha", "rho", "deletion", "insertion"], [alpha, rho, deletion, insertion]):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Sequence[Union[str, Sequence[str]]],
+    ) -> None:
+        """Accumulate per-sentence EED scores."""
+        scores = _eed_update(
+            preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion
+        )
+        self.sentence_eed.append(jnp.asarray(scores, dtype=jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Mean EED over accumulated sentences."""
+        all_scores = dim_zero_cat(self.sentence_eed)
+        average = all_scores.mean() if all_scores.size else jnp.asarray(0.0)
+        if self.return_sentence_level_score:
+            return average, all_scores
+        return average

@@ -1,0 +1,60 @@
+"""Perplexity module.
+
+Parity: reference ``src/torchmetrics/text/perplexity.py:27-124``. Fully jittable
+(tensor inputs), unlike its string-input siblings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+
+Array = jax.Array
+
+
+class Perplexity(Metric):
+    r"""Perplexity of a language model's predictions.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.text import Perplexity
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> perp = Perplexity(ignore_index=-100)
+        >>> float(perp(preds, target)) > 1
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    total_log_probs: Array
+    count: Array
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate token NLL sums and valid-token counts."""
+        total_log_probs, count = _perplexity_update(preds, target, self.ignore_index)
+        self.total_log_probs = self.total_log_probs + total_log_probs
+        self.count = self.count + count
+
+    def compute(self) -> Array:
+        """Perplexity over accumulated state."""
+        return _perplexity_compute(self.total_log_probs, self.count)
+
+    def _compute_group_params(self):
+        return (self.ignore_index,)
