@@ -1,0 +1,302 @@
+"""Clustering (vs sklearn), nominal (vs reference), segmentation (vs reference),
+pairwise (vs sklearn) differential tests, plus module lifecycle + mesh checks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+from sklearn import metrics as skm
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+
+import torchmetrics_tpu.functional.clustering as ours_cl  # noqa: E402
+import torchmetrics_tpu.functional.nominal as ours_nom  # noqa: E402
+import torchmetrics_tpu.functional.pairwise as ours_pw  # noqa: E402
+from torchmetrics_tpu.clustering import (  # noqa: E402
+    AdjustedMutualInfoScore,
+    AdjustedRandScore,
+    CalinskiHarabaszScore,
+    CompletenessScore,
+    DaviesBouldinScore,
+    DunnIndex,
+    FowlkesMallowsIndex,
+    HomogeneityScore,
+    MutualInfoScore,
+    NormalizedMutualInfoScore,
+    RandScore,
+    VMeasureScore,
+)
+from torchmetrics_tpu.functional.segmentation import generalized_dice_score, mean_iou  # noqa: E402
+from torchmetrics_tpu.nominal import (  # noqa: E402
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+from torchmetrics_tpu.segmentation import GeneralizedDiceScore, MeanIoU  # noqa: E402
+
+rng = np.random.RandomState(42)
+PREDS_LABELS = rng.randint(0, 5, 100)
+TARGET_LABELS = rng.randint(0, 4, 100)
+
+
+class TestClusteringFunctional:
+    @pytest.mark.parametrize(
+        ("ours_fn", "sk_fn"),
+        [
+            (ours_cl.mutual_info_score, skm.mutual_info_score),
+            (ours_cl.normalized_mutual_info_score, skm.normalized_mutual_info_score),
+            (ours_cl.adjusted_mutual_info_score, skm.adjusted_mutual_info_score),
+            (ours_cl.rand_score, skm.rand_score),
+            (ours_cl.adjusted_rand_score, skm.adjusted_rand_score),
+            (ours_cl.fowlkes_mallows_index, skm.fowlkes_mallows_score),
+            (ours_cl.homogeneity_score, skm.homogeneity_score),
+            (ours_cl.completeness_score, skm.completeness_score),
+            (ours_cl.v_measure_score, skm.v_measure_score),
+        ],
+    )
+    def test_against_sklearn(self, ours_fn, sk_fn):
+        res = ours_fn(jnp.asarray(PREDS_LABELS), jnp.asarray(TARGET_LABELS))
+        ref = sk_fn(TARGET_LABELS, PREDS_LABELS)
+        _assert_allclose(res, ref, atol=1e-4)
+
+    def test_intrinsic_against_sklearn(self):
+        data = rng.rand(50, 3).astype(np.float32)
+        labels = rng.randint(0, 3, 50)
+        _assert_allclose(
+            ours_cl.calinski_harabasz_score(jnp.asarray(data), jnp.asarray(labels)),
+            skm.calinski_harabasz_score(data, labels),
+            atol=1e-2,
+        )
+        _assert_allclose(
+            ours_cl.davies_bouldin_score(jnp.asarray(data), jnp.asarray(labels)),
+            skm.davies_bouldin_score(data, labels),
+            atol=1e-3,
+        )
+
+    def test_dunn_index(self):
+        data = jnp.array([[0.0, 0.0], [0.5, 0.0], [1.0, 0.0], [0.5, 1.0]])
+        labels = jnp.array([0, 0, 0, 1])
+        _assert_allclose(ours_cl.dunn_index(data, labels), 2.0, atol=1e-5)
+
+    def test_raises_on_float_labels(self):
+        with pytest.raises(ValueError, match="Expected real, discrete values"):
+            ours_cl.mutual_info_score(jnp.array([0.5, 1.0]), jnp.array([1, 0]))
+
+
+class TestClusteringModules:
+    @pytest.mark.parametrize(
+        ("ours_cls", "sk_fn", "kwargs"),
+        [
+            (MutualInfoScore, skm.mutual_info_score, {}),
+            (NormalizedMutualInfoScore, skm.normalized_mutual_info_score, {}),
+            (AdjustedMutualInfoScore, skm.adjusted_mutual_info_score, {}),
+            (RandScore, skm.rand_score, {}),
+            (AdjustedRandScore, skm.adjusted_rand_score, {}),
+            (FowlkesMallowsIndex, skm.fowlkes_mallows_score, {}),
+            (HomogeneityScore, skm.homogeneity_score, {}),
+            (CompletenessScore, skm.completeness_score, {}),
+            (VMeasureScore, skm.v_measure_score, {}),
+        ],
+    )
+    def test_accumulation_matches_sklearn(self, ours_cls, sk_fn, kwargs):
+        metric = ours_cls(**kwargs)
+        for i in range(0, 100, 25):
+            metric.update(jnp.asarray(PREDS_LABELS[i : i + 25]), jnp.asarray(TARGET_LABELS[i : i + 25]))
+        _assert_allclose(metric.compute(), sk_fn(TARGET_LABELS, PREDS_LABELS), atol=1e-4)
+        metric.reset()
+        assert metric.update_count == 0
+
+    def test_intrinsic_modules(self):
+        data = rng.rand(60, 3).astype(np.float32)
+        labels = rng.randint(0, 3, 60)
+        for cls, sk_fn, atol in (
+            (CalinskiHarabaszScore, skm.calinski_harabasz_score, 1e-2),
+            (DaviesBouldinScore, skm.davies_bouldin_score, 1e-3),
+        ):
+            metric = cls()
+            for i in range(0, 60, 20):
+                metric.update(jnp.asarray(data[i : i + 20]), jnp.asarray(labels[i : i + 20]))
+            _assert_allclose(metric.compute(), sk_fn(data, labels), atol=atol)
+
+    def test_dunn_module(self):
+        metric = DunnIndex(p=2)
+        metric.update(jnp.array([[0.0, 0.0], [0.5, 0.0]]), jnp.array([0, 0]))
+        metric.update(jnp.array([[1.0, 0.0], [0.5, 1.0]]), jnp.array([0, 1]))
+        _assert_allclose(metric.compute(), 2.0, atol=1e-5)
+
+
+NOM_PREDS = rng.randint(0, 4, 100)
+NOM_TARGET = (NOM_PREDS + rng.randint(0, 2, 100)) % 4
+
+
+class TestNominal:
+    @pytest.mark.parametrize(
+        ("ours_fn", "ref_name"),
+        [
+            (ours_nom.cramers_v, "cramers_v"),
+            (ours_nom.pearsons_contingency_coefficient, "pearsons_contingency_coefficient"),
+            (ours_nom.tschuprows_t, "tschuprows_t"),
+            (ours_nom.theils_u, "theils_u"),
+        ],
+    )
+    def test_functional_against_reference(self, ours_fn, ref_name):
+        import torchmetrics.functional.nominal as ref_nom
+
+        res = ours_fn(jnp.asarray(NOM_PREDS), jnp.asarray(NOM_TARGET))
+        ref = getattr(ref_nom, ref_name)(torch.tensor(NOM_PREDS), torch.tensor(NOM_TARGET))
+        _assert_allclose(res, ref.numpy(), atol=1e-4)
+
+    @pytest.mark.parametrize(
+        ("ours_cls", "ref_name"),
+        [
+            (CramersV, "CramersV"),
+            (PearsonsContingencyCoefficient, "PearsonsContingencyCoefficient"),
+            (TschuprowsT, "TschuprowsT"),
+            (TheilsU, "TheilsU"),
+        ],
+    )
+    def test_modules_against_reference(self, ours_cls, ref_name):
+        ref_cls = getattr(tm_ref.nominal, ref_name)
+        ours = ours_cls(num_classes=4)
+        theirs = ref_cls(num_classes=4)
+        for i in range(0, 100, 50):
+            ours.update(jnp.asarray(NOM_PREDS[i : i + 50]), jnp.asarray(NOM_TARGET[i : i + 50]))
+            theirs.update(torch.tensor(NOM_PREDS[i : i + 50]), torch.tensor(NOM_TARGET[i : i + 50]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+    @pytest.mark.parametrize("mode", ["counts", "probs"])
+    def test_fleiss_kappa(self, mode):
+        import torchmetrics.functional.nominal as ref_nom
+
+        if mode == "counts":
+            ratings = rng.randint(0, 10, (10, 5))
+        else:
+            ratings = rng.rand(10, 4, 5).astype(np.float32)
+        ours = FleissKappa(mode=mode)
+        ours.update(jnp.asarray(ratings))
+        ref = ref_nom.fleiss_kappa(torch.tensor(ratings), mode=mode)
+        _assert_allclose(ours.compute(), ref.numpy(), atol=1e-4)
+
+    def test_nan_handling(self):
+        p = jnp.array([0.0, 1.0, jnp.nan, 2.0])
+        t = jnp.array([0.0, 1.0, 1.0, 2.0])
+        val_replace = ours_nom.cramers_v(p, t, nan_strategy="replace", nan_replace_value=0.0)
+        val_drop = ours_nom.cramers_v(p, t, nan_strategy="drop", bias_correction=False)
+        assert not np.isnan(float(val_replace))
+        assert not np.isnan(float(val_drop))
+        # bias correction degenerates on this tiny table and yields NaN, like the reference
+        assert np.isnan(float(ours_nom.cramers_v(p, t, nan_strategy="drop")))
+
+
+SEG_PREDS = rng.randint(0, 2, (4, 5, 16, 16))
+SEG_TARGET = rng.randint(0, 2, (4, 5, 16, 16))
+
+
+class TestSegmentation:
+    @pytest.mark.parametrize("per_class", [False, True])
+    @pytest.mark.parametrize("include_background", [True, False])
+    def test_mean_iou_functional(self, per_class, include_background):
+        from torchmetrics.functional.segmentation import mean_iou as ref_miou
+
+        res = mean_iou(
+            jnp.asarray(SEG_PREDS), jnp.asarray(SEG_TARGET), num_classes=5,
+            include_background=include_background, per_class=per_class,
+        )
+        ref = ref_miou(
+            torch.tensor(SEG_PREDS), torch.tensor(SEG_TARGET), num_classes=5,
+            include_background=include_background, per_class=per_class,
+        )
+        _assert_allclose(res, ref.numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("weight_type", ["square", "simple", "linear"])
+    def test_generalized_dice_functional(self, weight_type):
+        from torchmetrics.functional.segmentation import generalized_dice_score as ref_gds
+
+        res = generalized_dice_score(
+            jnp.asarray(SEG_PREDS), jnp.asarray(SEG_TARGET), num_classes=5, weight_type=weight_type
+        )
+        ref = ref_gds(
+            torch.tensor(SEG_PREDS), torch.tensor(SEG_TARGET), num_classes=5, weight_type=weight_type
+        )
+        _assert_allclose(res, ref.numpy(), atol=1e-4)
+
+    def test_modules_match_reference(self):
+        ours_g = GeneralizedDiceScore(num_classes=5)
+        import torchmetrics.segmentation as ref_seg
+
+        theirs_g = ref_seg.GeneralizedDiceScore(num_classes=5)
+        ours_m = MeanIoU(num_classes=5)
+        theirs_m = ref_seg.MeanIoU(num_classes=5)
+        for i in range(0, 4, 2):
+            p, t = SEG_PREDS[i : i + 2], SEG_TARGET[i : i + 2]
+            ours_g.update(jnp.asarray(p), jnp.asarray(t))
+            theirs_g.update(torch.tensor(p), torch.tensor(t))
+            ours_m.update(jnp.asarray(p), jnp.asarray(t))
+            theirs_m.update(torch.tensor(p), torch.tensor(t))
+        _assert_allclose(ours_g.compute(), theirs_g.compute().numpy(), atol=1e-4)
+        _assert_allclose(ours_m.compute(), theirs_m.compute().numpy(), atol=1e-4)
+
+    def test_index_format(self):
+        pi = rng.randint(0, 5, (4, 16, 16))
+        ti = rng.randint(0, 5, (4, 16, 16))
+        from torchmetrics.functional.segmentation import mean_iou as ref_miou
+
+        res = mean_iou(jnp.asarray(pi), jnp.asarray(ti), num_classes=5, input_format="index")
+        ref = ref_miou(torch.tensor(pi), torch.tensor(ti), num_classes=5, input_format="index")
+        _assert_allclose(res, ref.numpy(), atol=1e-5)
+
+    def test_mean_iou_jit(self):
+        import jax
+
+        f = jax.jit(lambda p, t: mean_iou(p, t, num_classes=5))
+        res = f(jnp.asarray(SEG_PREDS), jnp.asarray(SEG_TARGET))
+        eager = mean_iou(jnp.asarray(SEG_PREDS), jnp.asarray(SEG_TARGET), num_classes=5)
+        _assert_allclose(res, eager, atol=1e-6)
+
+
+class TestPairwise:
+    X = rng.rand(6, 4).astype(np.float32)
+    Y = rng.rand(5, 4).astype(np.float32)
+
+    @pytest.mark.parametrize(
+        ("ours_fn", "sk_fn"),
+        [
+            (ours_pw.pairwise_cosine_similarity, skm.pairwise.cosine_similarity),
+            (ours_pw.pairwise_euclidean_distance, skm.pairwise.euclidean_distances),
+            (ours_pw.pairwise_linear_similarity, skm.pairwise.linear_kernel),
+            (ours_pw.pairwise_manhattan_distance, skm.pairwise.manhattan_distances),
+        ],
+    )
+    def test_against_sklearn(self, ours_fn, sk_fn):
+        res = ours_fn(jnp.asarray(self.X), jnp.asarray(self.Y))
+        ref = sk_fn(self.X, self.Y)
+        _assert_allclose(res, ref, atol=1e-4)
+
+    def test_minkowski(self):
+        from scipy.spatial.distance import cdist
+
+        res = ours_pw.pairwise_minkowski_distance(jnp.asarray(self.X), jnp.asarray(self.Y), exponent=3)
+        ref = cdist(self.X, self.Y, metric="minkowski", p=3)
+        _assert_allclose(res, ref, atol=1e-4)
+
+    def test_self_zero_diagonal(self):
+        res = np.asarray(ours_pw.pairwise_euclidean_distance(jnp.asarray(self.X)))
+        assert np.allclose(np.diag(res), 0.0)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+    def test_reductions(self, reduction):
+        res = ours_pw.pairwise_cosine_similarity(
+            jnp.asarray(self.X), jnp.asarray(self.Y), reduction=reduction
+        )
+        full = np.asarray(ours_pw.pairwise_cosine_similarity(jnp.asarray(self.X), jnp.asarray(self.Y)))
+        expected = {"mean": full.mean(-1), "sum": full.sum(-1), "none": full}[reduction]
+        _assert_allclose(res, expected, atol=1e-6)

@@ -29,6 +29,12 @@ from torchmetrics_tpu.image import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.image import __all__ as _image_all  # noqa: E402
 from torchmetrics_tpu.text import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.text import __all__ as _text_all  # noqa: E402
+from torchmetrics_tpu.clustering import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.clustering import __all__ as _clustering_all  # noqa: E402
+from torchmetrics_tpu.nominal import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.nominal import __all__ as _nominal_all  # noqa: E402
+from torchmetrics_tpu.segmentation import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.segmentation import __all__ as _segmentation_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from torchmetrics_tpu.wrappers import (  # noqa: E402
@@ -65,4 +71,7 @@ __all__ = [
     *_image_all,
     *_regression_all,
     *_text_all,
+    *_clustering_all,
+    *_nominal_all,
+    *_segmentation_all,
 ]

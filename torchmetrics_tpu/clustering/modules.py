@@ -1,0 +1,303 @@
+"""Clustering metric modules.
+
+Parity: reference ``src/torchmetrics/clustering/*.py`` — every class stores label (or
+data) "cat" states and evaluates its functional at compute time, exactly like the
+reference (contingency matrices need the full epoch's label sets).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from torchmetrics_tpu.functional.clustering.utils import _validate_average_method_arg, check_cluster_labels
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _LabelPairClusteringMetric(Metric):
+    """Base for metrics over (predicted labels, target labels) pairs."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Store the batch's cluster labels."""
+        check_cluster_labels(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+
+class _IntrinsicClusteringMetric(Metric):
+    """Base for metrics over (embedded data, cluster labels) pairs."""
+
+    is_differentiable = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", [], dist_reduce_fx="cat")
+        self.add_state("labels", [], dist_reduce_fx="cat")
+
+    def update(self, data: Array, labels: Array) -> None:
+        """Store the batch's embeddings and labels."""
+        self.data.append(data)
+        self.labels.append(labels)
+
+
+class MutualInfoScore(_LabelPairClusteringMetric):
+    r"""Mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import MutualInfoScore
+        >>> mi = MutualInfoScore()
+        >>> mi(jnp.array([1, 3, 2, 0, 1]), jnp.array([0, 3, 2, 2, 1])).round(4)
+        Array(1.0549, dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """MI over all accumulated labels."""
+        return mutual_info_score(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class AdjustedMutualInfoScore(_LabelPairClusteringMetric):
+    r"""Adjusted mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import AdjustedMutualInfoScore
+        >>> ami = AdjustedMutualInfoScore(average_method="arithmetic")
+        >>> ami(jnp.array([2, 1, 0, 1, 0]), jnp.array([0, 2, 1, 1, 0])).round(4)
+        Array(-0.25, dtype=float32)
+    """
+
+    plot_lower_bound: float = -1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def compute(self) -> Array:
+        """AMI over all accumulated labels."""
+        return adjusted_mutual_info_score(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.average_method
+        )
+
+
+class NormalizedMutualInfoScore(_LabelPairClusteringMetric):
+    r"""Normalized mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import NormalizedMutualInfoScore
+        >>> nmi = NormalizedMutualInfoScore("arithmetic")
+        >>> nmi(jnp.array([1, 3, 2, 0, 1]), jnp.array([0, 3, 2, 2, 1])).round(4)
+        Array(0.7919, dtype=float32)
+    """
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def compute(self) -> Array:
+        """NMI over all accumulated labels."""
+        return normalized_mutual_info_score(
+            dim_zero_cat(self.preds), dim_zero_cat(self.target), self.average_method
+        )
+
+
+class RandScore(_LabelPairClusteringMetric):
+    r"""Rand score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import RandScore
+        >>> metric = RandScore()
+        >>> metric(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1])).round(4)
+        Array(0.8333, dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """Rand score over all accumulated labels."""
+        return rand_score(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class AdjustedRandScore(_LabelPairClusteringMetric):
+    r"""Adjusted Rand score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import AdjustedRandScore
+        >>> metric = AdjustedRandScore()
+        >>> metric(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1])).round(4)
+        Array(0.5714, dtype=float32)
+    """
+
+    plot_lower_bound: float = -1.0
+
+    def compute(self) -> Array:
+        """ARI over all accumulated labels."""
+        return adjusted_rand_score(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class FowlkesMallowsIndex(_LabelPairClusteringMetric):
+    r"""Fowlkes-Mallows index between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import FowlkesMallowsIndex
+        >>> fmi = FowlkesMallowsIndex()
+        >>> fmi(jnp.array([2, 2, 0, 1, 0]), jnp.array([2, 2, 1, 1, 0])).round(4)
+        Array(0.5, dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """FMI over all accumulated labels."""
+        return fowlkes_mallows_index(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class HomogeneityScore(_LabelPairClusteringMetric):
+    r"""Homogeneity score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import HomogeneityScore
+        >>> metric = HomogeneityScore()
+        >>> metric(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1]))
+        Array(1., dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """Homogeneity over all accumulated labels."""
+        return homogeneity_score(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class CompletenessScore(_LabelPairClusteringMetric):
+    r"""Completeness score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import CompletenessScore
+        >>> metric = CompletenessScore()
+        >>> metric(jnp.array([0, 0, 1, 1]), jnp.array([1, 1, 0, 0]))
+        Array(1., dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        """Completeness over all accumulated labels."""
+        return completeness_score(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class VMeasureScore(_LabelPairClusteringMetric):
+    r"""V-measure score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import VMeasureScore
+        >>> metric = VMeasureScore(beta=1.0)
+        >>> metric(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1])).round(4)
+        Array(0.8, dtype=float32)
+    """
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = beta
+
+    def compute(self) -> Array:
+        """V-measure over all accumulated labels."""
+        return v_measure_score(dim_zero_cat(self.preds), dim_zero_cat(self.target), self.beta)
+
+
+class CalinskiHarabaszScore(_IntrinsicClusteringMetric):
+    r"""Calinski-Harabasz score for intrinsic cluster evaluation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.clustering import CalinskiHarabaszScore
+        >>> data = jax.random.normal(jax.random.PRNGKey(42), (10, 3))
+        >>> labels = jax.random.randint(jax.random.PRNGKey(0), (10,), 0, 2)
+        >>> chs = CalinskiHarabaszScore()
+        >>> float(chs(data, labels)) > 0
+        True
+    """
+
+    higher_is_better = True
+
+    def compute(self) -> Array:
+        """CH score over all accumulated data."""
+        return calinski_harabasz_score(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class DaviesBouldinScore(_IntrinsicClusteringMetric):
+    r"""Davies-Bouldin score for intrinsic cluster evaluation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.clustering import DaviesBouldinScore
+        >>> data = jax.random.normal(jax.random.PRNGKey(42), (10, 3))
+        >>> labels = jax.random.randint(jax.random.PRNGKey(0), (10,), 0, 2)
+        >>> dbs = DaviesBouldinScore()
+        >>> float(dbs(data, labels)) > 0
+        True
+    """
+
+    higher_is_better = False
+
+    def compute(self) -> Array:
+        """DB score over all accumulated data."""
+        return davies_bouldin_score(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class DunnIndex(_IntrinsicClusteringMetric):
+    r"""Dunn index for intrinsic cluster evaluation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import DunnIndex
+        >>> data = jnp.array([[0., 0.], [0.5, 0.], [1., 0.], [0.5, 1.]])
+        >>> labels = jnp.array([0, 0, 0, 1])
+        >>> dunn = DunnIndex(p=2)
+        >>> dunn(data, labels)
+        Array(2., dtype=float32)
+    """
+
+    higher_is_better = True
+
+    def __init__(self, p: float = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def compute(self) -> Array:
+        """Dunn index over all accumulated data."""
+        return dunn_index(dim_zero_cat(self.data), dim_zero_cat(self.labels), self.p)

@@ -1,10 +1,18 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import classification, image, regression, text
+from torchmetrics_tpu.functional import classification, clustering, image, nominal, pairwise, regression, segmentation, text
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
+from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
+from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.pairwise import __all__ as _pairwise_all
+from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.segmentation import __all__ as _segmentation_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
@@ -12,11 +20,19 @@ from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = [
     "classification",
+    "clustering",
+    "nominal",
     "image",
+    "pairwise",
     "regression",
+    "segmentation",
     "text",
     *_classification_all,
+    *_clustering_all,
+    *_nominal_all,
     *_image_all,
+    *_pairwise_all,
     *_regression_all,
+    *_segmentation_all,
     *_text_all,
 ]

@@ -1,0 +1,300 @@
+"""Extrinsic (label-vs-label) clustering metrics.
+
+Parity: reference ``src/torchmetrics/functional/clustering/{mutual_info_score,
+normalized_mutual_info_score,adjusted_mutual_info_score,rand_score,
+adjusted_rand_score,fowlkes_mallows_index,homogeneity_completeness_v_measure}.py``.
+All reduce through the contingency matrix built at compute time.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _validate_average_method_arg,
+    calculate_contingency_matrix,
+    calculate_entropy,
+    calculate_generalized_mean,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def _mutual_info_score_update(preds: Array, target: Array) -> np.ndarray:
+    """Contingency matrix for an MI-family metric."""
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target)
+
+
+def _mutual_info_score_compute(contingency: np.ndarray) -> Array:
+    """MI from the nonzero contingency entries."""
+    n = contingency.sum()
+    u = contingency.sum(axis=1)
+    v = contingency.sum(axis=0)
+    if u.size == 1 or v.size == 1:
+        return jnp.asarray(0.0)
+
+    nzu, nzv = np.nonzero(contingency)
+    vals = contingency[nzu, nzv]
+    log_outer = np.log(u[nzu]) + np.log(v[nzv])
+    mutual_info = vals / n * (np.log(n) + np.log(vals) - log_outer)
+    return jnp.asarray(mutual_info.sum(), dtype=jnp.float32)
+
+
+def mutual_info_score(preds: Array, target: Array) -> Array:
+    """Compute mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import mutual_info_score
+        >>> target = jnp.array([0, 3, 2, 2, 1])
+        >>> preds = jnp.array([1, 3, 2, 0, 1])
+        >>> mutual_info_score(preds, target).round(4)
+        Array(1.0549, dtype=float32)
+    """
+    return _mutual_info_score_compute(_mutual_info_score_update(preds, target))
+
+
+def normalized_mutual_info_score(
+    preds: Array, target: Array, average_method: str = "arithmetic"
+) -> Array:
+    """Compute normalized mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import normalized_mutual_info_score
+        >>> target = jnp.array([0, 3, 2, 2, 1])
+        >>> preds = jnp.array([1, 3, 2, 0, 1])
+        >>> normalized_mutual_info_score(preds, target, "arithmetic").round(4)
+        Array(0.7919, dtype=float32)
+    """
+    check_cluster_labels(preds, target)
+    _validate_average_method_arg(average_method)
+    mutual_info = mutual_info_score(preds, target)
+    if abs(float(mutual_info)) < np.finfo(np.float32).eps:
+        return mutual_info
+    normalizer = calculate_generalized_mean(
+        jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
+    )
+    return mutual_info / normalizer
+
+
+def expected_mutual_info_score(contingency: np.ndarray, n_samples: int) -> Array:
+    """Expected MI under the permutation model (hypergeometric sum, vectorized per cell)."""
+    a = contingency.sum(axis=1).astype(np.int64)
+    b = contingency.sum(axis=0).astype(np.int64)
+    if a.size == 1 or b.size == 1:
+        return jnp.asarray(0.0)
+
+    max_nij = int(max(a.max(), b.max())) + 1
+    nijs = np.arange(max_nij, dtype=np.float64)
+    nijs[0] = 1.0
+
+    try:  # scipy is optional (not in the base deps); its f64 gammaln is preferred
+        from scipy.special import gammaln
+    except ModuleNotFoundError:
+        from jax.scipy.special import gammaln as _gammaln
+
+        def gammaln(x):
+            return np.asarray(_gammaln(jnp.asarray(x, dtype=jnp.float32)))
+
+    term1 = nijs / n_samples
+    log_a = np.log(a)
+    log_b = np.log(b)
+    log_nnij = np.log(n_samples) + np.log(nijs)
+
+    gln_a = gammaln(a + 1)
+    gln_b = gammaln(b + 1)
+    gln_na = gammaln(n_samples - a + 1)
+    gln_nb = gammaln(n_samples - b + 1)
+    gln_nnij = gammaln(nijs + 1) + gammaln(n_samples + 1)
+
+    emi = 0.0
+    for i in range(a.size):
+        for j in range(b.size):
+            start = int(max(1, a[i] - n_samples + b[j]))
+            end = int(min(a[i], b[j]) + 1)
+            if end <= start:
+                continue
+            nij = np.arange(start, end)
+            term2 = log_nnij[nij] - log_a[i] - log_b[j]
+            gln = (
+                gln_a[i]
+                + gln_b[j]
+                + gln_na[i]
+                + gln_nb[j]
+                - gln_nnij[nij]
+                - gammaln(a[i] - nij + 1)
+                - gammaln(b[j] - nij + 1)
+                - gammaln(n_samples - a[i] - b[j] + nij + 1)
+            )
+            emi += float((term1[nij] * term2 * np.exp(gln)).sum())
+    return jnp.asarray(emi, dtype=jnp.float32)
+
+
+def adjusted_mutual_info_score(
+    preds: Array, target: Array, average_method: str = "arithmetic"
+) -> Array:
+    """Compute adjusted mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import adjusted_mutual_info_score
+        >>> preds = jnp.array([2, 1, 0, 1, 0])
+        >>> target = jnp.array([0, 2, 1, 1, 0])
+        >>> adjusted_mutual_info_score(preds, target, "arithmetic").round(4)
+        Array(-0.25, dtype=float32)
+    """
+    _validate_average_method_arg(average_method)
+    contingency = _mutual_info_score_update(preds, target)
+    mutual_info = _mutual_info_score_compute(contingency)
+    expected_mi = expected_mutual_info_score(contingency, int(np.asarray(target).size))
+    normalizer = calculate_generalized_mean(
+        jnp.stack([calculate_entropy(preds), calculate_entropy(target)]), average_method
+    )
+    denominator = float(normalizer - expected_mi)
+    eps = float(np.finfo(np.float32).eps)
+    if denominator < 0:
+        denominator = min(denominator, -eps)
+    else:
+        denominator = max(denominator, eps)
+    return (mutual_info - expected_mi) / denominator
+
+
+def _rand_score_compute(contingency: np.ndarray) -> Array:
+    """Rand index from the pair confusion matrix."""
+    pair_matrix = calculate_pair_cluster_confusion_matrix(contingency=contingency)
+    numerator = pair_matrix.diagonal().sum()
+    denominator = pair_matrix.sum()
+    if numerator == denominator or denominator == 0:
+        return jnp.asarray(1.0)
+    return jnp.asarray(numerator / denominator, dtype=jnp.float32)
+
+
+def rand_score(preds: Array, target: Array) -> Array:
+    """Compute the Rand score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import rand_score
+        >>> rand_score(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1])).round(4)
+        Array(0.8333, dtype=float32)
+    """
+    check_cluster_labels(preds, target)
+    return _rand_score_compute(calculate_contingency_matrix(preds, target))
+
+
+def _adjusted_rand_score_compute(contingency: np.ndarray) -> Array:
+    """ARI from the pair confusion matrix."""
+    (tn, fp), (fn, tp) = calculate_pair_cluster_confusion_matrix(contingency=contingency)
+    if fn == 0 and fp == 0:
+        return jnp.asarray(1.0)
+    return jnp.asarray(
+        2.0 * (tp * tn - fn * fp) / ((tp + fn) * (fn + tn) + (tp + fp) * (fp + tn)), dtype=jnp.float32
+    )
+
+
+def adjusted_rand_score(preds: Array, target: Array) -> Array:
+    """Compute the adjusted Rand score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import adjusted_rand_score
+        >>> adjusted_rand_score(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1])).round(4)
+        Array(0.5714, dtype=float32)
+    """
+    check_cluster_labels(preds, target)
+    return _adjusted_rand_score_compute(calculate_contingency_matrix(preds, target))
+
+
+def _fowlkes_mallows_index_compute(contingency: np.ndarray, n: int) -> Array:
+    """FMI from contingency pair counts."""
+    tk = float((contingency**2).sum() - n)
+    if abs(tk) < 1e-12:
+        return jnp.asarray(0.0)
+    pk = float((contingency.sum(axis=0) ** 2).sum() - n)
+    qk = float((contingency.sum(axis=1) ** 2).sum() - n)
+    return jnp.asarray(np.sqrt(tk / pk) * np.sqrt(tk / qk), dtype=jnp.float32)
+
+
+def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
+    """Compute the Fowlkes-Mallows index between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import fowlkes_mallows_index
+        >>> preds = jnp.array([2, 2, 0, 1, 0])
+        >>> target = jnp.array([2, 2, 1, 1, 0])
+        >>> fowlkes_mallows_index(preds, target).round(4)
+        Array(0.5, dtype=float32)
+    """
+    check_cluster_labels(preds, target)
+    contingency = calculate_contingency_matrix(preds, target)
+    return _fowlkes_mallows_index_compute(contingency, int(np.asarray(preds).size))
+
+
+def _homogeneity_score_compute(preds: Array, target: Array) -> Tuple[Array, Array, Array, Array]:
+    """Homogeneity plus MI/entropy intermediates."""
+    check_cluster_labels(preds, target)
+    if np.asarray(target).size == 0:
+        zero = jnp.asarray(0.0)
+        return zero, zero, zero, zero
+    entropy_target = calculate_entropy(target)
+    entropy_preds = calculate_entropy(preds)
+    mutual_info = mutual_info_score(preds, target)
+    homogeneity = mutual_info / entropy_target if float(entropy_target) else jnp.ones_like(entropy_target)
+    return homogeneity, mutual_info, entropy_preds, entropy_target
+
+
+def _completeness_score_compute(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Completeness plus homogeneity."""
+    homogeneity, mutual_info, entropy_preds, _ = _homogeneity_score_compute(preds, target)
+    completeness = mutual_info / entropy_preds if float(entropy_preds) else jnp.ones_like(entropy_preds)
+    return completeness, homogeneity
+
+
+def homogeneity_score(preds: Array, target: Array) -> Array:
+    """Compute the homogeneity score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import homogeneity_score
+        >>> homogeneity_score(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1]))
+        Array(1., dtype=float32)
+    """
+    homogeneity, _, _, _ = _homogeneity_score_compute(preds, target)
+    return homogeneity
+
+
+def completeness_score(preds: Array, target: Array) -> Array:
+    """Compute the completeness score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import completeness_score
+        >>> completeness_score(jnp.array([0, 0, 1, 1]), jnp.array([1, 1, 0, 0]))
+        Array(1., dtype=float32)
+    """
+    completeness, _ = _completeness_score_compute(preds, target)
+    return completeness
+
+
+def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
+    """Compute the V-measure score between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import v_measure_score
+        >>> v_measure_score(jnp.array([0, 0, 1, 2]), jnp.array([0, 0, 1, 1])).round(4)
+        Array(0.8, dtype=float32)
+    """
+    completeness, homogeneity = _completeness_score_compute(preds, target)
+    if float(homogeneity + completeness) == 0.0:
+        return jnp.ones_like(homogeneity)
+    return (1 + beta) * homogeneity * completeness / (beta * homogeneity + completeness)

@@ -1,0 +1,138 @@
+"""Intrinsic (data + labels) clustering metrics.
+
+Parity: reference ``src/torchmetrics/functional/clustering/{calinski_harabasz_score,
+davies_bouldin_score,dunn_index}.py``.
+
+TPU design: per-cluster means/dispersion are one-hot segment reductions (matmuls on the
+MXU) rather than the reference's per-cluster python loops.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    _validate_intrinsic_cluster_data,
+    _validate_intrinsic_labels_to_samples,
+)
+
+Array = jax.Array
+
+
+def _relabel(labels: Array) -> Tuple[Array, int]:
+    """Zero-index the labels on host (dynamic unique)."""
+    unique, inverse = np.unique(np.asarray(labels), return_inverse=True)
+    return jnp.asarray(inverse), len(unique)
+
+
+def _cluster_stats(data: Array, labels: Array, num_labels: int) -> Tuple[Array, Array]:
+    """Per-cluster counts and centroids via a one-hot segment matmul."""
+    onehot = jax.nn.one_hot(labels, num_labels, dtype=data.dtype)  # (N, K)
+    counts = onehot.sum(axis=0)  # (K,)
+    sums = jnp.matmul(onehot.T, data, precision=lax.Precision.HIGHEST)  # (K, d)
+    return counts, sums / counts[:, None]
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Compute the Calinski-Harabasz score for intrinsic cluster evaluation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.clustering import calinski_harabasz_score
+        >>> data = jax.random.normal(jax.random.PRNGKey(42), (10, 3))
+        >>> labels = jax.random.randint(jax.random.PRNGKey(0), (10,), 0, 2)
+        >>> float(calinski_harabasz_score(data, labels)) > 0
+        True
+    """
+    data = jnp.asarray(data)
+    labels = jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    labels, num_labels = _relabel(labels)
+    num_samples = data.shape[0]
+    _validate_intrinsic_labels_to_samples(num_labels, num_samples)
+
+    mean = data.mean(axis=0)
+    counts, centroids = _cluster_stats(data, labels, num_labels)
+    between = (jnp.square(centroids - mean).sum(axis=1) * counts).sum()
+    within = jnp.square(data - centroids[labels]).sum()
+
+    return jnp.where(
+        within == 0,
+        1.0,
+        between * (num_samples - num_labels) / (jnp.where(within == 0, 1.0, within) * (num_labels - 1.0)),
+    )
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Compute the Davies-Bouldin score for intrinsic cluster evaluation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.clustering import davies_bouldin_score
+        >>> data = jax.random.normal(jax.random.PRNGKey(42), (10, 3))
+        >>> labels = jax.random.randint(jax.random.PRNGKey(0), (10,), 0, 2)
+        >>> float(davies_bouldin_score(data, labels)) > 0
+        True
+    """
+    data = jnp.asarray(data)
+    labels = jnp.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    labels, num_labels = _relabel(labels)
+    num_samples = data.shape[0]
+    _validate_intrinsic_labels_to_samples(num_labels, num_samples)
+
+    counts, centroids = _cluster_stats(data, labels, num_labels)
+    dists = jnp.sqrt(jnp.square(data - centroids[labels]).sum(axis=1))
+    onehot = jax.nn.one_hot(labels, num_labels, dtype=data.dtype)
+    intra_dists = (onehot.T @ dists) / counts
+
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    centroid_distances = jnp.sqrt(jnp.square(diff).sum(axis=-1))
+
+    if bool(jnp.allclose(intra_dists, 0.0)) or bool(jnp.allclose(centroid_distances, 0.0)):
+        return jnp.asarray(0.0)
+
+    centroid_distances = jnp.where(centroid_distances == 0, jnp.inf, centroid_distances)
+    combined_intra = intra_dists[None, :] + intra_dists[:, None]
+    scores = (combined_intra / centroid_distances).max(axis=1)
+    return scores.mean()
+
+
+def _dunn_index_update(data: Array, labels: Array, p: float) -> Tuple[Array, Array]:
+    """Intercluster centroid distances and max intracluster radii."""
+    labels, num_labels = _relabel(labels)
+    _, centroids = _cluster_stats(jnp.asarray(data, dtype=jnp.float32), labels, num_labels)
+
+    inter = jnp.stack(
+        [jnp.linalg.norm(centroids[a] - centroids[b], ord=p) for a, b in combinations(range(num_labels), 2)]
+    )
+    radii = jnp.linalg.norm(jnp.asarray(data, dtype=jnp.float32) - centroids[labels], ord=p, axis=1)
+    onehot = jax.nn.one_hot(labels, num_labels)
+    max_intra = jnp.max(jnp.where(onehot.T > 0, radii[None, :], -jnp.inf), axis=1)
+    return inter, max_intra
+
+
+def _dunn_index_compute(intercluster_distance: Array, max_intracluster_distance: Array) -> Array:
+    """Dunn index: min separation over max diameter."""
+    return intercluster_distance.min() / max_intracluster_distance.max()
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2) -> Array:
+    """Compute the Dunn index for intrinsic cluster evaluation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import dunn_index
+        >>> data = jnp.array([[0., 0.], [0.5, 0.], [1., 0.], [0.5, 1.]])
+        >>> labels = jnp.array([0, 0, 0, 1])
+        >>> dunn_index(data, labels)
+        Array(2., dtype=float32)
+    """
+    pairwise, diameters = _dunn_index_update(jnp.asarray(data), jnp.asarray(labels), p)
+    return _dunn_index_compute(pairwise, diameters)

@@ -1,0 +1,136 @@
+"""Shared helpers for clustering metrics.
+
+Parity: reference ``src/torchmetrics/functional/clustering/utils.py`` (entropy ``:47``,
+generalized mean ``:78``, contingency ``:119``, pair confusion ``:215``).
+
+The label sets are dynamic (``unique``), so the contingency matrix is built on host
+with numpy at compute time — exactly when the reference builds it — and the downstream
+algebra runs on fixed-shape arrays.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _validate_average_method_arg(average_method: str = "arithmetic") -> None:
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of  `min`, `geometric`, `arithmetic`, `max`,"
+            f"but got {average_method}"
+        )
+
+
+def calculate_entropy(x: Array) -> Array:
+    """Entropy of a label assignment (natural log, computed in log-space)."""
+    x = np.asarray(x)
+    if len(x) == 0:
+        return jnp.asarray(1.0)
+    p = np.bincount(np.unique(x, return_inverse=True)[1])
+    p = p[p > 0]
+    if p.size == 1:
+        return jnp.asarray(0.0)
+    n = p.sum()
+    return jnp.asarray(-np.sum((p / n) * (np.log(p) - np.log(n))), dtype=jnp.float32)
+
+
+def calculate_generalized_mean(x: Array, p: Union[int, str]) -> Array:
+    """Generalized (power) mean: min / geometric / arithmetic / max or an exponent."""
+    x = jnp.asarray(x)
+    if isinstance(p, str):
+        if p == "min":
+            return x.min()
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(x)))
+        if p == "arithmetic":
+            return x.mean()
+        if p == "max":
+            return x.max()
+        raise ValueError("'method' must be 'min', 'geometric', 'arirthmetic', or 'max'")
+    return jnp.mean(jnp.power(x, p)) ** (1.0 / p)
+
+
+def calculate_contingency_matrix(
+    preds: Array, target: Array, eps: Optional[float] = None
+) -> np.ndarray:
+    """Dense contingency matrix of shape (n_classes_target, n_classes_preds)."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    if preds.ndim != 1 or target.ndim != 1:
+        raise ValueError(f"Expected 1d `preds` and `target` but got {preds.ndim} and {target.ndim}.")
+
+    _, preds_idx = np.unique(preds, return_inverse=True)
+    _, target_idx = np.unique(target, return_inverse=True)
+    num_preds = preds_idx.max() + 1 if preds_idx.size else 0
+    num_target = target_idx.max() + 1 if target_idx.size else 0
+
+    contingency = np.zeros((num_target, num_preds), dtype=np.float64)
+    np.add.at(contingency, (target_idx, preds_idx), 1)
+    if eps is not None:
+        contingency = contingency + eps
+    return contingency
+
+
+def check_cluster_labels(preds: Array, target: Array) -> None:
+    """Require same-shape 1D integer label tensors."""
+    _check_same_shape(preds, target)
+    if np.asarray(preds).ndim != 1:
+        raise ValueError("Expected arguments to be 1-d tensors.")
+    if any(np.issubdtype(np.asarray(x).dtype, np.floating) for x in (preds, target)):
+        p, t = np.asarray(preds), np.asarray(target)
+        raise ValueError(f"Expected real, discrete values for x but received {p.dtype} and {t.dtype}.")
+
+
+def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:
+    """Require 2D float data and 1D labels."""
+    if data.ndim != 2:
+        raise ValueError(f"Expected 2D data, got {data.ndim}D data instead")
+    if not jnp.issubdtype(jnp.asarray(data).dtype, jnp.floating):
+        raise ValueError(f"Expected floating point data, got {jnp.asarray(data).dtype} data instead")
+    if labels.ndim != 1:
+        raise ValueError(f"Expected 1D labels, got {labels.ndim}D labels instead")
+
+
+def _validate_intrinsic_labels_to_samples(num_labels: int, num_samples: int) -> None:
+    """Require 1 < clusters < samples."""
+    if not 1 < num_labels < num_samples:
+        raise ValueError(
+            "Number of detected clusters must be greater than one and less than the number of samples."
+            f"Got {num_labels} clusters and {num_samples} samples."
+        )
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds: Optional[Array] = None,
+    target: Optional[Array] = None,
+    contingency: Optional[np.ndarray] = None,
+) -> np.ndarray:
+    """2x2 pair-counting confusion matrix of two clusterings (in pair units)."""
+    if preds is None and target is None and contingency is None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+    if preds is not None and target is not None and contingency is not None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+    if preds is not None and target is not None:
+        contingency = calculate_contingency_matrix(preds, target)
+    if contingency is None:
+        raise ValueError("Must provide `contingency` if `preds` and `target` are not provided.")
+
+    num_samples = contingency.sum()
+    sum_c = contingency.sum(axis=1)
+    sum_k = contingency.sum(axis=0)
+    sum_squared = (contingency**2).sum()
+
+    pair_matrix = np.zeros((2, 2), dtype=contingency.dtype)
+    pair_matrix[1, 1] = sum_squared - num_samples
+    pair_matrix[1, 0] = (contingency * sum_k).sum() - sum_squared
+    pair_matrix[0, 1] = (contingency.T * sum_c).sum() - sum_squared
+    pair_matrix[0, 0] = num_samples**2 - pair_matrix[0, 1] - pair_matrix[1, 0] - sum_squared
+    return pair_matrix

@@ -1,0 +1,20 @@
+"""Functional nominal metrics.
+
+Parity: reference ``src/torchmetrics/functional/nominal/__init__.py``.
+"""
+
+from torchmetrics_tpu.functional.nominal.association import (
+    cramers_v,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+
+__all__ = [
+    "cramers_v",
+    "fleiss_kappa",
+    "pearsons_contingency_coefficient",
+    "theils_u",
+    "tschuprows_t",
+]

@@ -1,0 +1,285 @@
+"""Categorical association statistics: Cramer's V, Pearson's C, Tschuprow's T, Theil's U,
+Fleiss' kappa.
+
+Parity: reference ``src/torchmetrics/functional/nominal/{cramers,pearson,tschuprows,
+theils_u,fleiss_kappa}.py``. The contingency accumulation reuses the classification
+confusion-matrix engine (one-hot MXU contraction).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _multiclass_confusion_matrix_update,
+)
+from torchmetrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+
+Array = jax.Array
+
+
+def _nominal_confmat_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Shared nominal-pair update: argmax 2D inputs, handle NaNs, accumulate confmat."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    preds = preds.astype(jnp.int32)
+    target = target.astype(jnp.int32)
+    valid = jnp.ones_like(preds, dtype=bool)
+    return _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
+
+
+_cramers_v_update = _nominal_confmat_update
+_pearsons_contingency_coefficient_update = _nominal_confmat_update
+_tschuprows_t_update = _nominal_confmat_update
+_theils_u_update = _nominal_confmat_update
+
+
+def _prepare_nominal_confmat(preds, target, nan_strategy, nan_replace_value):
+    """NaN-handle, densify category values to 0..C-1, and build the contingency table
+    (reference counts classes as ``len(unique(cat(preds, target)))`` after NaN
+    handling)."""
+    import numpy as np
+
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    joint = np.concatenate([np.asarray(preds), np.asarray(target)])
+    classes, inverse = np.unique(joint, return_inverse=True)
+    n = np.asarray(preds).shape[0]
+    p = jnp.asarray(inverse[:n].astype(np.int32))
+    t = jnp.asarray(inverse[n:].astype(np.int32))
+    valid = jnp.ones_like(p, dtype=bool)
+    return _multiclass_confusion_matrix_update(p, t, valid, len(classes))
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Cramer's V from a contingency table."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    num_rows, num_cols = confmat.shape
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        if float(jnp.minimum(rows_corrected, cols_corrected)) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
+            return jnp.asarray(float("nan"))
+        cramers_v_value = jnp.sqrt(phi_squared_corrected / jnp.minimum(rows_corrected - 1, cols_corrected - 1))
+    else:
+        cramers_v_value = jnp.sqrt(phi_squared / min(num_rows - 1, num_cols - 1))
+    return jnp.clip(cramers_v_value, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Cramer's V statistic of association between two categorical series.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import cramers_v
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> float(cramers_v(preds, target)) > 0
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _prepare_nominal_confmat(preds, target, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """Pearson's contingency coefficient from a contingency table."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction=False)
+    phi_squared = chi_squared / cm_sum
+    return jnp.clip(jnp.sqrt(phi_squared / (1 + phi_squared)), 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Pearson's contingency coefficient between two categorical series.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import pearsons_contingency_coefficient
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> float(pearsons_contingency_coefficient(preds, target)) > 0
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _prepare_nominal_confmat(preds, target, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Tschuprow's T from a contingency table."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    num_rows, num_cols = confmat.shape
+
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        if float(jnp.minimum(rows_corrected, cols_corrected)) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
+            return jnp.asarray(float("nan"))
+        tschuprows_t_value = jnp.sqrt(
+            phi_squared_corrected / jnp.sqrt((rows_corrected - 1) * (cols_corrected - 1))
+        )
+    else:
+        tschuprows_t_value = jnp.sqrt(phi_squared / jnp.sqrt(float((num_rows - 1) * (num_cols - 1))))
+    return jnp.clip(tschuprows_t_value, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Tschuprow's T statistic between two categorical series.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import tschuprows_t
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> float(tschuprows_t(preds, target)) > 0
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _prepare_nominal_confmat(preds, target, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def _conditional_entropy_compute(confmat: Array) -> Array:
+    """H(X|Y) from a contingency table."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    total_occurrences = confmat.sum()
+    p_xy_m = confmat / total_occurrences
+    p_y = confmat.sum(axis=1) / total_occurrences
+    p_y_m = jnp.broadcast_to(p_y[:, None], p_xy_m.shape)
+    vals = p_xy_m * jnp.log(p_y_m / p_xy_m)
+    return jnp.nansum(vals)
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """Theil's U from a contingency table."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    s_xy = _conditional_entropy_compute(confmat)
+
+    total_occurrences = confmat.sum()
+    p_x = confmat.sum(axis=0) / total_occurrences
+    s_x = -jnp.sum(p_x * jnp.log(p_x))
+    if float(s_x) == 0:
+        return jnp.asarray(0.0)
+    return (s_x - s_xy) / s_x
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Theil's U (uncertainty coefficient) between two categorical series.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import theils_u
+        >>> preds = jax.random.randint(jax.random.PRNGKey(42), (100,), 0, 4)
+        >>> target = (preds + jax.random.randint(jax.random.PRNGKey(43), (100,), 0, 2)) % 4
+        >>> float(theils_u(preds, target)) > 0
+        True
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _prepare_nominal_confmat(preds, target, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def _fleiss_kappa_update(ratings: Array, mode: str = "counts") -> Array:
+    """Validate and convert ratings to a per-sample category-count matrix."""
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        n_categories = ratings.shape[1]
+        rater_choices = ratings.argmax(axis=1)  # (n_samples, n_raters)
+        one_hot = jax.nn.one_hot(rater_choices, n_categories, dtype=jnp.int32)  # (n_samples, n_raters, C)
+        ratings = one_hot.sum(axis=1)
+    elif mode == "counts" and (ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating)):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    """Fleiss' kappa from the per-sample category counts."""
+    counts = jnp.asarray(counts, dtype=jnp.float32)
+    total = counts.shape[0]
+    num_raters = counts.sum(axis=1).max()
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = (jnp.square(counts).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = jnp.square(p_i).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    r"""Compute Fleiss' kappa, the inter-rater agreement over chance.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import fleiss_kappa
+        >>> ratings = jax.random.randint(jax.random.PRNGKey(42), (10, 5), 0, 10)
+        >>> float(fleiss_kappa(ratings)) < 1
+        True
+    """
+    if mode not in ["counts", "probs"]:
+        raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

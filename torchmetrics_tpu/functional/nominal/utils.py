@@ -1,0 +1,104 @@
+"""Shared helpers for nominal (categorical association) metrics.
+
+Parity: reference ``src/torchmetrics/functional/nominal/utils.py`` (chi² ``:41-59``,
+bias correction ``:84-110``, NaN handling ``:113-150``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ["replace", "drop"]:
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (float, int)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace NaNs with a value, or drop rows containing any NaN."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if nan_strategy == "replace":
+        return jnp.nan_to_num(preds, nan=nan_replace_value), jnp.nan_to_num(target, nan=nan_replace_value)
+    if nan_strategy == "drop":
+        # dynamic row count → host-side boolean filter (only used eagerly, like the
+        # reference's index_select path)
+        p, t = np.asarray(preds, dtype=float), np.asarray(target, dtype=float)
+        keep = ~(np.isnan(p) | np.isnan(t))
+        return jnp.asarray(p[keep]), jnp.asarray(t[keep])
+    raise ValueError(f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}")
+
+
+def _compute_expected_freqs(confmat: Array) -> Array:
+    """Outer product of marginals over the total count."""
+    margin_rows = confmat.sum(axis=1)
+    margin_cols = confmat.sum(axis=0)
+    return jnp.einsum("r,c->rc", margin_rows, margin_cols) / confmat.sum()
+
+
+def _compute_chi_squared(confmat: Array, bias_correction: bool) -> Array:
+    """Chi-square statistic of a contingency table (with optional Yates correction)."""
+    expected_freqs = _compute_expected_freqs(confmat)
+    df = expected_freqs.size - sum(expected_freqs.shape) + expected_freqs.ndim - 1
+    if df == 0:
+        return jnp.asarray(0.0)
+    if df == 1 and bias_correction:
+        diff = expected_freqs - confmat
+        direction = jnp.sign(diff)
+        confmat = confmat + direction * jnp.minimum(0.5, jnp.abs(diff))
+    return jnp.sum(jnp.square(confmat - expected_freqs) / expected_freqs)
+
+
+def _drop_empty_rows_and_cols(confmat: Array) -> Array:
+    """Drop all-zero rows and columns (host-side; shapes are dynamic)."""
+    cm = np.asarray(confmat)
+    cm = cm[cm.sum(axis=1) != 0]
+    cm = cm[:, cm.sum(axis=0) != 0]
+    return jnp.asarray(cm)
+
+
+def _compute_phi_squared_corrected(phi_squared: Array, num_rows: int, num_cols: int, confmat_sum: Array) -> Array:
+    """Bias-corrected phi²."""
+    return jnp.maximum(0.0, phi_squared - ((num_rows - 1) * (num_cols - 1)) / (confmat_sum - 1))
+
+
+def _compute_rows_and_cols_corrected(num_rows: int, num_cols: int, confmat_sum: Array) -> Tuple[Array, Array]:
+    """Bias-corrected effective row/column counts."""
+    rows_corrected = num_rows - (num_rows - 1) ** 2 / (confmat_sum - 1)
+    cols_corrected = num_cols - (num_cols - 1) ** 2 / (confmat_sum - 1)
+    return rows_corrected, cols_corrected
+
+
+def _compute_bias_corrected_values(
+    phi_squared: Array, num_rows: int, num_cols: int, confmat_sum: Array
+) -> Tuple[Array, Array, Array]:
+    """Bias-corrected phi² plus effective row/column counts."""
+    phi_squared_corrected = _compute_phi_squared_corrected(phi_squared, num_rows, num_cols, confmat_sum)
+    rows_corrected, cols_corrected = _compute_rows_and_cols_corrected(num_rows, num_cols, confmat_sum)
+    return phi_squared_corrected, rows_corrected, cols_corrected
+
+
+def _unable_to_use_bias_correction_warning(metric_name: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric_name} using bias correction. Please consider to set `bias_correction=False`."
+    )

@@ -1,0 +1,20 @@
+"""Pairwise metrics (functional only).
+
+Parity: reference ``src/torchmetrics/functional/pairwise/__init__.py`` (5 fns).
+"""
+
+from torchmetrics_tpu.functional.pairwise.distances import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+    "pairwise_minkowski_distance",
+]

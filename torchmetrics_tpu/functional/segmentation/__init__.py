@@ -1,0 +1,8 @@
+"""Functional segmentation metrics.
+
+Parity: reference ``src/torchmetrics/functional/segmentation/__init__.py``.
+"""
+
+from torchmetrics_tpu.functional.segmentation.scores import generalized_dice_score, mean_iou
+
+__all__ = ["generalized_dice_score", "mean_iou"]

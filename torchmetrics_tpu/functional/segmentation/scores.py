@@ -1,0 +1,212 @@
+"""Segmentation scores: generalized dice and mean IoU.
+
+Parity: reference ``src/torchmetrics/functional/segmentation/{generalized_dice,
+mean_iou}.py``. One-hot intersection/union sums — fully jittable with static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+from torchmetrics_tpu.utils.data import safe_divide
+
+Array = jax.Array
+
+
+def _ignore_background(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop the background channel (index 0) when more than one class is present."""
+    preds = preds[:, 1:] if preds.shape[1] > 1 else preds
+    target = target[:, 1:] if target.shape[1] > 1 else target
+    return preds, target
+
+
+def _one_hot_channelfirst(x: Array, num_classes: int) -> Array:
+    """Index tensor (N, ...) → one-hot (N, C, ...)."""
+    return jnp.moveaxis(jax.nn.one_hot(x, num_classes, dtype=jnp.int32), -1, 1)
+
+
+def _generalized_dice_validate_args(
+    num_classes: int,
+    include_background: bool,
+    per_class: bool,
+    weight_type: str,
+    input_format: str,
+) -> None:
+    """Validate generalized-dice arguments."""
+    if num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if weight_type not in ["square", "simple", "linear"]:
+        raise ValueError(
+            f"Expected argument `weight_type` to be one of 'square', 'simple', 'linear', but got {weight_type}."
+        )
+    if input_format not in ["one-hot", "index"]:
+        raise ValueError(f"Expected argument `input_format` to be one of 'one-hot', 'index', but got {input_format}.")
+
+
+def _generalized_dice_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool,
+    weight_type: str = "square",
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array]:
+    """Weighted per-class numerator/denominator for the batch."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    if preds.ndim < 3:
+        raise ValueError(f"Expected both `preds` and `target` to have at least 3 dimensions, but got {preds.ndim}.")
+
+    if input_format == "index":
+        preds = _one_hot_channelfirst(preds, num_classes)
+        target = _one_hot_channelfirst(target, num_classes)
+
+    if not include_background:
+        preds, target = _ignore_background(preds, target)
+
+    reduce_axis = tuple(range(2, target.ndim))
+    preds_f = preds.astype(jnp.float32)
+    target_f = target.astype(jnp.float32)
+    intersection = jnp.sum(preds_f * target_f, axis=reduce_axis)
+    target_sum = jnp.sum(target_f, axis=reduce_axis)
+    pred_sum = jnp.sum(preds_f, axis=reduce_axis)
+    cardinality = target_sum + pred_sum
+
+    if weight_type == "simple":
+        weights = 1.0 / target_sum
+    elif weight_type == "linear":
+        weights = jnp.ones_like(target_sum)
+    elif weight_type == "square":
+        weights = 1.0 / jnp.square(target_sum)
+    else:
+        raise ValueError(
+            f"Expected argument `weight_type` to be one of 'simple', 'linear', 'square', but got {weight_type}."
+        )
+
+    # replace inf weights (empty classes) with the per-class max finite weight
+    infs = jnp.isinf(weights)
+    finite = jnp.where(infs, 0.0, weights)
+    w_max = jnp.max(finite, axis=0)  # per class over the batch
+    weights = jnp.where(infs, jnp.broadcast_to(w_max, weights.shape), weights)
+
+    numerator = 2.0 * intersection * weights
+    denominator = cardinality * weights
+    return numerator, denominator
+
+
+def _generalized_dice_compute(numerator: Array, denominator: Array, per_class: bool = True) -> Array:
+    """Per-sample (optionally per-class) generalized dice score."""
+    if not per_class:
+        numerator = jnp.sum(numerator, axis=1)
+        denominator = jnp.sum(denominator, axis=1)
+    return safe_divide(numerator, denominator)
+
+
+def generalized_dice_score(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    per_class: bool = False,
+    weight_type: str = "square",
+    input_format: str = "one-hot",
+) -> Array:
+    """Compute the generalized dice score for semantic segmentation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.segmentation import generalized_dice_score
+        >>> preds = jax.random.randint(jax.random.PRNGKey(0), (4, 5, 16, 16), 0, 2)
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (4, 5, 16, 16), 0, 2)
+        >>> generalized_dice_score(preds, target, num_classes=5).shape
+        (4,)
+    """
+    _generalized_dice_validate_args(num_classes, include_background, per_class, weight_type, input_format)
+    numerator, denominator = _generalized_dice_update(
+        preds, target, num_classes, include_background, weight_type, input_format
+    )
+    return _generalized_dice_compute(numerator, denominator, per_class)
+
+
+def _mean_iou_validate_args(
+    num_classes: int,
+    include_background: bool,
+    per_class: bool,
+    input_format: str = "one-hot",
+) -> None:
+    """Validate mean-IoU arguments."""
+    if num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if input_format not in ["one-hot", "index"]:
+        raise ValueError(f"Expected argument `input_format` to be one of 'one-hot', 'index', but got {input_format}.")
+
+
+def _mean_iou_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = False,
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array]:
+    """Per-sample per-class intersection and union counts."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    if input_format == "index":
+        preds = _one_hot_channelfirst(preds, num_classes)
+        target = _one_hot_channelfirst(target, num_classes)
+
+    if not include_background:
+        preds, target = _ignore_background(preds, target)
+
+    reduce_axis = tuple(range(2, preds.ndim))
+    preds_b = preds.astype(bool)
+    target_b = target.astype(bool)
+    intersection = jnp.sum(preds_b & target_b, axis=reduce_axis)
+    target_sum = jnp.sum(target_b, axis=reduce_axis)
+    pred_sum = jnp.sum(preds_b, axis=reduce_axis)
+    union = target_sum + pred_sum - intersection
+    return intersection, union
+
+
+def _mean_iou_compute(intersection: Array, union: Array, per_class: bool = False) -> Array:
+    """Per-sample IoU (optionally per class)."""
+    val = safe_divide(intersection, union)
+    return val if per_class else jnp.mean(val, axis=1)
+
+
+def mean_iou(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    per_class: bool = False,
+    input_format: str = "one-hot",
+) -> Array:
+    """Compute the mean intersection over union for semantic segmentation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.segmentation import mean_iou
+        >>> preds = jax.random.randint(jax.random.PRNGKey(0), (4, 5, 16, 16), 0, 2)
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (4, 5, 16, 16), 0, 2)
+        >>> mean_iou(preds, target, num_classes=5).shape
+        (4,)
+    """
+    _mean_iou_validate_args(num_classes, include_background, per_class, input_format)
+    intersection, union = _mean_iou_update(preds, target, num_classes, include_background, input_format)
+    return _mean_iou_compute(intersection, union, per_class=per_class)

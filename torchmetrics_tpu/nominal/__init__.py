@@ -1,0 +1,20 @@
+"""Nominal metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/nominal/__init__.py`` (5 classes).
+"""
+
+from torchmetrics_tpu.nominal.modules import (
+    CramersV,
+    FleissKappa,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+
+__all__ = [
+    "CramersV",
+    "FleissKappa",
+    "PearsonsContingencyCoefficient",
+    "TheilsU",
+    "TschuprowsT",
+]

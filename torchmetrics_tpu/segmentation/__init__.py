@@ -1,0 +1,8 @@
+"""Segmentation metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/segmentation/__init__.py`` (2 classes).
+"""
+
+from torchmetrics_tpu.segmentation.modules import GeneralizedDiceScore, MeanIoU
+
+__all__ = ["GeneralizedDiceScore", "MeanIoU"]

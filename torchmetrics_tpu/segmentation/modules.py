@@ -1,0 +1,131 @@
+"""Segmentation metric modules.
+
+Parity: reference ``src/torchmetrics/segmentation/{generalized_dice,mean_iou}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.segmentation.scores import (
+    _generalized_dice_compute,
+    _generalized_dice_update,
+    _generalized_dice_validate_args,
+    _mean_iou_compute,
+    _mean_iou_update,
+    _mean_iou_validate_args,
+)
+
+Array = jax.Array
+
+
+class GeneralizedDiceScore(Metric):
+    r"""Generalized dice score for semantic segmentation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.segmentation import GeneralizedDiceScore
+        >>> preds = jax.random.randint(jax.random.PRNGKey(0), (4, 5, 16, 16), 0, 2)
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (4, 5, 16, 16), 0, 2)
+        >>> gds = GeneralizedDiceScore(num_classes=5)
+        >>> 0 <= float(gds(preds, target)) <= 1
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    score: Array
+    samples: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        weight_type: str = "square",
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _generalized_dice_validate_args(num_classes, include_background, per_class, weight_type, input_format)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.weight_type = weight_type
+        self.input_format = input_format
+
+        num_score_classes = num_classes - (0 if include_background else 1)
+        self.add_state("score", jnp.zeros(num_score_classes if per_class else 1), dist_reduce_fx="sum")
+        self.add_state("samples", jnp.zeros(1), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample dice scores."""
+        numerator, denominator = _generalized_dice_update(
+            preds, target, self.num_classes, self.include_background, self.weight_type, self.input_format
+        )
+        self.score = self.score + _generalized_dice_compute(numerator, denominator, self.per_class).sum(axis=0)
+        self.samples = self.samples + preds.shape[0]
+
+    def compute(self) -> Array:
+        """Mean dice score over all samples."""
+        return self.score / self.samples
+
+
+class MeanIoU(Metric):
+    r"""Mean intersection over union for semantic segmentation.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.segmentation import MeanIoU
+        >>> preds = jax.random.randint(jax.random.PRNGKey(0), (4, 5, 16, 16), 0, 2)
+        >>> target = jax.random.randint(jax.random.PRNGKey(1), (4, 5, 16, 16), 0, 2)
+        >>> miou = MeanIoU(num_classes=5)
+        >>> 0 <= float(miou(preds, target)) <= 1
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    score: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        per_class: bool = False,
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _mean_iou_validate_args(num_classes, include_background, per_class, input_format)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.per_class = per_class
+        self.input_format = input_format
+
+        num_score_classes = num_classes - (0 if include_background else 1)
+        self.add_state("score", jnp.zeros(num_score_classes if per_class else 1).squeeze(), dist_reduce_fx="mean")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the batch-mean IoU (running mean via the reference's sum-then-rely-on-mean-sync)."""
+        intersection, union = _mean_iou_update(
+            preds, target, self.num_classes, self.include_background, self.input_format
+        )
+        score = _mean_iou_compute(intersection, union, per_class=self.per_class)
+        self.score = self.score + (score.mean(axis=0) if self.per_class else score.mean())
+
+    def compute(self) -> Array:
+        """Accumulated IoU score (reference semantics: sum of batch means)."""
+        return self.score
