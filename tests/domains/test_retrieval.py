@@ -1,0 +1,174 @@
+"""Retrieval metric tests: fuzz differential vs the upstream reference."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+import torchmetrics.functional.retrieval as ref_f  # noqa: E402
+import torchmetrics.retrieval as ref_m  # noqa: E402
+
+import torchmetrics_tpu.functional.retrieval as ours_f  # noqa: E402
+import torchmetrics_tpu.retrieval as ours_m  # noqa: E402
+
+rng = np.random.RandomState(42)
+
+FUNCTIONAL_PAIRS = [
+    ("retrieval_average_precision", {}),
+    ("retrieval_precision", {}),
+    ("retrieval_recall", {}),
+    ("retrieval_hit_rate", {}),
+    ("retrieval_fall_out", {}),
+    ("retrieval_reciprocal_rank", {}),
+    ("retrieval_r_precision", {}),
+    ("retrieval_auroc", {}),
+]
+
+
+class TestRetrievalFunctional:
+    @pytest.mark.parametrize(("name", "kwargs"), FUNCTIONAL_PAIRS)
+    @pytest.mark.parametrize("top_k", [None, 2])
+    def test_fuzz_against_reference(self, name, kwargs, top_k):
+        if name == "retrieval_r_precision" and top_k is not None:
+            pytest.skip("r_precision takes no top_k")
+        for trial in range(10):
+            n = rng.randint(3, 12)
+            p = rng.rand(n).astype(np.float32)
+            t = rng.randint(0, 2, n)
+            call_kwargs = dict(kwargs)
+            if name != "retrieval_r_precision":
+                call_kwargs["top_k"] = top_k
+            r = getattr(ref_f, name)(torch.tensor(p), torch.tensor(t), **call_kwargs)
+            o = getattr(ours_f, name)(jnp.asarray(p), jnp.asarray(t), **call_kwargs)
+            _assert_allclose(o, r.numpy(), atol=1e-4)
+
+    @pytest.mark.parametrize("top_k", [None, 3])
+    def test_ndcg_graded(self, top_k):
+        for trial in range(10):
+            n = rng.randint(3, 12)
+            p = rng.rand(n).astype(np.float32)
+            t = rng.randint(0, 5, n)
+            r = ref_f.retrieval_normalized_dcg(torch.tensor(p), torch.tensor(t), top_k=top_k)
+            o = ours_f.retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t), top_k=top_k)
+            _assert_allclose(o, r.numpy(), atol=1e-4)
+
+    def test_ndcg_with_ties(self):
+        p = np.array([0.5, 0.5, 0.5, 0.2], dtype=np.float32)
+        t = np.array([3, 0, 1, 2])
+        r = ref_f.retrieval_normalized_dcg(torch.tensor(p), torch.tensor(t))
+        o = ours_f.retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t))
+        _assert_allclose(o, r.numpy(), atol=1e-4)
+
+    def test_precision_recall_curve(self):
+        p = rng.rand(8).astype(np.float32)
+        t = rng.randint(0, 2, 8)
+        t[0] = 1  # ensure at least one positive
+        rp, rr, rk = ref_f.retrieval_precision_recall_curve(torch.tensor(p), torch.tensor(t), max_k=5)
+        op, orr, ok_ = ours_f.retrieval_precision_recall_curve(jnp.asarray(p), jnp.asarray(t), max_k=5)
+        _assert_allclose(op, rp.numpy(), atol=1e-4)
+        _assert_allclose(orr, rr.numpy(), atol=1e-4)
+        _assert_allclose(ok_, rk.numpy(), atol=0)
+
+    def test_raises_on_bad_inputs(self):
+        with pytest.raises(ValueError, match="same shape"):
+            ours_f.retrieval_precision(jnp.zeros(3), jnp.zeros(4, dtype=jnp.int32))
+        with pytest.raises(ValueError, match="`top_k`"):
+            ours_f.retrieval_precision(jnp.zeros(3), jnp.zeros(3, dtype=jnp.int32), top_k=-1)
+
+
+MODULES = [
+    ("RetrievalMAP", {}),
+    ("RetrievalMRR", {}),
+    ("RetrievalPrecision", {"top_k": 2}),
+    ("RetrievalRecall", {"top_k": 2}),
+    ("RetrievalHitRate", {"top_k": 2}),
+    ("RetrievalFallOut", {"top_k": 2}),
+    ("RetrievalRPrecision", {}),
+    ("RetrievalNormalizedDCG", {}),
+    ("RetrievalAUROC", {}),
+]
+
+
+class TestRetrievalModules:
+    @pytest.mark.parametrize(("cls_name", "kwargs"), MODULES)
+    def test_against_reference(self, cls_name, kwargs):
+        idx = rng.randint(0, 10, 200)
+        p = rng.rand(200).astype(np.float32)
+        t = rng.randint(0, 2, 200)
+        ours = getattr(ours_m, cls_name)(**kwargs)
+        theirs = getattr(ref_m, cls_name)(**kwargs)
+        for i in range(0, 200, 100):
+            ours.update(jnp.asarray(p[i : i + 100]), jnp.asarray(t[i : i + 100]), indexes=jnp.asarray(idx[i : i + 100]))
+            theirs.update(torch.tensor(p[i : i + 100]), torch.tensor(t[i : i + 100]), indexes=torch.tensor(idx[i : i + 100]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+    @pytest.mark.parametrize("aggregation", ["mean", "median", "min", "max"])
+    def test_aggregation(self, aggregation):
+        idx = rng.randint(0, 5, 100)
+        p = rng.rand(100).astype(np.float32)
+        t = rng.randint(0, 2, 100)
+        ours = ours_m.RetrievalMAP(aggregation=aggregation)
+        theirs = ref_m.RetrievalMAP(aggregation=aggregation)
+        ours.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        theirs.update(torch.tensor(p), torch.tensor(t), indexes=torch.tensor(idx))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+    @pytest.mark.parametrize("empty_target_action", ["neg", "pos", "skip"])
+    def test_empty_target_action(self, empty_target_action):
+        idx = np.array([0, 0, 1, 1])
+        p = np.array([0.1, 0.2, 0.3, 0.4], dtype=np.float32)
+        t = np.array([0, 0, 1, 0])  # query 0 has no positives
+        ours = ours_m.RetrievalMAP(empty_target_action=empty_target_action)
+        theirs = ref_m.RetrievalMAP(empty_target_action=empty_target_action)
+        ours.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        theirs.update(torch.tensor(p), torch.tensor(t), indexes=torch.tensor(idx))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+    def test_empty_target_error(self):
+        ours = ours_m.RetrievalMAP(empty_target_action="error")
+        ours.update(jnp.asarray([0.1, 0.2]), jnp.asarray([0, 0]), indexes=jnp.asarray([0, 0]))
+        with pytest.raises(ValueError, match="no positive target"):
+            ours.compute()
+
+    def test_ignore_index(self):
+        idx = np.array([0, 0, 0, 1, 1, 1])
+        p = np.array([0.1, 0.2, 0.3, 0.4, 0.5, 0.6], dtype=np.float32)
+        t = np.array([0, 1, -1, 1, 0, -1])
+        ours = ours_m.RetrievalMAP(ignore_index=-1)
+        theirs = ref_m.RetrievalMAP(ignore_index=-1)
+        ours.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        theirs.update(torch.tensor(p), torch.tensor(t), indexes=torch.tensor(idx))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+    def test_precision_recall_curve_module(self):
+        idx = rng.randint(0, 10, 200)
+        p = rng.rand(200).astype(np.float32)
+        t = rng.randint(0, 2, 200)
+        ours = ours_m.RetrievalPrecisionRecallCurve(max_k=5)
+        theirs = ref_m.RetrievalPrecisionRecallCurve(max_k=5)
+        ours.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        theirs.update(torch.tensor(p), torch.tensor(t), indexes=torch.tensor(idx))
+        op, orr, ok_ = ours.compute()
+        rp, rr_, rk = theirs.compute()
+        _assert_allclose(op, rp.numpy(), atol=1e-4)
+        _assert_allclose(orr, rr_.numpy(), atol=1e-4)
+
+    def test_recall_at_fixed_precision(self):
+        idx = rng.randint(0, 10, 200)
+        p = rng.rand(200).astype(np.float32)
+        t = rng.randint(0, 2, 200)
+        ours = ours_m.RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=5)
+        theirs = ref_m.RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=5)
+        ours.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        theirs.update(torch.tensor(p), torch.tensor(t), indexes=torch.tensor(idx))
+        orc, obk = ours.compute()
+        rrc, rbk = theirs.compute()
+        _assert_allclose(orc, rrc.numpy(), atol=1e-4)
+        assert int(obk) == int(rbk)

@@ -1,6 +1,6 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import classification, clustering, image, nominal, pairwise, regression, segmentation, text
+from torchmetrics_tpu.functional import classification, clustering, image, nominal, pairwise, regression, retrieval, segmentation, text
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
@@ -15,6 +15,8 @@ from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import __all__ as _segmentation_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
@@ -25,6 +27,7 @@ __all__ = [
     "image",
     "pairwise",
     "regression",
+    "retrieval",
     "segmentation",
     "text",
     *_classification_all,
@@ -33,6 +36,7 @@ __all__ = [
     *_image_all,
     *_pairwise_all,
     *_regression_all,
+    *_retrieval_all,
     *_segmentation_all,
     *_text_all,
 ]

@@ -99,10 +99,12 @@ def expected_mutual_info_score(contingency: np.ndarray, n_samples: int) -> Array
     try:  # scipy is optional (not in the base deps); its f64 gammaln is preferred
         from scipy.special import gammaln
     except ModuleNotFoundError:
-        from jax.scipy.special import gammaln as _gammaln
+        import math
+
+        _lgamma = np.vectorize(math.lgamma, otypes=[np.float64])
 
         def gammaln(x):
-            return np.asarray(_gammaln(jnp.asarray(x, dtype=jnp.float32)))
+            return _lgamma(np.asarray(x, dtype=np.float64))
 
     term1 = nijs / n_samples
     log_a = np.log(a)

@@ -28,6 +28,15 @@ from torchmetrics_tpu.functional.nominal.utils import (
 Array = jax.Array
 
 
+def _nominal_pair_preamble(preds, target, nan_strategy, nan_replace_value):
+    """Shared input pipeline: argmax 2D inputs, handle NaNs."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    return _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+
+
 def _nominal_confmat_update(
     preds: Array,
     target: Array,
@@ -35,35 +44,20 @@ def _nominal_confmat_update(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Shared nominal-pair update: argmax 2D inputs, handle NaNs, accumulate confmat."""
-    preds = jnp.asarray(preds)
-    target = jnp.asarray(target)
-    preds = preds.argmax(1) if preds.ndim == 2 else preds
-    target = target.argmax(1) if target.ndim == 2 else target
-    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    """Fixed-num_classes nominal update (the module path's psum-able state)."""
+    preds, target = _nominal_pair_preamble(preds, target, nan_strategy, nan_replace_value)
     preds = preds.astype(jnp.int32)
     target = target.astype(jnp.int32)
     valid = jnp.ones_like(preds, dtype=bool)
     return _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
 
 
-_cramers_v_update = _nominal_confmat_update
-_pearsons_contingency_coefficient_update = _nominal_confmat_update
-_tschuprows_t_update = _nominal_confmat_update
-_theils_u_update = _nominal_confmat_update
-
-
 def _prepare_nominal_confmat(preds, target, nan_strategy, nan_replace_value):
-    """NaN-handle, densify category values to 0..C-1, and build the contingency table
-    (reference counts classes as ``len(unique(cat(preds, target)))`` after NaN
-    handling)."""
+    """Functional-path update: densify category values to 0..C-1 first (reference
+    counts classes as ``len(unique(cat(preds, target)))`` after NaN handling)."""
     import numpy as np
 
-    preds = jnp.asarray(preds)
-    target = jnp.asarray(target)
-    preds = preds.argmax(1) if preds.ndim == 2 else preds
-    target = target.argmax(1) if target.ndim == 2 else target
-    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    preds, target = _nominal_pair_preamble(preds, target, nan_strategy, nan_replace_value)
     joint = np.concatenate([np.asarray(preds), np.asarray(target)])
     classes, inverse = np.unique(joint, return_inverse=True)
     n = np.asarray(preds).shape[0]

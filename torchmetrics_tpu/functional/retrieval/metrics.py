@@ -1,0 +1,311 @@
+"""Single-query retrieval metrics.
+
+Parity: reference ``src/torchmetrics/functional/retrieval/{average_precision,precision,
+recall,hit_rate,fall_out,reciprocal_rank,r_precision,auroc,ndcg,
+precision_recall_curve}.py``.
+
+Each function scores one query's 1D ``preds``/``target`` pair; the module layer's
+segment engine maps them over the (dynamic) query groups at compute time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    """Validate one query's scores/labels and normalize dtypes."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target:
+        if jnp.issubdtype(target.dtype, jnp.floating):
+            raise ValueError("`target` must be a tensor of booleans or integers")
+        if target.size and (int(target.max()) > 1 or int(target.min()) < 0):
+            raise ValueError("`target` must contain `binary` values")
+    target = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
+    return preds.astype(jnp.float32).ravel(), target.ravel()
+
+
+def _top_k_arg(top_k: Optional[int], default: int) -> int:
+    if top_k is None:
+        return default
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+    return top_k
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Compute average precision for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_average_precision
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_average_precision(preds, target).round(4)
+        Array(0.8333, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+    k = min(top_k, preds.shape[-1])
+
+    order = jnp.argsort(-preds)[:k]
+    target_sorted = target[order]
+    hits = target_sorted > 0
+    positions = jnp.arange(1, k + 1, dtype=jnp.float32)
+    precision_at_hit = jnp.cumsum(hits, axis=0) / positions
+    num_hits = hits.sum()
+    return jnp.where(num_hits > 0, jnp.sum(precision_at_hit * hits) / jnp.maximum(num_hits, 1), 0.0)
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Compute precision@k for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_precision
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_precision(preds, target, top_k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if top_k is None or (adaptive_k and top_k > preds.shape[-1]):
+        top_k = preds.shape[-1]
+    if not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+    relevant = target[jnp.argsort(-preds)][: min(top_k, preds.shape[-1])].sum().astype(jnp.float32)
+    return jnp.where(target.sum() > 0, relevant / top_k, 0.0)
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Compute recall@k for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_recall
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_recall(preds, target, top_k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+
+    relevant = target[jnp.argsort(-preds)][:top_k].sum().astype(jnp.float32)
+    return jnp.where(target.sum() > 0, relevant / jnp.maximum(target.sum(), 1), 0.0)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Compute hit-rate@k for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_hit_rate
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_hit_rate(preds, target, top_k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+    relevant = target[jnp.argsort(-preds)][:top_k].sum()
+    return (relevant > 0).astype(jnp.float32)
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Compute fall-out@k for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_fall_out
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_fall_out(preds, target, top_k=2)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+
+    target = 1 - target
+    relevant = target[jnp.argsort(-preds)][:top_k].sum().astype(jnp.float32)
+    return jnp.where(target.sum() > 0, relevant / jnp.maximum(target.sum(), 1), 0.0)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Compute the reciprocal rank for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_reciprocal_rank
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([False, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+    k = min(top_k, preds.shape[-1])
+
+    target_sorted = target[jnp.argsort(-preds)[:k]]
+    hits = target_sorted > 0
+    first = jnp.argmax(hits)
+    return jnp.where(hits.sum() > 0, 1.0 / (first + 1.0), 0.0)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Compute R-precision for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_r_precision
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_r_precision(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    relevant_number = int(target.sum())
+    if not relevant_number:
+        return jnp.asarray(0.0)
+    relevant = target[jnp.argsort(-preds)][:relevant_number].sum().astype(jnp.float32)
+    return relevant / relevant_number
+
+
+def retrieval_auroc(
+    preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    """Compute AUROC over a single query's retrieved documents.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_auroc
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_auroc(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    from torchmetrics_tpu.functional.classification import binary_auroc
+
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+    k = min(top_k, preds.shape[-1])
+
+    top_k_idx = jnp.argsort(-preds)[:k]
+    target = target[top_k_idx]
+    t_host = np.asarray(target)
+    if (0 not in t_host) or (1 not in t_host):
+        return jnp.asarray(0.0)
+    preds = preds[top_k_idx]
+    return binary_auroc(preds, target.astype(jnp.int32), max_fpr=max_fpr)
+
+
+def _dcg_sample_scores(target: Array, preds: Array, top_k: int, ignore_ties: bool) -> Array:
+    """Discounted cumulative gain (sklearn's tie-aware formulation)."""
+    n = target.shape[-1]
+    discount = 1.0 / jnp.log2(jnp.arange(n) + 2.0)
+    discount = jnp.where(jnp.arange(n) < top_k, discount, 0.0)
+
+    if ignore_ties:
+        ranking = jnp.argsort(-preds)
+        ranked = target[ranking].astype(jnp.float32)
+        return (discount * ranked).sum()
+
+    # average over tied prediction groups
+    discount_cumsum = jnp.cumsum(discount)
+    neg = np.asarray(-preds)
+    _, inv, counts = np.unique(neg, return_inverse=True, return_counts=True)
+    inv = jnp.asarray(inv)
+    counts = jnp.asarray(counts)
+    num_groups = counts.shape[0]
+    ranked = jnp.zeros(num_groups, dtype=jnp.float32).at[inv].add(target.astype(jnp.float32))
+    ranked = ranked / counts
+    groups = jnp.cumsum(counts) - 1
+    group_discounts = discount_cumsum[groups]
+    discount_sums = jnp.concatenate([group_discounts[:1], jnp.diff(group_discounts)])
+    return (ranked * discount_sums).sum()
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Compute normalized DCG for a single query (graded relevance supported).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_normalized_dcg
+        >>> preds = jnp.array([.1, .2, .3, 4, 70])
+        >>> target = jnp.array([10, 0, 0, 1, 5])
+        >>> retrieval_normalized_dcg(preds, target).round(4)
+        Array(0.6957, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    top_k = _top_k_arg(top_k, preds.shape[-1])
+
+    gain = _dcg_sample_scores(target, preds, top_k, ignore_ties=False)
+    normalized_gain = _dcg_sample_scores(target, target.astype(jnp.float32), top_k, ignore_ties=True)
+    return jnp.where(normalized_gain == 0, 0.0, gain / jnp.where(normalized_gain == 0, 1.0, normalized_gain))
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Compute precision/recall@k curves for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_precision_recall_curve
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> precisions, recalls, top_k = retrieval_precision_recall_curve(preds, target, max_k=2)
+        >>> precisions
+        Array([1. , 0.5], dtype=float32)
+        >>> recalls
+        Array([0.5, 0.5], dtype=float32)
+        >>> top_k
+        Array([1, 2], dtype=int32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is None:
+        max_k = preds.shape[-1]
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+
+    n = preds.shape[-1]
+    if adaptive_k and max_k > n:
+        topk = jnp.concatenate(
+            [jnp.arange(1, n + 1), jnp.full(max_k - n, n, dtype=jnp.int32)]
+        ).astype(jnp.int32)
+    else:
+        topk = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+
+    if not int(target.sum()):
+        return jnp.zeros(max_k), jnp.zeros(max_k), topk
+
+    k = min(max_k, n)
+    relevant = target[jnp.argsort(-preds)[:k]].astype(jnp.float32)
+    relevant = jnp.pad(relevant, (0, max(0, max_k - k)))
+    relevant = jnp.cumsum(relevant)
+
+    recall = relevant / target.sum()
+    precision = relevant / topk
+    return precision, recall, topk

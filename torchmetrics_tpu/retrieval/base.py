@@ -1,0 +1,182 @@
+"""The retrieval metric base: group-by-query segment engine.
+
+Parity: reference ``src/torchmetrics/retrieval/base.py`` (aggregation ``:24-41``,
+``RetrievalMetric`` ``:44-207``).
+
+Design: ``indexes/preds/target`` accumulate as "cat" list states; ``compute`` sorts by
+query id on host (group sizes are data-dependent) and maps the per-query functional over
+the segments, exactly the reference's epoch-end evaluation model.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _retrieval_aggregate(values: Array, aggregation: Union[str, Callable] = "mean", dim: Optional[int] = None) -> Array:
+    """Aggregate per-query scores: mean/median/min/max or a custom callable."""
+    if aggregation == "mean":
+        return values.mean() if dim is None else values.mean(axis=dim)
+    if aggregation == "median":
+        # torch.median semantics: the lower of the two middle elements
+        if dim is None:
+            flat = jnp.sort(values.ravel())
+            return flat[(flat.shape[0] - 1) // 2]
+        sorted_vals = jnp.sort(values, axis=dim)
+        return jnp.take(sorted_vals, (values.shape[dim] - 1) // 2, axis=dim)
+    if aggregation == "min":
+        return values.min() if dim is None else values.min(axis=dim)
+    if aggregation == "max":
+        return values.max() if dim is None else values.max(axis=dim)
+    return aggregation(values, dim=dim)
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Validate and flatten an (indexes, preds, target) triple."""
+    indexes = jnp.asarray(indexes)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+
+    indexes = indexes.ravel()
+    preds = preds.ravel()
+    target = target.ravel()
+
+    if ignore_index is not None:
+        valid = np.asarray(target != ignore_index)
+        indexes = jnp.asarray(np.asarray(indexes)[valid])
+        preds = jnp.asarray(np.asarray(preds)[valid])
+        target = jnp.asarray(np.asarray(target)[valid])
+
+    if indexes.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target:
+        if jnp.issubdtype(target.dtype, jnp.floating):
+            raise ValueError("`target` must be a tensor of booleans or integers")
+        if int(target.max()) > 1 or int(target.min()) < 0:
+            raise ValueError("`target` must contain `binary` values")
+
+    target = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
+    return indexes.astype(jnp.int32), preds.astype(jnp.float32), target
+
+
+def _group_by_query(indexes, preds, target) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Host-side group-by-query over flattened triples (dynamic group sizes)."""
+    indexes = np.asarray(indexes)
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    order = np.argsort(indexes, kind="stable")
+    indexes, preds, target = indexes[order], preds[order], target[order]
+    boundaries = np.flatnonzero(np.diff(indexes)) + 1
+    return list(zip(np.split(preds, boundaries), np.split(target, boundaries)))
+
+
+class RetrievalMetric(Metric, ABC):
+    """Base for query-grouped retrieval metrics (binary targets).
+
+    Subclasses implement ``_metric(preds, target)`` over one query's documents.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    indexes: List[Array]
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.allow_non_binary_target = False
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable function"
+                f"which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
+
+        self.add_state("indexes", [], dist_reduce_fx=None)
+        self.add_state("preds", [], dist_reduce_fx=None)
+        self.add_state("target", [], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Validate, flatten and store the batch triple."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
+            ignore_index=self.ignore_index,
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def _group_segments(self) -> List[Tuple[Array, Array]]:
+        """Group accumulated state by query id: list of (preds, target) per query."""
+        groups = _group_by_query(
+            dim_zero_cat(self.indexes), dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        )
+        return [(jnp.asarray(p), jnp.asarray(t)) for p, t in groups]
+
+    def _empty_query_check(self, target: Array) -> bool:
+        """True when the query lacks the targets this metric needs (positives)."""
+        return not float(jnp.sum(target))
+
+    def compute(self) -> Array:
+        """Group by query, score each group, aggregate."""
+        res = []
+        for mini_preds, mini_target in self._group_segments():
+            if self._empty_query_check(mini_target):
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    res.append(jnp.asarray(1.0))
+                elif self.empty_target_action == "neg":
+                    res.append(jnp.asarray(0.0))
+            else:
+                res.append(self._metric(mini_preds, mini_target))
+
+        if res:
+            return _retrieval_aggregate(jnp.stack([jnp.asarray(x, dtype=jnp.float32) for x in res]), self.aggregation)
+        return jnp.asarray(0.0)
+
+    @abstractmethod
+    def _metric(self, preds: Array, target: Array) -> Array:
+        """Score one query's documents."""

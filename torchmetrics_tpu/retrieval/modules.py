@@ -1,0 +1,412 @@
+"""Retrieval metric modules.
+
+Parity: reference ``src/torchmetrics/retrieval/{average_precision,precision,recall,
+hit_rate,fall_out,reciprocal_rank,r_precision,auroc,ndcg,precision_recall_curve}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.retrieval.metrics import (
+    retrieval_auroc,
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval.base import RetrievalMetric, _check_retrieval_inputs
+
+Array = jax.Array
+
+
+def _validate_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+class RetrievalMAP(RetrievalMetric):
+    r"""Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap(preds, target, indexes=indexes).round(4)
+        Array(0.7917, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_average_precision(preds, target, top_k=self.top_k)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    r"""Mean precision@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecision
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalPrecision(top_k=2)
+        >>> p2(preds, target, indexes=indexes)
+        Array(0.5, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, adaptive_k: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_precision(preds, target, top_k=self.top_k, adaptive_k=self.adaptive_k)
+
+
+class RetrievalRecall(RetrievalMetric):
+    r"""Mean recall@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecall
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> r2 = RetrievalRecall(top_k=2)
+        >>> r2(preds, target, indexes=indexes).round(4)
+        Array(0.75, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_recall(preds, target, top_k=self.top_k)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    r"""Mean hit-rate@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalHitRate
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([True, False, False, False, True, False, True])
+        >>> hr2 = RetrievalHitRate(top_k=2)
+        >>> hr2(preds, target, indexes=indexes)
+        Array(0.5, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_hit_rate(preds, target, top_k=self.top_k)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    r"""Mean fall-out@k over queries (empty-target queries are those with no negatives).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalFallOut
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> fo2 = RetrievalFallOut(top_k=2)
+        >>> fo2(preds, target, indexes=indexes).round(4)
+        Array(0.5, dtype=float32)
+    """
+
+    higher_is_better = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _empty_query_check(self, target: Array) -> bool:
+        """Fall-out needs at least one negative target."""
+        return not int(jnp.sum(1 - target))
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_fall_out(preds, target, top_k=self.top_k)
+
+
+class RetrievalMRR(RetrievalMetric):
+    r"""Mean reciprocal rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> mrr(preds, target, indexes=indexes).round(4)
+        Array(0.75, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_reciprocal_rank(preds, target, top_k=self.top_k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    r"""Mean R-precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalRPrecision
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> rp = RetrievalRPrecision()
+        >>> rp(preds, target, indexes=indexes).round(4)
+        Array(0.75, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_r_precision(preds, target)
+
+
+class RetrievalAUROC(RetrievalMetric):
+    r"""Mean AUROC over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalAUROC
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> auroc = RetrievalAUROC()
+        >>> auroc(preds, target, indexes=indexes).round(4)
+        Array(0.8333, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, max_fpr: Optional[float] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.top_k = top_k
+        self.max_fpr = max_fpr
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_auroc(preds, target, top_k=self.top_k, max_fpr=self.max_fpr)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    r"""Mean normalized DCG over queries (graded relevance supported).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalNormalizedDCG
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> ndcg(preds, target, indexes=indexes).round(4)
+        Array(0.854, dtype=float32)
+    """
+
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+        self.allow_non_binary_target = True
+
+    def _metric(self, preds: Array, target: Array) -> Array:
+        return retrieval_normalized_dcg(preds, target, top_k=self.top_k)
+
+
+class RetrievalPrecisionRecallCurve(Metric):
+    r"""Averaged precision/recall@k curves over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> curve = RetrievalPrecisionRecallCurve(max_k=2)
+        >>> precisions, recalls, top_k = curve(preds, target, indexes=indexes)
+        >>> top_k.tolist()
+        [1, 2]
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    indexes: List[Array]
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        self.max_k = max_k
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", [], dist_reduce_fx=None)
+        self.add_state("preds", [], dist_reduce_fx=None)
+        self.add_state("target", [], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        """Validate, flatten and store the batch triple."""
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, ignore_index=self.ignore_index
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Mean precision/recall@k over all queries."""
+        from torchmetrics_tpu.retrieval.base import _group_by_query
+        from torchmetrics_tpu.utils.data import dim_zero_cat
+
+        groups = _group_by_query(
+            dim_zero_cat(self.indexes), dim_zero_cat(self.preds), dim_zero_cat(self.target)
+        )
+
+        max_k = self.max_k or max(len(p) for p, _ in groups)
+
+        precisions, recalls = [], []
+        for mini_preds, mini_target in groups:
+            if not mini_target.sum():
+                if self.empty_target_action == "error":
+                    raise ValueError("`compute` method was provided with a query with no positive target.")
+                if self.empty_target_action == "pos":
+                    recalls.append(jnp.ones(max_k))
+                    precisions.append(jnp.ones(max_k))
+                elif self.empty_target_action == "neg":
+                    recalls.append(jnp.zeros(max_k))
+                    precisions.append(jnp.zeros(max_k))
+            else:
+                precision, recall, _ = retrieval_precision_recall_curve(
+                    jnp.asarray(mini_preds), jnp.asarray(mini_target), max_k, self.adaptive_k
+                )
+                precisions.append(precision)
+                recalls.append(recall)
+
+        precision = (
+            jnp.stack(precisions).mean(axis=0) if precisions else jnp.zeros(max_k)
+        )
+        recall = jnp.stack(recalls).mean(axis=0) if recalls else jnp.zeros(max_k)
+        top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+        return precision, recall, top_k
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    r"""Max recall@k subject to a minimum precision, with the best k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
+        >>> recall, best_k = metric(preds, target, indexes=indexes)
+        >>> int(best_k)
+        1
+    """
+
+    def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(max_k=max_k, **kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        """Best recall meeting the precision floor."""
+        precisions, recalls, top_k = super().compute()
+        candidates = [
+            (float(r), int(k)) for p, r, k in zip(precisions, recalls, top_k) if float(p) >= self.min_precision
+        ]
+        if candidates:
+            max_recall, best_k = max(candidates)
+        else:
+            max_recall, best_k = 0.0, len(top_k)
+        if max_recall == 0.0:
+            best_k = len(top_k)
+        return jnp.asarray(max_recall, dtype=jnp.float32), jnp.asarray(best_k, dtype=jnp.int32)
