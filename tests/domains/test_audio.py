@@ -1,0 +1,206 @@
+"""Audio metric tests: differential vs the upstream reference + jit/mesh checks."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+import torchmetrics.functional.audio as ref_f  # noqa: E402
+
+import torchmetrics_tpu.functional.audio as ours_f  # noqa: E402
+from torchmetrics_tpu.audio import (  # noqa: E402
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+
+rng = np.random.RandomState(42)
+TARGET = rng.randn(3, 4000).astype(np.float32)
+PREDS = (TARGET + 0.5 * rng.randn(3, 4000)).astype(np.float32)
+
+
+class TestSnrSdrFunctional:
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_snr(self, zero_mean):
+        r = ref_f.signal_noise_ratio(torch.tensor(PREDS), torch.tensor(TARGET), zero_mean=zero_mean)
+        o = ours_f.signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), zero_mean=zero_mean)
+        _assert_allclose(o, r.numpy(), atol=1e-3)
+
+    def test_si_snr(self):
+        r = ref_f.scale_invariant_signal_noise_ratio(torch.tensor(PREDS), torch.tensor(TARGET))
+        o = ours_f.scale_invariant_signal_noise_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        _assert_allclose(o, r.numpy(), atol=1e-3)
+
+    @pytest.mark.parametrize("zero_mean", [False, True])
+    def test_si_sdr(self, zero_mean):
+        r = ref_f.scale_invariant_signal_distortion_ratio(
+            torch.tensor(PREDS), torch.tensor(TARGET), zero_mean=zero_mean
+        )
+        o = ours_f.scale_invariant_signal_distortion_ratio(
+            jnp.asarray(PREDS), jnp.asarray(TARGET), zero_mean=zero_mean
+        )
+        _assert_allclose(o, r.numpy(), atol=1e-3)
+
+    @pytest.mark.parametrize("load_diag", [None, 0.001])
+    def test_sdr(self, load_diag):
+        r = ref_f.signal_distortion_ratio(torch.tensor(PREDS), torch.tensor(TARGET), load_diag=load_diag)
+        o = ours_f.signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET), load_diag=load_diag)
+        _assert_allclose(o, r.numpy(), atol=1e-2)
+
+    def test_c_si_snr(self):
+        pc = rng.randn(2, 129, 50, 2).astype(np.float32)
+        tc = rng.randn(2, 129, 50, 2).astype(np.float32)
+        r = ref_f.complex_scale_invariant_signal_noise_ratio(torch.tensor(pc), torch.tensor(tc))
+        o = ours_f.complex_scale_invariant_signal_noise_ratio(jnp.asarray(pc), jnp.asarray(tc))
+        _assert_allclose(o, r.numpy(), atol=1e-3)
+
+    @pytest.mark.parametrize("scale_invariant", [True, False])
+    def test_sa_sdr(self, scale_invariant):
+        pm = rng.randn(4, 2, 1000).astype(np.float32)
+        tm = rng.randn(4, 2, 1000).astype(np.float32)
+        r = ref_f.source_aggregated_signal_distortion_ratio(
+            torch.tensor(pm), torch.tensor(tm), scale_invariant=scale_invariant
+        )
+        o = ours_f.source_aggregated_signal_distortion_ratio(
+            jnp.asarray(pm), jnp.asarray(tm), scale_invariant=scale_invariant
+        )
+        _assert_allclose(o, r.numpy(), atol=1e-3)
+
+    def test_si_sdr_jit(self):
+        f = jax.jit(ours_f.scale_invariant_signal_distortion_ratio)
+        o = f(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        eager = ours_f.scale_invariant_signal_distortion_ratio(jnp.asarray(PREDS), jnp.asarray(TARGET))
+        _assert_allclose(o, eager, atol=1e-5)
+
+
+class TestPIT:
+    @pytest.mark.parametrize("eval_func", ["max", "min"])
+    def test_speaker_wise(self, eval_func):
+        pm = rng.randn(4, 2, 500).astype(np.float32)
+        tm = rng.randn(4, 2, 500).astype(np.float32)
+        rm, rp = ref_f.permutation_invariant_training(
+            torch.tensor(pm), torch.tensor(tm), ref_f.scale_invariant_signal_distortion_ratio, eval_func=eval_func
+        )
+        om, op = ours_f.permutation_invariant_training(
+            jnp.asarray(pm), jnp.asarray(tm), ours_f.scale_invariant_signal_distortion_ratio, eval_func=eval_func
+        )
+        _assert_allclose(om, rm.numpy(), atol=1e-3)
+        assert np.array_equal(np.asarray(op), rp.numpy())
+
+    def test_permutation_wise(self):
+        pm = rng.randn(4, 2, 500).astype(np.float32)
+        tm = rng.randn(4, 2, 500).astype(np.float32)
+        rm, _ = ref_f.permutation_invariant_training(
+            torch.tensor(pm), torch.tensor(tm), ref_f.source_aggregated_signal_distortion_ratio,
+            mode="permutation-wise",
+        )
+        om, _ = ours_f.permutation_invariant_training(
+            jnp.asarray(pm), jnp.asarray(tm), ours_f.source_aggregated_signal_distortion_ratio,
+            mode="permutation-wise",
+        )
+        _assert_allclose(om, rm.numpy(), atol=1e-3)
+
+    def test_four_speakers_lsa_path(self):
+        pm = rng.randn(2, 4, 300).astype(np.float32)
+        tm = rng.randn(2, 4, 300).astype(np.float32)
+        rm, _ = ref_f.permutation_invariant_training(
+            torch.tensor(pm), torch.tensor(tm), ref_f.scale_invariant_signal_distortion_ratio
+        )
+        om, _ = ours_f.permutation_invariant_training(
+            jnp.asarray(pm), jnp.asarray(tm), ours_f.scale_invariant_signal_distortion_ratio
+        )
+        _assert_allclose(om, rm.numpy(), atol=1e-3)
+
+    def test_pit_permutate(self):
+        preds = jnp.asarray(rng.randn(3, 2, 10).astype(np.float32))
+        perm = jnp.array([[1, 0], [0, 1], [1, 0]])
+        out = ours_f.pit_permutate(preds, perm)
+        assert np.allclose(np.asarray(out[0, 0]), np.asarray(preds[0, 1]))
+
+
+class TestAudioModules:
+    @pytest.mark.parametrize(
+        ("ours_cls", "ref_name", "kwargs"),
+        [
+            (SignalNoiseRatio, "SignalNoiseRatio", {}),
+            (ScaleInvariantSignalNoiseRatio, "ScaleInvariantSignalNoiseRatio", {}),
+            (ScaleInvariantSignalDistortionRatio, "ScaleInvariantSignalDistortionRatio", {}),
+            (SignalDistortionRatio, "SignalDistortionRatio", {}),
+        ],
+    )
+    def test_accumulation(self, ours_cls, ref_name, kwargs):
+        ours = ours_cls(**kwargs)
+        theirs = getattr(tm_ref.audio, ref_name)(**kwargs)
+        for i in range(3):
+            ours.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+            theirs.update(torch.tensor(PREDS[i]), torch.tensor(TARGET[i]))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-2)
+
+    def test_sa_sdr_module(self):
+        pm = rng.randn(4, 2, 1000).astype(np.float32)
+        tm = rng.randn(4, 2, 1000).astype(np.float32)
+        ours = SourceAggregatedSignalDistortionRatio()
+        theirs = tm_ref.audio.SourceAggregatedSignalDistortionRatio()
+        ours.update(jnp.asarray(pm), jnp.asarray(tm))
+        theirs.update(torch.tensor(pm), torch.tensor(tm))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-3)
+
+    def test_c_si_snr_module(self):
+        pc = rng.randn(2, 129, 50, 2).astype(np.float32)
+        tc = rng.randn(2, 129, 50, 2).astype(np.float32)
+        ours = ComplexScaleInvariantSignalNoiseRatio()
+        theirs = tm_ref.audio.ComplexScaleInvariantSignalNoiseRatio()
+        ours.update(jnp.asarray(pc), jnp.asarray(tc))
+        theirs.update(torch.tensor(pc), torch.tensor(tc))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-3)
+
+    def test_pit_module(self):
+        pm = rng.randn(4, 2, 500).astype(np.float32)
+        tm = rng.randn(4, 2, 500).astype(np.float32)
+        ours = PermutationInvariantTraining(ours_f.scale_invariant_signal_distortion_ratio)
+        theirs = tm_ref.audio.PermutationInvariantTraining(ref_f.scale_invariant_signal_distortion_ratio)
+        ours.update(jnp.asarray(pm), jnp.asarray(tm))
+        theirs.update(torch.tensor(pm), torch.tensor(tm))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-3)
+
+    def test_external_metrics_gated(self):
+        from torchmetrics_tpu.audio import PerceptualEvaluationSpeechQuality
+
+        pesq = PerceptualEvaluationSpeechQuality(fs=16000, mode="wb")
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            pesq.update(jnp.zeros(16000), jnp.zeros(16000))
+
+    def test_snr_mesh_distributed(self):
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        n_dev = len(jax.devices())
+        t = rng.randn(n_dev * 2, 1000).astype(np.float32)
+        p = (t + 0.3 * rng.randn(n_dev * 2, 1000)).astype(np.float32)
+
+        metric = SignalNoiseRatio()
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+
+        def shard_step(state, pp, tt):
+            state = metric.pure_update(state, pp, tt)
+            synced = metric.sync_state(state, axis_name="data")
+            return metric.pure_compute(synced)
+
+        f = shard_map(shard_step, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False)
+        value = jax.jit(f)(metric.init_state(), jnp.asarray(p), jnp.asarray(t))
+
+        eager = SignalNoiseRatio()
+        eager.update(jnp.asarray(p), jnp.asarray(t))
+        _assert_allclose(value, eager.compute(), atol=1e-4)

@@ -1,0 +1,33 @@
+"""Audio metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/audio/__init__.py`` (11 classes; the four
+external-library metrics are dependency-gated).
+"""
+
+from torchmetrics_tpu.audio.modules import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    DeepNoiseSuppressionMeanOpinionScore,
+    PerceptualEvaluationSpeechQuality,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+    SpeechReverberationModulationEnergyRatio,
+)
+
+__all__ = [
+    "ComplexScaleInvariantSignalNoiseRatio",
+    "DeepNoiseSuppressionMeanOpinionScore",
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+    "SourceAggregatedSignalDistortionRatio",
+    "SpeechReverberationModulationEnergyRatio",
+]

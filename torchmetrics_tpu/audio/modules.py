@@ -1,0 +1,384 @@
+"""Audio metric modules.
+
+Parity: reference ``src/torchmetrics/audio/{snr,sdr,pit,pesq,stoi,srmr,dnsmos}.py`` —
+all are mean-of-per-sample-score metrics with ``sum``/``count`` psum states.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.audio.external import (
+    deep_noise_suppression_mean_opinion_score,
+    perceptual_evaluation_speech_quality,
+    short_time_objective_intelligibility,
+    speech_reverberation_modulation_energy_ratio,
+)
+from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
+from torchmetrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from torchmetrics_tpu.functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+
+Array = jax.Array
+
+
+class _MeanScoreMetric(Metric):
+    """Base for audio metrics that average a per-sample score."""
+
+    full_state_update = False
+
+    sum_score: Array
+    total: Array
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def _accumulate(self, scores: Array) -> None:
+        self.sum_score = self.sum_score + scores.sum()
+        self.total = self.total + scores.size
+
+    def compute(self) -> Array:
+        """Mean score over all samples."""
+        return self.sum_score / self.total
+
+
+class SignalNoiseRatio(_MeanScoreMetric):
+    r"""Signal-to-noise ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> snr = SignalNoiseRatio()
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> snr(preds, target).round(4)
+        Array(16.1805, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SNR."""
+        self._accumulate(signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean))
+
+    def _compute_group_params(self):
+        return (self.zero_mean,)
+
+
+class ScaleInvariantSignalNoiseRatio(_MeanScoreMetric):
+    r"""Scale-invariant signal-to-noise ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr(preds, target).round(4)
+        Array(15.0918, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SI-SNR."""
+        self._accumulate(scale_invariant_signal_noise_ratio(preds=preds, target=target))
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_MeanScoreMetric):
+    r"""Complex scale-invariant signal-to-noise ratio.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import ComplexScaleInvariantSignalNoiseRatio
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.normal(k1, (1, 257, 100, 2))
+        >>> target = jax.random.normal(k2, (1, 257, 100, 2))
+        >>> c_si_snr = ComplexScaleInvariantSignalNoiseRatio()
+        >>> float(c_si_snr(preds, target)) < 0
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample C-SI-SNR."""
+        self._accumulate(
+            complex_scale_invariant_signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        )
+
+    def _compute_group_params(self):
+        return (self.zero_mean,)
+
+
+class SignalDistortionRatio(_MeanScoreMetric):
+    r"""Signal-to-distortion ratio (BSS-eval).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import SignalDistortionRatio
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        >>> preds = jax.random.normal(k1, (8000,))
+        >>> target = jax.random.normal(k2, (8000,))
+        >>> sdr = SignalDistortionRatio()
+        >>> float(sdr(preds, target)) < 0
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SDR."""
+        self._accumulate(
+            signal_distortion_ratio(preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag)
+        )
+
+    def _compute_group_params(self):
+        return (self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag)
+
+
+class ScaleInvariantSignalDistortionRatio(_MeanScoreMetric):
+    r"""Scale-invariant signal-to-distortion ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = ScaleInvariantSignalDistortionRatio()
+        >>> si_sdr(preds, target).round(4)
+        Array(18.403, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SI-SDR."""
+        self._accumulate(scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean))
+
+    def _compute_group_params(self):
+        return (self.zero_mean,)
+
+
+class SourceAggregatedSignalDistortionRatio(_MeanScoreMetric):
+    r"""Source-aggregated signal-to-distortion ratio.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.normal(k1, (4, 2, 8000))
+        >>> target = jax.random.normal(k2, (4, 2, 8000))
+        >>> sa_sdr = SourceAggregatedSignalDistortionRatio()
+        >>> float(sa_sdr(preds, target)) < 0
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.scale_invariant = scale_invariant
+        self.zero_mean = zero_mean
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample SA-SDR."""
+        self._accumulate(
+            source_aggregated_signal_distortion_ratio(preds, target, self.scale_invariant, self.zero_mean)
+        )
+
+    def _compute_group_params(self):
+        return (self.scale_invariant, self.zero_mean)
+
+
+class PermutationInvariantTraining(_MeanScoreMetric):
+    r"""Permutation-invariant training metric wrapper.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import PermutationInvariantTraining
+        >>> from torchmetrics_tpu.functional.audio import (
+        ...     scale_invariant_signal_distortion_ratio)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.normal(k1, (4, 2, 100))
+        >>> target = jax.random.normal(k2, (4, 2, 100))
+        >>> pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio)
+        >>> float(pit(preds, target)) < 0
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = True
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k
+            in (
+                "compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
+                "distributed_available_fn", "sync_on_compute", "compute_with_cache", "jit_update",
+            )
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        if mode not in ("speaker-wise", "permutation-wise"):
+            raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate the per-sample best-permutation metric."""
+        pit_metric = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.kwargs
+        )[0]
+        self._accumulate(pit_metric)
+
+    def _compute_group_params(self):
+        return None
+
+
+class PerceptualEvaluationSpeechQuality(_MeanScoreMetric):
+    r"""PESQ (requires the external ``pesq`` library)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = -0.5
+    plot_upper_bound: float = 4.5
+
+    def __init__(
+        self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        self.fs = fs
+        self.mode = mode
+        self.n_processes = n_processes
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample PESQ scores (host callback)."""
+        self._accumulate(
+            perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode, n_processes=self.n_processes)
+        )
+
+    def _compute_group_params(self):
+        return (self.fs, self.mode)
+
+
+class ShortTimeObjectiveIntelligibility(_MeanScoreMetric):
+    r"""STOI (requires the external ``pystoi`` library)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.fs = fs
+        self.extended = extended
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-sample STOI scores (host callback)."""
+        self._accumulate(short_time_objective_intelligibility(preds, target, self.fs, self.extended))
+
+    def _compute_group_params(self):
+        return (self.fs, self.extended)
+
+
+class SpeechReverberationModulationEnergyRatio(_MeanScoreMetric):
+    r"""SRMR (requires the external ``srmrpy`` library)."""
+
+    is_differentiable = False
+    higher_is_better = True
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.fs = fs
+
+    def update(self, preds: Array) -> None:
+        """Accumulate per-sample SRMR scores (host callback)."""
+        self._accumulate(speech_reverberation_modulation_energy_ratio(preds, self.fs))
+
+    def _compute_group_params(self):
+        return (self.fs,)
+
+
+class DeepNoiseSuppressionMeanOpinionScore(_MeanScoreMetric):
+    r"""DNSMOS (requires ``onnxruntime`` + the DNS-challenge model assets)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound: float = 1.0
+    plot_upper_bound: float = 5.0
+
+    def __init__(self, fs: int, personalized: bool, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.fs = fs
+        self.personalized = personalized
+
+    def update(self, preds: Array) -> None:
+        """Accumulate per-sample DNSMOS scores (host callback)."""
+        self._accumulate(deep_noise_suppression_mean_opinion_score(preds, self.fs, self.personalized))
+
+    def _compute_group_params(self):
+        return (self.fs, self.personalized)

@@ -1,6 +1,8 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import classification, clustering, image, nominal, pairwise, regression, retrieval, segmentation, text
+from torchmetrics_tpu.functional import audio, classification, clustering, image, nominal, pairwise, regression, retrieval, segmentation, text
+from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.audio import __all__ as _audio_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
@@ -21,6 +23,7 @@ from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = [
+    "audio",
     "classification",
     "clustering",
     "nominal",
@@ -30,6 +33,7 @@ __all__ = [
     "retrieval",
     "segmentation",
     "text",
+    *_audio_all,
     *_classification_all,
     *_clustering_all,
     *_nominal_all,

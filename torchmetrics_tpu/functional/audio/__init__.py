@@ -1,0 +1,37 @@
+"""Functional audio metrics.
+
+Parity: reference ``src/torchmetrics/functional/audio/__init__.py``.
+"""
+
+from torchmetrics_tpu.functional.audio.external import (
+    deep_noise_suppression_mean_opinion_score,
+    perceptual_evaluation_speech_quality,
+    short_time_objective_intelligibility,
+    speech_reverberation_modulation_energy_ratio,
+)
+from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate
+from torchmetrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from torchmetrics_tpu.functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+
+__all__ = [
+    "complex_scale_invariant_signal_noise_ratio",
+    "deep_noise_suppression_mean_opinion_score",
+    "perceptual_evaluation_speech_quality",
+    "permutation_invariant_training",
+    "pit_permutate",
+    "scale_invariant_signal_distortion_ratio",
+    "scale_invariant_signal_noise_ratio",
+    "short_time_objective_intelligibility",
+    "signal_distortion_ratio",
+    "signal_noise_ratio",
+    "source_aggregated_signal_distortion_ratio",
+    "speech_reverberation_modulation_energy_ratio",
+]

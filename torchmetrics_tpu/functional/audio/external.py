@@ -1,0 +1,148 @@
+"""Dependency-gated perceptual audio metrics: PESQ, STOI, SRMR, DNSMOS.
+
+Parity: reference ``src/torchmetrics/functional/audio/{pesq,stoi,srmr,dnsmos}.py`` —
+these wrap external CPU C/ONNX libraries (`pesq`, `pystoi`, gammatone filterbanks,
+onnxruntime). As in the reference, the signal is round-tripped to host and scored by
+the external library; the gates below raise the same install hints when the library is
+absent (none are in this image).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utils.imports import _package_available
+
+Array = jax.Array
+
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_SRMRPY_AVAILABLE = _package_available("srmrpy")
+_ONNXRUNTIME_AVAILABLE = _package_available("onnxruntime")
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """Compute PESQ via the external ``pesq`` library (host callback).
+
+    Raises:
+        ModuleNotFoundError: If ``pesq`` is not installed.
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if preds_np.ndim == 1:
+        pesq_val = pesq_backend.pesq(fs, target_np, preds_np, mode)
+        return jnp.asarray(pesq_val, dtype=jnp.float32)
+
+    preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+    target_np = target_np.reshape(-1, target_np.shape[-1])
+    vals = [pesq_backend.pesq(fs, t, p, mode) for p, t in zip(preds_np, target_np)]
+    return jnp.asarray(vals, dtype=jnp.float32).reshape(jnp.asarray(preds).shape[:-1])
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """Compute STOI via the external ``pystoi`` library (host callback).
+
+    Raises:
+        ModuleNotFoundError: If ``pystoi`` is not installed.
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "STOI metric requires that `pystoi` is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    if preds_np.ndim == 1:
+        return jnp.asarray(stoi_backend(target_np, preds_np, fs, extended), dtype=jnp.float32)
+
+    preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+    target_np = target_np.reshape(-1, target_np.shape[-1])
+    vals = [stoi_backend(t, p, fs, extended) for p, t in zip(preds_np, target_np)]
+    return jnp.asarray(vals, dtype=jnp.float32).reshape(jnp.asarray(preds).shape[:-1])
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: Optional[float] = None,
+    norm: bool = False,
+    fast: bool = False,
+    **kwargs: Any,
+) -> Array:
+    """Compute SRMR via the external ``srmrpy`` library (host callback).
+
+    Raises:
+        ModuleNotFoundError: If ``srmrpy`` is not installed.
+    """
+    if not _SRMRPY_AVAILABLE:
+        raise ModuleNotFoundError(
+            "speech_reverberation_modulation_energy_ratio requires that srmrpy is installed."
+            " Install it with `pip install srmrpy`."
+        )
+    import srmrpy
+
+    preds_np = np.asarray(preds)
+    if preds_np.ndim == 1:
+        return jnp.asarray(srmrpy.srmr(preds_np, fs, n_cochlear_filters=n_cochlear_filters, fast=fast, norm=norm)[0])
+    vals = [
+        srmrpy.srmr(p, fs, n_cochlear_filters=n_cochlear_filters, fast=fast, norm=norm)[0]
+        for p in preds_np.reshape(-1, preds_np.shape[-1])
+    ]
+    return jnp.asarray(vals, dtype=jnp.float32).reshape(preds_np.shape[:-1])
+
+
+def deep_noise_suppression_mean_opinion_score(
+    preds: Array,
+    fs: int,
+    personalized: bool,
+    device: Optional[str] = None,
+    num_threads: Optional[int] = None,
+) -> Array:
+    """Compute DNSMOS via Microsoft's ONNX models (host callback).
+
+    Raises:
+        ModuleNotFoundError: If ``onnxruntime`` (and the model assets) are not available.
+    """
+    if not _ONNXRUNTIME_AVAILABLE:
+        raise ModuleNotFoundError(
+            "DNSMOS metric requires that `onnxruntime` is installed."
+            " Install it with `pip install onnxruntime`."
+        )
+    raise ModuleNotFoundError(
+        "DNSMOS additionally requires the Microsoft DNS-challenge ONNX model assets, which are"
+        " not bundled in this environment."
+    )

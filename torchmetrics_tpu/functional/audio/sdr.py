@@ -1,0 +1,164 @@
+"""Signal-to-distortion ratio family.
+
+Parity: reference ``src/torchmetrics/functional/audio/sdr.py`` (Toeplitz ``:28-54``,
+FFT correlations ``:57-87``, SDR ``:90-204``, SI-SDR ``:207-249``, SA-SDR ``:252-320``).
+
+TPU notes: the optimal distortion filter solves a symmetric Toeplitz system built from
+FFT auto/cross-correlations — all expressed as batched jnp ops (rfft/irfft, a gather
+-built Toeplitz, ``jnp.linalg.solve``), one jittable program. The reference computes in
+f64; TPUs have no fast f64, so the solve runs in the input precision (f32) — on random
+audio this costs ~1e-3 dB versus the reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix from its first row; shape [..., L] → [..., L, L]."""
+    v_len = vector.shape[-1]
+    i = jnp.arange(v_len)
+    idx = jnp.abs(i[:, None] - i[None, :])
+    return vector[..., idx]
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int) -> Tuple[Array, Array]:
+    """FFT-based autocorrelation of ``target`` and cross-correlation with ``preds``."""
+    n_fft = 2 ** math.ceil(math.log2(preds.shape[-1] + target.shape[-1] - 1))
+
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real**2 + t_fft.imag**2, n=n_fft)[..., :corr_len]
+
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return r_0, b
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    r"""Calculate the signal-to-distortion ratio (BSS-eval SDR) per sample.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.audio import signal_distortion_ratio
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(1))
+        >>> preds = jax.random.normal(k1, (8000,))
+        >>> target = jax.random.normal(k2, (8000,))
+        >>> float(signal_distortion_ratio(preds, target)) < 0
+        True
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+
+    if use_cg_iter is not None:
+        from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(
+            "The `use_cg_iter` option is not supported by the TPU implementation; the "
+            "direct Toeplitz solve is used instead."
+        )
+
+    if zero_mean:
+        preds = preds - preds.mean(axis=-1, keepdims=True)
+        target = target - target.mean(axis=-1, keepdims=True)
+
+    target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), min=1e-6)
+    preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), min=1e-6)
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+    if load_diag is not None:
+        r_0 = r_0.at[..., 0].add(load_diag)
+
+    r = _symmetric_toeplitz(r_0)
+    sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+
+    coh = jnp.einsum("...l,...l->...", b, sol)
+    ratio = coh / (1 - coh)
+    return 10.0 * jnp.log10(ratio)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Calculate the scale-invariant signal-to-distortion ratio per sample.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import (
+        ...     scale_invariant_signal_distortion_ratio)
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_distortion_ratio(preds, target).round(4)
+        Array(18.4030, dtype=float32)
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(jnp.square(target), axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(jnp.square(target_scaled), axis=-1) + eps) / (jnp.sum(jnp.square(noise), axis=-1) + eps)
+    return 10 * jnp.log10(val)
+
+
+def source_aggregated_signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    scale_invariant: bool = True,
+    zero_mean: bool = False,
+) -> Array:
+    """Calculate the source-aggregated SDR over all speakers of each sample.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.audio import (
+        ...     source_aggregated_signal_distortion_ratio)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.normal(k1, (4, 2, 8000))
+        >>> target = jax.random.normal(k2, (4, 2, 8000))
+        >>> source_aggregated_signal_distortion_ratio(preds, target).shape
+        (4,)
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise RuntimeError(f"The preds and target should have the shape (..., spk, time), but {preds.shape} found")
+
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    if scale_invariant:
+        alpha = ((preds * target).sum(axis=(-1, -2), keepdims=True) + eps) / (
+            jnp.square(target).sum(axis=(-1, -2), keepdims=True) + eps
+        )
+        target = alpha * target
+
+    distortion = target - preds
+    val = (jnp.square(target).sum(axis=(-1, -2)) + eps) / (jnp.square(distortion).sum(axis=(-1, -2)) + eps)
+    return 10 * jnp.log10(val)

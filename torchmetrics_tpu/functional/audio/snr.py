@@ -1,0 +1,90 @@
+"""Signal-to-noise ratio family.
+
+Parity: reference ``src/torchmetrics/functional/audio/snr.py`` (SNR ``:21-62``,
+SI-SNR ``:65-88``, C-SI-SNR ``:91-140``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+from torchmetrics_tpu.utils.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    r"""Calculate the signal-to-noise ratio in dB per sample.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import signal_noise_ratio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> signal_noise_ratio(preds, target).round(4)
+        Array(16.1805, dtype=float32)
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(jnp.square(target), axis=-1) + eps) / (jnp.sum(jnp.square(noise), axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """Calculate the scale-invariant signal-to-noise ratio in dB per sample.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> scale_invariant_signal_noise_ratio(preds, target).round(4)
+        Array(15.0918, dtype=float32)
+    """
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(
+    preds: Array, target: Array, zero_mean: bool = False
+) -> Array:
+    """Calculate the complex scale-invariant signal-to-noise ratio.
+
+    Accepts complex STFT tensors of shape ``(..., frequency, time)`` or real tensors of
+    shape ``(..., frequency, time, 2)``.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.audio import (
+        ...     complex_scale_invariant_signal_noise_ratio)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> preds = jax.random.normal(k1, (1, 257, 100, 2))
+        >>> target = jax.random.normal(k2, (1, 257, 100, 2))
+        >>> float(complex_scale_invariant_signal_noise_ratio(preds, target)) < 0
+        True
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)

@@ -132,9 +132,11 @@ class RetrievalMetric(Metric, ABC):
             )
         self.aggregation = aggregation
 
-        self.add_state("indexes", [], dist_reduce_fx=None)
-        self.add_state("preds", [], dist_reduce_fx=None)
-        self.add_state("target", [], dist_reduce_fx=None)
+        # "cat": list states must gather-concat across processes during sync (the
+        # upstream's dist_reduce_fx=None also gathers; this repo's None is identity)
+        self.add_state("indexes", [], dist_reduce_fx="cat")
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         """Validate, flatten and store the batch triple."""
@@ -148,16 +150,18 @@ class RetrievalMetric(Metric, ABC):
         self.preds.append(preds)
         self.target.append(target)
 
-    def _group_segments(self) -> List[Tuple[Array, Array]]:
-        """Group accumulated state by query id: list of (preds, target) per query."""
-        groups = _group_by_query(
+    def _group_segments(self) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Group accumulated state by query id: list of (preds, target) per query.
+
+        Groups stay as host numpy — per-query documents are tiny, so per-group device
+        dispatch would dominate; the per-query functionals accept numpy directly."""
+        return _group_by_query(
             dim_zero_cat(self.indexes), dim_zero_cat(self.preds), dim_zero_cat(self.target)
         )
-        return [(jnp.asarray(p), jnp.asarray(t)) for p, t in groups]
 
-    def _empty_query_check(self, target: Array) -> bool:
+    def _empty_query_check(self, target) -> bool:
         """True when the query lacks the targets this metric needs (positives)."""
-        return not float(jnp.sum(target))
+        return not float(np.sum(target))
 
     def compute(self) -> Array:
         """Group by query, score each group, aggregate."""
