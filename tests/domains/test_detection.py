@@ -1,0 +1,321 @@
+"""Detection metric tests.
+
+mAP is diffed against the reference's own pure-torch evaluator (``_mean_ap.py``, the
+behavioral model named in SURVEY §7) via tiny torchvision/pycocotools shims; panoptic
+quality against the reference functional; box ops against naive numpy formulas.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+
+
+def _install_tv_coco_shims():
+    """Minimal torchvision/pycocotools stand-ins so the reference evaluator imports."""
+    if "torchvision" in sys.modules:
+        return
+
+    def _box_area(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+
+    def _box_iou(a, b):
+        area1, area2 = _box_area(a), _box_area(b)
+        lt = torch.max(a[:, None, :2], b[None, :, :2])
+        rb = torch.min(a[:, None, 2:], b[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    def _box_convert(boxes, in_fmt, out_fmt):
+        assert in_fmt == out_fmt == "xyxy"
+        return boxes
+
+    tv = types.ModuleType("torchvision")
+    tv_ops = types.ModuleType("torchvision.ops")
+    tv_ops.box_area = _box_area
+    tv_ops.box_iou = _box_iou
+    tv_ops.box_convert = _box_convert
+    tv.ops = tv_ops
+    sys.modules["torchvision"] = tv
+    sys.modules["torchvision.ops"] = tv_ops
+    pct = types.ModuleType("pycocotools")
+    pct_mask = types.ModuleType("pycocotools.mask")
+    pct.mask = pct_mask
+    sys.modules["pycocotools"] = pct
+    sys.modules["pycocotools.mask"] = pct_mask
+
+
+_install_tv_coco_shims()
+
+from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP  # noqa: E402
+from torchmetrics.functional.detection import (  # noqa: E402
+    modified_panoptic_quality as ref_mpq,
+    panoptic_quality as ref_pq,
+)
+
+from torchmetrics_tpu.detection import (  # noqa: E402
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+    ModifiedPanopticQuality,
+    PanopticQuality,
+)
+from torchmetrics_tpu.functional.detection import (  # noqa: E402
+    intersection_over_union,
+    modified_panoptic_quality,
+    panoptic_quality,
+)
+from torchmetrics_tpu.functional.detection.box_ops import (  # noqa: E402
+    box_convert,
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+
+rng = np.random.RandomState(42)
+
+
+def _random_detection_data(n_imgs=8, n_cls=3, seed=7):
+    r = np.random.RandomState(seed)
+    preds, target = [], []
+    for _ in range(n_imgs):
+        n_gt = r.randint(1, 6)
+        xy = r.rand(n_gt, 2) * 200
+        wh = r.rand(n_gt, 2) * 80 + 10
+        gt_boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+        gt_labels = r.randint(0, n_cls, n_gt)
+        det_boxes, det_scores, det_labels = [], [], []
+        for b, lab in zip(gt_boxes, gt_labels):
+            jitter = r.randn(4) * r.choice([1.0, 8.0, 30.0])
+            det_boxes.append(b + jitter)
+            det_scores.append(r.rand())
+            det_labels.append(lab if r.rand() > 0.15 else r.randint(0, n_cls))
+        for _ in range(r.randint(0, 3)):
+            xy2 = r.rand(2) * 200
+            wh2 = r.rand(2) * 60 + 10
+            det_boxes.append(np.concatenate([xy2, xy2 + wh2]))
+            det_scores.append(r.rand())
+            det_labels.append(r.randint(0, n_cls))
+        preds.append(
+            {
+                "boxes": np.asarray(det_boxes, dtype=np.float32),
+                "scores": np.asarray(det_scores, dtype=np.float32),
+                "labels": np.asarray(det_labels),
+            }
+        )
+        target.append({"boxes": gt_boxes, "labels": gt_labels})
+    return preds, target
+
+
+class TestBoxOps:
+    def test_box_iou_matches_shim(self):
+        a = (rng.rand(5, 2) * 100).astype(np.float32)
+        boxes1 = np.concatenate([a, a + rng.rand(5, 2).astype(np.float32) * 50 + 5], axis=1)
+        b = (rng.rand(4, 2) * 100).astype(np.float32)
+        boxes2 = np.concatenate([b, b + rng.rand(4, 2).astype(np.float32) * 50 + 5], axis=1)
+        ours = box_iou(jnp.asarray(boxes1), jnp.asarray(boxes2))
+        theirs = sys.modules["torchvision.ops"].box_iou(torch.tensor(boxes1), torch.tensor(boxes2))
+        _assert_allclose(ours, theirs.numpy(), atol=1e-5)
+
+    def test_giou_self_is_iou(self):
+        boxes = jnp.array([[0.0, 0.0, 10.0, 10.0], [5.0, 5.0, 15.0, 15.0]])
+        _assert_allclose(jnp.diagonal(generalized_box_iou(boxes, boxes)), np.ones(2), atol=1e-6)
+        _assert_allclose(jnp.diagonal(distance_box_iou(boxes, boxes)), np.ones(2), atol=1e-5)
+        _assert_allclose(jnp.diagonal(complete_box_iou(boxes, boxes)), np.ones(2), atol=1e-5)
+
+    def test_box_convert_roundtrip(self):
+        boxes = jnp.array([[10.0, 20.0, 30.0, 60.0]])
+        for fmt in ("xywh", "cxcywh"):
+            converted = box_convert(boxes, "xyxy", fmt)
+            back = box_convert(converted, fmt, "xyxy")
+            _assert_allclose(back, boxes, atol=1e-5)
+
+    def test_iou_functional(self):
+        preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
+        target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
+        _assert_allclose(intersection_over_union(preds, target), 0.6898, atol=1e-4)
+
+
+class TestIoUModules:
+    @pytest.mark.parametrize(
+        ("cls", "key"),
+        [
+            (IntersectionOverUnion, "iou"),
+            (GeneralizedIntersectionOverUnion, "giou"),
+            (DistanceIntersectionOverUnion, "diou"),
+            (CompleteIntersectionOverUnion, "ciou"),
+        ],
+    )
+    def test_runs_and_in_range(self, cls, key):
+        preds, target = _random_detection_data(n_imgs=4)
+        metric = cls(class_metrics=True)
+        metric.update(
+            [{k: jnp.asarray(v) for k, v in p.items() if k != "scores"} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in target],
+        )
+        result = metric.compute()
+        assert key in result
+        assert -2.0 <= float(result[key]) <= 1.0
+
+    def test_respect_labels(self):
+        boxes = jnp.array([[0.0, 0.0, 10.0, 10.0]])
+        m_respect = IntersectionOverUnion(respect_labels=True)
+        m_respect.update(
+            [{"boxes": boxes, "labels": jnp.array([0])}], [{"boxes": boxes, "labels": jnp.array([1])}]
+        )
+        assert float(m_respect.compute()["iou"]) == 0.0  # no valid (label-matched) pairs
+        m_ignore = IntersectionOverUnion(respect_labels=False)
+        m_ignore.update(
+            [{"boxes": boxes, "labels": jnp.array([0])}], [{"boxes": boxes, "labels": jnp.array([1])}]
+        )
+        _assert_allclose(m_ignore.compute()["iou"], 1.0, atol=1e-6)
+
+
+class TestMeanAveragePrecision:
+    def test_against_reference_evaluator(self):
+        preds, target = _random_detection_data()
+        ours = MeanAveragePrecision(class_metrics=True)
+        theirs = RefMAP(class_metrics=True)
+        ours.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in target],
+        )
+        theirs.update(
+            [{k: torch.tensor(v) for k, v in p.items()} for p in preds],
+            [{k: torch.tensor(v) for k, v in t.items()} for t in target],
+        )
+        o = ours.compute()
+        r = theirs.compute()
+        for k in [
+            "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+            "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+            "map_per_class",
+        ]:
+            _assert_allclose(o[k], np.asarray(r[k]), atol=1e-4)
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_fuzz_map50(self, seed):
+        preds, target = _random_detection_data(n_imgs=5, seed=seed)
+        ours = MeanAveragePrecision()
+        theirs = RefMAP()
+        ours.update(
+            [{k: jnp.asarray(v) for k, v in p.items()} for p in preds],
+            [{k: jnp.asarray(v) for k, v in t.items()} for t in target],
+        )
+        theirs.update(
+            [{k: torch.tensor(v) for k, v in p.items()} for p in preds],
+            [{k: torch.tensor(v) for k, v in t.items()} for t in target],
+        )
+        o = ours.compute()
+        r = theirs.compute()
+        _assert_allclose(o["map"], np.asarray(r["map"]), atol=1e-4)
+        _assert_allclose(o["map_50"], np.asarray(r["map_50"]), atol=1e-4)
+
+    def test_empty_predictions(self):
+        metric = MeanAveragePrecision()
+        metric.update(
+            [{"boxes": jnp.zeros((0, 4)), "scores": jnp.zeros(0), "labels": jnp.zeros(0, dtype=jnp.int32)}],
+            [{"boxes": jnp.array([[0.0, 0.0, 10.0, 10.0]]), "labels": jnp.array([0])}],
+        )
+        result = metric.compute()
+        assert float(result["map"]) == 0.0
+
+    def test_perfect_predictions(self):
+        boxes = jnp.array([[10.0, 10.0, 60.0, 60.0], [100.0, 100.0, 160.0, 180.0]])
+        metric = MeanAveragePrecision()
+        metric.update(
+            [{"boxes": boxes, "scores": jnp.array([0.9, 0.8]), "labels": jnp.array([0, 1])}],
+            [{"boxes": boxes, "labels": jnp.array([0, 1])}],
+        )
+        result = metric.compute()
+        _assert_allclose(result["map"], 1.0, atol=1e-5)
+
+
+class TestPanopticQuality:
+    PREDS = np.array(
+        [[[[6, 0], [0, 0], [6, 0], [6, 0]],
+          [[0, 0], [0, 0], [6, 0], [0, 1]],
+          [[0, 0], [0, 0], [6, 0], [0, 1]],
+          [[0, 0], [7, 0], [6, 0], [1, 0]],
+          [[0, 0], [7, 0], [7, 0], [7, 0]]]]
+    )
+    TARGET = np.array(
+        [[[[6, 0], [0, 1], [6, 0], [0, 1]],
+          [[0, 1], [0, 1], [6, 0], [0, 1]],
+          [[0, 1], [0, 1], [6, 0], [1, 0]],
+          [[0, 1], [7, 0], [1, 0], [1, 0]],
+          [[0, 1], [7, 0], [7, 0], [7, 0]]]]
+    )
+
+    @pytest.mark.parametrize("return_sq_and_rq", [False, True])
+    @pytest.mark.parametrize("return_per_class", [False, True])
+    def test_against_reference(self, return_sq_and_rq, return_per_class):
+        r = ref_pq(
+            torch.tensor(self.PREDS), torch.tensor(self.TARGET), things={0, 1}, stuffs={6, 7},
+            return_sq_and_rq=return_sq_and_rq, return_per_class=return_per_class,
+        )
+        o = panoptic_quality(
+            jnp.asarray(self.PREDS), jnp.asarray(self.TARGET), things={0, 1}, stuffs={6, 7},
+            return_sq_and_rq=return_sq_and_rq, return_per_class=return_per_class,
+        )
+        _assert_allclose(o, r.numpy(), atol=1e-4)
+
+    def test_fuzz_against_reference(self):
+        r2 = np.random.RandomState(0)
+        for _ in range(5):
+            p = np.stack([r2.randint(0, 3, (2, 8, 8)), r2.randint(0, 3, (2, 8, 8))], axis=-1)
+            t = np.stack([r2.randint(0, 3, (2, 8, 8)), r2.randint(0, 3, (2, 8, 8))], axis=-1)
+            r = float(ref_pq(torch.tensor(p), torch.tensor(t), things={0, 1}, stuffs={2}))
+            o = float(panoptic_quality(jnp.asarray(p), jnp.asarray(t), things={0, 1}, stuffs={2}))
+            assert abs(r - o) < 1e-4 or (np.isnan(r) and np.isnan(o))
+
+    def test_modified_pq(self):
+        p2 = np.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        t2 = np.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        r = ref_mpq(
+            torch.tensor(p2), torch.tensor(t2), things={0, 1}, stuffs={6, 7},
+            allow_unknown_preds_category=True,
+        )
+        o = modified_panoptic_quality(
+            jnp.asarray(p2), jnp.asarray(t2), things={0, 1}, stuffs={6, 7},
+            allow_unknown_preds_category=True,
+        )
+        _assert_allclose(o, r.numpy(), atol=1e-4)
+
+    def test_modules_accumulate(self):
+        ours = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        theirs = tm_ref.detection.PanopticQuality(things={0, 1}, stuffs={6, 7})
+        for _ in range(2):
+            ours.update(jnp.asarray(self.PREDS), jnp.asarray(self.TARGET))
+            theirs.update(torch.tensor(self.PREDS), torch.tensor(self.TARGET))
+        _assert_allclose(ours.compute(), theirs.compute().numpy(), atol=1e-4)
+
+    def test_modified_module(self):
+        p2 = np.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        t2 = np.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        m = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7}, allow_unknown_preds_category=True)
+        m.update(jnp.asarray(p2), jnp.asarray(t2))
+        r = ref_mpq(
+            torch.tensor(p2), torch.tensor(t2), things={0, 1}, stuffs={6, 7},
+            allow_unknown_preds_category=True,
+        )
+        _assert_allclose(m.compute(), r.numpy(), atol=1e-4)
+
+    def test_raises_on_overlapping_categories(self):
+        with pytest.raises(ValueError, match="distinct"):
+            PanopticQuality(things={0, 1}, stuffs={1, 2})

@@ -39,6 +39,8 @@ from torchmetrics_tpu.retrieval import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.retrieval import __all__ as _retrieval_all  # noqa: E402
 from torchmetrics_tpu.audio import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.audio import __all__ as _audio_all  # noqa: E402
+from torchmetrics_tpu.detection import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.detection import __all__ as _detection_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from torchmetrics_tpu.wrappers import (  # noqa: E402
@@ -80,4 +82,5 @@ __all__ = [
     *_segmentation_all,
     *_retrieval_all,
     *_audio_all,
+    *_detection_all,
 ]

@@ -1,0 +1,23 @@
+"""Detection metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/detection/__init__.py`` (7 classes).
+"""
+
+from torchmetrics_tpu.detection.iou_modules import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+)
+from torchmetrics_tpu.detection.mean_ap import MeanAveragePrecision
+from torchmetrics_tpu.detection.panoptic import ModifiedPanopticQuality, PanopticQuality
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

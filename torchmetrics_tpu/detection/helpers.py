@@ -1,0 +1,62 @@
+"""Input validation helpers for detection metrics.
+
+Parity: reference ``src/torchmetrics/detection/helpers.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _fix_empty_tensors(boxes: Array) -> Array:
+    """Give empty box tensors the canonical (0, 4) shape."""
+    boxes = jnp.asarray(boxes)
+    if boxes.size == 0 and boxes.ndim == 1:
+        return boxes.reshape(0, 4)
+    return boxes
+
+
+def _input_validator(
+    preds: Sequence[Dict[str, Array]],
+    targets: Sequence[Dict[str, Array]],
+    ignore_score: bool = False,
+) -> None:
+    """Validate the list-of-dicts detection input format."""
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+
+    for k in ["boxes", "labels"] + ([] if ignore_score else ["scores"]):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in ["boxes", "labels"]:
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+    for i, item in enumerate(targets):
+        n_boxes = jnp.asarray(item["boxes"]).shape[0] if jnp.asarray(item["boxes"]).size else 0
+        n_labels = jnp.asarray(item["labels"]).shape[0] if jnp.asarray(item["labels"]).size else 0
+        if n_boxes != n_labels:
+            raise ValueError(
+                f"Input '{i}' of `target` has a different length of boxes ({n_boxes}) and labels ({n_labels})"
+            )
+    if not ignore_score:
+        for i, item in enumerate(preds):
+            n_boxes = jnp.asarray(item["boxes"]).shape[0] if jnp.asarray(item["boxes"]).size else 0
+            n_labels = jnp.asarray(item["labels"]).shape[0] if jnp.asarray(item["labels"]).size else 0
+            n_scores = jnp.asarray(item["scores"]).shape[0] if jnp.asarray(item["scores"]).size else 0
+            if n_boxes != n_labels or n_boxes != n_scores:
+                raise ValueError(
+                    f"Input '{i}' of `preds` has a different length of boxes ({n_boxes}), labels ({n_labels})"
+                    f" and scores ({n_scores})"
+                )

@@ -1,0 +1,198 @@
+"""IoU-family detection metric modules.
+
+Parity: reference ``src/torchmetrics/detection/{iou,giou,diou,ciou}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from torchmetrics_tpu.functional.detection.box_ops import (
+    box_convert,
+    box_iou,
+    complete_box_iou,
+    distance_box_iou,
+    generalized_box_iou,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class IntersectionOverUnion(Metric):
+    r"""Intersection over union of detection boxes against ground-truth boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+        >>> preds = [{"boxes": jnp.array([[296.55, 93.96, 314.97, 152.79]]),
+        ...           "labels": jnp.array([0])}]
+        >>> target = [{"boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        ...            "labels": jnp.array([0])}]
+        >>> metric = IntersectionOverUnion()
+        >>> metric(preds, target)["iou"].round(4)
+        Array(0.6898, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+    _pairwise_fn = staticmethod(box_iou)
+
+    groundtruth_labels: List[Array]
+    iou_matrix: List[Array]
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        # per-image NxM matrices are ragged in both dims; multi-process sync is
+        # unsupported (see _sync_dist)
+        self.add_state("groundtruth_labels", [], dist_reduce_fx="cat")
+        self.add_state("iou_matrix", [], dist_reduce_fx=None)
+
+    def _sync_dist(self, dist_sync_fn=None) -> None:
+        if dist_sync_fn is None and self.dist_sync_fn is None:
+            raise NotImplementedError(
+                "IntersectionOverUnion holds per-image ragged IoU matrices that the"
+                " built-in sync cannot gather. Provide a custom `dist_sync_fn`, or"
+                " compute per process."
+            )
+        super()._sync_dist(dist_sync_fn)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        """Compute and store the per-image (thresholded) IoU matrix."""
+        _input_validator(preds, target, ignore_score=True)
+
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            self.groundtruth_labels.append(jnp.asarray(t["labels"]))
+
+            iou_matrix = self._pairwise_fn(det_boxes, gt_boxes)
+            if self.iou_threshold is not None:
+                iou_matrix = jnp.where(iou_matrix < self.iou_threshold, self._invalid_val, iou_matrix)
+            if self.respect_labels:
+                label_eq = jnp.asarray(p["labels"])[:, None] == jnp.asarray(t["labels"])[None, :]
+                iou_matrix = jnp.where(label_eq, iou_matrix, self._invalid_val)
+            self.iou_matrix.append(iou_matrix)
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(jnp.asarray(boxes, dtype=jnp.float32))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean (valid) IoU, optionally per class."""
+        import numpy as np
+
+        valid_vals = [
+            np.asarray(mat)[np.asarray(mat) != self._invalid_val] for mat in self.iou_matrix
+        ]
+        flat = np.concatenate(valid_vals) if valid_vals else np.zeros(0)
+        score = jnp.asarray(flat.mean() if flat.size else 0.0, dtype=jnp.float32)
+        results: Dict[str, Array] = {f"{self._iou_type}": score}
+
+        if self.class_metrics:
+            gt_labels = dim_zero_cat(self.groundtruth_labels)
+            classes = sorted({int(v) for v in np.asarray(gt_labels)}) if gt_labels.size else []
+            for cl in classes:
+                masked_iou, observed = 0.0, 0
+                for mat, gt_lab in zip(self.iou_matrix, self.groundtruth_labels):
+                    sub = np.asarray(mat)[:, np.asarray(gt_lab) == cl]
+                    sub = sub[sub != self._invalid_val]
+                    masked_iou += sub.sum()
+                    observed += sub.size
+                results[f"{self._iou_type}/cl_{cl}"] = jnp.asarray(
+                    masked_iou / observed if observed else 0.0, dtype=jnp.float32
+                )
+        return results
+
+
+class GeneralizedIntersectionOverUnion(IntersectionOverUnion):
+    r"""Generalized IoU of detection boxes against ground-truth boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import GeneralizedIntersectionOverUnion
+        >>> preds = [{"boxes": jnp.array([[296.55, 93.96, 314.97, 152.79]]),
+        ...           "labels": jnp.array([0])}]
+        >>> target = [{"boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        ...            "labels": jnp.array([0])}]
+        >>> metric = GeneralizedIntersectionOverUnion()
+        >>> metric(preds, target)["giou"].round(4)
+        Array(0.6895, dtype=float32)
+    """
+
+    _iou_type: str = "giou"
+    _invalid_val: float = -1.0
+    _pairwise_fn = staticmethod(generalized_box_iou)
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    r"""Distance IoU of detection boxes against ground-truth boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import DistanceIntersectionOverUnion
+        >>> preds = [{"boxes": jnp.array([[296.55, 93.96, 314.97, 152.79]]),
+        ...           "labels": jnp.array([0])}]
+        >>> target = [{"boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        ...            "labels": jnp.array([0])}]
+        >>> metric = DistanceIntersectionOverUnion()
+        >>> metric(preds, target)["diou"].round(4)
+        Array(0.6883, dtype=float32)
+    """
+
+    _iou_type: str = "diou"
+    _invalid_val: float = -1.0
+    _pairwise_fn = staticmethod(distance_box_iou)
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    r"""Complete IoU of detection boxes against ground-truth boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import CompleteIntersectionOverUnion
+        >>> preds = [{"boxes": jnp.array([[296.55, 93.96, 314.97, 152.79]]),
+        ...           "labels": jnp.array([0])}]
+        >>> target = [{"boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]),
+        ...            "labels": jnp.array([0])}]
+        >>> metric = CompleteIntersectionOverUnion()
+        >>> metric(preds, target)["ciou"].round(4)
+        Array(0.6883, dtype=float32)
+    """
+
+    _iou_type: str = "ciou"
+    _invalid_val: float = -2.0
+    _pairwise_fn = staticmethod(complete_box_iou)

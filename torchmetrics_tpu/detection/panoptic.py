@@ -1,0 +1,154 @@
+"""Panoptic quality metric modules.
+
+Parity: reference ``src/torchmetrics/detection/panoptic_qualities.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Collection
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.detection.panoptic import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _prepocess_inputs,
+    _validate_inputs,
+)
+
+Array = jax.Array
+
+
+class PanopticQuality(Metric):
+    r"""Panoptic quality of (category, instance) panoptic maps.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import PanopticQuality
+        >>> preds = jnp.array([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+        ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+        ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+        ...                     [[0, 0], [7, 0], [6, 0], [1, 0]],
+        ...                     [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+        >>> target = jnp.array([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+        ...                      [[0, 1], [0, 1], [6, 0], [0, 1]],
+        ...                      [[0, 1], [0, 1], [6, 0], [1, 0]],
+        ...                      [[0, 1], [7, 0], [1, 0], [1, 0]],
+        ...                      [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+        >>> panoptic_quality = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        >>> panoptic_quality(preds, target).round(4)
+        Array(0.5463, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    iou_sum: Array
+    true_positives: Array
+    false_positives: Array
+    false_negatives: Array
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        return_sq_and_rq: bool = False,
+        return_per_class: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        things_set, stuffs_set = _parse_categories(things, stuffs)
+        self.things = things_set
+        self.stuffs = stuffs_set
+        self.void_color = _get_void_color(things_set, stuffs_set)
+        self.cat_id_to_continuous_id = _get_category_id_to_continuous_id(things_set, stuffs_set)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self.return_sq_and_rq = return_sq_and_rq
+        self.return_per_class = return_per_class
+
+        num_categories = len(things_set) + len(stuffs_set)
+        self.add_state("iou_sum", jnp.zeros(num_categories), dist_reduce_fx="sum")
+        self.add_state("true_positives", jnp.zeros(num_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", jnp.zeros(num_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", jnp.zeros(num_categories, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-category PQ statistics for the batch."""
+        _validate_inputs(preds, target)
+        flatten_preds = _prepocess_inputs(
+            self.things, self.stuffs, preds, self.void_color, self.allow_unknown_preds_category
+        )
+        flatten_target = _prepocess_inputs(self.things, self.stuffs, target, self.void_color, True)
+        iou_sum, true_positives, false_positives, false_negatives = self._update_fn(
+            flatten_preds, flatten_target
+        )
+        self.iou_sum = self.iou_sum + iou_sum
+        self.true_positives = self.true_positives + true_positives
+        self.false_positives = self.false_positives + false_positives
+        self.false_negatives = self.false_negatives + false_negatives
+
+    def _update_fn(self, flatten_preds, flatten_target):
+        return _panoptic_quality_update(
+            flatten_preds, flatten_target, self.cat_id_to_continuous_id, self.void_color
+        )
+
+    def compute(self) -> Array:
+        """Panoptic quality over accumulated statistics."""
+        pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
+            self.iou_sum, self.true_positives, self.false_positives, self.false_negatives
+        )
+        if self.return_per_class:
+            if self.return_sq_and_rq:
+                return jnp.stack((pq, sq, rq), axis=-1)
+            return pq.reshape(1, -1)
+        if self.return_sq_and_rq:
+            return jnp.stack((pq_avg, sq_avg, rq_avg), axis=0)
+        return pq_avg
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    r"""Modified panoptic quality (stuff classes scored without segment matching).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import ModifiedPanopticQuality
+        >>> preds = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        >>> target = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        >>> pq_modified = ModifiedPanopticQuality(
+        ...     things={0, 1}, stuffs={6, 7}, allow_unknown_preds_category=True)
+        >>> pq_modified(preds, target).round(4)
+        Array(0.7667, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            things=things,
+            stuffs=stuffs,
+            allow_unknown_preds_category=allow_unknown_preds_category,
+            **kwargs,
+        )
+
+    def _update_fn(self, flatten_preds, flatten_target):
+        return _panoptic_quality_update(
+            flatten_preds,
+            flatten_target,
+            self.cat_id_to_continuous_id,
+            self.void_color,
+            modified_metric_stuffs=self.stuffs,
+        )

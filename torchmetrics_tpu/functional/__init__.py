@@ -1,12 +1,14 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import audio, classification, clustering, image, nominal, pairwise, regression, retrieval, segmentation, text
+from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, text
 from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.audio import __all__ as _audio_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
+from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.detection import __all__ as _detection_all
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
@@ -26,6 +28,7 @@ __all__ = [
     "audio",
     "classification",
     "clustering",
+    "detection",
     "nominal",
     "image",
     "pairwise",
@@ -36,6 +39,7 @@ __all__ = [
     *_audio_all,
     *_classification_all,
     *_clustering_all,
+    *_detection_all,
     *_nominal_all,
     *_image_all,
     *_pairwise_all,

@@ -115,11 +115,15 @@ def speech_reverberation_modulation_energy_ratio(
         )
     import srmrpy
 
+    srmr_kwargs = dict(
+        n_cochlear_filters=n_cochlear_filters, low_freq=low_freq, min_cf=min_cf,
+        max_cf=max_cf, fast=fast, norm=norm, **kwargs,
+    )
     preds_np = np.asarray(preds)
     if preds_np.ndim == 1:
-        return jnp.asarray(srmrpy.srmr(preds_np, fs, n_cochlear_filters=n_cochlear_filters, fast=fast, norm=norm)[0])
+        return jnp.asarray(srmrpy.srmr(preds_np, fs, **srmr_kwargs)[0])
     vals = [
-        srmrpy.srmr(p, fs, n_cochlear_filters=n_cochlear_filters, fast=fast, norm=norm)[0]
+        srmrpy.srmr(p, fs, **srmr_kwargs)[0]
         for p in preds_np.reshape(-1, preds_np.shape[-1])
     ]
     return jnp.asarray(vals, dtype=jnp.float32).reshape(preds_np.shape[:-1])

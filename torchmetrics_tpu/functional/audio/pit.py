@@ -6,8 +6,8 @@ Parity: reference ``src/torchmetrics/functional/audio/pit.py`` (permutation cach
 
 TPU notes: the permutation set is a compile-time constant (speaker counts are tiny), so
 the exhaustive search is a static gather + reduce — fully jittable. The scipy
-linear-sum-assignment path (host round-trip) kicks in only for speaker counts > 3, like
-the reference.
+linear-sum-assignment path (host round-trip) kicks in for speaker counts >= 3 when not
+tracing, like the reference.
 """
 
 from __future__ import annotations
@@ -113,7 +113,9 @@ def permutation_invariant_training(
             best_metric = jnp.min(metric_of_ps, axis=1)
         return best_metric, perms[best_indexes]
 
-    # speaker-wise: pairwise metric matrix [batch, spk_preds, spk_target]
+    # speaker-wise: pairwise metric matrix [batch, spk_target, spk_preds]
+    # (target-major rows, matching the reference's metric_mtx[:, t, e] layout so the
+    # returned permutation maps target position -> prediction index)
     first_ele = metric_func(preds[:, 0, ...], target[:, 0, ...], **kwargs)
     metric_mtx = jnp.zeros((batch_size, spk_num, spk_num), dtype=first_ele.dtype)
     metric_mtx = metric_mtx.at[:, 0, 0].set(first_ele)
@@ -121,11 +123,13 @@ def permutation_invariant_training(
         for e in range(spk_num):
             if t == 0 and e == 0:
                 continue
-            metric_mtx = metric_mtx.at[:, e, t].set(
+            metric_mtx = metric_mtx.at[:, t, e].set(
                 metric_func(preds[:, e, ...], target[:, t, ...], **kwargs)
             )
 
-    if spk_num < 3:
+    # the Hungarian path needs host arrays — under jit tracing, fall back to the
+    # (jittable) exhaustive search regardless of speaker count
+    if spk_num < 3 or isinstance(metric_mtx, jax.core.Tracer):
         return _find_best_perm_by_exhaustive_method(metric_mtx, eval_func)
     try:
         return _find_best_perm_by_linear_sum_assignment(metric_mtx, eval_func)

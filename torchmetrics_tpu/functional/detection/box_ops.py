@@ -1,0 +1,231 @@
+"""Native JAX bounding-box ops and the IoU-family functionals.
+
+Parity: reference ``src/torchmetrics/functional/detection/{iou,giou,diou,ciou}.py``
+(which delegate to torchvision's box ops — reimplemented here as batched jnp algebra;
+all four IoU variants are one fused elementwise program over the NxM pair grid).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def box_convert(boxes: Array, in_fmt: str, out_fmt: str) -> Array:
+    """Convert boxes between xyxy / xywh / cxcywh formats."""
+    if in_fmt == out_fmt:
+        return boxes
+    # normalize to xyxy
+    if in_fmt == "xywh":
+        x, y, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([x, y, x + w, y + h], axis=-1)
+    elif in_fmt == "cxcywh":
+        cx, cy, w, h = jnp.split(boxes, 4, axis=-1)
+        boxes = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+    elif in_fmt != "xyxy":
+        raise ValueError(f"Unsupported box format {in_fmt}")
+
+    if out_fmt == "xyxy":
+        return boxes
+    if out_fmt == "xywh":
+        x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+        return jnp.concatenate([x1, y1, x2 - x1, y2 - y1], axis=-1)
+    if out_fmt == "cxcywh":
+        x1, y1, x2, y2 = jnp.split(boxes, 4, axis=-1)
+        return jnp.concatenate([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1], axis=-1)
+    raise ValueError(f"Unsupported box format {out_fmt}")
+
+
+def box_area(boxes: Array) -> Array:
+    """Areas of xyxy boxes."""
+    boxes = jnp.asarray(boxes)
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _box_inter_union(boxes1: Array, boxes2: Array) -> Tuple[Array, Array]:
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = jnp.maximum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.minimum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter, union
+
+
+def box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise IoU matrix of two xyxy box sets; shape (N, M)."""
+    inter, union = _box_inter_union(jnp.asarray(boxes1), jnp.asarray(boxes2))
+    return inter / union
+
+
+def generalized_box_iou(boxes1: Array, boxes2: Array) -> Array:
+    """Pairwise generalized IoU: IoU minus the enclosure's non-union fraction."""
+    boxes1 = jnp.asarray(boxes1)
+    boxes2 = jnp.asarray(boxes2)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = jnp.clip(rb - lt, min=0)
+    enclosure = wh[..., 0] * wh[..., 1]
+    return iou - (enclosure - union) / enclosure
+
+
+def _center_distances(boxes1: Array, boxes2: Array) -> Tuple[Array, Array]:
+    """Squared center distance and squared enclosure diagonal, both (N, M)."""
+    c1 = (boxes1[:, None, :2] + boxes1[:, None, 2:]) / 2
+    c2 = (boxes2[None, :, :2] + boxes2[None, :, 2:]) / 2
+    center_dist_sq = jnp.square(c1 - c2).sum(axis=-1)
+    lt = jnp.minimum(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = jnp.maximum(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    diag_sq = jnp.square(rb - lt).sum(axis=-1)
+    return center_dist_sq, diag_sq
+
+
+def distance_box_iou(boxes1: Array, boxes2: Array, eps: float = 1e-7) -> Array:
+    """Pairwise distance-IoU: IoU minus the normalized center distance."""
+    boxes1 = jnp.asarray(boxes1)
+    boxes2 = jnp.asarray(boxes2)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    iou = inter / union
+    center_dist_sq, diag_sq = _center_distances(boxes1, boxes2)
+    return iou - center_dist_sq / (diag_sq + eps)
+
+
+def complete_box_iou(boxes1: Array, boxes2: Array, eps: float = 1e-7) -> Array:
+    """Pairwise complete-IoU: distance-IoU with an aspect-ratio consistency term."""
+    boxes1 = jnp.asarray(boxes1)
+    boxes2 = jnp.asarray(boxes2)
+    inter, union = _box_inter_union(boxes1, boxes2)
+    iou = inter / union
+    center_dist_sq, diag_sq = _center_distances(boxes1, boxes2)
+    diou = iou - center_dist_sq / (diag_sq + eps)
+
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    v = (4 / math.pi**2) * jnp.square(
+        jnp.arctan(w2 / h2)[None, :] - jnp.arctan(w1 / h1)[:, None]
+    )
+    alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v
+
+
+def _iou_family_update(
+    preds: Array,
+    target: Array,
+    pairwise_fn,
+    iou_threshold: Optional[float],
+    replacement_val: float = 0,
+) -> Array:
+    """Shared validation + threshold masking for the four IoU variants."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim != 2 or preds.shape[-1] != 4:
+        raise ValueError(f"Expected preds to be of shape (N, 4) but got {preds.shape}")
+    if target.ndim != 2 or target.shape[-1] != 4:
+        raise ValueError(f"Expected target to be of shape (N, 4) but got {target.shape}")
+    iou = pairwise_fn(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _iou_family_compute(iou: Array, aggregate: bool = True) -> Array:
+    if not aggregate:
+        return iou
+    return jnp.diagonal(iou).mean() if iou.size > 0 else jnp.asarray(0.0)
+
+
+def intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    r"""Compute IoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import intersection_over_union
+        >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
+        >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
+        >>> intersection_over_union(preds, target).round(4)
+        Array(0.6898, dtype=float32)
+    """
+    iou = _iou_family_update(preds, target, box_iou, iou_threshold, replacement_val)
+    return _iou_family_compute(iou, aggregate)
+
+
+def generalized_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    r"""Compute generalized IoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import (
+        ...     generalized_intersection_over_union)
+        >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
+        >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
+        >>> generalized_intersection_over_union(preds, target).round(4)
+        Array(0.6895, dtype=float32)
+    """
+    iou = _iou_family_update(preds, target, generalized_box_iou, iou_threshold, replacement_val)
+    return _iou_family_compute(iou, aggregate)
+
+
+def distance_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    r"""Compute distance IoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import (
+        ...     distance_intersection_over_union)
+        >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
+        >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
+        >>> distance_intersection_over_union(preds, target).round(4)
+        Array(0.6883, dtype=float32)
+    """
+    iou = _iou_family_update(preds, target, distance_box_iou, iou_threshold, replacement_val)
+    return _iou_family_compute(iou, aggregate)
+
+
+def complete_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    r"""Compute complete IoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import (
+        ...     complete_intersection_over_union)
+        >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
+        >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
+        >>> complete_intersection_over_union(preds, target).round(4)
+        Array(0.6883, dtype=float32)
+    """
+    iou = _iou_family_update(preds, target, complete_box_iou, iou_threshold, replacement_val)
+    return _iou_family_compute(iou, aggregate)

@@ -1,0 +1,301 @@
+"""Panoptic quality (PQ) and modified PQ.
+
+Parity: reference ``src/torchmetrics/functional/detection/{_panoptic_quality_common,
+panoptic_qualities}.py``.
+
+Segment ("color" = category+instance) areas and pairwise intersections are counted with
+numpy ``unique`` on host — segments are data-dependent sets, exactly the reference's
+dict-of-colors approach — while the accumulated per-category statistics are fixed-shape
+device arrays (psum-able).
+"""
+
+from __future__ import annotations
+
+from typing import Collection, Dict, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+_Color = Tuple[int, int]
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    """Validate and normalize the category id sets."""
+    things_parsed = set(things)
+    if len(things_parsed) < len(things):
+        raise ValueError("The provided `things` categories contained duplicates, which have been removed.")
+    stuffs_parsed = set(stuffs)
+    if len(stuffs_parsed) < len(stuffs):
+        raise ValueError("The provided `stuffs` categories contained duplicates, which have been removed.")
+    if not all(isinstance(val, int) for val in things_parsed):
+        raise TypeError(f"Expected argument `things` to contain `int` categories, but got {things}")
+    if not all(isinstance(val, int) for val in stuffs_parsed):
+        raise TypeError(f"Expected argument `stuffs` to contain `int` categories, but got {stuffs}")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _validate_inputs(preds, target) -> None:
+    """Require same-shape (..., 2) arrays with at least one spatial dim."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, but got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            "Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2), "
+            f"got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            "Expected argument `preds` to have exactly 2 channels in the last dimension (category, instance), "
+            f"got {preds.shape} instead"
+        )
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    """An unused (category, instance) pair used to mask out unknown/ignored points."""
+    unused_category_id = 1 + max([0, *list(things), *list(stuffs)])
+    return unused_category_id, 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    """Dense re-indexing: things first, then stuffs."""
+    thing_id_to_continuous_id = {thing_id: idx for idx, thing_id in enumerate(sorted(things))}
+    stuff_id_to_continuous_id = {
+        stuff_id: idx + len(things) for idx, stuff_id in enumerate(sorted(stuffs))
+    }
+    cat_id_to_continuous_id = {}
+    cat_id_to_continuous_id.update(thing_id_to_continuous_id)
+    cat_id_to_continuous_id.update(stuff_id_to_continuous_id)
+    return cat_id_to_continuous_id
+
+
+def _prepocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> np.ndarray:
+    """Flatten spatial dims, zero stuff instance ids, map unknown categories to void."""
+    out = np.array(np.asarray(inputs), copy=True)
+    out = out.reshape(out.shape[0], -1, 2)
+    mask_stuffs = np.isin(out[:, :, 0], list(stuffs))
+    mask_things = np.isin(out[:, :, 0], list(things))
+    out[:, :, 1][mask_stuffs] = 0
+    if not allow_unknown_category and not np.all(mask_things | mask_stuffs):
+        raise ValueError(f"Unknown categories found: {out[~(mask_things | mask_stuffs)]}")
+    out[~(mask_things | mask_stuffs)] = np.asarray(void_color)
+    return out
+
+
+def _get_color_areas(colors: np.ndarray) -> Dict[tuple, int]:
+    """Counts of each distinct color row; colors has shape (num_points, C)."""
+    unique, counts = np.unique(colors, axis=0, return_counts=True)
+    return {tuple(map(int, u.ravel())): int(c) for u, c in zip(unique, counts)}
+
+
+def _panoptic_quality_update_sample(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy segment matching for one sample → per-category iou/tp/fp/fn."""
+    stuffs_modified_metric = stuffs_modified_metric or set()
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, dtype=np.float64)
+    true_positives = np.zeros(num_categories, dtype=np.int64)
+    false_positives = np.zeros(num_categories, dtype=np.int64)
+    false_negatives = np.zeros(num_categories, dtype=np.int64)
+
+    pred_areas = _get_color_areas(flatten_preds)
+    target_areas = _get_color_areas(flatten_target)
+    intersection_matrix = np.concatenate([flatten_preds, flatten_target], axis=-1)
+    intersection_areas = {
+        (color[:2], color[2:]): area for color, area in _get_color_areas(intersection_matrix).items()
+    }
+
+    pred_segment_matched = set()
+    target_segment_matched = set()
+    for pred_color, target_color in intersection_areas:
+        if target_color == void_color:
+            continue
+        if pred_color[0] != target_color[0]:
+            continue
+        intersection = intersection_areas[(pred_color, target_color)]
+        pred_void_area = intersection_areas.get((pred_color, void_color), 0)
+        void_target_area = intersection_areas.get((void_color, target_color), 0)
+        union = pred_areas[pred_color] - pred_void_area + target_areas[target_color] - void_target_area - intersection
+        iou = intersection / union
+        continuous_id = cat_id_to_continuous_id[target_color[0]]
+        if target_color[0] not in stuffs_modified_metric and iou > 0.5:
+            pred_segment_matched.add(pred_color)
+            target_segment_matched.add(target_color)
+            iou_sum[continuous_id] += iou
+            true_positives[continuous_id] += 1
+        elif target_color[0] in stuffs_modified_metric and iou > 0:
+            iou_sum[continuous_id] += iou
+
+    # unmatched target segments are FN unless mostly void-covered
+    for target_color in set(target_areas) - target_segment_matched:
+        if target_color == void_color:
+            continue
+        void_target_area = intersection_areas.get((void_color, target_color), 0)
+        if void_target_area / target_areas[target_color] <= 0.5 and target_color[0] not in stuffs_modified_metric:
+            false_negatives[cat_id_to_continuous_id[target_color[0]]] += 1
+
+    # unmatched predicted segments are FP unless mostly void-covered
+    for pred_color in set(pred_areas) - pred_segment_matched:
+        if pred_color == void_color:
+            continue
+        pred_void_area = intersection_areas.get((pred_color, void_color), 0)
+        if pred_void_area / pred_areas[pred_color] <= 0.5 and pred_color[0] not in stuffs_modified_metric:
+            false_positives[cat_id_to_continuous_id[pred_color[0]]] += 1
+
+    # modified metric counts each present stuff category once as a "TP" denominator
+    for target_color in target_areas:
+        if target_color[0] in stuffs_modified_metric:
+            true_positives[cat_id_to_continuous_id[target_color[0]]] += 1
+
+    return iou_sum, true_positives, false_positives, false_negatives
+
+
+def _panoptic_quality_update(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Accumulate PQ statistics over a batch of samples."""
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, dtype=np.float64)
+    true_positives = np.zeros(num_categories, dtype=np.int64)
+    false_positives = np.zeros(num_categories, dtype=np.int64)
+    false_negatives = np.zeros(num_categories, dtype=np.int64)
+
+    for flatten_preds_single, flatten_target_single in zip(flatten_preds, flatten_target):
+        result = _panoptic_quality_update_sample(
+            flatten_preds_single,
+            flatten_target_single,
+            cat_id_to_continuous_id,
+            void_color,
+            stuffs_modified_metric=modified_metric_stuffs,
+        )
+        iou_sum += result[0]
+        true_positives += result[1]
+        false_positives += result[2]
+        false_negatives += result[3]
+
+    return (
+        jnp.asarray(iou_sum, dtype=jnp.float32),
+        jnp.asarray(true_positives, dtype=jnp.int32),
+        jnp.asarray(false_positives, dtype=jnp.int32),
+        jnp.asarray(false_negatives, dtype=jnp.int32),
+    )
+
+
+def _panoptic_quality_compute(
+    iou_sum: Array,
+    true_positives: Array,
+    false_positives: Array,
+    false_negatives: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Per-class and average panoptic/segmentation/recognition quality."""
+    sq = jnp.where(true_positives > 0, iou_sum / jnp.maximum(true_positives, 1), 0.0)
+    denominator = true_positives + 0.5 * false_positives + 0.5 * false_negatives
+    rq = jnp.where(denominator > 0, true_positives / jnp.where(denominator > 0, denominator, 1.0), 0.0)
+    pq = sq * rq
+    valid = denominator > 0
+    count = jnp.maximum(valid.sum(), 1)
+    pq_avg = jnp.where(valid, pq, 0.0).sum() / count
+    sq_avg = jnp.where(valid, sq, 0.0).sum() / count
+    rq_avg = jnp.where(valid, rq, 0.0).sum() / count
+    return pq, sq, rq, pq_avg, sq_avg, rq_avg
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    return_sq_and_rq: bool = False,
+    return_per_class: bool = False,
+) -> Array:
+    r"""Compute panoptic quality of (category, instance) panoptic maps.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import panoptic_quality
+        >>> preds = jnp.array([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+        ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+        ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+        ...                     [[0, 0], [7, 0], [6, 0], [1, 0]],
+        ...                     [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+        >>> target = jnp.array([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+        ...                      [[0, 1], [0, 1], [6, 0], [0, 1]],
+        ...                      [[0, 1], [0, 1], [6, 0], [1, 0]],
+        ...                      [[0, 1], [7, 0], [1, 0], [1, 0]],
+        ...                      [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+        >>> panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7}).round(4)
+        Array(0.5463, dtype=float32)
+    """
+    things_set, stuffs_set = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things_set, stuffs_set)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things_set, stuffs_set)
+    flatten_preds = _prepocess_inputs(things_set, stuffs_set, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _prepocess_inputs(things_set, stuffs_set, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(flatten_preds, flatten_target, cat_id_to_continuous_id, void_color)
+    pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(iou_sum, tp, fp, fn)
+    if return_per_class:
+        if return_sq_and_rq:
+            return jnp.stack((pq, sq, rq), axis=-1)
+        return pq.reshape(1, -1)
+    if return_sq_and_rq:
+        return jnp.stack((pq_avg, sq_avg, rq_avg), axis=0)
+    return pq_avg
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> Array:
+    r"""Compute modified panoptic quality (stuff classes scored without matching).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import modified_panoptic_quality
+        >>> preds = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        >>> target = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        >>> modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7},
+        ...                           allow_unknown_preds_category=True).round(4)
+        Array(0.7667, dtype=float32)
+    """
+    things_set, stuffs_set = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things_set, stuffs_set)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things_set, stuffs_set)
+    flatten_preds = _prepocess_inputs(things_set, stuffs_set, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _prepocess_inputs(things_set, stuffs_set, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(
+        flatten_preds, flatten_target, cat_id_to_continuous_id, void_color, modified_metric_stuffs=stuffs_set
+    )
+    _, _, _, pq_avg, _, _ = _panoptic_quality_compute(iou_sum, tp, fp, fn)
+    return pq_avg
