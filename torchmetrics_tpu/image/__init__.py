@@ -23,6 +23,10 @@ from torchmetrics_tpu.image.quality import (
     UniversalImageQualityIndex,
     VisualInformationFidelity,
 )
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance
+from torchmetrics_tpu.image.inception import InceptionScore
+from torchmetrics_tpu.image.kid import KernelInceptionDistance
+from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
 from torchmetrics_tpu.image.ssim import (
     MultiScaleStructuralSimilarityIndexMeasure,
     StructuralSimilarityIndexMeasure,
@@ -30,6 +34,10 @@ from torchmetrics_tpu.image.ssim import (
 
 __all__ = [
     "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
     "PeakSignalNoiseRatioWithBlockedEffect",

@@ -1,0 +1,99 @@
+"""Inception score.
+
+Parity: reference ``src/torchmetrics/image/inception.py:36-212``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.image._inception_net import InceptionFeatureExtractor
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    r"""Inception score of generated images (exp of the label-marginal KL).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import InceptionScore
+        >>> feature_fn = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :10]
+        >>> inception = InceptionScore(feature=feature_fn, splits=2)
+        >>> inception.update(jax.random.uniform(jax.random.PRNGKey(42), (16, 3, 8, 8)))
+        >>> score_mean, score_std = inception.compute()
+        >>> bool(score_mean >= 1.0)
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    features: List[Array]
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+
+        if isinstance(feature, (str, int)):
+            valid_inputs = ("logits_unbiased", 64, 192, 768, 2048)
+            if feature not in valid_inputs:
+                raise ValueError(
+                    f"Input to argument `feature` must be one of {valid_inputs}, but got {feature}."
+                )
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+
+        self.splits = splits
+        self.add_state("features", [], dist_reduce_fx="cat")
+
+    def update(self, imgs: Array) -> None:
+        """Extract and store features (logits) for the generated images."""
+        features = jnp.asarray(self.inception(imgs), dtype=jnp.float32)
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """Mean and std of the per-split inception scores."""
+        features = dim_zero_cat(self.features)
+        # global numpy RNG so np.random.seed makes compute reproducible
+        idx = np.random.permutation(features.shape[0])
+        features = features[idx]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        # torch.chunk semantics: ceil-sized chunks, possibly fewer than `splits`
+        n = features.shape[0]
+        chunk_size = -(-n // self.splits)
+        boundaries = list(range(chunk_size, n, chunk_size))
+        prob_chunks = jnp.split(prob, boundaries, axis=0)
+        log_prob_chunks = jnp.split(log_prob, boundaries, axis=0)
+
+        kl_ = []
+        for p, log_p in zip(prob_chunks, log_prob_chunks):
+            m_p = p.mean(axis=0, keepdims=True)
+            kl = p * (log_p - jnp.log(m_p))
+            kl_.append(jnp.exp(kl.sum(axis=1).mean()))
+        kl = jnp.stack(kl_)
+        return kl.mean(), kl.std(ddof=1)

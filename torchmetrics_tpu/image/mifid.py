@@ -1,0 +1,145 @@
+"""Memorization-informed Fréchet inception distance.
+
+Parity: reference ``src/torchmetrics/image/mifid.py`` (cosine distance ``:36-47``,
+compute ``:50-76``, ``MemorizationInformedFrechetInceptionDistance`` ``:79-260``).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Callable, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.image._inception_net import InceptionFeatureExtractor
+from torchmetrics_tpu.image.fid import _compute_fid
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _compute_cosine_distance(features1: Array, features2: Array, cosine_distance_eps: float = 0.1) -> Array:
+    """Mean nearest-neighbour cosine distance, thresholded at eps (memorization gate)."""
+    features1 = features1[jnp.sum(features1, axis=1) != 0]
+    features2 = features2[jnp.sum(features2, axis=1) != 0]
+
+    norm_f1 = features1 / jnp.linalg.norm(features1, axis=1, keepdims=True)
+    norm_f2 = features2 / jnp.linalg.norm(features2, axis=1, keepdims=True)
+
+    d = 1.0 - jnp.abs(jnp.matmul(norm_f1, norm_f2.T, precision=lax.Precision.HIGHEST))
+    mean_min_d = jnp.mean(d.min(axis=1))
+    return jnp.where(mean_min_d < cosine_distance_eps, mean_min_d, jnp.ones_like(mean_min_d))
+
+
+def _mifid_compute(
+    mu1: np.ndarray,
+    sigma1: np.ndarray,
+    features1: Array,
+    mu2: np.ndarray,
+    sigma2: np.ndarray,
+    features2: Array,
+    cosine_distance_eps: float = 0.1,
+) -> Array:
+    """FID divided by the memorization distance."""
+    fid_value = _compute_fid(mu1, sigma1, mu2, sigma2)
+    distance = _compute_cosine_distance(features1, features2, cosine_distance_eps)
+    return jnp.where(fid_value > 1e-8, fid_value / (distance + 10e-15), jnp.zeros_like(fid_value))
+
+
+class MemorizationInformedFrechetInceptionDistance(Metric):
+    r"""Memorization-informed FID.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import MemorizationInformedFrechetInceptionDistance
+        >>> feature_fn = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :16]
+        >>> mifid = MemorizationInformedFrechetInceptionDistance(feature=feature_fn)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> mifid.update(jax.random.uniform(k1, (8, 3, 8, 8)), real=True)
+        >>> mifid.update(jax.random.uniform(k2, (8, 3, 8, 8)), real=False)
+        >>> float(mifid.compute()) >= 0
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    real_features: List[Array]
+    fake_features: List[Array]
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+
+        if isinstance(feature, int):
+            valid_int_input = (64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        if not (isinstance(cosine_distance_eps, float) and 1 >= cosine_distance_eps > 0):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
+        self.cosine_distance_eps = cosine_distance_eps
+
+        self.add_state("real_features", [], dist_reduce_fx="cat")
+        self.add_state("fake_features", [], dist_reduce_fx="cat")
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract and store features for the requested distribution."""
+        features = jnp.asarray(self.inception(imgs), dtype=jnp.float32)
+        if features.ndim == 1:
+            features = features[None]
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        """MIFID over all accumulated features."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+
+        rf = np.asarray(real_features, dtype=np.float64)
+        ff = np.asarray(fake_features, dtype=np.float64)
+        mean_real, mean_fake = rf.mean(axis=0), ff.mean(axis=0)
+        cov_real, cov_fake = np.cov(rf.T), np.cov(ff.T)
+
+        return _mifid_compute(
+            mean_real, cov_real, real_features,
+            mean_fake, cov_fake, fake_features,
+            cosine_distance_eps=self.cosine_distance_eps,
+        )
+
+    def reset(self) -> None:
+        """Reset states; optionally keep the real-distribution features."""
+        if not self.reset_real_features:
+            value = deepcopy(self.real_features)
+            super().reset()
+            self.real_features = value
+        else:
+            super().reset()
