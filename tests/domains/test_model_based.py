@@ -1,0 +1,200 @@
+"""Model-based metric tests: BERTScore (vs reference, shared user model), LPIPS
+machinery, InfoLM measures, CLIP gating (weights cannot be downloaded here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import jax
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+
+rng = np.random.RandomState(42)
+EMB_TABLE = rng.randn(1000, 12).astype(np.float32)
+
+
+class _SharedTokenizer:
+    """Deterministic toy tokenizer: ids come from a stable content hash, so the torch
+    and jax paths see identical token ids regardless of tokenization order."""
+
+    def __call__(self, texts, padding=True, truncation=True, max_length=512, return_tensors="np"):
+        import zlib
+
+        ids_rows = []
+        for text in texts:
+            tokens = text.split()[: max_length - 2]
+            ids = [1] + [3 + zlib.crc32(t.encode()) % 900 for t in tokens] + [2]
+            ids_rows.append(ids)
+        width = max_length if padding == "max_length" else max(len(r) for r in ids_rows)
+        input_ids = np.zeros((len(texts), width), dtype=np.int64)
+        attention_mask = np.zeros((len(texts), width), dtype=np.int64)
+        for i, ids in enumerate(ids_rows):
+            input_ids[i, : len(ids)] = ids
+            attention_mask[i, : len(ids)] = 1
+        if return_tensors == "pt":
+            return {"input_ids": torch.tensor(input_ids), "attention_mask": torch.tensor(attention_mask)}
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def _jax_model(input_ids, attention_mask):
+    return jnp.asarray(EMB_TABLE)[jnp.asarray(input_ids) % 1000]
+
+
+class _TorchModel(tnn.Module):
+    def forward(self, input_ids, attention_mask):
+        return torch.tensor(EMB_TABLE)[input_ids % 1000]
+
+
+def _torch_forward_fn(model, batch):
+    return model(batch["input_ids"], batch["attention_mask"])
+
+
+# equal token counts everywhere: the reference sorts preds/target independently by
+# length before batching, which only preserves pair alignment for uniform lengths
+PREDS = ["hello there my friend", "the cat sat down", "completely different sentence here"]
+TARGET = ["hello there good friend", "a cat lay down", "unrelated words entirely here now"]
+
+
+class TestBERTScore:
+    @pytest.mark.parametrize("idf", [False, True])
+    def test_functional_against_reference(self, idf):
+        from torchmetrics.functional.text.bert import bert_score as ref_bert_score
+
+        from torchmetrics_tpu.functional.text import bert_score
+
+        tok = _SharedTokenizer()
+        ours = bert_score(PREDS, TARGET, model=_jax_model, user_tokenizer=tok, idf=idf)
+        theirs = ref_bert_score(
+            PREDS, TARGET, model=_TorchModel(), user_tokenizer=_SharedTokenizer(),
+            user_forward_fn=_torch_forward_fn, idf=idf,
+        )
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(ours[k], np.asarray(theirs[k]), atol=1e-4)
+
+    def test_module_accumulates(self):
+        from torchmetrics_tpu.text import BERTScore
+
+        metric = BERTScore(model=_jax_model, max_length=16)
+        metric.update(PREDS[:2], TARGET[:2])
+        metric.update(PREDS[2:], TARGET[2:])
+        result = metric.compute()
+        assert result["f1"].shape == (3,)
+        # identical sentences score ~1
+        metric2 = BERTScore(model=_jax_model, max_length=16)
+        metric2.update(["same text"], ["same text"])
+        assert float(np.asarray(metric2.compute()["f1"]).ravel()[0]) > 0.99
+
+    def test_gated_without_weights(self):
+        from torchmetrics_tpu.text import BERTScore
+
+        with pytest.raises(OSError, match="local"):
+            BERTScore(model_name_or_path="definitely/not-cached-model")
+
+
+class TestLPIPS:
+    def test_machinery_with_custom_features(self):
+        from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+        feature_fn = lambda img: [img, img[:, :, ::2, ::2]]
+        lpips = LearnedPerceptualImagePatchSimilarity(feature_fn=feature_fn)
+        k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        img1 = jax.random.uniform(k1, (4, 3, 16, 16)) * 2 - 1
+        img2 = jax.random.uniform(k2, (4, 3, 16, 16)) * 2 - 1
+        lpips.update(img1, img2)
+        assert float(lpips.compute()) > 0
+        # identical images → zero distance
+        lpips2 = LearnedPerceptualImagePatchSimilarity(feature_fn=feature_fn)
+        lpips2.update(img1, img1)
+        assert abs(float(lpips2.compute())) < 1e-6
+
+    def test_gated_without_weights(self):
+        from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+
+        with pytest.raises(ModuleNotFoundError, match="weights"):
+            LearnedPerceptualImagePatchSimilarity(net_type="alex")
+
+
+class TestPPL:
+    def test_with_custom_generator_and_similarity(self):
+        from torchmetrics_tpu.image.perceptual_path_length import perceptual_path_length
+
+        class Generator:
+            def __init__(self):
+                self.key = jax.random.PRNGKey(0)
+
+            def sample(self, n):
+                self.key, sub = jax.random.split(self.key)
+                return jax.random.normal(sub, (n, 8))
+
+            def __call__(self, z):
+                img = jnp.tanh(z[:, :3, None, None] * jnp.ones((1, 3, 16, 16)))
+                return img
+
+        def sim(a, b):
+            return jnp.abs(a - b).mean(axis=(1, 2, 3))
+
+        mean, std, dists = perceptual_path_length(
+            Generator(), num_samples=64, batch_size=32, resize=None, similarity_fn=sim
+        )
+        assert np.isfinite(float(mean))
+        assert dists.shape[0] <= 64
+
+
+class TestInfoLMMeasures:
+    """The divergence family is testable without model weights."""
+
+    @pytest.mark.parametrize(
+        ("measure", "kwargs"),
+        [
+            ("kl_divergence", {}),
+            ("alpha_divergence", {"alpha": 0.5}),
+            ("beta_divergence", {"beta": 0.5}),
+            ("ab_divergence", {"alpha": 0.5, "beta": 0.5}),
+            ("renyi_divergence", {"alpha": 0.5}),
+            ("l1_distance", {}),
+            ("l2_distance", {}),
+            ("l_infinity_distance", {}),
+            ("fisher_rao_distance", {}),
+        ],
+    )
+    def test_measures_match_reference(self, measure, kwargs):
+        from torchmetrics.functional.text.infolm import _InformationMeasure as RefIM
+
+        from torchmetrics_tpu.text.infolm import _InformationMeasure
+
+        p = rng.dirichlet(np.ones(20), size=4).astype(np.float32)
+        t = rng.dirichlet(np.ones(20), size=4).astype(np.float32)
+        ours = _InformationMeasure(measure, **kwargs)(jnp.asarray(p), jnp.asarray(t))
+        theirs = RefIM(measure, **kwargs)(torch.tensor(p), torch.tensor(t))
+        _assert_allclose(ours, theirs.numpy(), atol=1e-4)
+
+    def test_gated_without_weights(self):
+        from torchmetrics_tpu.text import InfoLM
+
+        with pytest.raises(OSError, match="local"):
+            InfoLM(model_name_or_path="definitely/not-cached-model")
+
+
+class TestCLIPGating:
+    def test_clip_score_gated(self):
+        from torchmetrics_tpu.multimodal import CLIPScore
+
+        with pytest.raises(OSError, match="local"):
+            CLIPScore()
+
+    def test_clip_iqa_prompt_validation(self):
+        from torchmetrics_tpu.functional.multimodal.clip_iqa import _clip_iqa_format_prompts
+
+        names, prompts = _clip_iqa_format_prompts(("quality", ("Custom good.", "Custom bad.")))
+        assert names == ["quality", "user_defined_0"]
+        assert len(prompts) == 4
+        with pytest.raises(ValueError, match="must be one of"):
+            _clip_iqa_format_prompts(("nonexistent_prompt",))

@@ -41,6 +41,8 @@ from torchmetrics_tpu.audio import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.audio import __all__ as _audio_all  # noqa: E402
 from torchmetrics_tpu.detection import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.detection import __all__ as _detection_all  # noqa: E402
+from torchmetrics_tpu.multimodal import *  # noqa: E402,F401,F403
+from torchmetrics_tpu.multimodal import __all__ as _multimodal_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from torchmetrics_tpu.wrappers import (  # noqa: E402
@@ -83,4 +85,5 @@ __all__ = [
     *_retrieval_all,
     *_audio_all,
     *_detection_all,
+    *_multimodal_all,
 ]

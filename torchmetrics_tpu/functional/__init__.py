@@ -1,6 +1,6 @@
 """Pure functional metric API."""
 
-from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, text
+from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, multimodal, nominal, pairwise, regression, retrieval, segmentation, text
 from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.audio import __all__ as _audio_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
@@ -9,6 +9,8 @@ from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
 from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.detection import __all__ as _detection_all
+from torchmetrics_tpu.functional.multimodal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.multimodal import __all__ as _multimodal_all
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
@@ -29,6 +31,7 @@ __all__ = [
     "classification",
     "clustering",
     "detection",
+    "multimodal",
     "nominal",
     "image",
     "pairwise",
@@ -40,6 +43,7 @@ __all__ = [
     *_classification_all,
     *_clustering_all,
     *_detection_all,
+    *_multimodal_all,
     *_nominal_all,
     *_image_all,
     *_pairwise_all,

@@ -9,6 +9,8 @@ from torchmetrics_tpu.functional.image.d_lambda import spectral_distortion_index
 from torchmetrics_tpu.functional.image.d_s import spatial_distortion_index
 from torchmetrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
 from torchmetrics_tpu.functional.image.gradients import image_gradients
+from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
+from torchmetrics_tpu.functional.image.perceptual_path_length import perceptual_path_length
 from torchmetrics_tpu.functional.image.psnr import peak_signal_noise_ratio
 from torchmetrics_tpu.functional.image.psnrb import peak_signal_noise_ratio_with_blocked_effect
 from torchmetrics_tpu.functional.image.qnr import quality_with_no_reference
@@ -27,6 +29,8 @@ from torchmetrics_tpu.functional.image.vif import visual_information_fidelity
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
+    "learned_perceptual_image_patch_similarity",
+    "perceptual_path_length",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "peak_signal_noise_ratio_with_blocked_effect",

@@ -1,0 +1,100 @@
+"""Perceptual path length (functional).
+
+Parity: reference ``src/torchmetrics/functional/image/perceptual_path_length.py``:
+epsilon-perturbed latent interpolations scored with a perceptual similarity, filtered
+to the [lower, upper] percentile band.
+
+The generator interface matches the reference (``generator.sample(num_samples)`` and
+``generator(z)`` — or ``generator.sample`` returning ``(z, labels)`` and
+``generator(z, labels)`` when ``conditional=True``). The similarity defaults to LPIPS
+and therefore needs either pretrained weights or a custom ``similarity_fn``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _interpolate(latents1: Array, latents2: Array, epsilon: float, interpolation_method: str) -> Array:
+    """Interpolate towards an epsilon-offset point (lerp, or slerp for any-d latents)."""
+    eps = epsilon
+    if interpolation_method == "lerp":
+        return latents1 + (latents2 - latents1) * eps
+    if interpolation_method in ("slerp_any", "slerp_unit"):
+        a = latents1 / jnp.linalg.norm(latents1, axis=-1, keepdims=True)
+        b = latents2 / jnp.linalg.norm(latents2, axis=-1, keepdims=True)
+        d = jnp.sum(a * b, axis=-1, keepdims=True)
+        p = eps * jnp.arccos(jnp.clip(d, -1, 1))
+        c = b - d * a
+        c = c / jnp.linalg.norm(c, axis=-1, keepdims=True)
+        interpolated = a * jnp.cos(p) + c * jnp.sin(p)
+        if interpolation_method == "slerp_any":
+            interpolated = interpolated * jnp.linalg.norm(latents1, axis=-1, keepdims=True)
+        return interpolated
+    raise ValueError(f"Interpolation method {interpolation_method} not supported.")
+
+
+def perceptual_path_length(
+    generator: Any,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 128,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    similarity_fn: Optional[Callable[[Array, Array], Array]] = None,
+) -> Tuple[Array, Array, Array]:
+    r"""Compute the perceptual path length of a generator.
+
+    With ``conditional=True``, ``generator.sample`` must return ``(latents, labels)``
+    and the generator is called as ``generator(latents, labels)``.
+    ``similarity_fn(img1, img2) -> (B,)`` defaults to LPIPS and therefore requires
+    pretrained weights; pass a custom callable here.
+    """
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must implement a `sample` method returning latents"
+            + (" and labels" if conditional else "")
+        )
+    if similarity_fn is None:
+        raise ModuleNotFoundError(
+            "The default LPIPS similarity requires pretrained torchvision weights, which cannot"
+            " be downloaded in this environment. Pass `similarity_fn` explicitly."
+        )
+
+    distances = []
+    num_batches = int(np.ceil(num_samples / batch_size))
+    for _ in range(num_batches):
+        if conditional:
+            latents1, labels1 = generator.sample(batch_size)
+            latents2, _ = generator.sample(batch_size)
+        else:
+            latents1 = jnp.asarray(generator.sample(batch_size))
+            latents2 = jnp.asarray(generator.sample(batch_size))
+        latents_interp = _interpolate(jnp.asarray(latents1), jnp.asarray(latents2), epsilon, interpolation_method)
+
+        if conditional:
+            imgs1 = jnp.asarray(generator(jnp.asarray(latents1), labels1))
+            imgs2 = jnp.asarray(generator(latents_interp, labels1))
+        else:
+            imgs1 = jnp.asarray(generator(jnp.asarray(latents1)))
+            imgs2 = jnp.asarray(generator(latents_interp))
+        if resize is not None:
+            imgs1 = jax.image.resize(imgs1, (imgs1.shape[0], imgs1.shape[1], resize, resize), "bilinear")
+            imgs2 = jax.image.resize(imgs2, (imgs2.shape[0], imgs2.shape[1], resize, resize), "bilinear")
+        distances.append(jnp.asarray(similarity_fn(imgs1, imgs2)) / epsilon**2)
+
+    distances_arr = jnp.concatenate(distances)[:num_samples]
+
+    lower = jnp.percentile(distances_arr, lower_discard * 100) if lower_discard is not None else distances_arr.min()
+    upper = jnp.percentile(distances_arr, upper_discard * 100) if upper_discard is not None else distances_arr.max()
+    kept = distances_arr[(distances_arr >= lower) & (distances_arr <= upper)]
+    return kept.mean(), kept.std(), kept

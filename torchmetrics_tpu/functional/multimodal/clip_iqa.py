@@ -1,0 +1,103 @@
+"""CLIP image quality assessment.
+
+Parity: reference ``src/torchmetrics/functional/multimodal/clip_iqa.py``: images are
+scored against antonym prompt pairs ("Good photo." vs "Bad photo.") by softmaxing the
+CLIP logits over each pair.
+
+Requires locally cached CLIP weights (this environment has no network egress).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from torchmetrics_tpu.functional.multimodal.clip_score import _get_clip_model_and_processor
+
+Array = jax.Array
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "warm": ("Warm photo.", "Cold photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+def _clip_iqa_format_prompts(prompts: Union[Tuple[str, ...], str]) -> Tuple[List[str], List[str]]:
+    """Expand prompt keywords / custom pairs into a flat list of positive/negative prompts."""
+    if isinstance(prompts, str):
+        prompts = (prompts,)
+    if not isinstance(prompts, tuple):
+        raise ValueError("Argument `prompts` must be a string or tuple of strings / prompt-pair tuples")
+
+    prompts_names: List[str] = []
+    prompts_list: List[str] = []
+    count = 0
+    for p in prompts:
+        if isinstance(p, str):
+            if p not in _PROMPTS:
+                raise ValueError(
+                    f"All elements of `prompts` must be one of {list(_PROMPTS)} if not custom tuple prompts,"
+                    f" got {p}."
+                )
+            prompts_names.append(p)
+            prompts_list.extend(_PROMPTS[p])
+        elif isinstance(p, tuple) and len(p) == 2:
+            prompts_names.append(f"user_defined_{count}")
+            prompts_list.extend(p)
+            count += 1
+        else:
+            raise ValueError("If a tuple is provided in argument `prompts`, it must be of length 2")
+    return prompts_names, prompts_list
+
+
+def clip_image_quality_assessment(
+    images: Array,
+    model_name_or_path: str = "clip_iqa",
+    data_range: float = 1.0,
+    prompts: Union[Tuple[str, ...], str] = ("quality",),
+) -> Union[Array, Dict[str, Array]]:
+    r"""Compute CLIP-IQA: no-reference image quality via antonym prompt pairs.
+
+    Requires locally cached CLIP weights (no network egress in this environment).
+    """
+    prompts_names, prompts_list = _clip_iqa_format_prompts(prompts)
+    if model_name_or_path == "clip_iqa":
+        model_name_or_path = "openai/clip-vit-base-patch32"
+    model, processor = _get_clip_model_and_processor(model_name_or_path)
+
+    images = jnp.asarray(images)
+    if images.ndim == 3:
+        images = images[None]
+    imgs_uint8 = [np.asarray(jnp.clip(i / data_range * 255, 0, 255), dtype=np.uint8) for i in images]
+
+    processed = processor(text=prompts_list, images=imgs_uint8, return_tensors="np", padding=True)
+    img_features = model.get_image_features(processed["pixel_values"])
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+    txt_features = model.get_text_features(processed["input_ids"], processed["attention_mask"])
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+
+    logits = 100 * jnp.einsum("bd,pd->bp", img_features, txt_features, precision=lax.Precision.HIGHEST)
+    logits = logits.reshape(logits.shape[0], -1, 2)
+    probs = jax.nn.softmax(logits, axis=-1)[..., 0]
+
+    if len(prompts_names) == 1:
+        return probs.squeeze(-1)
+    return {name: probs[:, i] for i, name in enumerate(prompts_names)}

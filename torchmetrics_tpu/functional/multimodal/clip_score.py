@@ -1,0 +1,96 @@
+"""CLIPScore.
+
+Parity: reference ``src/torchmetrics/functional/multimodal/clip_score.py`` (model
+loading ``:94-106``, score ``:109-170``): 100 * cosine similarity between CLIP image
+and text embeddings.
+
+The CLIP weights must be locally cached (this environment has no network egress);
+transformers' FlaxCLIPModel runs the forward natively on the TPU.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from torchmetrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+_DEFAULT_MODEL = "openai/clip-vit-large-patch14"
+
+
+def _get_clip_model_and_processor(model_name_or_path: str = _DEFAULT_MODEL):
+    """Load FlaxCLIPModel + processor from the local transformers cache."""
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "CLIP metrics require that `transformers` is installed."
+        )
+    from transformers import CLIPProcessor, FlaxCLIPModel
+
+    try:
+        model = FlaxCLIPModel.from_pretrained(model_name_or_path, local_files_only=True)
+        processor = CLIPProcessor.from_pretrained(model_name_or_path, local_files_only=True)
+    except Exception as err:
+        raise OSError(
+            f"Could not load CLIP model `{model_name_or_path}` from the local transformers cache"
+            " and this environment has no network access. Provide a locally cached model path."
+        ) from err
+    return model, processor
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model,
+    processor,
+) -> Tuple[Array, int]:
+    """Per-sample 100·cos(image emb, text emb) for a batch."""
+    if not isinstance(images, list):
+        if images.ndim == 3:
+            images = [images]
+        else:
+            images = list(images)
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+
+    processed_input = processor(
+        text=text, images=[np.asarray(i, dtype=np.uint8) for i in images],
+        return_tensors="np", padding=True,
+    )
+    img_features = model.get_image_features(processed_input["pixel_values"])
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+    txt_features = model.get_text_features(
+        processed_input["input_ids"], processed_input["attention_mask"]
+    )
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+
+    score = 100 * jnp.einsum(
+        "bd,bd->b", img_features, txt_features, precision=lax.Precision.HIGHEST
+    )
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: str = _DEFAULT_MODEL,
+) -> Array:
+    r"""Compute CLIPScore, the CLIP-embedding cosine agreement of images and captions.
+
+    Requires locally cached CLIP weights (no network egress in this environment).
+    """
+    model, processor = _get_clip_model_and_processor(model_name_or_path)
+    score, _ = _clip_score_update(images, text, model, processor)
+    score = score.mean(0)
+    return jnp.maximum(score, jnp.zeros_like(score))

@@ -4,6 +4,7 @@ Parity: reference ``src/torchmetrics/functional/text/__init__.py`` (BERTScore/In
 are model-based and ship with the Flax extractor stack).
 """
 
+from torchmetrics_tpu.functional.text.bert import bert_score
 from torchmetrics_tpu.functional.text.bleu import bleu_score
 from torchmetrics_tpu.functional.text.cer import char_error_rate
 from torchmetrics_tpu.functional.text.chrf import chrf_score
@@ -20,6 +21,7 @@ from torchmetrics_tpu.functional.text.wil import word_information_lost
 from torchmetrics_tpu.functional.text.wip import word_information_preserved
 
 __all__ = [
+    "bert_score",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

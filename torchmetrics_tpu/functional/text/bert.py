@@ -1,0 +1,218 @@
+"""BERTScore.
+
+Parity: reference ``src/torchmetrics/functional/text/bert.py`` (embedding/idf pipeline
+``:51-140``, greedy cosine matching ``:134-242``, public fn ``:243-447``) and
+``functional/text/helper_embedding_metric.py`` (special-token masking ``:33-48``, IDF
+``:240-259``).
+
+TPU design: the greedy matching is one ``blpd,blrd->blpr`` einsum (MXU) with masked
+row/column maxima; embeddings come from either a user-provided callable
+``model(input_ids, attention_mask) -> (B, S, D)`` or a ``transformers`` Flax model
+(requires locally cached weights — this environment cannot download them).
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from torchmetrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+_DEFAULT_MODEL = "roberta-large"
+
+
+def _simple_whitespace_tokenizer(texts: List[str], max_length: int) -> Dict[str, np.ndarray]:
+    """Minimal fallback tokenizer: whitespace tokens hashed to stable ids (crc32), so
+    ids agree across calls and processes."""
+    import zlib
+
+    ids_list, mask_list = [], []
+    for text in texts:
+        tokens = text.split()[: max_length - 2]
+        ids = [1] + [3 + zlib.crc32(tok.encode()) % (2**30) for tok in tokens] + [2]
+        ids_list.append(ids)
+        mask_list.append([1] * len(ids))
+    seq_len = max(len(i) for i in ids_list)
+    input_ids = np.zeros((len(texts), seq_len), dtype=np.int32)
+    attention_mask = np.zeros((len(texts), seq_len), dtype=np.int32)
+    for i, (ids, mask) in enumerate(zip(ids_list, mask_list)):
+        input_ids[i, : len(ids)] = ids
+        attention_mask[i, : len(mask)] = mask
+    return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: np.ndarray) -> np.ndarray:
+    """Zero out the [CLS] (first) and [SEP] (last attended) positions."""
+    attention_mask = attention_mask.copy()
+    attention_mask[:, 0] = 0
+    sep_position = np.cumsum(attention_mask - 0.1, axis=-1).argmax(-1)
+    attention_mask[np.arange(attention_mask.shape[0]), sep_position] = 0
+    return attention_mask
+
+
+def _get_tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """Inverse document frequencies over the reference corpus."""
+    num_sentences = input_ids.shape[0]
+    token_counter: Counter = Counter()
+    for ids, mask in zip(input_ids, attention_mask):
+        token_counter.update(set(ids[mask.astype(bool)].tolist()))
+    tokens_idf: Dict[int, float] = defaultdict(lambda: math.log(num_sentences + 1))
+    tokens_idf.update(
+        {idx: math.log((num_sentences + 1) / (occurrence + 1)) for idx, occurrence in token_counter.items()}
+    )
+    return tokens_idf
+
+
+def _embed_and_scale(
+    encoded: Dict[str, np.ndarray],
+    model: Callable,
+    idf: bool,
+    tokens_idf: Optional[Dict[int, float]],
+) -> Tuple[Array, Array]:
+    """Normalized masked embeddings + per-token (idf or uniform) weights."""
+    input_ids = jnp.asarray(encoded["input_ids"])
+    attention_mask = np.asarray(encoded["attention_mask"])
+
+    out = jnp.asarray(model(input_ids, jnp.asarray(attention_mask)), dtype=jnp.float32)
+    if out.ndim != 3 or out.shape[:2] != input_ids.shape:
+        raise ValueError(
+            "The model output must have the shape (batch_size, seq_len, model_dim),"
+            f" but got {out.shape}."
+        )
+    out = out / jnp.linalg.norm(out, axis=-1, keepdims=True)
+
+    processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
+    out = out * jnp.asarray(processed_mask, dtype=out.dtype)[:, :, None]
+
+    if idf:
+        assert tokens_idf is not None
+        ids_idf = np.vectorize(lambda t: tokens_idf[int(t)])(np.asarray(encoded["input_ids"]))
+        weights = ids_idf * processed_mask
+    else:
+        weights = processed_mask.astype(np.float64)
+    weights = weights / weights.sum(-1, keepdims=True)
+    return out, jnp.asarray(weights, dtype=jnp.float32)
+
+
+def _get_precision_recall_f1(
+    preds_embeddings: Array,
+    target_embeddings: Array,
+    preds_weights: Array,
+    target_weights: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-matched weighted precision/recall/F1 from normalized embeddings."""
+    cos_sim = jnp.einsum(
+        "bpd,brd->bpr", preds_embeddings, target_embeddings, precision=lax.Precision.HIGHEST
+    )
+    precision = (cos_sim.max(axis=2) * preds_weights).sum(-1)
+    recall = (cos_sim.max(axis=1) * target_weights).sum(-1)
+    f1_score = 2 * precision * recall / (precision + recall)
+    f1_score = jnp.where(jnp.isnan(f1_score), 0.0, f1_score)
+    return precision, recall, f1_score
+
+
+def _load_flax_model(model_name_or_path: str, num_layers: Optional[int]):
+    """Load a transformers Flax encoder + tokenizer from local cache (no egress here)."""
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` with a `model_name_or_path` requires that `transformers` is installed."
+        )
+    from transformers import AutoTokenizer, FlaxAutoModel
+
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+        hf_model = FlaxAutoModel.from_pretrained(model_name_or_path, local_files_only=True)
+    except Exception as err:
+        raise OSError(
+            f"Could not load `{model_name_or_path}` from the local transformers cache and this"
+            " environment has no network access. Provide a locally available model path, or pass"
+            " a custom `model` callable + `user_tokenizer`."
+        ) from err
+
+    def forward(input_ids: Array, attention_mask: Array) -> Array:
+        out = hf_model(
+            input_ids=np.asarray(input_ids), attention_mask=np.asarray(attention_mask),
+            output_hidden_states=True,
+        )
+        layer = num_layers if num_layers is not None else -1
+        return jnp.asarray(out.hidden_states[layer])
+
+    return forward, tokenizer
+
+
+def bert_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    model: Optional[Callable] = None,
+    user_tokenizer: Any = None,
+    idf: bool = False,
+    max_length: int = 512,
+    **kwargs: Any,
+) -> Dict[str, Array]:
+    """Compute BERTScore precision/recall/F1 between candidate and reference sentences.
+
+    ``model`` may be any callable ``(input_ids, attention_mask) -> (B, S, D)``
+    embeddings; without it, ``model_name_or_path`` is loaded through transformers'
+    Flax auto classes (locally cached weights required).
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.text import bert_score
+        >>> def toy_model(input_ids, attention_mask):
+        ...     key = jax.random.PRNGKey(0)
+        ...     table = jax.random.normal(key, (1000, 8))
+        ...     return table[input_ids % 1000]
+        >>> preds = ["hello there", "general kenobi"]
+        >>> target = ["hello there", "master kenobi"]
+        >>> score = bert_score(preds, target, model=toy_model)
+        >>> float(score["f1"][0]) > 0.99
+        True
+    """
+    preds_list = [preds] if isinstance(preds, str) else list(preds)
+    target_list = [target] if isinstance(target, str) else list(target)
+    if len(preds_list) != len(target_list):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    if model is None:
+        model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers)
+
+    if user_tokenizer is not None:
+        enc_p = user_tokenizer(preds_list, padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        enc_t = user_tokenizer(target_list, padding=True, truncation=True, max_length=max_length, return_tensors="np")
+        enc_preds = {"input_ids": np.asarray(enc_p["input_ids"]), "attention_mask": np.asarray(enc_p["attention_mask"])}
+        enc_target = {"input_ids": np.asarray(enc_t["input_ids"]), "attention_mask": np.asarray(enc_t["attention_mask"])}
+    else:
+        enc_all = _simple_whitespace_tokenizer(preds_list + target_list, max_length)
+        n = len(preds_list)
+        enc_preds = {k: v[:n] for k, v in enc_all.items()}
+        enc_target = {k: v[n:] for k, v in enc_all.items()}
+
+    tokens_idf = (
+        _get_tokens_idf(enc_target["input_ids"], enc_target["attention_mask"]) if idf else None
+    )
+
+    preds_emb, preds_w = _embed_and_scale(enc_preds, model, idf, tokens_idf)
+    target_emb, target_w = _embed_and_scale(enc_target, model, idf, tokens_idf)
+
+    # pad to a common sequence length so the einsum is static-shape
+    max_len = max(preds_emb.shape[1], target_emb.shape[1])
+
+    def pad_to(x, n):
+        return jnp.pad(x, [(0, 0), (0, n - x.shape[1])] + [(0, 0)] * (x.ndim - 2))
+
+    preds_emb, target_emb = pad_to(preds_emb, max_len), pad_to(target_emb, max_len)
+    preds_w, target_w = pad_to(preds_w, max_len), pad_to(target_w, max_len)
+
+    precision, recall, f1_score = _get_precision_recall_f1(preds_emb, target_emb, preds_w, target_w)
+    return {"precision": precision, "recall": recall, "f1": f1_score}

@@ -26,6 +26,8 @@ from torchmetrics_tpu.image.quality import (
 from torchmetrics_tpu.image.fid import FrechetInceptionDistance
 from torchmetrics_tpu.image.inception import InceptionScore
 from torchmetrics_tpu.image.kid import KernelInceptionDistance
+from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength
 from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
 from torchmetrics_tpu.image.ssim import (
     MultiScaleStructuralSimilarityIndexMeasure,
@@ -37,6 +39,8 @@ __all__ = [
     "FrechetInceptionDistance",
     "InceptionScore",
     "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "PerceptualPathLength",
     "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",

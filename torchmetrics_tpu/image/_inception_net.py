@@ -212,8 +212,11 @@ def _resize_bilinear_tf1(imgs: Array, out_h: int, out_w: int) -> Array:
     y_lo, y_hi, y_frac = axis_weights(in_h, out_h)
     x_lo, x_hi, x_frac = axis_weights(in_w, out_w)
 
-    top = imgs[:, y_lo][:, :, x_lo] * (1 - x_frac[None, None, :, None]) + imgs[:, y_lo][:, :, x_hi] * x_frac[None, None, :, None]
-    bottom = imgs[:, y_hi][:, :, x_lo] * (1 - x_frac[None, None, :, None]) + imgs[:, y_hi][:, :, x_hi] * x_frac[None, None, :, None]
+    rows_lo = imgs[:, y_lo]
+    rows_hi = imgs[:, y_hi]
+    xf = x_frac[None, None, :, None]
+    top = rows_lo[:, :, x_lo] * (1 - xf) + rows_lo[:, :, x_hi] * xf
+    bottom = rows_hi[:, :, x_lo] * (1 - xf) + rows_hi[:, :, x_hi] * xf
     return top * (1 - y_frac[None, :, None, None]) + bottom * y_frac[None, :, None, None]
 
 
@@ -263,12 +266,11 @@ class InceptionFeatureExtractor:
             self.params = self.net.init(rng, dummy)
             self._random_weights = True
 
-        self._forward = jax.jit(self._apply)
+        # preprocessing (layout fix, quantize, TF1 resize, remap) is shape-static, so
+        # the whole pipeline compiles into one fused program per input shape
+        self._forward = jax.jit(self._preprocess_and_apply)
 
-    def _apply(self, variables: dict, imgs: Array) -> Array:
-        return self.net.apply(variables, imgs)[self.feature_key]
-
-    def _preprocess(self, imgs: Array) -> Array:
+    def _preprocess_and_apply(self, variables: dict, imgs: Array) -> Array:
         imgs = jnp.asarray(imgs)
         if imgs.ndim == 3:
             imgs = imgs[None]
@@ -280,11 +282,11 @@ class InceptionFeatureExtractor:
         imgs = imgs.astype(jnp.float32)
         if imgs.shape[1:3] != (299, 299):
             imgs = _resize_bilinear_tf1(imgs, 299, 299)
-        return (imgs - 128.0) / 128.0
+        imgs = (imgs - 128.0) / 128.0
+        return self.net.apply(variables, imgs)[self.feature_key]
 
     def __call__(self, imgs: Array) -> Array:
-        feats = self._forward(self.params, self._preprocess(imgs))
-        return feats.astype(jnp.float32)
+        return self._forward(self.params, imgs).astype(jnp.float32)
 
 
 def load_torch_fidelity_weights(path: str) -> dict:

@@ -1,0 +1,91 @@
+"""Learned perceptual image patch similarity (LPIPS) module.
+
+Parity: reference ``src/torchmetrics/image/lpip.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    r"""LPIPS metric module.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+        >>> feature_fn = lambda img: [img, img[:, :, ::2, ::2]]
+        >>> lpips = LearnedPerceptualImagePatchSimilarity(feature_fn=feature_fn)
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
+        >>> img1 = jax.random.uniform(k1, (4, 3, 16, 16)) * 2 - 1
+        >>> img2 = jax.random.uniform(k2, (4, 3, 16, 16)) * 2 - 1
+        >>> lpips.update(img1, img2)
+        >>> float(lpips.compute()) > 0
+        True
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    sum_scores: Array
+    total: Array
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        feature_fn: Optional[Callable[[Array], Sequence[Array]]] = None,
+        head_weights: Optional[Sequence[Array]] = None,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        valid_net_type = ("vgg", "alex", "squeeze")
+        if net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        if feature_fn is None:
+            raise ModuleNotFoundError(
+                f"The `{net_type}` LPIPS backbone requires pretrained torchvision weights, which"
+                " cannot be downloaded in this environment. Pass `feature_fn` to use the native"
+                " LPIPS machinery with your own backbone."
+            )
+        self.net_type = net_type
+        self.reduction = reduction
+        self.normalize = normalize
+        self.feature_fn = feature_fn
+        self.head_weights = head_weights
+
+        self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Accumulate per-pair LPIPS distances."""
+        loss = learned_perceptual_image_patch_similarity(
+            img1, img2, self.net_type, reduction="sum", normalize=self.normalize,
+            feature_fn=self.feature_fn, head_weights=self.head_weights,
+        )
+        self.sum_scores = self.sum_scores + loss
+        self.total = self.total + jnp.asarray(img1).shape[0]
+
+    def compute(self) -> Array:
+        """Reduced LPIPS over all pairs."""
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

@@ -1,0 +1,102 @@
+"""Perceptual path length (module).
+
+Parity: reference ``src/torchmetrics/image/perceptual_path_length.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.image.perceptual_path_length import perceptual_path_length
+
+Array = jax.Array
+
+
+class PerceptualPathLength(Metric):
+    r"""PPL metric module: ``update`` stores the generator; ``compute`` samples and scores.
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PerceptualPathLength
+        >>> class Generator:
+        ...     key = jax.random.PRNGKey(0)
+        ...     def sample(self, n):
+        ...         self.key, sub = jax.random.split(self.key)
+        ...         return jax.random.normal(sub, (n, 8))
+        ...     def __call__(self, z):
+        ...         return jnp.tanh(z[:, :3, None, None] * jnp.ones((1, 3, 16, 16)))
+        >>> sim = lambda a, b: jnp.abs(a - b).mean(axis=(1, 2, 3))
+        >>> ppl = PerceptualPathLength(num_samples=32, batch_size=16, resize=None,
+        ...                            similarity_fn=sim)
+        >>> ppl.update(generator=Generator())
+        >>> mean, std, dists = ppl.compute()
+        >>> bool(jnp.isfinite(mean))
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        num_samples: int = 10_000,
+        conditional: bool = False,
+        batch_size: int = 128,
+        interpolation_method: str = "lerp",
+        epsilon: float = 1e-4,
+        resize: Optional[int] = 64,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        similarity_fn: Optional[Callable[[Array, Array], Array]] = None,
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        if not (isinstance(num_samples, int) and num_samples > 0):
+            raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+        if interpolation_method not in ("lerp", "slerp_any", "slerp_unit"):
+            raise ValueError(
+                f"Argument `interpolation_method` must be one of 'lerp', 'slerp_any', 'slerp_unit',"
+                f" got {interpolation_method}."
+            )
+        self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
+        self.epsilon = epsilon
+        self.resize = resize
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.similarity_fn = similarity_fn
+        self._generator = None
+
+    def update(self, generator: Any) -> None:
+        """Store the generator to be evaluated (sampling happens at compute)."""
+        if not hasattr(generator, "sample"):
+            raise NotImplementedError(
+                "The generator must implement a `sample` method returning latents"
+            )
+        self._generator = generator
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Sample interpolation pairs and return (mean, std, distances)."""
+        if self._generator is None:
+            raise RuntimeError("No generator was provided; call `update(generator)` first.")
+        return perceptual_path_length(
+            self._generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            resize=self.resize,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            similarity_fn=self.similarity_fn,
+        )

@@ -1,0 +1,9 @@
+"""Multimodal metrics (stateful modules).
+
+Parity: reference ``src/torchmetrics/multimodal/__init__.py``.
+"""
+
+from torchmetrics_tpu.multimodal.clip_score import CLIPScore
+from torchmetrics_tpu.multimodal.clip_iqa import CLIPImageQualityAssessment
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore"]

@@ -1,0 +1,67 @@
+"""CLIPImageQualityAssessment module.
+
+Parity: reference ``src/torchmetrics/multimodal/clip_iqa.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.multimodal.clip_iqa import (
+    _clip_iqa_format_prompts,
+    clip_image_quality_assessment,
+)
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CLIPImageQualityAssessment(Metric):
+    r"""CLIP-IQA: no-reference image quality via antonym prompt pairs.
+
+    Requires locally cached CLIP weights (this environment has no network egress);
+    the first ``update`` raises a descriptive ``OSError`` when they are unavailable.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = "clip_iqa",
+        data_range: float = 1.0,
+        prompts: Union[Tuple[str, ...], str] = ("quality",),
+        **kwargs: Any,
+    ) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.data_range = data_range
+        self.prompts = prompts
+        self.prompts_names, _ = _clip_iqa_format_prompts(prompts)
+        self.add_state("probs_list", [], dist_reduce_fx="cat")
+
+    def update(self, images: Array) -> None:
+        """Score the batch against the prompt pairs and store per-sample probabilities."""
+        result = clip_image_quality_assessment(
+            images, self.model_name_or_path, self.data_range, self.prompts
+        )
+        if isinstance(result, dict):
+            stacked = jnp.stack([result[name] for name in self.prompts_names], axis=1)
+        else:
+            stacked = result[:, None]
+        self.probs_list.append(stacked)
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        """Per-sample scores (single prompt) or a dict of per-prompt score vectors."""
+        probs = dim_zero_cat(self.probs_list)
+        if len(self.prompts_names) == 1:
+            return probs.squeeze(-1)
+        return {name: probs[:, i] for i, name in enumerate(self.prompts_names)}

@@ -1,0 +1,54 @@
+"""CLIPScore module.
+
+Parity: reference ``src/torchmetrics/multimodal/clip_score.py:37-186``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.functional.multimodal.clip_score import (
+    _DEFAULT_MODEL,
+    _clip_score_update,
+    _get_clip_model_and_processor,
+)
+
+Array = jax.Array
+
+
+class CLIPScore(Metric):
+    r"""CLIPScore: CLIP-embedding agreement between images and captions.
+
+    Requires locally cached CLIP weights (this environment has no network egress);
+    construction raises a descriptive ``OSError`` when they are unavailable.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 100.0
+
+    score: Array
+    n_samples: Array
+
+    def __init__(self, model_name_or_path: str = _DEFAULT_MODEL, **kwargs: Any) -> None:
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        self.model, self.processor = _get_clip_model_and_processor(model_name_or_path)
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
+        """Accumulate per-sample CLIP scores."""
+        score, n_samples = _clip_score_update(images, text, self.model, self.processor)
+        self.score = self.score + score.sum(0)
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        """Mean CLIPScore, clamped at zero."""
+        return jnp.maximum(self.score / self.n_samples, jnp.zeros_like(self.score))

@@ -1,10 +1,11 @@
 """Text metrics (stateful modules).
 
-Parity: reference ``src/torchmetrics/text/__init__.py`` (BERTScore/InfoLM are
-model-based and ship with the Flax extractor stack).
+Parity: reference ``src/torchmetrics/text/__init__.py``.
 """
 
+from torchmetrics_tpu.text.bert import BERTScore
 from torchmetrics_tpu.text.bleu import BLEUScore, SacreBLEUScore
+from torchmetrics_tpu.text.infolm import InfoLM
 from torchmetrics_tpu.text.chrf import CHRFScore
 from torchmetrics_tpu.text.eed import ExtendedEditDistance
 from torchmetrics_tpu.text.error_rates import (
@@ -21,7 +22,9 @@ from torchmetrics_tpu.text.squad import SQuAD
 from torchmetrics_tpu.text.ter import TranslationEditRate
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
+    "InfoLM",
     "CharErrorRate",
     "CHRFScore",
     "EditDistance",

@@ -1,0 +1,133 @@
+"""BERTScore module.
+
+Parity: reference ``src/torchmetrics/text/bert.py:57-268``: tokenized id/mask "cat"
+states, model embedding + greedy cosine matching at compute.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.text.bert import (
+    _DEFAULT_MODEL,
+    _embed_and_scale,
+    _get_precision_recall_f1,
+    _get_tokens_idf,
+    _load_flax_model,
+)
+from torchmetrics_tpu.text._base import _TextMetric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class BERTScore(_TextMetric):
+    r"""BERTScore: greedy cosine matching of contextual embeddings.
+
+    ``model`` may be any callable ``(input_ids, attention_mask) -> (B, S, D)``; without
+    it, ``model_name_or_path`` is loaded via transformers' Flax auto classes (locally
+    cached weights required — this environment cannot download them).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.text import BERTScore
+        >>> def toy_model(input_ids, attention_mask):
+        ...     table = jax.random.normal(jax.random.PRNGKey(0), (1000, 8))
+        ...     return table[input_ids % 1000]
+        >>> bertscore = BERTScore(model=toy_model)
+        >>> bertscore.update(["hello there"], ["hello there"])
+        >>> float(bertscore.compute()["f1"][0]) > 0.99
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    preds_input_ids: List[Array]
+    preds_attention_mask: List[Array]
+    target_input_ids: List[Array]
+    target_attention_mask: List[Array]
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        model: Optional[Callable] = None,
+        user_tokenizer: Any = None,
+        idf: bool = False,
+        max_length: int = 512,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if model is None:
+            model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers)
+        self.model = model
+        self.user_tokenizer = user_tokenizer
+        self.idf = idf
+        self.max_length = max_length
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def _tokenize(self, texts: Sequence[str]) -> Dict[str, np.ndarray]:
+        if self.user_tokenizer is not None:
+            enc = self.user_tokenizer(
+                list(texts), padding="max_length", truncation=True,
+                max_length=self.max_length, return_tensors="np",
+            )
+            return {"input_ids": np.asarray(enc["input_ids"]), "attention_mask": np.asarray(enc["attention_mask"])}
+        # whitespace fallback: ids come from a stable content hash, so they agree
+        # across updates AND across processes (the states are cat-synced)
+        import zlib
+
+        ids_rows, mask_rows = [], []
+        for text in texts:
+            tokens = text.split()[: self.max_length - 2]
+            ids = [1] + [3 + zlib.crc32(t.encode()) % (2**30) for t in tokens] + [2]
+            row = np.zeros(self.max_length, dtype=np.int32)
+            mask = np.zeros(self.max_length, dtype=np.int32)
+            row[: len(ids)] = ids
+            mask[: len(ids)] = 1
+            ids_rows.append(row)
+            mask_rows.append(mask)
+        return {"input_ids": np.stack(ids_rows), "attention_mask": np.stack(mask_rows)}
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        """Tokenize and store fixed-width id/mask rows."""
+        preds_list = [preds] if isinstance(preds, str) else list(preds)
+        target_list = [target] if isinstance(target, str) else list(target)
+        if len(preds_list) != len(target_list):
+            raise ValueError("Number of predicted and reference sentences must be the same!")
+        enc_p = self._tokenize(preds_list)
+        enc_t = self._tokenize(target_list)
+        self.preds_input_ids.append(jnp.asarray(enc_p["input_ids"]))
+        self.preds_attention_mask.append(jnp.asarray(enc_p["attention_mask"]))
+        self.target_input_ids.append(jnp.asarray(enc_t["input_ids"]))
+        self.target_attention_mask.append(jnp.asarray(enc_t["attention_mask"]))
+
+    def compute(self) -> Dict[str, Array]:
+        """BERTScore P/R/F1 over all accumulated sentences."""
+        enc_preds = {
+            "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),
+            "attention_mask": np.asarray(dim_zero_cat(self.preds_attention_mask)),
+        }
+        enc_target = {
+            "input_ids": np.asarray(dim_zero_cat(self.target_input_ids)),
+            "attention_mask": np.asarray(dim_zero_cat(self.target_attention_mask)),
+        }
+        tokens_idf = (
+            _get_tokens_idf(enc_target["input_ids"], enc_target["attention_mask"]) if self.idf else None
+        )
+        preds_emb, preds_w = _embed_and_scale(enc_preds, self.model, self.idf, tokens_idf)
+        target_emb, target_w = _embed_and_scale(enc_target, self.model, self.idf, tokens_idf)
+        precision, recall, f1_score = _get_precision_recall_f1(preds_emb, target_emb, preds_w, target_w)
+        return {"precision": precision, "recall": recall, "f1": f1_score}

@@ -1,0 +1,245 @@
+"""InfoLM.
+
+Parity: reference ``src/torchmetrics/text/infolm.py`` + ``functional/text/infolm.py``
+(information measures ``:104-296``, per-position masked-LM distributions ``:367-462``,
+update/compute ``:465-543``).
+
+The metric masks every token position, runs the masked LM, and aggregates the
+temperature-scaled token distributions into one per-sentence vocabulary distribution;
+sentence pairs are then compared with the chosen information measure. Pretrained
+masked-LM weights must be locally cached (no network egress here) — construction
+raises a descriptive error otherwise.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.text._base import _TextMetric
+from torchmetrics_tpu.utils.data import dim_zero_cat
+from torchmetrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+Array = jax.Array
+
+_ALLOWED_INFORMATION_MEASURES = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+
+class _InformationMeasure:
+    """The InfoLM divergence/distance family over vocabulary distributions."""
+
+    def __init__(self, information_measure: str, alpha: Optional[float] = None, beta: Optional[float] = None) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURES:
+            raise ValueError(
+                f"Argument `information_measure` expected to be one of {_ALLOWED_INFORMATION_MEASURES}"
+                f" but got {information_measure}"
+            )
+        self.information_measure = information_measure
+        needs_alpha = ("alpha_divergence", "ab_divergence", "renyi_divergence")
+        needs_beta = ("beta_divergence", "ab_divergence")
+        if information_measure in needs_alpha and not isinstance(alpha, float):
+            raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
+        if information_measure in needs_beta and not isinstance(beta, float):
+            raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+        if information_measure == "alpha_divergence" and alpha in (0, 1):
+            raise ValueError(f"Parameter `alpha` is expected to be float differened from 0 and 1 for {information_measure}.")
+        if information_measure == "beta_divergence" and beta in (0, -1):
+            raise ValueError(f"Parameter `beta` is expected to be float differened from 0 and -1 for {information_measure}.")
+        if information_measure == "ab_divergence" and (alpha is None or beta is None or 0 in (alpha, beta, alpha + beta)):
+            raise ValueError(
+                f"Parameters `alpha`, `beta` and their sum are expected to be differened from 0 for {information_measure}."
+            )
+        if information_measure == "renyi_divergence" and alpha == 1:
+            raise ValueError(f"Parameter `alpha` is expected to be float differened from 1 for {information_measure}.")
+        self.alpha = alpha or 0.0
+        self.beta = beta or 0.0
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        fn = getattr(self, f"_calculate_{self.information_measure}")
+        return jnp.nan_to_num(fn(preds_distribution, target_distribution))
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.linalg.norm(t - p, ord=1, axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.linalg.norm(t - p, ord=2, axis=-1)
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.linalg.norm(t - p, ord=jnp.inf, axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sqrt(p * t).sum(axis=-1), 0, 1))
+
+
+class InfoLM(_TextMetric):
+    r"""InfoLM: information measures over masked-LM predictive distributions.
+
+    Requires locally cached masked-LM weights (``google/bert_uncased_L-2_H-128_A-2``
+    by default); raises at construction when unavailable (no network egress here).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: str = "google/bert_uncased_L-2_H-128_A-2",
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        max_length: Optional[int] = None,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.information_measure_fn = _InformationMeasure(information_measure, alpha, beta)
+        if not _TRANSFORMERS_AVAILABLE:
+            raise ModuleNotFoundError("InfoLM metric requires that `transformers` is installed.")
+        from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
+
+        try:
+            self.tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+            self.model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path, local_files_only=True)
+        except Exception as err:
+            raise OSError(
+                f"Could not load `{model_name_or_path}` from the local transformers cache and this"
+                " environment has no network access. Provide a locally cached model path."
+            ) from err
+        if not (isinstance(temperature, float) and temperature > 0):
+            raise ValueError(f"Argument `temperature` is expected to be a positive float but got {temperature}")
+        self.temperature = temperature
+        self.idf = idf
+        self.max_length = max_length or self.model.config.max_position_embeddings
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def update(self, preds, target) -> None:
+        """Tokenize and store fixed-width id/mask rows."""
+        for texts, ids_state, mask_state in (
+            (preds, self.preds_input_ids, self.preds_attention_mask),
+            (target, self.target_input_ids, self.target_attention_mask),
+        ):
+            texts = [texts] if isinstance(texts, str) else list(texts)
+            enc = self.tokenizer(
+                texts, padding="max_length", truncation=True, max_length=self.max_length, return_tensors="np"
+            )
+            ids_state.append(jnp.asarray(enc["input_ids"]))
+            mask_state.append(jnp.asarray(enc["attention_mask"]))
+
+    # ------------------------------------------------------------------ internals
+
+    def _token_mask(self, input_ids: np.ndarray) -> np.ndarray:
+        """True for real content tokens (not PAD/SEP/CLS)."""
+        special = {
+            self.tokenizer.pad_token_id,
+            self.tokenizer.sep_token_id,
+            self.tokenizer.cls_token_id,
+        }
+        mask = np.ones_like(input_ids, dtype=bool)
+        for tok in special:
+            if tok is not None:
+                mask &= input_ids != tok
+        return mask
+
+    def _ids_idf(self, input_ids: np.ndarray) -> np.ndarray:
+        """Per-token inverse document frequencies over this corpus."""
+        num_sentences = input_ids.shape[0]
+        counter: Counter = Counter()
+        for row in input_ids:
+            counter.update(set(row.tolist()))
+        idf: Dict[int, float] = defaultdict(lambda: math.log(num_sentences + 1))
+        idf.update({idx: math.log((num_sentences + 1) / (occ + 1)) for idx, occ in counter.items()})
+        return np.vectorize(lambda t: idf[int(t)])(input_ids)
+
+    def _sentence_distribution(self, input_ids: np.ndarray, attention_mask: np.ndarray) -> Array:
+        """Aggregate per-position masked-LM distributions into one per sentence."""
+        token_mask = self._token_mask(input_ids)
+        ids_idf = self._ids_idf(input_ids) if self.idf else None
+        seq_len = input_ids.shape[1]
+        mask_token_id = self.tokenizer.mask_token_id
+
+        distributions = []
+        for mask_idx in range(seq_len):
+            if not token_mask[:, mask_idx].any():
+                distributions.append(np.zeros((input_ids.shape[0], 1)))
+                continue
+            masked = input_ids.copy()
+            masked[:, mask_idx] = mask_token_id
+            logits = np.asarray(self.model(input_ids=masked, attention_mask=attention_mask).logits)
+            probs = jax.nn.softmax(jnp.asarray(logits[:, mask_idx, :]) / self.temperature, axis=-1)
+            probs = np.asarray(probs, dtype=np.float64)
+            if self.idf:
+                probs = probs * ids_idf[:, mask_idx : mask_idx + 1]
+            distributions.append(probs * token_mask[:, mask_idx : mask_idx + 1])
+
+        vocab = max(d.shape[1] for d in distributions)
+        total = np.zeros((input_ids.shape[0], vocab))
+        for d in distributions:
+            total[:, : d.shape[1]] += d
+        if self.idf:
+            denom = (token_mask * ids_idf).sum(axis=1, keepdims=True)
+        else:
+            denom = token_mask.sum(axis=1, keepdims=True)
+        return jnp.asarray(total / denom)
+
+    def compute(self):
+        """InfoLM score over all accumulated sentence pairs."""
+        preds_distribution = self._sentence_distribution(
+            np.asarray(dim_zero_cat(self.preds_input_ids)),
+            np.asarray(dim_zero_cat(self.preds_attention_mask)),
+        )
+        target_distribution = self._sentence_distribution(
+            np.asarray(dim_zero_cat(self.target_input_ids)),
+            np.asarray(dim_zero_cat(self.target_attention_mask)),
+        )
+        info_lm_score = self.information_measure_fn(preds_distribution, target_distribution)
+        if self.return_sentence_level_score:
+            return info_lm_score.mean(), info_lm_score
+        return info_lm_score.mean()
