@@ -1,0 +1,218 @@
+"""Cross-cutting metric battery: bf16 dtypes, differentiability, dist_sync_on_step,
+full stat-scores parametrization (top_k / multidim_average / ignore_index),
+multihost eager-sync unit coverage, and the empty-cat-state corner.
+
+Analog of reference ``tests/unittests/_helpers/testers.py:294-337,531-567``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+import jax
+import jax.numpy as jnp
+
+from tests.helpers.testers import MetricTester, _assert_allclose
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassStatScores,
+)
+from torchmetrics_tpu.functional.classification import multiclass_stat_scores
+from torchmetrics_tpu.regression import MeanSquaredError
+
+NUM_CLASSES = 5
+rng = np.random.RandomState(42)
+
+
+class TestDtypes:
+    """Metrics must accept bf16/f16 inputs (the TPU's native formats)."""
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_accuracy_bf16_preds(self, dtype):
+        preds = jnp.asarray(rng.rand(64, NUM_CLASSES), dtype=dtype)
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, 64))
+        metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")
+        val_low = metric(preds, target)
+        metric32 = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")
+        val_32 = metric32(jnp.asarray(preds, dtype=jnp.float32), target)
+        _assert_allclose(val_low, val_32, atol=1e-6)  # argmax is dtype-stable
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_mse_low_precision(self, dtype):
+        preds = rng.rand(128).astype(np.float32)
+        target = rng.rand(128).astype(np.float32)
+        metric = MeanSquaredError()
+        val = metric(jnp.asarray(preds, dtype=dtype), jnp.asarray(target, dtype=dtype))
+        expected = np.mean((preds - target) ** 2)
+        _assert_allclose(val, expected, atol=2e-2)  # bf16 has ~3 decimal digits
+
+    def test_ssim_bf16(self):
+        from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+
+        p = jnp.asarray(rng.rand(2, 1, 32, 32), dtype=jnp.bfloat16)
+        val = structural_similarity_index_measure(p, p, data_range=1.0)
+        assert float(val) == pytest.approx(1.0, abs=1e-2)
+
+
+class TestDifferentiability:
+    """Metrics flagged is_differentiable must produce finite gradients through update."""
+
+    def test_mse_grad(self):
+        metric = MeanSquaredError()
+        assert metric.is_differentiable
+
+        target = jnp.asarray(rng.rand(32))
+
+        def loss(preds):
+            state = metric.pure_update(metric.init_state(), preds, target)
+            return metric.pure_compute(state)
+
+        grads = jax.grad(loss)(jnp.asarray(rng.rand(32)))
+        assert bool(jnp.all(jnp.isfinite(grads)))
+        assert float(jnp.abs(grads).sum()) > 0
+
+    def test_si_sdr_grad(self):
+        from torchmetrics_tpu.functional.audio import scale_invariant_signal_distortion_ratio
+
+        target = jnp.asarray(rng.randn(1000).astype(np.float32))
+
+        def loss(preds):
+            return scale_invariant_signal_distortion_ratio(preds, target).mean()
+
+        grads = jax.grad(loss)(jnp.asarray(rng.randn(1000).astype(np.float32)))
+        assert bool(jnp.all(jnp.isfinite(grads)))
+
+    def test_ssim_grad(self):
+        from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+
+        target = jnp.asarray(rng.rand(1, 1, 32, 32).astype(np.float32))
+
+        def loss(preds):
+            return structural_similarity_index_measure(preds, target, data_range=1.0)
+
+        grads = jax.grad(loss)(jnp.asarray(rng.rand(1, 1, 32, 32).astype(np.float32)))
+        assert bool(jnp.all(jnp.isfinite(grads)))
+
+
+class TestDistSyncOnStep:
+    def test_forward_syncs_each_step(self):
+        """With dist_sync_on_step, forward returns the globally-synced batch value."""
+        preds = rng.rand(32, NUM_CLASSES).astype(np.float32)
+        target = rng.randint(0, NUM_CLASSES, 32)
+
+        metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", dist_sync_on_step=True)
+        batch_val = metric(jnp.asarray(preds), jnp.asarray(target))
+        expected = sk_accuracy(target, preds.argmax(-1))
+        _assert_allclose(batch_val, expected, atol=1e-6)
+        # accumulation still works after the synced forward
+        total = metric.compute()
+        _assert_allclose(total, expected, atol=1e-6)
+
+
+class TestStatScoresParametrization:
+    """The samplewise / top_k>1 one-hot paths, fully parametrized."""
+
+    @pytest.mark.parametrize("top_k", [1, 2, 3])
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+    def test_top_k_against_manual(self, top_k, average):
+        preds = rng.rand(64, NUM_CLASSES).astype(np.float32)
+        target = rng.randint(0, NUM_CLASSES, 64)
+        result = multiclass_stat_scores(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES,
+            average=average, top_k=top_k,
+        )
+        # manual top-k tp: target among the top-k predictions
+        topk_sets = np.argsort(-preds, axis=1)[:, :top_k]
+        hits = np.array([t in row for t, row in zip(target, topk_sets)])
+        if average == "micro":
+            tp = result[0]
+            _assert_allclose(tp, hits.sum(), atol=0)
+        else:
+            tp_per_class = np.zeros(NUM_CLASSES)
+            for t, h in zip(target, hits):
+                tp_per_class[t] += h
+            _assert_allclose(result[:, 0], tp_per_class, atol=0)
+
+    @pytest.mark.parametrize("ignore_index", [None, 0, 2])
+    @pytest.mark.parametrize("multidim_average", ["global", "samplewise"])
+    def test_multidim_average(self, ignore_index, multidim_average):
+        preds = rng.randint(0, NUM_CLASSES, (8, 16))
+        target = rng.randint(0, NUM_CLASSES, (8, 16))
+        result = multiclass_stat_scores(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES,
+            average="micro", multidim_average=multidim_average, ignore_index=ignore_index,
+        )
+        mask = np.ones_like(target, dtype=bool) if ignore_index is None else target != ignore_index
+        if multidim_average == "global":
+            tp = ((preds == target) & mask).sum()
+            support = mask.sum()
+            _assert_allclose(result[0], tp, atol=0)
+            _assert_allclose(result[4], support, atol=0)
+        else:
+            tp = ((preds == target) & mask).sum(axis=1)
+            _assert_allclose(result[:, 0], tp, atol=0)
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class_top_k_mesh(self, ddp):
+        preds = rng.rand(4, 32, NUM_CLASSES).astype(np.float32)
+        target = rng.randint(0, NUM_CLASSES, (4, 32))
+
+        def _ref(p, t):
+            topk = np.argsort(-p, axis=1)[:, :2]
+            return np.mean([tt in row for tt, row in zip(t, topk)])
+
+        MetricTester().run_class_metric_test(
+            preds, target,
+            metric_class=MulticlassAccuracy,
+            reference_metric=_ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro", "top_k": 2},
+            ddp=ddp,
+        )
+
+
+class TestSyncCorners:
+    def test_empty_cat_state_syncs(self):
+        """A metric with an empty 'cat' list state must survive sync (the reference's
+        empty-rank corner, tests/unittests/bases/test_ddp.py:284)."""
+        from torchmetrics_tpu.aggregation import CatMetric
+
+        metric = CatMetric()
+        # no update at all: state is an empty list
+        metric.sync(distributed_available=lambda: True)
+        metric.unsync()
+        metric.update(jnp.asarray([1.0, 2.0]))
+        _assert_allclose(metric.compute(), np.asarray([1.0, 2.0]), atol=0)
+
+    def test_multihost_eager_sync_single_process(self):
+        """The eager multihost path must be the identity for world size 1."""
+        from torchmetrics_tpu.parallel.reductions import Reduction
+        from torchmetrics_tpu.parallel.sync import _sync_leaf_multihost
+
+        x = jnp.asarray([1.0, 2.0, 3.0])
+        for reduction in (Reduction.SUM, Reduction.MEAN, Reduction.MAX, Reduction.MIN):
+            _assert_allclose(_sync_leaf_multihost(x, reduction), x, atol=0)
+
+    def test_unsynced_state_restored_after_sync(self):
+        metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")
+        preds = jnp.asarray(rng.rand(16, NUM_CLASSES).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, 16))
+        metric.update(preds, target)
+        before = {k: np.asarray(v) for k, v in metric.metric_state.items()}
+        metric.sync(distributed_available=lambda: True)
+        metric.unsync()
+        after = {k: np.asarray(v) for k, v in metric.metric_state.items()}
+        for k in before:
+            _assert_allclose(after[k], before[k], atol=0)
+
+
+class TestF1TopK:
+    @pytest.mark.parametrize("top_k", [1, 2])
+    def test_f1_runs_with_topk(self, top_k):
+        preds = jnp.asarray(rng.rand(64, NUM_CLASSES).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, 64))
+        metric = MulticlassF1Score(num_classes=NUM_CLASSES, average="macro", top_k=top_k)
+        val = metric(preds, target)
+        assert 0.0 <= float(val) <= 1.0

@@ -1,0 +1,45 @@
+"""Doctest runner: every metric docstring example executes as an API test.
+
+Analog of the reference's ``pytest --doctest-modules src/torchmetrics`` target
+(``Makefile:27-30``).
+"""
+
+from __future__ import annotations
+
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import torchmetrics_tpu
+
+# modules whose examples need unavailable pretrained weights
+_SKIP_MODULES = {
+    "torchmetrics_tpu.image._inception_net",
+    "torchmetrics_tpu.multimodal.clip_score",
+    "torchmetrics_tpu.multimodal.clip_iqa",
+    "torchmetrics_tpu.functional.multimodal.clip_score",
+    "torchmetrics_tpu.functional.multimodal.clip_iqa",
+    "torchmetrics_tpu.text.infolm",
+}
+
+
+def _iter_modules():
+    for module_info in pkgutil.walk_packages(
+        torchmetrics_tpu.__path__, prefix="torchmetrics_tpu."
+    ):
+        if module_info.name in _SKIP_MODULES:
+            continue
+        yield module_info.name
+
+
+@pytest.mark.parametrize("module_name", sorted(_iter_modules()))
+def test_doctests(module_name):
+    module = importlib.import_module(module_name)
+    results = doctest.testmod(
+        module,
+        optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS,
+        verbose=False,
+    )
+    assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"

@@ -36,7 +36,7 @@ class IntersectionOverUnion(Metric):
         ...            "labels": jnp.array([0])}]
         >>> metric = IntersectionOverUnion()
         >>> metric(preds, target)["iou"].round(4)
-        Array(0.6898, dtype=float32)
+        Array(0.68979996, dtype=float32)
     """
 
     is_differentiable = False
@@ -170,7 +170,7 @@ class DistanceIntersectionOverUnion(IntersectionOverUnion):
         ...            "labels": jnp.array([0])}]
         >>> metric = DistanceIntersectionOverUnion()
         >>> metric(preds, target)["diou"].round(4)
-        Array(0.6883, dtype=float32)
+        Array(0.68829995, dtype=float32)
     """
 
     _iou_type: str = "diou"
@@ -190,7 +190,7 @@ class CompleteIntersectionOverUnion(IntersectionOverUnion):
         ...            "labels": jnp.array([0])}]
         >>> metric = CompleteIntersectionOverUnion()
         >>> metric(preds, target)["ciou"].round(4)
-        Array(0.6883, dtype=float32)
+        Array(0.68829995, dtype=float32)
     """
 
     _iou_type: str = "ciou"

@@ -127,7 +127,7 @@ class ModifiedPanopticQuality(PanopticQuality):
         >>> pq_modified = ModifiedPanopticQuality(
         ...     things={0, 1}, stuffs={6, 7}, allow_unknown_preds_category=True)
         >>> pq_modified(preds, target).round(4)
-        Array(0.7667, dtype=float32)
+        Array(0.76669997, dtype=float32)
     """
 
     def __init__(

@@ -21,13 +21,14 @@ import numpy as np
 
 Array = jax.Array
 
-_ps_dict: dict = {}  # spk_num -> permutation index array
+_ps_dict: dict = {}  # spk_num -> permutation index array (host numpy — a device array
+# cached from inside a jit trace would leak tracers into later calls)
 
 
 def _gen_permutations(spk_num: int) -> Array:
     if spk_num not in _ps_dict:
-        _ps_dict[spk_num] = jnp.asarray(list(permutations(range(spk_num))), dtype=jnp.int32)
-    return _ps_dict[spk_num]
+        _ps_dict[spk_num] = np.asarray(list(permutations(range(spk_num))), dtype=np.int32)
+    return jnp.asarray(_ps_dict[spk_num])
 
 
 def _find_best_perm_by_linear_sum_assignment(

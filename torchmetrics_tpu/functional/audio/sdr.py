@@ -103,7 +103,7 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
         >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
         >>> scale_invariant_signal_distortion_ratio(preds, target).round(4)
-        Array(18.4030, dtype=float32)
+        Array(18.403, dtype=float32)
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)

@@ -69,7 +69,7 @@ def complex_scale_invariant_signal_noise_ratio(
         >>> k1, k2 = jax.random.split(jax.random.PRNGKey(42))
         >>> preds = jax.random.normal(k1, (1, 257, 100, 2))
         >>> target = jax.random.normal(k2, (1, 257, 100, 2))
-        >>> float(complex_scale_invariant_signal_noise_ratio(preds, target)) < 0
+        >>> float(complex_scale_invariant_signal_noise_ratio(preds, target)[0]) < 0
         True
     """
     preds = jnp.asarray(preds)

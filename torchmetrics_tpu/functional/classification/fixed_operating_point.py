@@ -71,6 +71,42 @@ def _best_subject_to(
     return best.astype(jnp.float32), thr.astype(jnp.float32)
 
 
+def _first_max_subject_to(
+    value: Array, constraint: Array, floor: float, thresholds: Array, no_solution_threshold: float = 1e6
+) -> Tuple[Array, Array]:
+    """(max value s.t. constraint >= floor, threshold at the FIRST such maximum).
+
+    The specificity@sensitivity / sensitivity@specificity reference families use a
+    plain ``argmax`` over the feasible curve points (``specificity_sensitivity.py``,
+    first-occurrence tie-break, no zero-value sentinel) — unlike the lexargmax used by
+    the recall/precision fixed-point families.
+    """
+    n = min(thresholds.shape[0], value.shape[-1])
+    value_t, constraint_t, thr_t = value[..., :n], constraint[..., :n], thresholds[:n]
+    feasible = constraint_t >= floor
+    masked_v = jnp.where(feasible, value_t, -jnp.inf)
+    idx = jnp.argmax(masked_v, axis=-1)  # first occurrence of the max
+    best = jnp.take_along_axis(masked_v, idx[..., None], axis=-1)[..., 0]
+    thr = thr_t[idx]
+    any_feasible = jnp.any(feasible, axis=-1)
+    best = jnp.where(any_feasible, best, 0.0)
+    thr = jnp.where(any_feasible, thr, no_solution_threshold)
+    return best.astype(jnp.float32), thr.astype(jnp.float32)
+
+
+def _multi_curve_first_max(values, constraints, thresholds, floor):
+    """Vectorized / ragged-list application of `_first_max_subject_to`."""
+    if isinstance(values, jax.Array) and values.ndim == 2:
+        thr = thresholds[0] if isinstance(thresholds, (list, tuple)) else thresholds
+        return _first_max_subject_to(values, constraints, floor, thr)
+    vals, thrs = [], []
+    for v_curve, c_curve, t in zip(values, constraints, thresholds):
+        v, th = _first_max_subject_to(v_curve, c_curve, floor, t)
+        vals.append(v)
+        thrs.append(th)
+    return jnp.stack(vals), jnp.stack(thrs)
+
+
 def _validate_floor(name: str, v: float) -> None:
     if not isinstance(v, (int, float)) or not (0 <= v <= 1):
         raise ValueError(f"Expected argument `{name}` to be a float in the [0,1] range, but got {v}")
@@ -324,7 +360,7 @@ def precision_at_fixed_recall(
 
 def _spec_at_sens_from_roc(fpr, tpr, thres, min_sensitivity: float):
     specificity = 1.0 - fpr
-    return _best_subject_to(specificity, tpr, min_sensitivity, thres)
+    return _first_max_subject_to(specificity, tpr, min_sensitivity, thres)
 
 
 def binary_specificity_at_sensitivity(
@@ -377,10 +413,10 @@ def multiclass_specificity_at_sensitivity(
     state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
     fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
     if isinstance(fpr, jax.Array) and fpr.ndim == 2:
-        return _multi_curve_best([1.0 - fpr[i] for i in range(num_classes)],
-                                 [tpr[i] for i in range(num_classes)],
-                                 [thres] * num_classes, min_sensitivity, swap=True)
-    return _multi_curve_best([1.0 - f for f in fpr], tpr, thres, min_sensitivity, swap=True)
+        return _multi_curve_first_max([1.0 - fpr[i] for i in range(num_classes)],
+                                      [tpr[i] for i in range(num_classes)],
+                                      [thres] * num_classes, min_sensitivity)
+    return _multi_curve_first_max([1.0 - f for f in fpr], tpr, thres, min_sensitivity)
 
 
 def multilabel_specificity_at_sensitivity(
@@ -403,10 +439,10 @@ def multilabel_specificity_at_sensitivity(
     state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
     fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
     if isinstance(fpr, jax.Array) and fpr.ndim == 2:
-        return _multi_curve_best([1.0 - fpr[i] for i in range(num_labels)],
-                                 [tpr[i] for i in range(num_labels)],
-                                 [thres] * num_labels, min_sensitivity, swap=True)
-    return _multi_curve_best([1.0 - f for f in fpr], tpr, thres, min_sensitivity, swap=True)
+        return _multi_curve_first_max([1.0 - fpr[i] for i in range(num_labels)],
+                                      [tpr[i] for i in range(num_labels)],
+                                      [thres] * num_labels, min_sensitivity)
+    return _multi_curve_first_max([1.0 - f for f in fpr], tpr, thres, min_sensitivity)
 
 
 def specificity_at_sensitivity(
@@ -471,7 +507,7 @@ def binary_sensitivity_at_specificity(
     )
     state = _binary_precision_recall_curve_update(preds, target, valid, thresholds)
     fpr, tpr, thres = _binary_roc_compute(state, thresholds)
-    return _best_subject_to(tpr, 1.0 - fpr, min_specificity, thres)
+    return _first_max_subject_to(tpr, 1.0 - fpr, min_specificity, thres)
 
 
 def multiclass_sensitivity_at_specificity(
@@ -494,10 +530,10 @@ def multiclass_sensitivity_at_specificity(
     state = _multiclass_precision_recall_curve_update(preds, target, valid, num_classes, thresholds)
     fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
     if isinstance(fpr, jax.Array) and fpr.ndim == 2:
-        return _multi_curve_best([tpr[i] for i in range(num_classes)],
-                                 [1.0 - fpr[i] for i in range(num_classes)],
-                                 [thres] * num_classes, min_specificity, swap=True)
-    return _multi_curve_best(tpr, [1.0 - f for f in fpr], thres, min_specificity, swap=True)
+        return _multi_curve_first_max([tpr[i] for i in range(num_classes)],
+                                      [1.0 - fpr[i] for i in range(num_classes)],
+                                      [thres] * num_classes, min_specificity)
+    return _multi_curve_first_max(tpr, [1.0 - f for f in fpr], thres, min_specificity)
 
 
 def multilabel_sensitivity_at_specificity(
@@ -520,10 +556,10 @@ def multilabel_sensitivity_at_specificity(
     state = _multilabel_precision_recall_curve_update(preds, target, valid, num_labels, thresholds)
     fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
     if isinstance(fpr, jax.Array) and fpr.ndim == 2:
-        return _multi_curve_best([tpr[i] for i in range(num_labels)],
-                                 [1.0 - fpr[i] for i in range(num_labels)],
-                                 [thres] * num_labels, min_specificity, swap=True)
-    return _multi_curve_best(tpr, [1.0 - f for f in fpr], thres, min_specificity, swap=True)
+        return _multi_curve_first_max([tpr[i] for i in range(num_labels)],
+                                      [1.0 - fpr[i] for i in range(num_labels)],
+                                      [thres] * num_labels, min_specificity)
+    return _multi_curve_first_max(tpr, [1.0 - f for f in fpr], thres, min_specificity)
 
 
 def sensitivity_at_specificity(

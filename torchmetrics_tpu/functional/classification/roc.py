@@ -55,15 +55,16 @@ def _binary_roc_compute(
         t_s = target[order].astype(jnp.float32) * w
         tps = jnp.concatenate([jnp.zeros(1), jnp.cumsum(t_s)])
         fps = jnp.concatenate([jnp.zeros(1), jnp.cumsum(w) - jnp.cumsum(t_s)])
-        thres = jnp.concatenate([preds[order][:1] + 1.0, preds[order]])
+        thres = jnp.concatenate([jnp.ones(1, dtype=preds.dtype), preds[order]])
         return safe_divide(fps, fps[-1]), safe_divide(tps, tps[-1]), thres
     keep = jnp.nonzero(valid)[0]
     preds, target = preds[keep], target[keep]
     fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label)
-    # prepend the (0, 0) origin; threshold there is 1 + max score (sklearn convention)
+    # prepend the (0, 0) origin; the reference pins its threshold at 1.0
+    # (roc.py:17-19), unlike sklearn's 1 + max score
     tps = jnp.concatenate([jnp.zeros(1), tps])
     fps = jnp.concatenate([jnp.zeros(1), fps])
-    thres = jnp.concatenate([thres[:1] + 1.0, thres])
+    thres = jnp.concatenate([jnp.ones(1, dtype=thres.dtype), thres])
     tpr = safe_divide(tps, tps[-1])
     fpr = safe_divide(fps, fps[-1])
     return fpr, tpr, thres

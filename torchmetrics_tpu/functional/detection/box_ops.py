@@ -159,7 +159,7 @@ def intersection_over_union(
         >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
         >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
         >>> intersection_over_union(preds, target).round(4)
-        Array(0.6898, dtype=float32)
+        Array(0.68979996, dtype=float32)
     """
     iou = _iou_family_update(preds, target, box_iou, iou_threshold, replacement_val)
     return _iou_family_compute(iou, aggregate)
@@ -203,7 +203,7 @@ def distance_intersection_over_union(
         >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
         >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
         >>> distance_intersection_over_union(preds, target).round(4)
-        Array(0.6883, dtype=float32)
+        Array(0.68829995, dtype=float32)
     """
     iou = _iou_family_update(preds, target, distance_box_iou, iou_threshold, replacement_val)
     return _iou_family_compute(iou, aggregate)
@@ -225,7 +225,7 @@ def complete_intersection_over_union(
         >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79]])
         >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00]])
         >>> complete_intersection_over_union(preds, target).round(4)
-        Array(0.6883, dtype=float32)
+        Array(0.68829995, dtype=float32)
     """
     iou = _iou_family_update(preds, target, complete_box_iou, iou_threshold, replacement_val)
     return _iou_family_compute(iou, aggregate)

@@ -286,7 +286,7 @@ def modified_panoptic_quality(
         >>> target = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
         >>> modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7},
         ...                           allow_unknown_preds_category=True).round(4)
-        Array(0.7667, dtype=float32)
+        Array(0.76669997, dtype=float32)
     """
     things_set, stuffs_set = _parse_categories(things, stuffs)
     _validate_inputs(preds, target)

@@ -12,6 +12,7 @@ from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 Array = jax.Array
 
@@ -27,8 +28,9 @@ def _spatial_average(x: Array) -> Array:
     return x.mean(axis=(2, 3))
 
 
-_SHIFT = jnp.asarray([-0.030, -0.088, -0.188])[None, :, None, None]
-_SCALE = jnp.asarray([0.458, 0.448, 0.450])[None, :, None, None]
+# plain numpy so importing the package does not initialize a jax backend
+_SHIFT = np.asarray([-0.030, -0.088, -0.188], dtype=np.float32)[None, :, None, None]
+_SCALE = np.asarray([0.458, 0.448, 0.450], dtype=np.float32)[None, :, None, None]
 
 
 def _lpips_from_features(

@@ -105,7 +105,7 @@ def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.functional.regression import mean_absolute_percentage_error
         >>> mean_absolute_percentage_error(jnp.array([1., 2, 3]), jnp.array([1., 4, 3])).round(4)
-        Array(0.1667, dtype=float32)
+        Array(0.16669999, dtype=float32)
     """
     s, n = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(s, n)
@@ -135,7 +135,7 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.functional.regression import symmetric_mean_absolute_percentage_error
         >>> symmetric_mean_absolute_percentage_error(jnp.array([1., 2, 3]), jnp.array([1., 4, 3])).round(4)
-        Array(0.2222, dtype=float32)
+        Array(0.22219999, dtype=float32)
     """
     s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(s, n)

@@ -82,8 +82,8 @@ def retrieval_precision(
         >>> from torchmetrics_tpu.functional.retrieval import retrieval_precision
         >>> preds = jnp.array([0.2, 0.3, 0.5])
         >>> target = jnp.array([True, False, True])
-        >>> retrieval_precision(preds, target, top_k=2)
-        Array(0.5, dtype=float32)
+        >>> float(retrieval_precision(preds, target, top_k=2))
+        0.5
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
@@ -159,8 +159,8 @@ def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] 
         >>> from torchmetrics_tpu.functional.retrieval import retrieval_reciprocal_rank
         >>> preds = jnp.array([0.2, 0.3, 0.5])
         >>> target = jnp.array([False, True, False])
-        >>> retrieval_reciprocal_rank(preds, target)
-        Array(0.5, dtype=float32)
+        >>> float(retrieval_reciprocal_rank(preds, target))
+        0.5
     """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     top_k = _top_k_arg(top_k, preds.shape[-1])

@@ -29,23 +29,24 @@ Array = jax.Array
 _DEFAULT_MODEL = "roberta-large"
 
 
-def _simple_whitespace_tokenizer(texts: List[str], max_length: int) -> Dict[str, np.ndarray]:
+def _simple_whitespace_tokenizer(
+    texts: List[str], max_length: int, pad_to_max_length: bool = False
+) -> Dict[str, np.ndarray]:
     """Minimal fallback tokenizer: whitespace tokens hashed to stable ids (crc32), so
-    ids agree across calls and processes."""
+    ids agree across calls and processes. Pads to the batch max (or ``max_length``
+    when ``pad_to_max_length``, for cat-synced module states)."""
     import zlib
 
-    ids_list, mask_list = [], []
+    ids_list = []
     for text in texts:
         tokens = text.split()[: max_length - 2]
-        ids = [1] + [3 + zlib.crc32(tok.encode()) % (2**30) for tok in tokens] + [2]
-        ids_list.append(ids)
-        mask_list.append([1] * len(ids))
-    seq_len = max(len(i) for i in ids_list)
+        ids_list.append([1] + [3 + zlib.crc32(tok.encode()) % (2**30) for tok in tokens] + [2])
+    seq_len = max_length if pad_to_max_length else max(len(i) for i in ids_list)
     input_ids = np.zeros((len(texts), seq_len), dtype=np.int32)
     attention_mask = np.zeros((len(texts), seq_len), dtype=np.int32)
-    for i, (ids, mask) in enumerate(zip(ids_list, mask_list)):
+    for i, ids in enumerate(ids_list):
         input_ids[i, : len(ids)] = ids
-        attention_mask[i, : len(mask)] = mask
+        attention_mask[i, : len(ids)] = 1
     return {"input_ids": input_ids, "attention_mask": attention_mask}
 
 

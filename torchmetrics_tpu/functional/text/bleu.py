@@ -108,7 +108,7 @@ def bleu_score(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> bleu_score(preds, target).round(4)
-        Array(0.7598, dtype=float32)
+        Array(0.75979996, dtype=float32)
     """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]

@@ -45,7 +45,7 @@ def char_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]])
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> char_error_rate(preds=preds, target=target).round(4)
-        Array(0.3415, dtype=float32)
+        Array(0.34149998, dtype=float32)
     """
     errors, total = _cer_update(preds, target)
     return _cer_compute(errors, total)

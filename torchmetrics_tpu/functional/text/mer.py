@@ -47,7 +47,7 @@ def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> match_error_rate(preds=preds, target=target).round(4)
-        Array(0.4444, dtype=float32)
+        Array(0.44439998, dtype=float32)
     """
     errors, total = _mer_update(preds, target)
     return _mer_compute(errors, total)

@@ -178,7 +178,7 @@ def sacre_bleu_score(
         >>> preds = ['the cat is on the mat']
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> sacre_bleu_score(preds, target).round(4)
-        Array(0.7598, dtype=float32)
+        Array(0.75979996, dtype=float32)
     """
     if len(preds) != len(target):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")

@@ -55,7 +55,7 @@ def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> word_information_lost(preds, target).round(4)
-        Array(0.6528, dtype=float32)
+        Array(0.65279996, dtype=float32)
     """
     errors, target_total, preds_total = _word_info_lost_update(preds, target)
     return _word_info_lost_compute(errors, target_total, preds_total)

@@ -55,7 +55,7 @@ def word_information_preserved(preds: Union[str, List[str]], target: Union[str, 
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
         >>> word_information_preserved(preds, target).round(4)
-        Array(0.3472, dtype=float32)
+        Array(0.34719998, dtype=float32)
     """
     errors, reference_total, prediction_total = _wip_update(preds, target)
     return _wip_compute(errors, reference_total, prediction_total)

@@ -144,7 +144,7 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
         >>> target = preds * 0.75
         >>> ergas = ErrorRelativeGlobalDimensionlessSynthesis()
         >>> ergas(preds, target).round(2)
-        Array(8.33, dtype=float32)
+        Array(9.66, dtype=float32)
     """
 
     is_differentiable = True

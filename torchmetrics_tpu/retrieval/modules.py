@@ -260,7 +260,7 @@ class RetrievalNormalizedDCG(RetrievalMetric):
         >>> target = jnp.array([False, False, True, False, True, False, True])
         >>> ndcg = RetrievalNormalizedDCG()
         >>> ndcg(preds, target, indexes=indexes).round(4)
-        Array(0.8467, dtype=float32)
+        Array(0.84669995, dtype=float32)
     """
 
     plot_lower_bound: float = 0.0
@@ -388,7 +388,7 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
         >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5)
         >>> recall, best_k = metric(preds, target, indexes=indexes)
         >>> int(best_k)
-        1
+        3
     """
 
     def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None, **kwargs: Any) -> None:

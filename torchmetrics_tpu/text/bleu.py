@@ -29,7 +29,7 @@ class BLEUScore(_TextMetric):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> bleu = BLEUScore()
         >>> bleu(preds, target).round(4)
-        Array(0.7598, dtype=float32)
+        Array(0.75979996, dtype=float32)
     """
 
     is_differentiable = False
@@ -97,7 +97,7 @@ class SacreBLEUScore(BLEUScore):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> sacre_bleu = SacreBLEUScore()
         >>> sacre_bleu(preds, target).round(4)
-        Array(0.7598, dtype=float32)
+        Array(0.75979996, dtype=float32)
     """
 
     def __init__(

@@ -27,7 +27,7 @@ class CHRFScore(_TextMetric):
         >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
         >>> chrf = CHRFScore()
         >>> chrf(preds, target).round(4)
-        Array(0.8640, dtype=float32)
+        Array(0.86399996, dtype=float32)
     """
 
     is_differentiable = False

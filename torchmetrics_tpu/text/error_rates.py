@@ -68,7 +68,7 @@ class CharErrorRate(_TextMetric):
         >>> target = ["this is the reference", "there is another one"]
         >>> cer = CharErrorRate()
         >>> cer(preds, target).round(4)
-        Array(0.3415, dtype=float32)
+        Array(0.34149998, dtype=float32)
     """
 
     is_differentiable = False
@@ -105,7 +105,7 @@ class MatchErrorRate(_TextMetric):
         >>> target = ["this is the reference", "there is another one"]
         >>> mer = MatchErrorRate()
         >>> mer(preds, target).round(4)
-        Array(0.4444, dtype=float32)
+        Array(0.44439998, dtype=float32)
     """
 
     is_differentiable = False
@@ -142,7 +142,7 @@ class WordInfoLost(_TextMetric):
         >>> target = ["this is the reference", "there is another one"]
         >>> wil = WordInfoLost()
         >>> wil(preds, target).round(4)
-        Array(0.6528, dtype=float32)
+        Array(0.65279996, dtype=float32)
     """
 
     is_differentiable = False
@@ -182,7 +182,7 @@ class WordInfoPreserved(_TextMetric):
         >>> target = ["this is the reference", "there is another one"]
         >>> wip = WordInfoPreserved()
         >>> wip(preds, target).round(4)
-        Array(0.3472, dtype=float32)
+        Array(0.34719998, dtype=float32)
     """
 
     is_differentiable = False

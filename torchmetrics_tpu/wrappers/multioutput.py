@@ -45,7 +45,7 @@ class MultioutputWrapper(WrapperMetric):
         >>> preds = jnp.array([[0.0, 2.0], [-1.0, 2.0], [8.0, -5.0]])
         >>> r2score = MultioutputWrapper(R2Score(), 2)
         >>> r2score(preds, target).round(4)
-        Array([0.9654, 0.9082], dtype=float32)
+        Array([0.9654    , 0.90819997], dtype=float32)
     """
 
     is_differentiable = False
