@@ -139,19 +139,41 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (reference ``aggregation.py:301-356``)."""
+    """Concatenate all seen values (reference ``aggregation.py:301-356``).
 
-    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("cat", [], nan_strategy, **kwargs)
+    With ``capacity`` set, the state is a static-shape :class:`MaskedBuffer` instead of
+    a ragged list — updates jit and the state syncs inside ``shard_map`` (SURVEY §7).
+    NaN filtering is unsupported in buffered mode (it would need dynamic shapes); NaNs
+    follow ``nan_strategy`` value replacement instead.
+    """
+
+    def __init__(
+        self, nan_strategy: Union[str, float] = "warn", capacity: Optional[int] = None, **kwargs: Any
+    ) -> None:
+        if capacity is not None:
+            from torchmetrics_tpu.core.buffer import MaskedBuffer
+
+            super().__init__("cat", MaskedBuffer.create(capacity), nan_strategy, **kwargs)
+        else:
+            super().__init__("cat", [], nan_strategy, **kwargs)
+        self.capacity = capacity
 
     def update(self, value: Any) -> None:
         value, weight = self._cast_and_nan_check_input(value)
+        if self.capacity is not None:
+            value = jnp.where(weight > 0, value, jnp.nan_to_num(value))
+            self.value = self.value.append(jnp.ravel(value))
+            return
         if self.nan_strategy in ("ignore", "warn") and not isinstance(value, jax.core.Tracer):
             value = value[weight > 0]  # list state updates run eagerly: dynamic filter OK
         if value.size:
             self.value.append(value)
 
     def compute(self) -> Any:
+        if self.capacity is not None:
+            if isinstance(self.value.count, jax.core.Tracer):
+                return self.value.data  # inside jit: fixed-shape padded view
+            return self.value.values()
         if isinstance(self.value, list) and self.value:
             return dim_zero_cat(self.value)
         return self.value

@@ -93,6 +93,7 @@ class BinaryPrecisionRecallCurve(Metric):
         thresholds: Union[int, Sequence[float], Array, None] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -100,13 +101,23 @@ class BinaryPrecisionRecallCurve(Metric):
             _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         self.ignore_index = ignore_index
         self.validate_args = validate_args
+        self.buffer_capacity = buffer_capacity
 
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat")
-            self.add_state("target", [], dist_reduce_fx="cat")
-            self.add_state("valid", [], dist_reduce_fx="cat")
+            if buffer_capacity is not None:
+                # SURVEY §7 masked buffer: static-shape unbinned state, so the raw
+                # score path works under jit and shard_map sync like the binned path
+                from torchmetrics_tpu.core.buffer import MaskedBuffer
+
+                self.add_state("preds", MaskedBuffer.create(buffer_capacity), dist_reduce_fx="cat")
+                self.add_state("target", MaskedBuffer.create(buffer_capacity, dtype=jnp.int32), dist_reduce_fx="cat")
+                self.add_state("valid", MaskedBuffer.create(buffer_capacity, dtype=jnp.bool_), dist_reduce_fx="cat")
+            else:
+                self.add_state("preds", [], dist_reduce_fx="cat")
+                self.add_state("target", [], dist_reduce_fx="cat")
+                self.add_state("valid", [], dist_reduce_fx="cat")
         else:
             self.register_threshold_buffer(thresholds)
             self.add_state(
@@ -117,7 +128,7 @@ class BinaryPrecisionRecallCurve(Metric):
         self.thresholds = thresholds
 
     def _compute_group_params(self):
-        return (_thresholds_key(self.thresholds), self.ignore_index)
+        return (_thresholds_key(self.thresholds), self.ignore_index, getattr(self, "buffer_capacity", None))
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores (unbinned) or the threshold-binned confusion counts."""
@@ -127,10 +138,15 @@ class BinaryPrecisionRecallCurve(Metric):
             preds, target, None, self.ignore_index
         )
         if self.thresholds is None:
-            preds, target, valid = _filter_or_mask(preds, target, valid)
-            self.preds.append(preds)
-            self.target.append(target)
-            self.valid.append(valid)
+            if self.buffer_capacity is not None:
+                self.preds = self.preds.append(preds)
+                self.target = self.target.append(target)
+                self.valid = self.valid.append(valid)
+            else:
+                preds, target, valid = _filter_or_mask(preds, target, valid)
+                self.preds.append(preds)
+                self.target.append(target)
+                self.valid.append(valid)
         else:
             self.confmat = self.confmat + _binary_precision_recall_curve_update(
                 preds, target, valid, self.thresholds
@@ -138,6 +154,14 @@ class BinaryPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
+            if self.buffer_capacity is not None:
+                # padding slots are simply invalid entries — the unbinned compute
+                # path masks them out exactly like ignore_index samples
+                return (
+                    self.preds.data,
+                    self.target.data,
+                    self.valid.data & self.preds.mask,
+                )
             return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
         return self.confmat
 
@@ -193,6 +217,7 @@ class MulticlassPrecisionRecallCurve(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
 
+        self.buffer_capacity = None  # masked-buffer mode is binary-only for now
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
@@ -233,6 +258,14 @@ class MulticlassPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
+            if self.buffer_capacity is not None:
+                # padding slots are simply invalid entries — the unbinned compute
+                # path masks them out exactly like ignore_index samples
+                return (
+                    self.preds.data,
+                    self.target.data,
+                    self.valid.data & self.preds.mask,
+                )
             return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
         return self.confmat
 
@@ -289,6 +322,7 @@ class MultilabelPrecisionRecallCurve(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
 
+        self.buffer_capacity = None  # masked-buffer mode is binary-only for now
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
@@ -324,6 +358,14 @@ class MultilabelPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
+            if self.buffer_capacity is not None:
+                # padding slots are simply invalid entries — the unbinned compute
+                # path masks them out exactly like ignore_index samples
+                return (
+                    self.preds.data,
+                    self.target.data,
+                    self.valid.data & self.preds.mask,
+                )
             return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
         return self.confmat
 

@@ -1,0 +1,91 @@
+"""Fixed-capacity masked append buffer — the jit/shard_map-safe "cat" state.
+
+The reference grows python lists for "cat" states and pads/trims at gather time
+(``metric.py:440-450``, ``utilities/distributed.py:135-147``) — shapes a TPU program
+cannot express. ``MaskedBuffer`` is the SURVEY §7 design instead: a static
+``(capacity, *item)`` array plus a validity count. Appends are
+``lax.dynamic_update_slice`` writes, the mask is ``arange < count``, and cross-shard
+sync is one ``all_gather`` followed by a stable validity sort that compacts every
+shard's valid prefix — all static shapes, all inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+@jax.tree_util.register_pytree_node_class
+class MaskedBuffer:
+    """Append-only value buffer with static capacity and a validity count.
+
+    Appending beyond capacity raises eagerly; under jit the write clamps at the end
+    (callers size the capacity for the epoch, like the reference's binned-thresholds
+    memory contract).
+    """
+
+    def __init__(self, data: Array, count: Array) -> None:
+        self.data = data
+        self.count = count
+
+    @classmethod
+    def create(cls, capacity: int, item_shape: Tuple[int, ...] = (), dtype=jnp.float32) -> "MaskedBuffer":
+        """An empty buffer of ``capacity`` items of ``item_shape``."""
+        return cls(jnp.zeros((capacity, *item_shape), dtype=dtype), jnp.zeros((), dtype=jnp.int32))
+
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def append(self, batch: Array) -> "MaskedBuffer":
+        """Append a (n, *item) batch (n static); returns a new buffer."""
+        batch = jnp.asarray(batch, dtype=self.data.dtype)
+        if batch.ndim == self.data.ndim - 1:
+            batch = batch[None]
+        n = batch.shape[0]
+        if not isinstance(self.count, jax.core.Tracer) and int(self.count) + n > self.capacity:
+            raise ValueError(
+                f"MaskedBuffer overflow: capacity {self.capacity}, have {int(self.count)}, appending {n}."
+                " Construct the metric with a larger buffer capacity."
+            )
+        start = (self.count,) + (0,) * (self.data.ndim - 1)
+        data = lax.dynamic_update_slice(self.data, batch, start)
+        return MaskedBuffer(data, self.count + n)
+
+    @property
+    def mask(self) -> Array:
+        """Validity mask over the capacity axis."""
+        return jnp.arange(self.capacity) < self.count
+
+    def values(self) -> Array:
+        """The valid prefix (eager only — dynamic shape)."""
+        if isinstance(self.count, jax.core.Tracer):
+            raise ValueError("MaskedBuffer.values() needs concrete counts; use .data/.mask under jit.")
+        return self.data[: int(self.count)]
+
+    def concat_gathered(self, gathered_data: Array, gathered_counts: Array) -> "MaskedBuffer":
+        """Compact per-shard buffers ``[S, cap, *item]`` into one ``[S*cap, *item]`` buffer.
+
+        A stable sort on invalidity moves every shard's valid prefix to the front —
+        static shapes, jit-safe, and order-preserving across shards.
+        """
+        num_shards, cap = gathered_data.shape[:2]
+        flat = gathered_data.reshape((num_shards * cap,) + gathered_data.shape[2:])
+        item_valid = (jnp.arange(cap)[None, :] < gathered_counts[:, None]).reshape(-1)
+        order = jnp.argsort(~item_valid, stable=True)
+        return MaskedBuffer(flat[order], gathered_counts.sum().astype(jnp.int32))
+
+    def tree_flatten(self):
+        return (self.data, self.count), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"MaskedBuffer(capacity={self.capacity}, count={self.count}, item={self.data.shape[1:]})"

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.core.buffer import MaskedBuffer
 from torchmetrics_tpu.core.jit import jit_with_static_leaves
 from torchmetrics_tpu.parallel.reductions import Reduction, merge_states
 from torchmetrics_tpu.parallel.sync import distributed_available as _default_distributed_available
@@ -151,28 +152,42 @@ class Metric(ABC):
         if not name.isidentifier():
             raise ValueError(f"Argument `name` must be a valid python identifier, got {name!r}")
         is_list = isinstance(default, list)
+        is_buffer = isinstance(default, MaskedBuffer)
         if is_list and len(default) != 0:
             raise ValueError("state defaults that are lists must be empty lists")
-        if not is_list:
+        if not is_list and not is_buffer:
             try:
                 default = jnp.asarray(default)
             except Exception as err:
                 raise ValueError(
-                    "Invalid input to `add_state`. Expected array-like or empty list"
+                    "Invalid input to `add_state`. Expected array-like, MaskedBuffer or empty list"
                 ) from err
         reduction = Reduction.from_arg(dist_reduce_fx)
         if callable(dist_reduce_fx):
             self._custom_fx[name] = dist_reduce_fx
         # keep defaults on host so reset never aliases device buffers
-        self._defaults[name] = [] if is_list else np.asarray(default)
+        if is_list:
+            self._defaults[name] = []
+        elif is_buffer:
+            self._defaults[name] = ("__masked_buffer__", default.capacity, default.data.shape[1:], default.data.dtype)
+        else:
+            self._defaults[name] = np.asarray(default)
         self._reductions[name] = reduction
         self._persistent[name] = persistent
-        self._state_values[name] = [] if is_list else jnp.asarray(default)
+        self._state_values[name] = (
+            [] if is_list else default if is_buffer else jnp.asarray(default)
+        )
+
+    @staticmethod
+    def _default_to_value(v: Any) -> Any:
+        if isinstance(v, list):
+            return []
+        if isinstance(v, tuple) and v and v[0] == "__masked_buffer__":
+            return MaskedBuffer.create(v[1], v[2], v[3])
+        return jnp.asarray(v)
 
     def _fresh_state(self) -> Dict[str, Any]:
-        return {
-            k: ([] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._defaults.items()
-        }
+        return {k: self._default_to_value(v) for k, v in self._defaults.items()}
 
     # attribute routing: registered states live in ``_state_values``
     def __getattr__(self, name: str) -> Any:
@@ -231,7 +246,10 @@ class Metric(ABC):
             sorted(
                 (
                     name,
-                    "list" if isinstance(d, list) else (tuple(np.shape(d)), str(np.asarray(d).dtype)),
+                    "list"
+                    if isinstance(d, list)
+                    else (d if isinstance(d, tuple) and d and d[0] == "__masked_buffer__"
+                          else (tuple(np.shape(d)), str(np.asarray(d).dtype))),
                     str(self._reductions[name]),
                 )
                 for name, d in self._defaults.items()
@@ -570,6 +588,10 @@ class Metric(ABC):
                 continue
             if isinstance(value, list):
                 destination[prefix + key] = [np.asarray(v) for v in value]
+            elif isinstance(value, MaskedBuffer):
+                destination[prefix + key] = {
+                    "data": np.asarray(value.data), "count": np.asarray(value.count)
+                }
             else:
                 destination[prefix + key] = np.asarray(value)
         return destination
@@ -582,6 +604,10 @@ class Metric(ABC):
                 value = state_dict[full]
                 if isinstance(value, list):
                     self._state_values[key] = [jnp.asarray(v) for v in value]
+                elif isinstance(value, dict) and set(value) == {"data", "count"}:
+                    self._state_values[key] = MaskedBuffer(
+                        jnp.asarray(value["data"]), jnp.asarray(value["count"])
+                    )
                 else:
                     self._state_values[key] = jnp.asarray(value)
                 if self._update_count == 0:

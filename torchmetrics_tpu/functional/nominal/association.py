@@ -12,6 +12,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _multiclass_confusion_matrix_update,
@@ -277,3 +278,103 @@ def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
         raise ValueError("Argument ``mode`` must be one of 'counts' or 'probs'.")
     counts = _fleiss_kappa_update(ratings, mode)
     return _fleiss_kappa_compute(counts)
+
+
+def _pairwise_matrix(matrix, compute_one, symmetric: bool = True) -> Array:
+    """Matrix of a nominal statistic over all column pairs of ``matrix``."""
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i in range(num_variables):
+        for j in range((i + 1) if symmetric else 0, num_variables):
+            if i == j:
+                continue
+            value = float(compute_one(matrix[:, i], matrix[:, j]))
+            if symmetric:
+                out[i, j] = out[j, i] = value
+            else:
+                out[i, j] = value
+    return jnp.asarray(out)
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Cramer's V statistic between all pairs of columns in a data matrix.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import cramers_v_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> cramers_v_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: cramers_v(x, y, bias_correction, nan_strategy, nan_replace_value)
+    )
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Pearson's contingency coefficient between all pairs of columns.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import (
+        ...     pearsons_contingency_coefficient_matrix)
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> pearsons_contingency_coefficient_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: pearsons_contingency_coefficient(x, y, nan_strategy, nan_replace_value)
+    )
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Tschuprow's T statistic between all pairs of columns.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import tschuprows_t_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> tschuprows_t_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: tschuprows_t(x, y, bias_correction, nan_strategy, nan_replace_value)
+    )
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    r"""Compute Theil's U statistic between all pairs of columns (asymmetric).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import theils_u_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> theils_u_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: theils_u(x, y, nan_strategy, nan_replace_value), symmetric=False
+    )

@@ -70,6 +70,12 @@ def merge_states(old: Any, new: Any, reduction: Reduction, old_count, new_count,
     if reduction == Reduction.MIN:
         return jnp.minimum(old, new)
     if reduction == Reduction.CAT:
+        from torchmetrics_tpu.core.buffer import MaskedBuffer
+
+        if isinstance(old, MaskedBuffer) and isinstance(new, MaskedBuffer):
+            # forward fast path runs eagerly, so the batch buffer's valid prefix
+            # can be appended directly
+            return old.append(new.values())
         if not isinstance(old, list) and not isinstance(new, list):
             return jnp.concatenate([jnp.atleast_1d(old), jnp.atleast_1d(new)])
         old_list = old if isinstance(old, list) else [old]

@@ -119,9 +119,26 @@ def sync_state(
             given. When ``None``, multi-host eager sync is used if available, else
             identity.
     """
+    from torchmetrics_tpu.core.buffer import MaskedBuffer
+
     out: Dict[str, Any] = {}
     for name, value in state.items():
         red = Reduction(reductions.get(name, Reduction.NONE))
+        if isinstance(value, MaskedBuffer):
+            # static-shape "cat": gather data + counts, compact valid prefixes
+            if axis_name is not None:
+                gathered_data = lax.all_gather(value.data, axis_name, axis=0)
+                gathered_counts = lax.all_gather(value.count, axis_name, axis=0)
+                out[name] = value.concat_gathered(gathered_data, gathered_counts)
+            elif distributed_available():
+                from jax.experimental import multihost_utils
+
+                gathered_data = multihost_utils.process_allgather(value.data, tiled=False)
+                gathered_counts = multihost_utils.process_allgather(value.count, tiled=False)
+                out[name] = value.concat_gathered(jnp.asarray(gathered_data), jnp.asarray(gathered_counts))
+            else:
+                out[name] = value
+            continue
         if isinstance(value, list):
             if not value:
                 out[name] = value
