@@ -50,12 +50,9 @@ class TestMaskedBuffer:
 
     def test_concat_gathered_compacts(self):
         # three shards with counts 2, 0, 1 — valid items keep shard order
-        data = jnp.asarray(
-            [[1.0, 2.0, 0.0], [0.0, 0.0, 0.0], [5.0, 0.0, 0.0]]
-        )[..., None] * jnp.ones(1)
-        data = data.reshape(3, 3)
+        data = jnp.asarray([[1.0, 2.0, 0.0], [0.0, 0.0, 0.0], [5.0, 0.0, 0.0]])
         counts = jnp.asarray([2, 0, 1])
-        merged = MaskedBuffer.create(9).concat_gathered(data[..., None].squeeze(-1), counts)
+        merged = MaskedBuffer.create(9).concat_gathered(data, counts)
         _assert_allclose(merged.values(), [1.0, 2.0, 5.0], atol=0)
         assert int(merged.count) == 3
 
@@ -100,6 +97,61 @@ class TestBufferedCatMetric:
         metric.update(jnp.array([1.0]))
         metric.reset()
         assert int(metric.value.count) == 0
+
+    def test_eager_nan_dropping_matches_list_mode(self):
+        for strategy in ("warn", "ignore"):
+            buffered = CatMetric(capacity=8, nan_strategy=strategy)
+            listed = CatMetric(nan_strategy=strategy)
+            import contextlib
+
+            with pytest.warns() if strategy == "warn" else contextlib.nullcontext():
+                buffered.update(jnp.array([1.0, jnp.nan, 2.0]))
+                listed.update(jnp.array([1.0, jnp.nan, 2.0]))
+            _assert_allclose(buffered.compute(), [1.0, 2.0], atol=0)
+            _assert_allclose(buffered.compute(), listed.compute(), atol=0)
+
+    def test_buffer_capacity_with_thresholds_raises(self):
+        with pytest.raises(ValueError, match="unbinned"):
+            BinaryPrecisionRecallCurve(thresholds=5, buffer_capacity=8)
+        from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+
+        with pytest.raises(ValueError, match="unbinned"):
+            MulticlassPrecisionRecallCurve(num_classes=3, thresholds=5, buffer_capacity=8)
+
+    def test_clone_and_pickle_roundtrip(self):
+        import pickle
+
+        metric = CatMetric(capacity=8)
+        metric.update(jnp.array([1.0, 2.0]))
+        for copy in (metric.clone(), pickle.loads(pickle.dumps(metric))):
+            _assert_allclose(copy.compute(), [1.0, 2.0], atol=0)
+            copy.update(jnp.array([3.0]))
+            _assert_allclose(copy.compute(), [1.0, 2.0, 3.0], atol=0)
+        _assert_allclose(metric.compute(), [1.0, 2.0], atol=0)  # original untouched
+
+    def test_set_dtype_casts_buffer(self):
+        metric = CatMetric(capacity=8).set_dtype(jnp.float16)
+        assert metric.value.data.dtype == jnp.float16
+        metric.update(jnp.array([1.5]))
+        assert metric.compute().dtype == jnp.float16
+
+    def test_overflow_through_jitted_update_raises(self):
+        """The jitted dispatch clamps the write, but the stateful shell must still
+        surface the overflow — at the next update (previous-step counts, so dispatch
+        stays async) or at compute, whichever comes first."""
+        metric = BinaryAUROC(buffer_capacity=4)
+        p = jnp.asarray(rng.rand(3).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 3))
+        metric.update(p, t)
+        metric.update(p, t)  # overflows (6 > 4): detected one step late
+        with pytest.raises(ValueError, match="overflow"):
+            metric.update(p, t)
+
+        metric2 = BinaryAUROC(buffer_capacity=4)
+        metric2.update(p, t)
+        metric2.update(p, t)
+        with pytest.raises(ValueError, match="overflow"):
+            metric2.compute()
 
 
 class TestBufferedUnbinnedCurves:
@@ -165,6 +217,55 @@ class TestBufferedUnbinnedCurves:
         listed.update(jnp.asarray(p), jnp.asarray(t))
         for b, l in zip(buffered.compute(), listed.compute()):
             _assert_allclose(b, l, atol=1e-6)
+
+    def test_multiclass_buffered_matches_list_mode(self):
+        from sklearn.metrics import roc_auc_score as _  # noqa: F401
+        from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+
+        p = jax.nn.softmax(jnp.asarray(rng.randn(24, 4).astype(np.float32)), axis=-1)
+        t = jnp.asarray(rng.randint(0, 4, 24))
+        for avg in (None, "micro"):
+            cap = 24 * 4 if avg == "micro" else 64
+            buffered = MulticlassPrecisionRecallCurve(num_classes=4, average=avg, buffer_capacity=cap)
+            listed = MulticlassPrecisionRecallCurve(num_classes=4, average=avg)
+            buffered.update(p, t)
+            listed.update(p, t)
+            for b, l in zip(jax.tree_util.tree_leaves(buffered.compute()), jax.tree_util.tree_leaves(listed.compute())):
+                _assert_allclose(b, l, atol=1e-6)
+
+    def test_multilabel_buffered_matches_list_mode(self):
+        from torchmetrics_tpu.classification import MultilabelPrecisionRecallCurve
+
+        p = jnp.asarray(rng.rand(16, 3).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, (16, 3)))
+        buffered = MultilabelPrecisionRecallCurve(num_labels=3, buffer_capacity=32)
+        listed = MultilabelPrecisionRecallCurve(num_labels=3)
+        buffered.update(p, t)
+        listed.update(p, t)
+        for b, l in zip(jax.tree_util.tree_leaves(buffered.compute()), jax.tree_util.tree_leaves(listed.compute())):
+            _assert_allclose(b, l, atol=1e-6)
+
+    def test_multiclass_auroc_buffered_mesh_matches_sklearn(self):
+        from torchmetrics_tpu.classification import MulticlassAUROC
+
+        n_dev = len(jax.devices())
+        p = jax.nn.softmax(jnp.asarray(rng.randn(n_dev * 8, 3).astype(np.float32)), axis=-1)
+        t = np.asarray(rng.randint(0, 3, n_dev * 8))
+
+        metric = MulticlassAUROC(num_classes=3, buffer_capacity=16)  # per-shard capacity
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+
+        def shard_step(state, pp, tt):
+            state = metric.pure_update(state, pp, tt)
+            synced = metric.sync_state(state, axis_name="data")
+            return metric.pure_compute(synced)
+
+        f = shard_map(
+            shard_step, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P(), check_vma=False
+        )
+        val = jax.jit(f)(metric.init_state(), p, jnp.asarray(t))
+        expected = roc_auc_score(t, np.asarray(p), multi_class="ovr", average="macro")
+        _assert_allclose(val, expected, atol=1e-5)
 
     def test_buffered_update_jits(self):
         metric = BinaryAUROC(buffer_capacity=32)

@@ -44,6 +44,7 @@ from torchmetrics_tpu.detection import __all__ as _detection_all  # noqa: E402
 from torchmetrics_tpu.multimodal import *  # noqa: E402,F401,F403
 from torchmetrics_tpu.multimodal import __all__ as _multimodal_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
+from torchmetrics_tpu.core.buffer import MaskedBuffer  # noqa: E402
 from torchmetrics_tpu.core.metric import CompositionalMetric, Metric  # noqa: E402
 from torchmetrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
@@ -58,6 +59,7 @@ from torchmetrics_tpu.wrappers.running import RunningMean, RunningSum  # noqa: E
 
 __all__ = [
     "functional",
+    "MaskedBuffer",
     "Metric",
     "MetricCollection",
     "CompositionalMetric",

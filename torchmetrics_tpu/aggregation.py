@@ -143,8 +143,9 @@ class CatMetric(BaseAggregator):
 
     With ``capacity`` set, the state is a static-shape :class:`MaskedBuffer` instead of
     a ragged list — updates jit and the state syncs inside ``shard_map`` (SURVEY §7).
-    NaN filtering is unsupported in buffered mode (it would need dynamic shapes); NaNs
-    follow ``nan_strategy`` value replacement instead.
+    Eager updates drop NaNs exactly like list mode; inside a user's own jit/scan
+    dropping would need dynamic shapes, so NaNs follow ``nan_strategy`` value
+    replacement there instead.
     """
 
     def __init__(
@@ -154,6 +155,10 @@ class CatMetric(BaseAggregator):
             from torchmetrics_tpu.core.buffer import MaskedBuffer
 
             super().__init__("cat", MaskedBuffer.create(capacity), nan_strategy, **kwargs)
+            if nan_strategy == "ignore" and kwargs.get("jit_update") is None:
+                # keep the public path eager so NaNs are dropped exactly like list
+                # mode; pure_update/scan users get the documented imputation
+                self._jit_update_flag = False
         else:
             super().__init__("cat", [], nan_strategy, **kwargs)
         self.capacity = capacity
@@ -161,7 +166,9 @@ class CatMetric(BaseAggregator):
     def update(self, value: Any) -> None:
         value, weight = self._cast_and_nan_check_input(value)
         if self.capacity is not None:
-            value = jnp.where(weight > 0, value, jnp.nan_to_num(value))
+            if self.nan_strategy in ("ignore", "warn") and not isinstance(value, jax.core.Tracer):
+                value = value[weight > 0]  # eager: drop NaNs exactly like list mode
+            # under jit dropping needs dynamic shapes — NaNs stay imputed instead
             self.value = self.value.append(jnp.ravel(value))
             return
         if self.nan_strategy in ("ignore", "warn") and not isinstance(value, jax.core.Tracer):

@@ -49,6 +49,64 @@ def _thresholds_key(thresholds) -> Optional[tuple]:
     return None if thresholds is None else tuple(np.asarray(thresholds).tolist())
 
 
+def _validate_buffer_capacity(buffer_capacity, thresholds) -> None:
+    if buffer_capacity is not None and thresholds is not None:
+        raise ValueError(
+            "`buffer_capacity` only applies to unbinned mode — it cannot be combined"
+            " with `thresholds` (binned mode already has static-shape state)."
+        )
+
+
+def _add_unbinned_states(
+    metric: Metric,
+    buffer_capacity: Optional[int],
+    pred_item: Tuple[int, ...] = (),
+    label_item: Tuple[int, ...] = (),
+) -> None:
+    """Register the unbinned preds/target/valid states — MaskedBuffers when a
+    capacity is given (static shapes: jit-able updates, shard_map-able sync), ragged
+    host lists otherwise."""
+    if buffer_capacity is not None:
+        from torchmetrics_tpu.core.buffer import MaskedBuffer
+
+        metric.add_state("preds", MaskedBuffer.create(buffer_capacity, pred_item), dist_reduce_fx="cat")
+        metric.add_state(
+            "target", MaskedBuffer.create(buffer_capacity, label_item, dtype=jnp.int32), dist_reduce_fx="cat"
+        )
+        metric.add_state(
+            "valid", MaskedBuffer.create(buffer_capacity, label_item, dtype=jnp.bool_), dist_reduce_fx="cat"
+        )
+    else:
+        metric.add_state("preds", [], dist_reduce_fx="cat")
+        metric.add_state("target", [], dist_reduce_fx="cat")
+        metric.add_state("valid", [], dist_reduce_fx="cat")
+
+
+def _append_unbinned(metric: Metric, preds: Array, target: Array, valid: Array) -> None:
+    """Accumulate one formatted batch into the unbinned states (either mode)."""
+    if metric.buffer_capacity is not None:
+        metric.preds = metric.preds.append(preds)
+        metric.target = metric.target.append(target)
+        metric.valid = metric.valid.append(valid)
+    else:
+        preds, target, valid = _filter_or_mask(preds, target, valid)
+        metric.preds.append(preds)
+        metric.target.append(target)
+        metric.valid.append(valid)
+
+
+def _unbinned_curve_state(metric: Metric) -> Tuple[Array, Array, Array]:
+    """(preds, target, valid) for the unbinned compute path. In buffered mode the
+    padding slots are simply invalid entries — the curve computes mask them out
+    exactly like ignore_index samples (the mask broadcasts over any label rank)."""
+    if metric.buffer_capacity is not None:
+        mask = metric.preds.mask
+        valid = metric.valid.data
+        mask = mask.reshape(mask.shape + (1,) * (valid.ndim - 1))
+        return (metric.preds.data, metric.target.data, valid & mask)
+    return (dim_zero_cat(metric.preds), dim_zero_cat(metric.target), dim_zero_cat(metric.valid))
+
+
 def _filter_or_mask(preds: Array, target: Array, valid: Array) -> Tuple[Array, Array, Array]:
     """Eagerly drop masked elements before appending to unbinned list states.
 
@@ -56,7 +114,8 @@ def _filter_or_mask(preds: Array, target: Array, valid: Array) -> Tuple[Array, A
     kept as a list state instead, and the curve computes treat masked samples as
     zero-weight segments.
     """
-    if isinstance(valid, jax.core.Tracer) or bool(jnp.all(valid)):
+    if valid.ndim > 1 or isinstance(valid, jax.core.Tracer) or bool(jnp.all(valid)):
+        # multi-dim validity (multilabel [N, L]) cannot drop whole rows — keep the mask
         return preds, target, valid
     keep = jnp.nonzero(valid)[0]
     return preds[keep], target[keep], valid[keep]
@@ -102,22 +161,12 @@ class BinaryPrecisionRecallCurve(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         self.buffer_capacity = buffer_capacity
+        _validate_buffer_capacity(buffer_capacity, thresholds)
 
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            if buffer_capacity is not None:
-                # SURVEY §7 masked buffer: static-shape unbinned state, so the raw
-                # score path works under jit and shard_map sync like the binned path
-                from torchmetrics_tpu.core.buffer import MaskedBuffer
-
-                self.add_state("preds", MaskedBuffer.create(buffer_capacity), dist_reduce_fx="cat")
-                self.add_state("target", MaskedBuffer.create(buffer_capacity, dtype=jnp.int32), dist_reduce_fx="cat")
-                self.add_state("valid", MaskedBuffer.create(buffer_capacity, dtype=jnp.bool_), dist_reduce_fx="cat")
-            else:
-                self.add_state("preds", [], dist_reduce_fx="cat")
-                self.add_state("target", [], dist_reduce_fx="cat")
-                self.add_state("valid", [], dist_reduce_fx="cat")
+            _add_unbinned_states(self, buffer_capacity)
         else:
             self.register_threshold_buffer(thresholds)
             self.add_state(
@@ -128,7 +177,7 @@ class BinaryPrecisionRecallCurve(Metric):
         self.thresholds = thresholds
 
     def _compute_group_params(self):
-        return (_thresholds_key(self.thresholds), self.ignore_index, getattr(self, "buffer_capacity", None))
+        return (_thresholds_key(self.thresholds), self.ignore_index, self.buffer_capacity)
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores (unbinned) or the threshold-binned confusion counts."""
@@ -138,15 +187,7 @@ class BinaryPrecisionRecallCurve(Metric):
             preds, target, None, self.ignore_index
         )
         if self.thresholds is None:
-            if self.buffer_capacity is not None:
-                self.preds = self.preds.append(preds)
-                self.target = self.target.append(target)
-                self.valid = self.valid.append(valid)
-            else:
-                preds, target, valid = _filter_or_mask(preds, target, valid)
-                self.preds.append(preds)
-                self.target.append(target)
-                self.valid.append(valid)
+            _append_unbinned(self, preds, target, valid)
         else:
             self.confmat = self.confmat + _binary_precision_recall_curve_update(
                 preds, target, valid, self.thresholds
@@ -154,15 +195,7 @@ class BinaryPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
-            if self.buffer_capacity is not None:
-                # padding slots are simply invalid entries — the unbinned compute
-                # path masks them out exactly like ignore_index samples
-                return (
-                    self.preds.data,
-                    self.target.data,
-                    self.valid.data & self.preds.mask,
-                )
-            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
+            return _unbinned_curve_state(self)
         return self.confmat
 
     def compute(self) -> Tuple[Array, Array, Array]:
@@ -207,6 +240,7 @@ class MulticlassPrecisionRecallCurve(Metric):
         average: Optional[str] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -216,14 +250,15 @@ class MulticlassPrecisionRecallCurve(Metric):
         self.average = average
         self.ignore_index = ignore_index
         self.validate_args = validate_args
+        self.buffer_capacity = buffer_capacity
+        _validate_buffer_capacity(buffer_capacity, thresholds)
 
-        self.buffer_capacity = None  # masked-buffer mode is binary-only for now
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat")
-            self.add_state("target", [], dist_reduce_fx="cat")
-            self.add_state("valid", [], dist_reduce_fx="cat")
+            # with ``average="micro"`` the problem flattens to binary, so the
+            # capacity counts flattened (sample, class) pairs
+            _add_unbinned_states(self, buffer_capacity, () if average == "micro" else (num_classes,))
         else:
             self.thresholds = thresholds
             shape = (len(thresholds), 2, 2) if average == "micro" else (len(thresholds), num_classes, 2, 2)
@@ -231,7 +266,13 @@ class MulticlassPrecisionRecallCurve(Metric):
 
     def _compute_group_params(self):
         # micro-average changes the accumulated state itself (flattened binary confmat)
-        return (self.num_classes, _thresholds_key(self.thresholds), self.ignore_index, self.average == "micro")
+        return (
+            self.num_classes,
+            _thresholds_key(self.thresholds),
+            self.ignore_index,
+            self.average == "micro",
+            self.buffer_capacity,
+        )
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores or binned confusion counts."""
@@ -243,10 +284,7 @@ class MulticlassPrecisionRecallCurve(Metric):
             preds, target, self.num_classes, None, self.ignore_index, self.average
         )
         if self.thresholds is None:
-            preds, target, valid = _filter_or_mask(preds, target, valid)
-            self.preds.append(preds)
-            self.target.append(target)
-            self.valid.append(valid)
+            _append_unbinned(self, preds, target, valid)
         elif self.average == "micro":
             self.confmat = self.confmat + _binary_precision_recall_curve_update(
                 preds, target, valid, self.thresholds
@@ -258,15 +296,7 @@ class MulticlassPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
-            if self.buffer_capacity is not None:
-                # padding slots are simply invalid entries — the unbinned compute
-                # path masks them out exactly like ignore_index samples
-                return (
-                    self.preds.data,
-                    self.target.data,
-                    self.valid.data & self.preds.mask,
-                )
-            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
+            return _unbinned_curve_state(self)
         return self.confmat
 
     def compute(self):
@@ -313,6 +343,7 @@ class MultilabelPrecisionRecallCurve(Metric):
         thresholds: Union[int, Sequence[float], Array, None] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -321,14 +352,13 @@ class MultilabelPrecisionRecallCurve(Metric):
         self.num_labels = num_labels
         self.ignore_index = ignore_index
         self.validate_args = validate_args
+        self.buffer_capacity = buffer_capacity
+        _validate_buffer_capacity(buffer_capacity, thresholds)
 
-        self.buffer_capacity = None  # masked-buffer mode is binary-only for now
         thresholds = _adjust_threshold_arg(thresholds)
         if thresholds is None:
             self.thresholds = None
-            self.add_state("preds", [], dist_reduce_fx="cat")
-            self.add_state("target", [], dist_reduce_fx="cat")
-            self.add_state("valid", [], dist_reduce_fx="cat")
+            _add_unbinned_states(self, buffer_capacity, (num_labels,), (num_labels,))
         else:
             self.thresholds = thresholds
             self.add_state(
@@ -336,7 +366,7 @@ class MultilabelPrecisionRecallCurve(Metric):
             )
 
     def _compute_group_params(self):
-        return (self.num_labels, _thresholds_key(self.thresholds), self.ignore_index)
+        return (self.num_labels, _thresholds_key(self.thresholds), self.ignore_index, self.buffer_capacity)
 
     def update(self, preds: Array, target: Array) -> None:
         """Accumulate scores or binned confusion counts."""
@@ -348,9 +378,7 @@ class MultilabelPrecisionRecallCurve(Metric):
             preds, target, self.num_labels, None, self.ignore_index
         )
         if self.thresholds is None:
-            self.preds.append(preds)
-            self.target.append(target)
-            self.valid.append(valid)
+            _append_unbinned(self, preds, target, valid)
         else:
             self.confmat = self.confmat + _multilabel_precision_recall_curve_update(
                 preds, target, valid, self.num_labels, self.thresholds
@@ -358,15 +386,7 @@ class MultilabelPrecisionRecallCurve(Metric):
 
     def _curve_state(self):
         if self.thresholds is None:
-            if self.buffer_capacity is not None:
-                # padding slots are simply invalid entries — the unbinned compute
-                # path masks them out exactly like ignore_index samples
-                return (
-                    self.preds.data,
-                    self.target.data,
-                    self.valid.data & self.preds.mask,
-                )
-            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.valid))
+            return _unbinned_curve_state(self)
         return self.confmat
 
     def compute(self):

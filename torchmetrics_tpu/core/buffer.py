@@ -24,9 +24,11 @@ Array = jax.Array
 class MaskedBuffer:
     """Append-only value buffer with static capacity and a validity count.
 
-    Appending beyond capacity raises eagerly; under jit the write clamps at the end
-    (callers size the capacity for the epoch, like the reference's binned-thresholds
-    memory contract).
+    Appending beyond capacity raises eagerly when the count is concrete. Under jit
+    the write clamps at the end while ``count`` keeps growing, so the stateful
+    ``Metric.update`` dispatch re-checks ``count > capacity`` after each jitted step
+    and raises then; inside a user's own ``jit``/``scan`` the caller must size the
+    capacity for the epoch (like the reference's binned-thresholds memory contract).
     """
 
     def __init__(self, data: Array, count: Array) -> None:
@@ -66,6 +68,11 @@ class MaskedBuffer:
         """The valid prefix (eager only — dynamic shape)."""
         if isinstance(self.count, jax.core.Tracer):
             raise ValueError("MaskedBuffer.values() needs concrete counts; use .data/.mask under jit.")
+        if int(self.count) > self.capacity:
+            raise ValueError(
+                f"MaskedBuffer overflowed under jit: capacity {self.capacity}, count {int(self.count)}."
+                " Construct the metric with a larger buffer capacity."
+            )
         return self.data[: int(self.count)]
 
     def concat_gathered(self, gathered_data: Array, gathered_counts: Array) -> "MaskedBuffer":

@@ -355,11 +355,30 @@ class Metric(ABC):
         if self._jit_enabled():
             if self._jitted_update is None:
                 self._jitted_update = jit_with_static_leaves(self.pure_update)
+            # inside jit the MaskedBuffer overflow guard cannot raise (counts are
+            # tracers, writes clamp). Checking the PREVIOUS step's counts here keeps
+            # dispatch async — that array has had a whole step to finish, so int()
+            # does not stall the pipeline; compute()/values() backstop the last step.
+            self._check_buffer_overflow()
             self._state_values = self._jitted_update(dict(self._state_values), *args, **kwargs)
         else:
             self._update_impl(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
+
+    def _check_buffer_overflow(self) -> None:
+        """Raise if any MaskedBuffer state's (concrete) count exceeds its capacity."""
+        for key, value in self._state_values.items():
+            if (
+                isinstance(value, MaskedBuffer)
+                and not isinstance(value.count, jax.core.Tracer)
+                and int(value.count) > value.capacity
+            ):
+                raise ValueError(
+                    f"MaskedBuffer state {key!r} overflowed: capacity {value.capacity},"
+                    f" count {int(value.count)}. Construct the metric with a larger"
+                    " buffer capacity; the buffered state is now corrupt — call reset()."
+                )
 
     def _move_list_states_to_cpu(self) -> None:
         """Parity: reference ``metric.py:495-505`` (``compute_on_cpu``)."""
@@ -524,6 +543,7 @@ class Metric(ABC):
             )
         if self.compute_with_cache and self._computed is not None:
             return self._computed
+        self._check_buffer_overflow()  # backstop for the final jitted update
         with self.sync_context(
             dist_sync_fn=self.dist_sync_fn,
             should_sync=self._to_sync,
@@ -620,6 +640,10 @@ class Metric(ABC):
         self._dtype = dst_type
 
         def _cast(v):
+            if isinstance(v, MaskedBuffer):
+                if jnp.issubdtype(v.data.dtype, jnp.floating):
+                    return MaskedBuffer(jnp.asarray(v.data, dtype=dst_type), v.count)
+                return v
             if isinstance(v, (jax.Array, np.ndarray)) and jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating):
                 return jnp.asarray(v, dtype=dst_type)
             return v
@@ -636,6 +660,8 @@ class Metric(ABC):
         """Move array states to ``device`` (JAX analog of ``Metric.to``)."""
 
         def _put(v):
+            if isinstance(v, MaskedBuffer):
+                return MaskedBuffer(jax.device_put(v.data, device), jax.device_put(v.count, device))
             return jax.device_put(v, device) if isinstance(v, jax.Array) else v
 
         for key, value in self._state_values.items():
@@ -654,6 +680,8 @@ class Metric(ABC):
         state = {k: v for k, v in self.__dict__.items() if k not in skip}
         # device arrays -> host for portability
         def _host(v):
+            if isinstance(v, MaskedBuffer):
+                return MaskedBuffer(np.asarray(v.data), np.asarray(v.count))
             if isinstance(v, jax.Array):
                 return np.asarray(v)
             if isinstance(v, list):
@@ -677,6 +705,8 @@ class Metric(ABC):
         for k, v in self.__dict__["_state_values"].items():
             if isinstance(v, list):
                 sv[k] = [jnp.asarray(x) for x in v]
+            elif isinstance(v, MaskedBuffer):
+                sv[k] = MaskedBuffer(jnp.asarray(v.data), jnp.asarray(v.count))
             else:
                 sv[k] = jnp.asarray(v)
         self.__dict__["_state_values"] = sv

@@ -228,6 +228,12 @@ class InceptionFeatureExtractor:
         params: flax parameter pytree (from :func:`load_torch_fidelity_weights`), or
             None for random initialization (throughput benchmarking only).
         normalize: if True, inputs are floats in [0, 1]; else uint8 in [0, 255].
+        mesh: optional ``jax.sharding.Mesh``. When given, parameters are replicated
+            over the mesh and the image batch is sharded along the first mesh axis,
+            so extraction runs data-parallel across every chip; ragged batches are
+            zero-padded to a shardable multiple and the padding's features sliced
+            off. The reference shards extraction the same way via DDP'd forward
+            passes (``image/fid.py`` under Lightning).
     """
 
     def __init__(
@@ -236,6 +242,7 @@ class InceptionFeatureExtractor:
         params: Optional[dict] = None,
         weights_path: Optional[str] = None,
         normalize: bool = False,
+        mesh: Optional[Any] = None,
     ) -> None:
         if not _FLAX_AVAILABLE:
             raise ModuleNotFoundError(
@@ -268,7 +275,21 @@ class InceptionFeatureExtractor:
 
         # preprocessing (layout fix, quantize, TF1 resize, remap) is shape-static, so
         # the whole pipeline compiles into one fused program per input shape
-        self._forward = jax.jit(self._preprocess_and_apply)
+        self._mesh_divisor = 0
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._mesh_divisor = mesh.shape[mesh.axis_names[0]]
+            param_sharding = NamedSharding(mesh, PartitionSpec())
+            batch_sharding = NamedSharding(mesh, PartitionSpec(mesh.axis_names[0]))
+            self.params = jax.device_put(self.params, param_sharding)
+            self._forward = jax.jit(
+                self._preprocess_and_apply,
+                in_shardings=(param_sharding, batch_sharding),
+                out_shardings=batch_sharding,
+            )
+        else:
+            self._forward = jax.jit(self._preprocess_and_apply)
 
     def _preprocess_and_apply(self, variables: dict, imgs: Array) -> Array:
         imgs = jnp.asarray(imgs)
@@ -286,6 +307,17 @@ class InceptionFeatureExtractor:
         return self.net.apply(variables, imgs)[self.feature_key]
 
     def __call__(self, imgs: Array) -> Array:
+        imgs = jnp.asarray(imgs)
+        if self._mesh_divisor:
+            # ragged final batches: pad to a shardable multiple, slice features back
+            # (features are per-image, so padding is exact)
+            if imgs.ndim == 3:
+                imgs = imgs[None]
+            n = imgs.shape[0]
+            pad = (-n) % self._mesh_divisor
+            if pad:
+                imgs = jnp.concatenate([imgs, jnp.zeros((pad, *imgs.shape[1:]), dtype=imgs.dtype)])
+            return self._forward(self.params, imgs)[:n].astype(jnp.float32)
         return self._forward(self.params, imgs).astype(jnp.float32)
 
 

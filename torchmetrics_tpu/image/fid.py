@@ -74,6 +74,7 @@ class FrechetInceptionDistance(Metric):
         normalize: bool = False,
         num_features: Optional[int] = None,
         input_img_size: Tuple[int, int, int] = (3, 299, 299),
+        mesh: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -89,7 +90,7 @@ class FrechetInceptionDistance(Metric):
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
                 )
-            self.inception = InceptionFeatureExtractor(feature=feature, normalize=normalize)
+            self.inception = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
             num_features = feature
         elif callable(feature):
             self.inception = feature

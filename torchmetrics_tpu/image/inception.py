@@ -5,7 +5,7 @@ Parity: reference ``src/torchmetrics/image/inception.py:36-212``.
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Tuple, Union
+from typing import Any, Callable, List, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +44,7 @@ class InceptionScore(Metric):
         feature: Union[str, int, Callable] = "logits_unbiased",
         splits: int = 10,
         normalize: bool = False,
+        mesh: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -59,7 +60,7 @@ class InceptionScore(Metric):
                 raise ValueError(
                     f"Input to argument `feature` must be one of {valid_inputs}, but got {feature}."
                 )
-            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize)
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
         elif callable(feature):
             self.inception = feature
         else:

@@ -94,13 +94,14 @@ class KernelInceptionDistance(Metric):
         coef: float = 1.0,
         reset_real_features: bool = True,
         normalize: bool = False,
+        mesh: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
         super().__init__(**kwargs)
 
         if isinstance(feature, int):
-            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize)
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
         elif callable(feature):
             self.inception = feature
         else:

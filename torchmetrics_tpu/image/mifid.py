@@ -7,7 +7,7 @@ compute ``:50-76``, ``MemorizationInformedFrechetInceptionDistance`` ``:79-260``
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Callable, List, Union
+from typing import Any, Callable, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -79,6 +79,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
+        mesh: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -90,7 +91,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
                 )
-            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize)
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
         elif callable(feature):
             self.inception = feature
         else:
