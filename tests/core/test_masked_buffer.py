@@ -57,6 +57,13 @@ class TestMaskedBuffer:
         assert int(merged.count) == 3
 
 
+    def test_concat_gathered_rejects_overflowed_shard(self):
+        data = jnp.zeros((2, 4))
+        counts = jnp.asarray([6, 2])  # shard 0 overflowed its capacity of 4
+        with pytest.raises(ValueError, match="overflowed"):
+            MaskedBuffer.create(8).concat_gathered(data, counts)
+
+
 class TestBufferedCatMetric:
     def test_matches_list_mode(self):
         vals = rng.rand(3, 8).astype(np.float32)
@@ -143,9 +150,10 @@ class TestBufferedCatMetric:
         p = jnp.asarray(rng.rand(3).astype(np.float32))
         t = jnp.asarray(rng.randint(0, 2, 3))
         metric.update(p, t)
-        metric.update(p, t)  # overflows (6 > 4): detected one step late
+        metric.update(p, t)  # overflows (6 > 4): detected within the check period
         with pytest.raises(ValueError, match="overflow"):
-            metric.update(p, t)
+            for _ in range(2 * metric._buffer_overflow_check_every):
+                metric.update(p, t)
 
         metric2 = BinaryAUROC(buffer_capacity=4)
         metric2.update(p, t)
@@ -266,6 +274,76 @@ class TestBufferedUnbinnedCurves:
         val = jax.jit(f)(metric.init_state(), p, jnp.asarray(t))
         expected = roc_auc_score(t, np.asarray(p), multi_class="ovr", average="macro")
         _assert_allclose(val, expected, atol=1e-5)
+
+    def test_retrieval_buffered_matches_list_mode(self):
+        from torchmetrics_tpu.retrieval import RetrievalMRR
+
+        idx = jnp.asarray(rng.randint(0, 4, 32))
+        p = jnp.asarray(rng.rand(32).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, 2, 32))
+        buffered = RetrievalMRR(buffer_capacity=64)
+        listed = RetrievalMRR()
+        buffered.update(p, t, idx)
+        listed.update(p, t, idx)
+        _assert_allclose(buffered.compute(), listed.compute(), atol=1e-6)
+
+    def test_retrieval_buffered_graded_targets(self):
+        """allow_non_binary_target metrics must keep float relevance grades in the
+        buffer (not truncate to int)."""
+        from torchmetrics_tpu.retrieval import RetrievalNormalizedDCG
+
+        idx = jnp.array([0, 0, 0, 1, 1, 1])
+        p = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5])
+        t = jnp.array([0.5, 1.5, 2.0, 0.0, 1.0, 0.3])
+        buffered = RetrievalNormalizedDCG(buffer_capacity=16)
+        listed = RetrievalNormalizedDCG()
+        buffered.update(p, t, idx)
+        listed.update(p, t, idx)
+        _assert_allclose(buffered.compute(), listed.compute(), atol=1e-6)
+
+    def test_retrieval_list_mode_rejects_jit(self):
+        from torchmetrics_tpu.retrieval import RetrievalMRR
+
+        metric = RetrievalMRR()
+        with pytest.raises(ValueError, match="buffer_capacity"):
+            jax.jit(metric.pure_update)(
+                metric.init_state(),
+                jnp.array([0.2, 0.3]),
+                jnp.array([0, 1]),
+                jnp.array([0, 0]),
+            )
+
+    def test_retrieval_buffered_mesh_matches_eager(self):
+        """Updates + sync inside shard_map (trace-safe validation path), compute on
+        the gathered state outside — equals compute-on-all-data, incl. ignore_index."""
+        from torchmetrics_tpu.retrieval import RetrievalMRR
+
+        n_dev = len(jax.devices())
+        idx = rng.randint(0, 4, n_dev * 8)
+        p = rng.rand(n_dev * 8).astype(np.float32)
+        t = rng.randint(0, 2, n_dev * 8)
+        t[:3] = -1  # ignored entries exercise the valid-mask path
+
+        metric = RetrievalMRR(buffer_capacity=16, ignore_index=-1)
+        mesh = Mesh(np.array(jax.devices()), ("data",))
+
+        def shard_step(state, pp, tt, ii):
+            state = metric.pure_update(state, pp, tt, ii)
+            return metric.sync_state(state, axis_name="data")
+
+        f = shard_map(
+            shard_step,
+            mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        synced = jax.jit(f)(metric.init_state(), jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        val = metric.pure_compute(synced)
+
+        eager = RetrievalMRR(ignore_index=-1)
+        eager.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        _assert_allclose(val, eager.compute(), atol=1e-6)
 
     def test_buffered_update_jits(self):
         metric = BinaryAUROC(buffer_capacity=32)

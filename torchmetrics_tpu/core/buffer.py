@@ -82,6 +82,14 @@ class MaskedBuffer:
         static shapes, jit-safe, and order-preserving across shards.
         """
         num_shards, cap = gathered_data.shape[:2]
+        if not isinstance(gathered_counts, jax.core.Tracer) and int(jnp.max(gathered_counts)) > cap:
+            # a shard overflowed under jit before syncing: its tail was overwritten
+            # and the merged count would hide it — surface the corruption here
+            raise ValueError(
+                f"MaskedBuffer shard overflowed before sync: capacity {cap}, per-shard"
+                f" counts {[int(c) for c in gathered_counts]}. Construct the metric with"
+                " a larger buffer capacity."
+            )
         flat = gathered_data.reshape((num_shards * cap,) + gathered_data.shape[2:])
         item_valid = (jnp.arange(cap)[None, :] < gathered_counts[:, None]).reshape(-1)
         order = jnp.argsort(~item_valid, stable=True)

@@ -356,15 +356,19 @@ class Metric(ABC):
             if self._jitted_update is None:
                 self._jitted_update = jit_with_static_leaves(self.pure_update)
             # inside jit the MaskedBuffer overflow guard cannot raise (counts are
-            # tracers, writes clamp). Checking the PREVIOUS step's counts here keeps
-            # dispatch async — that array has had a whole step to finish, so int()
-            # does not stall the pipeline; compute()/values() backstop the last step.
-            self._check_buffer_overflow()
+            # tracers, writes clamp). Checking the PREVIOUS step's counts every K
+            # updates bounds detection latency without serializing dispatch (the
+            # int() readback blocks); compute()/values() backstop the tail.
+            if self._update_count % self._buffer_overflow_check_every == 0:
+                self._check_buffer_overflow()
             self._state_values = self._jitted_update(dict(self._state_values), *args, **kwargs)
         else:
             self._update_impl(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
+
+    # how often the jitted-update path syncs MaskedBuffer counts back to the host
+    _buffer_overflow_check_every: int = 16
 
     def _check_buffer_overflow(self) -> None:
         """Raise if any MaskedBuffer state's (concrete) count exceeds its capacity."""

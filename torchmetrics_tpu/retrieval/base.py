@@ -5,7 +5,10 @@ Parity: reference ``src/torchmetrics/retrieval/base.py`` (aggregation ``:24-41``
 
 Design: ``indexes/preds/target`` accumulate as "cat" list states; ``compute`` sorts by
 query id on host (group sizes are data-dependent) and maps the per-query functional over
-the segments, exactly the reference's epoch-end evaluation model.
+the segments, exactly the reference's epoch-end evaluation model. With a
+``buffer_capacity`` the same states become static-shape ``MaskedBuffer`` states:
+updates run inside jit/``shard_map`` (validation falls back to a trace-safe masked
+path) and cross-shard sync is one ``all_gather`` + compaction.
 """
 
 from __future__ import annotations
@@ -47,8 +50,12 @@ def _check_retrieval_inputs(
     target: Array,
     allow_non_binary_target: bool = False,
     ignore_index: Optional[int] = None,
-) -> Tuple[Array, Array, Array]:
-    """Validate and flatten an (indexes, preds, target) triple."""
+) -> Tuple[Array, Array, Array, Array]:
+    """Validate and flatten an (indexes, preds, target) triple.
+
+    Returns ``(indexes, preds, target, valid)``. Eagerly, ignore_index entries are
+    dropped and ``valid`` is all-True; under tracing nothing can be dropped, so the
+    value checks are skipped and ``valid`` marks the kept entries instead."""
     indexes = jnp.asarray(indexes)
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
@@ -60,6 +67,17 @@ def _check_retrieval_inputs(
     indexes = indexes.ravel()
     preds = preds.ravel()
     target = target.ravel()
+
+    if isinstance(target, jax.core.Tracer) or isinstance(preds, jax.core.Tracer):
+        # trace-safe path (buffered updates inside jit/shard_map): value checks need
+        # concrete data and dropping needs dynamic shapes — keep an explicit mask
+        valid = (
+            jnp.ones_like(target, dtype=jnp.bool_)
+            if ignore_index is None
+            else target != ignore_index
+        )
+        tgt = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
+        return indexes.astype(jnp.int32), preds.astype(jnp.float32), jnp.where(valid, tgt, 0), valid
 
     if ignore_index is not None:
         valid = np.asarray(target != ignore_index)
@@ -78,7 +96,12 @@ def _check_retrieval_inputs(
             raise ValueError("`target` must contain `binary` values")
 
     target = target.astype(jnp.float32) if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.int32)
-    return indexes.astype(jnp.int32), preds.astype(jnp.float32), target
+    return (
+        indexes.astype(jnp.int32),
+        preds.astype(jnp.float32),
+        target,
+        jnp.ones_like(target, dtype=jnp.bool_),
+    )
 
 
 def _group_by_query(indexes, preds, target) -> List[Tuple[np.ndarray, np.ndarray]]:
@@ -101,6 +124,7 @@ class RetrievalMetric(Metric, ABC):
     is_differentiable: bool = False
     higher_is_better: bool = True
     full_state_update: bool = False
+    allow_non_binary_target: bool = False
 
     indexes: List[Array]
     preds: List[Array]
@@ -111,10 +135,10 @@ class RetrievalMetric(Metric, ABC):
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
         aggregation: Union[str, Callable] = "mean",
+        buffer_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.allow_non_binary_target = False
 
         empty_target_action_options = ("error", "skip", "neg", "pos")
         if empty_target_action not in empty_target_action_options:
@@ -133,28 +157,63 @@ class RetrievalMetric(Metric, ABC):
         self.aggregation = aggregation
 
         # "cat": list states must gather-concat across processes during sync (the
-        # upstream's dist_reduce_fx=None also gathers; this repo's None is identity)
-        self.add_state("indexes", [], dist_reduce_fx="cat")
-        self.add_state("preds", [], dist_reduce_fx="cat")
-        self.add_state("target", [], dist_reduce_fx="cat")
+        # upstream's dist_reduce_fx=None also gathers; this repo's None is identity).
+        # With a buffer_capacity the same states become static-shape MaskedBuffers:
+        # updates run under jit/shard_map and sync is one all_gather + compaction.
+        self.buffer_capacity = buffer_capacity
+        if buffer_capacity is not None:
+            from torchmetrics_tpu.core.buffer import MaskedBuffer
+
+            # graded-relevance metrics (allow_non_binary_target) carry float targets
+            target_dtype = jnp.float32 if self.allow_non_binary_target else jnp.int32
+            self.add_state("indexes", MaskedBuffer.create(buffer_capacity, dtype=jnp.int32), dist_reduce_fx="cat")
+            self.add_state("preds", MaskedBuffer.create(buffer_capacity), dist_reduce_fx="cat")
+            self.add_state("target", MaskedBuffer.create(buffer_capacity, dtype=target_dtype), dist_reduce_fx="cat")
+            self.add_state("valid", MaskedBuffer.create(buffer_capacity, dtype=jnp.bool_), dist_reduce_fx="cat")
+            if self._jit_update_flag is None:
+                # validation is host-side; keep the public path eager (exact
+                # drop-filtering) — mesh users drive pure_update inside shard_map
+                self._jit_update_flag = False
+        else:
+            self.add_state("indexes", [], dist_reduce_fx="cat")
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array, indexes: Array) -> None:
         """Validate, flatten and store the batch triple."""
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
-        indexes, preds, target = _check_retrieval_inputs(
+        indexes, preds, target, valid = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target,
             ignore_index=self.ignore_index,
         )
-        self.indexes.append(indexes)
-        self.preds.append(preds)
-        self.target.append(target)
+        if self.buffer_capacity is not None:
+            self.indexes = self.indexes.append(indexes)
+            self.preds = self.preds.append(preds)
+            self.target = self.target.append(target)
+            self.valid = self.valid.append(valid)
+        else:
+            if isinstance(valid, jax.core.Tracer):
+                raise ValueError(
+                    "List-state retrieval metrics cannot update under jit (dynamic-size"
+                    " appends). Construct the metric with `buffer_capacity` instead."
+                )
+            self.indexes.append(indexes)
+            self.preds.append(preds)
+            self.target.append(target)
 
     def _group_segments(self) -> List[Tuple[np.ndarray, np.ndarray]]:
         """Group accumulated state by query id: list of (preds, target) per query.
 
         Groups stay as host numpy — per-query documents are tiny, so per-group device
         dispatch would dominate; the per-query functionals accept numpy directly."""
+        if self.buffer_capacity is not None:
+            keep = np.asarray(self.valid.values()).astype(bool)
+            return _group_by_query(
+                np.asarray(self.indexes.values())[keep],
+                np.asarray(self.preds.values())[keep],
+                np.asarray(self.target.values())[keep],
+            )
         return _group_by_query(
             dim_zero_cat(self.indexes), dim_zero_cat(self.preds), dim_zero_cat(self.target)
         )

@@ -263,14 +263,14 @@ class RetrievalNormalizedDCG(RetrievalMetric):
         Array(0.84669995, dtype=float32)
     """
 
+    allow_non_binary_target: bool = True
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 
     def __init__(self, top_k: Optional[int] = None, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
         _validate_top_k(top_k)
+        super().__init__(**kwargs)
         self.top_k = top_k
-        self.allow_non_binary_target = True
 
     def _metric(self, preds: Array, target: Array) -> Array:
         return retrieval_normalized_dcg(preds, target, top_k=self.top_k)
@@ -332,9 +332,13 @@ class RetrievalPrecisionRecallCurve(Metric):
         """Validate, flatten and store the batch triple."""
         if indexes is None:
             raise ValueError("Argument `indexes` cannot be None")
-        indexes, preds, target = _check_retrieval_inputs(
+        indexes, preds, target, valid = _check_retrieval_inputs(
             indexes, preds, target, ignore_index=self.ignore_index
         )
+        if isinstance(valid, jax.core.Tracer):
+            raise ValueError(
+                "RetrievalPrecisionRecallCurve cannot update under jit (dynamic-size appends)."
+            )
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
