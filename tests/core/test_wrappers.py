@@ -310,3 +310,57 @@ class TestTransformations:
             BinaryTargetTransformer(BinaryAccuracy(), threshold="nope")
         with pytest.raises(TypeError):
             BinaryTargetTransformer("not-a-metric")
+
+
+class TestFeatureShare:
+    def test_backbone_shared_and_cached(self):
+        """FID+KID+IS wrapped in FeatureShare must run the inception forward once per
+        distinct batch, and all members must see the same cached network."""
+        import warnings
+
+        from torchmetrics_tpu.image import (
+            FrechetInceptionDistance,
+            InceptionScore,
+            KernelInceptionDistance,
+        )
+        from torchmetrics_tpu.wrappers import FeatureShare
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fid = FrechetInceptionDistance(feature=64)
+            kid = KernelInceptionDistance(feature=64, subsets=2, subset_size=4)
+            inception = InceptionScore(feature=64)
+
+        calls = {"n": 0}
+        base_net = fid.inception
+        class CountingNet:
+            feature_key = base_net.feature_key
+            def __call__(self, imgs):
+                calls["n"] += 1
+                return base_net(imgs)
+        fid.inception = CountingNet()
+        kid.inception = fid.inception
+        inception.inception = fid.inception
+
+        fs = FeatureShare([fid, kid, inception])
+        nets = {id(getattr(m, m.feature_network)) for m in fs.values()}
+        assert len(nets) == 1  # one shared NetworkCache proxy
+
+        rng_l = np.random.RandomState(0)
+        imgs = jnp.asarray((rng_l.rand(8, 3, 32, 32) * 255).astype(np.uint8))
+        fs.update(imgs, real=True)
+        assert calls["n"] == 1  # three metrics, one backbone forward
+
+        imgs2 = jnp.asarray((rng_l.rand(8, 3, 32, 32) * 255).astype(np.uint8))
+        fs.update(imgs2, real=False)
+        assert calls["n"] == 2
+
+        # compute must work through the shared NetworkCache proxy
+        res = fs.compute()
+        assert np.isfinite(float(res["FrechetInceptionDistance"]))
+
+    def test_missing_feature_network_raises(self):
+        from torchmetrics_tpu.wrappers import FeatureShare
+
+        with pytest.raises(AttributeError, match="feature_network"):
+            FeatureShare([BinaryAccuracy()])

@@ -55,6 +55,7 @@ class FrechetInceptionDistance(Metric):
         True
     """
 
+    feature_network: str = "inception"  # FeatureShare hook (reference image/fid.py:296)
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

@@ -32,6 +32,7 @@ class InceptionScore(Metric):
         True
     """
 
+    feature_network: str = "inception"  # FeatureShare hook (reference image/inception.py:106)
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

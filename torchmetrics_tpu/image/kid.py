@@ -76,6 +76,7 @@ class KernelInceptionDistance(Metric):
         True
     """
 
+    feature_network: str = "inception"  # FeatureShare hook (reference image/kid.py:174)
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

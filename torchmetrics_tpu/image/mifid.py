@@ -65,6 +65,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         True
     """
 
+    feature_network: str = "inception"  # FeatureShare hook (reference image/mifid.py:154)
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

@@ -27,6 +27,7 @@ class CLIPScore(Metric):
     construction raises a descriptive ``OSError`` when they are unavailable.
     """
 
+    feature_network: str = "model"  # FeatureShare hook (reference clip_score.py:102)
     is_differentiable = False
     higher_is_better = True
     full_state_update = True
