@@ -296,7 +296,9 @@ class Metric(ABC):
         as the subclass ``update`` body is traceable."""
         prev = self._bind_state(state)
         try:
-            self._update_impl(*args, **kwargs)
+            # named scopes surface per-metric regions in XLA profiles / HLO metadata
+            with jax.named_scope(f"{type(self).__name__}.update"):
+                self._update_impl(*args, **kwargs)
             return dict(self.__dict__["_state_values"])
         finally:
             self.__dict__["_state_values"] = prev
@@ -305,13 +307,15 @@ class Metric(ABC):
         """Pure ``value = compute(state)``."""
         prev = self._bind_state(state)
         try:
-            return self._compute_impl()
+            with jax.named_scope(f"{type(self).__name__}.compute"):
+                return self._compute_impl()
         finally:
             self.__dict__["_state_values"] = prev
 
     def sync_state(self, state: Dict[str, Any], axis_name: Optional[str] = None) -> Dict[str, Any]:
         """Collective-sync a state pytree over a mesh axis (see ``parallel.sync_state``)."""
-        return _sync_state_fn(state, self._reductions, axis_name=axis_name)
+        with jax.named_scope(f"{type(self).__name__}.sync"):
+            return _sync_state_fn(state, self._reductions, axis_name=axis_name)
 
     def scan_update(self, state: Dict[str, Any], *batched_args: Any, **batched_kwargs: Any) -> Dict[str, Any]:
         """Fold a whole stream of batches into the state in ONE XLA program.
@@ -363,7 +367,8 @@ class Metric(ABC):
                 self._check_buffer_overflow()
             self._state_values = self._jitted_update(dict(self._state_values), *args, **kwargs)
         else:
-            self._update_impl(*args, **kwargs)
+            with jax.named_scope(f"{type(self).__name__}.update"):
+                self._update_impl(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -553,7 +558,8 @@ class Metric(ABC):
             should_sync=self._to_sync,
             should_unsync=self._should_unsync,
         ):
-            value = self._compute_impl()
+            with jax.named_scope(f"{type(self).__name__}.compute"):
+                value = self._compute_impl()
             value = _squeeze_if_scalar(value)
         if self.compute_with_cache:
             self._computed = value

@@ -29,6 +29,7 @@ _TRANSFORMERS_AVAILABLE = _package_available("transformers")
 _NLTK_AVAILABLE = _package_available("nltk")
 _REGEX_AVAILABLE = _package_available("regex")
 _TORCH_AVAILABLE = _package_available("torch")  # CPU torch: only for weight conversion
+_ORBAX_AVAILABLE = _package_available("orbax.checkpoint")
 _PESQ_AVAILABLE = _package_available("pesq")
 _PYSTOI_AVAILABLE = _package_available("pystoi")
 _GAMMATONE_AVAILABLE = _package_available("gammatone")
