@@ -1,0 +1,139 @@
+"""Differential battery for the thinly-covered stat-scores paths (VERDICT weak
+item 5): ``top_k > 1`` and ``multidim_average="samplewise"``, with and without
+``ignore_index`` — compared against the reference implementation itself
+(reference ``functional/classification/stat_scores.py:260-420``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+rng = np.random.RandomState(123)
+
+N, C, X = 24, 5, 7  # batch, classes, extra (multidim) axis
+
+
+def _logits(shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def ref():
+    tm = reference_torchmetrics()
+    import torch
+
+    return tm, torch
+
+
+class TestTopK:
+    @pytest.mark.parametrize("top_k", [1, 2, 3])
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_accuracy(self, ref, top_k, average):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import multiclass_accuracy
+
+        p, t = _logits((N, C)), rng.randint(0, C, N)
+        want = tm.functional.classification.multiclass_accuracy(
+            torch.from_numpy(p), torch.from_numpy(t), num_classes=C, top_k=top_k, average=average
+        )
+        got = multiclass_accuracy(jnp.asarray(p), jnp.asarray(t), num_classes=C, top_k=top_k, average=average)
+        _assert_allclose(got, want.numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("top_k", [2, 3])
+    def test_f1_with_ignore_index(self, ref, top_k):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import multiclass_f1_score
+
+        p, t = _logits((N, C)), rng.randint(0, C, N)
+        t[:4] = -1
+        want = tm.functional.classification.multiclass_f1_score(
+            torch.from_numpy(p), torch.from_numpy(t), num_classes=C, top_k=top_k,
+            average="macro", ignore_index=-1,
+        )
+        got = multiclass_f1_score(
+            jnp.asarray(p), jnp.asarray(t), num_classes=C, top_k=top_k, average="macro", ignore_index=-1
+        )
+        _assert_allclose(got, want.numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("top_k", [2, 3])
+    def test_stat_scores(self, ref, top_k):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import multiclass_stat_scores
+
+        p, t = _logits((N, C)), rng.randint(0, C, N)
+        want = tm.functional.classification.multiclass_stat_scores(
+            torch.from_numpy(p), torch.from_numpy(t), num_classes=C, top_k=top_k, average=None
+        )
+        got = multiclass_stat_scores(jnp.asarray(p), jnp.asarray(t), num_classes=C, top_k=top_k, average=None)
+        _assert_allclose(got, want.numpy(), atol=0)
+
+
+class TestSamplewise:
+    @pytest.mark.parametrize("ignore_index", [None, 1])
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_multiclass_accuracy_multidim(self, ref, ignore_index, average):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import multiclass_accuracy
+
+        p, t = _logits((N, C, X)), rng.randint(0, C, (N, X))
+        want = tm.functional.classification.multiclass_accuracy(
+            torch.from_numpy(p), torch.from_numpy(t), num_classes=C,
+            multidim_average="samplewise", average=average, ignore_index=ignore_index,
+        )
+        got = multiclass_accuracy(
+            jnp.asarray(p), jnp.asarray(t), num_classes=C,
+            multidim_average="samplewise", average=average, ignore_index=ignore_index,
+        )
+        assert got.shape == (N,)
+        _assert_allclose(got, want.numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("ignore_index", [None, 0])
+    def test_multiclass_stat_scores_multidim(self, ref, ignore_index):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import multiclass_stat_scores
+
+        p, t = _logits((N, C, X)), rng.randint(0, C, (N, X))
+        want = tm.functional.classification.multiclass_stat_scores(
+            torch.from_numpy(p), torch.from_numpy(t), num_classes=C,
+            multidim_average="samplewise", average=None, ignore_index=ignore_index,
+        )
+        got = multiclass_stat_scores(
+            jnp.asarray(p), jnp.asarray(t), num_classes=C,
+            multidim_average="samplewise", average=None, ignore_index=ignore_index,
+        )
+        _assert_allclose(got, want.numpy(), atol=0)
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_multilabel_f1_multidim(self, ref, average):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import multilabel_f1_score
+
+        p = rng.rand(N, C, X).astype(np.float32)
+        t = rng.randint(0, 2, (N, C, X))
+        want = tm.functional.classification.multilabel_f1_score(
+            torch.from_numpy(p), torch.from_numpy(t), num_labels=C,
+            multidim_average="samplewise", average=average,
+        )
+        got = multilabel_f1_score(
+            jnp.asarray(p), jnp.asarray(t), num_labels=C,
+            multidim_average="samplewise", average=average,
+        )
+        _assert_allclose(got, want.numpy(), atol=1e-6)
+
+    def test_binary_recall_multidim(self, ref):
+        tm, torch = ref
+        from torchmetrics_tpu.functional.classification import binary_recall
+
+        p = rng.rand(N, X).astype(np.float32)
+        t = rng.randint(0, 2, (N, X))
+        want = tm.functional.classification.binary_recall(
+            torch.from_numpy(p), torch.from_numpy(t), multidim_average="samplewise"
+        )
+        got = binary_recall(jnp.asarray(p), jnp.asarray(t), multidim_average="samplewise")
+        _assert_allclose(got, want.numpy(), atol=1e-6)

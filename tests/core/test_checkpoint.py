@@ -104,3 +104,16 @@ class TestCheckpoint:
         other = MetricCollection({"mse": MeanSquaredError()})
         with pytest.raises(KeyError, match="mse"):
             load_checkpoint(other, path)
+
+    def test_restore_into_live_metric_clears_cache(self, tmp_path):
+        """A metric that already computed must not serve its stale cached value
+        after a checkpoint restore (compute_with_cache defaults True)."""
+        fresh = MeanSquaredError()
+        fresh.update(jnp.array([1.0]), jnp.array([1.0]))  # mse = 0
+        path = save_checkpoint(fresh, str(tmp_path / "ckpt"))
+
+        live = MeanSquaredError()
+        live.update(jnp.array([0.0]), jnp.array([10.0]))
+        assert float(live.compute()) == 100.0  # caches the value
+        load_checkpoint(live, path)
+        assert float(live.compute()) == 0.0

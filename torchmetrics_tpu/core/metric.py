@@ -610,11 +610,16 @@ class Metric(ABC):
         for key in self._persistent:
             self._persistent[key] = mode
 
-    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> Dict[str, Any]:
-        """Serialize persistent states as host numpy arrays (reference ``metric.py:858-885``)."""
+    def state_dict(
+        self, destination: Optional[dict] = None, prefix: str = "", persistent_only: bool = True
+    ) -> Dict[str, Any]:
+        """Serialize states as host numpy arrays (reference ``metric.py:858-885``).
+
+        ``persistent_only=False`` includes every state — the checkpoint/resume path
+        (``utils/checkpoint.py``) uses this to capture mid-epoch state."""
         destination = destination if destination is not None else {}
         for key, value in self._state_values.items():
-            if not self._persistent.get(key, False):
+            if persistent_only and not self._persistent.get(key, False):
                 continue
             if isinstance(value, list):
                 destination[prefix + key] = [np.asarray(v) for v in value]

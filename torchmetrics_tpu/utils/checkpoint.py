@@ -17,7 +17,6 @@ from typing import Any, Dict, Union
 
 import numpy as np
 
-from torchmetrics_tpu.core.buffer import MaskedBuffer
 from torchmetrics_tpu.core.metric import Metric
 
 __all__ = ["save_checkpoint", "load_checkpoint"]
@@ -39,14 +38,14 @@ def _require_orbax():
 def _host_states(metric: Metric) -> Dict[str, Any]:
     """All states (not just persistent ones) as an orbax-friendly host pytree."""
     out: Dict[str, Any] = {}
-    for key, value in metric.metric_state.items():
+    for key, value in metric.state_dict(persistent_only=False).items():
         if isinstance(value, list):
             # orbax drops empty containers; index dicts keep ordering explicit
-            out[key] = {"__list__": {str(i): np.asarray(v) for i, v in enumerate(value)}}
-        elif isinstance(value, MaskedBuffer):
-            out[key] = {"__masked_buffer__": {"data": np.asarray(value.data), "count": np.asarray(value.count)}}
+            out[key] = {"__list__": {str(i): v for i, v in enumerate(value)}}
+        elif isinstance(value, dict):  # state_dict's MaskedBuffer wire format
+            out[key] = {"__masked_buffer__": value}
         else:
-            out[key] = np.asarray(value)
+            out[key] = value
     return {"states": out, "update_count": np.asarray(metric.update_count)}
 
 
@@ -76,6 +75,10 @@ def _restore_states(metric: Metric, tree: Dict[str, Any]) -> None:
     count = tree.get("update_count")
     if count is not None:
         metric._update_count = int(count)
+    # a live metric may hold results from before the restore — drop them
+    metric._computed = None
+    metric._cache = None
+    metric._is_synced = False
 
 
 def _tree_of(target: Union[Metric, Any]) -> Dict[str, Any]:
