@@ -117,3 +117,16 @@ class TestCheckpoint:
         assert float(live.compute()) == 100.0  # caches the value
         load_checkpoint(live, path)
         assert float(live.compute()) == 0.0
+
+    def test_direct_load_state_dict_clears_cache(self):
+        """The invalidation must live in load_state_dict itself, not only in the
+        orbax path."""
+        fresh = MeanSquaredError()
+        fresh.update(jnp.array([1.0]), jnp.array([1.0]))
+        sd = fresh.state_dict(persistent_only=False)
+
+        live = MeanSquaredError()
+        live.update(jnp.array([0.0]), jnp.array([10.0]))
+        assert float(live.compute()) == 100.0
+        live.load_state_dict(sd)
+        assert float(live.compute()) == 0.0

@@ -649,6 +649,10 @@ class Metric(ABC):
                     self._update_count = 1  # loaded state counts as updated
             elif strict and self._persistent.get(key, False):
                 raise KeyError(f"Missing key {full!r} in state_dict")
+        # a live metric may hold results computed before the load — drop them
+        self._computed = None
+        self._cache = None
+        self._is_synced = False
 
     def set_dtype(self, dst_type) -> "Metric":
         """Cast floating-point states (and future defaults) to ``dst_type``."""

@@ -71,14 +71,10 @@ def _restore_states(metric: Metric, tree: Dict[str, Any]) -> None:
             payload[key] = value["__masked_buffer__"]
         else:
             payload[key] = value
-    metric.load_state_dict(payload)
+    metric.load_state_dict(payload)  # also drops any stale compute cache
     count = tree.get("update_count")
     if count is not None:
         metric._update_count = int(count)
-    # a live metric may hold results from before the restore — drop them
-    metric._computed = None
-    metric._cache = None
-    metric._is_synced = False
 
 
 def _tree_of(target: Union[Metric, Any]) -> Dict[str, Any]:
