@@ -1,12 +1,18 @@
-"""Benchmark: metric update+compute µs/step on TPU vs reference TorchMetrics on CPU torch.
+"""Benchmark: TPU-native metrics vs reference TorchMetrics (torch CPU).
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "hardware": ...,
+   "configs": {...}}``
 
-The workload mirrors BASELINE.md config #1/#2: a MulticlassAccuracy-style hot loop
-(stat-scores counting) on batches of 4096 predictions, 100 classes. Ours runs as a single
-jitted XLA program on the TPU chip; the baseline is the reference TorchMetrics
-implementation on CPU torch (the reference has no TPU path). ``vs_baseline`` is the
-speedup factor (baseline_time / our_time).
+Headline = config #1 (per-step stateful update+compute — the apples-to-apples hot
+loop: our jit-cached dispatch vs the reference's eager per-step update). The
+``configs`` dict carries every BASELINE.md config measured this run, each with its own
+``vs_baseline`` (``null`` where the reference cannot run in this image).
+
+Backend policy: the host pins ``JAX_PLATFORMS=axon`` (tunneled TPU) and the tunnel has
+been wedged at bench time in past rounds. We probe the backend *in a subprocess* (a
+wedged tunnel hangs forever, it doesn't error), retry with backoff at bench time, and
+only then fall back to an 8-device virtual CPU mesh tagged ``cpu-fallback``.
 """
 
 import json
@@ -20,67 +26,43 @@ import numpy as np
 BATCH = 4096
 NUM_CLASSES = 100
 STEPS = 200
-WARMUP = 10
 
 
-def _probe_backend() -> str:
-    """Return the hardware tag to bench on, surviving a wedged TPU relay.
+# --------------------------------------------------------------------------- backend
 
-    The host image pins ``JAX_PLATFORMS=axon`` (tunneled TPU). If that backend is
-    down, ``jax.devices()`` either raises or hangs — so probe it in a subprocess with
-    a bounded retry, and fall back to CPU (with an explicit tag) when it's unusable.
-    The driver must always capture *a* number.
-    """
+
+def _probe_once(timeout_s: int = 90):
     probe = "import jax; d = jax.devices(); print(d[0].platform)"
-    for attempt in range(2):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=120,
-            )
-            if out.returncode == 0 and out.stdout.strip():
-                return out.stdout.strip().splitlines()[-1]
-        except subprocess.TimeoutExpired:
-            break  # a hang is not transient — don't burn another 120s on a retry
-        if attempt == 0:
-            time.sleep(5)
-    # TPU relay wedged: force the virtual CPU path for the whole process
-    from _jax_cpu_force import force_cpu
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True, timeout=timeout_s
+        )
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip().splitlines()[-1]
+    except subprocess.TimeoutExpired:
+        pass
+    return None
 
-    force_cpu(1)
+
+def _acquire_backend() -> str:
+    """Probe the pinned backend with retry+backoff *now* (bench time), then fall back.
+
+    Round-1/2 postmortem: a single early probe that never re-checks turned one transient
+    tunnel outage into a whole round of CPU numbers. Three probes spread over ~3 minutes
+    is cheap insurance against a relay that is restarting.
+    """
+    for wait in (0, 45, 90):
+        if wait:
+            time.sleep(wait)
+        platform = _probe_once()
+        if platform:
+            return platform
+    # JAX is deliberately NOT initialised in the main process on fallback — the
+    # worker subprocesses each pin their own device count (1 vs 8)
     return "cpu-fallback"
 
 
-def bench_ours() -> float:
-    """Idiomatic TPU hot loop: the whole step-stream folds through `lax.scan` inside one
-    jitted program (metric update fused into the step, zero marginal host dispatch)."""
-    import jax
-    import jax.numpy as jnp
-
-    from torchmetrics_tpu.classification import MulticlassAccuracy
-
-    rng = np.random.RandomState(0)
-    # pre-staged stream of STEPS batches (leading axis = steps)
-    preds = jnp.asarray(rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32))
-    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (STEPS, BATCH)))
-
-    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
-
-    @jax.jit
-    def run_epoch(state, preds, target):
-        state = metric.scan_update(state, preds, target)
-        return metric.pure_compute(state), state
-
-    value, state = run_epoch(metric.init_state(), preds, target)  # compile + warmup
-    jax.block_until_ready(value)
-
-    reps = 3
-    start = time.perf_counter()
-    for _ in range(reps):
-        value, state = run_epoch(metric.init_state(), preds, target)
-        jax.block_until_ready(value)
-    elapsed = time.perf_counter() - start
-    return elapsed / (STEPS * reps) * 1e6  # µs/step
+# ------------------------------------------------------------------- reference setup
 
 
 def _install_lightning_utilities_stub() -> None:
@@ -155,80 +137,426 @@ def _install_lightning_utilities_stub() -> None:
     sys.modules["lightning_utilities.core.apply_func"] = apply_mod
 
 
-def bench_reference() -> float:
-    try:
-        import torch
-
-        _install_lightning_utilities_stub()
+def _reference_modules():
+    """Import the reference TorchMetrics from /root/reference (torch CPU)."""
+    _install_lightning_utilities_stub()
+    if "/root/reference/src" not in sys.path:
         sys.path.insert(0, "/root/reference/src")
-        from torchmetrics.classification import MulticlassAccuracy as TorchMulticlassAccuracy
+    import torchmetrics  # noqa: F401
 
-        rng = np.random.RandomState(0)
-        preds = torch.from_numpy(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
-        target = torch.from_numpy(rng.randint(0, NUM_CLASSES, (BATCH,)))
-
-        metric = TorchMulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
-        for _ in range(WARMUP):
-            metric.update(preds, target)
-        metric.compute()
-        metric.reset()
-
-        start = time.perf_counter()
-        for _ in range(STEPS):
-            metric.update(preds, target)
-        metric.compute()
-        elapsed = time.perf_counter() - start
-        return elapsed / STEPS * 1e6
-    except Exception:
-        return float("nan")
+    return torchmetrics
 
 
-def bench_inception(batch: int = 64, iters: int = 5) -> float:
-    """FID-path Inception-v3 feature extraction throughput (BASELINE.md config #3).
+# ------------------------------------------------------------------------ our configs
+
+
+def _stage_data():
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(STEPS, BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (STEPS, BATCH)))
+    return preds, target
+
+
+def bench_acc_stateful(preds, target) -> float:
+    """Config #1: per-step stateful ``metric.update`` loop + one ``compute``.
+
+    This is the same call pattern a user writes and the same pattern the reference
+    baseline runs eagerly: one update per step, jit-cached dispatch per call.
+    """
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    # pre-split batches: slicing the stacked stream inside the loop would charge a
+    # per-step device copy that the eager reference baseline never pays
+    n_distinct = 8
+    batches = [(preds[i], target[i]) for i in range(n_distinct)]
+    jax.block_until_ready(batches)
+    metric.update(*batches[0])
+    jax.block_until_ready(metric.compute())
+    metric.reset()
+
+    start = time.perf_counter()
+    for i in range(STEPS):
+        p, t = batches[i % n_distinct]
+        metric.update(p, t)
+    jax.block_until_ready(metric.compute())
+    elapsed = time.perf_counter() - start
+    return elapsed / STEPS * 1e6
+
+
+def bench_acc_scan(preds, target) -> float:
+    """Config #2: whole epoch folded through ``lax.scan`` in ONE XLA program."""
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+    @jax.jit
+    def run_epoch(state, preds, target):
+        state = metric.scan_update(state, preds, target)
+        return metric.pure_compute(state), state
+
+    value, _ = run_epoch(metric.init_state(), preds, target)
+    jax.block_until_ready(value)
+
+    reps = 3
+    start = time.perf_counter()
+    for _ in range(reps):
+        value, _ = run_epoch(metric.init_state(), preds, target)
+        jax.block_until_ready(value)
+    elapsed = time.perf_counter() - start
+    return elapsed / (STEPS * reps) * 1e6
+
+
+def bench_collection_mesh_sync() -> float:
+    """Config #3: Accuracy+F1+AUROC update & mesh sync per step (BASELINE.md config 2).
+
+    Jitted shard_map step over every available device: per-shard pure updates of the
+    two compute groups (stat-scores shared by Acc/F1; binned-curve for AUROC) + psum
+    sync — the production distributed pattern. The reference baseline runs the same
+    three metrics eagerly WITHOUT any sync (its DDP needs a process group we can't
+    spawn here), so its number is a lower bound for the reference.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+
+    n_classes = 10
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("data",))
+    n_dev = len(devices)
+    per_step = 1024 * n_dev
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(per_step, n_classes).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, n_classes, (per_step,)))
+
+    acc = MulticlassAccuracy(num_classes=n_classes, average="macro", validate_args=False)
+    f1 = MulticlassF1Score(num_classes=n_classes, average="macro", validate_args=False)
+    auroc = MulticlassAUROC(num_classes=n_classes, thresholds=100, validate_args=False)
+
+    def step(states, p, t):
+        s_stat, s_curve = states
+        # Acc and F1 share one stat-scores state (what MetricCollection's compute
+        # groups dedup to); AUROC keeps the binned-curve state.
+        s_stat = acc.pure_update(s_stat, p, t)
+        s_curve = auroc.pure_update(s_curve, p, t)
+        sy_stat = acc.sync_state(s_stat, axis_name="data")
+        sy_curve = auroc.sync_state(s_curve, axis_name="data")
+        vals = (acc.pure_compute(sy_stat), f1.pure_compute(sy_stat), auroc.pure_compute(sy_curve))
+        return (s_stat, s_curve), vals
+
+    f = jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=((P(), P()), P("data"), P("data")),
+            out_specs=((P(), P()), (P(), P(), P())),
+            check_vma=False,
+        )
+    )
+    states = (acc.init_state(), auroc.init_state())
+    states, vals = f(states, preds, target)
+    jax.block_until_ready(vals)
+
+    iters = 50
+    start = time.perf_counter()
+    for _ in range(iters):
+        states, vals = f(states, preds, target)
+    jax.block_until_ready(vals)
+    return (time.perf_counter() - start) / iters * 1e6
+
+
+def bench_pr_curve() -> float:
+    """Config #5-ish: binned multiclass PR-curve, 50 update steps + compute (ms total)."""
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+
+    import jax.numpy as jnp
+
+    n_classes = 10
+    steps = 50
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(steps, BATCH, n_classes).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, n_classes, (steps, BATCH)))
+
+    metric = MulticlassPrecisionRecallCurve(num_classes=n_classes, thresholds=200, validate_args=False)
+
+    @jax.jit
+    def run(state, preds, target):
+        state = metric.scan_update(state, preds, target)
+        return metric.pure_compute(state)
+
+    out = run(metric.init_state(), preds, target)
+    jax.block_until_ready(out)
+    reps = 3
+    start = time.perf_counter()
+    for _ in range(reps):
+        jax.block_until_ready(run(metric.init_state(), preds, target))
+    return (time.perf_counter() - start) / reps * 1e3
+
+
+def bench_inception(hardware: str) -> float:
+    """Config #4: FID-path Inception-v3 feature extraction throughput (imgs/sec).
 
     Random weights — identical FLOPs/layout to the pretrained net, so imgs/sec is
-    representative even though scores would not be.
+    representative even though scores would not be. Smaller batch on the CPU fallback
+    so the config is never silently skipped.
     """
-    import time as _time
     import warnings
 
     import jax.numpy as jnp
 
     from torchmetrics_tpu.image._inception_net import InceptionFeatureExtractor
 
+    on_cpu = hardware.startswith("cpu")
+    batch, iters = (8, 2) if on_cpu else (64, 5)
+
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         ext = InceptionFeatureExtractor(feature=2048)
     imgs = jnp.zeros((batch, 3, 299, 299), dtype=jnp.uint8)
     ext(imgs).block_until_ready()  # compile
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     for _ in range(iters):
         out = ext(imgs)
     out.block_until_ready()
-    return batch * iters / (_time.perf_counter() - t0)
+    return batch * iters / (time.perf_counter() - t0)
+
+
+# ------------------------------------------------------------------ reference configs
+
+
+def ref_acc_stateful() -> float:
+    import torch
+
+    from torchmetrics.classification import MulticlassAccuracy as TMAcc
+
+    rng = np.random.RandomState(0)
+    preds = torch.from_numpy(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, (BATCH,)))
+    metric = TMAcc(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    for _ in range(10):
+        metric.update(preds, target)
+    metric.compute()
+    metric.reset()
+    start = time.perf_counter()
+    for _ in range(STEPS):
+        metric.update(preds, target)
+    metric.compute()
+    return (time.perf_counter() - start) / STEPS * 1e6
+
+
+def ref_collection() -> float:
+    import torch
+
+    from torchmetrics import MetricCollection
+    from torchmetrics.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+
+    n_classes = 10
+    n_dev = 8  # match the per-step element count of our mesh config
+    per_step = 1024 * n_dev
+    rng = np.random.RandomState(0)
+    preds = torch.from_numpy(rng.rand(per_step, n_classes).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, n_classes, (per_step,)))
+    col = MetricCollection([
+        MulticlassAccuracy(num_classes=n_classes, average="macro", validate_args=False),
+        MulticlassF1Score(num_classes=n_classes, average="macro", validate_args=False),
+        MulticlassAUROC(num_classes=n_classes, thresholds=100, validate_args=False),
+    ])
+    for _ in range(3):
+        col.update(preds, target)
+    col.compute()
+    col.reset()
+    iters = 50
+    start = time.perf_counter()
+    for _ in range(iters):
+        col.update(preds, target)
+        col.compute()
+    return (time.perf_counter() - start) / iters * 1e6
+
+
+def ref_pr_curve() -> float:
+    import torch
+
+    from torchmetrics.classification import MulticlassPrecisionRecallCurve as TMCurve
+
+    n_classes = 10
+    steps = 50
+    rng = np.random.RandomState(0)
+    preds = torch.from_numpy(rng.rand(steps, BATCH, n_classes).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, n_classes, (steps, BATCH)))
+    metric = TMCurve(num_classes=n_classes, thresholds=200, validate_args=False)
+    metric.update(preds[0], target[0])
+    metric.compute()
+    metric.reset()
+    start = time.perf_counter()
+    for i in range(steps):
+        metric.update(preds[i], target[i])
+    metric.compute()
+    return (time.perf_counter() - start) * 1e3
+
+
+# ------------------------------------------------------------------------------ main
+
+
+def _safe(fn, *args):
+    try:
+        return fn(*args)
+    except Exception as err:  # never break the one-line contract
+        sys.stderr.write(f"bench config {fn.__name__} failed: {err!r}\n")
+        return None
+
+
+def _run_ours(hardware: str) -> dict:
+    """Measure our configs in THIS process (backend already chosen)."""
+    preds, target = _stage_data()
+    return {
+        "stateful": _safe(bench_acc_stateful, preds, target),
+        "scan": _safe(bench_acc_scan, preds, target),
+        "collection": _safe(bench_collection_mesh_sync),
+        "curve": _safe(bench_pr_curve),
+        "inception": _safe(bench_inception, hardware),
+    }
+
+
+def _worker_main(mode: str) -> None:
+    """Subprocess entry: emit one JSON dict of raw config values on stdout.
+
+    The CPU fallback must NOT run the single-chip configs on the 8-virtual-device
+    mesh — on a small host the extra device threads oversubscribe the cores and the
+    numbers measure contention, not the kernels (this polluted BENCH_r02). Single-chip
+    configs get a 1-device process; only the mesh config gets the 8-device process.
+    """
+    from _jax_cpu_force import force_cpu
+
+    def _min_merge(acc: dict, new: dict) -> None:
+        for k, v in new.items():
+            if v is not None and (acc.get(k) is None or v < acc[k]):
+                acc[k] = v
+
+    out: dict = {}
+    if mode == "single":
+        force_cpu(1)
+        preds, target = _stage_data()
+        _safe(_reference_modules)
+        # interleave ours/reference rounds and keep per-config minima: a shared/noisy
+        # host drifts ±30% between runs, which biased BENCH_r02 — alternating rounds
+        # in one process exposes both sides to the same drift
+        for _ in range(3):
+            _min_merge(out, {
+                "stateful": _safe(bench_acc_stateful, preds, target),
+                "ref_stateful": _safe(ref_acc_stateful),
+                "scan": _safe(bench_acc_scan, preds, target),
+                "curve": _safe(bench_pr_curve),
+                "ref_curve": _safe(ref_pr_curve),
+            })
+        _min_merge(out, {"inception": _safe(bench_inception, "cpu-fallback")})
+    elif mode == "mesh":
+        force_cpu(8)
+        _safe(_reference_modules)
+        for _ in range(2):
+            _min_merge(out, {
+                "collection": _safe(bench_collection_mesh_sync),
+                "ref_collection": _safe(ref_collection),
+            })
+    print(json.dumps(out))
+
+
+def _run_fallback_via_workers() -> dict:
+    """Run the config suite split across 1-device and 8-device CPU subprocesses."""
+    merged: dict = {}
+    for mode in ("single", "mesh"):
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--worker", mode],
+                capture_output=True, text=True, timeout=1200,
+            )
+            if proc.returncode == 0 and proc.stdout.strip():
+                merged.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+            else:
+                sys.stderr.write(f"bench worker {mode} rc={proc.returncode}: {proc.stderr[-500:]}\n")
+        except Exception as err:
+            sys.stderr.write(f"bench worker {mode} failed: {err!r}\n")
+    return merged
 
 
 def main() -> None:
-    hardware = _probe_backend()
-    ours_us = bench_ours()
-    ref_us = bench_reference()
-    baseline_ok = ours_us > 0 and ref_us == ref_us
-    result = {
-        "metric": "MulticlassAccuracy update+compute (4096x100, 200 steps)",
-        "value": round(ours_us, 2),
-        "unit": "us/step",
-        # null (not 1.0) when the reference baseline could not be measured
-        "vs_baseline": round(ref_us / ours_us, 3) if baseline_ok else None,
-        "hardware": hardware,
+    hardware = _acquire_backend()
+    if hardware == "cpu-fallback":
+        ours = _run_fallback_via_workers()
+        # reference numbers come interleaved from the same worker processes
+        ref_stateful = ours.get("ref_stateful")
+        ref_col = ours.get("ref_collection")
+        ref_curve = ours.get("ref_curve")
+    else:
+        ours = _run_ours(hardware)
+        _safe(_reference_modules)
+        ref_stateful = _safe(ref_acc_stateful)
+        ref_col = _safe(ref_collection)
+        ref_curve = _safe(ref_pr_curve)
+    ours_stateful = ours.get("stateful")
+    ours_scan = ours.get("scan")
+    ours_collection = ours.get("collection")
+    ours_curve = ours.get("curve")
+    ours_incep = ours.get("inception")
+
+    def ratio(ref, ours):
+        if ref is None or ours is None or ours <= 0:
+            return None
+        return round(ref / ours, 3)
+
+    configs = {
+        "acc_update_stateful": {
+            "value": ours_stateful, "unit": "us/step", "baseline": ref_stateful,
+            "vs_baseline": ratio(ref_stateful, ours_stateful),
+        },
+        "acc_update_scan": {
+            "value": ours_scan, "unit": "us/step", "baseline": ref_stateful,
+            "vs_baseline": ratio(ref_stateful, ours_scan),
+        },
+        "collection_acc_f1_auroc_mesh_sync": {
+            "value": ours_collection, "unit": "us/step", "baseline": ref_col,
+            "vs_baseline": ratio(ref_col, ours_collection),
+            "note": "ours includes mesh sync every step; reference baseline is eager update+compute without any DDP sync",
+        },
+        "pr_curve_binned_50x4096": {
+            "value": ours_curve, "unit": "ms/epoch", "baseline": ref_curve,
+            "vs_baseline": ratio(ref_curve, ours_curve),
+        },
+        "inception_v3_features": {
+            "value": ours_incep, "unit": "imgs/sec", "baseline": None, "vs_baseline": None,
+            "note": "reference needs torch-fidelity weights (not installed); FLOPs-identical random-weight net",
+        },
     }
-    if not hardware.startswith("cpu"):
-        # secondary headline (too slow to be worth timing on the CPU fallback)
-        try:
-            result["extra"] = {"inception_imgs_per_sec_chip": round(bench_inception(), 1)}
-        except Exception:
-            pass  # never break the one-line contract
+    for cfg in configs.values():
+        if isinstance(cfg.get("value"), float):
+            cfg["value"] = round(cfg["value"], 2)
+        if isinstance(cfg.get("baseline"), float):
+            cfg["baseline"] = round(cfg["baseline"], 2)
+
+    result = {
+        "metric": "MulticlassAccuracy per-step update+compute (4096x100, 200 steps)",
+        "value": round(ours_stateful, 2) if ours_stateful else None,
+        "unit": "us/step",
+        "vs_baseline": ratio(ref_stateful, ours_stateful),
+        "hardware": hardware,
+        "configs": configs,
+    }
     print(json.dumps(result))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        _worker_main(sys.argv[2])
+    else:
+        main()

@@ -77,13 +77,27 @@ class TestComputeGroups:
     def test_static_groups_merge_stat_scores(self):
         col = MetricCollection(
             [
-                MulticlassAccuracy(NUM_CLASSES, average="micro"),
+                MulticlassAccuracy(NUM_CLASSES, average="weighted"),
                 MulticlassPrecision(NUM_CLASSES, average="macro"),
                 MulticlassRecall(NUM_CLASSES, average="macro"),
             ]
         )
         groups = col.compute_groups
         assert len(groups) == 1, f"expected one merged group, got {groups}"
+
+    def test_micro_scalar_state_gets_own_group(self):
+        # micro+top_k=1 keeps scalar states (update fast path), so it must NOT share
+        # a compute group with per-class metrics — matches the reference, where the
+        # state-equality merge also keeps scalar and [C] states apart
+        col = MetricCollection(
+            [
+                MulticlassAccuracy(NUM_CLASSES, average="micro"),
+                MulticlassPrecision(NUM_CLASSES, average="macro"),
+                MulticlassRecall(NUM_CLASSES, average="macro"),
+            ]
+        )
+        groups = col.compute_groups
+        assert len(groups) == 2, f"expected micro to split off, got {groups}"
 
     def test_different_params_do_not_merge(self):
         col = MetricCollection(

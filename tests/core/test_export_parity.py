@@ -1,0 +1,46 @@
+"""Top-level API parity: every reference ``torchmetrics.__all__`` name must resolve.
+
+Parity: ``/root/reference/src/torchmetrics/__init__.py`` (103 ``__all__`` names) —
+checked programmatically against the reference source so drift is caught even if the
+reference file changes (VERDICT missing item #5).
+"""
+
+from __future__ import annotations
+
+import ast
+
+import pytest
+
+import torchmetrics_tpu as tm
+
+_REFERENCE_INIT = "/root/reference/src/torchmetrics/__init__.py"
+
+
+def _reference_all() -> list:
+    with open(_REFERENCE_INIT) as fh:
+        tree = ast.parse(fh.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(getattr(t, "id", None) == "__all__" for t in node.targets):
+            return ast.literal_eval(node.value)
+    raise AssertionError("reference __all__ not found")
+
+
+def test_top_level_all_is_superset():
+    ref = set(_reference_all())
+    ours = set(tm.__all__)
+    missing = sorted(ref - ours)
+    assert not missing, f"top-level __all__ missing reference names: {missing}"
+
+
+def test_top_level_names_resolve():
+    for name in _reference_all():
+        assert hasattr(tm, name), f"`from torchmetrics_tpu import {name}` would fail"
+
+
+def test_all_names_are_importable():
+    dangling = [name for name in tm.__all__ if not hasattr(tm, name)]
+    assert not dangling, f"__all__ names without attributes: {dangling}"
+
+
+def test_metric_collection_has_plot():
+    assert callable(getattr(tm.MetricCollection, "plot", None))

@@ -23,8 +23,17 @@ from torchmetrics_tpu.parallel.reductions import Reduction
 
 
 def _fake_allgather(x, tiled=False):
-    """Two-host world: host 0 holds ``x``, host 1 holds ``x + 1`` (same shape)."""
+    """Two-host world: host 0 holds ``x``, host 1 holds ``x + 1`` (same shape).
+
+    The ragged-CAT protocol first exchanges int32 sizes — echo those unchanged on
+    both hosts so the simulated world stays shape-consistent; only float payloads
+    get the +1 shift that distinguishes host 1's data.
+    """
     x = jnp.asarray(x)
+    # CAUTION: this heuristic also matches genuine 0-d integer SUM states (e.g. the
+    # scalar micro fast-path counts) — tests syncing those need their own fake
+    if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
+        return jnp.stack([x, x])  # size exchange: both hosts report the same length
     other = x + jnp.ones((), dtype=x.dtype)
     gathered = jnp.stack([x, other])
     return gathered
@@ -65,9 +74,45 @@ class TestMultihostSyncState:
         # list pre-cats to [1, 2] locally; host 1 contributes [2, 3]
         _assert_allclose(out["parts"], [1.0, 2.0, 2.0, 3.0], atol=0)
 
-    def test_empty_list_state_passthrough(self, two_host_world):
+    def test_empty_list_state_still_enters_collective(self, monkeypatch):
+        """A rank with no data must still run the collective (VERDICT missing #6).
+
+        Simulated world: this host has 0 rows, the other host has 3 — the protocol
+        must exchange sizes, pad, gather, and hand the empty rank the peer's rows.
+        """
+        peer_rows = jnp.array([5.0, 6.0, 7.0])
+        calls = []
+
+        def protocol_fake(x, tiled=False):
+            x = jnp.asarray(x)
+            calls.append(x.shape)
+            if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
+                return jnp.stack([x, jnp.asarray(3, dtype=x.dtype)])  # sizes: [0, 3]
+            assert x.shape[0] == 3, "local leaf should be padded to the world max"
+            return jnp.stack([x, peer_rows.astype(x.dtype)])
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", protocol_fake)
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
         out = sync_mod.sync_state({"parts": []}, {"parts": Reduction.CAT}, axis_name=None)
-        assert out["parts"] == []
+        _assert_allclose(out["parts"], [5.0, 6.0, 7.0], atol=0)
+        assert len(calls) == 2, "empty rank must enter both collectives (size + data)"
+
+    def test_ragged_list_state_multihost(self, monkeypatch):
+        """Hosts with different row counts concatenate to sizes' sum, not 2*max."""
+
+        def protocol_fake(x, tiled=False):
+            x = jnp.asarray(x)
+            if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
+                return jnp.stack([x, jnp.asarray(1, dtype=x.dtype)])  # peer has 1 row
+            return jnp.stack([x, jnp.full_like(x, 9.0)])
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", protocol_fake)
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        out = sync_mod.sync_state(
+            {"parts": [jnp.array([1.0, 2.0])]}, {"parts": Reduction.CAT}, axis_name=None
+        )
+        # local 2 rows + peer trimmed to its true 1 row
+        _assert_allclose(out["parts"], [1.0, 2.0, 9.0], atol=0)
 
     def test_masked_buffer_state(self, two_host_world):
         buf = MaskedBuffer.create(4).append(jnp.array([1.0, 2.0]))

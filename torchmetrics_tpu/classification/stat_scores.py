@@ -174,12 +174,19 @@ class MulticlassStatScores(_AbstractStatScores):
         self.multidim_average = multidim_average
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self._create_state(size=num_classes, multidim_average=multidim_average)
+        # micro+top_k=1 keeps scalar states (reference ``stat_scores.py:332-334``): the
+        # update fast path never builds per-class counts
+        self._create_state(
+            size=1 if (average == "micro" and top_k == 1) else num_classes,
+            multidim_average=multidim_average,
+        )
 
     def _compute_group_params(self):
-        # `average` only affects compute (states are always per-class), so metrics
-        # differing only in average share one group
-        return (self.num_classes, self.top_k, self.multidim_average, self.ignore_index)
+        # `average` only affects compute for the per-class layouts, but the global
+        # micro+top_k=1 fast path switches to scalar states, so it must not share a
+        # group with per-class metrics (samplewise micro keeps [N, C] lists and merges)
+        is_scalar_micro = self.average == "micro" and self.top_k == 1 and self.multidim_average == "global"
+        return (self.num_classes, self.top_k, self.multidim_average, self.ignore_index, is_scalar_micro)
 
     def update(self, preds: Array, target: Array) -> None:
         """Update tp/fp/tn/fn with a batch."""

@@ -18,6 +18,12 @@ from torchmetrics_tpu.utils.data import safe_divide
 Array = jax.Array
 
 
+def _micro_sum(x: Array, multidim_average: str) -> Array:
+    """Collapse counts for micro averaging; global states may already be 0-d scalars
+    (the multiclass micro fast path keeps scalar states, never per-class vectors)."""
+    return jnp.sum(x) if multidim_average == "global" else x.sum(axis=-1)
+
+
 def _adjust_weights_safe_divide(
     score: Array,
     average: Optional[str],
@@ -57,11 +63,11 @@ def _accuracy_reduce(
     if average == "binary":
         return safe_divide(tp + tn, tp + tn + fp + fn)
     if average == "micro":
-        tp = tp.sum(axis=0 if multidim_average == "global" else -1)
-        fn = fn.sum(axis=0 if multidim_average == "global" else -1)
+        tp = _micro_sum(tp, multidim_average)
+        fn = _micro_sum(fn, multidim_average)
         if multilabel:
-            fp = fp.sum(axis=0 if multidim_average == "global" else -1)
-            tn = tn.sum(axis=0 if multidim_average == "global" else -1)
+            fp = _micro_sum(fp, multidim_average)
+            tn = _micro_sum(tn, multidim_average)
             return safe_divide(tp + tn, tp + tn + fp + fn)
         return safe_divide(tp, tp + fn)
     score = safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else safe_divide(tp, tp + fn)
@@ -84,8 +90,8 @@ def _precision_recall_reduce(
     if average == "binary":
         return safe_divide(tp, tp + different_stat, zero_division)
     if average == "micro":
-        tp = tp.sum(axis=0 if multidim_average == "global" else -1)
-        different_stat = different_stat.sum(axis=0 if multidim_average == "global" else -1)
+        tp = _micro_sum(tp, multidim_average)
+        different_stat = _micro_sum(different_stat, multidim_average)
         return safe_divide(tp, tp + different_stat, zero_division)
     score = safe_divide(tp, tp + different_stat, zero_division)
     return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k)
@@ -106,10 +112,9 @@ def _fbeta_reduce(
     if average == "binary":
         return safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
     if average == "micro":
-        sum_axis = 0 if multidim_average == "global" else -1
-        tp = tp.sum(axis=sum_axis)
-        fn = fn.sum(axis=sum_axis)
-        fp = fp.sum(axis=sum_axis)
+        tp = _micro_sum(tp, multidim_average)
+        fn = _micro_sum(fn, multidim_average)
+        fp = _micro_sum(fp, multidim_average)
         return safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
     fbeta_score = safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
     return _adjust_weights_safe_divide(fbeta_score, average, multilabel, tp, fp, fn)
@@ -127,9 +132,8 @@ def _specificity_reduce(
     if average == "binary":
         return safe_divide(tn, tn + fp)
     if average == "micro":
-        sum_axis = 0 if multidim_average == "global" else -1
-        tn = tn.sum(axis=sum_axis)
-        fp = fp.sum(axis=sum_axis)
+        tn = _micro_sum(tn, multidim_average)
+        fp = _micro_sum(fp, multidim_average)
         return safe_divide(tn, tn + fp)
     specificity_score = safe_divide(tn, tn + fp)
     return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn)
@@ -148,12 +152,11 @@ def _hamming_distance_reduce(
     if average == "binary":
         return 1 - safe_divide(tp + tn, tp + tn + fp + fn)
     if average == "micro":
-        sum_axis = 0 if multidim_average == "global" else -1
-        tp = tp.sum(axis=sum_axis)
-        fn = fn.sum(axis=sum_axis)
+        tp = _micro_sum(tp, multidim_average)
+        fn = _micro_sum(fn, multidim_average)
         if multilabel:
-            fp = fp.sum(axis=sum_axis)
-            tn = tn.sum(axis=sum_axis)
+            fp = _micro_sum(fp, multidim_average)
+            tn = _micro_sum(tn, multidim_average)
             return 1 - safe_divide(tp + tn, tp + tn + fp + fn)
         return 1 - safe_divide(tp, tp + fn)
     score = safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else safe_divide(tp, tp + fn)

@@ -22,7 +22,7 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _multiclass_confusion_matrix_tensor_validation,
 )
 from torchmetrics_tpu.functional.classification.precision_recall_curve import _maybe_softmax
-from torchmetrics_tpu.utils.data import safe_divide
+from torchmetrics_tpu.utils.data import first_argmax, safe_divide
 from torchmetrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 Array = jax.Array
@@ -117,7 +117,7 @@ def _multiclass_calibration_error_update(
     """Confidence = max softmax probability; accuracy = argmax == target."""
     preds = _maybe_softmax(preds, axis=-1)
     confidences = jnp.max(preds, axis=-1).astype(jnp.float32)
-    accuracies = (jnp.argmax(preds, axis=-1).astype(jnp.int32) == target).astype(jnp.float32)
+    accuracies = (first_argmax(preds, axis=-1).astype(jnp.int32) == target).astype(jnp.float32)
     return confidences, accuracies, valid
 
 

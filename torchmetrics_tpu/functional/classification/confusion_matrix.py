@@ -20,6 +20,7 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     _is_traced,
     _maybe_apply_sigmoid,
 )
+from torchmetrics_tpu.utils.data import first_argmax
 from torchmetrics_tpu.utils.enums import ClassificationTask
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -220,7 +221,7 @@ def _multiclass_confusion_matrix_format(
 ) -> Tuple[Array, Array, Array]:
     """Argmax score inputs and flatten; returns preds/target/valid of shape [N]."""
     if preds.ndim == target.ndim + 1 and convert_to_labels:
-        preds = jnp.argmax(preds, axis=1)
+        preds = first_argmax(preds, axis=1)
     if convert_to_labels:
         preds = preds.reshape(-1).astype(jnp.int32)
     else:

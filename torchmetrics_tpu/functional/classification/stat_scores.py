@@ -23,7 +23,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.utils.data import _bincount, select_topk
+from torchmetrics_tpu.utils.data import _bincount, first_argmax, select_topk
 from torchmetrics_tpu.utils.enums import ClassificationTask
 
 Array = jax.Array
@@ -249,7 +249,7 @@ def _multiclass_stat_scores_format(
 ) -> Tuple[Array, Array]:
     """Argmax score inputs (top_k=1) and flatten extra dims: preds [N,X] or [N,C,X]."""
     if preds.ndim == target.ndim + 1 and top_k == 1:
-        preds = jnp.argmax(preds, axis=1)
+        preds = first_argmax(preds, axis=1)
     if top_k != 1:
         preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
     else:
@@ -273,6 +273,19 @@ def _multiclass_stat_scores_update(
     """
     valid = jnp.ones_like(target, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
     target_safe = jnp.where(valid, target, 0).astype(jnp.int32)
+
+    if average == "micro" and top_k == 1 and multidim_average == "global":
+        # Micro fast path (reference ``stat_scores.py:394-404``): scalar counts from a
+        # single equality compare — no [N,C] one-hots, no C×C contraction. This is the
+        # per-step hot loop for MulticlassAccuracy(average="micro") and friends.
+        agree = (preds == target_safe) & valid
+        disagree = (preds != target_safe) & valid
+        tp = jnp.sum(agree).astype(jnp.int32)
+        fp = jnp.sum(disagree).astype(jnp.int32)
+        fn = fp
+        n_valid = jnp.sum(valid).astype(jnp.int32)
+        tn = num_classes * n_valid - (tp + fp + fn)
+        return tp, fp, tn, fn
 
     if multidim_average == "samplewise" or top_k != 1:
         if top_k > 1:

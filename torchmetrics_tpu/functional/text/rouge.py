@@ -1,18 +1,26 @@
-"""ROUGE score.
+"""ROUGE score (ROUGE-N / ROUGE-L / ROUGE-Lsum).
 
-Parity: reference ``src/torchmetrics/functional/text/rouge.py`` (LCS machinery
-``:101-164``, normalization ``:166-200``, rouge-n/l/lsum ``:203-287``, update
-``:289-400``, compute ``:403-417``, public fn ``:420-524``).
+Behavior parity: reference ``src/torchmetrics/functional/text/rouge.py`` (public
+surface and scores only). The machinery here is an independent, array-first design:
+
+- tokens are interned to integer ids once per sample; every scorer works on
+  ``np.ndarray`` ids, not token strings;
+- ROUGE-N counts n-gram overlap with a single ``np.unique`` over stacked
+  sliding-window views (no Counter-of-tuples);
+- ROUGE-L length uses Hyyrö's bit-parallel LCS recurrence (one machine-word op row
+  per target token via Python big-ints) instead of the O(n·m) table;
+- ROUGE-Lsum builds its union alignments from a cummax-vectorised DP (one
+  ``np.maximum.accumulate`` per row) with a target-major greedy backtrack.
 """
 
 from __future__ import annotations
 
 import re
-from collections import Counter
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchmetrics_tpu.utils.imports import _NLTK_AVAILABLE
 
@@ -33,6 +41,11 @@ ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
 }
 ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
+_STAT_NAMES = ("precision", "recall", "fmeasure")
+
+
+# ------------------------------------------------------------------ text preparation
+
 
 def _split_sentence(x: str) -> Sequence[str]:
     """Sentence-split for rougeLsum (requires nltk's punkt tokenizer)."""
@@ -50,134 +63,203 @@ def _split_sentence(x: str) -> Sequence[str]:
                 "`nltk` resource `punkt` is not available on a disk and cannot be downloaded as a machine is not "
                 "connected to the internet."
             ) from err
-
-    re.sub("<n>", "", x)  # remove pegasus newline char
+    # NOTE: the reference's pegasus-newline strip (`re.sub("<n>", "", x)`) never
+    # assigns its result, so "<n>" survives into scoring there; keep that observable
+    # behavior for exact score parity
     return nltk.sent_tokenize(x)
 
 
-def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:
-    """Precision/recall/F1 from a hit count and sequence lengths."""
-    precision = hits_or_lcs / pred_len
-    recall = hits_or_lcs / target_len
-    if precision == recall == 0.0:
-        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    fmeasure = 2 * precision * recall / (precision + recall)
-    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
+class _TokenInterner:
+    """Per-sample string→int token table so scorers can run on integer arrays."""
+
+    def __init__(self) -> None:
+        self._table: Dict[str, int] = {}
+
+    def encode(self, tokens: Sequence[str]) -> np.ndarray:
+        table = self._table
+        out = np.empty(len(tokens), dtype=np.int64)
+        for k, tok in enumerate(tokens):
+            idx = table.get(tok)
+            if idx is None:
+                idx = len(table)
+                table[tok] = idx
+            out[k] = idx
+        return out
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._table)
 
 
-def _lcs(
-    pred_tokens: Sequence[str], target_tokens: Sequence[str], return_full_table: bool = False
-) -> Union[int, Sequence[Sequence[int]]]:
-    """Longest-common-subsequence length (or the full DP table)."""
-    lcs = [[0] * (len(pred_tokens) + 1) for _ in range(len(target_tokens) + 1)]
-    for i in range(1, len(target_tokens) + 1):
-        for j in range(1, len(pred_tokens) + 1):
-            if target_tokens[i - 1] == pred_tokens[j - 1]:
-                lcs[i][j] = lcs[i - 1][j - 1] + 1
-            else:
-                lcs[i][j] = max(lcs[i - 1][j], lcs[i][j - 1])
-    if return_full_table:
-        return lcs
-    return lcs[-1][-1]
+def _prepare_tokens(
+    text: str,
+    stemmer: Optional[Any],
+    normalizer: Optional[Callable[[str], str]],
+    tokenizer: Optional[Callable[[str], Sequence[str]]],
+) -> List[str]:
+    """Normalise → tokenize → (optionally) stem, dropping empties.
+
+    Defaults follow the rouge-score convention: lowercase, strip non-alphanumerics,
+    whitespace split, Porter-stem only tokens longer than 3 chars.
+    """
+    cleaned = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
+    raw = tokenizer(cleaned) if callable(tokenizer) else cleaned.split()
+    if stemmer is not None:
+        raw = [tok if len(tok) <= 3 else stemmer.stem(tok) for tok in raw]
+    return [tok for tok in raw if isinstance(tok, str) and tok]
 
 
-def _backtracked_lcs(
-    lcs_table: Sequence[Sequence[int]], pred_tokens: Sequence[str], target_tokens: Sequence[str]
-) -> Sequence[int]:
-    """Indices of one LCS alignment in the target sequence."""
-    i = len(pred_tokens)
-    j = len(target_tokens)
-    backtracked_lcs: List[int] = []
+# ------------------------------------------------------------------------- primitives
+
+
+def _prf(overlap: float, pred_total: int, target_total: int) -> np.ndarray:
+    """[precision, recall, fmeasure] from an overlap count and the two totals."""
+    p = overlap / pred_total if pred_total else 0.0
+    r = overlap / target_total if target_total else 0.0
+    f = 2.0 * p * r / (p + r) if (p or r) else 0.0
+    return np.array([p, r, f], dtype=np.float64)
+
+
+def _ngram_windows(ids: np.ndarray, n: int) -> np.ndarray:
+    """All length-``n`` windows of ``ids`` as an [count, n] view."""
+    if len(ids) < n:
+        return np.empty((0, n), dtype=ids.dtype)
+    return np.lib.stride_tricks.sliding_window_view(ids, n)
+
+
+def _score_ngram(pred_ids: np.ndarray, target_ids: np.ndarray, n: int) -> np.ndarray:
+    """ROUGE-N: clipped n-gram overlap counted via one unique() over both sides."""
+    pw = _ngram_windows(pred_ids, n)
+    tw = _ngram_windows(target_ids, n)
+    if len(pw) == 0 or len(tw) == 0:
+        return np.zeros(3)
+    _, inverse = np.unique(np.concatenate([pw, tw]), axis=0, return_inverse=True)
+    n_kinds = int(inverse.max()) + 1
+    from_pred = np.bincount(inverse[: len(pw)], minlength=n_kinds)
+    from_target = np.bincount(inverse[len(pw):], minlength=n_kinds)
+    overlap = int(np.minimum(from_pred, from_target).sum())
+    return _prf(overlap, len(pw), len(tw))
+
+
+def _lcs_length(pred_ids: np.ndarray, target_ids: np.ndarray) -> int:
+    """Bit-parallel LCS length (Hyyrö 2004) — one big-int op chain per target token.
+
+    A set-bit column vector ``v`` tracks non-extension positions over the prediction;
+    after consuming every target token the LCS length is the number of cleared bits.
+    """
+    m = len(pred_ids)
+    if m == 0 or len(target_ids) == 0:
+        return 0
+    position_masks: Dict[int, int] = {}
+    for pos, tok in enumerate(pred_ids.tolist()):
+        position_masks[tok] = position_masks.get(tok, 0) | (1 << pos)
+    full = (1 << m) - 1
+    v = full
+    for tok in target_ids.tolist():
+        u = v & position_masks.get(tok, 0)
+        v = ((v + u) | (v - u)) & full
+    return m - bin(v).count("1")
+
+
+def _lcs_table_rows(target_ids: np.ndarray, pred_ids: np.ndarray) -> np.ndarray:
+    """Full DP table ``L[i, j] = LCS(target[:i], pred[:j])``, one vector op per row.
+
+    Row recurrence: the classic three-way max collapses to a running max because LCS
+    rows are non-decreasing — ``row = cummax(max(prev[1:], prev[:-1] + eq))``.
+    """
+    t_len, p_len = len(target_ids), len(pred_ids)
+    table = np.zeros((t_len + 1, p_len + 1), dtype=np.int32)
+    if t_len == 0 or p_len == 0:
+        return table
+    equal = target_ids[:, None] == pred_ids[None, :]
+    for i in range(1, t_len + 1):
+        prev = table[i - 1]
+        diagonal = prev[:-1] + equal[i - 1]
+        table[i, 1:] = np.maximum.accumulate(np.maximum(prev[1:], diagonal))
+    return table
+
+
+def _aligned_target_positions(target_ids: np.ndarray, pred_ids: np.ndarray) -> List[int]:
+    """Target-side indices of one optimal LCS alignment (target-major backtrack)."""
+    table = _lcs_table_rows(target_ids, pred_ids)
+    picked: List[int] = []
+    i, j = len(target_ids), len(pred_ids)
     while i > 0 and j > 0:
-        if pred_tokens[i - 1] == target_tokens[j - 1]:
-            backtracked_lcs.insert(0, j - 1)
+        if target_ids[i - 1] == pred_ids[j - 1]:
+            picked.append(i - 1)
             i -= 1
             j -= 1
-        elif lcs_table[j][i - 1] > lcs_table[j - 1][i]:
+        elif table[i - 1, j] >= table[i, j - 1]:
             i -= 1
         else:
             j -= 1
-    return backtracked_lcs
+    picked.reverse()
+    return picked
 
 
-def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequence[str]) -> Sequence[str]:
-    """Union-LCS of a target sentence against all prediction sentences (rougeLsum)."""
-
-    def lcs_ind(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> Sequence[int]:
-        lcs_table: Sequence[Sequence[int]] = _lcs(pred_tokens, target_tokens, return_full_table=True)
-        return _backtracked_lcs(lcs_table, pred_tokens, target_tokens)
-
-    lcs_tables = [lcs_ind(pred_tokens, target_tokens) for pred_tokens in pred_tokens_list]
-    union = sorted(set().union(*lcs_tables))
-    return [target_tokens[i] for i in union]
+def _score_lcs(pred_ids: np.ndarray, target_ids: np.ndarray) -> np.ndarray:
+    """ROUGE-L from the bit-parallel LCS length."""
+    if len(pred_ids) == 0 or len(target_ids) == 0:
+        return np.zeros(3)
+    return _prf(_lcs_length(pred_ids, target_ids), len(pred_ids), len(target_ids))
 
 
-def _normalize_and_tokenize_text(
-    text: str,
-    stemmer: Optional[Any] = None,
-    normalizer: Optional[Callable[[str], str]] = None,
-    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
-) -> Sequence[str]:
-    """Lowercase/strip-non-alphanumeric (or custom normalizer), tokenize, optionally stem."""
-    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
-    tokens = tokenizer(text) if callable(tokenizer) else re.split(r"\s+", text)
-    if stemmer:
-        tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
-    return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
+def _score_lcs_union(
+    pred_sentences: List[np.ndarray], target_sentences: List[np.ndarray], vocab_size: int
+) -> np.ndarray:
+    """ROUGE-Lsum: per-target-sentence union alignments, clipped by corpus counts.
 
+    Each matched token only scores while both sides still have unconsumed copies of
+    it — tracked with two bincount vectors over the interned vocabulary.
+    """
+    pred_total = sum(len(s) for s in pred_sentences)
+    target_total = sum(len(s) for s in target_sentences)
+    if pred_total == 0 or target_total == 0:
+        return np.zeros(3)
 
-def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
-    """ROUGE-N precision/recall/F1."""
-
-    def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
-        ngrams: Counter = Counter()
-        for ngram in (tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1)):
-            ngrams[ngram] += 1
-        return ngrams
-
-    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
-    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
-    if 0 in (pred_len, target_len):
-        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
-    return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
-
-
-def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, float]:
-    """ROUGE-L precision/recall/F1 from the LCS."""
-    pred_len, target_len = len(pred), len(target)
-    if 0 in (pred_len, target_len):
-        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    lcs: int = _lcs(pred, target)
-    return _compute_metrics(lcs, pred_len, target_len)
-
-
-def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, float]:
-    """ROUGE-Lsum precision/recall/F1 via per-sentence union LCS."""
-    pred_len = sum(map(len, pred))
-    target_len = sum(map(len, target))
-    if 0 in (pred_len, target_len):
-        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-
-    def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
-        ngrams: Counter = Counter()
-        for sentence in sentences:
-            ngrams.update(sentence)
-        return ngrams
-
-    pred_tokens_count = _get_token_counts(pred)
-    target_tokens_count = _get_token_counts(target)
+    size = max(vocab_size, 1)
+    remaining_pred = np.zeros(size, dtype=np.int64)
+    remaining_target = np.zeros(size, dtype=np.int64)
+    for s in pred_sentences:
+        remaining_pred += np.bincount(s, minlength=size)
+    for s in target_sentences:
+        remaining_target += np.bincount(s, minlength=size)
 
     hits = 0
-    for tgt in target:
-        lcs = _union_lcs(pred, tgt)
-        for token in lcs:
-            if pred_tokens_count[token] > 0 and target_tokens_count[token] > 0:
+    for tgt_sent in target_sentences:
+        union: set = set()
+        for pred_sent in pred_sentences:
+            union.update(_aligned_target_positions(tgt_sent, pred_sent))
+        for pos in sorted(union):
+            tok = int(tgt_sent[pos])
+            if remaining_pred[tok] > 0 and remaining_target[tok] > 0:
                 hits += 1
-                pred_tokens_count[token] -= 1
-                target_tokens_count[token] -= 1
+                remaining_pred[tok] -= 1
+                remaining_target[tok] -= 1
+    return _prf(hits, pred_total, target_total)
 
-    return _compute_metrics(hits, pred_len, target_len)
+
+# --------------------------------------------------------------------- update/compute
+
+
+def _variant_scores(
+    pred_ids: np.ndarray,
+    target_ids: np.ndarray,
+    pred_sent_ids: Optional[List[np.ndarray]],
+    target_sent_ids: Optional[List[np.ndarray]],
+    rouge_keys_values: List[Union[int, str]],
+    vocab_size: int,
+) -> np.ndarray:
+    """[n_keys, 3] (p, r, f) block for one (pred, target-variant) pair."""
+    rows = []
+    for key in rouge_keys_values:
+        if isinstance(key, int):
+            rows.append(_score_ngram(pred_ids, target_ids, key))
+        elif key == "L":
+            rows.append(_score_lcs(pred_ids, target_ids))
+        else:  # "Lsum"
+            rows.append(_score_lcs_union(pred_sent_ids or [], target_sent_ids or [], vocab_size))
+    return np.stack(rows)
 
 
 def _rouge_score_update(
@@ -189,52 +271,53 @@ def _rouge_score_update(
     normalizer: Optional[Callable[[str], str]] = None,
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
 ) -> Dict[Union[int, str], List[Dict[str, float]]]:
-    """Per-sample (best- or avg-accumulated) ROUGE results for the batch."""
-    results: Dict[Union[int, str], List[Dict[str, float]]] = {rouge_key: [] for rouge_key in rouge_keys_values}
+    """Per-sample ROUGE stats, reduced over target variants by ``accumulate``.
 
-    for pred_raw, target_raw in zip(preds, target):
-        result_inner: Dict[Union[int, str], Dict[str, float]] = {rouge_key: {} for rouge_key in rouge_keys_values}
-        result_avg: Dict[Union[int, str], List[Dict[str, float]]] = {rouge_key: [] for rouge_key in rouge_keys_values}
-        list_results = []
-        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
-        if "Lsum" in rouge_keys_values:
-            pred_lsum = [
-                _normalize_and_tokenize_text(pred_sentence, stemmer, normalizer, tokenizer)
-                for pred_sentence in _split_sentence(pred_raw)
+    ``best`` keeps the variant with the highest fmeasure on the *first* requested key;
+    ``avg`` means the (p, r, f) blocks elementwise across variants.
+    """
+    needs_sentences = "Lsum" in rouge_keys_values
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
+
+    for pred_raw, variants_raw in zip(preds, target):
+        interner = _TokenInterner()
+        pred_ids = interner.encode(_prepare_tokens(pred_raw, stemmer, normalizer, tokenizer))
+        pred_sent_ids = (
+            [
+                interner.encode(_prepare_tokens(s, stemmer, normalizer, tokenizer))
+                for s in _split_sentence(pred_raw)
             ]
+            if needs_sentences
+            else None
+        )
 
-        for target_raw_inner in target_raw:
-            tgt = _normalize_and_tokenize_text(target_raw_inner, stemmer, normalizer, tokenizer)
-            if "Lsum" in rouge_keys_values:
-                target_lsum = [
-                    _normalize_and_tokenize_text(tgt_sentence, stemmer, normalizer, tokenizer)
-                    for tgt_sentence in _split_sentence(target_raw_inner)
+        blocks = []
+        for variant_raw in variants_raw:
+            target_ids = interner.encode(_prepare_tokens(variant_raw, stemmer, normalizer, tokenizer))
+            target_sent_ids = (
+                [
+                    interner.encode(_prepare_tokens(s, stemmer, normalizer, tokenizer))
+                    for s in _split_sentence(variant_raw)
                 ]
-
-            for rouge_key in rouge_keys_values:
-                if isinstance(rouge_key, int):
-                    score = _rouge_n_score(pred, tgt, rouge_key)
-                elif rouge_key == "L":
-                    score = _rouge_l_score(pred, tgt)
-                elif rouge_key == "Lsum":
-                    score = _rouge_lsum_score(pred_lsum, target_lsum)
-                result_inner[rouge_key] = score
-                result_avg[rouge_key].append(score)
-            list_results.append(result_inner.copy())
+                if needs_sentences
+                else None
+            )
+            blocks.append(
+                _variant_scores(
+                    pred_ids, target_ids, pred_sent_ids, target_sent_ids, rouge_keys_values, interner.vocab_size
+                )
+            )
+        if not blocks:
+            continue
+        stacked = np.stack(blocks)  # [n_variants, n_keys, 3]
 
         if accumulate == "best":
-            key_curr = rouge_keys_values[0]
-            all_fmeasure = [v[key_curr]["fmeasure"] for v in list_results]
-            highest_idx = int(max(range(len(all_fmeasure)), key=all_fmeasure.__getitem__))
-            for rouge_key in rouge_keys_values:
-                results[rouge_key].append(list_results[highest_idx][rouge_key])
-        elif accumulate == "avg":
-            for rouge_key, metrics in result_avg.items():
-                avg_score = {
-                    _type: float(sum(metric[_type] for metric in metrics)) / len(metrics)
-                    for _type in metrics[0]
-                }
-                results[rouge_key].append(avg_score)
+            sample = stacked[int(np.argmax(stacked[:, 0, 2]))]
+        else:
+            sample = stacked.mean(axis=0)
+
+        for key_idx, key in enumerate(rouge_keys_values):
+            results[key].append({name: float(sample[key_idx, s]) for s, name in enumerate(_STAT_NAMES)})
 
     return results
 
@@ -268,12 +351,13 @@ def rouge_score(
         {'rouge1_fmeasure': 0.75, 'rouge1_precision': 0.75, 'rouge1_recall': 0.75,
          'rougeL_fmeasure': 0.5, 'rougeL_precision': 0.5, 'rougeL_recall': 0.5}
     """
+    if use_stemmer and not _NLTK_AVAILABLE:
+        raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+    stemmer = None
     if use_stemmer:
-        if not _NLTK_AVAILABLE:
-            raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
         import nltk
 
-    stemmer = nltk.stem.porter.PorterStemmer() if use_stemmer else None
+        stemmer = nltk.stem.porter.PorterStemmer()
 
     if accumulate not in ALLOWED_ACCUMULATE_VALUES:
         raise ValueError(
@@ -300,7 +384,7 @@ def rouge_score(
     )
 
     output: Dict[str, List[float]] = {
-        f"rouge{rouge_key}_{tp}": [] for rouge_key in rouge_keys_values for tp in ["fmeasure", "precision", "recall"]
+        f"rouge{rouge_key}_{tp}": [] for rouge_key in rouge_keys_values for tp in _STAT_NAMES
     }
     for rouge_key, metrics in sentence_results.items():
         for metric in metrics:

@@ -79,9 +79,36 @@ def _sync_leaf_in_axis(x: Array, reduction: Reduction, axis_name: str) -> Array:
     raise ValueError(f"Unknown reduction {reduction}")
 
 
+def _allgather_ragged_dim0(x: Array) -> Array:
+    """Concatenate per-host dim-0-ragged arrays across an eager multihost world.
+
+    Protocol mirrors the reference's pad-to-max ragged gather
+    (``utilities/distributed.py:135-147``): exchange sizes, pad dim 0 to the world
+    max, gather, trim each host's slice back to its true length. A host with zero
+    rows still enters both collectives (the reference synthesizes an empty tensor
+    for exactly this, ``metric.py:443-450``) — skipping them would desync the world.
+    Trailing dims must agree across hosts (same constraint as the reference).
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local_size = jnp.asarray(x.shape[0], dtype=jnp.int32)
+    sizes = np.asarray(multihost_utils.process_allgather(local_size, tiled=False)).reshape(-1)
+    max_size = int(sizes.max()) if sizes.size else 0
+    if max_size == 0:
+        return x
+    pad_width = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+    padded = jnp.pad(x, pad_width)
+    gathered = multihost_utils.process_allgather(padded, tiled=False)  # [world, max, ...]
+    pieces = [gathered[i, : int(sizes[i])] for i in range(gathered.shape[0])]
+    return jnp.concatenate(pieces, axis=0)
+
+
 def _sync_leaf_multihost(x: Array, reduction: Reduction) -> Array:
     from jax.experimental import multihost_utils
 
+    if reduction == Reduction.CAT:
+        return _allgather_ragged_dim0(x)
     gathered = multihost_utils.process_allgather(x, tiled=False)  # [world, ...]
     if reduction == Reduction.SUM:
         return jnp.sum(gathered, axis=0)
@@ -91,8 +118,6 @@ def _sync_leaf_multihost(x: Array, reduction: Reduction) -> Array:
         return jnp.max(gathered, axis=0)
     if reduction == Reduction.MIN:
         return jnp.min(gathered, axis=0)
-    if reduction == Reduction.CAT:
-        return jnp.reshape(gathered, (-1,) + gathered.shape[2:])
     if reduction == Reduction.GATHER:
         return gathered  # [world, ...]
     if reduction == Reduction.NONE:
@@ -141,7 +166,16 @@ def sync_state(
             continue
         if isinstance(value, list):
             if not value:
-                out[name] = value
+                if axis_name is None and distributed_available():
+                    # this host saw no data, but the world-wide collective must still
+                    # run on every host: synthesize a zero-length leaf and enter it.
+                    # Same contract (and limitation) as the reference's empty-tensor
+                    # synth (``metric.py:443-450``): the placeholder is 1-D float32,
+                    # so list states with trailing dims or other dtypes need at least
+                    # one local append before a sync (or a custom dist_sync_fn)
+                    out[name] = _sync_leaf_multihost(jnp.zeros((0,), dtype=jnp.float32), red)
+                else:
+                    out[name] = value
                 continue
             value = dim_zero_cat(value)
         if axis_name is not None:

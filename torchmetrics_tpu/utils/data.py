@@ -31,6 +31,43 @@ def dim_zero_cat(x: Union[Array, List[Array], tuple]) -> Array:
     return jnp.concatenate(x, axis=0)
 
 
+def _halving_reduce(x: Array, op) -> Array:
+    """Reduce a power-of-two minor axis by repeated halving.
+
+    XLA:CPU lowers a minor-axis reduce to a scalar per-row loop (~13x slower than its
+    major-axis reduce on [4096, 100] inputs); log2(n) elementwise ops on contiguous
+    half-rows vectorise instead. Shapes are static, so this traces fine under jit.
+    """
+    while x.shape[-1] > 1:
+        half = x.shape[-1] // 2
+        x = op(x[..., :half], x[..., half:])
+    return x[..., 0]
+
+
+def first_argmax(x: Array, axis: int = -1) -> Array:
+    """``jnp.argmax`` (first-max-wins ties) with a fast CPU path for 2D minor-axis.
+
+    On TPU the native argmax reduce runs fine on the VPU; on CPU (including the
+    virtual-device test/fallback mesh) the minor-axis tuple-reduce is pathologically
+    slow, so pad the class axis to a power of two and run two halving trees: max, then
+    min-index-of-max. Tie semantics match ``jnp.argmax`` exactly.
+    """
+    if jax.default_backend() != "cpu" or x.ndim != 2 or axis not in (1, -1) or x.shape[-1] < 2:
+        return jnp.argmax(x, axis=axis)
+    n = x.shape[-1]
+    padded_n = 1
+    while padded_n < n:
+        padded_n *= 2
+    if padded_n != n:
+        fill = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        x = jnp.pad(x, ((0, 0), (0, padded_n - n)), constant_values=fill)
+    row_max = _halving_reduce(x, jnp.maximum)
+    candidates = jnp.where(x == row_max[:, None], jnp.arange(padded_n, dtype=jnp.int32), padded_n)
+    # clamp keeps the result a valid index even for degenerate rows (all-NaN rows have
+    # no self-equal maximum); which in-range index a NaN row maps to is unspecified
+    return jnp.minimum(_halving_reduce(candidates, jnp.minimum), n - 1)
+
+
 def dim_zero_sum(x: Array) -> Array:
     return jnp.sum(x, axis=0)
 
