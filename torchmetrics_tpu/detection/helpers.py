@@ -25,6 +25,7 @@ def _input_validator(
     preds: Sequence[Dict[str, Array]],
     targets: Sequence[Dict[str, Array]],
     ignore_score: bool = False,
+    iou_type: str = "bbox",
 ) -> None:
     """Validate the list-of-dicts detection input format."""
     if not isinstance(preds, Sequence):
@@ -36,27 +37,32 @@ def _input_validator(
             f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
         )
 
-    for k in ["boxes", "labels"] + ([] if ignore_score else ["scores"]):
+    item_key = "masks" if iou_type == "segm" else "boxes"
+    for k in [item_key, "labels"] + ([] if ignore_score else ["scores"]):
         if any(k not in p for p in preds):
             raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
-    for k in ["boxes", "labels"]:
+    for k in [item_key, "labels"]:
         if any(k not in p for p in targets):
             raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
 
+    def _n_items(item: Dict[str, Array]) -> int:
+        arr = jnp.asarray(item[item_key])
+        return arr.shape[0] if arr.size else 0
+
     for i, item in enumerate(targets):
-        n_boxes = jnp.asarray(item["boxes"]).shape[0] if jnp.asarray(item["boxes"]).size else 0
+        n_boxes = _n_items(item)
         n_labels = jnp.asarray(item["labels"]).shape[0] if jnp.asarray(item["labels"]).size else 0
         if n_boxes != n_labels:
             raise ValueError(
-                f"Input '{i}' of `target` has a different length of boxes ({n_boxes}) and labels ({n_labels})"
+                f"Input '{i}' of `target` has a different length of {item_key} ({n_boxes}) and labels ({n_labels})"
             )
     if not ignore_score:
         for i, item in enumerate(preds):
-            n_boxes = jnp.asarray(item["boxes"]).shape[0] if jnp.asarray(item["boxes"]).size else 0
+            n_boxes = _n_items(item)
             n_labels = jnp.asarray(item["labels"]).shape[0] if jnp.asarray(item["labels"]).size else 0
             n_scores = jnp.asarray(item["scores"]).shape[0] if jnp.asarray(item["scores"]).size else 0
             if n_boxes != n_labels or n_boxes != n_scores:
                 raise ValueError(
-                    f"Input '{i}' of `preds` has a different length of boxes ({n_boxes}), labels ({n_labels})"
+                    f"Input '{i}' of `preds` has a different length of {item_key} ({n_boxes}), labels ({n_labels})"
                     f" and scores ({n_scores})"
                 )

@@ -74,19 +74,30 @@ class IntersectionOverUnion(Metric):
             raise ValueError("Expected argument `respect_labels` to be a boolean")
         self.respect_labels = respect_labels
 
-        # per-image NxM matrices are ragged in both dims; multi-process sync is
-        # unsupported (see _sync_dist)
-        self.add_state("groundtruth_labels", [], dist_reduce_fx="cat")
+        # per-image NxM matrices, ragged in both dims: synced across hosts via the
+        # pad-to-max ragged gather (shape table + flat buffer), which keeps the
+        # per-image boundaries that a plain concat-gather would destroy
+        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
         self.add_state("iou_matrix", [], dist_reduce_fx=None)
 
     def _sync_dist(self, dist_sync_fn=None) -> None:
-        if dist_sync_fn is None and self.dist_sync_fn is None:
-            raise NotImplementedError(
-                "IntersectionOverUnion holds per-image ragged IoU matrices that the"
-                " built-in sync cannot gather. Provide a custom `dist_sync_fn`, or"
-                " compute per process."
+        if dist_sync_fn is not None or self.dist_sync_fn is not None:
+            super()._sync_dist(dist_sync_fn)
+            return
+        import numpy as np
+
+        from torchmetrics_tpu.parallel.sync import allgather_ragged_arrays
+
+        sv = self._state_values
+        sv["iou_matrix"] = [
+            jnp.asarray(m) for m in allgather_ragged_arrays([np.asarray(m) for m in sv["iou_matrix"]], ndim=2)
+        ]
+        sv["groundtruth_labels"] = [
+            jnp.asarray(lab)
+            for lab in allgather_ragged_arrays(
+                [np.asarray(lab).reshape(-1) for lab in sv["groundtruth_labels"]], ndim=1, dtype=np.int64
             )
-        super()._sync_dist(dist_sync_fn)
+        ]
 
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
         """Compute and store the per-image (thresholded) IoU matrix."""

@@ -1,15 +1,24 @@
 """Mean average precision (COCO-style) with a native matcher.
 
 Parity: reference ``src/torchmetrics/detection/mean_ap.py`` (the pycocotools-backed
-API) with the matching semantics of the reference's own pure-torch evaluator
+API incl. ``iou_type="segm"``, ``extended_summary``, ``average``) with the matching
+semantics of the reference's own pure-torch evaluator
 ``src/torchmetrics/detection/_mean_ap.py`` (greedy per-detection best-GT matching
 ``:623-650``, per-image evaluation ``:522-620``, PR accumulation ``:791-860``,
 COCO summarization ``:652-695,755-789``).
 
-TPU design note: the greedy COCO matcher is sequential per detection with dynamic
-per-image box counts — host logic by nature (the reference runs it on CPU torch, COCO
-runs it in C). Here it runs in vectorized numpy at ``compute`` time; box IoU matrices
-are the only heavy arithmetic and are batched numpy einsum-free ops.
+TPU design notes:
+
+- The greedy COCO matcher is sequential per detection with dynamic per-image box
+  counts — host logic by nature (the reference runs it in C via pycocotools). Here it
+  runs in vectorized numpy at ``compute`` time.
+- **Distributed sync** works in both state layouts:
+  * list mode (default): per-image ragged numpy arrays; eager multihost sync ships
+    them through the pad-to-max ragged gather (:func:`allgather_ragged_arrays`) —
+    the tensor-native analog of the reference's object gather (``mean_ap.py:442-450``).
+  * buffered mode (``buffer_capacity``/``image_capacity`` set): static-shape
+    :class:`MaskedBuffer` row + per-image-size states that ``all_gather`` inside
+    ``shard_map`` like every other metric — the mesh-native layout (bbox only).
 """
 
 from __future__ import annotations
@@ -20,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.core.buffer import MaskedBuffer
 from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
 from torchmetrics_tpu.functional.detection.box_ops import box_convert
@@ -46,6 +56,61 @@ def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     wh = np.clip(rb - lt, 0, None)
     inter = wh[..., 0] * wh[..., 1]
     return inter / (area_det[:, None] + area_gt[None, :] - inter)
+
+
+def _np_mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Bitmap IoU: [n, H, W] x [m, H, W] -> [n, m] via flattened boolean matmuls."""
+    d = det.reshape(det.shape[0], -1).astype(np.float32)
+    g = gt.reshape(gt.shape[0], -1).astype(np.float32)
+    inter = d @ g.T
+    union = d.sum(axis=1)[:, None] + g.sum(axis=1)[None, :] - inter
+    with np.errstate(divide="ignore", invalid="ignore"):
+        iou = np.where(union > 0, inter / union, 0.0)
+    return iou
+
+
+class _Samples:
+    """Materialized per-image evaluation inputs (layout-independent)."""
+
+    def __init__(
+        self,
+        det_boxes: List[np.ndarray],
+        det_scores: List[np.ndarray],
+        det_labels: List[np.ndarray],
+        gt_boxes: List[np.ndarray],
+        gt_labels: List[np.ndarray],
+        det_masks: Optional[List[np.ndarray]] = None,
+        gt_masks: Optional[List[np.ndarray]] = None,
+    ) -> None:
+        self.det_boxes = det_boxes
+        self.det_scores = det_scores
+        self.det_labels = det_labels
+        self.gt_boxes = gt_boxes
+        self.gt_labels = gt_labels
+        self.det_masks = det_masks
+        self.gt_masks = gt_masks
+
+    @property
+    def num_images(self) -> int:
+        return len(self.gt_boxes)
+
+    def classes(self) -> List[int]:
+        labels = [lab for lab in self.det_labels + self.gt_labels if lab.size]
+        if labels:
+            return sorted({int(v) for v in np.concatenate(labels)})
+        return []
+
+    def relabeled_to_single_class(self) -> "_Samples":
+        """Micro averaging pools every class (reference ``mean_ap.py:552-555``)."""
+        return _Samples(
+            self.det_boxes,
+            self.det_scores,
+            [np.zeros_like(lab) for lab in self.det_labels],
+            self.gt_boxes,
+            [np.zeros_like(lab) for lab in self.gt_labels],
+            self.det_masks,
+            self.gt_masks,
+        )
 
 
 class MeanAveragePrecision(Metric):
@@ -80,6 +145,10 @@ class MeanAveragePrecision(Metric):
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        extended_summary: bool = False,
+        average: str = "macro",
+        buffer_capacity: Optional[int] = None,
+        image_capacity: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -89,8 +158,8 @@ class MeanAveragePrecision(Metric):
         if box_format not in allowed_box_formats:
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
-        if iou_type != "bbox":
-            raise ValueError(f"Expected argument `iou_type` to be `bbox` (native matcher) but got {iou_type}")
+        if iou_type not in ("bbox", "segm"):
+            raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {iou_type}")
         self.iou_type = iou_type
         self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, 10).round(2).tolist()
         self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.0, 101).round(2).tolist()
@@ -98,73 +167,196 @@ class MeanAveragePrecision(Metric):
         if not isinstance(class_metrics, bool):
             raise ValueError("Expected argument `class_metrics` to be a boolean")
         self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        self.extended_summary = extended_summary
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+        self.average = average
 
-        # per-image ragged lists: a concat-gather would lose image boundaries, so
-        # multi-process sync is explicitly unsupported (see _sync_dist)
-        self.add_state("detections", [], dist_reduce_fx=None)
-        self.add_state("detection_scores", [], dist_reduce_fx=None)
-        self.add_state("detection_labels", [], dist_reduce_fx=None)
-        self.add_state("groundtruths", [], dist_reduce_fx=None)
-        self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+        self._buffered = buffer_capacity is not None
+        if self._buffered:
+            if iou_type != "bbox":
+                raise ValueError("Buffered (mesh-syncable) states support `iou_type='bbox'` only")
+            image_capacity = image_capacity or 256
+            # static-shape mesh layout: flat row buffers + per-image size buffers;
+            # rows are [x1, y1, x2, y2, score, label] / [x1, y1, x2, y2, label]
+            self.add_state("det_rows", MaskedBuffer.create(buffer_capacity, (6,)), dist_reduce_fx="cat")
+            self.add_state("det_sizes", MaskedBuffer.create(image_capacity, (), dtype=jnp.int32), dist_reduce_fx="cat")
+            self.add_state("gt_rows", MaskedBuffer.create(buffer_capacity, (5,)), dist_reduce_fx="cat")
+            self.add_state("gt_sizes", MaskedBuffer.create(image_capacity, (), dtype=jnp.int32), dist_reduce_fx="cat")
+        else:
+            # per-image ragged lists; synced across hosts via the pad-to-max ragged
+            # gather in _sync_dist (boundaries preserved by gathering aligned lists)
+            self.add_state("detections", [], dist_reduce_fx=None)
+            self.add_state("detection_scores", [], dist_reduce_fx=None)
+            self.add_state("detection_labels", [], dist_reduce_fx=None)
+            self.add_state("groundtruths", [], dist_reduce_fx=None)
+            self.add_state("groundtruth_labels", [], dist_reduce_fx=None)
+            if iou_type == "segm":
+                self.add_state("detection_masks", [], dist_reduce_fx=None)
+                self.add_state("groundtruth_masks", [], dist_reduce_fx=None)
 
-    def _sync_dist(self, dist_sync_fn=None) -> None:
-        if dist_sync_fn is None and self.dist_sync_fn is None:
-            raise NotImplementedError(
-                "MeanAveragePrecision holds per-image ragged states that the built-in sync"
-                " cannot gather without corrupting image boundaries. Provide a custom"
-                " `dist_sync_fn` that gathers the per-image lists, or compute per process."
-            )
-        super()._sync_dist(dist_sync_fn)
+    # ------------------------------------------------------------------ state update
+
+    @staticmethod
+    def _canonical_masks(masks: Any) -> np.ndarray:
+        """Canonicalize masks to rank 3: a 1-D empty array becomes (0, 0, 0).
+
+        Mirrors ``_fix_empty_tensors`` for boxes — without this, the multihost
+        ragged gather's rank-3 shape table would reject inputs that evaluate fine
+        on a single host.
+        """
+        arr = np.asarray(masks).astype(bool)
+        if arr.size == 0 and arr.ndim != 3:
+            return arr.reshape(0, 0, 0)
+        return arr
+
+    def _convert_boxes(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(jnp.asarray(boxes, dtype=jnp.float32))
+        if boxes.ndim != 2 or boxes.shape[-1] != 4:
+            boxes = boxes.reshape(-1, 4)
+        if boxes.size:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
 
     def update(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
         """Store per-image detections and ground truths."""
-        _input_validator(preds, target)
+        _input_validator(preds, target, iou_type=self.iou_type)
+        if self._buffered:
+            self._update_buffered(preds, target)
+            return
 
         for item in preds:
-            boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
-            if boxes.size:
-                boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
-            self.detections.append(np.asarray(boxes))
+            n = np.asarray(item["labels"]).reshape(-1).shape[0]
+            self.detections.append(
+                np.asarray(self._convert_boxes(item["boxes"])) if "boxes" in item
+                else np.zeros((n, 4), dtype=np.float32)
+            )
             self.detection_labels.append(np.asarray(item["labels"]))
             self.detection_scores.append(np.asarray(item["scores"]))
+            if self.iou_type == "segm":
+                self.detection_masks.append(self._canonical_masks(item["masks"]))
 
         for item in target:
-            boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], dtype=jnp.float32))
-            if boxes.size:
-                boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
-            self.groundtruths.append(np.asarray(boxes))
+            n = np.asarray(item["labels"]).reshape(-1).shape[0]
+            self.groundtruths.append(
+                np.asarray(self._convert_boxes(item["boxes"])) if "boxes" in item
+                else np.zeros((n, 4), dtype=np.float32)
+            )
             self.groundtruth_labels.append(np.asarray(item["labels"]))
+            if self.iou_type == "segm":
+                self.groundtruth_masks.append(self._canonical_masks(item["masks"]))
 
-    # --------------------------------------------------------------- evaluation
+    def _update_buffered(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
+        # one append per state per call (not per image): concatenating the whole
+        # batch first keeps the eager path at a constant number of device dispatches
+        det_rows, det_sizes = [], []
+        for item in preds:
+            boxes = self._convert_boxes(item["boxes"])
+            scores = jnp.asarray(item["scores"], dtype=jnp.float32).reshape(-1, 1)
+            labels = jnp.asarray(item["labels"]).astype(jnp.float32).reshape(-1, 1)
+            rows = jnp.concatenate([boxes.reshape(-1, 4), scores, labels], axis=1)
+            det_rows.append(rows)
+            det_sizes.append(rows.shape[0])
+        if det_rows:
+            self.det_rows = self.det_rows.append(jnp.concatenate(det_rows, axis=0))
+            self.det_sizes = self.det_sizes.append(jnp.asarray(det_sizes, dtype=jnp.int32))
+        gt_rows, gt_sizes = [], []
+        for item in target:
+            boxes = self._convert_boxes(item["boxes"])
+            labels = jnp.asarray(item["labels"]).astype(jnp.float32).reshape(-1, 1)
+            rows = jnp.concatenate([boxes.reshape(-1, 4), labels], axis=1)
+            gt_rows.append(rows)
+            gt_sizes.append(rows.shape[0])
+        if gt_rows:
+            self.gt_rows = self.gt_rows.append(jnp.concatenate(gt_rows, axis=0))
+            self.gt_sizes = self.gt_sizes.append(jnp.asarray(gt_sizes, dtype=jnp.int32))
 
-    def _get_classes(self) -> List[int]:
-        labels = [lab for lab in self.detection_labels + self.groundtruth_labels if lab.size]
-        if labels:
-            return sorted({int(v) for v in np.concatenate(labels)})
-        return []
+    # ---------------------------------------------------------------- distributed sync
 
-    def _prepare_image(self, idx: int, class_id: int, max_det: int) -> Optional[Dict[str, np.ndarray]]:
+    def _sync_dist(self, dist_sync_fn=None) -> None:
+        if self._buffered or dist_sync_fn is not None or self.dist_sync_fn is not None:
+            # MaskedBuffer states ride the generic all_gather+compaction path
+            super()._sync_dist(dist_sync_fn)
+            return
+        from torchmetrics_tpu.parallel.sync import allgather_ragged_arrays
+
+        sv = self._state_values
+        names_2d = ["detections", "groundtruths"]
+        names_1d = ["detection_scores", "detection_labels", "groundtruth_labels"]
+        for name in names_2d:
+            sv[name] = allgather_ragged_arrays([np.asarray(a).reshape(-1, 4) for a in sv[name]], ndim=2)
+        for name in names_1d:
+            dtype = np.float32 if name == "detection_scores" else np.int64
+            sv[name] = [
+                a.astype(dtype)
+                for a in allgather_ragged_arrays([np.asarray(a).reshape(-1) for a in sv[name]], ndim=1, dtype=dtype)
+            ]
+        if self.iou_type == "segm":
+            for name in ("detection_masks", "groundtruth_masks"):
+                gathered = allgather_ragged_arrays([np.asarray(a) for a in sv[name]], ndim=3, dtype=np.uint8)
+                sv[name] = [a.astype(bool) for a in gathered]
+
+    # --------------------------------------------------------------- materialization
+
+    def _materialize(self) -> _Samples:
+        if not self._buffered:
+            return _Samples(
+                [np.asarray(a).reshape(-1, 4) for a in self.detections],
+                [np.asarray(a).reshape(-1) for a in self.detection_scores],
+                [np.asarray(a).reshape(-1) for a in self.detection_labels],
+                [np.asarray(a).reshape(-1, 4) for a in self.groundtruths],
+                [np.asarray(a).reshape(-1) for a in self.groundtruth_labels],
+                self.detection_masks if self.iou_type == "segm" else None,
+                self.groundtruth_masks if self.iou_type == "segm" else None,
+            )
+        det_rows = np.asarray(self.det_rows.values())
+        det_sizes = np.asarray(self.det_sizes.values()).astype(np.int64)
+        gt_rows = np.asarray(self.gt_rows.values())
+        gt_sizes = np.asarray(self.gt_sizes.values()).astype(np.int64)
+        det_split = np.split(det_rows, np.cumsum(det_sizes)[:-1]) if det_sizes.size else []
+        gt_split = np.split(gt_rows, np.cumsum(gt_sizes)[:-1]) if gt_sizes.size else []
+        return _Samples(
+            [r[:, :4] for r in det_split],
+            [r[:, 4] for r in det_split],
+            [r[:, 5].astype(np.int64) for r in det_split],
+            [r[:, :4] for r in gt_split],
+            [r[:, 4].astype(np.int64) for r in gt_split],
+        )
+
+    # --------------------------------------------------------------------- evaluation
+
+    def _prepare_image(self, samples: _Samples, idx: int, class_id: int, max_det: int) -> Optional[Dict[str, np.ndarray]]:
         """Per-(image, class) setup shared across area ranges: filtered + score-sorted
         detections, filtered GTs, areas, and the IoU matrix (computed once)."""
-        gt_mask = self.groundtruth_labels[idx] == class_id
-        det_mask = self.detection_labels[idx] == class_id
+        gt_mask = samples.gt_labels[idx] == class_id
+        det_mask = samples.det_labels[idx] == class_id
         if not gt_mask.any() and not det_mask.any():
             return None
 
-        gt = self.groundtruths[idx][gt_mask]
-        det = self.detections[idx][det_mask]
-        scores = self.detection_scores[idx][det_mask]
-
+        scores = samples.det_scores[idx][det_mask]
         dtind = np.argsort(-scores, kind="mergesort")[:max_det]
-        det = det[dtind]
         scores_sorted = scores[dtind]
 
+        if self.iou_type == "segm":
+            gt = samples.gt_masks[idx][gt_mask]
+            det = samples.det_masks[idx][det_mask][dtind]
+            gt_areas = gt.reshape(gt.shape[0], -1).sum(axis=1).astype(np.float64) if len(gt) else np.zeros(0)
+            det_areas = det.reshape(det.shape[0], -1).sum(axis=1).astype(np.float64) if len(det) else np.zeros(0)
+            ious = _np_mask_iou(det, gt) if len(det) and len(gt) else np.zeros((len(det), len(gt)))
+        else:
+            gt = samples.gt_boxes[idx][gt_mask]
+            det = samples.det_boxes[idx][det_mask][dtind]
+            gt_areas = _np_box_area(gt) if len(gt) else np.zeros(0)
+            det_areas = _np_box_area(det) if len(det) else np.zeros(0)
+            ious = _np_box_iou(det, gt) if len(det) and len(gt) else np.zeros((len(det), len(gt)))
+
         return {
-            "gt": gt,
-            "gt_areas": _np_box_area(gt) if len(gt) else np.zeros(0),
-            "det_areas": _np_box_area(det) if len(det) else np.zeros(0),
+            "gt_areas": gt_areas,
+            "det_areas": det_areas,
             "scores_sorted": scores_sorted,
-            "ious": _np_box_iou(det, gt) if len(det) and len(gt) else np.zeros((len(det), len(gt))),
+            "ious": ious,
         }
 
     def _evaluate_image(
@@ -209,24 +401,28 @@ class MeanAveragePrecision(Metric):
             "dtIgnore": det_ignore,
         }
 
-    def _accumulate(
-        self, classes: List[int]
-    ) -> Tuple[np.ndarray, np.ndarray]:
-        """PR-curve accumulation → precision[T,R,K,A,M] and recall[T,K,A,M]."""
+    def _accumulate(self, samples: _Samples, classes: List[int]) -> Tuple[np.ndarray, np.ndarray, np.ndarray, Dict]:
+        """PR accumulation → precision[T,R,K,A,M], recall[T,K,A,M], scores[T,R,K,A,M]."""
         num_thrs = len(self.iou_thresholds)
         num_rec = len(self.rec_thresholds)
         num_cls = len(classes)
         num_areas = len(_BBOX_AREA_RANGES)
         num_maxdet = len(self.max_detection_thresholds)
-        num_imgs = len(self.groundtruths)
 
         precision = -np.ones((num_thrs, num_rec, num_cls, num_areas, num_maxdet))
         recall = -np.ones((num_thrs, num_cls, num_areas, num_maxdet))
+        score_surface = -np.ones((num_thrs, num_rec, num_cls, num_areas, num_maxdet))
+        ious_out: Dict = {}
         rec_thrs = np.asarray(self.rec_thresholds)
         max_det_cap = self.max_detection_thresholds[-1]
 
         for k_idx, class_id in enumerate(classes):
-            preps = [self._prepare_image(i, class_id, max_det_cap) for i in range(num_imgs)]
+            preps = [self._prepare_image(samples, i, class_id, max_det_cap) for i in range(samples.num_images)]
+            if self.extended_summary:
+                for i, prep in enumerate(preps):
+                    ious_out[(i, class_id)] = (
+                        jnp.asarray(prep["ious"], dtype=jnp.float32) if prep is not None else jnp.zeros((0, 0))
+                    )
             for a_idx, area_range in enumerate(_BBOX_AREA_RANGES.values()):
                 evals = [self._evaluate_image(prep, area_range) for prep in preps]
                 evals = [e for e in evals if e is not None]
@@ -235,6 +431,7 @@ class MeanAveragePrecision(Metric):
                 for m_idx, max_det in enumerate(self.max_detection_thresholds):
                     det_scores = np.concatenate([e["dtScores"][:max_det] for e in evals])
                     inds = np.argsort(-det_scores, kind="mergesort")
+                    det_scores_sorted = det_scores[inds]
                     det_matches = np.concatenate(
                         [e["dtMatches"][:, :max_det] for e in evals], axis=1
                     )[:, inds]
@@ -262,11 +459,14 @@ class MeanAveragePrecision(Metric):
 
                         inds_r = np.searchsorted(rc, rec_thrs, side="left")
                         prec = np.zeros(num_rec)
+                        score_at = np.zeros(num_rec)
                         valid = inds_r < len(pr)
                         prec[valid] = pr[inds_r[valid]]
+                        score_at[valid] = det_scores_sorted[inds_r[valid]]
                         precision[t_idx, :, k_idx, a_idx, m_idx] = prec
+                        score_surface[t_idx, :, k_idx, a_idx, m_idx] = score_at
 
-        return precision, recall
+        return precision, recall, score_surface, ious_out
 
     @staticmethod
     def _mean_over_valid(values: np.ndarray) -> Array:
@@ -299,8 +499,10 @@ class MeanAveragePrecision(Metric):
 
     def compute(self) -> Dict[str, Array]:
         """COCO mAP/mAR metric dictionary over all accumulated images."""
-        classes = self._get_classes()
-        precision, recall = self._accumulate(classes)
+        samples = self._materialize()
+        eval_samples = samples.relabeled_to_single_class() if self.average == "micro" else samples
+        classes = eval_samples.classes()
+        precision, recall, score_surface, ious = self._accumulate(eval_samples, classes)
         last_max_det = self.max_detection_thresholds[-1]
 
         metrics: Dict[str, Array] = {}
@@ -326,18 +528,35 @@ class MeanAveragePrecision(Metric):
                 precision, recall, False, area_range=area, max_dets=last_max_det
             )
 
+        if self.extended_summary:
+            metrics["ious"] = ious
+            metrics["precision"] = jnp.asarray(precision, dtype=jnp.float32)
+            metrics["recall"] = jnp.asarray(recall, dtype=jnp.float32)
+            metrics["scores"] = jnp.asarray(score_surface, dtype=jnp.float32)
+
         map_per_class = jnp.asarray([-1.0])
         mar_per_class = jnp.asarray([-1.0])
-        if self.class_metrics and classes:
-            map_list, mar_list = [], []
-            for k_idx in range(len(classes)):
-                cls_prec = precision[:, :, k_idx : k_idx + 1]
-                cls_rec = recall[:, k_idx : k_idx + 1]
-                map_list.append(self._summarize(cls_prec, cls_rec, True, max_dets=last_max_det))
-                mar_list.append(self._summarize(cls_prec, cls_rec, False, max_dets=last_max_det))
-            map_per_class = jnp.stack(map_list)
-            mar_per_class = jnp.stack(mar_list)
+        if self.class_metrics:
+            # micro pooled everything into one class for the headline stats; per-class
+            # metrics always evaluate per true class (reference ``mean_ap.py:551-559``)
+            cls_samples = samples
+            cls_classes = cls_samples.classes()
+            if cls_classes:
+                if self.average == "micro":
+                    cls_precision, cls_recall, _, _ = self._accumulate(cls_samples, cls_classes)
+                else:
+                    cls_precision, cls_recall = precision, recall
+                map_list, mar_list = [], []
+                for k_idx in range(len(cls_classes)):
+                    cls_prec = cls_precision[:, :, k_idx : k_idx + 1]
+                    cls_rec = cls_recall[:, k_idx : k_idx + 1]
+                    map_list.append(self._summarize(cls_prec, cls_rec, True, max_dets=last_max_det))
+                    mar_list.append(self._summarize(cls_prec, cls_rec, False, max_dets=last_max_det))
+                map_per_class = jnp.stack(map_list)
+                mar_per_class = jnp.stack(mar_list)
         metrics["map_per_class"] = map_per_class
         metrics[f"mar_{last_max_det}_per_class"] = mar_per_class
-        metrics["classes"] = jnp.asarray(classes, dtype=jnp.int32)
+        metrics["classes"] = jnp.asarray(
+            samples.classes() if self.class_metrics or self.average == "micro" else classes, dtype=jnp.int32
+        )
         return metrics

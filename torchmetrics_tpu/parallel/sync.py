@@ -104,6 +104,37 @@ def _allgather_ragged_dim0(x: Array) -> Array:
     return jnp.concatenate(pieces, axis=0)
 
 
+def allgather_ragged_arrays(arrays: List, ndim: int, dtype=jnp.float32) -> List:
+    """Gather per-host *lists* of same-rank, arbitrarily-shaped arrays across hosts.
+
+    The detection states are lists of per-image arrays whose shapes differ both
+    within a host and across hosts (boxes [N_i, 4], IoU matrices [N_i, M_i]). The
+    reference gathers these as pickled object lists over the process group
+    (``dist_reduce_fx=None`` states, ``detection/mean_ap.py:442-450``); the
+    tensor-native equivalent here ships two ragged buffers per state — a [K, ndim]
+    shape table and a flat value buffer — through :func:`_allgather_ragged_dim0`,
+    then re-splits host-major. Returns the world-concatenated list (host 0's arrays
+    first), preserving per-image boundaries.
+    """
+    import numpy as np
+
+    shapes = np.asarray([a.shape for a in arrays], dtype=np.int32).reshape(len(arrays), ndim)
+    flat_np = (
+        np.concatenate([np.asarray(a, dtype=dtype).reshape(-1) for a in arrays])
+        if arrays
+        else np.zeros((0,), dtype=dtype)
+    )
+    g_shapes = np.asarray(_allgather_ragged_dim0(jnp.asarray(shapes)))
+    g_flat = np.asarray(_allgather_ragged_dim0(jnp.asarray(flat_np)))
+    out: List = []
+    offset = 0
+    for shape in g_shapes:
+        size = int(np.prod(shape))
+        out.append(g_flat[offset : offset + size].reshape(tuple(int(s) for s in shape)))
+        offset += size
+    return out
+
+
 def _sync_leaf_multihost(x: Array, reduction: Reduction) -> Array:
     from jax.experimental import multihost_utils
 
