@@ -1,0 +1,208 @@
+"""Weight-conversion fidelity (VERDICT missing #4).
+
+torch is installed, so conversion is provable offline with synthetic checkpoints:
+
+- a random-weight torch state dict in torch-fidelity's naming converts through
+  ``load_torch_fidelity_weights`` into *exactly* the flax net's parameter tree
+  (structure + shapes + values; catches silent key drops);
+- a torch conv+frozen-bn+relu block matches our flax ``BasicConv2d`` numerically
+  under the converted weights (catches OIHW->HWIO / bn-stat mapping errors);
+- a tiny random BERT round-trips torch -> flax through transformers and agrees on
+  the forward pass (the BERTScore/CLIP model-loading path);
+- the bundled LPIPS head npz files match the reference's pth checkpoints value
+  for value, and the functional auto-applies them for matching pyramids.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from torchmetrics_tpu.utils.imports import _FLAX_AVAILABLE, _TRANSFORMERS_AVAILABLE
+
+torch = pytest.importorskip("torch")
+
+pytestmark = pytest.mark.skipif(not _FLAX_AVAILABLE, reason="flax required")
+
+_REF_LPIPS_DIR = "/root/reference/src/torchmetrics/functional/image/lpips_models"
+
+
+def _flax_tree_to_torch_state_dict(variables) -> dict:
+    """Inverse of ``load_torch_fidelity_weights``: emit torch-fidelity-format names."""
+    state = {}
+
+    def walk(tree, path, collection):
+        for key, value in tree.items():
+            sub = path + [key]
+            if isinstance(value, dict):
+                walk(value, sub, collection)
+                continue
+            value = np.asarray(value)
+            if key == "kernel" and sub[-2] == "conv":
+                state[".".join(sub[:-1] + ["weight"])] = torch.from_numpy(
+                    value.transpose(3, 2, 0, 1).copy()  # HWIO -> OIHW
+                )
+            elif key == "kernel" and sub[-2] == "fc":
+                state["fc.weight"] = torch.from_numpy(value.transpose(1, 0).copy())
+            elif key == "bias" and sub[-2] == "fc":
+                state["fc.bias"] = torch.from_numpy(value.copy())
+            elif sub[-2] == "bn":
+                if collection == "params":
+                    name = "weight" if key == "scale" else "bias"
+                else:
+                    name = "running_mean" if key == "mean" else "running_var"
+                state[".".join(sub[:-1] + [name])] = torch.from_numpy(value.copy())
+
+    walk(variables["params"], [], "params")
+    walk(variables["batch_stats"], [], "batch_stats")
+    return state
+
+
+class TestInceptionConversion:
+    def test_synthetic_checkpoint_roundtrip(self, tmp_path):
+        """Converted synthetic checkpoint == the flax init tree, leaf for leaf."""
+        from torchmetrics_tpu.image._inception_net import FIDInceptionV3, load_torch_fidelity_weights
+
+        net = FIDInceptionV3(features_list=("2048",))
+        variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+        # randomize bn stats so mean/var mapping is actually exercised
+        variables = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(np.random.RandomState(0).normal(size=x.shape).astype(np.float32) * 0.1 + 1.0),
+            variables,
+        )
+        state_dict = _flax_tree_to_torch_state_dict(variables)
+        path = tmp_path / "synthetic_fid_inception.pth"
+        torch.save(state_dict, str(path))
+
+        converted = load_torch_fidelity_weights(str(path))
+
+        want_leaves, want_def = jax.tree_util.tree_flatten(variables)
+        got_leaves, got_def = jax.tree_util.tree_flatten(converted)
+        assert want_def == got_def, "converted tree structure differs from the flax net's"
+        for want, got in zip(want_leaves, got_leaves):
+            assert want.shape == got.shape
+            _assert_allclose(got, want, atol=0)
+
+        # and the net accepts the converted tree
+        out = net.apply(converted, jnp.zeros((2, 299, 299, 3)))
+        assert out["2048"].shape == (2, 2048)
+
+    def test_basic_conv_bn_numerics(self, tmp_path):
+        """torch conv+frozen-bn+relu == flax BasicConv2d under converted weights."""
+        from torchmetrics_tpu.image._inception_net import BasicConv2d, load_torch_fidelity_weights
+
+        rng = np.random.RandomState(1)
+        c_in, c_out, k = 3, 8, 3
+
+        tconv = torch.nn.Conv2d(c_in, c_out, k, stride=2, bias=False)
+        tbn = torch.nn.BatchNorm2d(c_out, eps=1e-3)
+        with torch.no_grad():
+            tconv.weight.copy_(torch.from_numpy(rng.normal(size=(c_out, c_in, k, k)).astype(np.float32)))
+            tbn.weight.copy_(torch.from_numpy(rng.uniform(0.5, 1.5, c_out).astype(np.float32)))
+            tbn.bias.copy_(torch.from_numpy(rng.normal(size=c_out).astype(np.float32)))
+            tbn.running_mean.copy_(torch.from_numpy(rng.normal(size=c_out).astype(np.float32)))
+            tbn.running_var.copy_(torch.from_numpy(rng.uniform(0.5, 2.0, c_out).astype(np.float32)))
+        tbn.eval()
+
+        # ship through the converter's naming ("<block>.conv.weight", "<block>.bn.*")
+        state = {
+            "Block.conv.weight": tconv.weight.detach(),
+            "Block.bn.weight": tbn.weight.detach(),
+            "Block.bn.bias": tbn.bias.detach(),
+            "Block.bn.running_mean": tbn.running_mean.detach(),
+            "Block.bn.running_var": tbn.running_var.detach(),
+        }
+        path = tmp_path / "block.pth"
+        torch.save(state, str(path))
+        converted = load_torch_fidelity_weights(str(path))
+        variables = {
+            "params": converted["params"]["Block"],
+            "batch_stats": converted["batch_stats"]["Block"],
+        }
+
+        x = rng.normal(size=(2, c_in, 11, 11)).astype(np.float32)
+        with torch.no_grad():
+            want = torch.relu(tbn(tconv(torch.from_numpy(x)))).numpy()
+
+        block = BasicConv2d(c_out, (k, k), strides=(2, 2))
+        got = block.apply(variables, jnp.asarray(x.transpose(0, 2, 3, 1)))  # NCHW->NHWC
+        _assert_allclose(np.transpose(np.asarray(got), (0, 3, 1, 2)), want, atol=1e-5)
+
+
+@pytest.mark.skipif(not _TRANSFORMERS_AVAILABLE, reason="transformers required")
+class TestHFTorchFlaxParity:
+    def test_tiny_bert_forward_parity(self, tmp_path):
+        from transformers import BertConfig, BertModel, FlaxBertModel
+
+        config = BertConfig(
+            vocab_size=99,
+            hidden_size=32,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            intermediate_size=64,
+            max_position_embeddings=64,
+        )
+        torch_model = BertModel(config)
+        torch_model.eval()
+        torch_model.save_pretrained(str(tmp_path / "tiny_bert"))
+        flax_model = FlaxBertModel.from_pretrained(str(tmp_path / "tiny_bert"), from_pt=True)
+
+        rng = np.random.RandomState(2)
+        input_ids = rng.randint(0, 99, (3, 17))
+        attention_mask = np.ones_like(input_ids)
+        with torch.no_grad():
+            want = torch_model(
+                input_ids=torch.from_numpy(input_ids),
+                attention_mask=torch.from_numpy(attention_mask),
+            ).last_hidden_state.numpy()
+        got = flax_model(
+            input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask)
+        ).last_hidden_state
+        _assert_allclose(got, want, atol=2e-4)
+
+
+class TestLpipsHeads:
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_bundled_heads_match_reference(self, net_type):
+        import os
+
+        from torchmetrics_tpu.functional.image.lpips import load_lpips_head_weights
+
+        heads = load_lpips_head_weights(net_type)
+        ref_path = os.path.join(_REF_LPIPS_DIR, f"{net_type}.pth")
+        if not os.path.exists(ref_path):
+            pytest.skip("reference checkpoints unavailable")
+        ref_state = torch.load(ref_path, map_location="cpu")
+        assert len(heads) == len(ref_state)
+        for lvl, head in enumerate(heads):
+            want = ref_state[f"lin{lvl}.model.1.weight"].numpy().reshape(-1)
+            _assert_allclose(head, want, atol=0)
+            assert bool((np.asarray(head) >= 0).all())  # lpips heads are non-negative
+
+    def test_functional_auto_applies_bundled_heads(self):
+        from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
+
+        rng = np.random.RandomState(3)
+        img = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+        other = jnp.asarray(rng.rand(2, 3, 16, 16).astype(np.float32))
+
+        # alex-shaped pyramid: channel counts match the bundled alex heads
+        def feature_fn(x):
+            maps = []
+            for c in (64, 192, 384, 256, 256):
+                reps = int(np.ceil(c / x.shape[1]))
+                maps.append(jnp.tile(x, (1, reps, 1, 1))[:, :c])
+            return maps
+
+        weighted = learned_perceptual_image_patch_similarity(img, other, net_type="alex", feature_fn=feature_fn)
+        uniform = learned_perceptual_image_patch_similarity(
+            img, other, net_type="alex", feature_fn=feature_fn,
+            head_weights=[jnp.ones(c) for c in (64, 192, 384, 256, 256)],
+        )
+        assert float(weighted) > 0
+        # bundled heads are not all-ones, so the two reductions must differ
+        assert abs(float(weighted) - float(uniform)) > 1e-6

@@ -54,6 +54,25 @@ def _lpips_from_features(
     return total
 
 
+def load_lpips_head_weights(net_type: str = "alex") -> list:
+    """Bundled per-level LPIPS linear-head weights for ``net_type``.
+
+    Converted to npz from the reference's bundled checkpoints
+    (``functional/image/lpips_models/{alex,vgg,squeeze}.pth``,
+    reference ``lpips.py:36-43``); each entry is the (C,) weight vector of the
+    level's 1x1 conv head.
+    """
+    import os
+
+    allowed = ("alex", "vgg", "squeeze")
+    if net_type not in allowed:
+        raise ValueError(f"Argument `net_type` must be one of {allowed}, but got {net_type}")
+    path = os.path.join(os.path.dirname(__file__), "lpips_models", f"{net_type}.npz")
+    with np.load(path) as data:
+        levels = sorted(data.files, key=lambda name: int(name.replace("lin", "")))
+        return [jnp.asarray(data[name]) for name in levels]
+
+
 def learned_perceptual_image_patch_similarity(
     img1: Array,
     img2: Array,
@@ -82,7 +101,19 @@ def learned_perceptual_image_patch_similarity(
             " cannot be downloaded in this environment. Pass `feature_fn` (a callable"
             " producing a feature pyramid) to use the native LPIPS machinery."
         )
-    loss = _lpips_from_features(feature_fn(img1), feature_fn(img2), head_weights)
+    feats1, feats2 = feature_fn(img1), feature_fn(img2)
+    if head_weights is None:
+        # auto-use the bundled heads only when the pyramid matches the named
+        # backbone's channel layout; custom pyramids fall back to uniform weights
+        try:
+            bundled = load_lpips_head_weights(net_type)
+            if len(bundled) == len(feats1) and all(
+                w.shape[0] == f.shape[1] for w, f in zip(bundled, feats1)
+            ):
+                head_weights = bundled
+        except (ValueError, OSError):
+            head_weights = None
+    loss = _lpips_from_features(feats1, feats2, head_weights)
     if reduction == "mean":
         return loss.mean()
     if reduction == "sum":
