@@ -3,6 +3,11 @@
 Parity: reference ``src/torchmetrics/functional/text/squad.py`` (normalization
 ``:41-65``, F1/EM ``:66-92``, input checks ``:95-140``, update ``:143-186``,
 compute ``:189-203``, public fn ``:206-255``).
+
+Attribution: the normalization/F1/EM rules here (like the reference's, which this
+mirrors for score parity) follow the official SQuAD v1.1 evaluation script
+(Rajpurkar et al., https://rajpurkar.github.io/SQuAD-explorer/) — the scoring is
+specified by that script, so any faithful implementation shares its structure.
 """
 
 from __future__ import annotations
