@@ -1,0 +1,217 @@
+"""Production-pattern integration test (VERDICT missing #7).
+
+A real flax/optax training loop: a small MLP trained with SGD, with a
+``MetricCollection(Accuracy, F1, AUROC)`` updated INSIDE the jitted train step over
+the 8-device mesh (data-parallel shard_map: psum'd grads + per-shard metric states),
+metrics computed at epoch end from the collective-synced states, and a mid-epoch
+orbax checkpoint of (params, opt_state, metric states) that resumes bit-exactly.
+
+This mirrors the reference's Lightning integration suite
+(``tests/integrations/test_lightning.py:48-464``) in the framework's native idiom:
+pure state pytrees threaded through the step function instead of module mutation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from tests.helpers.testers import _assert_allclose
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassF1Score
+
+flax = pytest.importorskip("flax")
+optax = pytest.importorskip("optax")
+
+from flax import linen as nn  # noqa: E402
+
+NUM_CLASSES = 5
+FEATURES = 8
+PER_DEVICE = 16
+
+
+class _MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(32)(x)
+        x = nn.relu(x)
+        return nn.Dense(NUM_CLASSES)(x)
+
+
+def _make_collection() -> MetricCollection:
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+            "f1": MulticlassF1Score(NUM_CLASSES, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(NUM_CLASSES, thresholds=50, validate_args=False),
+        }
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh(n_devices):
+    return Mesh(np.array(jax.devices()[:n_devices]), ("data",))
+
+
+@pytest.fixture(scope="module")
+def data(n_devices):
+    rng = np.random.RandomState(0)
+    steps = 6
+    n = n_devices * PER_DEVICE
+    x = rng.normal(size=(steps, n, FEATURES)).astype(np.float32)
+    w_true = rng.normal(size=(FEATURES, NUM_CLASSES)).astype(np.float32)
+    y = (x @ w_true + 0.1 * rng.normal(size=(steps, n, NUM_CLASSES))).argmax(-1)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _stacked_init(collection, n_devices):
+    """Per-shard metric states carried ACROSS jitted steps.
+
+    Inside shard_map each shard's state diverges (it saw different data), so the
+    state pytree cannot use a replicated out-spec. The carry gets an explicit
+    leading device axis instead: ``[n_devices, ...]`` sharded with ``P("data")`` —
+    each shard owns its ``[1, ...]`` slice between steps.
+    """
+    one = collection.init_state()
+    return jax.tree_util.tree_map(lambda a: jnp.stack([a] * n_devices), one)
+
+
+def _build_step(model, tx, collection, mesh):
+    def step(params, opt_state, shard_states, x, y):
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            onehot = jax.nn.one_hot(y, NUM_CLASSES)
+            return optax.softmax_cross_entropy(logits, onehot).mean(), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # data-parallel: gradients reduce across the mesh (replicated out is sound),
+        # metric states stay per-shard and ride the leading device axis
+        grads = jax.lax.pmean(grads, "data")
+        loss = jax.lax.pmean(loss, "data")
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        local = jax.tree_util.tree_map(lambda a: a[0], shard_states)
+        local = collection.pure_update(local, logits, y)
+        shard_states = jax.tree_util.tree_map(lambda a: a[None], local)
+        return params, opt_state, shard_states, loss
+
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(P(), P(), P("data"), P("data"), P("data")),
+            out_specs=(P(), P(), P("data"), P()),
+            check_vma=False,
+        )
+    )
+
+
+def _epoch_values(collection, shard_states, mesh):
+    """Collective-sync the per-shard states on the mesh, then compute on the host."""
+
+    def sync_only(states):
+        local = jax.tree_util.tree_map(lambda a: a[0], states)
+        return collection.sync_state(local, axis_name="data")
+
+    synced = jax.jit(
+        shard_map(sync_only, mesh=mesh, in_specs=(P("data"),), out_specs=P(), check_vma=False)
+    )(shard_states)
+    return collection.pure_compute(synced), synced
+
+
+class TestTrainLoopIntegration:
+    def test_metrics_inside_jitted_step_match_offline(self, mesh, data, n_devices):
+        x, y = data
+        model = _MLP()
+        tx = optax.sgd(0.05)
+        collection = _make_collection()
+        params = model.init(jax.random.PRNGKey(0), x[0])
+        opt_state = tx.init(params)
+        step = _build_step(model, tx, collection, mesh)
+
+        states = _stacked_init(collection, n_devices)
+        logits_per_step = []
+        for i in range(x.shape[0]):
+            logits_per_step.append(model.apply(params, x[i]))  # pre-update logits
+            params, opt_state, states, loss = step(params, opt_state, states, x[i], y[i])
+        assert bool(jnp.isfinite(loss))
+
+        values, _ = _epoch_values(collection, states, mesh)
+
+        # offline truth: a stateful collection fed the same logits streams
+        offline = _make_collection()
+        for logits, yy in zip(logits_per_step, y):
+            offline.update(logits, yy)
+        want = offline.compute()
+        assert set(values) == set(want)
+        for key in want:
+            _assert_allclose(values[key], want[key], atol=1e-5)
+
+    def test_training_actually_learns(self, mesh, data):
+        x, y = data
+        model = _MLP()
+        tx = optax.sgd(0.1)
+        collection = _make_collection()
+        params = model.init(jax.random.PRNGKey(1), x[0])
+        opt_state = tx.init(params)
+        step = _build_step(model, tx, collection, mesh)
+
+        first_epoch = last_epoch = None
+        n_devices = mesh.devices.size
+        for epoch in range(8):
+            states = _stacked_init(collection, n_devices)
+            for i in range(x.shape[0]):
+                params, opt_state, states, _ = step(params, opt_state, states, x[i], y[i])
+            values, _ = _epoch_values(collection, states, mesh)
+            if first_epoch is None:
+                first_epoch = float(values["acc"])
+            last_epoch = float(values["acc"])
+        assert last_epoch > first_epoch, (first_epoch, last_epoch)
+        assert last_epoch > 0.5
+
+    def test_mid_epoch_checkpoint_resume(self, mesh, data, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        import orbax.checkpoint as ocp
+
+        x, y = data
+        model = _MLP()
+        tx = optax.sgd(0.05)
+        collection = _make_collection()
+        params = model.init(jax.random.PRNGKey(2), x[0])
+        opt_state = tx.init(params)
+        step = _build_step(model, tx, collection, mesh)
+
+        # run 3 of 6 steps, checkpoint everything mid-epoch
+        states = _stacked_init(collection, mesh.devices.size)
+        for i in range(3):
+            params, opt_state, states, _ = step(params, opt_state, states, x[i], y[i])
+        ckpt = {"params": params, "opt_state": opt_state, "metrics": states}
+        path = str(tmp_path / "mid_epoch")
+        ocp.PyTreeCheckpointer().save(path, ckpt)
+
+        # continue to the epoch end without checkpointing (the truth)
+        params_a, opt_a, states_a = params, opt_state, states
+        for i in range(3, 6):
+            params_a, opt_a, states_a, _ = step(params_a, opt_a, states_a, x[i], y[i])
+        want, _ = _epoch_values(collection, states_a, mesh)
+
+        # resume from the checkpoint in a fresh everything
+        restored = ocp.PyTreeCheckpointer().restore(
+            path, item=jax.tree_util.tree_map(lambda a: a, ckpt)
+        )
+        collection_b = _make_collection()
+        step_b = _build_step(model, tx, collection_b, mesh)
+        params_b = jax.tree_util.tree_map(jnp.asarray, restored["params"])
+        opt_b = jax.tree_util.tree_map(jnp.asarray, restored["opt_state"])
+        states_b = jax.tree_util.tree_map(jnp.asarray, restored["metrics"])
+        for i in range(3, 6):
+            params_b, opt_b, states_b, _ = step_b(params_b, opt_b, states_b, x[i], y[i])
+        got, _ = _epoch_values(collection_b, states_b, mesh)
+
+        for key in want:
+            _assert_allclose(got[key], want[key], atol=0, rtol=0)  # bit-exact resume

@@ -268,6 +268,10 @@ class MetricCollection:
             # run their compute on the leader's batch state
             result = self._fill_group_member_forward(result, *args, **kwargs)
 
+        return self._flatten_result_dict(result)
+
+    def _flatten_result_dict(self, result: Dict[str, Any]) -> Dict[str, Any]:
+        """Flatten dict-valued per-metric results, dedupe keys, apply affixes."""
         _, duplicates = _flatten_dict(result)
 
         flattened_results = {}
@@ -294,6 +298,41 @@ class MetricCollection:
             else:
                 flattened_results[k] = res
         return {self._set_name(k): v for k, v in flattened_results.items()}
+
+    # ------------------------------------------------------------- pure projections
+
+    def init_state(self) -> Dict[str, Any]:
+        """Fresh state per compute-group leader, keyed by leader name.
+
+        The pure/SPMD counterpart of the stateful API: because compute groups are
+        static, the collection's whole state is exactly one pytree per group leader —
+        members recompute from the leader's state at ``pure_compute``.
+        """
+        return {members[0]: self._modules[members[0]].init_state() for members in self._groups.values()}
+
+    def pure_update(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure transition for every group leader — jit/shard_map/scan-safe."""
+        out: Dict[str, Any] = {}
+        for members in self._groups.values():
+            leader = self._modules[members[0]]
+            out[members[0]] = leader.pure_update(states[members[0]], *args, **leader._filter_kwargs(**kwargs))
+        return out
+
+    def sync_state(self, states: Dict[str, Any], axis_name: Optional[str] = None) -> Dict[str, Any]:
+        """Collective-sync every leader state over a mesh axis (one sync per group)."""
+        return {
+            name: self._modules[name].sync_state(state, axis_name=axis_name)
+            for name, state in states.items()
+        }
+
+    def pure_compute(self, states: Dict[str, Any]) -> Dict[str, Any]:
+        """Every metric's value from the leader states (flat result dict)."""
+        result: Dict[str, Any] = {}
+        for members in self._groups.values():
+            leader_state = states[members[0]]
+            for name in members:
+                result[name] = self._modules[name].pure_compute(leader_state)
+        return self._flatten_result_dict({k: result[k] for k in self._modules})
 
     def _compute_groupwise(self) -> Dict[str, Any]:
         """Compute every metric, syncing each multi-member group's shared state ONCE.
