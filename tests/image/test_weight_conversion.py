@@ -165,6 +165,49 @@ class TestHFTorchFlaxParity:
         _assert_allclose(got, want, atol=2e-4)
 
 
+@pytest.mark.skipif(not _TRANSFORMERS_AVAILABLE, reason="transformers required")
+class TestTinyClipParity:
+    def test_tiny_clip_forward_parity(self, tmp_path):
+        """torch->flax CLIP round trip agrees on image/text embeddings.
+
+        Validates the loading path CLIPScore/CLIP-IQA use (FlaxCLIPModel) without
+        network access: a tiny random CLIP is saved from torch and reloaded in flax.
+        """
+        from transformers import CLIPConfig, CLIPModel, CLIPTextConfig, CLIPVisionConfig, FlaxCLIPModel
+
+        config = CLIPConfig(
+            text_config=CLIPTextConfig(
+                vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                intermediate_size=37, max_position_embeddings=32,
+            ).to_dict(),
+            vision_config=CLIPVisionConfig(
+                hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+                intermediate_size=37, image_size=30, patch_size=6,
+            ).to_dict(),
+            projection_dim=16,
+        )
+        torch_model = CLIPModel(config)
+        torch_model.eval()
+        torch_model.save_pretrained(str(tmp_path / "tiny_clip"))
+        flax_model = FlaxCLIPModel.from_pretrained(str(tmp_path / "tiny_clip"), from_pt=True)
+
+        rng = np.random.RandomState(4)
+        pixels = rng.rand(2, 3, 30, 30).astype(np.float32)
+        input_ids = rng.randint(0, 99, (2, 12))
+        attention_mask = np.ones_like(input_ids)
+        with torch.no_grad():
+            want_img = torch_model.get_image_features(torch.from_numpy(pixels)).numpy()
+            want_txt = torch_model.get_text_features(
+                torch.from_numpy(input_ids), attention_mask=torch.from_numpy(attention_mask)
+            ).numpy()
+        got_img = flax_model.get_image_features(jnp.asarray(pixels))
+        got_txt = flax_model.get_text_features(
+            jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask)
+        )
+        _assert_allclose(got_img, want_img, atol=2e-4)
+        _assert_allclose(got_txt, want_txt, atol=2e-4)
+
+
 class TestLpipsHeads:
     @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
     def test_bundled_heads_match_reference(self, net_type):

@@ -80,13 +80,15 @@ def calculate_contingency_matrix(
 
 
 def check_cluster_labels(preds: Array, target: Array) -> None:
-    """Require same-shape 1D integer label tensors."""
+    """Require same-shape 1D integer label tensors (shape/dtype only — trace-safe)."""
     _check_same_shape(preds, target)
-    if np.asarray(preds).ndim != 1:
+    if preds.ndim != 1:
         raise ValueError("Expected arguments to be 1-d tensors.")
-    if any(np.issubdtype(np.asarray(x).dtype, np.floating) for x in (preds, target)):
-        p, t = np.asarray(preds), np.asarray(target)
-        raise ValueError(f"Expected real, discrete values for x but received {p.dtype} and {t.dtype}.")
+    if any(jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) for x in (preds, target)):
+        raise ValueError(
+            "Expected real, discrete values for x but received"
+            f" {jnp.asarray(preds).dtype} and {jnp.asarray(target).dtype}."
+        )
 
 
 def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:

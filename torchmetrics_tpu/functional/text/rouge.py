@@ -41,7 +41,9 @@ ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
 }
 ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
-_STAT_NAMES = ("precision", "recall", "fmeasure")
+# output key order matches the reference (fmeasure first); columns of the
+# internal (p, r, f) score rows are looked up by index
+_STAT_COLUMNS = {"fmeasure": 2, "precision": 0, "recall": 1}
 
 
 # ------------------------------------------------------------------ text preparation
@@ -317,7 +319,7 @@ def _rouge_score_update(
             sample = stacked.mean(axis=0)
 
         for key_idx, key in enumerate(rouge_keys_values):
-            results[key].append({name: float(sample[key_idx, s]) for s, name in enumerate(_STAT_NAMES)})
+            results[key].append({name: float(sample[key_idx, col]) for name, col in _STAT_COLUMNS.items()})
 
     return results
 
@@ -384,7 +386,7 @@ def rouge_score(
     )
 
     output: Dict[str, List[float]] = {
-        f"rouge{rouge_key}_{tp}": [] for rouge_key in rouge_keys_values for tp in _STAT_NAMES
+        f"rouge{rouge_key}_{tp}": [] for rouge_key in rouge_keys_values for tp in _STAT_COLUMNS
     }
     for rouge_key, metrics in sentence_results.items():
         for metric in metrics:

@@ -170,10 +170,18 @@ def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
 
 
 def _flexible_bincount(x: Array) -> Array:
-    """Bincount over the *observed* unique values (host-side; not jit-safe).
+    """Bincount over the *observed* unique values — EAGER ONLY, raises under jit.
 
-    Parity: reference ``utilities/data.py:210-228``.
+    Both the output shape (number of uniques) and the inner ``minlength`` are
+    data-dependent, so no XLA formulation exists; ``int(jnp.max(x))`` forces a host
+    sync by design. Callers (retrieval's per-query grouping) run at host-side
+    compute time. Parity: reference ``utilities/data.py:210-228``.
     """
+    if isinstance(x, jax.core.Tracer):
+        raise ValueError(
+            "`_flexible_bincount` has data-dependent output shapes and cannot run"
+            " under jit; call it from host-side (eager) compute only."
+        )
     x = x - jnp.min(x)
     unique_ids = jnp.unique(x)
     return _bincount(x, minlength=int(jnp.max(x)) + 1)[unique_ids]
