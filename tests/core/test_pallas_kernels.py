@@ -1,0 +1,97 @@
+"""Interpret-mode parity tests for the Pallas TPU kernels.
+
+No TPU is reachable from the test environment, so the kernels run under
+``interpret=True`` — same kernel code, CPU interpreter — and must match the XLA
+reference formulations exactly (float32 counting of integer events is exact).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from torchmetrics_tpu.ops import binned_curve_counts_pallas, confusion_matrix_pallas, pallas_enabled
+
+
+class TestConfusionMatrixKernel:
+    @pytest.mark.parametrize("n, c", [(100, 5), (1024, 10), (1500, 130), (7, 3)])
+    def test_matches_dense_reference(self, n, c):
+        rng = np.random.RandomState(n + c)
+        preds = rng.randint(0, c, n)
+        target = rng.randint(0, c, n)
+        valid = rng.rand(n) > 0.2
+
+        got = confusion_matrix_pallas(
+            jnp.asarray(preds), jnp.asarray(target), jnp.asarray(valid), c, interpret=True
+        )
+        want = np.zeros((c, c))
+        for p, t, v in zip(preds, target, valid):
+            if v:
+                want[t, p] += 1
+        _assert_allclose(got, want, atol=0)
+
+    def test_empty_input_is_zero(self):
+        got = confusion_matrix_pallas(
+            jnp.zeros(0, dtype=jnp.int32), jnp.zeros(0, dtype=jnp.int32),
+            jnp.zeros(0, dtype=bool), 4, interpret=True,
+        )
+        _assert_allclose(got, np.zeros((4, 4)), atol=0)
+        curve = binned_curve_counts_pallas(
+            jnp.zeros(0), jnp.zeros(0, dtype=jnp.int32), jnp.zeros(0, dtype=bool),
+            jnp.linspace(0, 1, 5), interpret=True,
+        )
+        _assert_allclose(curve, np.zeros((5, 2)), atol=0)
+
+    def test_all_invalid_is_zero(self):
+        got = confusion_matrix_pallas(
+            jnp.asarray([0, 1, 2]), jnp.asarray([1, 2, 0]), jnp.zeros(3, dtype=bool), 3,
+            interpret=True,
+        )
+        _assert_allclose(got, np.zeros((3, 3)), atol=0)
+
+    def test_matches_stat_scores_engine(self):
+        from torchmetrics_tpu.functional.classification.stat_scores import multiclass_stat_scores
+
+        rng = np.random.RandomState(0)
+        n, c = 512, 7
+        preds = rng.rand(n, c).astype(np.float32)
+        target = rng.randint(0, c, n)
+        confmat = confusion_matrix_pallas(
+            jnp.asarray(preds.argmax(1)), jnp.asarray(target), jnp.ones(n, dtype=bool), c,
+            interpret=True,
+        )
+        tp = jnp.diagonal(confmat)
+        fp = confmat.sum(axis=0) - tp
+        fn = confmat.sum(axis=1) - tp
+        ss = multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), c, average=None)
+        _assert_allclose(tp, ss[:, 0], atol=0)
+        _assert_allclose(fp, ss[:, 1], atol=0)
+        _assert_allclose(fn, ss[:, 3], atol=0)
+
+
+class TestBinnedCurveKernel:
+    @pytest.mark.parametrize("n, t", [(256, 20), (1000, 101), (50, 7)])
+    def test_matches_dense_reference(self, n, t):
+        rng = np.random.RandomState(n + t)
+        scores = rng.rand(n).astype(np.float32)
+        labels = rng.randint(0, 2, n)
+        valid = rng.rand(n) > 0.1
+        thresholds = np.linspace(0, 1, t).astype(np.float32)
+
+        got = binned_curve_counts_pallas(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(valid),
+            jnp.asarray(thresholds), interpret=True,
+        )
+        above = scores[None, :] >= thresholds[:, None]
+        want_tp = (above & (labels == 1)[None] & valid[None]).sum(1)
+        want_fp = (above & (labels == 0)[None] & valid[None]).sum(1)
+        _assert_allclose(got[:, 0], want_tp, atol=0)
+        _assert_allclose(got[:, 1], want_fp, atol=0)
+
+
+def test_pallas_disabled_off_tpu():
+    # env opt-in AND a tpu backend are both required
+    assert pallas_enabled() is False

@@ -1,0 +1,146 @@
+"""Exercised CLIPScore / CLIP-IQA tests on a fabricated tiny local CLIP checkpoint.
+
+The real OpenAI CLIP weights cannot exist in this image (zero egress) so round-2
+shipped these metrics gated-but-unexercised. A complete checkpoint directory can be
+fabricated offline though — tiny random FlaxCLIPModel + toy single-character BPE
+tokenizer + 30px image processor — which drives the full metric path end to end:
+processor batching, flax forwards, cosine/softmax scoring, and state accumulation.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from torchmetrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+pytestmark = pytest.mark.skipif(not _TRANSFORMERS_AVAILABLE, reason="transformers required")
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_dir(tmp_path_factory):
+    from transformers import (
+        CLIPConfig,
+        CLIPImageProcessor,
+        CLIPProcessor,
+        CLIPTextConfig,
+        CLIPTokenizer,
+        CLIPVisionConfig,
+        FlaxCLIPModel,
+    )
+
+    d = str(tmp_path_factory.mktemp("assets") / "tiny_clip")
+    os.makedirs(d, exist_ok=True)
+
+    chars = "abcdefghijklmnopqrstuvwxyz0123456789"
+    vocab = {}
+    for c in chars:
+        vocab[c] = len(vocab)
+    for c in chars:
+        vocab[c + "</w>"] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(d + "/vocab.json", "w") as fh:
+        json.dump(vocab, fh)
+    with open(d + "/merges.txt", "w") as fh:
+        fh.write("#version: 0.2\n")
+    tokenizer = CLIPTokenizer(d + "/vocab.json", d + "/merges.txt")
+
+    config = CLIPConfig(
+        text_config=CLIPTextConfig(
+            vocab_size=tokenizer.vocab_size, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=37, max_position_embeddings=77,
+        ).to_dict(),
+        vision_config=CLIPVisionConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=37, image_size=30, patch_size=6,
+        ).to_dict(),
+        projection_dim=16,
+    )
+    FlaxCLIPModel(config).save_pretrained(d)
+    image_processor = CLIPImageProcessor(size={"shortest_edge": 30}, crop_size={"height": 30, "width": 30})
+    CLIPProcessor(image_processor=image_processor, tokenizer=tokenizer).save_pretrained(d)
+    return d
+
+
+def _images(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randint(0, 255, (n, 3, 30, 30)).astype(np.uint8))
+
+
+class TestClipScore:
+    def test_functional_matches_manual_cosine(self, tiny_clip_dir):
+        from transformers import CLIPProcessor, FlaxCLIPModel
+
+        from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
+
+        imgs = _images(2)
+        texts = ["a cat runs", "blue sky over dog"]
+        got = clip_score(imgs, texts, model_name_or_path=tiny_clip_dir)
+
+        model = FlaxCLIPModel.from_pretrained(tiny_clip_dir, local_files_only=True)
+        processor = CLIPProcessor.from_pretrained(tiny_clip_dir, local_files_only=True)
+        done = processor(
+            text=texts, images=[np.asarray(i) for i in imgs], return_tensors="np", padding=True
+        )
+        img_f = model.get_image_features(done["pixel_values"])
+        txt_f = model.get_text_features(done["input_ids"], done["attention_mask"])
+        img_f = img_f / np.linalg.norm(img_f, axis=-1, keepdims=True)
+        txt_f = txt_f / np.linalg.norm(txt_f, axis=-1, keepdims=True)
+        want = np.maximum(100 * (np.asarray(img_f) * np.asarray(txt_f)).sum(-1).mean(), 0)
+        _assert_allclose(got, want, atol=1e-3)
+
+    def test_module_accumulates_mean(self, tiny_clip_dir):
+        from torchmetrics_tpu.multimodal import CLIPScore
+
+        metric = CLIPScore(model_name_or_path=tiny_clip_dir)
+        metric.update(_images(2, seed=1), ["the cat sat", "dogs run fast"])
+        metric.update(_images(3, seed=2), ["a blue sky", "over the lazy dog", "cat and dog"])
+        value = float(metric.compute())
+        assert np.isfinite(value)
+        assert -100.0 <= value <= 100.0
+
+    def test_mismatched_lengths_raise(self, tiny_clip_dir):
+        from torchmetrics_tpu.functional.multimodal.clip_score import clip_score
+
+        with pytest.raises(ValueError, match="number of images and text"):
+            clip_score(_images(2), ["only one"], model_name_or_path=tiny_clip_dir)
+
+
+class TestClipIqa:
+    def test_single_prompt_probabilities(self, tiny_clip_dir):
+        from torchmetrics_tpu.functional.multimodal.clip_iqa import clip_image_quality_assessment
+
+        imgs = jnp.asarray(np.random.RandomState(3).rand(2, 3, 30, 30).astype(np.float32))
+        probs = clip_image_quality_assessment(imgs, model_name_or_path=tiny_clip_dir)
+        assert probs.shape == (2,)
+        assert bool(((probs >= 0) & (probs <= 1)).all())
+
+    def test_multiple_and_custom_prompts(self, tiny_clip_dir):
+        from torchmetrics_tpu.functional.multimodal.clip_iqa import clip_image_quality_assessment
+
+        imgs = jnp.asarray(np.random.RandomState(4).rand(2, 3, 30, 30).astype(np.float32))
+        out = clip_image_quality_assessment(
+            imgs,
+            model_name_or_path=tiny_clip_dir,
+            prompts=("quality", ("a sharp photo", "a blurry photo")),
+        )
+        assert set(out) == {"quality", "user_defined_0"}
+        for v in out.values():
+            assert v.shape == (2,)
+            assert bool(((v >= 0) & (v <= 1)).all())
+
+    def test_module(self, tiny_clip_dir):
+        from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+
+        metric = CLIPImageQualityAssessment(model_name_or_path=tiny_clip_dir)
+        imgs = jnp.asarray(np.random.RandomState(5).rand(2, 3, 30, 30).astype(np.float32))
+        metric.update(imgs)
+        value = metric.compute()
+        assert bool(jnp.isfinite(jnp.asarray(value)).all())

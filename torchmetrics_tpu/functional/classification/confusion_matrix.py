@@ -45,7 +45,21 @@ def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) ->
 
 
 def _masked_confmat(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
-    """[C, C] counts of (target=row, pred=col) pairs where ``valid``; MXU contraction."""
+    """[C, C] counts of (target=row, pred=col) pairs where ``valid``.
+
+    Default path: one-hot MXU contraction (scatter-free, XLA fuses the one-hots
+    into the matmul). Opt-in (``TM_TPU_USE_PALLAS=1`` on a TPU backend): the Pallas
+    kernel that builds one-hot tiles in VMEM and keeps the accumulator resident —
+    shared by the stat-scores engine and the confusion-matrix family.
+    """
+    from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled():
+        from torchmetrics_tpu.ops.pallas_kernels import confusion_matrix_pallas
+
+        return confusion_matrix_pallas(
+            preds.astype(jnp.int32), target.astype(jnp.int32), valid, num_classes
+        ).astype(jnp.int32)
     pred_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
     targ_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * valid.astype(jnp.float32)[:, None]
     return jnp.einsum("nt,np->tp", targ_oh, pred_oh).astype(jnp.int32)

@@ -303,14 +303,15 @@ def _multiclass_stat_scores_update(
         tn = jnp.sum(~p & ~t & v, axis=sum_dims).astype(jnp.int32)
         return tp, fp, tn, fn
 
-    # global, top_k == 1: confusion matrix as a one-hot matmul — targᵀ·pred one-hots
-    # contract on the MXU (scatter-free; float32 counting is exact below 2^24 per cell).
+    # global, top_k == 1: confusion matrix as a one-hot contraction (MXU; the shared
+    # helper also carries the opt-in Pallas kernel — float32 counting is exact below
+    # 2^24 per cell)
+    from torchmetrics_tpu.functional.classification.confusion_matrix import _masked_confmat
+
     preds_f = preds.reshape(-1).astype(jnp.int32)
     target_f = target_safe.reshape(-1)
     valid_f = valid.reshape(-1)
-    pred_oh = jax.nn.one_hot(preds_f, num_classes, dtype=jnp.float32)
-    targ_oh = jax.nn.one_hot(target_f, num_classes, dtype=jnp.float32) * valid_f[:, None]
-    confmat = jnp.einsum("nt,np->tp", targ_oh, pred_oh).astype(jnp.int32)
+    confmat = _masked_confmat(preds_f, target_f, valid_f, num_classes)
     tp = jnp.diagonal(confmat)
     fp = confmat.sum(axis=0) - tp
     fn = confmat.sum(axis=1) - tp

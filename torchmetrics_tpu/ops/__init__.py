@@ -1,0 +1,9 @@
+"""Hand-written TPU (Pallas) kernels for the metric hot loops."""
+
+from torchmetrics_tpu.ops.pallas_kernels import (
+    binned_curve_counts_pallas,
+    confusion_matrix_pallas,
+    pallas_enabled,
+)
+
+__all__ = ["binned_curve_counts_pallas", "confusion_matrix_pallas", "pallas_enabled"]

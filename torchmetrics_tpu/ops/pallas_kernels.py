@@ -1,0 +1,178 @@
+"""Pallas TPU kernels for the classification hot ops.
+
+Two fused kernels back the stat-scores engine (see ``functional/classification``):
+
+- :func:`confusion_matrix_pallas` — tiles the sample axis, builds each tile's
+  one-hot blocks directly in VMEM via iota compares, and contracts them on the MXU
+  into a resident [C, C] accumulator. The XLA path materialises two [N, C] one-hot
+  operands; the kernel's HBM traffic is just the two [N] label vectors.
+- :func:`binned_curve_counts_pallas` — the binned PrecisionRecallCurve update:
+  per-threshold tp/fp counts from score/label tiles on the VPU, [T, 2] out.
+
+Both run under ``interpret=True`` on CPU for tests; the real-hardware path is
+opt-in from the stat-scores engine via ``TM_TPU_USE_PALLAS=1`` (the XLA fallback
+fuses well already — the kernels exist for the memory-bound regime where skipping
+the one-hot round trip matters).
+
+Grid accumulation relies on the TPU grid executing sequentially per core (revisit
+for Megacore dimension-parallel grids).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_SAMPLE_TILE = 1024
+_LANE = 128
+
+
+def pallas_enabled() -> bool:
+    """Whether the stat-scores engine should route through the Pallas kernels."""
+    return os.environ.get("TM_TPU_USE_PALLAS", "0") == "1" and jax.default_backend() == "tpu"
+
+
+def _pad_to(x: Array, size: int, fill) -> Array:
+    if x.shape[0] == size:
+        return x
+    return jnp.pad(x, (0, size - x.shape[0]), constant_values=fill)
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "interpret"))
+def confusion_matrix_pallas(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    num_classes: int,
+    interpret: bool = False,
+) -> Array:
+    """[C, C] confusion matrix (rows = target, cols = preds) from label vectors.
+
+    ``preds``/``target`` are int32 [N]; ``valid`` masks ignored samples. Counting is
+    exact in float32 up to 2^24 per cell (same contract as the XLA one-hot path).
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = preds.shape[0]
+    if n == 0:
+        # a zero-length grid would never run the init/flush steps — the output
+        # buffer must not be left uninitialized
+        return jnp.zeros((num_classes, num_classes), dtype=jnp.float32)
+    c_pad = max(_LANE, ((num_classes + _LANE - 1) // _LANE) * _LANE)
+    # 1-D blocks need a lane-aligned (128) last dim for Mosaic lowering on hardware
+    tile = min(_SAMPLE_TILE, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+    n_pad = ((n + tile - 1) // tile) * tile
+
+    # invalid/padded samples route to class index c_pad-1 with valid=0 weight
+    preds_p = _pad_to(preds.astype(jnp.int32), n_pad, 0)
+    target_p = _pad_to(target.astype(jnp.int32), n_pad, 0)
+    weight_p = _pad_to(valid.astype(jnp.float32), n_pad, 0.0)
+
+    def kernel(p_ref, t_ref, w_ref, out_ref, acc_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        classes = jax.lax.broadcasted_iota(jnp.int32, (tile, c_pad), 1)
+        pred_oh = (p_ref[:][:, None] == classes).astype(jnp.float32)
+        # fold the validity weight into the target side only (one multiply)
+        targ_oh = (t_ref[:][:, None] == classes).astype(jnp.float32) * w_ref[:][:, None]
+        acc_ref[:] += jax.lax.dot_general(
+            targ_oh,
+            pred_oh,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+        @pl.when(step == pl.num_programs(0) - 1)
+        def _flush():
+            out_ref[:] = acc_ref[:]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+        ],
+        out_specs=pl.BlockSpec((c_pad, c_pad), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((c_pad, c_pad), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((c_pad, c_pad), jnp.float32)],
+        interpret=interpret,
+    )(preds_p, target_p, weight_p)
+    return out[:num_classes, :num_classes]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def binned_curve_counts_pallas(
+    scores: Array,
+    labels: Array,
+    valid: Array,
+    thresholds: Array,
+    interpret: bool = False,
+) -> Array:
+    """Per-threshold [T, 2] (tp, fp) counts for the binned curve family.
+
+    ``tp[t] = sum(valid & label & (score >= thr_t))``,
+    ``fp[t] = sum(valid & ~label & (score >= thr_t))`` — fn/tn follow from the
+    (cheap) global positive/negative totals outside the kernel.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = scores.shape[0]
+    t = thresholds.shape[0]
+    if n == 0:
+        return jnp.zeros((t, 2), dtype=jnp.float32)
+    t_pad = max(8, ((t + 7) // 8) * 8)
+    tile = min(_SAMPLE_TILE, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+    n_pad = ((n + tile - 1) // tile) * tile
+
+    scores_p = _pad_to(scores.astype(jnp.float32), n_pad, 0.0)
+    pos_p = _pad_to((labels.astype(jnp.bool_) & valid.astype(jnp.bool_)).astype(jnp.float32), n_pad, 0.0)
+    neg_p = _pad_to((~labels.astype(jnp.bool_) & valid.astype(jnp.bool_)).astype(jnp.float32), n_pad, 0.0)
+    thr_p = jnp.pad(
+        thresholds.astype(jnp.float32), (0, t_pad - t), constant_values=jnp.finfo(jnp.float32).max
+    )
+
+    def kernel(s_ref, pos_ref, neg_ref, thr_ref, out_ref, acc_ref):
+        step = pl.program_id(0)
+
+        @pl.when(step == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        above = (s_ref[:][None, :] >= thr_ref[:][:, None]).astype(jnp.float32)  # [T, tile]
+        tp = above @ pos_ref[:]  # [T]
+        fp = above @ neg_ref[:]
+        acc_ref[:, 0] += tp
+        acc_ref[:, 1] += fp
+
+        @pl.when(step == pl.num_programs(0) - 1)
+        def _flush():
+            out_ref[:] = acc_ref[:]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec((t_pad,), lambda i: (0,)),
+        ],
+        out_specs=pl.BlockSpec((t_pad, 2), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((t_pad, 2), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((t_pad, 2), jnp.float32)],
+        interpret=interpret,
+    )(scores_p, pos_p, neg_p, thr_p)
+    return out[:t]
