@@ -138,3 +138,60 @@ class TestGroupFairness(MetricTester):
         preds, target, groups = self._data()
         out = binary_fairness(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(groups), task="all")
         assert len(out) == 2
+
+
+class TestDiceMulticlassOverride:
+    """Legacy `multiclass` input-inference override (reference ``dice.py:155,173``)."""
+
+    def _cmp(self, ours_kw, p, t):
+        import numpy as np
+        import torch
+
+        import jax.numpy as jnp
+
+        from tests.helpers.torch_ref import reference_torchmetrics
+        from torchmetrics_tpu import Dice
+
+        tm_ref = reference_torchmetrics()
+        ours = Dice(**ours_kw)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref = tm_ref.classification.Dice(**ours_kw)
+        ref.update(torch.from_numpy(np.asarray(p)), torch.from_numpy(np.asarray(t)))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5)
+
+    def test_binary_probs_forced_multiclass(self):
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        self._cmp({"multiclass": True, "num_classes": 2}, rng.rand(64).astype(np.float32), rng.randint(0, 2, 64))
+
+    def test_binary_labels_forced_multiclass(self):
+        import numpy as np
+
+        rng = np.random.RandomState(1)
+        self._cmp(
+            {"multiclass": True, "num_classes": 2},
+            rng.randint(0, 2, 64).astype(np.int64),
+            rng.randint(0, 2, 64),
+        )
+
+    def test_multilabel_forced_not_multiclass(self):
+        import numpy as np
+
+        rng = np.random.RandomState(2)
+        self._cmp({"multiclass": False}, rng.rand(16, 4).astype(np.float32), rng.randint(0, 2, (16, 4)))
+
+    def test_conflicting_extra_dim_raises(self):
+        import numpy as np
+        import pytest
+
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.functional.classification import dice
+
+        with pytest.raises(ValueError, match="multiclass=False"):
+            dice(
+                jnp.asarray(np.random.rand(8, 3).astype(np.float32)),
+                jnp.asarray(np.random.randint(0, 3, 8)),
+                multiclass=False,
+            )

@@ -45,6 +45,7 @@ class Dice(Metric):
         mdmc_average: Optional[str] = "global",
         ignore_index: Optional[int] = None,
         top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -62,6 +63,7 @@ class Dice(Metric):
         self.mdmc_average = mdmc_average
         self.ignore_index = ignore_index
         self.top_k = top_k
+        self.multiclass = multiclass
         if num_classes is None:
             # class-count inference reads concrete values — not traceable
             self._jit_update_flag = False
@@ -78,7 +80,7 @@ class Dice(Metric):
         """Accumulate tp/fp/fn counts."""
         tp, fp, fn = _dice_update(
             preds, target, self.threshold, self.ignore_index, self.top_k, self.num_classes,
-            samplewise=self._samplewise,
+            samplewise=self._samplewise, multiclass=self.multiclass,
         )
         if self._samplewise:
             self.tp.append(tp)

@@ -31,12 +31,24 @@ def _dice_format_onehot(
     threshold: float = 0.5,
     top_k: Optional[int] = None,
     num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array, bool]:
-    """Convert any legacy input mode to one-hot [N, C, X] pairs; returns (p, t, binary)."""
+    """Convert any legacy input mode to one-hot [N, C, X] pairs; returns (p, t, binary).
+
+    ``multiclass`` overrides the shape/dtype inference (legacy
+    ``_input_format_classification`` semantics): ``True`` forces binary-looking
+    inputs to be counted as 2-class one-hots; ``False`` forces same-shape inputs
+    onto the positives-only (binary/multilabel) path.
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     binary = False
     if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == target.ndim + 1:
+        if multiclass is False:
+            raise ValueError(
+                "You can not use `multiclass=False` with `preds` carrying an extra class"
+                " dimension over `target`."
+            )
         # multiclass probabilities [N, C, ...]
         num_classes = num_classes or preds.shape[1]
         if top_k and top_k > 1:
@@ -55,7 +67,17 @@ def _dice_format_onehot(
     int_max = None if isinstance(preds, jax.core.Tracer) else int(max(int(jnp.max(preds)), int(jnp.max(target))))
     if num_classes is None:
         num_classes = 2 if (binary or (int_max is not None and int_max <= 1)) else (int_max or 1) + 1
-    if num_classes <= 2 and preds.shape == target.shape and (int_max is None or int_max <= 1):
+    take_binary_path = (
+        num_classes <= 2 and preds.shape == target.shape and (int_max is None or int_max <= 1)
+    )
+    if multiclass is True:
+        take_binary_path = False
+        num_classes = max(num_classes, 2)
+    elif multiclass is False:
+        take_binary_path = preds.shape == target.shape
+        if not take_binary_path:
+            raise ValueError("`multiclass=False` requires `preds` and `target` of the same shape.")
+    if take_binary_path:
         # binary labels: count only the positive class
         p = preds.reshape(preds.shape[0], 1, -1).astype(jnp.int32)
         t = target.reshape(target.shape[0], 1, -1).astype(jnp.int32)
@@ -73,9 +95,10 @@ def _dice_update(
     top_k: Optional[int] = None,
     num_classes: Optional[int] = None,
     samplewise: bool = False,
+    multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array, Array]:
     """(tp, fp, fn): [C] (global) or [N, C] (samplewise) from one-hot pairs."""
-    p_oh, t_oh, binary = _dice_format_onehot(preds, target, threshold, top_k, num_classes)
+    p_oh, t_oh, binary = _dice_format_onehot(preds, target, threshold, top_k, num_classes, multiclass)
     dims = (0, 2) if not samplewise else (2,)
     tp = jnp.sum((p_oh == 1) & (t_oh == 1), axis=dims).astype(jnp.float32)
     fp = jnp.sum((p_oh == 1) & (t_oh == 0), axis=dims).astype(jnp.float32)
@@ -119,6 +142,7 @@ def dice(
     threshold: float = 0.5,
     num_classes: Optional[int] = None,
     top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
     """Dice score: ``2·tp / (2·tp + fp + fn)``.
@@ -136,7 +160,8 @@ def dice(
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
     samplewise = average == "samples" or mdmc_average == "samplewise"
     tp, fp, fn = _dice_update(
-        preds, target, threshold, ignore_index, top_k, num_classes, samplewise=samplewise
+        preds, target, threshold, ignore_index, top_k, num_classes, samplewise=samplewise,
+        multiclass=multiclass,
     )
     if average == "weighted":
         scores = safe_divide(2 * tp, 2 * tp + fp + fn, zero_division)
