@@ -38,7 +38,6 @@ def _eed_function(
     hyp_chars = np.frombuffer(hyp.encode("utf-32-le"), dtype=np.uint32) if n else np.empty(0, np.uint32)
     number_of_visits = np.full(n + 1, -1, dtype=np.int64)
 
-    idx_del = np.arange(n + 1) * deletion
     row = np.ones(n + 1)
     row[0] = 0.0
 
@@ -47,9 +46,14 @@ def _eed_function(
         # base[i] (i>=1): best of substitution/identity and insertion into row i
         sub = row[:-1] + (hyp_chars != ref_char).astype(np.float64)
         ins = row[1:] + insertion
-        base = np.concatenate(([row[0] + 1.0], np.minimum(sub, ins)))
-        # deletion chain resolves as a prefix-min over (base[k] - k*d) + i*d
-        next_row = np.minimum.accumulate(base - idx_del) + idx_del
+        next_row = np.concatenate(([row[0] + 1.0], np.minimum(sub, ins)))
+        # the deletion chain must accumulate sequentially: a closed-form prefix-min
+        # ((base[k] - k*d) + i*d) is not float-identical, and the min_index pick
+        # below turns ulp differences into different coverage counts
+        for i in range(1, n + 1):
+            step = next_row[i - 1] + deletion
+            if step < next_row[i]:
+                next_row[i] = step
 
         min_index = int(np.argmin(next_row))
         number_of_visits[min_index] += 1
