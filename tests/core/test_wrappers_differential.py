@@ -1,0 +1,183 @@
+"""Wrapper + composition differential tests vs the reference implementation.
+
+The existing wrapper tests are behavioral; these pit the deterministic wrappers
+(ClasswiseWrapper, MinMaxMetric, MultioutputWrapper, MultitaskWrapper, Tracker) and
+the CompositionalMetric operator algebra directly against the reference package on
+identical update streams. BootStrapper is excluded (different RNG machinery).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+torch = pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+NUM_CLASSES = 4
+_rng = np.random.RandomState(99)
+
+
+def _stream(n_batches=4, n=32):
+    return (
+        [_rng.rand(n, NUM_CLASSES).astype(np.float32) for _ in range(n_batches)],
+        [_rng.randint(0, NUM_CLASSES, n) for _ in range(n_batches)],
+    )
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+class TestClasswiseDifferential:
+    def test_matches_reference_keys_and_values(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+        from torchmetrics_tpu.wrappers import ClasswiseWrapper
+
+        preds, targets = _stream()
+        ours = ClasswiseWrapper(MulticlassAccuracy(NUM_CLASSES, average=None))
+        ref = tm_ref.ClasswiseWrapper(tm_ref.classification.MulticlassAccuracy(NUM_CLASSES, average=None))
+        for p, t in zip(preds, targets):
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+        got, want = ours.compute(), ref.compute()
+        assert set(got) == set(want)
+        for key in want:
+            _assert_allclose(got[key], want[key].numpy(), atol=1e-5)
+
+
+class TestMinMaxDifferential:
+    def test_update_compute_stream_matches_reference(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+        from torchmetrics_tpu.wrappers import MinMaxMetric
+
+        preds, targets = _stream(6)
+        ours = MinMaxMetric(MulticlassAccuracy(NUM_CLASSES))
+        ref = tm_ref.MinMaxMetric(tm_ref.classification.MulticlassAccuracy(NUM_CLASSES))
+        for p, t in zip(preds, targets):
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+            got, want = ours.compute(), ref.compute()
+            for key in ("raw", "min", "max"):
+                _assert_allclose(got[key], want[key].numpy(), atol=1e-5)
+
+    def test_forward_stream_batch_values_match_reference(self):
+        """Per-batch forward dicts agree; the FINAL compute intentionally diverges.
+
+        The reference's MinMaxMetric.forward restore-cache only covers the wrapper's
+        own min/max states, so each forward leaves the base metric holding batch-only
+        state — a post-stream compute() returns the LAST batch's value as ``raw``.
+        Ours preserves the base metric's accumulation (raw = whole-stream value),
+        while the extrema match the reference exactly.
+        """
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+        from torchmetrics_tpu.wrappers import MinMaxMetric
+
+        preds, targets = _stream(6)
+        ours = MinMaxMetric(MulticlassAccuracy(NUM_CLASSES))
+        ref = tm_ref.MinMaxMetric(tm_ref.classification.MulticlassAccuracy(NUM_CLASSES))
+        for p, t in zip(preds, targets):
+            got_b = ours(jnp.asarray(p), jnp.asarray(t))
+            want_b = ref(_t(p), _t(t))
+            for key in ("raw", "min", "max"):
+                _assert_allclose(got_b[key], want_b[key].numpy(), atol=1e-5)
+        got, want = ours.compute(), ref.compute()
+        for key in ("min", "max"):
+            _assert_allclose(got[key], want[key].numpy(), atol=1e-5)
+        # accumulated raw: ours equals a fresh metric fed the full stream
+        truth = MulticlassAccuracy(NUM_CLASSES)
+        for p, t in zip(preds, targets):
+            truth.update(jnp.asarray(p), jnp.asarray(t))
+        _assert_allclose(got["raw"], truth.compute(), atol=1e-5)
+
+
+class TestMultioutputDifferential:
+    def test_r2_two_outputs(self):
+        from torchmetrics_tpu.regression import R2Score
+        from torchmetrics_tpu.wrappers import MultioutputWrapper
+
+        ours = MultioutputWrapper(R2Score(), num_outputs=2)
+        ref = tm_ref.MultioutputWrapper(tm_ref.regression.R2Score(), num_outputs=2)
+        for _ in range(4):
+            p = _rng.rand(16, 2).astype(np.float32)
+            t = (p + 0.1 * _rng.rand(16, 2)).astype(np.float32)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4)
+
+
+class TestMultitaskDifferential:
+    def test_mixed_tasks(self):
+        from torchmetrics_tpu.classification import BinaryAccuracy
+        from torchmetrics_tpu.regression import MeanSquaredError
+        from torchmetrics_tpu.wrappers import MultitaskWrapper
+
+        ours = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        ref = tm_ref.MultitaskWrapper(
+            {"cls": tm_ref.classification.BinaryAccuracy(), "reg": tm_ref.regression.MeanSquaredError()}
+        )
+        for _ in range(3):
+            pc = _rng.rand(24).astype(np.float32)
+            tc = _rng.randint(0, 2, 24)
+            pr = _rng.rand(24).astype(np.float32)
+            tr = _rng.rand(24).astype(np.float32)
+            ours.update({"cls": jnp.asarray(pc), "reg": jnp.asarray(pr)}, {"cls": jnp.asarray(tc), "reg": jnp.asarray(tr)})
+            ref.update({"cls": _t(pc), "reg": _t(pr)}, {"cls": _t(tc), "reg": _t(tr)})
+        got, want = ours.compute(), ref.compute()
+        _assert_allclose(got["cls"], want["cls"].numpy(), atol=1e-5)
+        _assert_allclose(got["reg"], want["reg"].numpy(), atol=1e-5)
+
+
+class TestTrackerDifferential:
+    def test_best_metric_and_history(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+        from torchmetrics_tpu.wrappers import MetricTracker
+
+        preds, targets = _stream(6)
+        ours = MetricTracker(MulticlassAccuracy(NUM_CLASSES))
+        ref = tm_ref.MetricTracker(tm_ref.classification.MulticlassAccuracy(NUM_CLASSES))
+        for step in range(3):
+            ours.increment()
+            ref.increment()
+            for p, t in zip(preds[step * 2 : step * 2 + 2], targets[step * 2 : step * 2 + 2]):
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute_all(), ref.compute_all().numpy(), atol=1e-5)
+        _assert_allclose(ours.best_metric(), float(ref.best_metric()), atol=1e-5)
+
+
+class TestCompositionDifferential:
+    def test_operator_algebra(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+        preds, targets = _stream(3)
+        oa = MulticlassAccuracy(NUM_CLASSES)
+        of = MulticlassF1Score(NUM_CLASSES)
+        ra = tm_ref.classification.MulticlassAccuracy(NUM_CLASSES)
+        rf = tm_ref.classification.MulticlassF1Score(NUM_CLASSES)
+        ours_expr = 2 * oa + of / 2 - 0.1
+        ref_expr = 2 * ra + rf / 2 - 0.1
+        for p, t in zip(preds, targets):
+            oa.update(jnp.asarray(p), jnp.asarray(t))
+            of.update(jnp.asarray(p), jnp.asarray(t))
+            ra.update(_t(p), _t(t))
+            rf.update(_t(p), _t(t))
+        _assert_allclose(ours_expr.compute(), ref_expr.compute().numpy(), atol=1e-5)
+
+    def test_unary_ops(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        om = MeanSquaredError()
+        rm = tm_ref.regression.MeanSquaredError()
+        ours_expr = abs(-om)
+        ref_expr = abs(-rm)
+        p = _rng.rand(32).astype(np.float32)
+        t = _rng.rand(32).astype(np.float32)
+        om.update(jnp.asarray(p), jnp.asarray(t))
+        rm.update(_t(p), _t(t))
+        _assert_allclose(ours_expr.compute(), ref_expr.compute().numpy(), atol=1e-6)
