@@ -44,3 +44,27 @@ def test_all_names_are_importable():
 
 def test_metric_collection_has_plot():
     assert callable(getattr(tm.MetricCollection, "plot", None))
+
+
+def test_class_metadata_matches_reference():
+    """higher_is_better / is_differentiable metadata parity for shared exports."""
+    import inspect
+    import sys
+
+    import bench as _bench
+
+    _bench._install_lightning_utilities_stub()
+    if "/root/reference/src" not in sys.path:
+        sys.path.insert(0, "/root/reference/src")
+    import torchmetrics as ref
+
+    drift = []
+    for name in _reference_all():
+        rc = getattr(ref, name, None)
+        oc = getattr(tm, name, None)
+        if rc is None or oc is None or not inspect.isclass(rc):
+            continue
+        for attr in ("higher_is_better", "is_differentiable"):
+            if getattr(rc, attr, "MISSING") != getattr(oc, attr, "MISSING"):
+                drift.append((name, attr, getattr(rc, attr, None), getattr(oc, attr, None)))
+    assert not drift, f"class metadata drift vs reference: {drift}"

@@ -101,7 +101,7 @@ class MaxMetric(BaseAggregator):
     """Running maximum (reference ``aggregation.py:106-168``)."""
 
     full_state_update = True
-    higher_is_better = True
+    higher_is_better = None  # matches the reference (None, not True)
     _nan_fill = -float("inf")
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
@@ -116,7 +116,7 @@ class MinMetric(BaseAggregator):
     """Running minimum (reference ``aggregation.py:171-233``)."""
 
     full_state_update = True
-    higher_is_better = False
+    higher_is_better = None  # matches the reference (None, not False)
     _nan_fill = float("inf")
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:

@@ -259,7 +259,7 @@ class PermutationInvariantTraining(_MeanScoreMetric):
     """
 
     is_differentiable = True
-    higher_is_better = True
+    higher_is_better = None  # matches the reference (depends on the wrapped metric)
 
     def __init__(
         self,

@@ -38,7 +38,7 @@ class SpectralDistortionIndex(Metric):
         True
     """
 
-    higher_is_better = False
+    higher_is_better = True  # matches the reference metadata
     is_differentiable = True
     full_state_update = False
     plot_lower_bound: float = 0.0

@@ -331,7 +331,7 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
     """
 
     higher_is_better = False
-    is_differentiable = False
+    is_differentiable = True
     full_state_update = False
     plot_lower_bound: float = 0.0
 
@@ -378,7 +378,7 @@ class RelativeAverageSpectralError(Metric):
     """
 
     higher_is_better = False
-    is_differentiable = False
+    is_differentiable = True
     full_state_update = False
     plot_lower_bound: float = 0.0
 

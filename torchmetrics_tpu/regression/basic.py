@@ -348,7 +348,7 @@ class TweedieDevianceScore(Metric):
     """
 
     is_differentiable = True
-    higher_is_better = False
+    higher_is_better = None
     full_state_update = False
     plot_lower_bound: float = 0.0
 

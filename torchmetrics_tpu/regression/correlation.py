@@ -94,6 +94,8 @@ class ConcordanceCorrCoef(PearsonCorrCoef):
         Array(0.9777, dtype=float32)
     """
 
+    higher_is_better = True
+
     def compute(self) -> Array:
         """Concordance correlation."""
         mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._aggregated()

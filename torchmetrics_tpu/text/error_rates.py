@@ -186,7 +186,7 @@ class WordInfoPreserved(_TextMetric):
     """
 
     is_differentiable = False
-    higher_is_better = True
+    higher_is_better = False  # matches the reference metadata (its value, odd as it is)
     full_state_update = False
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
