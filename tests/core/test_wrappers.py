@@ -364,3 +364,26 @@ class TestFeatureShare:
 
         with pytest.raises(AttributeError, match="feature_network"):
             FeatureShare([BinaryAccuracy()])
+
+
+class TestTrackerListManagement:
+    def test_append_extend_insert(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+        from torchmetrics_tpu.wrappers import MetricTracker
+
+        rng = np.random.RandomState(0)
+        tracker = MetricTracker(MulticlassAccuracy(num_classes=3))
+        # externally constructed increments, reference ModuleList-style
+        pre = MulticlassAccuracy(num_classes=3)
+        pre.update(jnp.asarray(rng.rand(16, 3).astype("float32")), jnp.asarray(rng.randint(0, 3, 16)))
+        tracker.append(pre)
+        tracker.extend([MulticlassAccuracy(num_classes=3)])
+        tracker.insert(0, MulticlassAccuracy(num_classes=3))
+        assert len(tracker) == 3
+        assert tracker[1] is pre
+        tracker._increment_called = True  # increments were provided externally
+        assert tracker.compute_all().shape[0] == 3

@@ -72,6 +72,22 @@ class MetricTracker:
         self._increment_called = True
         self._increments.append(deepcopy(self._base_metric))
 
+    # list-management parity with the reference's ModuleList base
+    def append(self, metric: Union[Metric, MetricCollection]) -> "MetricTracker":
+        """Append an externally constructed increment (reference ModuleList API)."""
+        self._increments.append(metric)
+        return self
+
+    def extend(self, metrics: List[Union[Metric, MetricCollection]]) -> "MetricTracker":
+        """Extend with externally constructed increments (reference ModuleList API)."""
+        self._increments.extend(metrics)
+        return self
+
+    def insert(self, index: int, metric: Union[Metric, MetricCollection]) -> "MetricTracker":
+        """Insert an externally constructed increment (reference ModuleList API)."""
+        self._increments.insert(index, metric)
+        return self
+
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Forward on the current increment."""
         self._check_for_increment("forward")
