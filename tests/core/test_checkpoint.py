@@ -130,3 +130,56 @@ class TestCheckpoint:
         assert float(live.compute()) == 100.0
         live.load_state_dict(sd)
         assert float(live.compute()) == 0.0
+
+
+class TestBufferedDomainCheckpoints:
+    def test_buffered_detection_roundtrip(self, tmp_path):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.detection import MeanAveragePrecision
+        from torchmetrics_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        rng = np.random.RandomState(0)
+
+        def boxes(n):
+            x1 = rng.uniform(0, 50, (n, 1)); y1 = rng.uniform(0, 50, (n, 1))
+            return np.concatenate([x1, y1, x1 + 10, y1 + 10], 1).astype(np.float32)
+
+        preds = [{"boxes": jnp.asarray(boxes(3)), "scores": jnp.asarray(rng.rand(3).astype(np.float32)),
+                  "labels": jnp.asarray(rng.randint(0, 2, 3))}]
+        target = [{"boxes": jnp.asarray(boxes(2)), "labels": jnp.asarray(rng.randint(0, 2, 2))}]
+
+        metric = MeanAveragePrecision(buffer_capacity=32, image_capacity=8)
+        metric.update(preds, target)
+        want = metric.compute()
+        path = save_checkpoint(metric, str(tmp_path / "det"))
+
+        fresh = MeanAveragePrecision(buffer_capacity=32, image_capacity=8)
+        load_checkpoint(fresh, path)
+        got = fresh.compute()
+        for key in want:
+            np.testing.assert_allclose(np.asarray(got[key]), np.asarray(want[key]), atol=0)
+
+    def test_buffered_retrieval_roundtrip(self, tmp_path):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.retrieval import RetrievalMAP
+        from torchmetrics_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        rng = np.random.RandomState(1)
+        metric = RetrievalMAP(buffer_capacity=64)
+        metric.update(
+            jnp.asarray(rng.rand(20).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 2, 20)),
+            indexes=jnp.asarray(rng.randint(0, 4, 20)),
+        )
+        want = float(metric.compute())
+        path = save_checkpoint(metric, str(tmp_path / "retr"))
+
+        fresh = RetrievalMAP(buffer_capacity=64)
+        load_checkpoint(fresh, path)
+        assert abs(float(fresh.compute()) - want) < 1e-7
