@@ -155,9 +155,19 @@ def _binary_precision_recall_curve_update(
     v = valid.astype(jnp.float32)
     t1 = target.astype(jnp.float32) * v  # positives
     t0 = (1.0 - target.astype(jnp.float32)) * v  # negatives
-    pge = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # [N, T]
-    tps = pge.T @ t1  # [T]
-    fps = pge.T @ t0
+    from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled():
+        # opt-in TPU kernel: threshold-compare tiles stay in VMEM, [T, 2]
+        # accumulator resident — the [N, T] compare matrix never reaches HBM
+        from torchmetrics_tpu.ops.pallas_kernels import binned_curve_counts_pallas
+
+        counts = binned_curve_counts_pallas(preds, target, valid, thresholds)
+        tps, fps = counts[:, 0], counts[:, 1]
+    else:
+        pge = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # [N, T]
+        tps = pge.T @ t1  # [T]
+        fps = pge.T @ t0
     pos = jnp.sum(t1)
     neg = jnp.sum(t0)
     fns = pos - tps
