@@ -1,0 +1,106 @@
+"""Randomized Metric-lifecycle differential fuzz vs the reference runtime.
+
+Random sequences of {update, forward, compute, reset} are applied in lockstep to our
+metric and the reference's; every observable (forward batch values, compute values,
+update counters, reset effects) must agree at every step. This pins the core
+runtime's lifecycle semantics (reference ``tests/unittests/bases/test_metric.py``)
+far beyond the hand-written cases.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+torch = pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+NUM_CLASSES = 4
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def _pairs(seed):
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    kind = seed % 3
+    if kind == 0:
+        return (
+            MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            tm_ref.classification.MulticlassAccuracy(num_classes=NUM_CLASSES, average="macro"),
+            "cls",
+        )
+    if kind == 1:
+        return (
+            MulticlassF1Score(NUM_CLASSES, average="weighted"),
+            tm_ref.classification.MulticlassF1Score(num_classes=NUM_CLASSES, average="weighted"),
+            "cls",
+        )
+    return MeanSquaredError(), tm_ref.regression.MeanSquaredError(), "reg"
+
+
+class TestLifecycleFuzz:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_op_sequences_agree(self, seed):
+        rng = np.random.RandomState(seed)
+        ours, ref, kind = _pairs(seed)
+
+        def batch():
+            if kind == "cls":
+                return rng.rand(16, NUM_CLASSES).astype(np.float32), rng.randint(0, NUM_CLASSES, 16)
+            p = rng.rand(16).astype(np.float32)
+            return p, (p + 0.3 * rng.rand(16)).astype(np.float32)
+
+        has_data = False
+        for _ in range(30):
+            op = rng.choice(["update", "forward", "compute", "reset"], p=[0.4, 0.3, 0.2, 0.1])
+            if op == "update":
+                p, t = batch()
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(_t(p), _t(t))
+                has_data = True
+            elif op == "forward":
+                p, t = batch()
+                got = ours(jnp.asarray(p), jnp.asarray(t))
+                want = ref(_t(p), _t(t))
+                _assert_allclose(got, want.numpy(), atol=1e-5)
+                has_data = True
+            elif op == "compute":
+                if not has_data:
+                    continue  # both would warn; values are degenerate
+                _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+                assert ours.update_count == ref._update_count
+            else:
+                ours.reset()
+                ref.reset()
+                has_data = False
+                assert ours.update_count == 0
+
+        if has_data:
+            _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_interleaved_clone_keeps_independent_state(self, seed):
+        rng = np.random.RandomState(seed)
+        ours, ref, kind = _pairs(seed)
+        p, t = rng.rand(16, NUM_CLASSES).astype(np.float32), rng.randint(0, NUM_CLASSES, 16)
+        if kind == "reg":
+            p = rng.rand(16).astype(np.float32)
+            t = (p + 0.1).astype(np.float32)
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        clone = ours.clone()
+        p2, t2 = (rng.rand(16, NUM_CLASSES).astype(np.float32), rng.randint(0, NUM_CLASSES, 16)) if kind == "cls" else (
+            rng.rand(16).astype(np.float32), rng.rand(16).astype(np.float32))
+        clone.update(jnp.asarray(p2), jnp.asarray(t2))
+        # original must be unaffected by the clone's update
+        before = np.asarray(ours.compute())
+        clone.compute()
+        _assert_allclose(ours.compute(), before, atol=0)
