@@ -1,0 +1,103 @@
+"""Randomized MetricCollection differential fuzz vs the reference.
+
+Our compute groups are decided statically from state specs; the reference merges
+them at runtime with an allclose pass. The observable surface (forward dicts,
+compute dicts, reset behavior, clone with affixes) must nonetheless agree on any
+op sequence — this fuzz drives both through random lockstep streams.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+torch = pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+NUM_CLASSES = 4
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def _collections():
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    ours = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(NUM_CLASSES, average="macro"),
+            "prec": MulticlassPrecision(NUM_CLASSES, average="macro"),
+            "rec": MulticlassRecall(NUM_CLASSES, average="macro"),
+            "f1": MulticlassF1Score(NUM_CLASSES, average="weighted"),
+        },
+        prefix="m_",
+    )
+    ref = tm_ref.MetricCollection(
+        {
+            "acc": tm_ref.classification.MulticlassAccuracy(num_classes=NUM_CLASSES, average="macro"),
+            "prec": tm_ref.classification.MulticlassPrecision(num_classes=NUM_CLASSES, average="macro"),
+            "rec": tm_ref.classification.MulticlassRecall(num_classes=NUM_CLASSES, average="macro"),
+            "f1": tm_ref.classification.MulticlassF1Score(num_classes=NUM_CLASSES, average="weighted"),
+        },
+        prefix="m_",
+    )
+    return ours, ref
+
+
+def _compare_dicts(got, want):
+    want = {k: v.numpy() for k, v in want.items()}
+    assert set(got) == set(want), (sorted(got), sorted(want))
+    for key in want:
+        _assert_allclose(got[key], want[key], atol=1e-5)
+
+
+class TestCollectionFuzz:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_sequences_agree(self, seed):
+        rng = np.random.RandomState(seed)
+        ours, ref = _collections()
+        has_data = False
+        for _ in range(20):
+            op = rng.choice(["update", "forward", "compute", "reset"], p=[0.4, 0.3, 0.2, 0.1])
+            p = rng.rand(16, NUM_CLASSES).astype(np.float32)
+            t = rng.randint(0, NUM_CLASSES, 16)
+            if op == "update":
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(_t(p), _t(t))
+                has_data = True
+            elif op == "forward":
+                _compare_dicts(ours(jnp.asarray(p), jnp.asarray(t)), ref(_t(p), _t(t)))
+                has_data = True
+            elif op == "compute":
+                if not has_data:
+                    continue
+                _compare_dicts(ours.compute(), ref.compute())
+            else:
+                ours.reset()
+                ref.reset()
+                has_data = False
+        if has_data:
+            _compare_dicts(ours.compute(), ref.compute())
+
+    def test_clone_with_affixes_matches(self):
+        rng = np.random.RandomState(9)
+        ours, ref = _collections()
+        ours2 = ours.clone(prefix="x_")
+        ref2 = ref.clone(prefix="x_")
+        p = rng.rand(16, NUM_CLASSES).astype(np.float32)
+        t = rng.randint(0, NUM_CLASSES, 16)
+        ours2.update(jnp.asarray(p), jnp.asarray(t))
+        ref2.update(_t(p), _t(t))
+        _compare_dicts(ours2.compute(), ref2.compute())
