@@ -1,0 +1,75 @@
+"""Streaming differential sweep over the full regression domain.
+
+Every regression class runs a 4-batch update stream in lockstep with the reference
+class — this exercises the accumulate/merge semantics (Pearson's parallel mean/cov
+merge, R2's sums, Kendall/Spearman's cat states) rather than single-shot values.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as O
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+torch = pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+_rng = np.random.RandomState(2024)
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+# (name, ctor kwargs, needs_positive_inputs)
+_CASES = [
+    ("MeanSquaredError", {}, False),
+    ("MeanAbsoluteError", {}, False),
+    ("MeanAbsolutePercentageError", {}, True),
+    ("SymmetricMeanAbsolutePercentageError", {}, True),
+    ("WeightedMeanAbsolutePercentageError", {}, True),
+    ("MeanSquaredLogError", {}, True),
+    ("R2Score", {}, False),
+    ("PearsonCorrCoef", {}, False),
+    ("SpearmanCorrCoef", {}, False),
+    ("KendallRankCorrCoef", {}, False),
+    ("ConcordanceCorrCoef", {}, False),
+    ("CosineSimilarity", {}, False),
+    ("ExplainedVariance", {}, False),
+    ("KLDivergence", {}, True),
+    ("LogCoshError", {}, False),
+    ("MinkowskiDistance", {"p": 3.0}, False),
+    ("RelativeSquaredError", {}, False),
+    ("TweedieDevianceScore", {"power": 1.5}, True),
+    ("CriticalSuccessIndex", {"threshold": 0.5}, False),
+]
+
+
+class TestRegressionStreamSweep:
+    @pytest.mark.parametrize("name, kwargs, positive", _CASES, ids=[c[0] for c in _CASES])
+    def test_four_batch_stream_matches_reference(self, name, kwargs, positive):
+        ours = getattr(O, name)(**kwargs)
+        ref = getattr(tm_ref, name)(**kwargs)
+        for i in range(4):
+            if name == "KLDivergence":
+                # rows must be distributions
+                p = _rng.rand(16, 6).astype(np.float32) + 0.1
+                t = _rng.rand(16, 6).astype(np.float32) + 0.1
+                p /= p.sum(1, keepdims=True)
+                t /= t.sum(1, keepdims=True)
+            elif name == "CosineSimilarity":
+                p = _rng.normal(size=(16, 8)).astype(np.float32)
+                t = _rng.normal(size=(16, 8)).astype(np.float32)
+            else:
+                p = _rng.rand(32).astype(np.float32) if positive else _rng.normal(size=32).astype(np.float32)
+                noise = 0.3 * _rng.rand(32).astype(np.float32)
+                t = (p + noise) if positive else (p + 0.3 * _rng.normal(size=32)).astype(np.float32)
+                t = np.abs(t).astype(np.float32) if positive else t.astype(np.float32)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4)

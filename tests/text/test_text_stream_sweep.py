@@ -1,0 +1,100 @@
+"""Streaming differential sweep over the text-module corpus accumulation.
+
+Multi-batch update streams in lockstep with the reference modules: corpus-level
+metrics must aggregate their n-gram/edit statistics across updates exactly like
+the reference (not just match on single calls).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as O
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+
+def _corpus(n, seed):
+    rng = np.random.RandomState(seed)
+    words = ["the", "cat", "dog", "runs", "fast", "blue", "sky", "over", "jumps", "lazy"]
+    return [" ".join(rng.choice(words, size=rng.randint(2, 10))) for _ in range(n)]
+
+
+_SINGLE_REF_CASES = [
+    ("EditDistance", {}),
+    ("WordErrorRate", {}),
+    ("CharErrorRate", {}),
+    ("MatchErrorRate", {}),
+    ("WordInfoLost", {}),
+    ("WordInfoPreserved", {}),
+]
+
+_MULTI_REF_CASES = [
+    ("BLEUScore", {"n_gram": 2}),
+    ("SacreBLEUScore", {}),
+    ("CHRFScore", {}),
+    ("TranslationEditRate", {}),
+    ("ExtendedEditDistance", {}),
+]
+
+
+class TestTextStreamSweep:
+    @pytest.mark.parametrize("name, kwargs", _SINGLE_REF_CASES, ids=[c[0] for c in _SINGLE_REF_CASES])
+    def test_single_reference_stream(self, name, kwargs):
+        ours = getattr(O, name)(**kwargs)
+        ref = getattr(tm_ref, name, None) or tm_ref.text.EditDistance
+        ref = ref(**kwargs)
+        for step in range(3):
+            preds = _corpus(5, step)
+            target = _corpus(5, step + 50)
+            ours.update(preds, target)
+            ref.update(preds, target)
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("name, kwargs", _MULTI_REF_CASES, ids=[c[0] for c in _MULTI_REF_CASES])
+    def test_multi_reference_stream(self, name, kwargs):
+        ours = getattr(O, name)(**kwargs)
+        ref = getattr(tm_ref, name)(**kwargs)
+        for step in range(3):
+            preds = _corpus(4, step)
+            target = [[t, t2] for t, t2 in zip(_corpus(4, step + 70), _corpus(4, step + 90))]
+            ours.update(preds, target)
+            ref.update(preds, target)
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+
+    def test_squad_stream(self):
+        ours = O.SQuAD()
+        ref = tm_ref.SQuAD()
+        for step in range(2):
+            preds = [
+                {"prediction_text": text, "id": f"q{step}_{i}"}
+                for i, text in enumerate(_corpus(3, step))
+            ]
+            target = [
+                {"answers": {"answer_start": [0], "text": [text]}, "id": f"q{step}_{i}"}
+                for i, text in enumerate(_corpus(3, step + 7))
+            ]
+            ours.update(preds, target)
+            ref.update(preds, target)
+        got, want = ours.compute(), ref.compute()
+        for key in want:
+            _assert_allclose(got[key], want[key].numpy(), atol=1e-5)
+
+    def test_perplexity_stream(self):
+        rng = np.random.RandomState(0)
+        import jax.numpy as jnp
+        import torch
+
+        ours = O.Perplexity(ignore_index=-100)
+        ref = tm_ref.Perplexity(ignore_index=-100)
+        for _ in range(3):
+            logits = rng.normal(size=(2, 8, 12)).astype(np.float32)
+            target = rng.randint(0, 12, (2, 8))
+            target[0, :2] = -100
+            ours.update(jnp.asarray(logits), jnp.asarray(target))
+            ref.update(torch.from_numpy(logits), torch.from_numpy(target))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-3)
