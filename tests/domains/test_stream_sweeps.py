@@ -1,0 +1,144 @@
+"""Streaming differential sweeps: image / audio / clustering / nominal / segmentation.
+
+Multi-batch update streams in lockstep with the reference classes — pins the
+accumulate/merge semantics across every remaining array-input domain (the
+single-shot differentials live in the per-domain test files).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as O
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+torch = pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+_rng = np.random.RandomState(31337)
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+def _img_pair():
+    p = _rng.rand(4, 3, 16, 16).astype(np.float32)
+    t = np.clip(p + 0.1 * _rng.rand(4, 3, 16, 16).astype(np.float32), 0, 1)
+    return p, t
+
+
+_IMAGE_CASES = [
+    ("PeakSignalNoiseRatio", {"data_range": 1.0}),
+    ("StructuralSimilarityIndexMeasure", {"data_range": 1.0}),
+    ("MultiScaleStructuralSimilarityIndexMeasure", {"data_range": 1.0, "kernel_size": 3, "betas": (0.4, 0.6)}),
+    ("UniversalImageQualityIndex", {}),
+    ("ErrorRelativeGlobalDimensionlessSynthesis", {}),
+    ("SpectralAngleMapper", {}),
+    ("RelativeAverageSpectralError", {}),
+    ("RootMeanSquaredErrorUsingSlidingWindow", {}),
+    ("TotalVariation", {}),
+]
+
+
+class TestImageStreams:
+    @pytest.mark.parametrize("name, kwargs", _IMAGE_CASES, ids=[c[0] for c in _IMAGE_CASES])
+    def test_three_batch_stream(self, name, kwargs):
+        ours = getattr(O, name)(**kwargs)
+        ref = getattr(tm_ref, name)(**kwargs)
+        for _ in range(3):
+            p, t = _img_pair()
+            if name == "TotalVariation":
+                ours.update(jnp.asarray(p))
+                ref.update(_t(p))
+            else:
+                ours.update(jnp.asarray(p), jnp.asarray(t))
+                ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-3)
+
+
+_AUDIO_CASES = [
+    ("SignalNoiseRatio", {}),
+    ("ScaleInvariantSignalNoiseRatio", {}),
+    ("SignalDistortionRatio", {}),
+    ("ScaleInvariantSignalDistortionRatio", {}),
+]
+
+
+class TestAudioStreams:
+    @pytest.mark.parametrize("name, kwargs", _AUDIO_CASES, ids=[c[0] for c in _AUDIO_CASES])
+    def test_three_batch_stream(self, name, kwargs):
+        ours = getattr(O, name)(**kwargs)
+        ref = getattr(tm_ref, name)(**kwargs)
+        for _ in range(3):
+            p = _rng.normal(size=(4, 256)).astype(np.float32)
+            t = (p + 0.2 * _rng.normal(size=(4, 256))).astype(np.float32)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-3)
+
+
+_CLUSTER_CASES = [
+    "MutualInfoScore",
+    "AdjustedMutualInfoScore",
+    "NormalizedMutualInfoScore",
+    "RandScore",
+    "AdjustedRandScore",
+    "FowlkesMallowsIndex",
+    "HomogeneityScore",
+    "CompletenessScore",
+    "VMeasureScore",
+]
+
+
+class TestClusteringStreams:
+    @pytest.mark.parametrize("name", _CLUSTER_CASES)
+    def test_three_batch_stream(self, name):
+        import torchmetrics_tpu.clustering as oc
+
+        ref_mod = __import__("torchmetrics.clustering", fromlist=[name])
+        ours = getattr(oc, name)()
+        ref = getattr(ref_mod, name)()
+        for _ in range(3):
+            p = _rng.randint(0, 5, 40)
+            t = _rng.randint(0, 5, 40)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4)
+
+
+class TestNominalStreams:
+    @pytest.mark.parametrize("name", ["CramersV", "PearsonsContingencyCoefficient", "TschuprowsT", "TheilsU"])
+    def test_three_batch_stream(self, name):
+        ours = getattr(O, name)(num_classes=4)
+        ref = getattr(tm_ref, name)(num_classes=4)
+        for _ in range(3):
+            p = _rng.randint(0, 4, 60)
+            t = _rng.randint(0, 4, 60)
+            ours.update(jnp.asarray(p), jnp.asarray(t))
+            ref.update(_t(p), _t(t))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4)
+
+
+class TestSegmentationStreams:
+    @pytest.mark.parametrize("name, kwargs", [
+        ("MeanIoU", {"num_classes": 4}),
+        ("GeneralizedDiceScore", {"num_classes": 4}),
+    ], ids=["MeanIoU", "GeneralizedDiceScore"])
+    def test_three_batch_stream(self, name, kwargs):
+        import torchmetrics_tpu.segmentation as os_
+        ref_mod = __import__("torchmetrics.segmentation", fromlist=[name])
+        ours = getattr(os_, name)(**kwargs)
+        ref = getattr(ref_mod, name)(**kwargs)
+        for _ in range(3):
+            p = _rng.randint(0, 4, (4, 12, 12))
+            t = _rng.randint(0, 4, (4, 12, 12))
+            po = jnp.asarray(np.eye(4, dtype=np.int64)[p].transpose(0, 3, 1, 2))
+            to = jnp.asarray(np.eye(4, dtype=np.int64)[t].transpose(0, 3, 1, 2))
+            ours.update(po, to)
+            ref.update(_t(np.asarray(po)), _t(np.asarray(to)))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4)
