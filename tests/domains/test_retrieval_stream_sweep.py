@@ -1,0 +1,72 @@
+"""Streaming differential sweep over the retrieval domain vs the reference."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as O
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+torch = pytest.importorskip("torch")
+tm_ref = reference_torchmetrics()
+
+_rng = np.random.RandomState(4242)
+
+
+def _t(x):
+    return torch.from_numpy(np.asarray(x))
+
+
+_CASES = [
+    ("RetrievalMAP", {}),
+    ("RetrievalMRR", {}),
+    ("RetrievalPrecision", {"top_k": 3}),
+    ("RetrievalRecall", {"top_k": 3}),
+    ("RetrievalHitRate", {"top_k": 3}),
+    ("RetrievalFallOut", {"top_k": 3}),
+    ("RetrievalNormalizedDCG", {}),
+    ("RetrievalRPrecision", {}),
+]
+
+
+class TestRetrievalStreamSweep:
+    @pytest.mark.parametrize("name, kwargs", _CASES, ids=[c[0] for c in _CASES])
+    def test_three_batch_stream(self, name, kwargs):
+        ours = getattr(O, name)(**kwargs)
+        ref = getattr(tm_ref, name)(**kwargs)
+        for step in range(3):
+            n = 40
+            preds = _rng.rand(n).astype(np.float32)
+            target = _rng.randint(0, 2, n)
+            # queries overlap across batches: same id may gain documents later
+            indexes = _rng.randint(0, 6, n)
+            ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+            ref.update(_t(preds), _t(target), indexes=_t(indexes))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("agg", ["median", "min", "max"])
+    def test_aggregation_modes(self, agg):
+        ours = O.RetrievalMAP(aggregation=agg)
+        ref = tm_ref.RetrievalMAP(aggregation=agg)
+        preds = _rng.rand(60).astype(np.float32)
+        target = _rng.randint(0, 2, 60)
+        indexes = _rng.randint(0, 8, 60)
+        ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        ref.update(_t(preds), _t(target), indexes=_t(indexes))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    def test_empty_target_actions(self, action):
+        ours = O.RetrievalPrecision(empty_target_action=action, top_k=2)
+        ref = tm_ref.RetrievalPrecision(empty_target_action=action, top_k=2)
+        preds = _rng.rand(30).astype(np.float32)
+        target = np.zeros(30, dtype=np.int64)  # several all-negative queries
+        target[:10] = _rng.randint(0, 2, 10)
+        indexes = _rng.randint(0, 5, 30)
+        ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+        ref.update(_t(preds), _t(target), indexes=_t(indexes))
+        _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
