@@ -895,6 +895,12 @@ class CompositionalMetric(Metric):
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
         pass  # children sync themselves
 
+    def _wrapped_compute(self) -> Any:
+        # The composite must NOT cache or sync at its own level (reference unwraps
+        # compute entirely, ``metric.py:1186``): a child metric updating would leave
+        # a stale composite cache, and children already run their own sync_context.
+        return self._compute_impl()
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):
             self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
