@@ -198,3 +198,47 @@ class TestCLIPGating:
         assert len(prompts) == 4
         with pytest.raises(ValueError, match="must be one of"):
             _clip_iqa_format_prompts(("nonexistent_prompt",))
+
+
+class TestBertScoreMesh:
+    def test_mesh_sharded_embeddings_match_single_device(self, n_devices):
+        """Data-parallel BERTScore embedding extraction over the mesh == unsharded."""
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.functional.text.bert import bert_score
+
+        def toy_model(input_ids, attention_mask):
+            key = jax.random.PRNGKey(0)
+            table = jax.random.normal(key, (1000, 8))
+            return table[input_ids % 1000] * attention_mask[..., None]
+
+        preds = [f"sentence number {i} with words" for i in range(10)]  # ragged vs 8 devices
+        target = [f"sentence number {i} with terms" for i in range(10)]
+        plain = bert_score(preds, target, model=toy_model)
+        mesh = Mesh(np.array(jax.devices()[:n_devices]), ("data",))
+        sharded = bert_score(preds, target, model=toy_model, mesh=mesh)
+        for key in plain:
+            np.testing.assert_allclose(np.asarray(sharded[key]), np.asarray(plain[key]), atol=1e-6)
+
+    def test_module_mesh_kwarg(self, n_devices):
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from torchmetrics_tpu.text import BERTScore
+
+        def toy_model(input_ids, attention_mask):
+            key = jax.random.PRNGKey(1)
+            table = jax.random.normal(key, (1000, 8))
+            return table[input_ids % 1000] * attention_mask[..., None]
+
+        mesh = Mesh(np.array(jax.devices()[:n_devices]), ("data",))
+        metric = BERTScore(model=toy_model, mesh=mesh, max_length=16)
+        metric.update(["hello there friend"], ["hello there pal"])
+        metric.update(["more text rows"], ["more text lines"])
+        out = metric.compute()
+        assert np.isfinite(np.asarray(out["f1"])).all()

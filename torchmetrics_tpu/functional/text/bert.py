@@ -139,14 +139,43 @@ def _load_flax_model(model_name_or_path: str, num_layers: Optional[int]):
         ) from err
 
     def forward(input_ids: Array, attention_mask: Array) -> Array:
+        # traceable (no host round trip): the mesh-sharded path jits this callable
         out = hf_model(
-            input_ids=np.asarray(input_ids), attention_mask=np.asarray(attention_mask),
+            input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask),
             output_hidden_states=True,
         )
         layer = num_layers if num_layers is not None else -1
         return jnp.asarray(out.hidden_states[layer])
 
     return forward, tokenizer
+
+
+def _shard_model_over_mesh(model: Callable, mesh) -> Callable:
+    """Data-parallel embedding forward: batch axis sharded over ``mesh``'s first axis.
+
+    The same recipe as the Inception extractor's mesh mode
+    (``image/_inception_net.py``): pad the sentence batch to a shardable multiple,
+    jit with batch in/out shardings so XLA partitions the transformer forward over
+    the devices, slice the padding back off. ``model`` must be traceable.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+    batch_sharding = NamedSharding(mesh, PartitionSpec(axis))
+    jitted = jax.jit(model, in_shardings=(batch_sharding, batch_sharding), out_shardings=batch_sharding)
+
+    def wrapped(input_ids: Array, attention_mask: Array) -> Array:
+        ids = jnp.asarray(input_ids)
+        mask = jnp.asarray(attention_mask)
+        n = ids.shape[0]
+        pad = (-n) % n_dev
+        if pad:
+            ids = jnp.concatenate([ids, jnp.zeros((pad, ids.shape[1]), dtype=ids.dtype)])
+            mask = jnp.concatenate([mask, jnp.zeros((pad, mask.shape[1]), dtype=mask.dtype)])
+        return jitted(ids, mask)[:n]
+
+    return wrapped
 
 
 def bert_score(
@@ -158,6 +187,7 @@ def bert_score(
     user_tokenizer: Any = None,
     idf: bool = False,
     max_length: int = 512,
+    mesh: Optional[Any] = None,
     **kwargs: Any,
 ) -> Dict[str, Array]:
     """Compute BERTScore precision/recall/F1 between candidate and reference sentences.
@@ -187,6 +217,9 @@ def bert_score(
 
     if model is None:
         model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers)
+    if mesh is not None:
+        # data-parallel embedding extraction over the mesh's first axis
+        model = _shard_model_over_mesh(model, mesh)
 
     if user_tokenizer is not None:
         enc_p = user_tokenizer(preds_list, padding=True, truncation=True, max_length=max_length, return_tensors="np")

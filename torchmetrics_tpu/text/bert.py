@@ -64,11 +64,17 @@ class BERTScore(_TextMetric):
         user_tokenizer: Any = None,
         idf: bool = False,
         max_length: int = 512,
+        mesh: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if model is None:
             model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers)
+        if mesh is not None:
+            from torchmetrics_tpu.functional.text.bert import _shard_model_over_mesh
+
+            # data-parallel embedding extraction: sentence batch sharded over the mesh
+            model = _shard_model_over_mesh(model, mesh)
         self.model = model
         self.user_tokenizer = user_tokenizer
         self.idf = idf
