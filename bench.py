@@ -543,7 +543,7 @@ def main() -> None:
             cfg["baseline"] = round(cfg["baseline"], 2)
 
     result = {
-        "metric": "MulticlassAccuracy per-step update+compute (4096x100, 200 steps)",
+        "metric": f"MulticlassAccuracy per-step update+compute (4096x100, {STEPS} steps)",
         "value": round(ours_stateful, 2) if ours_stateful else None,
         "unit": "us/step",
         "vs_baseline": ratio(ref_stateful, ours_stateful),
