@@ -1,0 +1,308 @@
+"""Differential tests for the full `bert_score` option surface vs the reference.
+
+Reference `src/torchmetrics/functional/text/bert.py:243-447`: all_layers,
+user_forward_fn, pre-tokenized dict inputs, rescale_with_baseline (local csv),
+return_hash, batch_size chunking, empty-input behavior, strict kwargs.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+
+rng = np.random.RandomState(7)
+EMB_TABLE = rng.randn(1000, 12).astype(np.float32)
+
+# equal token counts everywhere: the reference sorts preds/target independently by
+# length before batching, which only preserves pair alignment for uniform lengths
+PREDS = ["hello there my friend", "the cat sat down", "completely different sentence here"]
+TARGET = ["hello there good friend", "a cat lay down", "unrelated words entirely here now"]
+
+
+class _SharedTokenizer:
+    def __call__(self, texts, padding=True, truncation=True, max_length=512, return_tensors="np"):
+        import zlib
+
+        ids_rows = []
+        for text in texts:
+            tokens = text.split()[: max_length - 2]
+            ids = [1] + [3 + zlib.crc32(t.encode()) % 900 for t in tokens] + [2]
+            ids_rows.append(ids)
+        width = max_length if padding == "max_length" else max(len(r) for r in ids_rows)
+        input_ids = np.zeros((len(texts), width), dtype=np.int64)
+        attention_mask = np.zeros((len(texts), width), dtype=np.int64)
+        for i, ids in enumerate(ids_rows):
+            input_ids[i, : len(ids)] = ids
+            attention_mask[i, : len(ids)] = 1
+        if return_tensors == "pt":
+            return {"input_ids": torch.tensor(input_ids), "attention_mask": torch.tensor(attention_mask)}
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def _layer_stack_np(ids: np.ndarray) -> np.ndarray:
+    """Three deterministic 'hidden layers' from the shared embedding table."""
+    base = EMB_TABLE[ids % 1000]
+    return np.stack([base, base * 0.5 + 1.0, np.tanh(base)], axis=1)  # (B, 3, S, D)
+
+
+def _jax_last_layer_model(input_ids, attention_mask):
+    stack = _layer_stack_np(np.asarray(input_ids))
+    return jnp.asarray(stack[:, -1])
+
+
+def _jax_all_layers_model(input_ids, attention_mask):
+    return jnp.asarray(_layer_stack_np(np.asarray(input_ids)))
+
+
+class _TorchLayersModel(tnn.Module):
+    """Transformers-like interface: output object with a `.hidden_states` tuple."""
+
+    def forward(self, input_ids, attention_mask, output_hidden_states=False):
+        stack = torch.tensor(_layer_stack_np(input_ids.numpy()))
+        return SimpleNamespace(
+            hidden_states=tuple(stack[:, i] for i in range(stack.shape[1])),
+            config=None,
+        )
+
+
+def _ref_bert_score(**kwargs):
+    from torchmetrics.functional.text.bert import bert_score as ref_fn
+
+    return ref_fn(**kwargs)
+
+
+def _our_bert_score(**kwargs):
+    from torchmetrics_tpu.functional.text import bert_score
+
+    return bert_score(**kwargs)
+
+
+class TestAllLayers:
+    def test_against_reference(self):
+        theirs = _ref_bert_score(
+            preds=PREDS, target=TARGET, model=_TorchLayersModel(),
+            user_tokenizer=_SharedTokenizer(), all_layers=True,
+        )
+        ours = _our_bert_score(
+            preds=PREDS, target=TARGET, model=_jax_all_layers_model,
+            user_tokenizer=_SharedTokenizer(), all_layers=True,
+        )
+        for k in ("precision", "recall", "f1"):
+            assert ours[k].shape == (3, 3)  # (num_layers, batch)
+            _assert_allclose(ours[k], np.asarray(theirs[k]), atol=1e-4)
+
+    def test_with_user_forward_fn_raises(self):
+        with pytest.raises(ValueError, match="all_layers"):
+            _our_bert_score(
+                preds=PREDS, target=TARGET, model=_jax_all_layers_model,
+                user_tokenizer=_SharedTokenizer(), all_layers=True,
+                user_forward_fn=lambda m, b: m(b["input_ids"], b["attention_mask"]),
+            )
+
+    def test_bad_layer_shape_raises(self):
+        with pytest.raises(ValueError, match="num_layers"):
+            _our_bert_score(
+                preds=PREDS, target=TARGET, model=_jax_last_layer_model,
+                user_tokenizer=_SharedTokenizer(), all_layers=True,
+            )
+
+
+class TestUserForwardFn:
+    def test_against_reference(self):
+        def torch_fwd(model, batch):
+            return torch.tensor(EMB_TABLE)[batch["input_ids"] % 1000]
+
+        sentinel = object()
+
+        def jax_fwd(model, batch):
+            assert model is sentinel  # passed through verbatim
+            return jnp.asarray(EMB_TABLE)[jnp.asarray(batch["input_ids"]) % 1000]
+
+        class _Dummy(tnn.Module):
+            def forward(self, *a, **k):  # pragma: no cover - never called
+                raise AssertionError
+
+        theirs = _ref_bert_score(
+            preds=PREDS, target=TARGET, model=_Dummy(), user_tokenizer=_SharedTokenizer(),
+            user_forward_fn=torch_fwd,
+        )
+        ours = _our_bert_score(
+            preds=PREDS, target=TARGET, model=sentinel, user_tokenizer=_SharedTokenizer(),
+            user_forward_fn=jax_fwd,
+        )
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(ours[k], np.asarray(theirs[k]), atol=1e-4)
+
+    def test_bad_output_shape_raises(self):
+        with pytest.raises(ValueError, match="shape"):
+            _our_bert_score(
+                preds=PREDS, target=TARGET, model=object(), user_tokenizer=_SharedTokenizer(),
+                user_forward_fn=lambda m, b: jnp.zeros((1, 2)),
+            )
+
+
+class TestPreTokenizedDict:
+    @pytest.mark.parametrize("idf", [False, True])
+    def test_against_reference(self, idf):
+        tok = _SharedTokenizer()
+        enc_p_pt = tok(PREDS, return_tensors="pt")
+        enc_t_pt = tok(TARGET, return_tensors="pt")
+        enc_p_np = tok(PREDS, return_tensors="np")
+        enc_t_np = tok(TARGET, return_tensors="np")
+
+        def torch_fwd(model, batch):
+            return torch.tensor(EMB_TABLE)[batch["input_ids"] % 1000]
+
+        class _Dummy(tnn.Module):
+            def forward(self, *a, **k):  # pragma: no cover
+                raise AssertionError
+
+        theirs = _ref_bert_score(
+            preds=enc_p_pt, target=enc_t_pt, model=_Dummy(), user_forward_fn=torch_fwd, idf=idf,
+        )
+        ours = _our_bert_score(
+            preds=enc_p_np, target=enc_t_np,
+            model=lambda ids, mask: jnp.asarray(EMB_TABLE)[jnp.asarray(ids) % 1000], idf=idf,
+        )
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(ours[k], np.asarray(theirs[k]), atol=1e-4)
+
+    def test_matches_string_path(self):
+        tok = _SharedTokenizer()
+        model = lambda ids, mask: jnp.asarray(EMB_TABLE)[jnp.asarray(ids) % 1000]
+        from_strings = _our_bert_score(preds=PREDS, target=TARGET, model=model, user_tokenizer=tok)
+        from_dicts = _our_bert_score(
+            preds=tok(PREDS), target=tok(TARGET), model=model,
+        )
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(from_strings[k], from_dicts[k], atol=1e-6)
+
+
+BASELINE_CSV = "LAYER,P,R,F1\n0,0.10,0.20,0.30\n1,0.15,0.25,0.35\n2,0.20,0.30,0.40\n"
+
+
+class TestRescaleWithBaseline:
+    @pytest.mark.parametrize("all_layers", [False, True])
+    def test_against_reference(self, tmp_path, all_layers):
+        baseline_path = tmp_path / "baseline.csv"
+        baseline_path.write_text(BASELINE_CSV)
+
+        theirs = _ref_bert_score(
+            preds=PREDS, target=TARGET, model=_TorchLayersModel(),
+            user_tokenizer=_SharedTokenizer(), all_layers=all_layers,
+            rescale_with_baseline=True, baseline_path=str(baseline_path),
+        )
+        ours = _our_bert_score(
+            preds=PREDS, target=TARGET,
+            model=_jax_all_layers_model if all_layers else _jax_last_layer_model,
+            user_tokenizer=_SharedTokenizer(), all_layers=all_layers,
+            rescale_with_baseline=True, baseline_path=str(baseline_path),
+        )
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(ours[k], np.asarray(theirs[k]), atol=1e-4)
+
+    def test_affine_rescale_values(self, tmp_path):
+        """rescaled = (raw - b) / (1 - b), row -1 when num_layers unset."""
+        baseline_path = tmp_path / "baseline.csv"
+        baseline_path.write_text(BASELINE_CSV)
+        model = _jax_last_layer_model
+        raw = _our_bert_score(preds=PREDS, target=TARGET, model=model, user_tokenizer=_SharedTokenizer())
+        scaled = _our_bert_score(
+            preds=PREDS, target=TARGET, model=model, user_tokenizer=_SharedTokenizer(),
+            rescale_with_baseline=True, baseline_path=str(baseline_path),
+        )
+        b = {"precision": 0.20, "recall": 0.30, "f1": 0.40}
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(scaled[k], (np.asarray(raw[k]) - b[k]) / (1 - b[k]), atol=1e-5)
+
+
+class TestReturnHashAndMisc:
+    def test_return_hash_matches_reference(self):
+        theirs = _ref_bert_score(
+            preds=PREDS, target=TARGET, model=_TorchLayersModel(),
+            user_tokenizer=_SharedTokenizer(),
+            user_forward_fn=lambda m, b: torch.tensor(EMB_TABLE)[b["input_ids"] % 1000],
+            return_hash=True, model_name_or_path="my-model", num_layers=None, idf=False,
+        )
+        ours = _our_bert_score(
+            preds=PREDS, target=TARGET, model=_jax_last_layer_model,
+            user_tokenizer=_SharedTokenizer(), return_hash=True,
+            model_name_or_path="my-model",
+        )
+        assert ours["hash"] == theirs["hash"] == "my-model_LNone_no-idf"
+
+    def test_empty_inputs(self):
+        out = _our_bert_score(preds=[], target=[], model=_jax_last_layer_model, return_hash=True)
+        assert out["precision"] == [0.0] and out["recall"] == [0.0] and out["f1"] == [0.0]
+        assert out["hash"] == "None_LNone_no-idf"
+
+    def test_batch_size_chunking_is_invariant(self):
+        model = _jax_last_layer_model
+        big = _our_bert_score(preds=PREDS, target=TARGET, model=model, user_tokenizer=_SharedTokenizer())
+        small = _our_bert_score(
+            preds=PREDS, target=TARGET, model=model, user_tokenizer=_SharedTokenizer(), batch_size=1,
+        )
+        for k in ("precision", "recall", "f1"):
+            _assert_allclose(big[k], small[k], atol=1e-6)
+
+    def test_unknown_kwarg_rejected(self):
+        with pytest.raises(TypeError):
+            _our_bert_score(
+                preds=PREDS, target=TARGET, model=_jax_last_layer_model,
+                user_tokenizer=_SharedTokenizer(), rescale_wth_baseline=True,
+            )
+
+    def test_bare_string_inputs(self):
+        """Single bare strings of unequal char length are wrapped, not len-compared."""
+        out = _our_bert_score(
+            preds="general kenobi", target="master kenobi", model=_jax_last_layer_model,
+            user_tokenizer=_SharedTokenizer(),
+        )
+        assert np.isfinite(float(np.asarray(out["f1"])))
+
+    def test_single_pair_squeezes_like_reference(self):
+        """B=1, all_layers=False → 0-d score, matching the reference's `.squeeze()`."""
+        out = _our_bert_score(preds=[PREDS[0]], target=[TARGET[0]], model=_jax_last_layer_model,
+                              user_tokenizer=_SharedTokenizer())
+        assert out["f1"].shape == ()
+
+
+class TestModulePassThrough:
+    def test_module_all_layers_and_hash(self):
+        from torchmetrics_tpu.text import BERTScore
+
+        metric = BERTScore(
+            model=_jax_all_layers_model, all_layers=True, max_length=16, return_hash=True,
+            model_name_or_path="my-model",
+        )
+        metric.update(PREDS, TARGET)
+        out = metric.compute()
+        assert out["f1"].shape == (3, 3)
+        assert out["hash"] == "my-model_LNone_no-idf"
+
+    def test_module_rescale(self, tmp_path):
+        from torchmetrics_tpu.text import BERTScore
+
+        baseline_path = tmp_path / "baseline.csv"
+        baseline_path.write_text(BASELINE_CSV)
+        metric = BERTScore(
+            model=_jax_last_layer_model, max_length=16,
+            rescale_with_baseline=True, baseline_path=str(baseline_path),
+        )
+        metric.update(PREDS, TARGET)
+        plain = BERTScore(model=_jax_last_layer_model, max_length=16)
+        plain.update(PREDS, TARGET)
+        raw = np.asarray(plain.compute()["f1"])
+        scaled = np.asarray(metric.compute()["f1"])
+        _assert_allclose(scaled, (raw - 0.40) / (1 - 0.40), atol=1e-5)

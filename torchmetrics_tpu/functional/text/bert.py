@@ -1,19 +1,23 @@
 """BERTScore.
 
 Parity: reference ``src/torchmetrics/functional/text/bert.py`` (embedding/idf pipeline
-``:51-140``, greedy cosine matching ``:134-242``, public fn ``:243-447``) and
-``functional/text/helper_embedding_metric.py`` (special-token masking ``:33-48``, IDF
-``:240-259``).
+``:53-131``, greedy cosine matching ``:134-167``, baseline rescale ``:170-240``, public
+fn ``:243-447``) and ``functional/text/helper_embedding_metric.py`` (special-token
+masking ``:33-48``, IDF ``:240-259``).
 
 TPU design: the greedy matching is one ``blpd,blrd->blpr`` einsum (MXU) with masked
-row/column maxima; embeddings come from either a user-provided callable
-``model(input_ids, attention_mask) -> (B, S, D)`` or a ``transformers`` Flax model
-(requires locally cached weights — this environment cannot download them).
+row/column maxima carried over an explicit layer axis (``L=1`` unless ``all_layers``);
+embeddings come from either a user-provided callable
+``model(input_ids, attention_mask) -> (B, S, D)`` (``(B, L, S, D)`` when
+``all_layers``), a ``user_forward_fn(model, batch_dict)``, or a ``transformers`` Flax
+model (requires locally cached weights — this environment cannot download them).
 """
 
 from __future__ import annotations
 
+import csv
 import math
+import urllib.request
 from collections import Counter, defaultdict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -22,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from torchmetrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from torchmetrics_tpu.utils.imports import _TQDM_AVAILABLE, _TRANSFORMERS_AVAILABLE
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 Array = jax.Array
 
@@ -72,30 +77,82 @@ def _get_tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[i
     return tokens_idf
 
 
-def _embed_and_scale(
+def _check_shape_of_model_output(out: Array, input_ids: Array) -> None:
+    """Reference ``helper_embedding_metric.py``: model output must be (B, S, D)."""
+    bsz, seq_len = input_ids.shape[:2]
+    invalid = out.ndim != 3 or out.shape[:2] != (bsz, seq_len)
+    if invalid:
+        raise ValueError(
+            "The model output must be `Tensor` of a shape `[batch_size, seq_len, model_dim]`"
+            f" i.e. [{bsz}, {seq_len}. , `model_dim`], but got {out.shape}."
+        )
+
+
+def _get_progress_bar(iterable, verbose: bool = False):
+    """Wrap batches in tqdm when ``verbose`` (reference ``helper_embedding_metric.py``)."""
+    if not verbose:
+        return iterable
+    import tqdm.auto
+
+    return tqdm.auto.tqdm(iterable)
+
+
+def _embed_corpus(
     encoded: Dict[str, np.ndarray],
     model: Callable,
-    idf: bool,
-    tokens_idf: Optional[Dict[int, float]],
+    *,
+    all_layers: bool = False,
+    user_forward_fn: Optional[Callable] = None,
+    idf: bool = False,
+    tokens_idf: Optional[Dict[int, float]] = None,
+    batch_size: int = 64,
+    verbose: bool = False,
 ) -> Tuple[Array, Array]:
-    """Normalized masked embeddings + per-token (idf or uniform) weights."""
-    input_ids = jnp.asarray(encoded["input_ids"])
-    attention_mask = np.asarray(encoded["attention_mask"])
+    """Normalized masked embeddings ``(B, L, S, D)`` + per-token weights ``(B, S)``.
 
-    out = jnp.asarray(model(input_ids, jnp.asarray(attention_mask)), dtype=jnp.float32)
-    if out.ndim != 3 or out.shape[:2] != input_ids.shape:
-        raise ValueError(
-            "The model output must have the shape (batch_size, seq_len, model_dim),"
-            f" but got {out.shape}."
-        )
+    Reference ``bert.py:53-131`` (``_get_embeddings_and_idf_scale``): batched model
+    forward, L2-normalise, zero the special-token positions, and compute per-token
+    idf (or uniform) weights normalised over each sentence.
+    """
+    input_ids = np.asarray(encoded["input_ids"])
+    attention_mask = np.asarray(encoded["attention_mask"])
+    n = input_ids.shape[0]
+
+    chunks: List[Array] = []
+    starts = list(range(0, n, batch_size))
+    for start in _get_progress_bar(starts, verbose):
+        ids_b = jnp.asarray(input_ids[start : start + batch_size])
+        mask_b = jnp.asarray(attention_mask[start : start + batch_size])
+        if not all_layers:
+            if user_forward_fn is not None:
+                out = user_forward_fn(model, {"input_ids": ids_b, "attention_mask": mask_b})
+                out = jnp.asarray(out, dtype=jnp.float32)
+                _check_shape_of_model_output(out, ids_b)
+            else:
+                out = jnp.asarray(model(ids_b, mask_b), dtype=jnp.float32)
+                _check_shape_of_model_output(out, ids_b)
+            out = out[:, None]  # (B, 1, S, D)
+        else:
+            if user_forward_fn is not None:
+                raise ValueError(
+                    "The option `all_layers=True` can be used only with default `transformers` models."
+                )
+            out = jnp.asarray(model(ids_b, mask_b), dtype=jnp.float32)
+            if out.ndim != 4 or out.shape[0] != ids_b.shape[0] or out.shape[2] != ids_b.shape[1]:
+                raise ValueError(
+                    "With `all_layers=True` the model must return embeddings of shape"
+                    f" (batch_size, num_layers, seq_len, model_dim), but got {out.shape}."
+                )
+        chunks.append(out)
+    out = jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
     out = out / jnp.linalg.norm(out, axis=-1, keepdims=True)
 
     processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
-    out = out * jnp.asarray(processed_mask, dtype=out.dtype)[:, :, None]
+    out = out * jnp.asarray(processed_mask, dtype=out.dtype)[:, None, :, None]
 
     if idf:
         assert tokens_idf is not None
-        ids_idf = np.vectorize(lambda t: tokens_idf[int(t)])(np.asarray(encoded["input_ids"]))
+        ids_idf = np.vectorize(lambda t: tokens_idf[int(t)])(input_ids)
         weights = ids_idf * processed_mask
     else:
         weights = processed_mask.astype(np.float64)
@@ -109,19 +166,87 @@ def _get_precision_recall_f1(
     preds_weights: Array,
     target_weights: Array,
 ) -> Tuple[Array, Array, Array]:
-    """Greedy-matched weighted precision/recall/F1 from normalized embeddings."""
+    """Greedy-matched weighted precision/recall/F1 from normalized ``(B, L, S, D)``
+    embeddings. Reference ``bert.py:134-167``: layer axis carried through the einsum,
+    result transposed to layer-major and squeezed."""
     cos_sim = jnp.einsum(
-        "bpd,brd->bpr", preds_embeddings, target_embeddings, precision=lax.Precision.HIGHEST
+        "blpd,blrd->blpr", preds_embeddings, target_embeddings, precision=lax.Precision.HIGHEST
     )
-    precision = (cos_sim.max(axis=2) * preds_weights).sum(-1)
-    recall = (cos_sim.max(axis=1) * target_weights).sum(-1)
+    precision = jnp.einsum("blp,bp->bl", cos_sim.max(axis=3), preds_weights)
+    recall = jnp.einsum("blr,br->bl", cos_sim.max(axis=2), target_weights)
     f1_score = 2 * precision * recall / (precision + recall)
     f1_score = jnp.where(jnp.isnan(f1_score), 0.0, f1_score)
-    return precision, recall, f1_score
+    # layer-major then squeeze, matching the reference's output convention
+    return precision.T.squeeze(), recall.T.squeeze(), f1_score.T.squeeze()
 
 
-def _load_flax_model(model_name_or_path: str, num_layers: Optional[int]):
-    """Load a transformers Flax encoder + tokenizer from local cache (no egress here)."""
+def _get_hash(model_name_or_path: Optional[str] = None, num_layers: Optional[int] = None, idf: bool = False) -> str:
+    """Reference ``bert.py:170-172``: the bert-score configuration hash string."""
+    return f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+
+
+def _read_csv_from_local_file(baseline_path: str) -> Array:
+    """Baseline csv/tsv (header row skipped, first column dropped) — ``bert.py:175-184``."""
+    with open(baseline_path) as fname:
+        csv_file = csv.reader(fname)
+        baseline_list = [[float(item) for item in row] for idx, row in enumerate(csv_file) if idx > 0]
+    return jnp.asarray(baseline_list)[:, 1:]
+
+
+def _read_csv_from_url(baseline_url: str) -> Array:
+    """Baseline csv from a URL — ``bert.py:187-199`` (no egress here; fails naturally)."""
+    with urllib.request.urlopen(baseline_url) as http_request:
+        baseline_list = [
+            [float(item) for item in row.strip().decode("utf-8").split(",")]
+            for idx, row in enumerate(http_request)
+            if idx > 0
+        ]
+    return jnp.asarray(baseline_list)[:, 1:]
+
+
+def _load_baseline(
+    lang: str = "en",
+    model_name_or_path: Optional[str] = None,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Optional[Array]:
+    """Load the rescale baseline (local path, url, or the upstream bert-score repo) —
+    reference ``bert.py:202-222``."""
+    if baseline_path:
+        return _read_csv_from_local_file(baseline_path)
+    if baseline_url:
+        return _read_csv_from_url(baseline_url)
+    if lang and model_name_or_path:
+        url_base = "https://raw.githubusercontent.com/Tiiiger/bert_score/master/bert_score/rescale_baseline"
+        return _read_csv_from_url(f"{url_base}/{lang}/{model_name_or_path}.tsv")
+    rank_zero_warn("Baseline was not successfully loaded. No baseline is going to be used.")
+    return None
+
+
+def _rescale_metrics_with_baseline(
+    precision: Array,
+    recall: Array,
+    f1_score: Array,
+    baseline: Array,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Affine rescale against the pre-computed baseline — reference ``bert.py:225-240``."""
+    if num_layers is None and all_layers is False:
+        num_layers = -1
+    all_metrics = jnp.stack([precision, recall, f1_score], axis=-1)
+    baseline_scale = baseline[:, None] if all_layers else baseline[num_layers]
+    all_metrics = (all_metrics - baseline_scale) / (1 - baseline_scale)
+    return all_metrics[..., 0], all_metrics[..., 1], all_metrics[..., 2]
+
+
+def _load_flax_model(model_name_or_path: str, num_layers: Optional[int], all_layers: bool = False):
+    """Load a transformers Flax encoder + tokenizer from local cache (no egress here).
+
+    Returns ``(forward, tokenizer)``; the raw transformers model is attached as
+    ``forward.hf_model`` so ``user_forward_fn`` can receive it (the reference passes
+    the loaded ``AutoModel`` itself to ``user_forward_fn`` — ``bert.py:100-103``).
+    """
     if not _TRANSFORMERS_AVAILABLE:
         raise ModuleNotFoundError(
             "`bert_score` with a `model_name_or_path` requires that `transformers` is installed."
@@ -138,15 +263,25 @@ def _load_flax_model(model_name_or_path: str, num_layers: Optional[int]):
             " a custom `model` callable + `user_tokenizer`."
         ) from err
 
+    if num_layers and getattr(getattr(hf_model, "config", None), "num_hidden_layers", None) is not None:
+        if num_layers > hf_model.config.num_hidden_layers:
+            raise ValueError(
+                f"num_layers={num_layers} is forbidden for {model_name_or_path}."
+                f" Please use num_layers <= {hf_model.config.num_hidden_layers}"
+            )
+
     def forward(input_ids: Array, attention_mask: Array) -> Array:
         # traceable (no host round trip): the mesh-sharded path jits this callable
         out = hf_model(
             input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask),
             output_hidden_states=True,
         )
+        if all_layers:
+            return jnp.stack([jnp.asarray(h) for h in out.hidden_states], axis=1)  # (B, L, S, D)
         layer = num_layers if num_layers is not None else -1
         return jnp.asarray(out.hidden_states[layer])
 
+    forward.hf_model = hf_model
     return forward, tokenizer
 
 
@@ -178,23 +313,96 @@ def _shard_model_over_mesh(model: Callable, mesh) -> Callable:
     return wrapped
 
 
+def _is_tokenized_dict(text: Any) -> bool:
+    return isinstance(text, dict) and "input_ids" in text
+
+
+def _score_from_encodings(
+    enc_preds: Dict[str, np.ndarray],
+    enc_target: Dict[str, np.ndarray],
+    model: Callable,
+    *,
+    all_layers: bool = False,
+    user_forward_fn: Optional[Callable] = None,
+    idf: bool = False,
+    batch_size: int = 64,
+    verbose: bool = False,
+    baseline: Optional[Array] = None,
+    num_layers: Optional[int] = None,
+) -> Dict[str, Array]:
+    """Shared scoring core for the functional entry and the ``BERTScore`` module:
+    embed both corpora, greedy-match, optionally baseline-rescale."""
+    tokens_idf = (
+        _get_tokens_idf(np.asarray(enc_target["input_ids"]), np.asarray(enc_target["attention_mask"]))
+        if idf
+        else None
+    )
+    common = dict(
+        all_layers=all_layers, user_forward_fn=user_forward_fn, idf=idf,
+        tokens_idf=tokens_idf, batch_size=batch_size, verbose=verbose,
+    )
+    preds_emb, preds_w = _embed_corpus(enc_preds, model, **common)
+    target_emb, target_w = _embed_corpus(enc_target, model, **common)
+
+    # pad to a common sequence length so the einsum is static-shape
+    max_len = max(preds_emb.shape[2], target_emb.shape[2])
+
+    def pad_seq(x, n, axis):
+        pads = [(0, 0)] * x.ndim
+        pads[axis] = (0, n - x.shape[axis])
+        return jnp.pad(x, pads)
+
+    preds_emb, target_emb = pad_seq(preds_emb, max_len, 2), pad_seq(target_emb, max_len, 2)
+    preds_w, target_w = pad_seq(preds_w, max_len, 1), pad_seq(target_w, max_len, 1)
+
+    precision, recall, f1_score = _get_precision_recall_f1(preds_emb, target_emb, preds_w, target_w)
+    if baseline is not None:
+        precision, recall, f1_score = _rescale_metrics_with_baseline(
+            precision, recall, f1_score, baseline, num_layers, all_layers
+        )
+    return {"precision": precision, "recall": recall, "f1": f1_score}
+
+
 def bert_score(
-    preds: Union[str, Sequence[str]],
-    target: Union[str, Sequence[str]],
+    preds: Union[str, Sequence[str], Dict[str, Array]],
+    target: Union[str, Sequence[str], Dict[str, Array]],
     model_name_or_path: Optional[str] = None,
     num_layers: Optional[int] = None,
+    all_layers: bool = False,
     model: Optional[Callable] = None,
     user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
     idf: bool = False,
     max_length: int = 512,
+    batch_size: int = 64,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
     mesh: Optional[Any] = None,
-    **kwargs: Any,
-) -> Dict[str, Array]:
+) -> Dict[str, Union[Array, List[float], str]]:
     """Compute BERTScore precision/recall/F1 between candidate and reference sentences.
 
-    ``model`` may be any callable ``(input_ids, attention_mask) -> (B, S, D)``
-    embeddings; without it, ``model_name_or_path`` is loaded through transformers'
-    Flax auto classes (locally cached weights required).
+    Full option parity with the reference public fn (``bert.py:243-447``):
+
+    - ``preds``/``target`` may be sentences or pre-tokenized
+      ``{"input_ids": ..., "attention_mask": ...}`` dicts.
+    - ``model`` may be any callable ``(input_ids, attention_mask) -> (B, S, D)``
+      embeddings (``(B, num_layers, S, D)`` when ``all_layers=True``); without it,
+      ``model_name_or_path`` is loaded through transformers' Flax auto classes
+      (locally cached weights required).
+    - ``user_forward_fn(model, batch_dict) -> (B, S, D)`` overrides how ``model`` is
+      invoked (incompatible with ``all_layers``, as in the reference).
+    - ``rescale_with_baseline`` applies the bert-score affine baseline rescale, from
+      ``baseline_path`` (local csv/tsv), ``baseline_url``, or the upstream repo URL
+      derived from ``lang`` + ``model_name_or_path``.
+    - ``return_hash`` adds the configuration ``"hash"`` key.
+
+    ``mesh`` (TPU extension) shards the embedding forward data-parallel over a device
+    mesh; there is deliberately no ``device``/``num_threads`` argument (torch
+    DataLoader specifics with no JAX equivalent).
 
     Example:
         >>> import jax
@@ -210,43 +418,78 @@ def bert_score(
         >>> float(score["f1"][0]) > 0.99
         True
     """
-    preds_list = [preds] if isinstance(preds, str) else list(preds)
-    target_list = [target] if isinstance(target, str) else list(target)
+    preds_list = [preds] if isinstance(preds, str) else preds if isinstance(preds, dict) else list(preds)
+    target_list = [target] if isinstance(target, str) else target if isinstance(target, dict) else list(target)
     if len(preds_list) != len(target_list):
         raise ValueError("Number of predicted and reference sentences must be the same!")
 
+    if verbose and not _TQDM_AVAILABLE:
+        raise ModuleNotFoundError(
+            "An argument `verbose = True` requires `tqdm` package be installed. Install with `pip install tqdm`."
+        )
+
+    _are_empty_lists = all(isinstance(t, list) and len(t) == 0 for t in (preds_list, target_list))
+    _are_valid_lists = all(
+        isinstance(t, list) and len(t) > 0 and isinstance(t[0], str) for t in (preds_list, target_list)
+    )
+    _are_valid_tensors = all(_is_tokenized_dict(t) for t in (preds_list, target_list))
+
+    if _are_empty_lists:
+        rank_zero_warn("Predictions and references are empty.")
+        output_dict: Dict[str, Union[Array, List[float], str]] = {
+            "precision": [0.0],
+            "recall": [0.0],
+            "f1": [0.0],
+        }
+        if return_hash:
+            output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})
+        return output_dict
+
     if model is None:
-        model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers)
-    if mesh is not None:
-        # data-parallel embedding extraction over the mesh's first axis
+        model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers, all_layers)
+        if user_forward_fn is not None:
+            # reference contract: user_forward_fn receives the loaded transformers
+            # model itself, not the embedding wrapper (``bert.py:100-103``)
+            model = model.hf_model
+    if mesh is not None and user_forward_fn is None:
+        # data-parallel embedding extraction over the mesh's first axis (callable
+        # contract only — a user_forward_fn drives the model itself)
         model = _shard_model_over_mesh(model, mesh)
 
-    if user_tokenizer is not None:
-        enc_p = user_tokenizer(preds_list, padding=True, truncation=True, max_length=max_length, return_tensors="np")
-        enc_t = user_tokenizer(target_list, padding=True, truncation=True, max_length=max_length, return_tensors="np")
-        enc_preds = {"input_ids": np.asarray(enc_p["input_ids"]), "attention_mask": np.asarray(enc_p["attention_mask"])}
-        enc_target = {"input_ids": np.asarray(enc_t["input_ids"]), "attention_mask": np.asarray(enc_t["attention_mask"])}
+    baseline = _load_baseline(lang, model_name_or_path, baseline_path, baseline_url) if rescale_with_baseline else None
+
+    if _are_valid_tensors:
+        enc_preds = {
+            "input_ids": np.asarray(preds_list["input_ids"]),
+            "attention_mask": np.asarray(preds_list["attention_mask"]),
+        }
+        enc_target = {
+            "input_ids": np.asarray(target_list["input_ids"]),
+            "attention_mask": np.asarray(target_list["attention_mask"]),
+        }
+    elif _are_valid_lists:
+        if user_tokenizer is not None:
+            enc_p = user_tokenizer(
+                preds_list, padding=True, truncation=True, max_length=max_length, return_tensors="np"
+            )
+            enc_t = user_tokenizer(
+                target_list, padding=True, truncation=True, max_length=max_length, return_tensors="np"
+            )
+            enc_preds = {"input_ids": np.asarray(enc_p["input_ids"]), "attention_mask": np.asarray(enc_p["attention_mask"])}
+            enc_target = {"input_ids": np.asarray(enc_t["input_ids"]), "attention_mask": np.asarray(enc_t["attention_mask"])}
+        else:
+            enc_all = _simple_whitespace_tokenizer(preds_list + target_list, max_length)
+            n = len(preds_list)
+            enc_preds = {k: v[:n] for k, v in enc_all.items()}
+            enc_target = {k: v[n:] for k, v in enc_all.items()}
     else:
-        enc_all = _simple_whitespace_tokenizer(preds_list + target_list, max_length)
-        n = len(preds_list)
-        enc_preds = {k: v[:n] for k, v in enc_all.items()}
-        enc_target = {k: v[n:] for k, v in enc_all.items()}
+        raise ValueError("Invalid input provided.")
 
-    tokens_idf = (
-        _get_tokens_idf(enc_target["input_ids"], enc_target["attention_mask"]) if idf else None
+    output_dict = _score_from_encodings(
+        enc_preds, enc_target, model,
+        all_layers=all_layers, user_forward_fn=user_forward_fn, idf=idf,
+        batch_size=batch_size, verbose=verbose, baseline=baseline, num_layers=num_layers,
     )
-
-    preds_emb, preds_w = _embed_and_scale(enc_preds, model, idf, tokens_idf)
-    target_emb, target_w = _embed_and_scale(enc_target, model, idf, tokens_idf)
-
-    # pad to a common sequence length so the einsum is static-shape
-    max_len = max(preds_emb.shape[1], target_emb.shape[1])
-
-    def pad_to(x, n):
-        return jnp.pad(x, [(0, 0), (0, n - x.shape[1])] + [(0, 0)] * (x.ndim - 2))
-
-    preds_emb, target_emb = pad_to(preds_emb, max_len), pad_to(target_emb, max_len)
-    preds_w, target_w = pad_to(preds_w, max_len), pad_to(target_w, max_len)
-
-    precision, recall, f1_score = _get_precision_recall_f1(preds_emb, target_emb, preds_w, target_w)
-    return {"precision": precision, "recall": recall, "f1": f1_score}
+    if return_hash:
+        output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})
+    return output_dict

@@ -1,7 +1,8 @@
 """BERTScore module.
 
 Parity: reference ``src/torchmetrics/text/bert.py:57-268``: tokenized id/mask "cat"
-states, model embedding + greedy cosine matching at compute.
+states, full functional option pass-through at compute (the reference's compute calls
+the functional ``bert_score`` with pre-tokenized dict inputs — ``text/bert.py:176-206``).
 """
 
 from __future__ import annotations
@@ -14,11 +15,9 @@ import numpy as np
 
 from torchmetrics_tpu.functional.text.bert import (
     _DEFAULT_MODEL,
-    _embed_and_scale,
-    _get_precision_recall_f1,
-    _get_tokens_idf,
     _load_flax_model,
     _simple_whitespace_tokenizer,
+    bert_score,
 )
 from torchmetrics_tpu.text._base import _TextMetric
 from torchmetrics_tpu.utils.data import dim_zero_cat
@@ -29,9 +28,13 @@ Array = jax.Array
 class BERTScore(_TextMetric):
     r"""BERTScore: greedy cosine matching of contextual embeddings.
 
-    ``model`` may be any callable ``(input_ids, attention_mask) -> (B, S, D)``; without
-    it, ``model_name_or_path`` is loaded via transformers' Flax auto classes (locally
-    cached weights required — this environment cannot download them).
+    ``model`` may be any callable ``(input_ids, attention_mask) -> (B, S, D)``
+    (``(B, num_layers, S, D)`` when ``all_layers=True``); without it,
+    ``model_name_or_path`` is loaded via transformers' Flax auto classes (locally
+    cached weights required — this environment cannot download them). All reference
+    options (``all_layers``, ``user_forward_fn``, ``rescale_with_baseline`` +
+    ``baseline_path``/``baseline_url``, ``return_hash``, ``lang``, ``batch_size``,
+    ``verbose``) pass through to the functional entry at compute.
 
     Example:
         >>> import jax
@@ -60,25 +63,49 @@ class BERTScore(_TextMetric):
         self,
         model_name_or_path: Optional[str] = None,
         num_layers: Optional[int] = None,
+        all_layers: bool = False,
         model: Optional[Callable] = None,
         user_tokenizer: Any = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
         idf: bool = False,
         max_length: int = 512,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
         mesh: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path or _DEFAULT_MODEL
         if model is None:
-            model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers)
-        if mesh is not None:
+            model, user_tokenizer = _load_flax_model(self.model_name_or_path, num_layers, all_layers)
+            if user_forward_fn is not None:
+                # reference contract: user_forward_fn receives the loaded transformers
+                # model itself, not the embedding wrapper
+                model = model.hf_model
+        if mesh is not None and user_forward_fn is None:
             from torchmetrics_tpu.functional.text.bert import _shard_model_over_mesh
 
             # data-parallel embedding extraction: sentence batch sharded over the mesh
             model = _shard_model_over_mesh(model, mesh)
         self.model = model
         self.user_tokenizer = user_tokenizer
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
         self.idf = idf
         self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
         self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
@@ -108,8 +135,9 @@ class BERTScore(_TextMetric):
         self.target_input_ids.append(jnp.asarray(enc_t["input_ids"]))
         self.target_attention_mask.append(jnp.asarray(enc_t["attention_mask"]))
 
-    def compute(self) -> Dict[str, Array]:
-        """BERTScore P/R/F1 over all accumulated sentences."""
+    def compute(self) -> Dict[str, Union[Array, List[float], str]]:
+        """BERTScore P/R/F1 over all accumulated sentences (pre-tokenized dict path of
+        the functional entry, mirroring reference ``text/bert.py:176-206``)."""
         enc_preds = {
             "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),
             "attention_mask": np.asarray(dim_zero_cat(self.preds_attention_mask)),
@@ -118,10 +146,21 @@ class BERTScore(_TextMetric):
             "input_ids": np.asarray(dim_zero_cat(self.target_input_ids)),
             "attention_mask": np.asarray(dim_zero_cat(self.target_attention_mask)),
         }
-        tokens_idf = (
-            _get_tokens_idf(enc_target["input_ids"], enc_target["attention_mask"]) if self.idf else None
+        return bert_score(
+            enc_preds,
+            enc_target,
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
         )
-        preds_emb, preds_w = _embed_and_scale(enc_preds, self.model, self.idf, tokens_idf)
-        target_emb, target_w = _embed_and_scale(enc_target, self.model, self.idf, tokens_idf)
-        precision, recall, f1_score = _get_precision_recall_f1(preds_emb, target_emb, preds_w, target_w)
-        return {"precision": precision, "recall": recall, "f1": f1_score}

@@ -37,6 +37,7 @@ _ONNXRUNTIME_AVAILABLE = _package_available("onnxruntime")
 _PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")
 _TORCHVISION_AVAILABLE = _package_available("torchvision")
 _SENTENCEPIECE_AVAILABLE = _package_available("sentencepiece")
+_TQDM_AVAILABLE = _package_available("tqdm")
 _MECAB_AVAILABLE = _package_available("MeCab")
 _IPADIC_AVAILABLE = _package_available("ipadic")
 
