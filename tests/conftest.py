@@ -30,6 +30,46 @@ THRESHOLD = 0.5
 NUM_DEVICES = 8
 
 
+# ---------------------------------------------------------------- test tiering
+# Smoke tier = everything not marked `full`; run with `-m "not full"` (<5 min on the
+# 1-core host, still touches every domain). The heavy differential batteries and
+# model-forward tests below are auto-marked `full` (randomized sweeps additionally
+# `fuzz`), module by module, from measured durations.
+_FUZZ_MODULES = {
+    "test_collection_fuzz",
+    "test_composition_sweep",
+    "test_functional_parity_sweep",
+    "test_stream_sweeps",
+    "test_text_stream_sweep",
+}
+_FULL_MODULES = _FUZZ_MODULES | {
+    "test_battery",
+    "test_domain_battery",
+    "test_masked_buffer",
+    "test_wrappers_differential",
+    "test_retrieval",
+    "test_multimodal_exercised",
+    "test_image",
+    "test_fid_family",
+    "test_weight_conversion",
+    "test_train_loop",
+    "test_doctests",
+    "test_wrappers",
+    "test_model_based",
+    "test_detection_extras",
+    "test_bert_options",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        module = os.path.splitext(os.path.basename(str(item.fspath)))[0]
+        if module in _FULL_MODULES:
+            item.add_marker(pytest.mark.full)
+        if module in _FUZZ_MODULES:
+            item.add_marker(pytest.mark.fuzz)
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(42)

@@ -1,0 +1,39 @@
+"""No public functional API silently accepts unknown keywords.
+
+Reference behavior: every functional entry has an explicit signature; passing a
+typo'd option raises TypeError (e.g. `functional/text/bert.py:243-263` — no
+`**kwargs`). The only sanctioned `**kwargs` acceptors are metric-wrapping
+forwarders whose kwargs are passed through verbatim to a user-supplied
+`metric_func`, exactly as the reference's PIT does
+(`functional/audio/pit.py:228-230`).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import pytest
+
+import torchmetrics_tpu.functional as F
+
+# kwargs forwarded verbatim to a user metric_func — same contract as the reference
+_FORWARDERS = {
+    "permutation_invariant_training",
+}
+
+
+def _public_functions():
+    for name in sorted(F.__all__):
+        obj = getattr(F, name)
+        if callable(obj) and not inspect.isclass(obj):
+            yield name, obj
+
+
+@pytest.mark.parametrize("name_fn", list(_public_functions()), ids=lambda nf: nf[0])
+def test_no_silent_kwargs(name_fn):
+    name, fn = name_fn
+    if name in _FORWARDERS:
+        pytest.skip("sanctioned metric_func forwarder")
+    sig = inspect.signature(fn)
+    var_kw = [p.name for p in sig.parameters.values() if p.kind is inspect.Parameter.VAR_KEYWORD]
+    assert not var_kw, f"{name} accepts **{var_kw[0]} — unknown options would be silently ignored"

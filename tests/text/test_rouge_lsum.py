@@ -1,0 +1,133 @@
+"""Executable differential coverage for rougeLsum.
+
+Both implementations gate sentence splitting on nltk's punkt, which cannot download
+here. The union-LCS math itself is splitter-independent, so this suite installs the
+same deterministic regex splitter on both sides (monkeypatching the reference's
+`_split_sentence`, reference `rouge.py:62-71`; using `set_rouge_sentence_splitter`
+on ours) and differential-tests the Lsum scores over multi-sentence corpora.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+import torchmetrics.functional.text.rouge as ref_rouge_mod  # noqa: E402
+
+import torchmetrics_tpu.functional.text.rouge as ours_rouge_mod  # noqa: E402
+from torchmetrics_tpu.functional.text.rouge import (  # noqa: E402
+    _regex_split_sentence,
+    set_rouge_sentence_splitter,
+)
+
+MULTI_SENT_PREDS = [
+    "The cat sat on the mat. It was a sunny day! The dog barked loudly.",
+    "Results improved significantly. We attribute this to better data.",
+    "One sentence only here",
+    "First point. Second point. Third point? Yes. No! Maybe.",
+]
+MULTI_SENT_TARGET = [
+    "A cat was sitting on the mat. The day was sunny. A dog barked.",
+    "The results were significantly better. This is attributed to data quality.",
+    "Only one sentence here",
+    "First point. The second point differs. A third point? Yes indeed. No!",
+]
+
+
+@pytest.fixture(autouse=True)
+def _shared_splitter(monkeypatch):
+    monkeypatch.setattr(ref_rouge_mod, "_split_sentence", _regex_split_sentence)
+    set_rouge_sentence_splitter(_regex_split_sentence)
+    yield
+    set_rouge_sentence_splitter(None)
+
+
+class TestRougeLsumDifferential:
+    @pytest.mark.parametrize("use_stemmer", [False, True])
+    def test_single_reference(self, use_stemmer):
+        keys = ("rougeLsum",)
+        ours = ours_rouge_mod.rouge_score(
+            MULTI_SENT_PREDS, MULTI_SENT_TARGET, rouge_keys=keys, use_stemmer=use_stemmer
+        )
+        theirs = ref_rouge_mod.rouge_score(
+            MULTI_SENT_PREDS, MULTI_SENT_TARGET, rouge_keys=keys, use_stemmer=use_stemmer
+        )
+        for k, v in theirs.items():
+            _assert_allclose(ours[k], np.asarray(v), atol=1e-5)
+
+    @pytest.mark.parametrize("accumulate", ["avg", "best"])
+    def test_multi_reference(self, accumulate):
+        preds = MULTI_SENT_PREDS[:2]
+        target = [
+            [MULTI_SENT_TARGET[0], "The mat had a cat. Dogs bark."],
+            [MULTI_SENT_TARGET[1]],
+        ]
+        keys = ("rouge1", "rougeL", "rougeLsum")
+        ours = ours_rouge_mod.rouge_score(preds, target, rouge_keys=keys, accumulate=accumulate)
+        theirs = ref_rouge_mod.rouge_score(preds, target, rouge_keys=keys, accumulate=accumulate)
+        for k, v in theirs.items():
+            _assert_allclose(ours[k], np.asarray(v), atol=1e-5)
+
+    def test_module_streaming(self):
+        from torchmetrics_tpu.text import ROUGEScore
+
+        ours_m = ROUGEScore(rouge_keys=("rougeLsum",))
+        theirs_m = tm_ref.text.ROUGEScore(rouge_keys=("rougeLsum",))
+        for i in range(0, len(MULTI_SENT_PREDS), 2):
+            ours_m.update(MULTI_SENT_PREDS[i : i + 2], MULTI_SENT_TARGET[i : i + 2])
+            theirs_m.update(MULTI_SENT_PREDS[i : i + 2], MULTI_SENT_TARGET[i : i + 2])
+        ours_res = ours_m.compute()
+        for k, v in theirs_m.compute().items():
+            _assert_allclose(ours_res[k], np.asarray(v), atol=1e-5)
+
+    def test_fuzz_corpus(self):
+        rng = np.random.RandomState(3)
+        vocab = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta"]
+
+        def make_doc():
+            n_sent = rng.randint(1, 5)
+            sents = []
+            for _ in range(n_sent):
+                n_tok = rng.randint(1, 8)
+                words = [vocab[rng.randint(len(vocab))] for _ in range(n_tok)]
+                sents.append(" ".join(words) + rng.choice([".", "!", "?"]))
+            return " ".join(sents)
+
+        preds = [make_doc() for _ in range(12)]
+        target = [make_doc() for _ in range(12)]
+        ours = ours_rouge_mod.rouge_score(preds, target, rouge_keys=("rougeLsum",))
+        theirs = ref_rouge_mod.rouge_score(preds, target, rouge_keys=("rougeLsum",))
+        for k, v in theirs.items():
+            _assert_allclose(ours[k], np.asarray(v), atol=1e-5)
+
+
+class TestRegexSplitter:
+    def test_split_behavior(self):
+        assert _regex_split_sentence("A b. C d! E f? G.") == ["A b.", "C d!", "E f?", "G."]
+        assert _regex_split_sentence('He said "stop." Then left.') == ['He said "stop."', "Then left."]
+        assert _regex_split_sentence('He said ("stop.") Then left.') == ['He said ("stop.")', "Then left."]
+        assert _regex_split_sentence("no terminal punctuation") == ["no terminal punctuation"]
+        assert _regex_split_sentence("  ") == []
+
+    def test_env_opt_in(self, monkeypatch):
+        set_rouge_sentence_splitter(None)
+        monkeypatch.setenv("TM_TPU_ROUGE_REGEX_SPLIT", "1")
+        out = ours_rouge_mod.rouge_score(["One. Two."], ["One. Two too."], rouge_keys=("rougeLsum",))
+        assert np.isfinite(float(np.asarray(out["rougeLsum_fmeasure"])))
+
+    def test_gated_without_opt_in(self, monkeypatch):
+        try:
+            import nltk
+
+            nltk.data.find("tokenizers/punkt")
+            pytest.skip("nltk punkt is installed; the gate does not apply")
+        except (ImportError, LookupError):
+            pass
+        set_rouge_sentence_splitter(None)
+        monkeypatch.delenv("TM_TPU_ROUGE_REGEX_SPLIT", raising=False)
+        with pytest.raises((OSError, ModuleNotFoundError)):
+            ours_rouge_mod.rouge_score(["One. Two."], ["One."], rouge_keys=("rougeLsum",))

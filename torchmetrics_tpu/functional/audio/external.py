@@ -9,7 +9,7 @@ absent (none are in this image).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -101,7 +101,6 @@ def speech_reverberation_modulation_energy_ratio(
     max_cf: Optional[float] = None,
     norm: bool = False,
     fast: bool = False,
-    **kwargs: Any,
 ) -> Array:
     """Compute SRMR via the external ``srmrpy`` library (host callback).
 
@@ -117,7 +116,7 @@ def speech_reverberation_modulation_energy_ratio(
 
     srmr_kwargs = dict(
         n_cochlear_filters=n_cochlear_filters, low_freq=low_freq, min_cf=min_cf,
-        max_cf=max_cf, fast=fast, norm=norm, **kwargs,
+        max_cf=max_cf, fast=fast, norm=norm,
     )
     preds_np = np.asarray(preds)
     if preds_np.ndim == 1:

@@ -15,6 +15,7 @@ surface and scores only). The machinery here is an independent, array-first desi
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -49,8 +50,41 @@ _STAT_COLUMNS = {"fmeasure": 2, "precision": 0, "recall": 1}
 # ------------------------------------------------------------------ text preparation
 
 
+def _regex_split_sentence(x: str) -> Sequence[str]:
+    """Rule-based sentence splitter: break after ``.!?`` (plus optional closing
+    quotes/brackets) followed by whitespace. A deterministic, dependency-free
+    stand-in for nltk's punkt — opt in via ``TM_TPU_ROUGE_REGEX_SPLIT=1`` or
+    ``set_rouge_sentence_splitter``."""
+    # split on whitespace following [.!?] plus any run of closers; `re` has no
+    # variable-width lookbehind, so capture the terminator and re-attach it
+    tokens = re.split(r"([.!?][\"')\]]*)\s+", x.strip())
+    parts = [tokens[i] + tokens[i + 1] for i in range(0, len(tokens) - 1, 2)]
+    if tokens[-1]:
+        parts.append(tokens[-1])
+    return [p for p in parts if p]
+
+
+# user-installed splitter override; None → punkt (or the regex fallback when opted in)
+_SENTENCE_SPLITTER: Optional[Callable[[str], Sequence[str]]] = None
+
+
+def set_rouge_sentence_splitter(splitter: Optional[Callable[[str], Sequence[str]]]) -> None:
+    """Install a custom rougeLsum sentence splitter (``None`` restores the default).
+
+    The reference hard-requires nltk's punkt (``rouge.py:42-71``); this hook (plus the
+    ``TM_TPU_ROUGE_REGEX_SPLIT=1`` env opt-in for :func:`_regex_split_sentence`) keeps
+    rougeLsum usable on machines where punkt cannot be downloaded.
+    """
+    global _SENTENCE_SPLITTER
+    _SENTENCE_SPLITTER = splitter
+
+
 def _split_sentence(x: str) -> Sequence[str]:
-    """Sentence-split for rougeLsum (requires nltk's punkt tokenizer)."""
+    """Sentence-split for rougeLsum (nltk punkt by default, as in the reference)."""
+    if _SENTENCE_SPLITTER is not None:
+        return _SENTENCE_SPLITTER(x)
+    if os.environ.get("TM_TPU_ROUGE_REGEX_SPLIT", "0") == "1":
+        return _regex_split_sentence(x)
     if not _NLTK_AVAILABLE:
         raise ModuleNotFoundError("ROUGE-Lsum calculation requires that `nltk` is installed. Use `pip install nltk`.")
     import nltk
