@@ -1,0 +1,125 @@
+"""Independent float64 numpy transcription of STOI/ESTOI for differential testing.
+
+Written loop-by-loop from the published algorithms (Taal et al. 2011; Jensen & Taal
+2016) and pystoi's pipeline structure (reference
+``src/torchmetrics/functional/audio/stoi.py`` delegates to pystoi), deliberately
+using explicit Python loops and scipy resampling — a different implementation shape
+from the vectorised static-shape JAX version in
+``torchmetrics_tpu/functional/audio/stoi.py``, so shared vectorisation bugs can't
+hide. When ``pystoi`` is installed the test suite additionally cross-checks both
+against it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+FS = 10000
+N_FRAME = 256
+HOP = 128
+NFFT = 512
+NUM_BANDS = 15
+MIN_FREQ = 150.0
+N_SEG = 30
+BETA = -15.0
+DYN_RANGE = 40.0
+EPS = np.finfo(np.float64).eps
+
+
+def _window() -> np.ndarray:
+    return np.hanning(N_FRAME + 2)[1:-1]
+
+
+def _octave_band_matrix() -> np.ndarray:
+    f = np.linspace(0, FS, NFFT + 1)[: NFFT // 2 + 1]
+    obm = np.zeros((NUM_BANDS, len(f)))
+    for i in range(NUM_BANDS):
+        f_low = MIN_FREQ * 2.0 ** ((2 * i - 1) / 6)
+        f_high = MIN_FREQ * 2.0 ** ((2 * i + 1) / 6)
+        lo = int(np.argmin((f - f_low) ** 2))
+        hi = int(np.argmin((f - f_high) ** 2))
+        obm[i, lo:hi] = 1.0
+    return obm
+
+
+def _frames(x: np.ndarray) -> list:
+    w = _window()
+    return [w * x[i : i + N_FRAME] for i in range(0, len(x) - N_FRAME, HOP)]
+
+
+def _remove_silent_frames(x: np.ndarray, y: np.ndarray):
+    x_frames = _frames(x)
+    y_frames = _frames(y)
+    energies = [20 * np.log10(np.linalg.norm(f) + EPS) for f in x_frames]
+    thresh = max(energies) - DYN_RANGE
+    keep = [i for i, e in enumerate(energies) if e > thresh]
+    if not keep:
+        return np.zeros(N_FRAME), np.zeros(N_FRAME)
+    out_len = (len(keep) - 1) * HOP + N_FRAME
+    x_sil = np.zeros(out_len)
+    y_sil = np.zeros(out_len)
+    for slot, i in enumerate(keep):
+        x_sil[slot * HOP : slot * HOP + N_FRAME] += x_frames[i]
+        y_sil[slot * HOP : slot * HOP + N_FRAME] += y_frames[i]
+    return x_sil, y_sil
+
+
+def _third_octave(x: np.ndarray, obm: np.ndarray) -> np.ndarray:
+    frames = _frames(x)
+    cols = []
+    for fr in frames:
+        spec = np.fft.rfft(fr, NFFT)
+        cols.append(np.sqrt(obm @ np.abs(spec) ** 2))
+    return np.stack(cols, axis=1) if cols else np.zeros((NUM_BANDS, 0))
+
+
+def stoi_numpy(x: np.ndarray, y: np.ndarray, fs: int, extended: bool = False) -> float:
+    """x = clean/target, y = processed/preds (pystoi argument order)."""
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)
+    if fs != FS:
+        from math import gcd
+
+        from scipy.signal import resample_poly
+
+        g = gcd(FS, fs)
+        x = resample_poly(x, FS // g, fs // g)
+        y = resample_poly(y, FS // g, fs // g)
+    x_sil, y_sil = _remove_silent_frames(x, y)
+    obm = _octave_band_matrix()
+    x_tob = _third_octave(x_sil, obm)
+    y_tob = _third_octave(y_sil, obm)
+    n_frames = x_tob.shape[1]
+    if n_frames < N_SEG:
+        return 1e-5
+
+    if not extended:
+        clip_value = 10 ** (-BETA / 20)
+        d_total = 0.0
+        n_segments = n_frames - N_SEG + 1
+        for m in range(N_SEG, n_frames + 1):
+            x_seg = x_tob[:, m - N_SEG : m]
+            y_seg = y_tob[:, m - N_SEG : m]
+            for j in range(NUM_BANDS):
+                alpha = np.linalg.norm(x_seg[j]) / (np.linalg.norm(y_seg[j]) + EPS)
+                y_prime = np.minimum(alpha * y_seg[j], x_seg[j] * (1 + clip_value))
+                xc = x_seg[j] - x_seg[j].mean()
+                yc = y_prime - y_prime.mean()
+                denom = (np.linalg.norm(xc) + EPS) * (np.linalg.norm(yc) + EPS)
+                d_total += float(xc @ yc) / denom
+        return d_total / (NUM_BANDS * n_segments)
+
+    # ESTOI
+    def row_col_normalize(seg: np.ndarray) -> np.ndarray:
+        rn = seg - seg.mean(axis=1, keepdims=True)
+        rn = rn / (np.linalg.norm(rn, axis=1, keepdims=True) + EPS)
+        cn = rn - rn.mean(axis=0, keepdims=True)
+        return cn / (np.linalg.norm(cn, axis=0, keepdims=True) + EPS)
+
+    n_segments = n_frames - N_SEG + 1
+    d_total = 0.0
+    for m in range(N_SEG, n_frames + 1):
+        xn = row_col_normalize(x_tob[:, m - N_SEG : m])
+        yn = row_col_normalize(y_tob[:, m - N_SEG : m])
+        d_total += float(np.sum(xn * yn)) / N_SEG
+    return d_total / n_segments

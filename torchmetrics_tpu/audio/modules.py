@@ -15,9 +15,9 @@ from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.functional.audio.external import (
     deep_noise_suppression_mean_opinion_score,
     perceptual_evaluation_speech_quality,
-    short_time_objective_intelligibility,
     speech_reverberation_modulation_energy_ratio,
 )
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
 from torchmetrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,
@@ -325,7 +325,7 @@ class PerceptualEvaluationSpeechQuality(_MeanScoreMetric):
 
 
 class ShortTimeObjectiveIntelligibility(_MeanScoreMetric):
-    r"""STOI (requires the external ``pystoi`` library)."""
+    r"""STOI / ESTOI, computed natively on device (no pystoi dependency)."""
 
     is_differentiable = False
     higher_is_better = True
@@ -338,7 +338,7 @@ class ShortTimeObjectiveIntelligibility(_MeanScoreMetric):
         self.extended = extended
 
     def update(self, preds: Array, target: Array) -> None:
-        """Accumulate per-sample STOI scores (host callback)."""
+        """Accumulate per-sample STOI scores (on-device, jittable)."""
         self._accumulate(short_time_objective_intelligibility(preds, target, self.fs, self.extended))
 
     def _compute_group_params(self):

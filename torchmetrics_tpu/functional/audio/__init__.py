@@ -6,9 +6,9 @@ Parity: reference ``src/torchmetrics/functional/audio/__init__.py``.
 from torchmetrics_tpu.functional.audio.external import (
     deep_noise_suppression_mean_opinion_score,
     perceptual_evaluation_speech_quality,
-    short_time_objective_intelligibility,
     speech_reverberation_modulation_energy_ratio,
 )
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate
 from torchmetrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,

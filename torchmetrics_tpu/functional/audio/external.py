@@ -71,6 +71,11 @@ def short_time_objective_intelligibility(
 ) -> Array:
     """Compute STOI via the external ``pystoi`` library (host callback).
 
+    NOT the public entry point: the framework's default STOI is the on-device JAX
+    implementation (``functional/audio/stoi.py``), which needs no external library.
+    This wrapper is kept as an opt-in cross-checking fallback when ``pystoi`` is
+    installed.
+
     Raises:
         ModuleNotFoundError: If ``pystoi`` is not installed.
     """

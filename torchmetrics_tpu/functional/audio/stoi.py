@@ -1,0 +1,272 @@
+"""Native on-device STOI / ESTOI.
+
+Parity: reference ``src/torchmetrics/functional/audio/stoi.py`` wraps the external
+``pystoi`` CPU library (host round trip per batch). This is a from-scratch JAX
+implementation of the published algorithms instead — STOI (Taal et al., "An Algorithm
+for Intelligibility Prediction of Time-Frequency Weighted Noisy Speech", 2011) and
+ESTOI (Jensen & Taal, 2016) — so the metric runs *inside* jit on TPU with no host
+callback. The pystoi-compatible pipeline:
+
+1. polyphase resample to 10 kHz (filter designed host-side with scipy at trace time,
+   applied as a strided/dilated conv on device);
+2. remove silent frames (256/128 Hann framing, 40 dB VAD on the clean signal,
+   overlap-add reconstruction) — done with static shapes via a cumsum scatter-add
+   compaction plus validity masks, so it stays jittable;
+3. 512-point STFT, one-third-octave band energies (15 bands from 150 Hz, one MXU
+   matmul);
+4. sliding 30-frame segments: clipped, normalised band-vector correlations (STOI) or
+   row+column-normalised segment inner products (ESTOI), masked-averaged over the
+   dynamically valid segment count.
+
+TPU design notes: every array keeps its static shape — the dynamic "number of kept
+frames" only flows through *values* (masks, scatter positions), never shapes, which is
+what makes the whole metric compilable. pystoi computes in float64; this runs in
+float32 (x64 is disabled on TPU), so scores agree to ~1e-4, not bit-exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+_FS = 10000
+_N_FRAME = 256
+_HOP = _N_FRAME // 2
+_NFFT = 512
+_NUM_BANDS = 15
+_MIN_FREQ = 150.0
+_N_SEG = 30  # 384 ms
+_BETA = -15.0  # lower SDR bound (dB)
+_DYN_RANGE = 40.0  # VAD dynamic range (dB)
+_EPS = np.finfo(np.float32).eps
+
+
+@functools.lru_cache(maxsize=None)
+def _hann_window(framelen: int) -> np.ndarray:
+    """pystoi's window: hanning(N+2) with the zero endpoints dropped."""
+    return np.hanning(framelen + 2)[1:-1].astype(np.float32)
+
+
+@functools.lru_cache(maxsize=None)
+def _third_octave_matrix(fs: int, nfft: int, num_bands: int, min_freq: float) -> np.ndarray:
+    """One-third-octave band matrix (num_bands, nfft//2+1): 0/1 rows selecting the
+    rfft bins between each band's lower and upper edge (edges snapped to the nearest
+    bin, as in the published MATLAB/pystoi construction)."""
+    f = np.linspace(0, fs, nfft + 1)[: nfft // 2 + 1]
+    k = np.arange(num_bands, dtype=np.float64)
+    freq_low = min_freq * np.power(2.0, (2 * k - 1) / 6)
+    freq_high = min_freq * np.power(2.0, (2 * k + 1) / 6)
+    obm = np.zeros((num_bands, len(f)), dtype=np.float32)
+    for i in range(num_bands):
+        lo = int(np.argmin(np.square(f - freq_low[i])))
+        hi = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, lo:hi] = 1.0
+    return obm
+
+
+@functools.lru_cache(maxsize=None)
+def _resample_filter(up: int, down: int) -> np.ndarray:
+    """Kaiser-windowed FIR for polyphase resampling (scipy.signal.resample_poly's
+    default design: numtaps = 20*max(up,down)+1, cutoff 1/max, kaiser beta 5.0)."""
+    from scipy.signal import firwin
+
+    max_rate = max(up, down)
+    half_len = 10 * max_rate
+    h = firwin(2 * half_len + 1, 1.0 / max_rate, window=("kaiser", 5.0))
+    return (h * up).astype(np.float32)
+
+
+def _resample_to_10k(x: Array, fs: int) -> Array:
+    """Polyphase resample (B, T) -> (B, ceil(T*up/down)) via one dilated strided conv."""
+    g = math.gcd(_FS, fs)
+    up, down = _FS // g, fs // g
+    h = jnp.asarray(_resample_filter(up, down))
+    n_in = x.shape[-1]
+    n_out = -(-n_in * up // down)
+    # full conv of the zero-stuffed signal starts at pad (len(h)-1); sampling the
+    # centred output lattice offset (len(h)-1)//2 with stride `down` reproduces
+    # scipy.signal.upfirdn's trimmed output
+    offset = (h.shape[0] - 1) // 2
+    pad_left = h.shape[0] - 1 - offset
+    dilated_len = (n_in - 1) * up + 1
+    pad_right = max(0, (n_out - 1) * down + h.shape[0] - dilated_len - pad_left)
+    out = lax.conv_general_dilated(
+        x[:, None, :],
+        h[None, None, :],
+        window_strides=(down,),
+        padding=[(pad_left, pad_right)],
+        lhs_dilation=(up,),
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    return out[:, 0, :n_out]
+
+
+def _frame_signal(x: Array, framelen: int, hop: int, n_frames: int) -> Array:
+    """(T,) -> (n_frames, framelen) sliding windows at `hop` (static gather)."""
+    idx = np.arange(n_frames)[:, None] * hop + np.arange(framelen)[None, :]
+    return x[idx]
+
+
+def _remove_silent_frames(
+    x: Array, y: Array, framelen: int, hop: int
+) -> Tuple[Array, Array, Array]:
+    """Static-shape VAD compaction (pystoi ``utils.remove_silent_frames``).
+
+    Frames of the *clean* signal ``x`` whose energy is within 40 dB of the loudest
+    frame are kept; both signals are rebuilt by overlap-adding the kept windowed
+    frames contiguously. Returns ``(x_sil, y_sil, n_kept)`` where the buffers have
+    the static worst-case length and only the first ``(n_kept-1)*hop + framelen``
+    samples are meaningful.
+    """
+    n_frames = max(1, -(-(x.shape[-1] - framelen) // hop))
+    w = jnp.asarray(_hann_window(framelen))
+    x_frames = _frame_signal(x, framelen, hop, n_frames) * w
+    y_frames = _frame_signal(y, framelen, hop, n_frames) * w
+
+    energies = 20.0 * jnp.log10(jnp.linalg.norm(x_frames, axis=1) + _EPS)
+    mask = energies > (jnp.max(energies) - _DYN_RANGE)
+    n_kept = jnp.sum(mask)
+
+    # compact kept frames to the front: frame j overlap-adds at slot cumsum(mask)-1
+    pos = jnp.clip(jnp.cumsum(mask) - 1, 0)
+    idx = pos[:, None] * hop + jnp.arange(framelen)[None, :]
+    buf_len = (n_frames - 1) * hop + framelen
+    maskf = mask[:, None].astype(x_frames.dtype)
+    x_sil = jnp.zeros(buf_len, x.dtype).at[idx].add(x_frames * maskf)
+    y_sil = jnp.zeros(buf_len, y.dtype).at[idx].add(y_frames * maskf)
+    return x_sil, y_sil, n_kept
+
+
+def _stft_tob(x: Array, n_frames: int, obm: Array) -> Array:
+    """Windowed 512-pt rfft over 256/128 frames, then sqrt of band energies:
+    (T,) -> (num_bands, n_frames)."""
+    w = jnp.asarray(_hann_window(_N_FRAME))
+    frames = _frame_signal(x, _N_FRAME, _HOP, n_frames) * w
+    spec = jnp.fft.rfft(frames, n=_NFFT, axis=-1)  # (M, 257)
+    power = jnp.real(spec) ** 2 + jnp.imag(spec) ** 2
+    return jnp.sqrt(
+        jnp.einsum("bf,mf->bm", obm, power, precision=lax.Precision.HIGHEST)
+    )
+
+
+def _segment_windows(tob: Array, n_segments: int) -> Array:
+    """(J, M) -> (n_segments, J, N_SEG) sliding 30-frame segments (stride 1)."""
+    idx = np.arange(n_segments)[:, None] + np.arange(_N_SEG)[None, :]
+    return jnp.transpose(tob[:, idx], (1, 0, 2))
+
+
+def _stoi_core(x10k: Array, y10k: Array, extended: bool) -> Array:
+    """STOI for one pair of 10 kHz signals (static shapes throughout)."""
+    x_sil, y_sil, n_kept = _remove_silent_frames(x10k, y10k, _N_FRAME, _HOP)
+
+    # the compacted signal of k kept frames spans (k-1)*hop + framelen samples and
+    # therefore yields exactly k-1 STFT frames; frames past that hold zeros
+    n_frames_max = max(1, -(-(x_sil.shape[-1] - _N_FRAME) // _HOP))
+    obm = jnp.asarray(_third_octave_matrix(_FS, _NFFT, _NUM_BANDS, _MIN_FREQ))
+    x_tob = _stft_tob(x_sil, n_frames_max, obm)
+    y_tob = _stft_tob(y_sil, n_frames_max, obm)
+
+    n_segments_max = max(1, n_frames_max - _N_SEG + 1)
+    x_seg = _segment_windows(x_tob, n_segments_max)  # (S, J, N)
+    y_seg = _segment_windows(y_tob, n_segments_max)
+
+    # segment s uses frames [s, s+N); all must be < the n_kept-1 valid frames
+    n_valid_frames = n_kept - 1
+    seg_valid = (jnp.arange(n_segments_max) + _N_SEG) <= n_valid_frames
+    n_valid_seg = jnp.sum(seg_valid)
+
+    if not extended:
+        # per-(segment, band) clipped correlation over the 30-frame time axis
+        norm_const = jnp.linalg.norm(x_seg, axis=-1, keepdims=True) / (
+            jnp.linalg.norm(y_seg, axis=-1, keepdims=True) + _EPS
+        )
+        y_prime = jnp.minimum(y_seg * norm_const, x_seg * (1 + 10 ** (-_BETA / 20)))
+        xc = x_seg - jnp.mean(x_seg, axis=-1, keepdims=True)
+        yc = y_prime - jnp.mean(y_prime, axis=-1, keepdims=True)
+        xc = xc / (jnp.linalg.norm(xc, axis=-1, keepdims=True) + _EPS)
+        yc = yc / (jnp.linalg.norm(yc, axis=-1, keepdims=True) + _EPS)
+        corr = jnp.sum(xc * yc, axis=-1)  # (S, J)
+        d_sum = jnp.sum(jnp.where(seg_valid[:, None], corr, 0.0))
+        denom = _NUM_BANDS * jnp.maximum(n_valid_seg, 1)
+    else:
+        # ESTOI: normalise each band's time series (rows), then each frame's band
+        # vector (columns), inner-product per segment / N
+        def row_col_normalize(seg: Array) -> Array:
+            rn = seg - jnp.mean(seg, axis=-1, keepdims=True)
+            rn = rn / (jnp.linalg.norm(rn, axis=-1, keepdims=True) + _EPS)
+            cn = rn - jnp.mean(rn, axis=1, keepdims=True)
+            return cn / (jnp.linalg.norm(cn, axis=1, keepdims=True) + _EPS)
+
+        xn = row_col_normalize(x_seg)
+        yn = row_col_normalize(y_seg)
+        d_seg = jnp.sum(xn * yn, axis=(1, 2)) / _N_SEG  # (S,)
+        d_sum = jnp.sum(jnp.where(seg_valid, d_seg, 0.0))
+        denom = jnp.maximum(n_valid_seg, 1)
+
+    d = d_sum / denom
+    # pystoi's degenerate-input behavior: too few non-silent frames -> 1e-5
+    return jnp.where(n_valid_seg > 0, d, 1e-5)
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """Compute STOI (or ESTOI with ``extended=True``) fully on device.
+
+    Unlike the reference (``stoi.py:85-106``), which ships the signals to the host
+    for pystoi, this runs inside jit: ``jax.jit(partial(stoi, fs=..))`` compiles.
+    ``keep_same_device`` is accepted for signature parity (a no-op here — the result
+    already lives on the input's device).
+
+    Args:
+        preds: processed/degraded speech, shape ``(..., time)``.
+        target: clean reference speech, same shape.
+        fs: sampling rate of the input signals (resampled to 10 kHz internally).
+        extended: compute ESTOI instead of STOI.
+        keep_same_device: accepted for reference-signature parity.
+
+    Returns:
+        STOI value(s) with shape ``preds.shape[:-1]``.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.audio import short_time_objective_intelligibility
+        >>> g = jax.random.PRNGKey(0)
+        >>> speech = jax.random.normal(g, (8000,))
+        >>> float(short_time_objective_intelligibility(speech, speech, fs=10000)) > 0.999
+        True
+    """
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected `preds` and `target` to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+    if fs <= 0:
+        raise ValueError(f"Expected argument `fs` to be a positive integer, but got {fs}")
+    batch_shape = preds.shape[:-1]
+    n = int(np.prod(batch_shape)) if batch_shape else 1
+    x = target.reshape(n, -1)
+    y = preds.reshape(n, -1)
+    if fs != _FS:
+        x = _resample_to_10k(x, fs)
+        y = _resample_to_10k(y, fs)
+    if x.shape[-1] < _N_FRAME + _HOP:
+        raise ValueError(
+            "Signals are too short to compute STOI: need at least"
+            f" {int(np.ceil((_N_FRAME + _HOP) * fs / _FS))} samples at fs={fs}, got {preds.shape[-1]}."
+        )
+    out = jax.vmap(lambda xi, yi: _stoi_core(xi, yi, extended))(x, y)
+    return out.reshape(batch_shape) if batch_shape else out[0]
