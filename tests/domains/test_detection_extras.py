@@ -198,11 +198,13 @@ class TestBufferedStates:
         mesh = Mesh(np.array(jax.devices()[:n_devices]), ("data",))
 
         def shard_step(state, p_boxes, p_scores, p_labels, t_boxes, t_labels):
-            # two images per shard, static [2, 3, ...] shapes
+            # two images per shard; the local block keeps the sharded axis as a
+            # leading 1, so image i is [0, i] (plain [i] would OOB-clamp to image 0)
             local_preds = [
-                {"boxes": p_boxes[i], "scores": p_scores[i], "labels": p_labels[i]} for i in range(2)
+                {"boxes": p_boxes[0, i], "scores": p_scores[0, i], "labels": p_labels[0, i]}
+                for i in range(2)
             ]
-            local_targets = [{"boxes": t_boxes[i], "labels": t_labels[i]} for i in range(2)]
+            local_targets = [{"boxes": t_boxes[0, i], "labels": t_labels[0, i]} for i in range(2)]
             state = metric.pure_update(state, local_preds, local_targets)
             return metric.sync_state(state, axis_name="data")
 
@@ -226,6 +228,175 @@ class TestBufferedStates:
         got = metric.pure_compute(synced)
         for key in ("map", "map_50", "map_75", "mar_100"):
             _assert_allclose(got[key], want[key], atol=1e-6)
+
+
+class TestBufferedSegm:
+    """Buffered (mesh-syncable) states for `iou_type="segm"`: bit-packed bitmap rows
+    of a declared static `mask_shape` (reference segm path `mean_ap.py:514-560`
+    keeps everything on host via pycocotools — no mesh analog to compare against,
+    so list mode is the oracle)."""
+
+    HW = 32
+
+    def _segm_items(self, rng, n_det, n_gt):
+        p, t = _random_image(rng, n_det, n_gt, hw=self.HW)
+        p = {**p, "masks": jnp.asarray(_boxes_to_masks(np.asarray(p["boxes"]), hw=self.HW))}
+        t = {**t, "masks": jnp.asarray(_boxes_to_masks(np.asarray(t["boxes"]), hw=self.HW))}
+        return p, t
+
+    def test_pack_unpack_roundtrip(self):
+        from torchmetrics_tpu.detection.mean_ap import _pack_mask_bits, _unpack_mask_bits
+
+        rng = np.random.RandomState(0)
+        for hw in ((5, 7), (8, 8), (1, 1)):
+            masks = rng.rand(4, *hw) > 0.5
+            packed_len = -(-(hw[0] * hw[1]) // 8)
+            packed = _pack_mask_bits(jnp.asarray(masks), packed_len)
+            assert packed.dtype == jnp.uint8 and packed.shape == (4, packed_len)
+            back = _unpack_mask_bits(np.asarray(packed), hw)
+            np.testing.assert_array_equal(back, masks)
+
+    def test_buffered_segm_equals_list_mode(self):
+        rng = np.random.RandomState(23)
+        preds, targets = [], []
+        for _ in range(6):
+            p, t = self._segm_items(rng, rng.randint(0, 5), rng.randint(1, 5))
+            preds.append(p)
+            targets.append(t)
+
+        plain = MeanAveragePrecision(iou_type="segm")
+        plain.update(preds, targets)
+        want = plain.compute()
+
+        buffered = MeanAveragePrecision(
+            iou_type="segm", buffer_capacity=256, image_capacity=64, mask_shape=(self.HW, self.HW)
+        )
+        buffered.update(preds, targets)
+        got = buffered.compute()
+        for key in want:
+            _assert_allclose(got[key], want[key], atol=1e-6)
+
+    def test_buffered_segm_mesh_sync_equals_concat(self, n_devices):
+        """Per-shard buffered segm states all_gather on the mesh == single compute."""
+        import jax
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.RandomState(29)
+        n_imgs = n_devices * 2
+        fixed_preds, fixed_targets = [], []
+        for _ in range(n_imgs):
+            p, t = self._segm_items(rng, 3, 3)
+            fixed_preds.append(p)
+            fixed_targets.append(t)
+
+        kwargs = dict(
+            iou_type="segm", buffer_capacity=n_imgs * 3, image_capacity=n_imgs,
+            mask_shape=(self.HW, self.HW),
+        )
+        single = MeanAveragePrecision(**kwargs)
+        single.update(fixed_preds, fixed_targets)
+        want = single.compute()
+
+        metric = MeanAveragePrecision(**kwargs)
+        mesh = Mesh(np.array(jax.devices()[:n_devices]), ("data",))
+
+        def shard_step(state, p_boxes, p_scores, p_labels, p_masks, t_boxes, t_labels, t_masks):
+            # local blocks keep the sharded axis as a leading 1 -> image i is [0, i]
+            local_preds = [
+                {"boxes": p_boxes[0, i], "scores": p_scores[0, i], "labels": p_labels[0, i],
+                 "masks": p_masks[0, i]}
+                for i in range(2)
+            ]
+            local_targets = [
+                {"boxes": t_boxes[0, i], "labels": t_labels[0, i], "masks": t_masks[0, i]}
+                for i in range(2)
+            ]
+            state = metric.pure_update(state, local_preds, local_targets)
+            return metric.sync_state(state, axis_name="data")
+
+        stack = lambda key, items: jnp.stack([jnp.asarray(it[key]) for it in items])
+        p_boxes = stack("boxes", fixed_preds).reshape(n_devices, 2, 3, 4)
+        p_scores = stack("scores", fixed_preds).reshape(n_devices, 2, 3)
+        p_labels = stack("labels", fixed_preds).reshape(n_devices, 2, 3)
+        p_masks = stack("masks", fixed_preds).reshape(n_devices, 2, 3, self.HW, self.HW)
+        t_boxes = stack("boxes", fixed_targets).reshape(n_devices, 2, 3, 4)
+        t_labels = stack("labels", fixed_targets).reshape(n_devices, 2, 3)
+        t_masks = stack("masks", fixed_targets).reshape(n_devices, 2, 3, self.HW, self.HW)
+
+        f = jax.jit(
+            shard_map(
+                shard_step,
+                mesh=mesh,
+                in_specs=(P(),) + (P("data"),) * 7,
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+        synced = f(
+            metric.init_state(), p_boxes, p_scores, p_labels, p_masks, t_boxes, t_labels, t_masks
+        )
+        got = metric.pure_compute(synced)
+        for key in ("map", "map_50", "map_75", "mar_100"):
+            _assert_allclose(got[key], want[key], atol=1e-6)
+
+    def test_requires_mask_shape(self):
+        with pytest.raises(ValueError, match="mask_shape"):
+            MeanAveragePrecision(iou_type="segm", buffer_capacity=64)
+
+    def test_mask_shape_only_for_segm(self):
+        with pytest.raises(ValueError, match="segm"):
+            MeanAveragePrecision(mask_shape=(8, 8))
+
+    def test_mask_shape_requires_buffering(self):
+        with pytest.raises(ValueError, match="buffer_capacity"):
+            MeanAveragePrecision(iou_type="segm", mask_shape=(8, 8))
+
+    def test_nonbool_masks_cast_like_list_mode(self):
+        """uint8 {0,255} bitmaps must score identically to bool masks."""
+        rng = np.random.RandomState(31)
+        p, t = self._segm_items(rng, 3, 3)
+        p255 = {**p, "masks": jnp.asarray(np.asarray(p["masks"]).astype(np.uint8) * 255)}
+        t255 = {**t, "masks": jnp.asarray(np.asarray(t["masks"]).astype(np.uint8) * 255)}
+        kwargs = dict(
+            iou_type="segm", buffer_capacity=64, image_capacity=8, mask_shape=(self.HW, self.HW)
+        )
+        want = MeanAveragePrecision(**kwargs)
+        want.update([p], [t])
+        got = MeanAveragePrecision(**kwargs)
+        got.update([p255], [t255])
+        _assert_allclose(got.compute()["map"], want.compute()["map"], atol=1e-6)
+
+    def test_mask_count_mismatch_rejected(self):
+        metric = MeanAveragePrecision(
+            iou_type="segm", buffer_capacity=64, mask_shape=(self.HW, self.HW)
+        )
+        rng = np.random.RandomState(5)
+        p, t = self._segm_items(rng, 3, 3)
+        p_bad = {**p, "masks": p["masks"][:2]}  # 3 labels, 2 masks
+        with pytest.raises(ValueError, match="different length"):
+            metric.update([p_bad], [t])
+        # the internal alignment guard also catches it (defense in depth for
+        # callers that bypass _input_validator, e.g. traced update paths)
+        with pytest.raises(ValueError, match="static shape"):
+            metric._checked_masks(p_bad, 3)
+
+    def test_wrong_mask_shape_rejected(self):
+        metric = MeanAveragePrecision(iou_type="segm", buffer_capacity=64, mask_shape=(16, 16))
+        rng = np.random.RandomState(1)
+        p, t = self._segm_items(rng, 2, 2)  # HW=32 masks
+        with pytest.raises(ValueError, match="static shape"):
+            metric.update([p], [t])
+
+    def test_empty_masks_ok(self):
+        metric = MeanAveragePrecision(
+            iou_type="segm", buffer_capacity=64, mask_shape=(self.HW, self.HW)
+        )
+        rng = np.random.RandomState(2)
+        p, t = self._segm_items(rng, 0, 2)
+        metric.update([p], [t])
+        out = metric.compute()
+        assert float(out["map"]) <= 0.0  # no detections -> no AP
 
 
 class TestDetectionMultihostSync:

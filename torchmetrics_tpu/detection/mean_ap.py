@@ -18,7 +18,9 @@ TPU design notes:
     the tensor-native analog of the reference's object gather (``mean_ap.py:442-450``).
   * buffered mode (``buffer_capacity``/``image_capacity`` set): static-shape
     :class:`MaskedBuffer` row + per-image-size states that ``all_gather`` inside
-    ``shard_map`` like every other metric — the mesh-native layout (bbox only).
+    ``shard_map`` like every other metric — the mesh-native layout. ``segm`` rides
+    it too: masks of a declared static ``mask_shape`` are bit-packed to uint8 rows
+    on device (8x smaller than bool) and unpacked at compute.
 """
 
 from __future__ import annotations
@@ -56,6 +58,28 @@ def _np_box_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
     wh = np.clip(rb - lt, 0, None)
     inter = wh[..., 0] * wh[..., 1]
     return inter / (area_det[:, None] + area_gt[None, :] - inter)
+
+
+def _pack_mask_bits(masks: Array, packed_len: int) -> Array:
+    """Bit-pack boolean masks: (n, H, W) -> (n, packed_len) uint8, big-endian bit
+    order (np.unpackbits-compatible). Keeps the mesh-synced mask buffer 8x smaller
+    than a bool layout; traceable, so it runs inside ``pure_update`` under jit."""
+    if masks.shape[0] == 0:
+        return jnp.zeros((0, packed_len), dtype=jnp.uint8)
+    flat = masks.reshape(masks.shape[0], -1).astype(jnp.int32)
+    flat = jnp.pad(flat, ((0, 0), (0, packed_len * 8 - flat.shape[1])))
+    groups = flat.reshape(flat.shape[0], packed_len, 8)
+    weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], dtype=jnp.int32)
+    return jnp.sum(groups * weights, axis=-1).astype(jnp.uint8)
+
+
+def _unpack_mask_bits(rows: np.ndarray, mask_shape: Tuple[int, int]) -> np.ndarray:
+    """Inverse of :func:`_pack_mask_bits` on host: (n, packed_len) -> (n, H, W) bool."""
+    n = rows.shape[0]
+    if n == 0:
+        return np.zeros((0,) + tuple(mask_shape), dtype=bool)
+    bits = np.unpackbits(rows.astype(np.uint8), axis=1)[:, : mask_shape[0] * mask_shape[1]]
+    return bits.reshape(n, *mask_shape).astype(bool)
 
 
 def _np_mask_iou(det: np.ndarray, gt: np.ndarray) -> np.ndarray:
@@ -149,6 +173,7 @@ class MeanAveragePrecision(Metric):
         average: str = "macro",
         buffer_capacity: Optional[int] = None,
         image_capacity: Optional[int] = None,
+        mask_shape: Optional[Tuple[int, int]] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -174,10 +199,13 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
         self.average = average
 
+        if mask_shape is not None and (iou_type != "segm" or buffer_capacity is None):
+            raise ValueError(
+                "Argument `mask_shape` is only used by the buffered segm layout"
+                " (`iou_type='segm'` together with `buffer_capacity`)"
+            )
         self._buffered = buffer_capacity is not None
         if self._buffered:
-            if iou_type != "bbox":
-                raise ValueError("Buffered (mesh-syncable) states support `iou_type='bbox'` only")
             image_capacity = image_capacity or 256
             # static-shape mesh layout: flat row buffers + per-image size buffers;
             # rows are [x1, y1, x2, y2, score, label] / [x1, y1, x2, y2, label]
@@ -185,6 +213,27 @@ class MeanAveragePrecision(Metric):
             self.add_state("det_sizes", MaskedBuffer.create(image_capacity, (), dtype=jnp.int32), dist_reduce_fx="cat")
             self.add_state("gt_rows", MaskedBuffer.create(buffer_capacity, (5,)), dist_reduce_fx="cat")
             self.add_state("gt_sizes", MaskedBuffer.create(image_capacity, (), dtype=jnp.int32), dist_reduce_fx="cat")
+            if iou_type == "segm":
+                # fixed-capacity bit-packed bitmap rows: masks must share one static
+                # (H, W) so segm states stay mesh-syncable inside shard_map
+                if mask_shape is None:
+                    raise ValueError(
+                        "Buffered (mesh-syncable) segm states need a static `mask_shape=(H, W)`;"
+                        " pass it, or use the default list-mode states (no `buffer_capacity`)"
+                        " whose ragged masks sync via the eager multihost gather."
+                    )
+                self.mask_shape = (int(mask_shape[0]), int(mask_shape[1]))
+                self._packed_len = -(-(self.mask_shape[0] * self.mask_shape[1]) // 8)
+                self.add_state(
+                    "det_mask_rows",
+                    MaskedBuffer.create(buffer_capacity, (self._packed_len,), dtype=jnp.uint8),
+                    dist_reduce_fx="cat",
+                )
+                self.add_state(
+                    "gt_mask_rows",
+                    MaskedBuffer.create(buffer_capacity, (self._packed_len,), dtype=jnp.uint8),
+                    dist_reduce_fx="cat",
+                )
         else:
             # per-image ragged lists; synced across hosts via the pad-to-max ragged
             # gather in _sync_dist (boundaries preserved by gathering aligned lists)
@@ -248,30 +297,59 @@ class MeanAveragePrecision(Metric):
             if self.iou_type == "segm":
                 self.groundtruth_masks.append(self._canonical_masks(item["masks"]))
 
+    def _checked_masks(self, item: Dict[str, Array], n_rows: int) -> Array:
+        masks = jnp.asarray(item["masks"]).astype(bool)
+        if masks.size == 0 and n_rows == 0:
+            return jnp.zeros((0,) + self.mask_shape, dtype=bool)
+        if masks.ndim != 3 or tuple(masks.shape[-2:]) != self.mask_shape or masks.shape[0] != n_rows:
+            raise ValueError(
+                f"Buffered segm states hold per-image masks of static shape"
+                f" ({n_rows}, {self.mask_shape[0]}, {self.mask_shape[1]}) for this item,"
+                f" but got an array of shape {tuple(masks.shape)}."
+            )
+        return masks
+
     def _update_buffered(self, preds: Sequence[Dict[str, Array]], target: Sequence[Dict[str, Array]]) -> None:
         # one append per state per call (not per image): concatenating the whole
         # batch first keeps the eager path at a constant number of device dispatches
-        det_rows, det_sizes = [], []
+        segm = self.iou_type == "segm"
+        det_rows, det_sizes, det_mask_rows = [], [], []
         for item in preds:
-            boxes = self._convert_boxes(item["boxes"])
+            n = np.prod(jnp.asarray(item["labels"]).shape, dtype=int)
+            boxes = (
+                self._convert_boxes(item["boxes"]) if "boxes" in item
+                else jnp.zeros((n, 4), dtype=jnp.float32)
+            )
             scores = jnp.asarray(item["scores"], dtype=jnp.float32).reshape(-1, 1)
             labels = jnp.asarray(item["labels"]).astype(jnp.float32).reshape(-1, 1)
             rows = jnp.concatenate([boxes.reshape(-1, 4), scores, labels], axis=1)
             det_rows.append(rows)
             det_sizes.append(rows.shape[0])
+            if segm:
+                det_mask_rows.append(_pack_mask_bits(self._checked_masks(item, rows.shape[0]), self._packed_len))
         if det_rows:
             self.det_rows = self.det_rows.append(jnp.concatenate(det_rows, axis=0))
             self.det_sizes = self.det_sizes.append(jnp.asarray(det_sizes, dtype=jnp.int32))
-        gt_rows, gt_sizes = [], []
+            if segm:
+                self.det_mask_rows = self.det_mask_rows.append(jnp.concatenate(det_mask_rows, axis=0))
+        gt_rows, gt_sizes, gt_mask_rows = [], [], []
         for item in target:
-            boxes = self._convert_boxes(item["boxes"])
+            n = np.prod(jnp.asarray(item["labels"]).shape, dtype=int)
+            boxes = (
+                self._convert_boxes(item["boxes"]) if "boxes" in item
+                else jnp.zeros((n, 4), dtype=jnp.float32)
+            )
             labels = jnp.asarray(item["labels"]).astype(jnp.float32).reshape(-1, 1)
             rows = jnp.concatenate([boxes.reshape(-1, 4), labels], axis=1)
             gt_rows.append(rows)
             gt_sizes.append(rows.shape[0])
+            if segm:
+                gt_mask_rows.append(_pack_mask_bits(self._checked_masks(item, rows.shape[0]), self._packed_len))
         if gt_rows:
             self.gt_rows = self.gt_rows.append(jnp.concatenate(gt_rows, axis=0))
             self.gt_sizes = self.gt_sizes.append(jnp.asarray(gt_sizes, dtype=jnp.int32))
+            if segm:
+                self.gt_mask_rows = self.gt_mask_rows.append(jnp.concatenate(gt_mask_rows, axis=0))
 
     # ---------------------------------------------------------------- distributed sync
 
@@ -317,12 +395,20 @@ class MeanAveragePrecision(Metric):
         gt_sizes = np.asarray(self.gt_sizes.values()).astype(np.int64)
         det_split = np.split(det_rows, np.cumsum(det_sizes)[:-1]) if det_sizes.size else []
         gt_split = np.split(gt_rows, np.cumsum(gt_sizes)[:-1]) if gt_sizes.size else []
+        det_masks = gt_masks = None
+        if self.iou_type == "segm":
+            det_mask_rows = _unpack_mask_bits(np.asarray(self.det_mask_rows.values()), self.mask_shape)
+            gt_mask_rows = _unpack_mask_bits(np.asarray(self.gt_mask_rows.values()), self.mask_shape)
+            det_masks = np.split(det_mask_rows, np.cumsum(det_sizes)[:-1]) if det_sizes.size else []
+            gt_masks = np.split(gt_mask_rows, np.cumsum(gt_sizes)[:-1]) if gt_sizes.size else []
         return _Samples(
             [r[:, :4] for r in det_split],
             [r[:, 4] for r in det_split],
             [r[:, 5].astype(np.int64) for r in det_split],
             [r[:, :4] for r in gt_split],
             [r[:, 4].astype(np.int64) for r in gt_split],
+            det_masks,
+            gt_masks,
         )
 
     # --------------------------------------------------------------------- evaluation
