@@ -58,6 +58,9 @@ _FULL_MODULES = _FUZZ_MODULES | {
     "test_model_based",
     "test_detection_extras",
     "test_bert_options",
+    "test_lpips_backbones",
+    "test_cli",
+    "test_real_weights",
 }
 
 

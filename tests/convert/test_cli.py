@@ -1,0 +1,125 @@
+"""Convert-CLI end-to-end: synthetic checkpoints through every subcommand.
+
+The real pretrained files cannot be downloaded here, so each subcommand is proven on
+a synthetic checkpoint with the exact naming/layout of the real one — the same
+artifact flow a user follows after dropping the real files (VERDICT item 3: the
+weights-readiness kit must make a file-drop complete the proof with zero code).
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from torchmetrics_tpu.utils.imports import _FLAX_AVAILABLE, _TRANSFORMERS_AVAILABLE
+
+torch = pytest.importorskip("torch")
+
+
+def _run_cli(*args: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "torchmetrics_tpu.convert", *args],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+    )
+
+
+@pytest.mark.skipif(not _FLAX_AVAILABLE, reason="flax required")
+def test_inception_cli_roundtrip(tmp_path):
+    from tests.image.test_weight_conversion import _flax_tree_to_torch_state_dict
+    from torchmetrics_tpu.image._inception_net import (
+        FIDInceptionV3,
+        InceptionFeatureExtractor,
+        load_torch_fidelity_weights,
+    )
+
+    net = FIDInceptionV3(features_list=("2048",))
+    variables = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3)))
+    ckpt = tmp_path / "pt_inception-2015-12-05-6726825d.pth"
+    torch.save(_flax_tree_to_torch_state_dict(variables), str(ckpt))
+
+    out = tmp_path / "inception.npz"
+    cli = _run_cli("inception", str(ckpt), "-o", str(out))
+    assert cli.returncode == 0, cli.stderr
+
+    manifest = json.loads((tmp_path / "MANIFEST.json").read_text())
+    entry = manifest["inception.npz"]
+    assert entry["kind"] == "fid-inception-v3"
+    assert len(entry["sha256"]) == 64 and len(entry["source_sha256"]) == 64
+
+    # npz load == pth load, leaf for leaf, and runs without torch at runtime
+    from_pth = load_torch_fidelity_weights(str(ckpt))
+    from_npz = load_torch_fidelity_weights(str(out))
+    want, want_def = jax.tree_util.tree_flatten(from_pth)
+    got, got_def = jax.tree_util.tree_flatten(from_npz)
+    assert want_def == got_def
+    for a, b in zip(want, got):
+        _assert_allclose(b, a, atol=0)
+
+    extractor = InceptionFeatureExtractor(feature=2048, weights_path=str(out))
+    feats = extractor(jnp.zeros((2, 3, 32, 32)))
+    assert feats.shape == (2, 2048) and bool(np.isfinite(np.asarray(feats)).all())
+
+
+@pytest.mark.skipif(not _TRANSFORMERS_AVAILABLE, reason="transformers required")
+def test_hf_flax_cli_converts_torch_only_snapshot(tmp_path):
+    from transformers import BertConfig, BertModel, FlaxAutoModel
+
+    config = BertConfig(
+        vocab_size=99, hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=64, max_position_embeddings=64,
+    )
+    src = tmp_path / "tiny_bert_pt"
+    BertModel(config).eval().save_pretrained(str(src))
+    assert not (src / "flax_model.msgpack").exists()
+
+    out = tmp_path / "tiny_bert_flax"
+    cli = _run_cli("hf-flax", str(src), "-o", str(out))
+    assert cli.returncode == 0, cli.stderr
+    assert (out / "flax_model.msgpack").exists()
+    manifest = json.loads((out / "MANIFEST.json").read_text())
+    assert manifest["flax_model.msgpack"]["kind"] == "hf-flax"
+
+    # loads as a flax-native snapshot (no from_pt needed)
+    model = FlaxAutoModel.from_pretrained(str(out), local_files_only=True)
+    hidden = model(input_ids=jnp.ones((1, 5), dtype=jnp.int32)).last_hidden_state
+    assert hidden.shape == (1, 5, 32)
+
+
+def test_extensionless_output_path_normalized(tmp_path):
+    """np.savez silently appends .npz — the CLI must report/hash the real filename."""
+    from tests.image.test_lpips_backbones import _torch_alexnet_features
+
+    torch.manual_seed(2)
+    torch.save(_torch_alexnet_features().state_dict(), tmp_path / "alex.pth")
+    cli = _run_cli("lpips-backbone", str(tmp_path / "alex.pth"), "--net", "alex",
+                   "-o", str(tmp_path / "alex_converted"))
+    assert cli.returncode == 0, cli.stderr
+    assert (tmp_path / "alex_converted.npz").exists()
+    assert "alex_converted.npz" in cli.stdout
+    manifest = json.loads((tmp_path / "MANIFEST.json").read_text())
+    assert "alex_converted.npz" in manifest
+
+
+def test_manifest_accumulates(tmp_path):
+    from tests.image.test_lpips_backbones import _torch_alexnet_features, _torch_vgg16_features
+
+    torch.manual_seed(0)
+    torch.save(_torch_alexnet_features().state_dict(), tmp_path / "alex.pth")
+    torch.save(_torch_vgg16_features().state_dict(), tmp_path / "vgg.pth")
+    assert _run_cli("lpips-backbone", str(tmp_path / "alex.pth"), "--net", "alex",
+                    "-o", str(tmp_path / "alex.npz")).returncode == 0
+    assert _run_cli("lpips-backbone", str(tmp_path / "vgg.pth"), "--net", "vgg",
+                    "-o", str(tmp_path / "vgg.npz")).returncode == 0
+    manifest = json.loads((tmp_path / "MANIFEST.json").read_text())
+    assert set(manifest) == {"alex.npz", "vgg.npz"}
+    assert manifest["vgg.npz"]["kind"] == "lpips-backbone-vgg"

@@ -1,0 +1,97 @@
+"""Real-pretrained-weights battery discovery (VERDICT item 3).
+
+This environment has no network egress, so the pretrained files behind the
+model-based metrics cannot be downloaded — every test here auto-skips until the
+corresponding file is locally provided. Dropping the real checkpoints into
+``/root/repo/weights/`` (or pointing the env vars at them) completes the
+FID/LPIPS/BERTScore/CLIPScore proof with zero code changes:
+
+- ``pt_inception-2015-12-05-6726825d.pth`` (torch-fidelity) or a converted
+  ``inception.npz`` → ``$TORCHMETRICS_TPU_INCEPTION_WEIGHTS`` or ``weights/``
+- torchvision ``alexnet-owt-*.pth`` / ``vgg16-*.pth`` / ``squeezenet1_1-*.pth``
+  (or converted ``{alex,vgg,squeeze}.npz``) → ``$TORCHMETRICS_TPU_LPIPS_BACKBONES``
+  or ``weights/``
+- an HF snapshot directory for BERTScore (e.g. ``roberta-large``) →
+  ``$TORCHMETRICS_TPU_BERT_MODEL`` or ``weights/bert/``
+- an HF CLIP snapshot (e.g. ``clip-vit-large-patch14``) →
+  ``$TORCHMETRICS_TPU_CLIP_MODEL`` or ``weights/clip/``
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Optional
+
+import pytest
+
+WEIGHTS_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))), "weights")
+
+
+def find_inception_weights() -> Optional[str]:
+    explicit = os.environ.get("TORCHMETRICS_TPU_INCEPTION_WEIGHTS")
+    if explicit and os.path.exists(explicit):
+        return explicit
+    for pattern in ("pt_inception-*.pth", "inception.npz"):
+        hits = glob.glob(os.path.join(WEIGHTS_DIR, pattern))
+        if hits:
+            return hits[0]
+    return None
+
+
+def find_lpips_backbone(net_type: str) -> Optional[str]:
+    names = {
+        "alex": ("alex.npz", "alexnet-owt-*.pth"),
+        "vgg": ("vgg.npz", "vgg16-*.pth"),
+        "squeeze": ("squeeze.npz", "squeezenet1_1-*.pth"),
+    }[net_type]
+    for root in (os.environ.get("TORCHMETRICS_TPU_LPIPS_BACKBONES"), WEIGHTS_DIR):
+        if not root:
+            continue
+        for pattern in names:
+            hits = glob.glob(os.path.join(root, pattern))
+            if hits:
+                return hits[0]
+    return None
+
+
+def _find_hf_dir(env_var: str, subdir: str) -> Optional[str]:
+    explicit = os.environ.get(env_var)
+    if explicit and os.path.isdir(explicit):
+        return explicit
+    candidate = os.path.join(WEIGHTS_DIR, subdir)
+    if os.path.isdir(candidate) and glob.glob(os.path.join(candidate, "config.json")):
+        return candidate
+    return None
+
+
+def find_bert_model() -> Optional[str]:
+    return _find_hf_dir("TORCHMETRICS_TPU_BERT_MODEL", "bert")
+
+
+def find_clip_model() -> Optional[str]:
+    return _find_hf_dir("TORCHMETRICS_TPU_CLIP_MODEL", "clip")
+
+
+@pytest.fixture
+def inception_weights() -> str:
+    path = find_inception_weights()
+    if path is None:
+        pytest.skip("real FID inception weights not provided (see tests/weights/conftest.py)")
+    return path
+
+
+@pytest.fixture
+def bert_model_dir() -> str:
+    path = find_bert_model()
+    if path is None:
+        pytest.skip("real BERT model snapshot not provided (see tests/weights/conftest.py)")
+    return path
+
+
+@pytest.fixture
+def clip_model_dir() -> str:
+    path = find_clip_model()
+    if path is None:
+        pytest.skip("real CLIP model snapshot not provided (see tests/weights/conftest.py)")
+    return path

@@ -1,0 +1,174 @@
+"""Real-pretrained-weights parity battery — auto-skipped until weights are dropped.
+
+Every test runs the moment the corresponding real checkpoint appears (see
+``conftest.py`` for discovery); no code changes needed. Where the reference's own
+scoring stack is importable offline (transformers-based BERTScore/CLIPScore), the
+test is a direct differential against ``/root/reference``; where the reference
+additionally needs an uninstalled package (torch_fidelity for FID, torchvision for
+LPIPS), the differential arm gates on that import and the remaining arm still
+computes and sanity-checks the real score through our (synthetically conversion-
+verified) path.
+
+Reference anchors: ``src/torchmetrics/image/fid.py:44-66,326`` (inception weights),
+``functional/text/bert.py`` (BERTScore), ``functional/multimodal/clip_score.py:94-106``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import _assert_allclose
+from tests.helpers.torch_ref import reference_torchmetrics
+from tests.weights.conftest import find_lpips_backbone
+
+torch = pytest.importorskip("torch")
+
+_HAS_TORCH_FIDELITY = importlib.util.find_spec("torch_fidelity") is not None
+_HAS_TORCHVISION = importlib.util.find_spec("torchvision") is not None
+
+
+def _seeded_uint8_images(seed: int, n: int = 8, size: int = 64) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    base = rng.randint(0, 256, (n, 3, size, size), dtype=np.uint8)
+    # smooth spatially so images are not pure noise (FID stats better conditioned)
+    smoothed = base.astype(np.float32)
+    for _ in range(2):
+        smoothed = 0.25 * (
+            smoothed
+            + np.roll(smoothed, 1, axis=2)
+            + np.roll(smoothed, 1, axis=3)
+            + np.roll(smoothed, (1, 1), axis=(2, 3))
+        )
+    return np.clip(smoothed, 0, 255).astype(np.uint8)
+
+
+class TestRealInception:
+    def test_fid_real_score(self, inception_weights):
+        """Real FID between two fixed image sets: finite, >0, and 0 on identical sets."""
+        from torchmetrics_tpu.image import FrechetInceptionDistance
+
+        real = _seeded_uint8_images(0)
+        fake = _seeded_uint8_images(1)
+
+        fid = FrechetInceptionDistance(feature=2048, weights_path=inception_weights)
+        fid.update(jnp.asarray(real), real=True)
+        fid.update(jnp.asarray(fake), real=False)
+        score = float(fid.compute())
+        assert np.isfinite(score) and score > 0
+        print(f"\nreal-weights FID (seeded 8v8 @64px): {score:.4f}")
+
+        same = FrechetInceptionDistance(feature=2048, weights_path=inception_weights)
+        same.update(jnp.asarray(real), real=True)
+        same.update(jnp.asarray(real), real=False)
+        assert abs(float(same.compute())) < 1e-2
+
+    @pytest.mark.skipif(not _HAS_TORCH_FIDELITY, reason="torch_fidelity not installed")
+    def test_fid_matches_reference(self, inception_weights):
+        from torchmetrics_tpu.image import FrechetInceptionDistance
+
+        real = _seeded_uint8_images(0)
+        fake = _seeded_uint8_images(1)
+
+        ours = FrechetInceptionDistance(feature=2048, weights_path=inception_weights)
+        ours.update(jnp.asarray(real), real=True)
+        ours.update(jnp.asarray(fake), real=False)
+
+        ref_tm = reference_torchmetrics()
+        ref = ref_tm.image.fid.FrechetInceptionDistance(feature=2048)
+        ref.update(torch.from_numpy(real), real=True)
+        ref.update(torch.from_numpy(fake), real=False)
+
+        _assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-2)
+
+
+class TestRealLpips:
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_lpips_real_score(self, net_type):
+        path = find_lpips_backbone(net_type)
+        if path is None:
+            pytest.skip(f"real {net_type} backbone weights not provided")
+        from torchmetrics_tpu.functional.image.lpips import (
+            learned_perceptual_image_patch_similarity,
+        )
+
+        rng = np.random.RandomState(11)
+        img1 = jnp.asarray(rng.rand(4, 3, 64, 64).astype(np.float32)) * 2 - 1
+        img2 = jnp.asarray(rng.rand(4, 3, 64, 64).astype(np.float32)) * 2 - 1
+        score = float(
+            learned_perceptual_image_patch_similarity(
+                img1, img2, net_type=net_type, weights_path=path
+            )
+        )
+        assert np.isfinite(score) and score > 0
+        print(f"\nreal-weights LPIPS[{net_type}] (seeded 4 pairs @64px): {score:.4f}")
+        zero = learned_perceptual_image_patch_similarity(
+            img1, img1, net_type=net_type, weights_path=path
+        )
+        assert abs(float(zero)) < 1e-6
+
+    @pytest.mark.skipif(not _HAS_TORCHVISION, reason="torchvision not installed")
+    @pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+    def test_lpips_matches_reference(self, net_type):
+        path = find_lpips_backbone(net_type)
+        if path is None:
+            pytest.skip(f"real {net_type} backbone weights not provided")
+        from torchmetrics_tpu.functional.image.lpips import (
+            learned_perceptual_image_patch_similarity,
+        )
+
+        rng = np.random.RandomState(11)
+        img1 = rng.rand(4, 3, 64, 64).astype(np.float32) * 2 - 1
+        img2 = rng.rand(4, 3, 64, 64).astype(np.float32) * 2 - 1
+        ours = learned_perceptual_image_patch_similarity(
+            jnp.asarray(img1), jnp.asarray(img2), net_type=net_type, weights_path=path
+        )
+
+        ref_tm = reference_torchmetrics()
+        ref = ref_tm.functional.image.lpips.learned_perceptual_image_patch_similarity(
+            torch.from_numpy(img1), torch.from_numpy(img2), net_type=net_type
+        )
+        _assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-4)
+
+
+class TestRealBertScore:
+    def test_bert_score_matches_reference(self, bert_model_dir):
+        """Direct differential: both stacks run the same local snapshot offline."""
+        from torchmetrics_tpu.functional.text.bert import bert_score
+
+        preds = ["the cat sat on the mat", "a quick brown fox", "hello world"]
+        target = ["a cat sat on the mat", "the fast brown fox jumps", "hello there world"]
+
+        ours = bert_score(preds, target, model_name_or_path=bert_model_dir, num_layers=None)
+
+        ref_tm = reference_torchmetrics()
+        ref = ref_tm.functional.text.bert.bert_score(
+            preds, target, model_name_or_path=bert_model_dir, num_layers=None
+        )
+        for key in ("precision", "recall", "f1"):
+            _assert_allclose(
+                np.asarray(ours[key]), np.asarray(ref[key]), atol=1e-3
+            )
+        print(f"\nreal-weights BERTScore f1: {np.asarray(ours['f1'])}")
+
+
+class TestRealClipScore:
+    def test_clip_score_matches_reference(self, clip_model_dir):
+        from torchmetrics_tpu.functional.multimodal import clip_score
+
+        rng = np.random.RandomState(5)
+        images = rng.randint(0, 256, (2, 3, 224, 224), dtype=np.uint8)
+        text = ["a photo of a cat", "a rendering of a mountain at dusk"]
+
+        ours = clip_score(jnp.asarray(images), text, model_name_or_path=clip_model_dir)
+
+        ref_tm = reference_torchmetrics()
+        ref = ref_tm.functional.multimodal.clip_score(
+            torch.from_numpy(images), text, model_name_or_path=clip_model_dir
+        )
+        _assert_allclose(np.asarray(ours), ref.detach().numpy(), atol=0.05)
+        print(f"\nreal-weights CLIPScore: {float(np.asarray(ours)):.3f}")

@@ -1,0 +1,141 @@
+"""Checkpoint conversion kit: torch checkpoints → JAX-native npz/flax artifacts.
+
+This environment (and many TPU pods) has no network egress, so the pretrained
+networks behind the model-based metrics — FID/KID/IS/MIFID's Inception-v3
+(torch-fidelity checkpoint, reference ``src/torchmetrics/image/fid.py:44-66``),
+the LPIPS backbones (torchvision, ``functional/image/lpips.py:65-204``), and the
+BERTScore/InfoLM/CLIP transformers models — must be provided as local files. The
+converters here turn those torch checkpoints into artifacts every metric in this
+package loads directly:
+
+- ``convert_inception``  — torch-fidelity ``pt_inception-2015-12-05-*.pth`` → flat npz
+- ``convert_lpips_backbone`` — torchvision ``{alexnet,vgg16,squeezenet1_1}-*.pth`` → flat npz
+- ``convert_hf_flax``    — a local HF snapshot with torch weights → flax ``save_pretrained``
+
+Each conversion records input/output SHA-256 checksums in a ``MANIFEST.json`` next to
+the outputs, so a converted-weights directory is self-describing and auditable.
+
+CLI: ``python -m torchmetrics_tpu.convert --help``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+MANIFEST_NAME = "MANIFEST.json"
+
+
+def sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            block = fh.read(chunk)
+            if not block:
+                break
+            digest.update(block)
+    return digest.hexdigest()
+
+
+def _record_manifest(out_path: str, entry: Dict[str, Any]) -> str:
+    """Merge ``entry`` into the MANIFEST.json beside ``out_path`` (keyed by output)."""
+    manifest_path = os.path.join(os.path.dirname(os.path.abspath(out_path)), MANIFEST_NAME)
+    manifest: Dict[str, Any] = {}
+    if os.path.exists(manifest_path):
+        with open(manifest_path) as fh:
+            manifest = json.load(fh)
+    manifest[os.path.basename(out_path)] = entry
+    with open(manifest_path, "w") as fh:
+        json.dump(manifest, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return manifest_path
+
+
+def convert_inception(checkpoint: str, out: str) -> str:
+    """torch-fidelity FID Inception-v3 ``.pth`` → flat flax-pytree ``.npz``.
+
+    The emitted npz loads through ``InceptionFeatureExtractor(weights_path=out)``
+    (or $TORCHMETRICS_TPU_INCEPTION_WEIGHTS) with no torch needed at runtime.
+    """
+    from torchmetrics_tpu.image._inception_net import load_torch_fidelity_weights
+    from torchmetrics_tpu.utils.serialization import save_tree_npz
+
+    variables = load_torch_fidelity_weights(checkpoint)
+    out = save_tree_npz(out, variables)
+    _record_manifest(
+        out,
+        {
+            "kind": "fid-inception-v3",
+            "source": os.path.basename(checkpoint),
+            "source_sha256": sha256_file(checkpoint),
+            "sha256": sha256_file(out),
+        },
+    )
+    return out
+
+
+def convert_lpips_backbone(checkpoint: str, net_type: str, out: str) -> str:
+    """torchvision backbone ``.pth`` → flat LPIPS-pyramid ``.npz``.
+
+    ``net_type``: ``alex`` (alexnet-owt), ``vgg`` (vgg16), or ``squeeze``
+    (squeezenet1_1). The emitted npz is picked up from the
+    $TORCHMETRICS_TPU_LPIPS_BACKBONES directory as ``{net_type}.npz``.
+    """
+    import torch
+
+    from torchmetrics_tpu.functional.image._lpips_backbones import convert_torchvision_backbone
+    from torchmetrics_tpu.utils.serialization import save_tree_npz
+
+    state = torch.load(checkpoint, map_location="cpu", weights_only=True)
+    params = convert_torchvision_backbone({k: v.numpy() for k, v in state.items()}, net_type)
+    out = save_tree_npz(out, params)
+    _record_manifest(
+        out,
+        {
+            "kind": f"lpips-backbone-{net_type}",
+            "source": os.path.basename(checkpoint),
+            "source_sha256": sha256_file(checkpoint),
+            "sha256": sha256_file(out),
+        },
+    )
+    return out
+
+
+def convert_hf_flax(model_path: str, out_dir: str, model_class: Optional[str] = None) -> str:
+    """Local HF snapshot (torch weights) → flax ``save_pretrained`` directory.
+
+    Loads with ``Flax<Auto>Model.from_pretrained(..., from_pt=True)`` when only torch
+    weights exist, then saves flax weights + config (and tokenizer/processor when
+    present) to ``out_dir`` — the directory the BERTScore/InfoLM/CLIPScore metrics
+    accept as ``model_name_or_path``. ``model_class`` optionally names a specific
+    transformers Flax class (e.g. ``FlaxCLIPModel``); default is ``FlaxAutoModel``.
+    """
+    import transformers
+    from transformers import AutoTokenizer
+
+    from torchmetrics_tpu.utils.imports import load_flax_with_pt_fallback
+
+    cls = getattr(transformers, model_class) if model_class else transformers.FlaxAutoModel
+    model = load_flax_with_pt_fallback(cls, model_path)
+    os.makedirs(out_dir, exist_ok=True)
+    model.save_pretrained(out_dir)
+
+    # AutoProcessor first: for CLIP-style models it bundles the image processor AND
+    # the tokenizer; plain AutoTokenizer is the fallback for bare encoders
+    for loader in (getattr(transformers, "AutoProcessor", None), AutoTokenizer):
+        if loader is None:
+            continue
+        try:
+            loader.from_pretrained(model_path, local_files_only=True).save_pretrained(out_dir)
+            break
+        except Exception:  # tokenizer/processor is optional (e.g. bare encoders)
+            continue
+
+    weights = os.path.join(out_dir, "flax_model.msgpack")
+    entry: Dict[str, Any] = {"kind": "hf-flax", "source": os.path.abspath(model_path)}
+    if os.path.exists(weights):
+        entry["sha256"] = sha256_file(weights)
+    _record_manifest(weights, entry)
+    return out_dir

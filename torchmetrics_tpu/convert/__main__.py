@@ -1,0 +1,79 @@
+"""CLI for the checkpoint conversion kit — see package docstring.
+
+Examples::
+
+    python -m torchmetrics_tpu.convert inception pt_inception-2015-12-05-6726825d.pth \
+        -o weights/inception.npz
+    python -m torchmetrics_tpu.convert lpips-backbone vgg16-397923af.pth --net vgg \
+        -o weights/vgg.npz
+    python -m torchmetrics_tpu.convert hf-flax /data/hf/roberta-large -o weights/roberta-large
+    python -m torchmetrics_tpu.convert hf-flax /data/hf/clip-vit-base-patch16 \
+        --model-class FlaxCLIPModel -o weights/clip-vit-base-patch16
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+# conversion is host-side numpy work — never wait on an accelerator runtime. The
+# host image may pin JAX_PLATFORMS to a tunneled TPU plugin (and import jax at
+# interpreter startup), so the env var alone is not enough: force the config and
+# deregister any non-cpu backend factory before anything can init it.
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+
+    for _name in [n for n in _xb._backend_factories if n != "cpu"]:
+        _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
+from torchmetrics_tpu.convert import convert_hf_flax, convert_inception, convert_lpips_backbone  # noqa: E402
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.convert",
+        description="Convert locally provided torch checkpoints to JAX-native artifacts.",
+    )
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_inc = sub.add_parser("inception", help="torch-fidelity FID Inception-v3 .pth -> .npz")
+    p_inc.add_argument("checkpoint", help="path to pt_inception-2015-12-05-*.pth")
+    p_inc.add_argument("-o", "--out", default="inception.npz", help="output npz path")
+
+    p_lpips = sub.add_parser("lpips-backbone", help="torchvision backbone .pth -> .npz")
+    p_lpips.add_argument("checkpoint", help="torchvision alexnet/vgg16/squeezenet1_1 .pth")
+    p_lpips.add_argument("--net", required=True, choices=("alex", "vgg", "squeeze"))
+    p_lpips.add_argument("-o", "--out", default=None, help="output npz path (default {net}.npz)")
+
+    p_hf = sub.add_parser("hf-flax", help="local HF snapshot (torch weights) -> flax directory")
+    p_hf.add_argument("model_path", help="local HF model directory or cached name")
+    p_hf.add_argument("-o", "--out", required=True, help="output directory")
+    p_hf.add_argument(
+        "--model-class",
+        default=None,
+        help="transformers Flax class name (e.g. FlaxCLIPModel); default FlaxAutoModel",
+    )
+
+    args = parser.parse_args(argv)
+    if args.command == "inception":
+        out = convert_inception(args.checkpoint, args.out)
+        manifest_anchor = os.path.dirname(os.path.abspath(out))
+    elif args.command == "lpips-backbone":
+        out = convert_lpips_backbone(args.checkpoint, args.net, args.out or f"{args.net}.npz")
+        manifest_anchor = os.path.dirname(os.path.abspath(out))
+    else:
+        out = convert_hf_flax(args.model_path, args.out, model_class=args.model_class)
+        manifest_anchor = os.path.abspath(out)  # manifest lives inside the output dir
+    print(f"wrote {out} (manifest: {os.path.join(manifest_anchor, 'MANIFEST.json')})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,13 +1,16 @@
 """Learned perceptual image patch similarity (functional).
 
 Parity: reference ``src/torchmetrics/functional/image/lpips.py`` (backbones
-``:65-204`` + bundled linear heads). The backbone weights come from torchvision
-checkpoints which this environment cannot download; the scoring machinery works with
-any user-provided feature pyramid, and the named backbones are weight-gated.
+``:65-204`` + bundled linear heads). The named AlexNet/VGG16/SqueezeNet backbones are
+implemented natively in ``_lpips_backbones.py``; their pretrained torchvision
+checkpoints cannot be downloaded in this environment, so they activate when weights
+are locally provided (``weights_path`` / ``$TORCHMETRICS_TPU_LPIPS_BACKBONES``).
+The scoring machinery also works with any user-provided feature pyramid.
 """
 
 from __future__ import annotations
 
+import functools
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -73,6 +76,14 @@ def load_lpips_head_weights(net_type: str = "alex") -> list:
         return [jnp.asarray(data[name]) for name in levels]
 
 
+@functools.lru_cache(maxsize=8)
+def _cached_backbone_fn(net_type: str, weights_path: Optional[str]) -> Callable:
+    """Load + jit the named backbone once per (net, path)."""
+    from torchmetrics_tpu.functional.image._lpips_backbones import make_lpips_feature_fn
+
+    return make_lpips_feature_fn(net_type, weights_path=weights_path)
+
+
 def learned_perceptual_image_patch_similarity(
     img1: Array,
     img2: Array,
@@ -81,11 +92,14 @@ def learned_perceptual_image_patch_similarity(
     normalize: bool = False,
     feature_fn: Optional[Callable[[Array], Sequence[Array]]] = None,
     head_weights: Optional[Sequence[Array]] = None,
+    weights_path: Optional[str] = None,
 ) -> Array:
     r"""Compute LPIPS between two image batches.
 
-    With ``feature_fn`` (image batch → feature pyramid) the distance is fully native;
-    the named backbones require locally provided pretrained weights.
+    Without ``feature_fn``, the named ``net_type`` backbone runs natively from
+    locally provided torchvision weights (``weights_path`` or the
+    ``TORCHMETRICS_TPU_LPIPS_BACKBONES`` directory). A custom ``feature_fn``
+    (image batch → feature pyramid) plugs into the same scoring machinery.
     """
     img1 = jnp.asarray(img1)
     img2 = jnp.asarray(img2)
@@ -96,11 +110,16 @@ def learned_perceptual_image_patch_similarity(
     img2 = (img2 - _SHIFT) / _SCALE
 
     if feature_fn is None:
-        raise ModuleNotFoundError(
-            f"The `{net_type}` LPIPS backbone requires pretrained torchvision weights, which"
-            " cannot be downloaded in this environment. Pass `feature_fn` (a callable"
-            " producing a feature pyramid) to use the native LPIPS machinery."
-        )
+        try:
+            feature_fn = _cached_backbone_fn(net_type, weights_path)
+        except FileNotFoundError as err:
+            raise ModuleNotFoundError(
+                f"The `{net_type}` LPIPS backbone requires pretrained torchvision weights,"
+                " which cannot be downloaded in this environment. Provide them locally"
+                " (`weights_path` / $TORCHMETRICS_TPU_LPIPS_BACKBONES, optionally converted"
+                " with `python -m torchmetrics_tpu.convert lpips-backbone`), or pass"
+                " `feature_fn` (a callable producing a feature pyramid)."
+            ) from err
     feats1, feats2 = feature_fn(img1), feature_fn(img2)
     if head_weights is None:
         # auto-use the bundled heads only when the pyramid matches the named

@@ -32,8 +32,10 @@ def _get_clip_model_and_processor(model_name_or_path: str = _DEFAULT_MODEL):
         )
     from transformers import CLIPProcessor, FlaxCLIPModel
 
+    from torchmetrics_tpu.utils.imports import load_flax_with_pt_fallback
+
     try:
-        model = FlaxCLIPModel.from_pretrained(model_name_or_path, local_files_only=True)
+        model = load_flax_with_pt_fallback(FlaxCLIPModel, model_name_or_path)
         processor = CLIPProcessor.from_pretrained(model_name_or_path, local_files_only=True)
     except Exception as err:
         raise OSError(

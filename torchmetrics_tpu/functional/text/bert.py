@@ -253,9 +253,11 @@ def _load_flax_model(model_name_or_path: str, num_layers: Optional[int], all_lay
         )
     from transformers import AutoTokenizer, FlaxAutoModel
 
+    from torchmetrics_tpu.utils.imports import load_flax_with_pt_fallback
+
     try:
         tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
-        hf_model = FlaxAutoModel.from_pretrained(model_name_or_path, local_files_only=True)
+        hf_model = load_flax_with_pt_fallback(FlaxAutoModel, model_name_or_path)
     except Exception as err:
         raise OSError(
             f"Could not load `{model_name_or_path}` from the local transformers cache and this"

@@ -322,11 +322,23 @@ class InceptionFeatureExtractor:
 
 
 def load_torch_fidelity_weights(path: str) -> dict:
-    """Convert a torch-fidelity FID inception checkpoint to the flax param pytree.
+    """Load the FID inception params from a torch-fidelity ``.pth`` or converted ``.npz``.
 
     ``path`` must point at a locally available ``pt_inception-2015-12-05-*.pth``
-    (this environment cannot download it).
+    (this environment cannot download it) or the ``.npz`` produced by
+    ``python -m torchmetrics_tpu.convert inception`` — the latter needs no torch at
+    runtime.
     """
+    if path.endswith(".npz"):
+        from torchmetrics_tpu.utils.serialization import load_tree_npz
+
+        tree = load_tree_npz(path)
+        if set(tree) != {"params", "batch_stats"}:
+            raise ValueError(
+                f"`{path}` is not a converted inception checkpoint (expected top-level"
+                f" 'params'/'batch_stats', got {sorted(tree)})"
+            )
+        return jax.tree_util.tree_map(jnp.asarray, tree)
     import torch
 
     state = torch.load(path, map_location="cpu", weights_only=True)

@@ -76,6 +76,7 @@ class FrechetInceptionDistance(Metric):
         num_features: Optional[int] = None,
         input_img_size: Tuple[int, int, int] = (3, 299, 299),
         mesh: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -91,7 +92,7 @@ class FrechetInceptionDistance(Metric):
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
                 )
-            self.inception = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
+            self.inception = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh, weights_path=weights_path)
             num_features = feature
         elif callable(feature):
             self.inception = feature

@@ -46,6 +46,7 @@ class InceptionScore(Metric):
         splits: int = 10,
         normalize: bool = False,
         mesh: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -61,7 +62,7 @@ class InceptionScore(Metric):
                 raise ValueError(
                     f"Input to argument `feature` must be one of {valid_inputs}, but got {feature}."
                 )
-            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh, weights_path=weights_path)
         elif callable(feature):
             self.inception = feature
         else:

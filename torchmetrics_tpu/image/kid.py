@@ -96,13 +96,14 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         mesh: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
         super().__init__(**kwargs)
 
         if isinstance(feature, int):
-            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh, weights_path=weights_path)
         elif callable(feature):
             self.inception = feature
         else:

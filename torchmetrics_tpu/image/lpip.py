@@ -48,6 +48,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         normalize: bool = False,
         feature_fn: Optional[Callable[[Array], Sequence[Array]]] = None,
         head_weights: Optional[Sequence[Array]] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -61,11 +62,19 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         if not isinstance(normalize, bool):
             raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
         if feature_fn is None:
-            raise ModuleNotFoundError(
-                f"The `{net_type}` LPIPS backbone requires pretrained torchvision weights, which"
-                " cannot be downloaded in this environment. Pass `feature_fn` to use the native"
-                " LPIPS machinery with your own backbone."
-            )
+            # fail at construction (reference raises at __init__ too when torchvision
+            # is missing) rather than on the first update
+            from torchmetrics_tpu.functional.image.lpips import _cached_backbone_fn
+
+            try:
+                feature_fn = _cached_backbone_fn(net_type, weights_path)
+            except FileNotFoundError as err:
+                raise ModuleNotFoundError(
+                    f"The `{net_type}` LPIPS backbone requires pretrained torchvision weights,"
+                    " which cannot be downloaded in this environment. Provide them locally"
+                    " (`weights_path` / $TORCHMETRICS_TPU_LPIPS_BACKBONES) or pass"
+                    " `feature_fn` to use the native LPIPS machinery with your own backbone."
+                ) from err
         self.net_type = net_type
         self.reduction = reduction
         self.normalize = normalize

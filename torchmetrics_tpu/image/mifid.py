@@ -81,6 +81,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
         mesh: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         kwargs.setdefault("jit_update", False)
@@ -92,7 +93,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
                 raise ValueError(
                     f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
                 )
-            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh)
+            self.inception: Callable = InceptionFeatureExtractor(feature=feature, normalize=normalize, mesh=mesh, weights_path=weights_path)
         elif callable(feature):
             self.inception = feature
         else:

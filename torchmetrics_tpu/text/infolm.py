@@ -140,9 +140,11 @@ class InfoLM(_TextMetric):
             raise ModuleNotFoundError("InfoLM metric requires that `transformers` is installed.")
         from transformers import AutoTokenizer, FlaxAutoModelForMaskedLM
 
+        from torchmetrics_tpu.utils.imports import load_flax_with_pt_fallback
+
         try:
             self.tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
-            self.model = FlaxAutoModelForMaskedLM.from_pretrained(model_name_or_path, local_files_only=True)
+            self.model = load_flax_with_pt_fallback(FlaxAutoModelForMaskedLM, model_name_or_path)
         except Exception as err:
             raise OSError(
                 f"Could not load `{model_name_or_path}` from the local transformers cache and this"

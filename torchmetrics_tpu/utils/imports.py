@@ -43,3 +43,18 @@ _IPADIC_AVAILABLE = _package_available("ipadic")
 
 _PYTHON_GREATER_EQUAL_3_11 = sys.version_info >= (3, 11)
 _LATEX_AVAILABLE = shutil.which("latex") is not None
+
+
+def load_flax_with_pt_fallback(model_cls, model_name_or_path: str, **kwargs):
+    """``from_pretrained`` a transformers Flax model from a local snapshot, converting
+    torch-only snapshots (e.g. a dropped HF download) on the fly via ``from_pt=True``.
+
+    Shared by every HF-backed metric (BERTScore, InfoLM, CLIPScore) and the convert
+    CLI so the fallback behavior cannot drift between call sites.
+    """
+    try:
+        return model_cls.from_pretrained(model_name_or_path, local_files_only=True, **kwargs)
+    except (OSError, ValueError):
+        return model_cls.from_pretrained(
+            model_name_or_path, local_files_only=True, from_pt=True, **kwargs
+        )

@@ -1,0 +1,62 @@
+"""Flat-npz (de)serialization for parameter pytrees.
+
+The converted-weights artifacts produced by ``python -m torchmetrics_tpu.convert``
+are plain ``.npz`` archives whose keys are ``/``-joined pytree paths — loadable with
+nothing but numpy, inspectable with ``np.load``, and stable across jax versions
+(unlike pickled pytrees). Reference counterpart: the reference ships torch ``.pth``
+checkpoints (e.g. ``functional/image/lpips_models/*.pth``); npz is the JAX-native
+equivalent.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+_SEP = "/"
+
+
+def flatten_tree(tree: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    """Flatten a nested dict-of-arrays into ``{"a/b/c": ndarray}``."""
+    flat: Dict[str, np.ndarray] = {}
+    for key, value in tree.items():
+        if _SEP in str(key):
+            raise ValueError(f"Tree keys must not contain {_SEP!r}, got {key!r}")
+        path = f"{prefix}{_SEP}{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            flat.update(flatten_tree(value, path))
+        else:
+            flat[path] = np.asarray(value)
+    return flat
+
+
+def unflatten_tree(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_tree`."""
+    tree: Dict[str, Any] = {}
+    for path, value in flat.items():
+        parts = path.split(_SEP)
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_tree_npz(path: str, tree: Dict[str, Any]) -> str:
+    """Write a nested param pytree to a flat ``.npz`` archive; returns the real path.
+
+    ``np.savez`` silently appends ``.npz`` to extension-less paths — normalize up
+    front so callers (checksum manifests, extension-dispatching loaders) always see
+    the filename actually written.
+    """
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    np.savez(path, **flatten_tree(tree))
+    return path
+
+
+def load_tree_npz(path: str) -> Dict[str, Any]:
+    """Load a flat ``.npz`` archive back into a nested param pytree."""
+    with np.load(path) as data:
+        return unflatten_tree({name: data[name] for name in data.files})
