@@ -213,7 +213,7 @@ def bench_acc_scan(preds, target) -> float:
     return elapsed / (STEPS * reps) * 1e6
 
 
-def bench_collection_mesh_sync() -> float:
+def bench_collection_mesh_sync(sync: bool = True) -> float:
     """Config #3: Accuracy+F1+AUROC update & mesh sync per step (BASELINE.md config 2).
 
     Jitted shard_map step over every available device: per-shard pure updates of the
@@ -221,6 +221,10 @@ def bench_collection_mesh_sync() -> float:
     sync — the production distributed pattern. The reference baseline runs the same
     three metrics eagerly WITHOUT any sync (its DDP needs a process group we can't
     spawn here), so its number is a lower bound for the reference.
+
+    ``sync=False`` measures the identical step with the collectives removed (compute
+    runs on the local shard state) — the honest decomposition behind BASELINE.md's
+    "sync overhead < 2% of step time" north star, reported as its own config.
     """
     import jax
     import jax.numpy as jnp
@@ -249,8 +253,11 @@ def bench_collection_mesh_sync() -> float:
         # groups dedup to); AUROC keeps the binned-curve state.
         s_stat = acc.pure_update(s_stat, p, t)
         s_curve = auroc.pure_update(s_curve, p, t)
-        sy_stat = acc.sync_state(s_stat, axis_name="data")
-        sy_curve = auroc.sync_state(s_curve, axis_name="data")
+        if sync:
+            sy_stat = acc.sync_state(s_stat, axis_name="data")
+            sy_curve = auroc.sync_state(s_curve, axis_name="data")
+        else:
+            sy_stat, sy_curve = s_stat, s_curve
         vals = (acc.pure_compute(sy_stat), f1.pure_compute(sy_stat), auroc.pure_compute(sy_curve))
         return (s_stat, s_curve), vals
 
@@ -331,6 +338,279 @@ def bench_inception(hardware: str) -> float:
     return batch * iters / (time.perf_counter() - t0)
 
 
+# --------------------------------------------------- model-based + text configs
+
+_WORDS = (
+    "the cat sat on mat quick brown fox jumps over lazy dog model metric stream "
+    "update compute shard mesh chip fast slow image text score batch epoch"
+).split()
+
+
+def _corpus(n: int, seed: int = 0, length: int = 16):
+    rng = np.random.RandomState(seed)
+    return [" ".join(rng.choice(_WORDS, length)) for _ in range(n)]
+
+
+def _fabricate_clip_dir(root: str, tiny: bool) -> str:
+    """Random-weight local CLIP snapshot: tiny dims on the CPU fallback (the same
+    fabrication the multimodal tests use), real ViT-B/32 dims on TPU — FLOPs match
+    the pretrained model, so samples/sec is representative even though scores are not.
+    """
+    import json as _json
+
+    from transformers import (
+        CLIPConfig,
+        CLIPImageProcessor,
+        CLIPProcessor,
+        CLIPTextConfig,
+        CLIPTokenizer,
+        CLIPVisionConfig,
+        FlaxCLIPModel,
+    )
+
+    os.makedirs(root, exist_ok=True)
+    chars = "abcdefghijklmnopqrstuvwxyz0123456789"
+    vocab = {}
+    for c in chars:
+        vocab[c] = len(vocab)
+    for c in chars:
+        vocab[c + "</w>"] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    with open(root + "/vocab.json", "w") as fh:
+        _json.dump(vocab, fh)
+    with open(root + "/merges.txt", "w") as fh:
+        fh.write("#version: 0.2\n")
+    tokenizer = CLIPTokenizer(root + "/vocab.json", root + "/merges.txt")
+
+    if tiny:
+        text_cfg = CLIPTextConfig(
+            vocab_size=tokenizer.vocab_size, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=37, max_position_embeddings=77,
+        )
+        vision_cfg = CLIPVisionConfig(
+            hidden_size=32, num_hidden_layers=2, num_attention_heads=4,
+            intermediate_size=37, image_size=30, patch_size=6,
+        )
+        proj, img_size = 16, 30
+    else:  # openai/clip-vit-base-patch32 dims
+        text_cfg = CLIPTextConfig(
+            vocab_size=tokenizer.vocab_size, hidden_size=512, num_hidden_layers=12,
+            num_attention_heads=8, intermediate_size=2048, max_position_embeddings=77,
+        )
+        vision_cfg = CLIPVisionConfig(
+            hidden_size=768, num_hidden_layers=12, num_attention_heads=12,
+            intermediate_size=3072, image_size=224, patch_size=32,
+        )
+        proj, img_size = 512, 224
+    config = CLIPConfig(
+        text_config=text_cfg.to_dict(), vision_config=vision_cfg.to_dict(), projection_dim=proj
+    )
+    FlaxCLIPModel(config).save_pretrained(root)
+    image_processor = CLIPImageProcessor(
+        size={"shortest_edge": img_size}, crop_size={"height": img_size, "width": img_size}
+    )
+    CLIPProcessor(image_processor=image_processor, tokenizer=tokenizer).save_pretrained(root)
+    return root
+
+
+def _fabricate_bert_dir(root: str, tiny: bool) -> str:
+    """Random-weight local BERT snapshot + wordpiece tokenizer over the bench corpus.
+
+    Encoder dims are BERT-base on TPU (the FLOPs that matter for BERTScore — no vocab
+    softmax in the scoring path), tiny on the CPU fallback.
+    """
+    from transformers import BertConfig, BertTokenizerFast, FlaxBertModel
+
+    os.makedirs(root, exist_ok=True)
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"] + sorted(set(_WORDS))
+    with open(root + "/vocab.txt", "w") as fh:
+        fh.write("\n".join(vocab))
+    if tiny:
+        config = BertConfig(
+            vocab_size=len(vocab), hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64, max_position_embeddings=64,
+        )
+    else:  # bert-base encoder dims
+        config = BertConfig(
+            vocab_size=len(vocab), hidden_size=768, num_hidden_layers=12,
+            num_attention_heads=12, intermediate_size=3072, max_position_embeddings=512,
+        )
+    FlaxBertModel(config).save_pretrained(root)
+    BertTokenizerFast(vocab_file=root + "/vocab.txt", do_lower_case=True).save_pretrained(root)
+    return root
+
+
+def bench_clip_score(hardware: str) -> float:
+    """BASELINE.md config 4: CLIPScore samples/sec (ViT-B/32-dims random weights on
+    TPU, tiny fabricated model on the CPU fallback)."""
+    import tempfile
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.multimodal import CLIPScore
+
+    tiny = hardware.startswith("cpu")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        d = _fabricate_clip_dir(tempfile.mkdtemp(prefix="bench_clip_"), tiny)
+        metric = CLIPScore(model_name_or_path=d)
+    n, iters, size = (4, 2, 30) if tiny else (32, 5, 224)
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.randint(0, 256, (n, 3, size, size), dtype=np.uint8))
+    texts = _corpus(n, seed=1, length=6)
+    metric.update(imgs, texts)  # compile + processor warmup
+    jax.block_until_ready(metric.compute())
+    start = time.perf_counter()
+    for _ in range(iters):
+        metric.update(imgs, texts)
+    np.asarray(metric.compute())
+    return n * iters / (time.perf_counter() - start)
+
+
+def bench_bert_score(hardware: str) -> float:
+    """BASELINE.md config 5a: BERTScore sentence-pairs/sec (BERT-base encoder dims
+    random weights on TPU, tiny on the CPU fallback)."""
+    import tempfile
+    import warnings
+
+    import jax
+
+    from torchmetrics_tpu.text import BERTScore
+
+    tiny = hardware.startswith("cpu")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        d = _fabricate_bert_dir(tempfile.mkdtemp(prefix="bench_bert_"), tiny)
+        metric = BERTScore(model_name_or_path=d, num_layers=None)
+    n, iters = (16, 2) if tiny else (64, 5)
+    preds = _corpus(n, seed=2, length=12)
+    target = _corpus(n, seed=3, length=12)
+    metric.update(preds, target)
+    np.asarray(metric.compute()["f1"])
+    start = time.perf_counter()
+    for _ in range(iters):
+        metric.update(preds, target)
+    np.asarray(metric.compute()["f1"])
+    return n * iters / (time.perf_counter() - start)
+
+
+_PPL_SHAPE = (8, 128, 8192)  # batch, seq, vocab — same logits both sides
+
+
+def bench_perplexity() -> float:
+    """BASELINE.md config 5b: Perplexity sequences/sec over (8, 128, 8192) logits —
+    the metric side of the LM-eval loop, honest same-shape differential vs the
+    reference (the model forward producing logits is benched separately)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.text import Perplexity
+
+    b, t, v = _PPL_SHAPE
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(b, t, v).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, v, (b, t)))
+    metric = Perplexity()
+    steps = 10
+    metric.update(preds, target)
+    jax.block_until_ready(metric.compute())
+    metric.reset()
+    start = time.perf_counter()
+    for _ in range(steps):
+        metric.update(preds, target)
+    jax.block_until_ready(metric.compute())
+    return b * steps / (time.perf_counter() - start)
+
+
+_ROUGE_N = 64
+
+
+def bench_rouge() -> float:
+    """BASELINE.md config 5c: ROUGE-1/2/L samples/sec over a seeded corpus — honest
+    differential (pure text metric, no weights on either side)."""
+    from torchmetrics_tpu.functional.text.rouge import rouge_score
+
+    keys = ("rouge1", "rouge2", "rougeL")  # rougeLsum needs the nltk punkt download
+    preds = _corpus(_ROUGE_N, seed=4, length=20)
+    target = _corpus(_ROUGE_N, seed=5, length=20)
+    rouge_score(preds, target, rouge_keys=keys)  # warm caches
+    iters = 3
+    start = time.perf_counter()
+    for _ in range(iters):
+        rouge_score(preds, target, rouge_keys=keys)
+    return _ROUGE_N * iters / (time.perf_counter() - start)
+
+
+# -------------------------------------------------------- pallas A/B hot-op configs
+
+
+def bench_hotops() -> dict:
+    """Kernel-backed hot ops, ms each — run twice (TM_TPU_USE_PALLAS=0/1 subprocess
+    env) on real TPU hardware so the Pallas kernels get an automatic A/B the moment
+    the relay yields a chip. Op set mirrors the kernel surface: confmat, binned
+    curve, bincount, SSIM moments."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.confusion_matrix import multiclass_confusion_matrix
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        multiclass_precision_recall_curve,
+    )
+    from torchmetrics_tpu.functional.image.ssim import structural_similarity_index_measure
+    from torchmetrics_tpu.utils.data import _bincount
+
+    rng = np.random.RandomState(0)
+    out = {}
+
+    def timeit(fn, *args, iters=5):
+        jax.block_until_ready(fn(*args))
+        start = time.perf_counter()
+        for _ in range(iters):
+            val = fn(*args)
+        jax.block_until_ready(val)
+        return (time.perf_counter() - start) / iters * 1e3
+
+    n, c = 1 << 18, 512
+    preds_l = jnp.asarray(rng.randint(0, c, n))
+    target_l = jnp.asarray(rng.randint(0, c, n))
+    out["confmat_262k_c512_ms"] = _safe(
+        timeit, lambda p, t: multiclass_confusion_matrix(p, t, c, validate_args=False), preds_l, target_l
+    )
+
+    scores = jnp.asarray(rng.rand(1 << 18, 16).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 16, 1 << 18))
+    out["binned_curve_262k_c16_t200_ms"] = _safe(
+        timeit,
+        lambda p, t: multiclass_precision_recall_curve(p, t, 16, thresholds=200, validate_args=False),
+        scores, labels,
+    )
+
+    vals = jnp.asarray(rng.randint(0, 4096, 1 << 20))
+    out["bincount_1m_c4096_ms"] = _safe(
+        timeit, lambda x: _bincount(x, minlength=4096), vals
+    )
+
+    img1 = jnp.asarray(rng.rand(4, 3, 256, 256).astype(np.float32))
+    img2 = jnp.asarray(rng.rand(4, 3, 256, 256).astype(np.float32))
+    out["ssim_4x3x256_ms"] = _safe(
+        timeit, lambda a, b: structural_similarity_index_measure(a, b, data_range=1.0), img1, img2
+    )
+
+    from torchmetrics_tpu.functional.classification.calibration_error import (
+        binary_calibration_error,
+    )
+
+    conf = jnp.asarray(rng.rand(1 << 20).astype(np.float32))
+    lbls = jnp.asarray(rng.randint(0, 2, 1 << 20))
+    out["calibration_1m_b100_ms"] = _safe(
+        timeit, lambda p, t: binary_calibration_error(p, t, n_bins=100), conf, lbls
+    )
+    return out
+
+
 # ------------------------------------------------------------------ reference configs
 
 
@@ -383,6 +663,41 @@ def ref_collection() -> float:
     return (time.perf_counter() - start) / iters * 1e6
 
 
+def ref_perplexity() -> float:
+    import torch
+
+    from torchmetrics.text import Perplexity as TMPerplexity
+
+    b, t, v = _PPL_SHAPE
+    rng = np.random.RandomState(0)
+    preds = torch.from_numpy(rng.rand(b, t, v).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, v, (b, t)))
+    metric = TMPerplexity()
+    steps = 10
+    metric.update(preds, target)
+    metric.compute()
+    metric.reset()
+    start = time.perf_counter()
+    for _ in range(steps):
+        metric.update(preds, target)
+    metric.compute()
+    return b * steps / (time.perf_counter() - start)
+
+
+def ref_rouge() -> float:
+    from torchmetrics.functional.text.rouge import rouge_score as tm_rouge
+
+    keys = ("rouge1", "rouge2", "rougeL")
+    preds = _corpus(_ROUGE_N, seed=4, length=20)
+    target = _corpus(_ROUGE_N, seed=5, length=20)
+    tm_rouge(preds, target, rouge_keys=keys)
+    iters = 3
+    start = time.perf_counter()
+    for _ in range(iters):
+        tm_rouge(preds, target, rouge_keys=keys)
+    return _ROUGE_N * iters / (time.perf_counter() - start)
+
+
 def ref_pr_curve() -> float:
     import torch
 
@@ -422,8 +737,13 @@ def _run_ours(hardware: str) -> dict:
         "stateful": _safe(bench_acc_stateful, preds, target),
         "scan": _safe(bench_acc_scan, preds, target),
         "collection": _safe(bench_collection_mesh_sync),
+        "collection_nosync": _safe(bench_collection_mesh_sync, False),
         "curve": _safe(bench_pr_curve),
         "inception": _safe(bench_inception, hardware),
+        "clip": _safe(bench_clip_score, hardware),
+        "bert": _safe(bench_bert_score, hardware),
+        "perplexity": _safe(bench_perplexity),
+        "rouge": _safe(bench_rouge),
     }
 
 
@@ -458,15 +778,28 @@ def _worker_main(mode: str) -> None:
                 "curve": _safe(bench_pr_curve),
                 "ref_curve": _safe(ref_pr_curve),
             })
-        _min_merge(out, {"inception": _safe(bench_inception, "cpu-fallback")})
+        _min_merge(out, {
+            "inception": _safe(bench_inception, "cpu-fallback"),
+            "clip": _safe(bench_clip_score, "cpu-fallback"),
+            "bert": _safe(bench_bert_score, "cpu-fallback"),
+            "perplexity": _safe(bench_perplexity),
+            "ref_perplexity": _safe(ref_perplexity),
+            "rouge": _safe(bench_rouge),
+            "ref_rouge": _safe(ref_rouge),
+        })
     elif mode == "mesh":
         force_cpu(8)
         _safe(_reference_modules)
         for _ in range(2):
             _min_merge(out, {
                 "collection": _safe(bench_collection_mesh_sync),
+                "collection_nosync": _safe(bench_collection_mesh_sync, False),
                 "ref_collection": _safe(ref_collection),
             })
+    elif mode == "hotops":
+        # NO force_cpu: inherits the pinned TPU backend; TM_TPU_USE_PALLAS comes
+        # from the spawning process's env (the A/B lever)
+        out = bench_hotops()
     print(json.dumps(out))
 
 
@@ -488,6 +821,35 @@ def _run_fallback_via_workers() -> dict:
     return merged
 
 
+def _run_pallas_ab() -> dict:
+    """On real TPU hardware: run the kernel-backed hot ops with the Pallas kernels
+    off and on (subprocess env is the only reliable lever — the jit caches in a
+    live process would otherwise pin the first trace's choice)."""
+    ab = {}
+    for arm, flag in (("xla", "0"), ("pallas", "1")):
+        env = dict(os.environ, TM_TPU_USE_PALLAS=flag)
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--worker", "hotops"],
+                capture_output=True, text=True, timeout=900, env=env,
+            )
+            if proc.returncode == 0 and proc.stdout.strip():
+                ab[arm] = json.loads(proc.stdout.strip().splitlines()[-1])
+            else:
+                sys.stderr.write(f"pallas A/B arm {arm} rc={proc.returncode}: {proc.stderr[-400:]}\n")
+        except Exception as err:
+            sys.stderr.write(f"pallas A/B arm {arm} failed: {err!r}\n")
+    if "xla" in ab and "pallas" in ab:
+        ab["speedup"] = {
+            op: round(ab["xla"][op] / ab["pallas"][op], 3)
+            for op in ab["xla"]
+            if isinstance(ab["xla"].get(op), (int, float))
+            and isinstance(ab["pallas"].get(op), (int, float))
+            and ab["pallas"][op] > 0
+        }
+    return ab
+
+
 def main() -> None:
     hardware = _acquire_backend()
     if hardware == "cpu-fallback":
@@ -496,12 +858,16 @@ def main() -> None:
         ref_stateful = ours.get("ref_stateful")
         ref_col = ours.get("ref_collection")
         ref_curve = ours.get("ref_curve")
+        pallas_ab = {"note": "skipped: Pallas kernels require TPU hardware (interpret-mode parity is covered in tests)"}
     else:
         ours = _run_ours(hardware)
         _safe(_reference_modules)
         ref_stateful = _safe(ref_acc_stateful)
         ref_col = _safe(ref_collection)
         ref_curve = _safe(ref_pr_curve)
+        ours["ref_perplexity"] = _safe(ref_perplexity)
+        ours["ref_rouge"] = _safe(ref_rouge)
+        pallas_ab = _run_pallas_ab()
     ours_stateful = ours.get("stateful")
     ours_scan = ours.get("scan")
     ours_collection = ours.get("collection")
@@ -512,6 +878,17 @@ def main() -> None:
         if ref is None or ours is None or ours <= 0:
             return None
         return round(ref / ours, 3)
+
+    def ratio_inv(ref, ours):
+        # throughput configs: higher is better, so vs_baseline = ours / ref
+        if ref is None or ours is None or ref <= 0:
+            return None
+        return round(ours / ref, 3)
+
+    def _sync_overhead_pct(with_sync, without_sync):
+        if with_sync is None or without_sync is None or with_sync <= 0:
+            return None
+        return round(max(0.0, (with_sync - without_sync) / with_sync * 100.0), 2)
 
     configs = {
         "acc_update_stateful": {
@@ -535,6 +912,33 @@ def main() -> None:
             "value": ours_incep, "unit": "imgs/sec", "baseline": None, "vs_baseline": None,
             "note": "reference needs torch-fidelity weights (not installed); FLOPs-identical random-weight net",
         },
+        "clip_score": {
+            "value": ours.get("clip"), "unit": "samples/sec", "baseline": None, "vs_baseline": None,
+            "note": "ViT-B/32-dims random weights on TPU, tiny fabricated CLIP on the CPU fallback;"
+                    " reference downloads weights (no egress here)",
+        },
+        "bert_score": {
+            "value": ours.get("bert"), "unit": "samples/sec", "baseline": None, "vs_baseline": None,
+            "note": "BERT-base encoder dims (random weights) on TPU, tiny on the CPU fallback;"
+                    " reference downloads weights (no egress here)",
+        },
+        "perplexity_8x128x8192": {
+            "value": ours.get("perplexity"), "unit": "samples/sec",
+            "baseline": ours.get("ref_perplexity"),
+            "vs_baseline": ratio_inv(ours.get("ref_perplexity"), ours.get("perplexity")),
+        },
+        "rouge_corpus_64": {
+            "value": ours.get("rouge"), "unit": "samples/sec",
+            "baseline": ours.get("ref_rouge"),
+            "vs_baseline": ratio_inv(ours.get("ref_rouge"), ours.get("rouge")),
+        },
+        "mesh_sync_overhead_pct": {
+            "value": _sync_overhead_pct(ours.get("collection"), ours.get("collection_nosync")),
+            "unit": "% of step time", "baseline": 2.0,
+            "vs_baseline": None,
+            "note": "BASELINE.md north star: metric-sync overhead < 2% of step time"
+                    " (sync-every-step vs identical step without collectives)",
+        },
     }
     for cfg in configs.values():
         if isinstance(cfg.get("value"), float):
@@ -549,6 +953,7 @@ def main() -> None:
         "vs_baseline": ratio(ref_stateful, ours_stateful),
         "hardware": hardware,
         "configs": configs,
+        "pallas_ab": pallas_ab,
     }
     print(json.dumps(result))
 

@@ -9,6 +9,7 @@ weights-readiness kit must make a file-drop complete the proof with zero code).
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 
@@ -23,13 +24,15 @@ from torchmetrics_tpu.utils.imports import _FLAX_AVAILABLE, _TRANSFORMERS_AVAILA
 
 torch = pytest.importorskip("torch")
 
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 
 def _run_cli(*args: str) -> subprocess.CompletedProcess:
     return subprocess.run(
         [sys.executable, "-m", "torchmetrics_tpu.convert", *args],
         capture_output=True,
         text=True,
-        cwd="/root/repo",
+        cwd=_REPO_ROOT,
     )
 
 

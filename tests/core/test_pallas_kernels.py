@@ -13,7 +13,14 @@ import pytest
 import jax.numpy as jnp
 
 from tests.helpers.testers import _assert_allclose
-from torchmetrics_tpu.ops import binned_curve_counts_pallas, confusion_matrix_pallas, pallas_enabled
+from torchmetrics_tpu.ops import (
+    bincount_pallas,
+    binned_curve_counts_pallas,
+    confusion_matrix_pallas,
+    pallas_enabled,
+    ssim_moments_pallas,
+    weighted_bincount_pallas,
+)
 
 
 class TestConfusionMatrixKernel:
@@ -90,6 +97,145 @@ class TestBinnedCurveKernel:
         want_fp = (above & (labels == 0)[None] & valid[None]).sum(1)
         _assert_allclose(got[:, 0], want_tp, atol=0)
         _assert_allclose(got[:, 1], want_fp, atol=0)
+
+
+class TestBincountKernel:
+    @pytest.mark.parametrize("n, c", [(100, 5), (4096, 1000), (50, 257), (3, 2)])
+    def test_matches_numpy(self, n, c):
+        rng = np.random.RandomState(n + c)
+        x = rng.randint(0, c, n)
+        valid = rng.rand(n) > 0.25
+        got = bincount_pallas(
+            jnp.asarray(x), jnp.asarray(valid.astype(np.float32)), c, interpret=True
+        )
+        want = np.bincount(x[valid], minlength=c)
+        _assert_allclose(got, want, atol=0)
+
+    def test_empty_input_is_zero(self):
+        got = bincount_pallas(
+            jnp.zeros(0, dtype=jnp.int32), jnp.zeros(0, dtype=jnp.float32), 7, interpret=True
+        )
+        _assert_allclose(got, np.zeros(7), atol=0)
+        got = bincount_pallas(jnp.zeros(0, dtype=jnp.int32), None, 7, interpret=True)
+        _assert_allclose(got, np.zeros(7), atol=0)
+
+    @pytest.mark.parametrize("n, c", [(100, 5), (1000, 128), (130, 300)])
+    def test_unweighted_kernel_matches_numpy(self, n, c):
+        # valid=None selects the index-only kernel (padding routed to bin `minlength`)
+        rng = np.random.RandomState(n * c)
+        x = rng.randint(0, c, n)
+        got = bincount_pallas(jnp.asarray(x), None, c, interpret=True)
+        _assert_allclose(got, np.bincount(x, minlength=c), atol=0)
+
+    def test_wired_into_bincount_engine(self, monkeypatch):
+        """`utils/data._bincount` routes through the kernel when pallas is on."""
+        import functools
+
+        from torchmetrics_tpu.ops import pallas_kernels
+        from torchmetrics_tpu.utils.data import _bincount
+
+        monkeypatch.setattr(pallas_kernels, "pallas_enabled", lambda: True)
+        monkeypatch.setattr(
+            pallas_kernels, "bincount_pallas",
+            functools.partial(bincount_pallas, interpret=True),
+        )
+        rng = np.random.RandomState(3)
+        x = rng.randint(0, 700, 2048)  # n*minlength > 1<<18 → kernel path
+        got = _bincount(jnp.asarray(x), minlength=700)
+        _assert_allclose(got, np.bincount(x, minlength=700), atol=0)
+
+
+class TestWeightedBincountKernel:
+    @pytest.mark.parametrize("n, c, k", [(300, 15, 3), (2048, 400, 2), (9, 5, 1)])
+    def test_matches_numpy(self, n, c, k):
+        rng = np.random.RandomState(n + c + k)
+        x = rng.randint(0, c, n)
+        weights = rng.rand(k, n).astype(np.float32)
+        got = weighted_bincount_pallas(
+            jnp.asarray(x), jnp.asarray(weights), c, interpret=True
+        )
+        want = np.stack([np.bincount(x, weights=weights[i], minlength=c) for i in range(k)])
+        _assert_allclose(got, want, atol=1e-4)
+
+    def test_wired_into_calibration_error(self, monkeypatch):
+        """Binary ECE through the kernel equals the XLA one-hot-matmul path."""
+        import functools
+
+        from torchmetrics_tpu.functional.classification.calibration_error import (
+            binary_calibration_error,
+        )
+        from torchmetrics_tpu.ops import pallas_kernels
+
+        rng = np.random.RandomState(21)
+        preds = jnp.asarray(rng.rand(512).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, 512))
+        want = binary_calibration_error(preds, target, n_bins=15)
+
+        monkeypatch.setattr(pallas_kernels, "pallas_enabled", lambda: True)
+        monkeypatch.setattr(
+            pallas_kernels, "weighted_bincount_pallas",
+            functools.partial(weighted_bincount_pallas, interpret=True),
+        )
+        monkeypatch.setattr(
+            pallas_kernels, "bincount_pallas",
+            functools.partial(bincount_pallas, interpret=True),
+        )
+        got = binary_calibration_error(preds, target, n_bins=15)
+        _assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+
+class TestSsimMomentsKernel:
+    @pytest.mark.parametrize("shape, kh, kw", [((3, 20, 22), 5, 7), ((1, 16, 16), 11, 11), ((4, 13, 9), 3, 3)])
+    def test_matches_separable_conv(self, shape, kh, kw):
+        rng = np.random.RandomState(sum(shape) + kh + kw)
+        p = rng.rand(*shape).astype(np.float32)
+        t = rng.rand(*shape).astype(np.float32)
+        wh = rng.rand(kh).astype(np.float32)
+        ww = rng.rand(kw).astype(np.float32)
+
+        got = np.asarray(
+            ssim_moments_pallas(
+                jnp.asarray(p), jnp.asarray(t), jnp.asarray(wh), jnp.asarray(ww), interpret=True
+            )
+        )
+        k2 = np.outer(wh, ww)
+        ho, wo = shape[1] - kh + 1, shape[2] - kw + 1
+        assert got.shape == (shape[0], 5, ho, wo)
+        for plane_idx in range(shape[0]):
+            planes = (p[plane_idx], t[plane_idx], p[plane_idx] ** 2,
+                      t[plane_idx] ** 2, p[plane_idx] * t[plane_idx])
+            for m, plane in enumerate(planes):
+                want = np.zeros((ho, wo), dtype=np.float64)
+                for i in range(kh):
+                    for j in range(kw):
+                        want += k2[i, j] * plane[i:i + ho, j:j + wo]
+                _assert_allclose(got[plane_idx, m], want, atol=1e-4)
+
+    @pytest.mark.parametrize("gaussian_kernel", [True, False])
+    def test_wired_into_ssim(self, monkeypatch, gaussian_kernel):
+        """Full SSIM through the kernel equals the XLA grouped-conv path."""
+        import functools
+
+        from torchmetrics_tpu.functional.image.ssim import structural_similarity_index_measure
+        from torchmetrics_tpu.ops import pallas_kernels
+
+        rng = np.random.RandomState(9)
+        preds = jnp.asarray(rng.rand(2, 3, 32, 32).astype(np.float32))
+        target = jnp.asarray(rng.rand(2, 3, 32, 32).astype(np.float32))
+
+        want = structural_similarity_index_measure(
+            preds, target, gaussian_kernel=gaussian_kernel, data_range=1.0
+        )
+
+        monkeypatch.setattr(pallas_kernels, "pallas_enabled", lambda: True)
+        monkeypatch.setattr(
+            pallas_kernels, "ssim_moments_pallas",
+            functools.partial(ssim_moments_pallas, interpret=True),
+        )
+        got = structural_similarity_index_measure(
+            preds, target, gaussian_kernel=gaussian_kernel, data_range=1.0
+        )
+        _assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5)
 
 
 def test_pallas_disabled_off_tpu():

@@ -11,6 +11,7 @@ real torchvision checkpoint drop yields reference LPIPS values with no code chan
 
 from __future__ import annotations
 
+import os
 import subprocess
 import sys
 
@@ -30,6 +31,8 @@ from torchmetrics_tpu.functional.image._lpips_backbones import (
 )
 
 torch = pytest.importorskip("torch")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 nn = torch.nn
 
 
@@ -174,7 +177,7 @@ def test_full_lpips_with_converted_backbone(tmp_path):
     cli = subprocess.run(
         [sys.executable, "-m", "torchmetrics_tpu.convert", "lpips-backbone",
          str(ckpt), "--net", "alex", "-o", str(out)],
-        capture_output=True, text=True, cwd="/root/repo",
+        capture_output=True, text=True, cwd=_REPO_ROOT,
     )
     assert cli.returncode == 0, cli.stderr
     assert (tmp_path / "MANIFEST.json").exists()

@@ -28,8 +28,15 @@ from tests.weights.conftest import find_lpips_backbone
 
 torch = pytest.importorskip("torch")
 
-_HAS_TORCH_FIDELITY = importlib.util.find_spec("torch_fidelity") is not None
-_HAS_TORCHVISION = importlib.util.find_spec("torchvision") is not None
+def _has_module(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ValueError):  # ValueError: another test stubbed it in sys.modules
+        return False
+
+
+_HAS_TORCH_FIDELITY = _has_module("torch_fidelity")
+_HAS_TORCHVISION = _has_module("torchvision")
 
 
 def _seeded_uint8_images(seed: int, n: int = 8, size: int = 64) -> np.ndarray:

@@ -133,9 +133,18 @@ def convert_hf_flax(model_path: str, out_dir: str, model_class: Optional[str] = 
         except Exception:  # tokenizer/processor is optional (e.g. bare encoders)
             continue
 
-    weights = os.path.join(out_dir, "flax_model.msgpack")
-    entry: Dict[str, Any] = {"kind": "hf-flax", "source": os.path.abspath(model_path)}
-    if os.path.exists(weights):
-        entry["sha256"] = sha256_file(weights)
-    _record_manifest(weights, entry)
+    import glob
+
+    # large models shard as flax_model-00001-of-0000N.msgpack — record every shard
+    shards = sorted(glob.glob(os.path.join(out_dir, "flax_model*.msgpack")))
+    for shard in shards:
+        _record_manifest(
+            shard,
+            {"kind": "hf-flax", "source": os.path.abspath(model_path), "sha256": sha256_file(shard)},
+        )
+    if not shards:  # still leave an auditable trace of the conversion
+        _record_manifest(
+            os.path.join(out_dir, "flax_model.msgpack"),
+            {"kind": "hf-flax", "source": os.path.abspath(model_path)},
+        )
     return out_dir

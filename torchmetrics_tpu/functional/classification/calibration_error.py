@@ -50,6 +50,14 @@ def _binning_update(confidences: Array, accuracies: Array, valid: Array, n_bins:
     """
     v = valid.astype(jnp.float32)
     bin_idx = jnp.clip(jnp.ceil(confidences * n_bins).astype(jnp.int32) - 1, 0, n_bins - 1)
+    from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled():
+        # one index pass, all three statistics contracted in VMEM
+        from torchmetrics_tpu.ops.pallas_kernels import weighted_bincount_pallas
+
+        weights = jnp.stack([confidences.astype(jnp.float32) * v, accuracies.astype(jnp.float32) * v, v])
+        return weighted_bincount_pallas(bin_idx, weights, n_bins)
     oh = jax.nn.one_hot(bin_idx, n_bins, dtype=jnp.float32) * v[:, None]  # [N, B]
     conf_sum = oh.T @ confidences.astype(jnp.float32)
     acc_sum = oh.T @ accuracies.astype(jnp.float32)

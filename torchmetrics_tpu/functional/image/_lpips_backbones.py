@@ -239,13 +239,13 @@ def _validate_backbone_params(params: Dict[str, Any], net_type: str) -> None:
         )
 
 
-def load_lpips_backbone_params(net_type: str, path: Optional[str] = None) -> Dict[str, Any]:
-    """Load (and convert if needed) the ``net_type`` backbone parameters.
+def resolve_lpips_backbone_path(net_type: str, path: Optional[str] = None) -> str:
+    """Resolve the concrete weights file for ``net_type``.
 
     Resolution order: explicit ``path`` → ``$TORCHMETRICS_TPU_LPIPS_BACKBONES``
     directory containing ``{alex,vgg,squeeze}.npz`` or the torchvision ``.pth``.
-    ``.npz`` files are loaded with plain numpy; ``.pth`` via ``torch.load`` and
-    converted on the fly.
+    Exposed separately so callers that cache loaded backbones can key on the
+    resolved file, not on the mutable env var.
     """
     if net_type not in _PYRAMIDS:
         raise ValueError(f"Argument `net_type` must be one of {tuple(_PYRAMIDS)}, but got {net_type}")
@@ -266,6 +266,16 @@ def load_lpips_backbone_params(net_type: str, path: Optional[str] = None) -> Dic
             f" `.npz` via the `weights_path` argument, or point {_BACKBONES_ENV_VAR}"
             " at a directory containing it. This environment cannot download weights."
         )
+    return path
+
+
+def load_lpips_backbone_params(net_type: str, path: Optional[str] = None) -> Dict[str, Any]:
+    """Load (and convert if needed) the ``net_type`` backbone parameters.
+
+    ``.npz`` files are loaded with plain numpy; ``.pth`` via ``torch.load`` and
+    converted on the fly. See :func:`resolve_lpips_backbone_path` for resolution.
+    """
+    path = resolve_lpips_backbone_path(net_type, path)
     if path.endswith(".npz"):
         from torchmetrics_tpu.utils.serialization import load_tree_npz
 

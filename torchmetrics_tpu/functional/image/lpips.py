@@ -77,11 +77,22 @@ def load_lpips_head_weights(net_type: str = "alex") -> list:
 
 
 @functools.lru_cache(maxsize=8)
-def _cached_backbone_fn(net_type: str, weights_path: Optional[str]) -> Callable:
-    """Load + jit the named backbone once per (net, path)."""
+def _cached_backbone_by_file(net_type: str, resolved_path: str) -> Callable:
     from torchmetrics_tpu.functional.image._lpips_backbones import make_lpips_feature_fn
 
-    return make_lpips_feature_fn(net_type, weights_path=weights_path)
+    return make_lpips_feature_fn(net_type, weights_path=resolved_path)
+
+
+def _cached_backbone_fn(net_type: str, weights_path: Optional[str]) -> Callable:
+    """Load + jit the named backbone once per (net, concrete file).
+
+    Env-var resolution happens *before* the cache key, so re-pointing
+    ``$TORCHMETRICS_TPU_LPIPS_BACKBONES`` at different weights is picked up by the
+    next construction instead of silently reusing the old backbone.
+    """
+    from torchmetrics_tpu.functional.image._lpips_backbones import resolve_lpips_backbone_path
+
+    return _cached_backbone_by_file(net_type, resolve_lpips_backbone_path(net_type, weights_path))
 
 
 def learned_perceptual_image_patch_similarity(

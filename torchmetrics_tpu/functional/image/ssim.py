@@ -106,30 +106,54 @@ def _ssim_update(
         pad_d = (gauss_kernel_size[2] - 1) // 2
         preds = _reflect_pad_3d(preds, pad_h, pad_w, pad_d)
         target = _reflect_pad_3d(target, pad_h, pad_w, pad_d)
-        if gaussian_kernel:
-            kernel = _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
     else:
         preds = _reflect_pad_2d(preds, pad_h, pad_w)
         target = _reflect_pad_2d(target, pad_h, pad_w)
-        if gaussian_kernel:
-            kernel = _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
 
-    if not gaussian_kernel:
-        kernel = jnp.full(
-            (channel, 1, *kernel_size), 1.0 / jnp.prod(jnp.asarray(kernel_size)), dtype=dtype
-        )
-
-    # (5B, C, ...) stack: one grouped conv produces all five moments
-    input_list = jnp.concatenate(
-        (preds, target, preds * preds, target * target, preds * target), axis=0
-    )
-    outputs = (
-        _conv3d(input_list, kernel, groups=channel)
-        if is_3d
-        else _conv2d(input_list, kernel, groups=channel)
-    )
     b = preds.shape[0]
-    mu_pred, mu_target, e_pp, e_tt, e_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
+    from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
+
+    if not is_3d and pallas_enabled():
+        # fused separable path (the 2D window is always an outer product of two 1D
+        # factors): the p², t², pt product planes never touch HBM
+        from torchmetrics_tpu.functional.image.utils import _gaussian
+        from torchmetrics_tpu.ops.pallas_kernels import ssim_moments_pallas
+
+        if gaussian_kernel:
+            wh = _gaussian(gauss_kernel_size[0], sigma[0], jnp.float32)
+            ww = _gaussian(gauss_kernel_size[1], sigma[1], jnp.float32)
+        else:
+            wh = jnp.full((kernel_size[0],), 1.0 / kernel_size[0], dtype=jnp.float32)
+            ww = jnp.full((kernel_size[1],), 1.0 / kernel_size[1], dtype=jnp.float32)
+        planes = ssim_moments_pallas(
+            preds.reshape(-1, *preds.shape[2:]),
+            target.reshape(-1, *target.shape[2:]),
+            wh,
+            ww,
+        )  # [B*C, 5, Ho, Wo]
+        moments = planes.reshape(b, channel, 5, *planes.shape[2:]).astype(dtype)
+        mu_pred, mu_target, e_pp, e_tt, e_pt = (moments[:, :, i] for i in range(5))
+    else:
+        if gaussian_kernel:
+            kernel = (
+                _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
+                if is_3d
+                else _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
+            )
+        else:
+            kernel = jnp.full(
+                (channel, 1, *kernel_size), 1.0 / jnp.prod(jnp.asarray(kernel_size)), dtype=dtype
+            )
+        # (5B, C, ...) stack: one grouped conv produces all five moments
+        input_list = jnp.concatenate(
+            (preds, target, preds * preds, target * target, preds * target), axis=0
+        )
+        outputs = (
+            _conv3d(input_list, kernel, groups=channel)
+            if is_3d
+            else _conv2d(input_list, kernel, groups=channel)
+        )
+        mu_pred, mu_target, e_pp, e_tt, e_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
 
     mu_pred_sq = jnp.square(mu_pred)
     mu_target_sq = jnp.square(mu_target)

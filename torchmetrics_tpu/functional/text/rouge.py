@@ -163,13 +163,25 @@ def _ngram_windows(ids: np.ndarray, n: int) -> np.ndarray:
     return np.lib.stride_tricks.sliding_window_view(ids, n)
 
 
-def _score_ngram(pred_ids: np.ndarray, target_ids: np.ndarray, n: int) -> np.ndarray:
-    """ROUGE-N: clipped n-gram overlap counted via one unique() over both sides."""
+def _score_ngram(
+    pred_ids: np.ndarray, target_ids: np.ndarray, n: int, vocab_size: int = 0
+) -> np.ndarray:
+    """ROUGE-N: clipped n-gram overlap counted via one unique() over both sides.
+
+    When the per-sample vocabulary is small enough (always, for natural sentences),
+    each window is packed into one int64 key so the dedup is a 1-D ``np.unique`` —
+    roughly an order of magnitude cheaper than the row-sorting ``axis=0`` form.
+    """
     pw = _ngram_windows(pred_ids, n)
     tw = _ngram_windows(target_ids, n)
     if len(pw) == 0 or len(tw) == 0:
         return np.zeros(3)
-    _, inverse = np.unique(np.concatenate([pw, tw]), axis=0, return_inverse=True)
+    if vocab_size and vocab_size ** n < (1 << 62):
+        powers = vocab_size ** np.arange(n, dtype=np.int64)
+        keys = np.concatenate([pw, tw]) @ powers
+        _, inverse = np.unique(keys, return_inverse=True)
+    else:
+        _, inverse = np.unique(np.concatenate([pw, tw]), axis=0, return_inverse=True)
     n_kinds = int(inverse.max()) + 1
     from_pred = np.bincount(inverse[: len(pw)], minlength=n_kinds)
     from_target = np.bincount(inverse[len(pw):], minlength=n_kinds)
@@ -290,7 +302,7 @@ def _variant_scores(
     rows = []
     for key in rouge_keys_values:
         if isinstance(key, int):
-            rows.append(_score_ngram(pred_ids, target_ids, key))
+            rows.append(_score_ngram(pred_ids, target_ids, key, vocab_size))
         elif key == "L":
             rows.append(_score_lcs(pred_ids, target_ids))
         else:  # "Lsum"

@@ -1,9 +1,19 @@
 """Hand-written TPU (Pallas) kernels for the metric hot loops."""
 
 from torchmetrics_tpu.ops.pallas_kernels import (
+    bincount_pallas,
     binned_curve_counts_pallas,
     confusion_matrix_pallas,
     pallas_enabled,
+    ssim_moments_pallas,
+    weighted_bincount_pallas,
 )
 
-__all__ = ["binned_curve_counts_pallas", "confusion_matrix_pallas", "pallas_enabled"]
+__all__ = [
+    "bincount_pallas",
+    "binned_curve_counts_pallas",
+    "confusion_matrix_pallas",
+    "pallas_enabled",
+    "ssim_moments_pallas",
+    "weighted_bincount_pallas",
+]

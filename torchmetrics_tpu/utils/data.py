@@ -148,6 +148,14 @@ def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
     n = x.size
     if n == 0:
         return jnp.zeros(minlength, dtype=jnp.int32)
+    from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
+
+    if pallas_enabled() and n * minlength > (1 << 18):
+        # one-hot tiles stay in VMEM instead of HBM for the large-range regime;
+        # valid=None selects the unweighted kernel (only the [N] indices stream in)
+        from torchmetrics_tpu.ops.pallas_kernels import bincount_pallas
+
+        return bincount_pallas(x, None, minlength)
     if minlength <= 64 or n * minlength <= (1 << 22):
         iota = jnp.arange(minlength, dtype=x.dtype)
         return (x[:, None] == iota[None, :]).astype(jnp.int32).sum(axis=0)

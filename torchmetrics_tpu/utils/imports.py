@@ -50,11 +50,23 @@ def load_flax_with_pt_fallback(model_cls, model_name_or_path: str, **kwargs):
     torch-only snapshots (e.g. a dropped HF download) on the fly via ``from_pt=True``.
 
     Shared by every HF-backed metric (BERTScore, InfoLM, CLIPScore) and the convert
-    CLI so the fallback behavior cannot drift between call sites.
+    CLI so the fallback behavior cannot drift between call sites. When the snapshot
+    directory *does* contain flax weights, a load failure is a corrupt file, not a
+    torch-only snapshot — re-raised as-is so the true cause is not masked.
     """
+    import glob
+    import os
+
     try:
         return model_cls.from_pretrained(model_name_or_path, local_files_only=True, **kwargs)
-    except (OSError, ValueError):
-        return model_cls.from_pretrained(
-            model_name_or_path, local_files_only=True, from_pt=True, **kwargs
-        )
+    except (OSError, ValueError) as first_err:
+        if os.path.isdir(model_name_or_path) and glob.glob(
+            os.path.join(model_name_or_path, "flax_model*.msgpack")
+        ):
+            raise
+        try:
+            return model_cls.from_pretrained(
+                model_name_or_path, local_files_only=True, from_pt=True, **kwargs
+            )
+        except Exception as second_err:
+            raise second_err from first_err
