@@ -140,7 +140,7 @@ class TestBincountKernel:
             functools.partial(bincount_pallas, interpret=True),
         )
         rng = np.random.RandomState(3)
-        x = rng.randint(0, 700, 2048)  # n*minlength > 1<<18 → kernel path
+        x = rng.randint(0, 700, 8192)  # 64 < minlength ≤ 8192, n*minlength > 1<<22 → kernel path
         got = _bincount(jnp.asarray(x), minlength=700)
         _assert_allclose(got, np.bincount(x, minlength=700), atol=0)
 

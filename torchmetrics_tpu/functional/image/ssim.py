@@ -113,7 +113,16 @@ def _ssim_update(
     b = preds.shape[0]
     from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
 
-    if not is_3d and pallas_enabled():
+    def _moments_fit_vmem() -> bool:
+        # the kernel holds 2 padded input planes, the 5 output planes and ~3
+        # row-pass temporaries resident per grid step (no spatial tiling yet) —
+        # route only plane sizes that stay within a conservative ~12MB budget
+        hp, wp = preds.shape[-2], preds.shape[-1]
+        kh, kw = (gauss_kernel_size if gaussian_kernel else kernel_size)[:2]
+        ho, wo = hp - kh + 1, wp - kw + 1
+        return ho > 0 and wo > 0 and (2 * hp * wp + 5 * ho * wo + 3 * ho * wp) * 4 <= 12 << 20
+
+    if not is_3d and pallas_enabled() and _moments_fit_vmem():
         # fused separable path (the 2D window is always an outer product of two 1D
         # factors): the p², t², pt product planes never touch HBM
         from torchmetrics_tpu.functional.image.utils import _gaussian

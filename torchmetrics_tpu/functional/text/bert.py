@@ -16,7 +16,9 @@ model (requires locally cached weights — this environment cannot download them
 from __future__ import annotations
 
 import csv
+import functools
 import math
+import os
 import urllib.request
 from collections import Counter, defaultdict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -118,11 +120,39 @@ def _embed_corpus(
     attention_mask = np.asarray(encoded["attention_mask"])
     n = input_ids.shape[0]
 
+    # Shape bucketing: the tokenizer pads to the corpus' longest sentence and a
+    # streaming metric's corpus grows every compute, so raw shapes force a fresh XLA
+    # compile per call. Round the seq axis to a multiple of 16 (mask 0 ⇒ padding is
+    # inert through attention) and each chunk's row count to a power of two, so
+    # repeated computes hit a handful of cached programs instead of recompiling.
+    # The user_forward_fn path keeps raw shapes (an arbitrary callable may be
+    # shape-sensitive; reference contract, bert.py:100-103).
+    if user_forward_fn is None:
+        s = input_ids.shape[1]
+        s_pad = -(-s // 16) * 16
+        if s_pad != s:
+            input_ids_f = np.pad(input_ids, ((0, 0), (0, s_pad - s)))
+            attention_mask_f = np.pad(attention_mask, ((0, 0), (0, s_pad - s)))
+        else:
+            input_ids_f, attention_mask_f = input_ids, attention_mask
+    else:
+        input_ids_f, attention_mask_f = input_ids, attention_mask
+
     chunks: List[Array] = []
     starts = list(range(0, n, batch_size))
     for start in _get_progress_bar(starts, verbose):
-        ids_b = jnp.asarray(input_ids[start : start + batch_size])
-        mask_b = jnp.asarray(attention_mask[start : start + batch_size])
+        ids_np = input_ids_f[start : start + batch_size]
+        mask_np = attention_mask_f[start : start + batch_size]
+        rows = ids_np.shape[0]
+        if user_forward_fn is None and rows < batch_size:
+            # bucket the ragged final chunk: all-zero-mask pad rows are inert (the
+            # additive attention bias stays finite) and sliced off below
+            bucket = 1 << (rows - 1).bit_length()
+            if bucket != rows:
+                ids_np = np.pad(ids_np, ((0, bucket - rows), (0, 0)))
+                mask_np = np.pad(mask_np, ((0, bucket - rows), (0, 0)))
+        ids_b = jnp.asarray(ids_np)
+        mask_b = jnp.asarray(mask_np)
         if not all_layers:
             if user_forward_fn is not None:
                 out = user_forward_fn(model, {"input_ids": ids_b, "attention_mask": mask_b})
@@ -143,8 +173,10 @@ def _embed_corpus(
                     "With `all_layers=True` the model must return embeddings of shape"
                     f" (batch_size, num_layers, seq_len, model_dim), but got {out.shape}."
                 )
-        chunks.append(out)
+        chunks.append(out[:rows])
     out = jnp.concatenate(chunks, axis=0) if len(chunks) > 1 else chunks[0]
+    if user_forward_fn is None and out.shape[2] != input_ids.shape[1]:
+        out = out[:, :, : input_ids.shape[1]]  # drop the seq-axis bucketing pad
     out = out / jnp.linalg.norm(out, axis=-1, keepdims=True)
 
     processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
@@ -240,7 +272,37 @@ def _rescale_metrics_with_baseline(
     return all_metrics[..., 0], all_metrics[..., 1], all_metrics[..., 2]
 
 
+def _snapshot_stamp(model_name_or_path: str):
+    """(name, mtime, size) of every weights file in a local snapshot dir, so the model
+    cache key changes when the checkpoint on disk is replaced (e.g. the convert CLI
+    overwriting the same directory). Cache-by-name (HF hub ids) stamps as empty."""
+    import glob as _glob
+
+    if not os.path.isdir(model_name_or_path):
+        return ()
+    stamps = []
+    for pattern in ("flax_model*.msgpack", "pytorch_model*.bin", "model*.safetensors"):
+        for path in sorted(_glob.glob(os.path.join(model_name_or_path, pattern))):
+            stat = os.stat(path)
+            stamps.append((os.path.basename(path), stat.st_mtime_ns, stat.st_size))
+    return tuple(stamps)
+
+
 def _load_flax_model(model_name_or_path: str, num_layers: Optional[int], all_layers: bool = False):
+    """Cached wrapper around :func:`_load_flax_model_uncached` — the metric module's
+    ``compute`` goes through the functional on every call, and without the cache each
+    call would re-read the checkpoint AND re-create the jit wrapper (recompiling
+    every batch shape from scratch). Keyed on the snapshot's weight-file stamps so an
+    overwritten checkpoint is reloaded, not served stale."""
+    return _load_flax_model_uncached(
+        model_name_or_path, num_layers, all_layers, _snapshot_stamp(model_name_or_path)
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _load_flax_model_uncached(
+    model_name_or_path: str, num_layers: Optional[int], all_layers: bool = False, _stamp=()
+):
     """Load a transformers Flax encoder + tokenizer from local cache (no egress here).
 
     Returns ``(forward, tokenizer)``; the raw transformers model is attached as
@@ -272,18 +334,33 @@ def _load_flax_model(model_name_or_path: str, num_layers: Optional[int], all_lay
                 f" Please use num_layers <= {hf_model.config.num_hidden_layers}"
             )
 
-    def forward(input_ids: Array, attention_mask: Array) -> Array:
-        # traceable (no host round trip): the mesh-sharded path jits this callable
+    def _apply(params, input_ids: Array, attention_mask: Array) -> Array:
         out = hf_model(
             input_ids=jnp.asarray(input_ids), attention_mask=jnp.asarray(attention_mask),
-            output_hidden_states=True,
+            params=params, output_hidden_states=True,
         )
         if all_layers:
             return jnp.stack([jnp.asarray(h) for h in out.hidden_states], axis=1)  # (B, L, S, D)
         layer = num_layers if num_layers is not None else -1
         return jnp.asarray(out.hidden_states[layer])
 
+    # transformers' flax models run module.apply EAGERLY — per-op dispatch is the
+    # whole runtime on small batches (~150 pjit calls per forward). Jit with the
+    # params as an explicit operand: one compiled program per (B, S) shape bucket,
+    # ONE copy of the weights in device memory shared by all of them (folding them
+    # in as closure constants would duplicate the full model per bucket).
+    jitted = jax.jit(_apply)
+    model_params = hf_model.params
+
+    def forward(input_ids: Array, attention_mask: Array) -> Array:
+        return jitted(model_params, input_ids, attention_mask)
+
+    def _traceable(input_ids: Array, attention_mask: Array) -> Array:
+        # for the mesh path's sharded re-jit (params replicated by that jit once)
+        return _apply(model_params, input_ids, attention_mask)
+
     forward.hf_model = hf_model
+    forward.traceable = _traceable
     return forward, tokenizer
 
 
@@ -455,8 +532,9 @@ def bert_score(
             model = model.hf_model
     if mesh is not None and user_forward_fn is None:
         # data-parallel embedding extraction over the mesh's first axis (callable
-        # contract only — a user_forward_fn drives the model itself)
-        model = _shard_model_over_mesh(model, mesh)
+        # contract only — a user_forward_fn drives the model itself); re-jit from
+        # the traceable inner fn rather than nesting the single-device jit
+        model = _shard_model_over_mesh(getattr(model, "traceable", model), mesh)
 
     baseline = _load_baseline(lang, model_name_or_path, baseline_path, baseline_url) if rescale_with_baseline else None
 

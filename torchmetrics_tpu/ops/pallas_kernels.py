@@ -47,6 +47,18 @@ def pallas_enabled() -> bool:
     return os.environ.get("TM_TPU_USE_PALLAS", "0") == "1" and jax.default_backend() == "tpu"
 
 
+def _bin_sample_tile(n: int, c_pad: int) -> int:
+    """Sample-tile size keeping the in-VMEM [tile, c_pad] one-hot block ≤ ~2MB.
+
+    The fixed 1024-sample tile is only safe for narrow bin ranges; wide ranges must
+    shrink the tile (1024 bins → 512 samples, 8192 bins → 128 minimum). Callers gate
+    out ranges past ~8k bins where even the minimum tile blows the budget.
+    """
+    budget = (1 << 19) // c_pad  # 2MB / 4 bytes
+    tile = min(_SAMPLE_TILE, max(_LANE, (budget // _LANE) * _LANE))
+    return min(tile, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+
+
 def _pad_to(x: Array, size: int, fill) -> Array:
     if x.shape[0] == size:
         return x
@@ -208,7 +220,7 @@ def weighted_bincount_pallas(
     if n == 0:
         return jnp.zeros((k, minlength), dtype=jnp.float32)
     c_pad = max(_LANE, ((minlength + _LANE - 1) // _LANE) * _LANE)
-    tile = min(_SAMPLE_TILE, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+    tile = _bin_sample_tile(n, c_pad)
     n_pad = ((n + tile - 1) // tile) * tile
 
     x_p = _pad_to(x.astype(jnp.int32), n_pad, 0)
@@ -278,7 +290,7 @@ def bincount_pallas(
     if n == 0:
         return jnp.zeros((minlength,), dtype=jnp.int32)
     c_pad = max(_LANE, ((minlength + _LANE - 1) // _LANE) * _LANE)
-    tile = min(_SAMPLE_TILE, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+    tile = _bin_sample_tile(n, c_pad)
     n_pad = ((n + tile - 1) // tile) * tile
     # padded samples route to bin `minlength`: inside the padded iota range when
     # minlength < c_pad (sliced off below), outside it when minlength == c_pad
@@ -335,7 +347,9 @@ def ssim_moments_pallas(
     The product planes p², t², pt are formed in VMEM and consumed by the separable
     convolution without ever being written to HBM; the static Kh/Kw shift-and-add
     loops run on the VPU (8×128 lanes) while each plane's row pass reuses the
-    VMEM-resident input.
+    VMEM-resident input. No spatial tiling yet: the two input planes + five output
+    planes + temporaries must fit VMEM together — callers gate plane sizes (the SSIM
+    wiring routes only ≲12MB footprints; ~720×720 f32 planes).
     """
     from jax.experimental import pallas as pl
 

@@ -148,14 +148,18 @@ def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
     n = x.size
     if n == 0:
         return jnp.zeros(minlength, dtype=jnp.int32)
-    from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
+    # Pallas gate matches the kernel's contract exactly: only the regime where the
+    # XLA path below is the f32 chunked scan (same 2^24-per-bin exactness), and only
+    # bin ranges whose one-hot tile fits VMEM (the kernel shrinks its sample tile
+    # with c_pad; past 8192 bins no tile size keeps it in budget).
+    if 64 < minlength <= 8192 and n * minlength > (1 << 22):
+        from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
 
-    if pallas_enabled() and n * minlength > (1 << 18):
-        # one-hot tiles stay in VMEM instead of HBM for the large-range regime;
-        # valid=None selects the unweighted kernel (only the [N] indices stream in)
-        from torchmetrics_tpu.ops.pallas_kernels import bincount_pallas
+        if pallas_enabled():
+            # valid=None selects the unweighted kernel (only the [N] indices stream in)
+            from torchmetrics_tpu.ops.pallas_kernels import bincount_pallas
 
-        return bincount_pallas(x, None, minlength)
+            return bincount_pallas(x, None, minlength)
     if minlength <= 64 or n * minlength <= (1 << 22):
         iota = jnp.arange(minlength, dtype=x.dtype)
         return (x[:, None] == iota[None, :]).astype(jnp.int32).sum(axis=0)
