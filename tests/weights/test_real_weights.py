@@ -16,6 +16,7 @@ Reference anchors: ``src/torchmetrics/image/fid.py:44-66,326`` (inception weight
 from __future__ import annotations
 
 import importlib.util
+import os
 
 import numpy as np
 import pytest
@@ -142,6 +143,94 @@ class TestRealLpips:
         _assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-4)
 
 
+class TestRealInceptionFamily:
+    """KID / IS / MIFID ride the same inception checkpoint as FID."""
+
+    def test_kid_real_score(self, inception_weights):
+        from torchmetrics_tpu.image import KernelInceptionDistance
+
+        real = _seeded_uint8_images(0, n=12)
+        fake = _seeded_uint8_images(1, n=12)
+        kid = KernelInceptionDistance(subsets=4, subset_size=6, weights_path=inception_weights)
+        kid.update(jnp.asarray(real), real=True)
+        kid.update(jnp.asarray(fake), real=False)
+        mean, std = kid.compute()
+        assert np.isfinite(float(mean)) and np.isfinite(float(std))
+        print(f"\nreal-weights KID: {float(mean):.5f} ± {float(std):.5f}")
+
+    def test_inception_score_real(self, inception_weights):
+        from torchmetrics_tpu.image import InceptionScore
+
+        imgs = _seeded_uint8_images(2, n=12)
+        metric = InceptionScore(weights_path=inception_weights)
+        metric.update(jnp.asarray(imgs))
+        mean, std = metric.compute()
+        assert np.isfinite(float(mean)) and float(mean) >= 1.0  # IS lower bound is 1
+        print(f"\nreal-weights IS: {float(mean):.4f} ± {float(std):.4f}")
+
+    def test_mifid_real_score(self, inception_weights):
+        from torchmetrics_tpu.image import MemorizationInformedFrechetInceptionDistance
+
+        real = _seeded_uint8_images(0, n=12)
+        fake = _seeded_uint8_images(1, n=12)
+        mifid = MemorizationInformedFrechetInceptionDistance(weights_path=inception_weights)
+        mifid.update(jnp.asarray(real), real=True)
+        mifid.update(jnp.asarray(fake), real=False)
+        score = float(mifid.compute())
+        assert np.isfinite(score)
+        print(f"\nreal-weights MIFID: {score:.4f}")
+
+
+class TestRealClipIqa:
+    def test_clip_iqa_real_score(self, clip_model_dir):
+        from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment
+
+        rng = np.random.RandomState(7)
+        imgs = jnp.asarray(rng.randint(0, 256, (2, 3, 224, 224), dtype=np.uint8))
+        probs = clip_image_quality_assessment(imgs, model_name_or_path=clip_model_dir)
+        vals = np.asarray(probs)
+        assert np.isfinite(vals).all() and ((0 <= vals) & (vals <= 1)).all()
+        print(f"\nreal-weights CLIP-IQA: {vals}")
+
+
+class TestRealInfoLM:
+    def test_infolm_real_model(self, bert_model_dir):
+        """Needs a full checkpoint (MLM head included) — a bare encoder dir would
+        random-init the head differently on each side, so detect and skip."""
+        import glob as _glob
+
+        head_found = False
+        for pattern in ("pytorch_model*.bin", "model*.safetensors"):
+            for path in _glob.glob(os.path.join(bert_model_dir, pattern)):
+                if path.endswith(".bin"):
+                    keys = torch.load(path, map_location="meta", weights_only=True).keys()
+                else:
+                    import safetensors.torch
+
+                    keys = safetensors.torch.load_file(path).keys()
+                head_found = any(
+                    k.startswith(("cls.", "lm_head", "vocab_projector", "generator_lm_head"))
+                    for k in keys
+                )
+        if not head_found:
+            pytest.skip("snapshot has no MLM head weights (bare encoder)")
+
+        from torchmetrics_tpu.text import InfoLM
+
+        preds = ["the cat sat on the mat", "hello world"]
+        target = ["a cat sat on a mat", "hello there world"]
+        ours = InfoLM(bert_model_dir, idf=False, verbose=False)
+        ours.update(preds, target)
+        got = ours.compute()
+
+        ref_tm = reference_torchmetrics()
+        ref = ref_tm.text.infolm.InfoLM(bert_model_dir, idf=False, verbose=False)
+        ref.update(preds, target)
+        want = ref.compute()
+        _assert_allclose(np.asarray(got), np.asarray(want), atol=1e-3)
+        print(f"\nreal-weights InfoLM: {float(np.asarray(got)):.5f}")
+
+
 class TestRealBertScore:
     def test_bert_score_matches_reference(self, bert_model_dir):
         """Direct differential: both stacks run the same local snapshot offline."""
@@ -173,9 +262,9 @@ class TestRealClipScore:
 
         ours = clip_score(jnp.asarray(images), text, model_name_or_path=clip_model_dir)
 
-        ref_tm = reference_torchmetrics()
-        ref = ref_tm.functional.multimodal.clip_score(
-            torch.from_numpy(images), text, model_name_or_path=clip_model_dir
-        )
+        reference_torchmetrics()
+        from torchmetrics.functional.multimodal.clip_score import clip_score as ref_clip_score
+
+        ref = ref_clip_score(torch.from_numpy(images), text, model_name_or_path=clip_model_dir)
         _assert_allclose(np.asarray(ours), ref.detach().numpy(), atol=0.05)
         print(f"\nreal-weights CLIPScore: {float(np.asarray(ours)):.3f}")
