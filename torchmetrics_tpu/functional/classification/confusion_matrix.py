@@ -54,7 +54,10 @@ def _masked_confmat(preds: Array, target: Array, valid: Array, num_classes: int)
     """
     from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
 
-    if pallas_enabled():
+    # VMEM guard: the kernel keeps a [c_pad, c_pad] accumulator plus two
+    # [tile, c_pad] one-hot tiles resident; past ~1024 classes no tile size keeps
+    # the footprint in budget, so wide-C cases stay on the XLA contraction
+    if num_classes <= 1024 and pallas_enabled():
         from torchmetrics_tpu.ops.pallas_kernels import confusion_matrix_pallas
 
         return confusion_matrix_pallas(

@@ -157,7 +157,9 @@ def _binary_precision_recall_curve_update(
     t0 = (1.0 - target.astype(jnp.float32)) * v  # negatives
     from torchmetrics_tpu.ops.pallas_kernels import pallas_enabled
 
-    if pallas_enabled():
+    # VMEM guard: the kernel holds a [t_pad, tile] compare block; huge threshold
+    # grids stay on the XLA matmul path
+    if thresholds.shape[0] <= 4096 and pallas_enabled():
         # opt-in TPU kernel: threshold-compare tiles stay in VMEM, [T, 2]
         # accumulator resident — the [N, T] compare matrix never reaches HBM
         from torchmetrics_tpu.ops.pallas_kernels import binned_curve_counts_pallas

@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the framework's hottest memory-bound ops.
 
-Four fused kernels (the compute-bound ops — inception convs, BERT matmuls — belong
+Five fused kernels (the compute-bound ops — inception convs, BERT matmuls — belong
 to XLA; these are the ops where skipping an HBM round trip is the win):
 
 - :func:`confusion_matrix_pallas` — tiles the sample axis, builds each tile's
@@ -9,9 +9,10 @@ to XLA; these are the ops where skipping an HBM round trip is the win):
   operands; the kernel's HBM traffic is just the two [N] label vectors.
 - :func:`binned_curve_counts_pallas` — the binned PrecisionRecallCurve update:
   per-threshold tp/fp counts from score/label tiles on the VPU, [T, 2] out.
-- :func:`bincount_pallas` — the dim-zero reduction engine's scatter-free bincount
-  (``utils/data.py``): one-hot tiles in VMEM contracted against the validity
-  weights, [C] out; HBM traffic is one pass over the [N] values.
+- :func:`bincount_pallas` / :func:`weighted_bincount_pallas` — the dim-zero
+  reduction engine's scatter-free bincount (``utils/data.py``) and its K-statistic
+  generalization (calibration error's Σconf/Σacc/count ride one index pass):
+  one-hot tiles in VMEM contracted on the MXU, [C] / [K, C] out.
 - :func:`ssim_moments_pallas` — the SSIM window-moment accumulation: per image
   plane, computes the five sliding-window moments (E[p], E[t], E[p²], E[t²],
   E[pt]) with a separable gaussian/uniform window entirely in VMEM. The XLA path
@@ -87,8 +88,9 @@ def confusion_matrix_pallas(
         # buffer must not be left uninitialized
         return jnp.zeros((num_classes, num_classes), dtype=jnp.float32)
     c_pad = max(_LANE, ((num_classes + _LANE - 1) // _LANE) * _LANE)
-    # 1-D blocks need a lane-aligned (128) last dim for Mosaic lowering on hardware
-    tile = min(_SAMPLE_TILE, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+    # 1-D blocks need a lane-aligned (128) last dim for Mosaic lowering on hardware;
+    # the sample tile shrinks with c_pad so the one-hot blocks stay in VMEM budget
+    tile = _bin_sample_tile(n, c_pad)
     n_pad = ((n + tile - 1) // tile) * tile
 
     # invalid/padded samples route to class index c_pad-1 with valid=0 weight
@@ -156,7 +158,7 @@ def binned_curve_counts_pallas(
     if n == 0:
         return jnp.zeros((t, 2), dtype=jnp.float32)
     t_pad = max(8, ((t + 7) // 8) * 8)
-    tile = min(_SAMPLE_TILE, max(_LANE, ((n + _LANE - 1) // _LANE) * _LANE))
+    tile = _bin_sample_tile(n, t_pad)
     n_pad = ((n + tile - 1) // tile) * tile
 
     scores_p = _pad_to(scores.astype(jnp.float32), n_pad, 0.0)
