@@ -89,9 +89,11 @@ def clip_image_quality_assessment(
     imgs_uint8 = [np.asarray(jnp.clip(i / data_range * 255, 0, 255), dtype=np.uint8) for i in images]
 
     processed = processor(text=prompts_list, images=imgs_uint8, return_tensors="np", padding=True)
-    img_features = model.get_image_features(processed["pixel_values"])
+    img_fn = getattr(model, "_tm_image_features", model.get_image_features)
+    txt_fn = getattr(model, "_tm_text_features", model.get_text_features)
+    img_features = img_fn(np.asarray(processed["pixel_values"]))
     img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
-    txt_features = model.get_text_features(processed["input_ids"], processed["attention_mask"])
+    txt_features = txt_fn(np.asarray(processed["input_ids"]), np.asarray(processed["attention_mask"]))
     txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
 
     logits = 100 * jnp.einsum("bd,pd->bp", img_features, txt_features, precision=lax.Precision.HIGHEST)

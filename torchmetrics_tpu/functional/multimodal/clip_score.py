@@ -10,6 +10,7 @@ transformers' FlaxCLIPModel runs the forward natively on the TPU.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Tuple, Union
 
 import jax
@@ -25,7 +26,24 @@ _DEFAULT_MODEL = "openai/clip-vit-large-patch14"
 
 
 def _get_clip_model_and_processor(model_name_or_path: str = _DEFAULT_MODEL):
-    """Load FlaxCLIPModel + processor from the local transformers cache."""
+    """Load FlaxCLIPModel + processor from the local transformers cache.
+
+    Cached per (path, weight-file stamps) — the functional API goes through here on
+    every call — and the model carries jitted image/text feature extractors
+    (``_tm_image_features`` / ``_tm_text_features``) with the params as an explicit
+    operand: transformers' flax models otherwise run ``module.apply`` eagerly, one
+    dispatch per op, and folding params into the closure would duplicate the weights
+    per compiled batch shape.
+    """
+    from torchmetrics_tpu.utils.imports import snapshot_weight_stamp
+
+    return _get_clip_model_and_processor_uncached(
+        model_name_or_path, snapshot_weight_stamp(model_name_or_path)
+    )
+
+
+@functools.lru_cache(maxsize=2)
+def _get_clip_model_and_processor_uncached(model_name_or_path: str, _stamp=()):
     if not _TRANSFORMERS_AVAILABLE:
         raise ModuleNotFoundError(
             "CLIP metrics require that `transformers` is installed."
@@ -42,6 +60,14 @@ def _get_clip_model_and_processor(model_name_or_path: str = _DEFAULT_MODEL):
             f"Could not load CLIP model `{model_name_or_path}` from the local transformers cache"
             " and this environment has no network access. Provide a locally cached model path."
         ) from err
+
+    params = model.params
+    jit_img = jax.jit(lambda p, pv: model.get_image_features(pixel_values=pv, params=p))
+    jit_txt = jax.jit(
+        lambda p, ids, mask: model.get_text_features(input_ids=ids, attention_mask=mask, params=p)
+    )
+    model._tm_image_features = lambda pv: jit_img(params, pv)
+    model._tm_text_features = lambda ids, mask: jit_txt(params, ids, mask)
     return model, processor
 
 
@@ -70,11 +96,32 @@ def _clip_score_update(
         text=text, images=[np.asarray(i, dtype=np.uint8) for i in images],
         return_tensors="np", padding=True,
     )
-    img_features = model.get_image_features(processed_input["pixel_values"])
+    n = len(text)
+    pixel_values = np.asarray(processed_input["pixel_values"])
+    input_ids = np.asarray(processed_input["input_ids"])
+    attention_mask = np.asarray(processed_input["attention_mask"])
+    img_fn = getattr(model, "_tm_image_features", None)
+    txt_fn = getattr(model, "_tm_text_features", None)
+    if img_fn is not None:
+        # bucket the batch to a power of two (pad rows inert, sliced off) and the
+        # text seq to a multiple of 8, so varying user batches reuse a handful of
+        # compiled programs instead of recompiling every shape
+        bucket = 1 << (n - 1).bit_length()
+        if bucket != n:
+            pixel_values = np.pad(pixel_values, ((0, bucket - n), *([(0, 0)] * (pixel_values.ndim - 1))))
+            input_ids = np.pad(input_ids, ((0, bucket - n), (0, 0)))
+            attention_mask = np.pad(attention_mask, ((0, bucket - n), (0, 0)))
+        s = input_ids.shape[1]
+        s_pad = -(-s // 8) * 8
+        if s_pad != s:
+            input_ids = np.pad(input_ids, ((0, 0), (0, s_pad - s)))
+            attention_mask = np.pad(attention_mask, ((0, 0), (0, s_pad - s)))
+        img_features = img_fn(pixel_values)[:n]
+        txt_features = txt_fn(input_ids, attention_mask)[:n]
+    else:
+        img_features = model.get_image_features(pixel_values)
+        txt_features = model.get_text_features(input_ids, attention_mask)
     img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
-    txt_features = model.get_text_features(
-        processed_input["input_ids"], processed_input["attention_mask"]
-    )
     txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
 
     score = 100 * jnp.einsum(

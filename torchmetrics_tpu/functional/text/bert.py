@@ -272,30 +272,16 @@ def _rescale_metrics_with_baseline(
     return all_metrics[..., 0], all_metrics[..., 1], all_metrics[..., 2]
 
 
-def _snapshot_stamp(model_name_or_path: str):
-    """(name, mtime, size) of every weights file in a local snapshot dir, so the model
-    cache key changes when the checkpoint on disk is replaced (e.g. the convert CLI
-    overwriting the same directory). Cache-by-name (HF hub ids) stamps as empty."""
-    import glob as _glob
-
-    if not os.path.isdir(model_name_or_path):
-        return ()
-    stamps = []
-    for pattern in ("flax_model*.msgpack", "pytorch_model*.bin", "model*.safetensors"):
-        for path in sorted(_glob.glob(os.path.join(model_name_or_path, pattern))):
-            stat = os.stat(path)
-            stamps.append((os.path.basename(path), stat.st_mtime_ns, stat.st_size))
-    return tuple(stamps)
-
-
 def _load_flax_model(model_name_or_path: str, num_layers: Optional[int], all_layers: bool = False):
     """Cached wrapper around :func:`_load_flax_model_uncached` — the metric module's
     ``compute`` goes through the functional on every call, and without the cache each
     call would re-read the checkpoint AND re-create the jit wrapper (recompiling
     every batch shape from scratch). Keyed on the snapshot's weight-file stamps so an
     overwritten checkpoint is reloaded, not served stale."""
+    from torchmetrics_tpu.utils.imports import snapshot_weight_stamp
+
     return _load_flax_model_uncached(
-        model_name_or_path, num_layers, all_layers, _snapshot_stamp(model_name_or_path)
+        model_name_or_path, num_layers, all_layers, snapshot_weight_stamp(model_name_or_path)
     )
 
 

@@ -152,6 +152,13 @@ class InfoLM(_TextMetric):
             ) from err
         if not (isinstance(temperature, float) and temperature > 0):
             raise ValueError(f"Argument `temperature` is expected to be a positive float but got {temperature}")
+        # transformers flax models run module.apply eagerly (one dispatch per op);
+        # jit the MLM forward with params as an explicit operand — the per-position
+        # masking loop then replays one compiled program per (B, S) shape
+        self._model_params = self.model.params
+        self._jit_logits = jax.jit(
+            lambda p, ids, mask: self.model(input_ids=ids, attention_mask=mask, params=p).logits
+        )
         self.temperature = temperature
         self.idf = idf
         self.max_length = max_length or self.model.config.max_position_embeddings
@@ -214,7 +221,7 @@ class InfoLM(_TextMetric):
                 continue
             masked = input_ids.copy()
             masked[:, mask_idx] = mask_token_id
-            logits = np.asarray(self.model(input_ids=masked, attention_mask=attention_mask).logits)
+            logits = np.asarray(self._jit_logits(self._model_params, masked, attention_mask))
             probs = jax.nn.softmax(jnp.asarray(logits[:, mask_idx, :]) / self.temperature, axis=-1)
             probs = np.asarray(probs, dtype=np.float64)
             if self.idf:

@@ -45,6 +45,24 @@ _PYTHON_GREATER_EQUAL_3_11 = sys.version_info >= (3, 11)
 _LATEX_AVAILABLE = shutil.which("latex") is not None
 
 
+def snapshot_weight_stamp(model_name_or_path: str):
+    """(name, mtime, size) of every weights file in a local snapshot dir, so model
+    caches keyed on it reload when the checkpoint on disk is replaced (e.g. the
+    convert CLI overwriting the same directory). Cache-by-name (HF hub ids) stamps
+    as empty."""
+    import glob
+    import os
+
+    if not os.path.isdir(model_name_or_path):
+        return ()
+    stamps = []
+    for pattern in ("flax_model*.msgpack", "pytorch_model*.bin", "model*.safetensors"):
+        for path in sorted(glob.glob(os.path.join(model_name_or_path, pattern))):
+            stat = os.stat(path)
+            stamps.append((os.path.basename(path), stat.st_mtime_ns, stat.st_size))
+    return tuple(stamps)
+
+
 def load_flax_with_pt_fallback(model_cls, model_name_or_path: str, **kwargs):
     """``from_pretrained`` a transformers Flax model from a local snapshot, converting
     torch-only snapshots (e.g. a dropped HF download) on the fly via ``from_pt=True``.
