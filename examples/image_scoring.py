@@ -1,0 +1,60 @@
+"""Image-quality scoring pipeline: analytic metrics + the weights-gated FID family.
+
+Runs anywhere as-is (analytic metrics are fully native; FID falls back to random
+inception weights with a warning). Drop the torch-fidelity checkpoint to get real
+FID/KID numbers with no code changes:
+
+    python -m torchmetrics_tpu.convert inception pt_inception-2015-12-05-6726825d.pth \
+        -o weights/inception.npz
+    env TORCHMETRICS_TPU_INCEPTION_WEIGHTS=weights/inception.npz \
+        PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/image_scoring.py
+
+Reference equivalents: ``torchmetrics.image.{ssim,psnr,fid,kid}`` (which download
+weights at first use — this framework takes a local checkpoint instead, because TPU
+pods are routinely egress-free).
+"""
+
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.image import (
+    FrechetInceptionDistance,
+    PeakSignalNoiseRatio,
+    StructuralSimilarityIndexMeasure,
+)
+
+
+def main() -> None:
+    rng = np.random.RandomState(0)
+    clean = rng.rand(8, 3, 64, 64).astype(np.float32)
+    noisy = np.clip(clean + 0.05 * rng.normal(size=clean.shape).astype(np.float32), 0, 1)
+
+    analytic = MetricCollection(
+        {
+            "psnr": PeakSignalNoiseRatio(data_range=1.0),
+            "ssim": StructuralSimilarityIndexMeasure(data_range=1.0),
+        }
+    )
+    analytic.update(jnp.asarray(noisy), jnp.asarray(clean))
+    print("analytic:", {k: round(float(v), 4) for k, v in analytic.compute().items()})
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # random-weights warning when no checkpoint is set
+        fid = FrechetInceptionDistance(feature=2048, normalize=True)
+    generated = rng.rand(8, 3, 64, 64).astype(np.float32)  # a fake "generator" output
+    fid.update(jnp.asarray(clean), real=True)
+    fid.update(jnp.asarray(generated), real=False)
+    tag = "real weights" if os.environ.get("TORCHMETRICS_TPU_INCEPTION_WEIGHTS") else "RANDOM weights (drop a checkpoint for real scores)"
+    print(f"fid ({tag}): {float(fid.compute()):.4f}")
+
+
+if __name__ == "__main__":
+    main()
