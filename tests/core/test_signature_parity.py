@@ -52,6 +52,19 @@ def test_every_reference_class_exists():
     assert not missing, f"reference classes without a counterpart: {missing}"
 
 
+def test_reference_utilities_surface_exists():
+    """Everything the reference exports from ``torchmetrics.utilities`` has a
+    counterpart in ``torchmetrics_tpu.utils``."""
+    import torchmetrics_tpu.utils as our_u
+
+    reference_torchmetrics()
+    import torchmetrics.utilities as ref_u
+
+    ref_all = getattr(ref_u, "__all__", [n for n in dir(ref_u) if not n.startswith("_")])
+    missing = [name for name in ref_all if not hasattr(our_u, name)]
+    assert not missing, f"reference utilities without a counterpart: {missing}"
+
+
 @pytest.mark.parametrize(
     "cls_name, kwargs, attrs",
     [

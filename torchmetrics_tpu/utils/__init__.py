@@ -17,8 +17,13 @@ from torchmetrics_tpu.utils.data import (
 from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
 from torchmetrics_tpu.utils.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
 
+# reference exports these from torchmetrics.utilities (utilities/__init__.py)
+from torchmetrics_tpu.parallel.reductions import class_reduce, reduce
+
 __all__ = [
     "check_forward_full_state_property",
+    "class_reduce",
+    "reduce",
     "dim_zero_cat",
     "dim_zero_max",
     "dim_zero_mean",
