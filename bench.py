@@ -942,7 +942,9 @@ def main() -> None:
             "unit": "% of step time", "baseline": 2.0,
             "vs_baseline": None,
             "note": "BASELINE.md north star: metric-sync overhead < 2% of step time"
-                    " (sync-every-step vs identical step without collectives)",
+                    " (sync-every-step vs identical step without collectives); the"
+                    " cpu-fallback reading is noise-dominated on the oversubscribed"
+                    " 1-core host (observed 0-5% across runs) — meaningful on real TPU",
         },
     }
     for cfg in configs.values():
