@@ -52,6 +52,60 @@ def test_every_reference_class_exists():
     assert not missing, f"reference classes without a counterpart: {missing}"
 
 
+_DOMAINS = [
+    "classification", "regression", "image", "text", "audio", "detection",
+    "retrieval", "clustering", "segmentation", "nominal", "multimodal",
+    "wrappers", "aggregation",
+]
+
+# classes whose reference-named init options ride **kwargs to a validated shared
+# base (verified constructible below / in test_kwargs_passthrough_options_are_honored)
+_KNOWN_PASSTHROUGH = {
+    "BinaryPrecision", "BinaryRecall", "MulticlassPrecision", "MulticlassRecall",
+    "MultilabelPrecision", "MultilabelRecall",
+    "RetrievalAUROC", "RetrievalFallOut", "RetrievalHitRate", "RetrievalMAP",
+    "RetrievalMRR", "RetrievalNormalizedDCG", "RetrievalPrecision", "RetrievalRecall",
+    "RetrievalRecallAtFixedPrecision",
+    "CramersV", "TschuprowsT",
+}
+
+
+def test_domain_classes_exist_with_param_superset():
+    """The InfoLM/SCC class of gap: classes the reference exports only at domain
+    level must still match its init signature (modulo the verified kwargs
+    passthroughs)."""
+    import importlib
+
+    reference_torchmetrics()
+    gaps, missing = [], []
+    for dom in _DOMAINS:
+        ref_mod = importlib.import_module(f"torchmetrics.{dom}")
+        our_mod = importlib.import_module(f"torchmetrics_tpu.{dom}")
+        for name in sorted(getattr(ref_mod, "__all__", [])):
+            ref_cls = getattr(ref_mod, name, None)
+            if not inspect.isclass(ref_cls):
+                continue
+            # strict domain-path lookup: a drop-in user writes
+            # `from torchmetrics_tpu.<domain> import X`, so a top-level-only alias
+            # does not count as existing
+            our_cls = getattr(our_mod, name, None)
+            if our_cls is None:
+                missing.append(f"{dom}.{name}")
+                continue
+            if name in _KNOWN_PASSTHROUGH:
+                continue
+            try:
+                ref_params = set(inspect.signature(ref_cls.__init__).parameters)
+                our_params = set(inspect.signature(our_cls.__init__).parameters)
+            except (ValueError, TypeError):
+                continue
+            gap = ref_params - our_params - {"kwargs"}
+            if gap:
+                gaps.append((f"{dom}.{name}", sorted(gap)))
+    assert not missing, f"reference domain classes without a counterpart: {missing}"
+    assert not gaps, f"domain classes missing reference init parameters: {gaps}"
+
+
 def test_reference_utilities_surface_exists():
     """Everything the reference exports from ``torchmetrics.utilities`` has a
     counterpart in ``torchmetrics_tpu.utils``."""

@@ -306,3 +306,42 @@ class TestModulePassThrough:
         raw = np.asarray(plain.compute()["f1"])
         scaled = np.asarray(metric.compute()["f1"])
         _assert_allclose(scaled, (raw - 0.40) / (1 - 0.40), atol=1e-5)
+
+
+class TestModuleMatchesFunctional:
+    def test_small_position_budget_model(self, tmp_path):
+        """Module path pads stored encodings to `max_length`; with a model whose
+        position table is smaller than the 512 default this used to run the flax
+        forward out of its embedding range and silently return NaN→0 scores.
+        The module must cap to the encoder's budget and match the functional."""
+        transformers = pytest.importorskip("transformers")
+        from transformers import BertConfig, BertTokenizerFast, FlaxBertModel
+
+        from torchmetrics_tpu.functional.text.bert import bert_score
+        from torchmetrics_tpu.text import BERTScore
+
+        d = str(tmp_path / "tiny64")
+        vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]", "the", "cat", "sat", "hello", "world", "a", "there"]
+        import os as _os
+
+        _os.makedirs(d, exist_ok=True)
+        with open(d + "/vocab.txt", "w") as fh:
+            fh.write("\n".join(vocab))
+        config = BertConfig(
+            vocab_size=len(vocab), hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64, max_position_embeddings=64,
+        )
+        FlaxBertModel(config).save_pretrained(d)
+        BertTokenizerFast(vocab_file=d + "/vocab.txt", do_lower_case=True).save_pretrained(d)
+
+        preds = ["the cat sat", "hello world"]
+        target = ["a cat sat", "hello there"]
+        metric = BERTScore(model_name_or_path=d)
+        assert metric.max_length == 64  # capped from the 512 default
+        metric.update(preds, target)
+        got = metric.compute()
+        want = bert_score(preds, target, model_name_or_path=d)
+        for key in ("precision", "recall", "f1"):
+            vals = np.asarray(got[key])
+            assert np.isfinite(vals).all(), f"{key} has non-finite entries: {vals}"
+            _assert_allclose(vals, np.asarray(want[key]), atol=1e-5)

@@ -512,6 +512,14 @@ def bert_score(
 
     if model is None:
         model, user_tokenizer = _load_flax_model(model_name_or_path or _DEFAULT_MODEL, num_layers, all_layers)
+        # cap to the encoder's position-embedding budget: padding/truncating past it
+        # makes the flax forward produce garbage silently (torch raises an index
+        # error) — matters for small/custom local models with < 512 positions
+        model_max = getattr(
+            getattr(getattr(model, "hf_model", None), "config", None), "max_position_embeddings", None
+        )
+        if model_max is not None and max_length > model_max:
+            max_length = model_max
         if user_forward_fn is not None:
             # reference contract: user_forward_fn receives the loaded transformers
             # model itself, not the embedding wrapper (``bert.py:100-103``)

@@ -193,11 +193,15 @@ class SpatialCorrelationCoefficient(Metric):
     scc_score: Array
     total: Array
 
-    def __init__(self, hp_filter: Optional[Array] = None, window_size: int = 8, **kwargs: Any) -> None:
+    def __init__(
+        self, high_pass_filter: Optional[Array] = None, window_size: int = 8, **kwargs: Any
+    ) -> None:
+        # reference names the module kwarg `high_pass_filter` (image/scc.py:60); the
+        # functional keeps the reference functional's `hp_filter` name
         super().__init__(**kwargs)
-        if hp_filter is None:
-            hp_filter = jnp.array([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
-        self.hp_filter = hp_filter
+        if high_pass_filter is None:
+            high_pass_filter = jnp.array([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+        self.hp_filter = high_pass_filter
         self.ws = window_size
         self.add_state("scc_score", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")

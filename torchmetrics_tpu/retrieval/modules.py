@@ -305,6 +305,7 @@ class RetrievalPrecisionRecallCurve(Metric):
         adaptive_k: bool = False,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        aggregation: Union[str, Callable] = "mean",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -314,6 +315,12 @@ class RetrievalPrecisionRecallCurve(Metric):
         if not isinstance(adaptive_k, bool):
             raise ValueError("`adaptive_k` has to be a boolean")
         self.adaptive_k = adaptive_k
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable function"
+                f"which takes tensor of values, but got {aggregation}."
+            )
+        self.aggregation = aggregation
 
         empty_target_action_options = ("error", "skip", "neg", "pos")
         if empty_target_action not in empty_target_action_options:
@@ -372,10 +379,18 @@ class RetrievalPrecisionRecallCurve(Metric):
                 precisions.append(precision)
                 recalls.append(recall)
 
+        from torchmetrics_tpu.retrieval.base import _retrieval_aggregate
+
         precision = (
-            jnp.stack(precisions).mean(axis=0) if precisions else jnp.zeros(max_k)
+            _retrieval_aggregate(jnp.stack(precisions), self.aggregation, dim=0)
+            if precisions
+            else jnp.zeros(max_k)
         )
-        recall = jnp.stack(recalls).mean(axis=0) if recalls else jnp.zeros(max_k)
+        recall = (
+            _retrieval_aggregate(jnp.stack(recalls), self.aggregation, dim=0)
+            if recalls
+            else jnp.zeros(max_k)
+        )
         top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
         return precision, recall, top_k
 

@@ -69,8 +69,10 @@ class BERTScore(_TextMetric):
         user_forward_fn: Optional[Callable] = None,
         verbose: bool = False,
         idf: bool = False,
+        device: Optional[Any] = None,
         max_length: int = 512,
         batch_size: int = 64,
+        num_threads: int = 0,
         return_hash: bool = False,
         lang: str = "en",
         rescale_with_baseline: bool = False,
@@ -80,6 +82,11 @@ class BERTScore(_TextMetric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        # `device`/`num_threads` exist for drop-in signature parity with the
+        # reference (text/bert.py:178-180), where they pick the torch device and
+        # DataLoader workers; under JAX device placement is global (mesh/jit) and
+        # tokenization is in-process, so both are accepted and ignored.
+        del device, num_threads
         self.model_name_or_path = model_name_or_path or _DEFAULT_MODEL
         if model is None:
             model, user_tokenizer = _load_flax_model(self.model_name_or_path, num_layers, all_layers)
@@ -99,6 +106,14 @@ class BERTScore(_TextMetric):
         self.user_forward_fn = user_forward_fn
         self.verbose = verbose
         self.idf = idf
+        # cap to the loaded encoder's position-embedding budget: padding past it
+        # makes the flax forward produce garbage silently (torch would raise an
+        # index error) — matters for small/custom local models with < 512 positions
+        model_max = getattr(
+            getattr(getattr(model, "hf_model", None), "config", None), "max_position_embeddings", None
+        )
+        if model_max is not None and max_length > model_max:
+            max_length = model_max
         self.max_length = max_length
         self.batch_size = batch_size
         self.return_hash = return_hash

@@ -130,11 +130,23 @@ class InfoLM(_TextMetric):
         idf: bool = True,
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
+        device: Optional[Any] = None,
         max_length: Optional[int] = None,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
         return_sentence_level_score: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        # `device`/`num_threads` are accepted for drop-in parity with the reference
+        # (text/infolm.py:128-131) and ignored: device placement is global under
+        # JAX and tokenization is in-process
+        del device, num_threads
+        if not (isinstance(batch_size, int) and batch_size > 0):
+            raise ValueError(f"Argument `batch_size` is expected to be a positive integer but got {batch_size}")
+        self.batch_size = batch_size
+        self.verbose = verbose
         self.information_measure_fn = _InformationMeasure(information_measure, alpha, beta)
         if not _TRANSFORMERS_AVAILABLE:
             raise ModuleNotFoundError("InfoLM metric requires that `transformers` is installed.")
@@ -161,7 +173,10 @@ class InfoLM(_TextMetric):
         )
         self.temperature = temperature
         self.idf = idf
-        self.max_length = max_length or self.model.config.max_position_embeddings
+        # cap to the encoder's position budget (padding past it silently corrupts
+        # the flax forward; torch raises an index error)
+        model_max = self.model.config.max_position_embeddings
+        self.max_length = min(max_length, model_max) if max_length else model_max
         self.return_sentence_level_score = return_sentence_level_score
 
         self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
@@ -214,15 +229,35 @@ class InfoLM(_TextMetric):
         seq_len = input_ids.shape[1]
         mask_token_id = self.tokenizer.mask_token_id
 
+        from torchmetrics_tpu.functional.text.bert import _get_progress_bar
+
+        n = input_ids.shape[0]
         distributions = []
-        for mask_idx in range(seq_len):
+        for mask_idx in _get_progress_bar(range(seq_len), self.verbose):
             if not token_mask[:, mask_idx].any():
                 distributions.append(np.zeros((input_ids.shape[0], 1)))
                 continue
             masked = input_ids.copy()
             masked[:, mask_idx] = mask_token_id
-            logits = np.asarray(self._jit_logits(self._model_params, masked, attention_mask))
-            probs = jax.nn.softmax(jnp.asarray(logits[:, mask_idx, :]) / self.temperature, axis=-1)
+            chunks = []
+            for start in range(0, n, self.batch_size):
+                ids_b = masked[start : start + self.batch_size]
+                mask_b = attention_mask[start : start + self.batch_size]
+                rows = ids_b.shape[0]
+                if rows < self.batch_size:
+                    # bucket the ragged final chunk to a power of two (zero-mask
+                    # pad rows are inert, sliced off) so a growing corpus reuses
+                    # compiled programs — same recipe as bert._embed_corpus
+                    bucket = 1 << (rows - 1).bit_length()
+                    if bucket != rows:
+                        ids_b = np.pad(ids_b, ((0, bucket - rows), (0, 0)))
+                        mask_b = np.pad(mask_b, ((0, bucket - rows), (0, 0)))
+                # slice the mask position on device: only (rows, vocab) crosses to
+                # host, never the full (rows, seq, vocab) logits
+                out = self._jit_logits(self._model_params, ids_b, mask_b)[:rows, mask_idx, :]
+                chunks.append(np.asarray(out))
+            logits_at_mask = np.concatenate(chunks) if len(chunks) > 1 else chunks[0]
+            probs = jax.nn.softmax(jnp.asarray(logits_at_mask) / self.temperature, axis=-1)
             probs = np.asarray(probs, dtype=np.float64)
             if self.idf:
                 probs = probs * ids_idf[:, mask_idx : mask_idx + 1]
