@@ -106,6 +106,38 @@ def test_domain_classes_exist_with_param_superset():
     assert not gaps, f"domain classes missing reference init parameters: {gaps}"
 
 
+def test_domain_functionals_exist_with_param_superset():
+    """Same guarantee for the functional layer's domain modules — the top-level
+    audit can be fooled by lazy re-export wrappers whose signatures are (*args,
+    **kwargs), so the true signatures are checked at the domain path."""
+    import importlib
+
+    reference_torchmetrics()
+    fn_domains = [d for d in _DOMAINS if d not in ("wrappers", "aggregation")] + ["pairwise"]
+    gaps, missing = [], []
+    for dom in fn_domains:
+        ref_mod = importlib.import_module(f"torchmetrics.functional.{dom}")
+        our_mod = importlib.import_module(f"torchmetrics_tpu.functional.{dom}")
+        for name in sorted(getattr(ref_mod, "__all__", [])):
+            ref_fn = getattr(ref_mod, name, None)
+            if not callable(ref_fn) or inspect.isclass(ref_fn):
+                continue
+            our_fn = getattr(our_mod, name, None)
+            if our_fn is None:
+                missing.append(f"{dom}.{name}")
+                continue
+            try:
+                ref_params = set(inspect.signature(ref_fn).parameters)
+                our_params = set(inspect.signature(our_fn).parameters)
+            except (ValueError, TypeError):
+                continue
+            gap = ref_params - our_params - {"kwargs"}
+            if gap:
+                gaps.append((f"{dom}.{name}", sorted(gap)))
+    assert not missing, f"reference domain functionals without a counterpart: {missing}"
+    assert not gaps, f"domain functionals missing reference parameters: {gaps}"
+
+
 def test_reference_utilities_surface_exists():
     """Everything the reference exports from ``torchmetrics.utilities`` has a
     counterpart in ``torchmetrics_tpu.utils``."""

@@ -12,7 +12,7 @@ and therefore needs either pretrained weights or a custom ``similarity_fn``.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +40,29 @@ def _interpolate(latents1: Array, latents2: Array, epsilon: float, interpolation
     raise ValueError(f"Interpolation method {interpolation_method} not supported.")
 
 
+def _named_lpips_similarity(net_type: str) -> Callable[[Array, Array], Array]:
+    """Per-pair LPIPS distance from a named backbone (locally provided weights)."""
+    from torchmetrics_tpu.functional.image.lpips import (
+        _SCALE,
+        _SHIFT,
+        _cached_backbone_fn,
+        _lpips_from_features,
+        load_lpips_head_weights,
+    )
+
+    feature_fn = _cached_backbone_fn(net_type, None)
+    heads = load_lpips_head_weights(net_type)
+
+    def similarity(img1: Array, img2: Array) -> Array:
+        # generator images are in [-1, 1] (reference PPL contract); apply the
+        # LPIPS scaling layer then the backbone pyramid
+        feats1 = feature_fn((jnp.asarray(img1) - _SHIFT) / _SCALE)
+        feats2 = feature_fn((jnp.asarray(img2) - _SHIFT) / _SCALE)
+        return _lpips_from_features(feats1, feats2, heads)
+
+    return similarity
+
+
 def perceptual_path_length(
     generator: Any,
     num_samples: int = 10_000,
@@ -50,25 +73,41 @@ def perceptual_path_length(
     resize: Optional[int] = 64,
     lower_discard: Optional[float] = 0.01,
     upper_discard: Optional[float] = 0.99,
+    sim_net: Union[str, Callable[[Array, Array], Array]] = "vgg",
+    device: Optional[Any] = None,
     similarity_fn: Optional[Callable[[Array, Array], Array]] = None,
 ) -> Tuple[Array, Array, Array]:
     r"""Compute the perceptual path length of a generator.
 
     With ``conditional=True``, ``generator.sample`` must return ``(latents, labels)``
     and the generator is called as ``generator(latents, labels)``.
-    ``similarity_fn(img1, img2) -> (B,)`` defaults to LPIPS and therefore requires
-    pretrained weights; pass a custom callable here.
+
+    ``sim_net`` mirrors the reference (``perceptual_path_length.py:163``): a named
+    LPIPS backbone (``"alex"``/``"vgg"``/``"squeeze"`` — requires locally provided
+    torchvision weights, see ``_lpips_backbones.py``) or a callable
+    ``(img1, img2) -> (B,)``. ``similarity_fn`` is this framework's original alias
+    for the callable form and takes precedence when given. ``device`` is accepted
+    for drop-in parity and ignored (placement is global under JAX).
     """
+    del device
     if not hasattr(generator, "sample"):
         raise NotImplementedError(
             "The generator must implement a `sample` method returning latents"
             + (" and labels" if conditional else "")
         )
     if similarity_fn is None:
-        raise ModuleNotFoundError(
-            "The default LPIPS similarity requires pretrained torchvision weights, which cannot"
-            " be downloaded in this environment. Pass `similarity_fn` explicitly."
-        )
+        if callable(sim_net):
+            similarity_fn = sim_net
+        else:
+            try:
+                similarity_fn = _named_lpips_similarity(sim_net)
+            except FileNotFoundError as err:
+                raise ModuleNotFoundError(
+                    f"The default `{sim_net}` LPIPS similarity requires pretrained torchvision"
+                    " weights, which cannot be downloaded in this environment. Provide them"
+                    " locally ($TORCHMETRICS_TPU_LPIPS_BACKBONES) or pass a callable"
+                    " `sim_net`/`similarity_fn`."
+                ) from err
 
     distances = []
     num_batches = int(np.ceil(num_samples / batch_size))

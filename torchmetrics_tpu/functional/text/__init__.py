@@ -5,6 +5,7 @@ are model-based and ship with the Flax extractor stack).
 """
 
 from torchmetrics_tpu.functional.text.bert import bert_score
+from torchmetrics_tpu.functional.text.infolm import infolm
 from torchmetrics_tpu.functional.text.bleu import bleu_score
 from torchmetrics_tpu.functional.text.cer import char_error_rate
 from torchmetrics_tpu.functional.text.chrf import chrf_score
@@ -22,6 +23,7 @@ from torchmetrics_tpu.functional.text.wip import word_information_preserved
 
 __all__ = [
     "bert_score",
+    "infolm",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

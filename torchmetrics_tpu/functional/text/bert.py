@@ -439,8 +439,10 @@ def bert_score(
     user_forward_fn: Optional[Callable] = None,
     verbose: bool = False,
     idf: bool = False,
+    device: Optional[Any] = None,
     max_length: int = 512,
     batch_size: int = 64,
+    num_threads: int = 0,
     return_hash: bool = False,
     lang: str = "en",
     rescale_with_baseline: bool = False,
@@ -449,6 +451,10 @@ def bert_score(
     mesh: Optional[Any] = None,
 ) -> Dict[str, Union[Array, List[float], str]]:
     """Compute BERTScore precision/recall/F1 between candidate and reference sentences.
+
+    ``device``/``num_threads`` are accepted for drop-in signature parity with the
+    reference (where they pick the torch device and DataLoader workers) and ignored:
+    device placement is global under JAX and tokenization is in-process.
 
     Full option parity with the reference public fn (``bert.py:243-447``):
 
@@ -466,8 +472,7 @@ def bert_score(
     - ``return_hash`` adds the configuration ``"hash"`` key.
 
     ``mesh`` (TPU extension) shards the embedding forward data-parallel over a device
-    mesh; there is deliberately no ``device``/``num_threads`` argument (torch
-    DataLoader specifics with no JAX equivalent).
+    mesh.
 
     Example:
         >>> import jax
@@ -483,6 +488,7 @@ def bert_score(
         >>> float(score["f1"][0]) > 0.99
         True
     """
+    del device, num_threads  # parity-only (see docstring)
     preds_list = [preds] if isinstance(preds, str) else preds if isinstance(preds, dict) else list(preds)
     target_list = [target] if isinstance(target, str) else target if isinstance(target, dict) else list(target)
     if len(preds_list) != len(target_list):

@@ -1,0 +1,58 @@
+"""InfoLM (functional).
+
+Parity: reference ``src/torchmetrics/functional/text/infolm.py:545-625`` — the
+functional entry constructs the same masked-LM distribution machinery the module
+uses and scores one corpus pair. Implemented as a thin wrapper over the module
+(whose jitted MLM forward, chunking, and position-budget capping are shared), the
+same way the reference's functional shares ``_get_batch_distribution``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+
+Array = jax.Array
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "google/bert_uncased_L-2_H-128_A-2",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    device: Optional[Any] = None,
+    max_length: Optional[int] = None,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    verbose: bool = True,
+    return_sentence_level_score: bool = False,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Compute InfoLM between a predicted and a reference corpus.
+
+    ``device``/``num_threads`` are accepted for drop-in signature parity with the
+    reference and ignored (device placement is global under JAX; tokenization is
+    in-process).
+    """
+    from torchmetrics_tpu.text.infolm import InfoLM
+
+    metric = InfoLM(
+        model_name_or_path=model_name_or_path,
+        temperature=temperature,
+        information_measure=information_measure,
+        idf=idf,
+        alpha=alpha,
+        beta=beta,
+        device=device,
+        max_length=max_length,
+        batch_size=batch_size,
+        num_threads=num_threads,
+        verbose=verbose,
+        return_sentence_level_score=return_sentence_level_score,
+    )
+    metric.update(preds, target)
+    return metric.compute()

@@ -53,6 +53,7 @@ class PerceptualPathLength(Metric):
         resize: Optional[int] = 64,
         lower_discard: Optional[float] = 0.01,
         upper_discard: Optional[float] = 0.99,
+        sim_net: Any = "vgg",
         similarity_fn: Optional[Callable[[Array, Array], Array]] = None,
         **kwargs: Any,
     ) -> None:
@@ -73,6 +74,7 @@ class PerceptualPathLength(Metric):
         self.resize = resize
         self.lower_discard = lower_discard
         self.upper_discard = upper_discard
+        self.sim_net = sim_net
         self.similarity_fn = similarity_fn
         self._generator = None
 
@@ -98,5 +100,6 @@ class PerceptualPathLength(Metric):
             resize=self.resize,
             lower_discard=self.lower_discard,
             upper_discard=self.upper_discard,
+            sim_net=self.sim_net,
             similarity_fn=self.similarity_fn,
         )
