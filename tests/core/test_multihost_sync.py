@@ -22,18 +22,27 @@ from torchmetrics_tpu.core.buffer import MaskedBuffer
 from torchmetrics_tpu.parallel.reductions import Reduction
 
 
+def _desc(n, trail=(), dtype=jnp.float32):
+    """A ragged-gather wire descriptor (sync.py's encoder is the single source)."""
+    return jnp.asarray(sync_mod._encode_descriptor(n, trail, dtype))
+
+
+def _is_descriptor(x):
+    return x.ndim == 1 and x.dtype == jnp.int32 and x.shape[0] == sync_mod._DESC_LEN
+
+
 def _fake_allgather(x, tiled=False):
     """Two-host world: host 0 holds ``x``, host 1 holds ``x + 1`` (same shape).
 
-    The ragged-CAT protocol first exchanges int32 sizes — echo those unchanged on
-    both hosts so the simulated world stays shape-consistent; only float payloads
+    The ragged-CAT protocol first exchanges int32 descriptors — echo those unchanged
+    on both hosts so the simulated world stays shape-consistent; only float payloads
     get the +1 shift that distinguishes host 1's data.
     """
     x = jnp.asarray(x)
-    # CAUTION: this heuristic also matches genuine 0-d integer SUM states (e.g. the
-    # scalar micro fast-path counts) — tests syncing those need their own fake
-    if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
-        return jnp.stack([x, x])  # size exchange: both hosts report the same length
+    # CAUTION: this heuristic also matches a genuine 1-D int32 payload of length
+    # _DESC_LEN — tests syncing those need their own fake
+    if _is_descriptor(x):
+        return jnp.stack([x, x])  # descriptor exchange: both hosts report the same
     other = x + jnp.ones((), dtype=x.dtype)
     gathered = jnp.stack([x, other])
     return gathered
@@ -86,8 +95,8 @@ class TestMultihostSyncState:
         def protocol_fake(x, tiled=False):
             x = jnp.asarray(x)
             calls.append(x.shape)
-            if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
-                return jnp.stack([x, jnp.asarray(3, dtype=x.dtype)])  # sizes: [0, 3]
+            if _is_descriptor(x):
+                return jnp.stack([x, _desc(3)])  # sizes: [0, 3]
             assert x.shape[0] == 3, "local leaf should be padded to the world max"
             return jnp.stack([x, peer_rows.astype(x.dtype)])
 
@@ -102,8 +111,8 @@ class TestMultihostSyncState:
 
         def protocol_fake(x, tiled=False):
             x = jnp.asarray(x)
-            if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
-                return jnp.stack([x, jnp.asarray(1, dtype=x.dtype)])  # peer has 1 row
+            if _is_descriptor(x):
+                return jnp.stack([x, _desc(1)])  # peer has 1 row
             return jnp.stack([x, jnp.full_like(x, 9.0)])
 
         monkeypatch.setattr(multihost_utils, "process_allgather", protocol_fake)
@@ -113,6 +122,60 @@ class TestMultihostSyncState:
         )
         # local 2 rows + peer trimmed to its true 1 row
         _assert_allclose(out["parts"], [1.0, 2.0, 9.0], atol=0)
+
+    def test_empty_rank_adopts_world_shape_and_dtype(self, monkeypatch):
+        """An empty rank must adopt the peers' trailing dims + dtype (beats the
+        reference, whose empty-rank placeholder is hardwired 1-D float32 —
+        ``metric.py:443-450``)."""
+        peer = jnp.arange(6, dtype=jnp.int32).reshape(3, 2)
+        seen_payload_shapes = []
+
+        def protocol_fake(x, tiled=False):
+            x = jnp.asarray(x)
+            if _is_descriptor(x) and not seen_payload_shapes:
+                return jnp.stack([x, _desc(3, trail=(2,), dtype=jnp.int32)])
+            seen_payload_shapes.append((x.shape, x.dtype))
+            return jnp.stack([x, peer.astype(x.dtype)])
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", protocol_fake)
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        out = sync_mod.sync_state({"parts": []}, {"parts": Reduction.CAT}, axis_name=None)
+        assert out["parts"].shape == (3, 2)
+        _assert_allclose(out["parts"], np.arange(6).reshape(3, 2), atol=0)
+        # the local placeholder entered the payload collective with the WORLD's spec
+        assert seen_payload_shapes == [((3, 2), jnp.dtype(jnp.int32))]
+
+    def test_all_empty_world_harmonizes_spec(self, monkeypatch):
+        """With zero rows world-wide, a typed 0-row peer still defines the spec, so
+        every host exits sync with a consistent empty state (no payload collective)."""
+        calls = []
+
+        def protocol_fake(x, tiled=False):
+            x = jnp.asarray(x)
+            calls.append(x.shape)
+            assert _is_descriptor(x), "all-empty world must stop at the descriptor exchange"
+            return jnp.stack([x, _desc(0, trail=(4,), dtype=jnp.int32)])
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", protocol_fake)
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        out = sync_mod.sync_state({"parts": []}, {"parts": Reduction.CAT}, axis_name=None)
+        assert out["parts"].shape == (0, 4)
+        assert out["parts"].dtype == jnp.int32
+        assert len(calls) == 1
+
+    def test_nonempty_ranks_disagree_raises(self, monkeypatch):
+        def protocol_fake(x, tiled=False):
+            x = jnp.asarray(x)
+            if _is_descriptor(x):
+                return jnp.stack([x, _desc(2, trail=(4,))])  # peer rows are [2, 4]
+            raise AssertionError("must fail before the payload collective")
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", protocol_fake)
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        with pytest.raises(ValueError, match="disagree on trailing shape"):
+            sync_mod.sync_state(
+                {"parts": [jnp.zeros((2, 3))]}, {"parts": Reduction.CAT}, axis_name=None
+            )
 
     def test_masked_buffer_state(self, two_host_world):
         buf = MaskedBuffer.create(4).append(jnp.array([1.0, 2.0]))

@@ -410,9 +410,12 @@ class TestDetectionMultihostSync:
 
         def fake(x, tiled=False):
             x = jnp.asarray(x)
-            if x.ndim == 0 and jnp.issubdtype(x.dtype, jnp.integer):
-                peer = peer_payloads[state["i"]]
-                return jnp.stack([x, jnp.asarray(peer.shape[0], dtype=x.dtype)])
+            if x.shape == (sync_mod._DESC_LEN,) and x.dtype == jnp.int32:
+                # descriptor exchange: peer spec = local spec (same trailing dims and
+                # dtype; the payload branch casts to x.dtype) with the peer's row count
+                d = np.asarray(x).copy()
+                d[0] = np.asarray(peer_payloads[state["i"]]).shape[0]
+                return jnp.stack([x, jnp.asarray(d)])
             peer = jnp.asarray(peer_payloads[state["i"]], dtype=x.dtype)
             state["i"] += 1
             pad = [(0, x.shape[0] - peer.shape[0])] + [(0, 0)] * (x.ndim - 1)

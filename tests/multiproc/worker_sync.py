@@ -1,0 +1,186 @@
+"""Worker for the REAL two-process ``jax.distributed`` sync battery.
+
+Launched twice (process_id 0 and 1) by ``test_two_process_sync.py`` with the CPU-force
+env; the two processes connect to one coordinator and run the *actual* eager multihost
+sync stack — no monkeypatched fakes. Every check runs on BOTH processes (the world
+must execute identical collective sequences) and asserts gather-then-compute equals
+compute-on-all-data, the reference's definition of distributed correctness
+(``tests/unittests/bases/test_ddp.py:284-300`` over a real 2-process Gloo pool —
+here the pool is JAX's gloo-backed CPU collectives).
+
+Usage: ``python worker_sync.py <process_id> <port> <result_json_path>``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+
+assert os.environ.get("JAX_PLATFORMS") == "cpu", "launcher must pass the CPU-force env"
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    out_path = sys.argv[3]
+
+    import jax
+
+    jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.core.buffer import MaskedBuffer
+    from torchmetrics_tpu.parallel.reductions import Reduction
+    from torchmetrics_tpu.parallel.sync import (
+        allgather_ragged_arrays,
+        distributed_available,
+        gather_all_tensors,
+        sync_state,
+    )
+
+    assert jax.process_count() == 2
+    assert distributed_available(), "real 2-process world must report distributed"
+    results = {"world": jax.process_count()}
+
+    # -- 1. scalar reductions: proc p holds p+1 -------------------------------
+    local = jnp.asarray(float(pid + 1))
+    out = sync_state(
+        {"s": local, "m": local, "mx": local, "mn": local},
+        {"s": Reduction.SUM, "m": Reduction.MEAN, "mx": Reduction.MAX, "mn": Reduction.MIN},
+    )
+    np.testing.assert_allclose(out["s"], 3.0)
+    np.testing.assert_allclose(out["m"], 1.5)
+    np.testing.assert_allclose(out["mx"], 2.0)
+    np.testing.assert_allclose(out["mn"], 1.0)
+    results["scalar_reductions"] = True
+
+    # -- 2. ragged CAT with trailing dims: 2 rows on proc 0, 3 on proc 1 ------
+    rows = 2 if pid == 0 else 3
+    base = 0.0 if pid == 0 else 100.0
+    x = base + jnp.arange(rows * 4, dtype=jnp.float32).reshape(rows, 4)
+    out = sync_state({"c": [x]}, {"c": Reduction.CAT})
+    want = np.concatenate(
+        [np.arange(8, dtype=np.float32).reshape(2, 4), 100.0 + np.arange(12, dtype=np.float32).reshape(3, 4)]
+    )
+    np.testing.assert_allclose(np.asarray(out["c"]), want)
+    results["ragged_cat_trailing_dims"] = True
+
+    # -- 3. empty rank adopts the world's trailing dims + dtype ----------------
+    # proc 1 never updated its list state; the descriptor exchange must hand it
+    # proc 0's (3, 2) int32 rows — the reference's 1-D float32 placeholder cannot.
+    state = {"c": [jnp.arange(6, dtype=jnp.int32).reshape(3, 2)]} if pid == 0 else {"c": []}
+    out = sync_state(state, {"c": Reduction.CAT})
+    assert out["c"].shape == (3, 2), out["c"].shape
+    assert out["c"].dtype == jnp.int32, out["c"].dtype
+    np.testing.assert_array_equal(np.asarray(out["c"]), np.arange(6, dtype=np.int32).reshape(3, 2))
+    results["empty_rank_shape_dtype_adoption"] = True
+
+    # -- 4. MaskedBuffer multihost compaction ---------------------------------
+    buf = MaskedBuffer.create(4).append(jnp.asarray([1.0 + 10 * pid, 2.0 + 10 * pid]))
+    out = sync_state({"v": buf}, {"v": Reduction.CAT})
+    merged = out["v"]
+    assert merged.capacity == 8
+    vals = np.sort(np.asarray(merged.data)[np.asarray(merged.mask)])
+    np.testing.assert_allclose(vals, [1.0, 2.0, 11.0, 12.0])
+    results["masked_buffer_compaction"] = True
+
+    # -- 5. detection-style ragged list-of-arrays gather ----------------------
+    if pid == 0:
+        arrays = [np.full((2, 4), 0.5, np.float32), np.full((1, 4), 5.5, np.float32)]
+    else:
+        arrays = [np.full((3, 4), 7.5, np.float32)]
+    gathered = allgather_ragged_arrays([jnp.asarray(a) for a in arrays], ndim=2)
+    assert [g.shape for g in gathered] == [(2, 4), (1, 4), (3, 4)]
+    np.testing.assert_allclose(gathered[2], np.full((3, 4), 7.5))
+    results["allgather_ragged_arrays"] = True
+
+    # -- 6. gather_all_tensors -------------------------------------------------
+    parts = gather_all_tensors(jnp.asarray([float(pid)]))
+    assert len(parts) == 2
+    np.testing.assert_allclose(np.asarray(parts[0]), [0.0])
+    np.testing.assert_allclose(np.asarray(parts[1]), [1.0])
+    results["gather_all_tensors"] = True
+
+    # -- 7. SumMetric end-to-end through the default distributed path ---------
+    from torchmetrics_tpu.aggregation import SumMetric
+
+    m = SumMetric()
+    m.update(jnp.asarray(10.0 * (pid + 1)))
+    np.testing.assert_allclose(np.asarray(m.compute()), 30.0)
+    results["sum_metric_e2e"] = True
+
+    # -- 8. sharded MulticlassF1Score == all-data ------------------------------
+    from torchmetrics_tpu.classification import MulticlassF1Score
+
+    rng = np.random.default_rng(0)
+    n_per = 40
+    preds = rng.integers(0, 5, size=2 * n_per)
+    target = rng.integers(0, 5, size=2 * n_per)
+    dist = MulticlassF1Score(num_classes=5, average="macro")
+    dist.update(jnp.asarray(preds[pid * n_per : (pid + 1) * n_per]), jnp.asarray(target[pid * n_per : (pid + 1) * n_per]))
+    ref = MulticlassF1Score(num_classes=5, average="macro", distributed_available_fn=lambda: False)
+    ref.update(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(dist.compute()), np.asarray(ref.compute()), atol=1e-6)
+    results["f1_sharded_equals_alldata"] = True
+
+    # -- 9. unbinned PR curve (MaskedBuffer states) sharded == all-data --------
+    from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+
+    p = rng.random(2 * n_per).astype(np.float32)
+    t = rng.integers(0, 2, size=2 * n_per)
+    dist = BinaryPrecisionRecallCurve(thresholds=None, buffer_capacity=64)
+    dist.update(jnp.asarray(p[pid * n_per : (pid + 1) * n_per]), jnp.asarray(t[pid * n_per : (pid + 1) * n_per]))
+    ref = BinaryPrecisionRecallCurve(
+        thresholds=None, buffer_capacity=128, distributed_available_fn=lambda: False
+    )
+    ref.update(jnp.asarray(p), jnp.asarray(t))
+    for got, want in zip(dist.compute(), ref.compute()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+    results["unbinned_prc_sharded_equals_alldata"] = True
+
+    # -- 10. detection mAP sharded == all-data ---------------------------------
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    def _img(seed: int):
+        r = np.random.default_rng(seed)
+        n_pred, n_gt = 4, 3
+        xy = r.random((n_pred, 2)) * 50
+        pred = {
+            "boxes": jnp.asarray(np.concatenate([xy, xy + 10 + r.random((n_pred, 2)) * 20], axis=1, dtype=np.float32)),
+            "scores": jnp.asarray(r.random(n_pred).astype(np.float32)),
+            "labels": jnp.asarray(r.integers(0, 2, n_pred)),
+        }
+        xy = r.random((n_gt, 2)) * 50
+        tgt = {
+            "boxes": jnp.asarray(np.concatenate([xy, xy + 10 + r.random((n_gt, 2)) * 20], axis=1, dtype=np.float32)),
+            "labels": jnp.asarray(r.integers(0, 2, n_gt)),
+        }
+        return pred, tgt
+
+    all_imgs = [_img(s) for s in range(4)]
+    mine = all_imgs[pid * 2 : (pid + 1) * 2]
+    dist = MeanAveragePrecision(iou_type="bbox")
+    dist.update([p for p, _ in mine], [t for _, t in mine])
+    ref = MeanAveragePrecision(iou_type="bbox", distributed_available_fn=lambda: False)
+    ref.update([p for p, _ in all_imgs], [t for _, t in all_imgs])
+    got, want = dist.compute(), ref.compute()
+    for key in ("map", "map_50", "map_75", "mar_100"):
+        np.testing.assert_allclose(np.asarray(got[key]), np.asarray(want[key]), atol=1e-6)
+    results["detection_map_sharded_equals_alldata"] = True
+
+    if pid == 0:
+        with open(out_path, "w") as fh:
+            json.dump(results, fh)
+    print(f"WORKER {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)

@@ -79,24 +79,104 @@ def _sync_leaf_in_axis(x: Array, reduction: Reduction, axis_name: str) -> Array:
     raise ValueError(f"Unknown reduction {reduction}")
 
 
+# Ragged-gather wire protocol: every host first exchanges a fixed-width int32
+# descriptor [n_rows, n_trailing_dims, trail_0..trail_{MAX-1}, dtype_name_bytes] so
+# that a host holding *no* rows (or a mis-shaped placeholder) can adopt the world's
+# trailing shape and dtype before the payload collective. The reference instead
+# synthesizes a 1-D float32 empty tensor on empty ranks (``metric.py:443-450``) and
+# desyncs when the real state has trailing dims or another dtype; the descriptor
+# exchange removes that limitation entirely. The dtype travels as its canonical
+# *name* (``np.dtype(...).name`` ASCII, zero-padded) — dtype nums are
+# runtime-assigned for extension dtypes (bfloat16, float8_*, int4) and may differ
+# across hosts, so they cannot be wire format.
+_MAX_TRAILING_DIMS = 14  # protocol constant: payload rank <= 15
+_DTYPE_NAME_BYTES = 24  # longest jax dtype name ("float8_e4m3b11fnuz") + margin
+_DESC_LEN = 2 + _MAX_TRAILING_DIMS + _DTYPE_NAME_BYTES // 4
+
+
+def _encode_dtype_name(dtype) -> "np.ndarray":  # noqa: F821 - numpy imported locally
+    import numpy as np
+
+    name = np.dtype(dtype).name.encode("ascii")
+    if len(name) > _DTYPE_NAME_BYTES:
+        raise ValueError(f"dtype name {name!r} exceeds the {_DTYPE_NAME_BYTES}-byte wire field")
+    return np.frombuffer(name.ljust(_DTYPE_NAME_BYTES, b"\0"), dtype="<i4").copy()
+
+
+def _decode_dtype_name(words) -> "np.dtype":  # noqa: F821
+    import numpy as np
+
+    name = np.asarray(words, dtype="<i4").tobytes().rstrip(b"\0").decode("ascii")
+    return np.dtype(name)  # extension names (bfloat16, int4, ...) resolve via ml_dtypes
+
+
+def _encode_descriptor(n_rows: int, trail: tuple, dtype) -> "np.ndarray":  # noqa: F821
+    """Build the ragged-gather wire descriptor (single source of the layout)."""
+    import numpy as np
+
+    if len(trail) > _MAX_TRAILING_DIMS:
+        raise ValueError(
+            f"Ragged multihost gather wire format supports rank <= {_MAX_TRAILING_DIMS + 1},"
+            f" got {len(trail) + 1}"
+        )
+    desc = np.zeros((_DESC_LEN,), dtype=np.int32)
+    desc[0] = n_rows
+    desc[1] = len(trail)
+    desc[2 : 2 + len(trail)] = trail
+    desc[2 + _MAX_TRAILING_DIMS :] = _encode_dtype_name(dtype)
+    return desc
+
+
+def _decode_descriptor(desc) -> tuple:
+    """Inverse of :func:`_encode_descriptor` -> (n_rows, trail, np.dtype)."""
+    n_trail = int(desc[1])
+    trail = tuple(int(v) for v in desc[2 : 2 + n_trail])
+    return int(desc[0]), trail, _decode_dtype_name(desc[2 + _MAX_TRAILING_DIMS :])
+
+
 def _allgather_ragged_dim0(x: Array) -> Array:
     """Concatenate per-host dim-0-ragged arrays across an eager multihost world.
 
-    Protocol mirrors the reference's pad-to-max ragged gather
-    (``utilities/distributed.py:135-147``): exchange sizes, pad dim 0 to the world
-    max, gather, trim each host's slice back to its true length. A host with zero
-    rows still enters both collectives (the reference synthesizes an empty tensor
-    for exactly this, ``metric.py:443-450``) — skipping them would desync the world.
-    Trailing dims must agree across hosts (same constraint as the reference).
+    Protocol extends the reference's pad-to-max ragged gather
+    (``utilities/distributed.py:135-147``): exchange *descriptors* (size, trailing
+    shape, dtype), pad dim 0 to the world max, gather, trim each host's slice back to
+    its true length. A host with zero rows still enters both collectives (the
+    reference synthesizes an empty tensor for exactly this, ``metric.py:443-450``) —
+    skipping them would desync the world. Unlike the reference, an empty host adopts
+    the world's trailing dims and dtype from the descriptor exchange, so never-updated
+    list states with trailing dims or non-float32 dtypes gather correctly. Non-empty
+    hosts must agree on trailing dims and dtype (validated; clear error beats a
+    silent desync).
     """
     import numpy as np
     from jax.experimental import multihost_utils
 
-    local_size = jnp.asarray(x.shape[0], dtype=jnp.int32)
-    sizes = np.asarray(multihost_utils.process_allgather(local_size, tiled=False)).reshape(-1)
+    x = jnp.asarray(x)
+    trail = x.shape[1:]
+    desc = _encode_descriptor(x.shape[0], trail, x.dtype)
+    g_desc = np.asarray(multihost_utils.process_allgather(jnp.asarray(desc), tiled=False))
+    g_desc = g_desc.reshape(-1, _DESC_LEN)
+    sizes = g_desc[:, 0]
     max_size = int(sizes.max()) if sizes.size else 0
+    # which descriptors define the world's spec? Rows win; with zero rows everywhere,
+    # a typed 0-row array (trailing dims, or any non-placeholder dtype) still defines
+    # the spec so every host exits the sync with a *consistent* empty state — the
+    # placeholder spec (1-D float32) never overrides a typed one.
+    placeholder = _encode_descriptor(0, (), jnp.float32)
+    spec_bearing = g_desc[sizes > 0] if max_size > 0 else g_desc[(g_desc[:, 1:] != placeholder[1:]).any(axis=1)]
+    if len(spec_bearing) == 0:
+        return x  # every host holds the trivial 1-D empty; nothing to gather
+    ref_desc = spec_bearing[0]
+    if not (spec_bearing[:, 1:] == ref_desc[1:]).all():
+        raise ValueError(
+            "Ragged multihost gather: hosts disagree on trailing shape or dtype: "
+            f"{[tuple(int(v) for v in row[1:]) for row in spec_bearing]}"
+        )
+    _, world_trail, world_dtype = _decode_descriptor(ref_desc)
+    if x.shape[0] == 0 and (trail != world_trail or np.dtype(x.dtype) != world_dtype):
+        x = jnp.zeros((0, *world_trail), dtype=world_dtype)  # adopt the world's spec
     if max_size == 0:
-        return x
+        return x  # world-wide empty, but now with a consistent spec on every host
     pad_width = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
     padded = jnp.pad(x, pad_width)
     gathered = multihost_utils.process_allgather(padded, tiled=False)  # [world, max, ...]
@@ -199,11 +279,11 @@ def sync_state(
             if not value:
                 if axis_name is None and distributed_available():
                     # this host saw no data, but the world-wide collective must still
-                    # run on every host: synthesize a zero-length leaf and enter it.
-                    # Same contract (and limitation) as the reference's empty-tensor
-                    # synth (``metric.py:443-450``): the placeholder is 1-D float32,
-                    # so list states with trailing dims or other dtypes need at least
-                    # one local append before a sync (or a custom dist_sync_fn)
+                    # run on every host: synthesize a zero-length leaf and enter it
+                    # (as the reference does, ``metric.py:443-450``). The descriptor
+                    # exchange in ``_allgather_ragged_dim0`` reshapes/casts this
+                    # placeholder to the world's trailing dims and dtype, so unlike
+                    # the reference no local append is needed first.
                     out[name] = _sync_leaf_multihost(jnp.zeros((0,), dtype=jnp.float32), red)
                 else:
                     out[name] = value
