@@ -61,6 +61,8 @@ _FULL_MODULES = _FUZZ_MODULES | {
     "test_lpips_backbones",
     "test_cli",
     "test_real_weights",
+    "test_plot_battery",
+    "test_two_process_sync",
 }
 
 

@@ -10,6 +10,7 @@ from typing import Any, Optional, Sequence, Union
 import jax
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -177,3 +178,11 @@ class AveragePrecision(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
             return MultilabelAveragePrecision(num_labels, average, **kwargs)
         raise ValueError(f"Task {task} not supported!")
+
+
+# These classes inherit curve/heatmap state handling but compute scalars;
+# restore the base single-value plot (the reference overrides plot per class,
+# e.g. ``average_precision.py:106-142``).
+for _cls in (BinaryAveragePrecision, MulticlassAveragePrecision, MultilabelAveragePrecision):
+    _cls.plot = Metric.plot
+del _cls

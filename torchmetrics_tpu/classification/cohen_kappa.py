@@ -10,6 +10,7 @@ from typing import Any, Optional
 import jax
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.classification.confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
 from torchmetrics_tpu.functional.classification.cohen_kappa import (
     _cohen_kappa_arg_validation,
@@ -128,3 +129,11 @@ class CohenKappa(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
             return MulticlassCohenKappa(num_classes, **kwargs)
         raise ValueError(f"Task {task} not supported!")
+
+
+# These classes inherit curve/heatmap state handling but compute scalars;
+# restore the base single-value plot (the reference overrides plot per class,
+# e.g. ``cohen_kappa.py:106-142``).
+for _cls in (BinaryCohenKappa, MulticlassCohenKappa):
+    _cls.plot = Metric.plot
+del _cls

@@ -13,6 +13,7 @@ from typing import Any, Optional, Sequence, Tuple, Union
 import jax
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.classification.precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
@@ -579,3 +580,22 @@ class SensitivityAtSpecificity(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
             return MultilabelSensitivityAtSpecificity(num_labels, min_specificity, **kwargs)
         raise ValueError(f"Task {task} not supported!")
+
+
+def _plot_value_only(self, val=None, ax=None):
+    """Plot the operating-point *value*, not the (value, threshold) tuple.
+
+    The reference selects ``compute()[0]`` by default (the threshold is an
+    arbitrary-scale operating point, not a metric value —
+    ``recall_fixed_precision.py:174``).
+    """
+    val = val if val is not None else self.compute()[0]
+    return self._plot(val, ax)
+
+
+# These classes inherit the curve plot from the PR-curve state machinery but
+# compute (value, threshold) pairs; plot the value alone, as the reference's
+# per-class overrides do (e.g. ``recall_fixed_precision.py:120-180``).
+for _cls in (BinaryRecallAtFixedPrecision, MulticlassRecallAtFixedPrecision, MultilabelRecallAtFixedPrecision, BinaryPrecisionAtFixedRecall, MulticlassPrecisionAtFixedRecall, MultilabelPrecisionAtFixedRecall, BinarySpecificityAtSensitivity, MulticlassSpecificityAtSensitivity, MultilabelSpecificityAtSensitivity, BinarySensitivityAtSpecificity, MulticlassSensitivityAtSpecificity, MultilabelSensitivityAtSpecificity):
+    _cls.plot = _plot_value_only
+del _cls

@@ -10,6 +10,7 @@ from typing import Any, Optional
 import jax
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     MulticlassConfusionMatrix,
@@ -182,3 +183,11 @@ class JaccardIndex(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
             return MultilabelJaccardIndex(num_labels, threshold, average, **kwargs)
         raise ValueError(f"Task {task} not supported!")
+
+
+# These classes inherit curve/heatmap state handling but compute scalars;
+# restore the base single-value plot (the reference overrides plot per class,
+# e.g. ``jaccard.py:112-150``).
+for _cls in (BinaryJaccardIndex, MulticlassJaccardIndex, MultilabelJaccardIndex):
+    _cls.plot = Metric.plot
+del _cls

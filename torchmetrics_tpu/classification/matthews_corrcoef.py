@@ -10,6 +10,7 @@ from typing import Any, Optional
 import jax
 
 from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     MulticlassConfusionMatrix,
@@ -157,3 +158,11 @@ class MatthewsCorrCoef(_ClassificationTaskWrapper):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
             return MultilabelMatthewsCorrCoef(num_labels, threshold, **kwargs)
         raise ValueError(f"Task {task} not supported!")
+
+
+# These classes inherit curve/heatmap state handling but compute scalars;
+# restore the base single-value plot (the reference overrides plot per class,
+# e.g. ``matthews_corrcoef.py:84-120``).
+for _cls in (BinaryMatthewsCorrCoef, MulticlassMatthewsCorrCoef, MultilabelMatthewsCorrCoef):
+    _cls.plot = Metric.plot
+del _cls

@@ -71,7 +71,7 @@ def plot_single_or_multi_val(
         for i, (k, v) in enumerate(val.items()):
             v = np.atleast_1d(v)
             if v.size == 1:
-                ax.plot(i, float(v), "o", label=k)
+                ax.plot(i, float(v.reshape(-1)[0]), "o", label=k)
             else:
                 ax.plot(v, label=k)
         ax.legend()
@@ -86,7 +86,13 @@ def plot_single_or_multi_val(
     else:
         arr = np.atleast_1d(val)
         if arr.size == 1:
-            ax.plot([0], [float(arr)], "o", label=name or "metric")
+            ax.plot([0], [float(arr.reshape(-1)[0])], "o", label=name or "metric")
+        elif arr.ndim >= 2:
+            # multi-group values (e.g. per-class stat scores [C, 5]): one point
+            # cluster per leading index (reference ``utilities/plot.py:98-110``)
+            for i, row in enumerate(arr.reshape(arr.shape[0], -1)):
+                ax.plot([i] * row.size, row, "o", linestyle="None",
+                        label=f"{legend_name or 'group'} {i}")
         else:
             ax.bar(np.arange(arr.size), arr, label=name or "metric")
         ax.legend()

@@ -120,6 +120,21 @@ class MetricTracker:
         except (TypeError, ValueError):
             return res
 
+    def plot(self, val: Any = None, ax: Any = None):
+        """Plot one or all tracked values (reference ``wrappers/tracker.py:273-311``).
+
+        Args:
+            val: result(s) to plot; defaults to :meth:`compute_all` (the full history).
+            ax: existing matplotlib axis to draw into.
+        """
+        from torchmetrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute_all()
+        if isinstance(val, Array) and val.ndim >= 1:
+            # the stacked history plots as a time series (one entry per increment)
+            val = [v for v in val]
+        return plot_single_or_multi_val(val, ax=ax, name=type(self._base_metric).__name__)
+
     def reset(self) -> None:
         """Reset the current increment."""
         if self._increments:
