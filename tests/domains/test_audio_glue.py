@@ -95,8 +95,17 @@ class TestSrmrGlue:
     def test_batched_rows(self, fake_srmrpy):
         rng = np.random.RandomState(3)
         p = rng.randn(2, 2, 64).astype(np.float32)
-        got = ext.speech_reverberation_modulation_energy_ratio(jnp.asarray(p), 8000)
+        got = ext._srmr_srmrpy(jnp.asarray(p), 8000)
         assert got.shape == (2, 2)
+        _assert_allclose(got, np.abs(p).sum(-1))
+
+    def test_fast_path_routes_to_callback(self, fake_srmrpy):
+        """fast=True delegates the public (native) functional to the srmrpy callback."""
+        from torchmetrics_tpu.functional.audio import speech_reverberation_modulation_energy_ratio
+
+        rng = np.random.RandomState(4)
+        p = rng.randn(2, 64).astype(np.float32)
+        got = speech_reverberation_modulation_energy_ratio(jnp.asarray(p), 8000, fast=True)
         _assert_allclose(got, np.abs(p).sum(-1))
 
 
@@ -111,4 +120,4 @@ class TestGatesStillRaise:
                 ext.short_time_objective_intelligibility(p, p, 8000)
         if not ext._SRMRPY_AVAILABLE:
             with pytest.raises(ModuleNotFoundError, match="srmrpy"):
-                ext.speech_reverberation_modulation_energy_ratio(p, 8000)
+                ext._srmr_srmrpy(p, 8000)

@@ -264,6 +264,9 @@ EXPLICIT_CASES = {
         jnp.asarray(r.randn(1, 8000).astype(np.float32)),
         jnp.asarray(r.randn(1, 8000).astype(np.float32)),
     )),
+    "SpeechReverberationModulationEnergyRatio": ({"fs": 8000}, lambda r: (
+        jnp.asarray(r.randn(1, 8000).astype(np.float32)),
+    )),
     "PermutationInvariantTraining": (
         {"metric_func": lambda p, t: -jnp.mean((p - t) ** 2, axis=-1)},
         lambda r: (
@@ -358,7 +361,6 @@ GATED = {
     "PerceptualPathLength": "generator + weights",
     "PerceptualEvaluationSpeechQuality": "pesq host lib",
     "DeepNoiseSuppressionMeanOpinionScore": "DNSMOS onnx weights",
-    "SpeechReverberationModulationEnergyRatio": "srmr host lib",
 }
 
 # structural classes exercised through dedicated composition tests below

@@ -15,8 +15,8 @@ from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.functional.audio.external import (
     deep_noise_suppression_mean_opinion_score,
     perceptual_evaluation_speech_quality,
-    speech_reverberation_modulation_energy_ratio,
 )
+from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
 from torchmetrics_tpu.functional.audio.sdr import (
@@ -346,21 +346,56 @@ class ShortTimeObjectiveIntelligibility(_MeanScoreMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_MeanScoreMetric):
-    r"""SRMR (requires the external ``srmrpy`` library)."""
+    r"""SRMR, computed natively on device (reference ``audio/srmr.py:36-164`` needs
+    the external ``gammatone`` + ``torchaudio``; ``fast=True`` here delegates to the
+    optional ``srmrpy`` host callback).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> srmr = SpeechReverberationModulationEnergyRatio(8000)
+        >>> bool(srmr(preds) > 0)
+        True
+    """
 
     is_differentiable = False
     higher_is_better = True
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: Optional[float] = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
+        from torchmetrics_tpu.functional.audio.srmr import _srmr_arg_validate
+
+        _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
         self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
 
     def update(self, preds: Array) -> None:
-        """Accumulate per-sample SRMR scores (host callback)."""
-        self._accumulate(speech_reverberation_modulation_energy_ratio(preds, self.fs))
+        """Accumulate per-sample SRMR scores."""
+        self._accumulate(
+            speech_reverberation_modulation_energy_ratio(
+                preds, self.fs, self.n_cochlear_filters, self.low_freq,
+                self.min_cf, self.max_cf, self.norm, self.fast,
+            )
+        )
 
     def _compute_group_params(self):
-        return (self.fs,)
+        return (self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf, self.max_cf, self.norm, self.fast)
 
 
 class DeepNoiseSuppressionMeanOpinionScore(_MeanScoreMetric):

@@ -97,7 +97,7 @@ def short_time_objective_intelligibility(
     return jnp.asarray(vals, dtype=jnp.float32).reshape(jnp.asarray(preds).shape[:-1])
 
 
-def speech_reverberation_modulation_energy_ratio(
+def _srmr_srmrpy(
     preds: Array,
     fs: int,
     n_cochlear_filters: int = 23,
@@ -107,7 +107,11 @@ def speech_reverberation_modulation_energy_ratio(
     norm: bool = False,
     fast: bool = False,
 ) -> Array:
-    """Compute SRMR via the external ``srmrpy`` library (host callback).
+    """SRMR via the external ``srmrpy`` library (host callback).
+
+    Opt-in fallback: the public functional (``functional/audio/srmr.py``) computes
+    SRMR natively on device; this path serves ``fast=True`` (the gammatonegram
+    approximation) and cross-checking against the upstream implementation.
 
     Raises:
         ModuleNotFoundError: If ``srmrpy`` is not installed.
@@ -125,7 +129,8 @@ def speech_reverberation_modulation_energy_ratio(
     )
     preds_np = np.asarray(preds)
     if preds_np.ndim == 1:
-        return jnp.asarray(srmrpy.srmr(preds_np, fs, **srmr_kwargs)[0])
+        # shape (1,) for 1-D input: same contract as the native path (srmr.py)
+        return jnp.asarray([srmrpy.srmr(preds_np, fs, **srmr_kwargs)[0]], dtype=jnp.float32)
     vals = [
         srmrpy.srmr(p, fs, **srmr_kwargs)[0]
         for p in preds_np.reshape(-1, preds_np.shape[-1])

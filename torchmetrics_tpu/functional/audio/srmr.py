@@ -1,0 +1,311 @@
+"""Native on-device SRMR (speech-to-reverberation modulation energy ratio).
+
+Parity: reference ``src/torchmetrics/functional/audio/srmr.py`` translates SRMRpy
+onto torch, but still needs the external ``gammatone`` package for the filter design
+and ``torchaudio.lfilter`` for sample-sequential IIR filtering — a poor fit for TPU,
+where a recursive filter serializes the whole time axis. This is a from-scratch JAX
+redesign of the published algorithm (Falk, Zheng & Chan, "A Non-Intrusive Quality and
+Intelligibility Measure of Reverberant and Dereverberated Speech", IEEE TASLP 2010):
+
+1. **Filter design on host, at trace time** (float64 numpy/scipy, cached): the Slaney
+   ERB gammatone cascade (4 biquad sections) and the 8-channel Q=2 second-order
+   modulation bandpass bank are designed exactly as the reference does, then each
+   IIR's impulse response is materialised and truncated where its tail energy drops
+   below 1e-12 of the total.
+2. **Filtering on device as batched FFT convolution**: both filterbanks apply as one
+   rfft × filter-bank multiply × irfft — no recursion, static shapes, vectorized over
+   (batch, cochlear, modulation) — instead of 4 cascaded ``lfilter`` passes.
+3. Hilbert envelope via rfft; Hamming-windowed modulation-band energies via one
+   strided convolution; branch-free adaptive K* selection (the 90 % cumulative-energy
+   bandwidth rule) with masked band sums.
+
+The whole metric compiles under ``jit`` (static shapes; data-dependent choices like
+K* flow through values, not shapes). Differences vs the reference:
+
+- ``fast=True`` (the gammatonegram approximation, which the reference itself marks
+  experimental/inconsistent) is delegated to the optional ``srmrpy`` host callback.
+- the reference *raises* when the 90 % bandwidth falls below the 5th modulation
+  band's left cutoff; raising on data values is impossible under jit, so K* clamps
+  to 5 (the same denominator) instead.
+- float32 on device (f64 filter design on host), so scores match a float64 host
+  implementation to ~1e-4 relative, not bit-exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+
+_EAR_Q = 9.26449  # Glasberg & Moore parameters (as the reference's _calc_erbs)
+_MIN_BW = 24.7
+_TAIL_ENERGY = 1e-12  # impulse-response truncation threshold (fraction of total)
+
+
+def _centre_freqs(fs: int, n_filters: int, cutoff: float) -> np.ndarray:
+    """Slaney ERB-spaced centre frequencies, descending from ~fs/2 to ``cutoff``."""
+    c = _EAR_Q * _MIN_BW
+    return -c + np.exp(
+        np.arange(1, n_filters + 1) * (-np.log(fs / 2 + c) + np.log(cutoff + c)) / n_filters
+    ) * (fs / 2 + c)
+
+
+def _erbs(fs: int, n_filters: int, low_freq: float) -> np.ndarray:
+    """Equivalent rectangular bandwidths of the cochlear channels (descending)."""
+    return _centre_freqs(fs, n_filters, low_freq) / _EAR_Q + _MIN_BW
+
+
+def _np_biquad(b: np.ndarray, a: np.ndarray, x: np.ndarray) -> np.ndarray:
+    from scipy.signal import lfilter
+
+    return lfilter(b, a, x)
+
+
+def _trim_impulse(h: np.ndarray) -> np.ndarray:
+    """Truncate where the remaining tail energy < _TAIL_ENERGY of the total."""
+    tail = np.cumsum((h**2)[:, ::-1], axis=-1)[:, ::-1]
+    total = tail[:, :1]
+    keep = int(np.max(np.argmax(tail < _TAIL_ENERGY * total, axis=-1)))
+    keep = max(keep, 16)
+    return h[:, : math.ceil(keep / 16) * 16]
+
+
+@functools.lru_cache(maxsize=32)
+def _gammatone_fir(fs: int, n_filters: int, low_freq: float) -> np.ndarray:
+    """Impulse responses [n_filters, L] of the Slaney ERB gammatone cascade.
+
+    The coefficient math mirrors the reference's ``_make_erb_filters`` /
+    ``_erb_filterbank`` (4 biquad sections sharing one denominator, divided by the
+    analytic gain), evaluated here once on host to produce an FIR for FFT conv.
+    """
+    cfs = _centre_freqs(fs, n_filters, low_freq)
+    T = 1.0 / fs
+    B = 1.019 * 2 * np.pi * _erbs(fs, n_filters, low_freq)
+    arg = 2 * cfs * np.pi * T
+    ebt = np.exp(B * T)
+    rt_pos = np.sqrt(3 + 2**1.5)
+    rt_neg = np.sqrt(3 - 2**1.5)
+    a0, a2 = T, 0.0
+    b0, b1, b2 = 1.0, -2 * np.cos(arg) / ebt, np.exp(-2 * B * T)
+    a11 = -(2 * T * np.cos(arg) / ebt + 2 * rt_pos * T * np.sin(arg) / ebt) / 2
+    a12 = -(2 * T * np.cos(arg) / ebt - 2 * rt_pos * T * np.sin(arg) / ebt) / 2
+    a13 = -(2 * T * np.cos(arg) / ebt + 2 * rt_neg * T * np.sin(arg) / ebt) / 2
+    a14 = -(2 * T * np.cos(arg) / ebt - 2 * rt_neg * T * np.sin(arg) / ebt) / 2
+    i = 1j
+    z = np.exp(4 * i * cfs * np.pi * T)
+    zb = np.exp(-(B * T) + 2 * i * cfs * np.pi * T)
+    gain = np.abs(
+        (-2 * z * T + 2 * zb * T * (np.cos(arg) - rt_neg * np.sin(arg)))
+        * (-2 * z * T + 2 * zb * T * (np.cos(arg) + rt_neg * np.sin(arg)))
+        * (-2 * z * T + 2 * zb * T * (np.cos(arg) - rt_pos * np.sin(arg)))
+        * (-2 * z * T + 2 * zb * T * (np.cos(arg) + rt_pos * np.sin(arg)))
+        / (-2 / np.exp(2 * B * T) - 2 * z + 2 * (1 + z) / ebt) ** 4
+    )
+    length = max(int(0.25 * fs), 64)
+    impulse = np.zeros(length, dtype=np.float64)
+    impulse[0] = 1.0
+    h = np.empty((n_filters, length), dtype=np.float64)
+    for k in range(n_filters):
+        a = np.array([b0, b1[k], b2[k]])
+        y = _np_biquad(np.array([a0, a11[k], a2]), a, impulse)
+        y = _np_biquad(np.array([a0, a12[k], a2]), a, y)
+        y = _np_biquad(np.array([a0, a13[k], a2]), a, y)
+        y = _np_biquad(np.array([a0, a14[k], a2]), a, y)
+        h[k] = y / gain[k]
+    return _trim_impulse(h).astype(np.float32)
+
+
+@functools.lru_cache(maxsize=32)
+def _modulation_fir(mfs: int, min_cf: float, max_cf: float, n: int = 8, q: int = 2) -> Tuple[np.ndarray, np.ndarray]:
+    """(impulse responses [n, L], left 3 dB cutoffs [n]) of the modulation bank.
+
+    Second-order bandpass bank with Q=2, log-spaced centre frequencies — the exact
+    coefficient math of the reference's ``_compute_modulation_filterbank_and_cutoffs``.
+    """
+    spacing = (max_cf / min_cf) ** (1.0 / (n - 1))
+    cfs = min_cf * spacing ** np.arange(n, dtype=np.float64)
+    w0 = 2 * np.pi * cfs / mfs
+    W0 = np.tan(w0 / 2)
+    b0 = W0 / q
+    # impulse length: the narrowest (lowest-cf) filter decays slowest; size for it
+    decay = np.min(b0 / (1 + b0 + W0**2))  # ~pole-radius deficit per sample
+    length = max(int(np.log(1e7) / max(decay, 1e-9)), 64)
+    impulse = np.zeros(length, dtype=np.float64)
+    impulse[0] = 1.0
+    h = np.empty((n, length), dtype=np.float64)
+    for k in range(n):
+        b = np.array([b0[k], 0.0, -b0[k]])
+        a = np.array([1 + b0[k] + W0[k] ** 2, 2 * W0[k] ** 2 - 2, 1 - b0[k] + W0[k] ** 2])
+        h[k] = _np_biquad(b, a, impulse)
+    cutoffs_left = cfs - b0 * mfs / (2 * np.pi)
+    return _trim_impulse(h).astype(np.float32), cutoffs_left
+
+
+def _fft_conv(x: Array, h: np.ndarray) -> Array:
+    """Causal FFT convolution of ``x [..., T]`` with a filter bank ``h [F, L]``.
+
+    Returns ``[..., F, T]`` — the first T samples of the full convolution, matching
+    what a recursive ``lfilter`` pass would produce.
+    """
+    t = x.shape[-1]
+    n = 1 << ((t + h.shape[-1] - 1) - 1).bit_length()
+    xf = jnp.fft.rfft(x[..., None, :], n=n)
+    hf = jnp.fft.rfft(jnp.asarray(h), n=n)
+    return jnp.fft.irfft(xf * hf, n=n)[..., :t]
+
+
+def _hilbert_env(x: Array) -> Array:
+    """|analytic signal| along the last axis (reference ``srmr.py:92-113``)."""
+    t = x.shape[-1]
+    n = math.ceil(t / 16) * 16
+    xf = jnp.fft.fft(x, n=n, axis=-1)
+    weight = np.zeros(n, dtype=np.float32)
+    if n % 2 == 0:
+        weight[0] = weight[n // 2] = 1
+        weight[1 : n // 2] = 2
+    else:
+        weight[0] = 1
+        weight[1 : (n + 1) // 2] = 2
+    return jnp.abs(jnp.fft.ifft(xf * jnp.asarray(weight), axis=-1)[..., :t])
+
+
+def _frame_energies(mod: Array, w_length: int, w_inc: int, num_frames: int) -> Array:
+    """Hamming-windowed per-frame energies via one strided conv.
+
+    ``sum((frame * w)^2)`` == correlation of the squared signal with ``w^2`` — a
+    single stride-``w_inc`` convolution instead of an unfold + reduce.
+    """
+    b, nch, m, t = mod.shape
+    pad = max(math.ceil(t / w_inc) * w_inc - t, w_length - t)
+    sq = jnp.pad(mod**2, ((0, 0), (0, 0), (0, 0), (0, pad)))
+    w2 = (np.hamming(w_length + 1)[:-1] ** 2).astype(np.float32)  # periodic window
+    out = lax.conv_general_dilated(
+        sq.reshape(b * nch * m, 1, t + pad),
+        jnp.asarray(w2).reshape(1, 1, w_length),
+        window_strides=(w_inc,),
+        padding="VALID",
+    )
+    return out.reshape(b, nch, m, -1)[..., :num_frames]
+
+
+def _normalize_energy(energy: Array, drange: float = 30.0) -> Array:
+    """Clamp energies into a 30 dB dynamic range below the mean-channel peak."""
+    peak = jnp.max(jnp.mean(energy, axis=1, keepdims=True), axis=(2, 3), keepdims=True)
+    floor = peak * 10.0 ** (-drange / 10.0)
+    return jnp.clip(energy, floor, peak)
+
+
+def _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast) -> None:
+    """Error-string parity with the reference's ``_srmr_arg_validate``."""
+    if not (isinstance(fs, int) and fs > 0):
+        raise ValueError(f"Expected argument `fs` to be an int larger than 0, but got {fs}")
+    if not (isinstance(n_cochlear_filters, int) and n_cochlear_filters > 0):
+        raise ValueError(
+            f"Expected argument `n_cochlear_filters` to be an int larger than 0, but got {n_cochlear_filters}"
+        )
+    if not (isinstance(low_freq, (float, int)) and low_freq > 0):
+        raise ValueError(f"Expected argument `low_freq` to be a float larger than 0, but got {low_freq}")
+    if not (isinstance(min_cf, (float, int)) and min_cf > 0):
+        raise ValueError(f"Expected argument `min_cf` to be a float larger than 0, but got {min_cf}")
+    if max_cf is not None and not (isinstance(max_cf, (float, int)) and max_cf > 0):
+        raise ValueError(f"Expected argument `max_cf` to be a float larger than 0, but got {max_cf}")
+    if not isinstance(norm, bool):
+        raise ValueError("Expected argument `norm` to be a bool value")
+    if not isinstance(fast, bool):
+        raise ValueError("Expected argument `fast` to be a bool value")
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: Optional[float] = None,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """Speech-to-reverberation modulation energy ratio, computed on device.
+
+    Args:
+        preds: shape ``(..., time)``
+        fs: sampling rate
+        n_cochlear_filters: number of gammatone channels
+        low_freq: lowest gammatone centre frequency
+        min_cf: centre frequency of the first modulation filter
+        max_cf: centre frequency of the last modulation filter; defaults to 30 Hz
+            when ``norm`` else 128 Hz (as the reference)
+        norm: clamp modulation energies into a 30 dB dynamic range
+        fast: gammatonegram approximation — delegated to the optional ``srmrpy``
+            host callback (the reference marks this path experimental)
+
+    Returns:
+        SRMR value(s) with shape ``(...)`` (shape ``(1,)`` for 1-D input, as the
+        reference).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import speech_reverberation_modulation_energy_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> score = speech_reverberation_modulation_energy_ratio(preds, 8000)
+        >>> bool(score.shape == (1,)) and bool(score > 0)
+        True
+    """
+    _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
+    if max_cf is None:
+        max_cf = 30 if norm else 128
+    if fast:
+        from torchmetrics_tpu.functional.audio.external import _srmr_srmrpy
+
+        return _srmr_srmrpy(
+            preds, fs, n_cochlear_filters=n_cochlear_filters, low_freq=low_freq,
+            min_cf=min_cf, max_cf=max_cf, norm=norm, fast=True,
+        )
+    shape = preds.shape
+    x = preds.reshape(1, -1) if preds.ndim == 1 else preds.reshape(-1, shape[-1])
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        x = x.astype(jnp.float32) / float(jnp.iinfo(preds.dtype).max)
+    x = x.astype(jnp.float32)
+    # normalize into [-1, 1] (reference ``srmr.py:257-264``)
+    max_vals = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    x = x / jnp.where(max_vals > 1, max_vals, 1.0)
+
+    time = x.shape[-1]
+    w_length = math.ceil(0.256 * fs)
+    w_inc = math.ceil(0.064 * fs)
+
+    gt_env = _hilbert_env(_fft_conv(x, _gammatone_fir(fs, n_cochlear_filters, float(low_freq))))
+    mod_fir, cutoffs = _modulation_fir(fs, float(min_cf), float(max_cf))
+    mod_out = _fft_conv(gt_env, mod_fir)  # [B, N, 8, time]
+
+    num_frames = max(int(1 + (time - w_length) // w_inc), 1)
+    energy = _frame_energies(mod_out, w_length, w_inc, num_frames)
+    if norm:
+        energy = _normalize_energy(energy)
+
+    avg_energy = jnp.mean(energy, axis=-1)  # [B, N, 8]
+    total_energy = jnp.sum(avg_energy, axis=(1, 2))
+    ac_energy = jnp.sum(avg_energy, axis=2)  # [B, N]
+    ac_perc = ac_energy * 100 / jnp.maximum(total_energy[:, None], 1e-20)
+    # 90 % cumulative-energy bandwidth, counted from the lowest cochlear channel
+    cum = jnp.cumsum(ac_perc[:, ::-1], axis=-1)
+    k90_idx = jnp.argmax(cum > 90, axis=-1)
+    erbs_asc = jnp.asarray(_erbs(fs, n_cochlear_filters, float(low_freq))[::-1].copy(), dtype=jnp.float32)
+    bw = erbs_asc[k90_idx]  # [B]
+    # adaptive upper band K*: 5..8 by which left cutoff the bandwidth exceeds
+    # (branch-free; the reference raises when bw < cutoffs[4] — under jit we clamp
+    # to K*=5, which yields the same denominator)
+    kstar = 5 + ((bw[:, None] >= jnp.asarray(cutoffs[5:8], dtype=jnp.float32)).sum(axis=-1))
+    band = jnp.arange(8)
+    denom_mask = (band[None, :] >= 4) & (band[None, :] < kstar[:, None])
+    numerator = jnp.sum(avg_energy[:, :, :4], axis=(1, 2))
+    denominator = jnp.sum(avg_energy * denom_mask[:, None, :], axis=(1, 2))
+    score = numerator / jnp.maximum(denominator, 1e-20)
+    return score.reshape(shape[:-1]) if len(shape) > 1 else score
