@@ -1,0 +1,85 @@
+"""Gradient sweep across every exported class flagged ``is_differentiable=True``.
+
+The reference runs ``run_differentiability_test`` for every metric
+(``tests/unittests/_helpers/testers.py:531-567``): if the metric says it is
+differentiable and its preds are floating, backprop through ``metric(preds, ...)``
+must produce a real gradient. This is the analog: auto-enumerate the exports, and
+for each flagged class take ``jax.grad`` of the (summed) metric value with respect
+to the floating first update argument, asserting every gradient entry is finite.
+Classes whose first update argument is integral (the label-pair clustering scores)
+are skipped exactly as the reference's tester skips non-floating preds.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from tests.helpers.instantiation import CASES, GATED, STRUCTURAL, exported_metric_classes
+
+_SEED = 1234
+
+
+def _flagged_classes():
+    names = []
+    for name in sorted(exported_metric_classes()):
+        cls = getattr(tm, name)
+        if getattr(cls, "is_differentiable", None) is True and name in CASES:
+            names.append(name)
+    return names
+
+
+FLAGGED = _flagged_classes()
+
+
+def _tree_scalar(value):
+    """Reduce any compute() output (scalar/array/tuple/dict) to one real scalar."""
+    leaves = [x for x in jax.tree_util.tree_leaves(value) if isinstance(x, jax.Array)]
+    total = sum(jnp.sum(jnp.real(leaf.astype(jnp.float32))) for leaf in leaves)
+    return total
+
+
+@pytest.mark.parametrize("name", FLAGGED)
+def test_flagged_metric_has_finite_grads(name):
+    ctor_kwargs, maker = CASES[name]
+    args = maker(np.random.RandomState(_SEED))
+    first = args[0]
+    if not (isinstance(first, jax.Array) and jnp.issubdtype(first.dtype, jnp.floating)):
+        pytest.skip("first update argument is not floating; grads undefined (reference skips too)")
+
+    cls = getattr(tm, name)
+
+    def loss(x0):
+        m = cls(**ctor_kwargs)
+        m.update(x0, *args[1:])
+        return _tree_scalar(m.compute())
+
+    grads = jax.grad(loss)(first)
+    assert grads.shape == first.shape
+    assert bool(jnp.all(jnp.isfinite(grads))), f"{name}: non-finite gradients"
+
+
+def test_sweep_covers_every_flagged_export():
+    """Every is_differentiable=True export is either swept here or gated/structural."""
+    flagged_all = {
+        n
+        for n in exported_metric_classes()
+        if getattr(getattr(tm, n), "is_differentiable", None) is True
+    }
+    unswept = flagged_all - set(FLAGGED) - set(GATED) - STRUCTURAL
+    assert not unswept, f"differentiable classes not swept: {sorted(unswept)}"
+
+
+def test_not_flagged_metadata_is_exported():
+    """Every exported class carries the is_differentiable metadata attribute."""
+    for n in sorted(exported_metric_classes() - {"Metric"}):
+        cls = getattr(tm, n)
+        if inspect.isabstract(cls):
+            continue
+        assert hasattr(cls, "is_differentiable"), n

@@ -1,0 +1,405 @@
+"""Shared per-class instantiation registry: ctor kwargs + domain-appropriate inputs.
+
+One place maps every exported :class:`Metric` subclass to a constructor-kwargs dict
+and an input maker, so batteries that must cover the whole export surface (the
+``.plot()`` battery, the differentiability sweep) stay in sync. ``GATED`` enumerates
+weights/backend-gated classes that cannot instantiate in this environment;
+``STRUCTURAL`` the composition surfaces with their own dedicated tests.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.core.metric import Metric
+
+N, C, L = 24, 4, 3
+
+
+def bin_cls(r):
+    return jnp.asarray(r.rand(N).astype(np.float32)), jnp.asarray(r.randint(0, 2, N))
+
+
+def mc_cls(r):
+    logits = r.rand(N, C).astype(np.float32)
+    return jnp.asarray(logits / logits.sum(1, keepdims=True)), jnp.asarray(r.randint(0, C, N))
+
+
+def mc_labels(r):
+    return jnp.asarray(r.randint(0, C, N)), jnp.asarray(r.randint(0, C, N))
+
+
+def ml_cls(r):
+    return jnp.asarray(r.rand(N, L).astype(np.float32)), jnp.asarray(r.randint(0, 2, (N, L)))
+
+
+def reg(r):
+    return jnp.asarray(r.randn(N).astype(np.float32)), jnp.asarray(r.randn(N).astype(np.float32))
+
+
+def reg_pos(r):
+    return (
+        jnp.asarray(r.rand(N).astype(np.float32) + 0.1),
+        jnp.asarray(r.rand(N).astype(np.float32) + 0.1),
+    )
+
+
+def img(r):
+    return (
+        jnp.asarray(r.rand(2, 3, 32, 32).astype(np.float32)),
+        jnp.asarray(r.rand(2, 3, 32, 32).astype(np.float32)),
+    )
+
+
+def audio(r):
+    return (
+        jnp.asarray(r.randn(2, 4000).astype(np.float32)),
+        jnp.asarray(r.randn(2, 4000).astype(np.float32)),
+    )
+
+
+def text_pair(r):
+    return ["the cat sat on the mat"], ["the cat sat on a mat"]
+
+
+def text_corpus(r):
+    return ["the cat sat on the mat"], [["the cat sat on a mat", "a cat sat on the mat"]]
+
+
+def retrieval(r):
+    return (
+        jnp.asarray(r.rand(N).astype(np.float32)),
+        jnp.asarray(r.randint(0, 2, N)),
+        jnp.asarray(r.randint(0, 3, N)),
+    )
+
+
+def clustering(r):
+    return jnp.asarray(r.randint(0, C, N)), jnp.asarray(r.randint(0, C, N))
+
+
+def clustering_data(r):
+    return jnp.asarray(r.randn(N, 2).astype(np.float32)), jnp.asarray(r.randint(0, C, N))
+
+
+def detection(r):
+    def boxes(n):
+        xy = r.rand(n, 2).astype(np.float32) * 50
+        return np.concatenate([xy, xy + 10], axis=1)
+
+    preds = [
+        {
+            "boxes": jnp.asarray(boxes(3)),
+            "scores": jnp.asarray(r.rand(3).astype(np.float32)),
+            "labels": jnp.asarray(r.randint(0, 2, 3)),
+        }
+    ]
+    target = [{"boxes": jnp.asarray(boxes(2)), "labels": jnp.asarray(r.randint(0, 2, 2))}]
+    return preds, target
+
+
+def segmentation(r):
+    return jnp.asarray(r.randint(0, C, (2, 16, 16))), jnp.asarray(r.randint(0, C, (2, 16, 16)))
+
+
+def panoptic(r):
+    # [B, H, W, 2] = (category_id, instance_id); categories from things={0} stuffs={1}
+    cat = r.randint(0, 2, (1, 8, 8, 1))
+    inst = r.randint(0, 2, (1, 8, 8, 1))
+    arr = jnp.asarray(np.concatenate([cat, inst], axis=-1))
+    return arr, arr
+
+
+def perplexity(r):
+    probs = r.rand(2, 8, 10).astype(np.float32) + 0.01
+    probs = probs / probs.sum(-1, keepdims=True)
+    return jnp.asarray(np.log(probs)), jnp.asarray(r.randint(0, 10, (2, 8)))
+
+
+# --------------------------------------------------------------------- the table
+# name -> (ctor_kwargs, input_maker). Grouped defaults below the explicit entries:
+# Binary*/Multiclass*/Multilabel* classification, Retrieval*, task-wrapper factories.
+EXPLICIT_CASES = {
+    # aggregation
+    "CatMetric": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    "MaxMetric": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    "MinMetric": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    "MeanMetric": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    "SumMetric": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    "RunningMean": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    "RunningSum": ({}, lambda r: (jnp.asarray(r.rand(5).astype(np.float32)),)),
+    # classification specials
+    "BinaryFairness": ({"num_groups": 2}, lambda r: (*bin_cls(r), jnp.asarray(r.randint(0, 2, N)))),
+    "BinaryGroupStatRates": (
+        {"num_groups": 2},
+        lambda r: (*bin_cls(r), jnp.asarray(r.randint(0, 2, N))),
+    ),
+    "Dice": ({}, mc_cls),
+    # regression specials
+    "KLDivergence": (
+        {},
+        lambda r: tuple(
+            jnp.asarray((p := r.rand(N, C).astype(np.float32)) / p.sum(1, keepdims=True))
+            for _ in range(2)
+        ),
+    ),
+    "TweedieDevianceScore": ({}, reg_pos),
+    "MinkowskiDistance": ({"p": 3.0}, reg),
+    "CosineSimilarity": ({}, lambda r: (jnp.asarray(r.randn(N, C).astype(np.float32)),) * 2),
+    "CriticalSuccessIndex": ({"threshold": 0.5}, reg_pos),
+    "LogCoshError": ({}, reg),
+    "MeanAbsolutePercentageError": ({}, reg_pos),
+    "MeanSquaredLogError": ({}, reg_pos),
+    "SymmetricMeanAbsolutePercentageError": ({}, reg_pos),
+    "WeightedMeanAbsolutePercentageError": ({}, reg_pos),
+    "RelativeSquaredError": ({}, reg),
+    "ExplainedVariance": ({}, reg),
+    "R2Score": ({}, reg),
+    "PearsonCorrCoef": ({}, reg),
+    "SpearmanCorrCoef": ({}, reg),
+    "ConcordanceCorrCoef": ({}, reg),
+    "KendallRankCorrCoef": ({}, reg),
+    "MeanAbsoluteError": ({}, reg),
+    "MeanSquaredError": ({}, reg),
+    # image
+    "PeakSignalNoiseRatio": ({}, img),
+    "PeakSignalNoiseRatioWithBlockedEffect": (
+        {},
+        lambda r: (
+            jnp.asarray(r.rand(2, 1, 32, 32).astype(np.float32)),
+            jnp.asarray(r.rand(2, 1, 32, 32).astype(np.float32)),
+        ),
+    ),
+    "StructuralSimilarityIndexMeasure": ({}, img),
+    "MultiScaleStructuralSimilarityIndexMeasure": (
+        {},
+        lambda r: (
+            jnp.asarray(r.rand(1, 3, 180, 180).astype(np.float32)),
+            jnp.asarray(r.rand(1, 3, 180, 180).astype(np.float32)),
+        ),
+    ),
+    "UniversalImageQualityIndex": ({}, img),
+    "SpectralAngleMapper": ({}, img),
+    "SpectralDistortionIndex": ({}, img),
+    "RelativeAverageSpectralError": ({}, img),
+    "RootMeanSquaredErrorUsingSlidingWindow": ({}, img),
+    "ErrorRelativeGlobalDimensionlessSynthesis": ({}, img),
+    "VisualInformationFidelity": (
+        {},
+        lambda r: (
+            jnp.asarray(r.rand(1, 3, 41, 41).astype(np.float32)),
+            jnp.asarray(r.rand(1, 3, 41, 41).astype(np.float32)),
+        ),
+    ),
+    "TotalVariation": ({}, lambda r: (jnp.asarray(r.rand(2, 3, 16, 16).astype(np.float32)),)),
+    "QualityWithNoReference": (
+        {},
+        lambda r: (
+            jnp.asarray(r.rand(2, 3, 32, 32).astype(np.float32)),
+            {
+                "ms": jnp.asarray(r.rand(2, 3, 16, 16).astype(np.float32)),
+                "pan": jnp.asarray(r.rand(2, 3, 32, 32).astype(np.float32)),
+            },
+        ),
+    ),
+    "SpatialCorrelationCoefficient": ({}, img),
+    "SpatialDistortionIndex": (
+        {},
+        lambda r: (
+            jnp.asarray(r.rand(2, 3, 32, 32).astype(np.float32)),
+            {
+                "ms": jnp.asarray(r.rand(2, 3, 16, 16).astype(np.float32)),
+                "pan": jnp.asarray(r.rand(2, 3, 32, 32).astype(np.float32)),
+            },
+        ),
+    ),
+    # audio (native paths)
+    "SignalNoiseRatio": ({}, audio),
+    "ScaleInvariantSignalNoiseRatio": ({}, audio),
+    "SignalDistortionRatio": ({}, audio),
+    "ScaleInvariantSignalDistortionRatio": ({}, audio),
+    "ComplexScaleInvariantSignalNoiseRatio": (
+        {},
+        lambda r: (
+            jnp.asarray(r.randn(2, 64, 33, 2).astype(np.float32)),
+            jnp.asarray(r.randn(2, 64, 33, 2).astype(np.float32)),
+        ),
+    ),
+    "SourceAggregatedSignalDistortionRatio": (
+        {},
+        lambda r: (
+            jnp.asarray(r.randn(2, 2, 4000).astype(np.float32)),
+            jnp.asarray(r.randn(2, 2, 4000).astype(np.float32)),
+        ),
+    ),
+    "ShortTimeObjectiveIntelligibility": ({"fs": 8000}, lambda r: (
+        jnp.asarray(r.randn(1, 8000).astype(np.float32)),
+        jnp.asarray(r.randn(1, 8000).astype(np.float32)),
+    )),
+    "SpeechReverberationModulationEnergyRatio": ({"fs": 8000}, lambda r: (
+        jnp.asarray(r.randn(1, 8000).astype(np.float32)),
+    )),
+    "PermutationInvariantTraining": (
+        {"metric_func": lambda p, t: -jnp.mean((p - t) ** 2, axis=-1)},
+        lambda r: (
+            jnp.asarray(r.randn(2, 2, 100).astype(np.float32)),
+            jnp.asarray(r.randn(2, 2, 100).astype(np.float32)),
+        ),
+    ),
+    # text (host-side string metrics)
+    "BLEUScore": ({}, text_corpus),
+    "SacreBLEUScore": ({}, text_corpus),
+    "CHRFScore": ({}, text_corpus),
+    "TranslationEditRate": ({}, text_corpus),
+    "CharErrorRate": ({}, text_pair),
+    "WordErrorRate": ({}, text_pair),
+    "MatchErrorRate": ({}, text_pair),
+    "WordInfoLost": ({}, text_pair),
+    "WordInfoPreserved": ({}, text_pair),
+    "EditDistance": ({}, text_pair),
+    "ExtendedEditDistance": ({}, text_pair),
+    # rougeLsum needs the host nltk splitter (absent here; error parity is tested
+    # in tests/text) — plot the executable keys
+    "ROUGEScore": ({"rouge_keys": ("rouge1", "rouge2", "rougeL")}, text_pair),
+    "BinaryFBetaScore": ({"beta": 2.0}, bin_cls),
+    "MulticlassFBetaScore": ({"beta": 2.0, "num_classes": C}, mc_cls),
+    "MultilabelFBetaScore": ({"beta": 2.0, "num_labels": L}, ml_cls),
+    "SQuAD": (
+        {},
+        lambda r: (
+            [{"prediction_text": "the cat", "id": "0"}],
+            [{"answers": {"answer_start": [0], "text": ["the cat"]}, "id": "0"}],
+        ),
+    ),
+    "Perplexity": ({}, perplexity),
+    # clustering
+    "MutualInfoScore": ({}, clustering),
+    "NormalizedMutualInfoScore": ({}, clustering),
+    "AdjustedMutualInfoScore": ({}, clustering),
+    "RandScore": ({}, clustering),
+    "AdjustedRandScore": ({}, clustering),
+    "FowlkesMallowsIndex": ({}, clustering),
+    "CompletenessScore": ({}, clustering),
+    "HomogeneityScore": ({}, clustering),
+    "VMeasureScore": ({}, clustering),
+    "CalinskiHarabaszScore": ({}, clustering_data),
+    "DaviesBouldinScore": ({}, clustering_data),
+    "DunnIndex": ({}, clustering_data),
+    # nominal
+    "CramersV": ({"num_classes": C}, mc_labels),
+    "TschuprowsT": ({"num_classes": C}, mc_labels),
+    "TheilsU": ({"num_classes": C}, mc_labels),
+    "PearsonsContingencyCoefficient": ({"num_classes": C}, mc_labels),
+    "FleissKappa": ({}, lambda r: (jnp.asarray(r.randint(0, 5, (10, 3))),)),
+    # detection
+    "MeanAveragePrecision": ({}, detection),
+    "IntersectionOverUnion": ({}, detection),
+    "GeneralizedIntersectionOverUnion": ({}, detection),
+    "DistanceIntersectionOverUnion": ({}, detection),
+    "CompleteIntersectionOverUnion": ({}, detection),
+    "PanopticQuality": ({"things": {0}, "stuffs": {1}}, panoptic),
+    "ModifiedPanopticQuality": ({"things": {0}, "stuffs": {1}}, panoptic),
+    # segmentation
+    "GeneralizedDiceScore": ({"num_classes": C}, segmentation),
+    "MeanIoU": ({"num_classes": C}, segmentation),
+    # multilabel ranking (plain float preds)
+    "MultilabelCoverageError": ({"num_labels": L}, ml_cls),
+    "MultilabelRankingAveragePrecision": ({"num_labels": L}, ml_cls),
+    "MultilabelRankingLoss": ({"num_labels": L}, ml_cls),
+}
+
+# task-wrapper factory classes: instantiating with task="multiclass"/"binary"
+# returns the task class; plot must work through the factory surface too
+TASK_FACTORIES = {
+    "Accuracy", "AUROC", "AveragePrecision", "CalibrationError", "CohenKappa",
+    "ConfusionMatrix", "ExactMatch", "F1Score", "FBetaScore", "HammingDistance",
+    "HingeLoss", "JaccardIndex", "MatthewsCorrCoef", "Precision",
+    "PrecisionAtFixedRecall", "PrecisionRecallCurve", "ROC", "Recall",
+    "RecallAtFixedPrecision", "SensitivityAtSpecificity", "Specificity",
+    "SpecificityAtSensitivity", "StatScores",
+}
+
+# weights/backend-gated: cannot instantiate without checkpoint drops or host libs
+GATED = {
+    "BERTScore": "HF BERT weights",
+    "InfoLM": "HF LM weights",
+    "CLIPScore": "CLIP weights",
+    "CLIPImageQualityAssessment": "CLIP weights",
+    "FrechetInceptionDistance": "Inception weights",
+    "InceptionScore": "Inception weights",
+    "KernelInceptionDistance": "Inception weights",
+    "MemorizationInformedFrechetInceptionDistance": "Inception weights",
+    "LearnedPerceptualImagePatchSimilarity": "LPIPS weights",
+    "PerceptualPathLength": "generator + weights",
+    "PerceptualEvaluationSpeechQuality": "pesq host lib",
+    "DeepNoiseSuppressionMeanOpinionScore": "DNSMOS onnx weights",
+}
+
+# structural classes exercised through dedicated composition tests below
+STRUCTURAL = {"Metric", "RetrievalMetric", "CompositionalMetric", "Running",
+              "BootStrapper", "ClasswiseWrapper", "MinMaxMetric", "MultioutputWrapper",
+              "MultitaskWrapper"}
+
+
+def _binary_fixed_rate_kwargs(name):
+    if "AtFixedRecall" in name:
+        return {"min_recall": 0.5}
+    if "AtFixedPrecision" in name:
+        return {"min_precision": 0.5}
+    if "AtSpecificity" in name:
+        return {"min_specificity": 0.5}
+    if "AtSensitivity" in name:
+        return {"min_sensitivity": 0.5}
+    return {}
+
+
+def _build_cases():
+    cases = dict(EXPLICIT_CASES)
+    for name in tm.__all__:
+        obj = getattr(tm, name, None)
+        if not (inspect.isclass(obj) and issubclass(obj, Metric)):
+            continue
+        if name in cases or name in GATED or name in STRUCTURAL or name in TASK_FACTORIES:
+            continue
+        extra = _binary_fixed_rate_kwargs(name)
+        if name.startswith("Binary"):
+            cases[name] = (extra, bin_cls)
+        elif name.startswith("Multiclass"):
+            cases[name] = ({"num_classes": C, **extra}, mc_cls)
+        elif name.startswith("Multilabel"):
+            cases[name] = ({"num_labels": L, **extra}, ml_cls)
+        elif name.startswith("Retrieval"):
+            cases[name] = (extra, retrieval)
+    for name in TASK_FACTORIES:
+        extra = _binary_fixed_rate_kwargs(name)
+        if name == "ExactMatch":  # no binary task in the reference either
+            cases[name] = ({"task": "multiclass", "num_classes": C}, mc_cls)
+        elif name == "FBetaScore":
+            cases[name] = ({"task": "binary", "beta": 2.0}, bin_cls)
+        else:
+            cases[name] = ({"task": "binary", **extra}, bin_cls)
+    return cases
+
+
+CASES = _build_cases()
+
+
+def make_metric(name, rng):
+    """Instantiate ``name`` from the registry and update it once; returns the metric."""
+    ctor_kwargs, maker = CASES[name]
+    m = getattr(tm, name)(**ctor_kwargs)
+    m.update(*maker(rng))
+    return m
+
+
+def exported_metric_classes():
+    return {
+        n
+        for n in tm.__all__
+        if inspect.isclass(getattr(tm, n, None)) and issubclass(getattr(tm, n), Metric)
+    }

@@ -148,16 +148,25 @@ def _modulation_fir(mfs: int, min_cf: float, max_cf: float, n: int = 8, q: int =
     return _trim_impulse(h).astype(np.float32), cutoffs_left
 
 
-def _fft_conv(x: Array, h: np.ndarray) -> Array:
+_HF_CACHE: dict = {}
+
+
+def _fft_conv(x: Array, h: np.ndarray, cache_key: tuple = None) -> Array:
     """Causal FFT convolution of ``x [..., T]`` with a filter bank ``h [F, L]``.
 
     Returns ``[..., F, T]`` — the first T samples of the full convolution, matching
-    what a recursive ``lfilter`` pass would produce.
+    what a recursive ``lfilter`` pass would produce. The filter bank's transform is
+    memoized per (design, fft length) so the eager path doesn't re-transform the
+    static filters on every update.
     """
     t = x.shape[-1]
     n = 1 << ((t + h.shape[-1] - 1) - 1).bit_length()
+    hf = _HF_CACHE.get((cache_key, n)) if cache_key is not None else None
+    if hf is None:
+        hf = jnp.fft.rfft(jnp.asarray(h), n=n)
+        if cache_key is not None:
+            _HF_CACHE[(cache_key, n)] = hf
     xf = jnp.fft.rfft(x[..., None, :], n=n)
-    hf = jnp.fft.rfft(jnp.asarray(h), n=n)
     return jnp.fft.irfft(xf * hf, n=n)[..., :t]
 
 
@@ -281,9 +290,10 @@ def speech_reverberation_modulation_energy_ratio(
     w_length = math.ceil(0.256 * fs)
     w_inc = math.ceil(0.064 * fs)
 
-    gt_env = _hilbert_env(_fft_conv(x, _gammatone_fir(fs, n_cochlear_filters, float(low_freq))))
+    gt_key = ("gt", fs, n_cochlear_filters, float(low_freq))
+    gt_env = _hilbert_env(_fft_conv(x, _gammatone_fir(fs, n_cochlear_filters, float(low_freq)), gt_key))
     mod_fir, cutoffs = _modulation_fir(fs, float(min_cf), float(max_cf))
-    mod_out = _fft_conv(gt_env, mod_fir)  # [B, N, 8, time]
+    mod_out = _fft_conv(gt_env, mod_fir, ("mod", fs, float(min_cf), float(max_cf)))  # [B, N, 8, time]
 
     num_frames = max(int(1 + (time - w_length) // w_inc), 1)
     energy = _frame_energies(mod_out, w_length, w_inc, num_frames)

@@ -69,6 +69,24 @@ def calinski_harabasz_score(data: Array, labels: Array) -> Array:
     )
 
 
+def _grad_safe_norm(diff: Array) -> Array:
+    """L2 norm along the last axis with a finite gradient at exactly zero.
+
+    ``sqrt`` backward at 0 is inf, and a downstream ``where`` turns that into NaN
+    (0 * inf) — the standard JAX double-where guard: never let sqrt see the zero.
+    """
+    sq = jnp.square(diff).sum(axis=-1)
+    return jnp.where(sq == 0, 0.0, jnp.sqrt(jnp.where(sq == 0, 1.0, sq)))
+
+
+def _grad_safe_pnorm(v: Array, p: float, axis=-1) -> Array:
+    """p-norm with finite gradients at exact zeros (same double-where guard)."""
+    a = jnp.abs(v)
+    powed = jnp.where(a == 0, 0.0, jnp.where(a == 0, 1.0, a) ** p)
+    s = jnp.sum(powed, axis=axis)
+    return jnp.where(s == 0, 0.0, jnp.where(s == 0, 1.0, s) ** (1.0 / p))
+
+
 def davies_bouldin_score(data: Array, labels: Array) -> Array:
     """Compute the Davies-Bouldin score for intrinsic cluster evaluation.
 
@@ -88,12 +106,12 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
     _validate_intrinsic_labels_to_samples(num_labels, num_samples)
 
     counts, centroids = _cluster_stats(data, labels, num_labels)
-    dists = jnp.sqrt(jnp.square(data - centroids[labels]).sum(axis=1))
+    dists = _grad_safe_norm(data - centroids[labels])
     onehot = jax.nn.one_hot(labels, num_labels, dtype=data.dtype)
     intra_dists = (onehot.T @ dists) / counts
 
     diff = centroids[:, None, :] - centroids[None, :, :]
-    centroid_distances = jnp.sqrt(jnp.square(diff).sum(axis=-1))
+    centroid_distances = _grad_safe_norm(diff)
 
     if bool(jnp.allclose(intra_dists, 0.0)) or bool(jnp.allclose(centroid_distances, 0.0)):
         return jnp.asarray(0.0)
@@ -110,9 +128,9 @@ def _dunn_index_update(data: Array, labels: Array, p: float) -> Tuple[Array, Arr
     _, centroids = _cluster_stats(jnp.asarray(data, dtype=jnp.float32), labels, num_labels)
 
     inter = jnp.stack(
-        [jnp.linalg.norm(centroids[a] - centroids[b], ord=p) for a, b in combinations(range(num_labels), 2)]
+        [_grad_safe_pnorm(centroids[a] - centroids[b], p) for a, b in combinations(range(num_labels), 2)]
     )
-    radii = jnp.linalg.norm(jnp.asarray(data, dtype=jnp.float32) - centroids[labels], ord=p, axis=1)
+    radii = _grad_safe_pnorm(jnp.asarray(data, dtype=jnp.float32) - centroids[labels], p, axis=1)
     onehot = jax.nn.one_hot(labels, num_labels)
     max_intra = jnp.max(jnp.where(onehot.T > 0, radii[None, :], -jnp.inf), axis=1)
     return inter, max_intra
