@@ -1,0 +1,218 @@
+"""ONNX reader + jnp executor: fabricated-protobuf round trips vs numpy oracles.
+
+No ``onnx`` package exists here, so the tests carry their own minimal protobuf
+*writer* (wire format per the protobuf spec: varint tags, length-delimited
+messages) and fabricate genuine ONNX ModelProto bytes — a DNSMOS-shaped CNN head
+(Conv → Relu → pooling → Gemm → Sigmoid), shape-plumbing chains (Shape → Gather →
+Concat → Reshape), and each arithmetic op — then assert the parsed graph executes
+in jnp to match an independently hand-rolled numpy forward.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.convert.onnx_flax import convert_onnx_flax, load_onnx_graph, run_graph
+from torchmetrics_tpu.convert.onnx_reader import parse_onnx
+
+
+from tests.helpers.onnx_fab import _model, _node, _tensor, _varint  # noqa: F401
+
+# ------------------------------------------------------------------- oracles
+def _np_conv2d(x, w, b, pad):
+    n, cin, h, wd = x.shape
+    cout, _, kh, kw = w.shape
+    xp = np.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    oh, ow = xp.shape[2] - kh + 1, xp.shape[3] - kw + 1
+    out = np.zeros((n, cout, oh, ow), np.float32)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i : i + kh, j : j + kw].reshape(n, -1)
+            out[:, :, i, j] = patch @ w.reshape(cout, -1).T
+    return out + b.reshape(1, -1, 1, 1)
+
+
+class TestParserPrimitives:
+    def test_roundtrip_graph_structure(self):
+        w = np.arange(6, dtype=np.float32).reshape(2, 3)
+        model = _model(
+            [_node("MatMul", ["x", "w"], ["y"]), _node("Relu", ["y"], ["out"])],
+            {"w": w},
+            ["x", "w"],
+            ["out"],
+        )
+        g = parse_onnx(model)
+        assert [n["op"] for n in g["nodes"]] == ["MatMul", "Relu"]
+        assert g["inputs"] == ["x"]  # initializer names are not runtime inputs
+        assert g["outputs"] == ["out"]
+        np.testing.assert_array_equal(g["initializers"]["w"], w)
+
+    def test_attribute_kinds(self):
+        model = _model(
+            [
+                _node(
+                    "Conv", ["x", "w"], ["y"],
+                    strides=[2, 2], pads=[1, 1, 1, 1], alpha=0.5, auto_pad="NOTSET", group=1,
+                )
+            ],
+            {"w": np.zeros((1, 1, 3, 3), np.float32)},
+            ["x"], ["y"],
+        )
+        attrs = parse_onnx(model)["nodes"][0]["attrs"]
+        assert attrs["strides"] == [2, 2] and attrs["pads"] == [1, 1, 1, 1]
+        assert attrs["alpha"] == pytest.approx(0.5)
+        assert attrs["auto_pad"] == "NOTSET" and attrs["group"] == 1
+
+    def test_negative_int_attr(self):
+        model = _model([_node("Softmax", ["x"], ["y"], axis=-1)], {}, ["x"], ["y"])
+        assert parse_onnx(model)["nodes"][0]["attrs"]["axis"] == -1
+
+
+class TestExecutorVsOracle:
+    def test_dnsmos_shaped_cnn_head(self):
+        """Conv→Relu→Conv→Relu→GlobalAveragePool→Flatten→Gemm→Sigmoid, vs numpy."""
+        rng = np.random.RandomState(0)
+        w1 = rng.randn(4, 1, 3, 3).astype(np.float32) * 0.3
+        b1 = rng.randn(4).astype(np.float32)
+        w2 = rng.randn(8, 4, 3, 3).astype(np.float32) * 0.3
+        b2 = rng.randn(8).astype(np.float32)
+        wd = rng.randn(8, 3).astype(np.float32)
+        bd = rng.randn(3).astype(np.float32)
+        model = _model(
+            [
+                _node("Conv", ["x", "w1", "b1"], ["c1"], pads=[1, 1, 1, 1]),
+                _node("Relu", ["c1"], ["r1"]),
+                _node("Conv", ["r1", "w2", "b2"], ["c2"], pads=[1, 1, 1, 1]),
+                _node("Relu", ["c2"], ["r2"]),
+                _node("GlobalAveragePool", ["r2"], ["gap"]),
+                _node("Flatten", ["gap"], ["fl"], axis=1),
+                _node("Gemm", ["fl", "wd", "bd"], ["gm"]),
+                _node("Sigmoid", ["gm"], ["out"]),
+            ],
+            {"w1": w1, "b1": b1, "w2": w2, "b2": b2, "wd": wd, "bd": bd},
+            ["x"], ["out"],
+        )
+        x = rng.randn(2, 1, 8, 10).astype(np.float32)
+
+        g = parse_onnx(model)
+        got = run_graph(g, g["initializers"], {"x": jnp.asarray(x)})[0]
+
+        ref = _np_conv2d(x, w1, b1, 1)
+        ref = np.maximum(ref, 0)
+        ref = np.maximum(_np_conv2d(ref, w2, b2, 1), 0)
+        ref = ref.mean(axis=(2, 3)).reshape(2, -1)
+        ref = 1 / (1 + np.exp(-(ref @ wd + bd)))
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-5)
+
+    def test_shape_plumbing_chain_stays_concrete_under_jit(self):
+        """keras-style Shape→Gather→Concat→Reshape must not leak tracers into shapes."""
+        model = _model(
+            [
+                _node("Shape", ["x"], ["sh"]),
+                _node("Gather", ["sh", "idx0"], ["n"], axis=0),
+                _node("Unsqueeze", ["n"], ["n1"], axes=[0]),
+                _node("Concat", ["n1", "minus1"], ["target"], axis=0),
+                _node("Reshape", ["x", "target"], ["out"]),
+            ],
+            {"idx0": np.asarray(0, np.int64), "minus1": np.asarray([-1], np.int64)},
+            ["x"], ["out"],
+        )
+        g = parse_onnx(model)
+        x = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+        fn = jax.jit(lambda v: run_graph(g, g["initializers"], {"x": v})[0])
+        out = fn(x)
+        assert out.shape == (2, 12)
+
+    def test_elementwise_pool_norm_ops(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        scale = rng.rand(2).astype(np.float32) + 0.5
+        bias = rng.randn(2).astype(np.float32)
+        mean = rng.randn(2).astype(np.float32)
+        var = rng.rand(2).astype(np.float32) + 0.5
+        model = _model(
+            [
+                _node("BatchNormalization", ["x", "s", "b", "m", "v"], ["bn"], epsilon=1e-5),
+                _node("MaxPool", ["bn"], ["mp"], kernel_shape=[2, 2], strides=[2, 2]),
+                _node("AveragePool", ["mp"], ["ap"], kernel_shape=[3, 3], strides=[1, 1], pads=[0, 0, 0, 0]),
+                _node("Transpose", ["ap"], ["tr"], perm=[0, 2, 3, 1]),
+            ],
+            {"s": scale, "b": bias, "m": mean, "v": var},
+            ["x"], ["tr"],
+        )
+        g = parse_onnx(model)
+        got = np.asarray(run_graph(g, g["initializers"], {"x": jnp.asarray(x)})[0])
+        bn = (x - mean.reshape(1, 2, 1, 1)) / np.sqrt(var.reshape(1, 2, 1, 1) + 1e-5)
+        bn = bn * scale.reshape(1, 2, 1, 1) + bias.reshape(1, 2, 1, 1)
+        mp = bn.reshape(1, 2, 3, 2, 3, 2).max(axis=(3, 5))
+        ap = mp.mean(axis=(2, 3), keepdims=True)  # 3x3 window over 3x3 = global here
+        ref = ap.transpose(0, 2, 3, 1)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_unsupported_op_raises_with_name(self):
+        model = _model([_node("LSTM", ["x"], ["y"])], {}, ["x"], ["y"])
+        g = parse_onnx(model)
+        with pytest.raises(NotImplementedError, match="LSTM"):
+            run_graph(g, g["initializers"], {"x": jnp.zeros((1, 4))})
+
+
+class TestConverterArtifacts:
+    def test_convert_and_reload(self, tmp_path):
+        rng = np.random.RandomState(2)
+        w = rng.randn(4, 3).astype(np.float32)
+        model_bytes = _model(
+            [_node("MatMul", ["x", "w"], ["mm"]), _node("Softmax", ["mm"], ["out"], axis=-1)],
+            {"w": w},
+            ["x"], ["out"],
+        )
+        onnx_path = tmp_path / "tiny.onnx"
+        onnx_path.write_bytes(model_bytes)
+        out_dir = convert_onnx_flax(str(onnx_path), str(tmp_path / "converted"))
+        spec, params = load_onnx_graph(out_dir)
+        x = rng.randn(5, 4).astype(np.float32)
+        got = np.asarray(run_graph(spec, params, {"x": jnp.asarray(x)})[0])
+        logits = x @ w
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(got, e / e.sum(-1, keepdims=True), rtol=1e-5)
+        # manifest records source/output hashes + the op inventory
+        import json
+
+        manifest = json.loads((tmp_path / "converted" / "MANIFEST.json").read_text())
+        entry = list(manifest.values())[0] if isinstance(manifest, dict) else manifest[0]
+        assert "MatMul" in str(manifest)
+
+    def test_constant_tensor_attr_roundtrips_through_npz(self, tmp_path):
+        const = np.arange(4, dtype=np.float32).reshape(2, 2)
+        model_bytes = _model(
+            [_node("Constant", [], ["c"], value=const), _node("Add", ["x", "c"], ["out"])],
+            {},
+            ["x"], ["out"],
+        )
+        p = tmp_path / "c.onnx"
+        p.write_bytes(model_bytes)
+        out_dir = convert_onnx_flax(str(p), str(tmp_path / "conv"))
+        spec, params = load_onnx_graph(out_dir)
+        got = np.asarray(run_graph(spec, params, {"x": jnp.ones((2, 2), jnp.float32)})[0])
+        np.testing.assert_allclose(got, const + 1.0)
+
+
+class TestTypedTensorData:
+    def test_int64_data_varints_sign_decode(self):
+        """int64_data-encoded tensors (keras shape tensors) must sign-decode: -1
+        travels as a 10-byte varint, not a huge unsigned."""
+        from tests.helpers.onnx_fab import _len_field, _tensor_typed_int64, _varint_field
+
+        graph = _len_field(1, _node("Reshape", ["x", "target"], ["out"]))
+        graph += _len_field(2, b"g")
+        graph += _len_field(5, _tensor_typed_int64("target", np.asarray([2, -1], np.int64)))
+        graph += _len_field(11, _len_field(1, b"x"))  # ValueInfoProto{name: "x"}
+        graph += _len_field(12, _len_field(1, b"out"))
+        model = _varint_field(1, 8) + _len_field(7, graph)
+        g = parse_onnx(model)
+        np.testing.assert_array_equal(g["initializers"]["target"], [2, -1])
+        out = run_graph(g, g["initializers"], {"x": jnp.arange(8.0).reshape(4, 2)})[0]
+        assert out.shape == (2, 4)

@@ -1,0 +1,116 @@
+"""Minimal ONNX protobuf *writer* for fabricating test models.
+
+The real ``onnx`` package is absent; these helpers emit genuine ModelProto wire
+bytes (varint tags, length-delimited messages) so tests can fabricate graphs for
+the reader/executor in ``torchmetrics_tpu/convert/onnx_reader.py``.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# ----------------------------------------------------------- protobuf writer
+def _varint(v: int) -> bytes:
+    out = b""
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        out += bytes([b | (0x80 if v else 0)])
+        if not v:
+            return out
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _len_field(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _varint_field(field: int, v: int) -> bytes:
+    return _tag(field, 0) + _varint(v if v >= 0 else v + (1 << 64))
+
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.asarray(arr)
+    dtype_code = {np.dtype(np.float32): 1, np.dtype(np.int64): 7, np.dtype(np.int32): 6}[arr.dtype]
+    msg = b""
+    for d in arr.shape:
+        msg += _varint_field(1, d)
+    msg += _varint_field(2, dtype_code)
+    msg += _len_field(8, name.encode())
+    msg += _len_field(9, arr.tobytes())
+    return msg
+
+
+def _tensor_typed_int64(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto using int64_data varints (field 7) instead of raw_data —
+    the alternate encoding keras exporters use for shape tensors."""
+    arr = np.asarray(arr, dtype=np.int64)
+    msg = b""
+    for d in arr.shape:
+        msg += _varint_field(1, d)
+    msg += _varint_field(2, 7)
+    for v in arr.reshape(-1).tolist():
+        msg += _varint_field(7, int(v))
+    msg += _len_field(8, name.encode())
+    return msg
+
+
+def _attr(name: str, value) -> bytes:
+    msg = _len_field(1, name.encode())
+    if isinstance(value, float):
+        msg += _tag(2, 5) + struct.pack("<f", value)
+        msg += _varint_field(20, 1)
+    elif isinstance(value, int):
+        msg += _varint_field(3, value)
+        msg += _varint_field(20, 2)
+    elif isinstance(value, str):
+        msg += _len_field(4, value.encode())
+        msg += _varint_field(20, 3)
+    elif isinstance(value, np.ndarray):
+        msg += _len_field(5, _tensor("", value))
+        msg += _varint_field(20, 4)
+    elif isinstance(value, (list, tuple)):
+        for v in value:
+            msg += _varint_field(8, int(v))
+        msg += _varint_field(20, 7)
+    else:
+        raise TypeError(type(value))
+    return msg
+
+
+def _node(op: str, inputs, outputs, **attrs) -> bytes:
+    msg = b""
+    for i in inputs:
+        msg += _len_field(1, i.encode())
+    for o in outputs:
+        msg += _len_field(2, o.encode())
+    msg += _len_field(3, f"{op}_{outputs[0]}".encode())
+    msg += _len_field(4, op.encode())
+    for k, v in attrs.items():
+        msg += _len_field(5, _attr(k, v))
+    return msg
+
+
+def _value_info(name: str) -> bytes:
+    return _len_field(1, name.encode())
+
+
+def _model(nodes, initializers, inputs, outputs) -> bytes:
+    graph = b""
+    for n in nodes:
+        graph += _len_field(1, n)
+    graph += _len_field(2, b"g")
+    for name, arr in initializers.items():
+        graph += _len_field(5, _tensor(name, arr))
+    for i in inputs:
+        graph += _len_field(11, _value_info(i))
+    for o in outputs:
+        graph += _len_field(12, _value_info(o))
+    return _varint_field(1, 8) + _len_field(7, graph)  # ir_version + graph
+
+

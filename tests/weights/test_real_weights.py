@@ -268,3 +268,31 @@ class TestRealClipScore:
         ref = ref_clip_score(torch.from_numpy(images), text, model_name_or_path=clip_model_dir)
         _assert_allclose(np.asarray(ours), ref.detach().numpy(), atol=0.05)
         print(f"\nreal-weights CLIPScore: {float(np.asarray(ours)):.3f}")
+
+
+class TestRealDnsmos:
+    def test_dnsmos_real_onnx_scores(self):
+        """A dropped DNS-challenge ONNX file produces an on-device score.
+
+        Drop Microsoft's DNSMOS checkpoints (DNSMOS/model_v8.onnx,
+        DNSMOS/sig_bak_ovr.onnx, pDNSMOS/sig_bak_ovr.onnx) under
+        ``weights/dnsmos`` or ``$TORCHMETRICS_TPU_DNSMOS_DIR``; they auto-convert
+        to jnp graphs on first use (convert/onnx_flax.py).
+        """
+        from torchmetrics_tpu.functional.audio import dnsmos as dnsmos_mod
+
+        root = dnsmos_mod._dnsmos_root()
+        if root is None or any(
+            dnsmos_mod._resolve_model(root, key) is None for key in ("model_v8", "sig_bak_ovr")
+        ):
+            pytest.skip("DNSMOS onnx checkpoints not provided")
+        from torchmetrics_tpu.functional.audio import deep_noise_suppression_mean_opinion_score
+
+        rng = np.random.RandomState(1)
+        t = np.arange(16000 * 4) / 16000
+        speechlike = (np.sin(2 * np.pi * 440 * t) * (0.6 + 0.4 * np.sin(2 * np.pi * 4 * t))).astype(np.float32)
+        out = np.asarray(deep_noise_suppression_mean_opinion_score(jnp.asarray(speechlike), 16000, False))
+        assert out.shape == (4,)
+        assert np.isfinite(out).all()
+        assert (out > 0.5).all() and (out < 5.5).all(), out
+        print(f"\nreal-weights DNSMOS [p808, sig, bak, ovr]: {out}")

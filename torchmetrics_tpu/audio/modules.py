@@ -12,10 +12,8 @@ import jax
 import jax.numpy as jnp
 
 from torchmetrics_tpu.core.metric import Metric
-from torchmetrics_tpu.functional.audio.external import (
-    deep_noise_suppression_mean_opinion_score,
-    perceptual_evaluation_speech_quality,
-)
+from torchmetrics_tpu.functional.audio.dnsmos import deep_noise_suppression_mean_opinion_score
+from torchmetrics_tpu.functional.audio.external import perceptual_evaluation_speech_quality
 from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training
@@ -309,6 +307,7 @@ class PerceptualEvaluationSpeechQuality(_MeanScoreMetric):
     def __init__(
         self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any
     ) -> None:
+        kwargs.setdefault("jit_update", False)  # host callback can't trace
         super().__init__(**kwargs)
         self.fs = fs
         self.mode = mode
@@ -373,6 +372,8 @@ class SpeechReverberationModulationEnergyRatio(_MeanScoreMetric):
         fast: bool = False,
         **kwargs: Any,
     ) -> None:
+        if fast:
+            kwargs.setdefault("jit_update", False)  # srmrpy host callback can't trace
         super().__init__(**kwargs)
         from torchmetrics_tpu.functional.audio.srmr import _srmr_arg_validate
 
@@ -399,7 +400,9 @@ class SpeechReverberationModulationEnergyRatio(_MeanScoreMetric):
 
 
 class DeepNoiseSuppressionMeanOpinionScore(_MeanScoreMetric):
-    r"""DNSMOS (requires ``onnxruntime`` + the DNS-challenge model assets)."""
+    r"""DNSMOS from converted DNS-challenge ONNX checkpoints, executed as jnp graphs
+    (drop the .onnx files under ``$TORCHMETRICS_TPU_DNSMOS_DIR`` or
+    ``<repo>/weights/dnsmos`` — see ``functional/audio/dnsmos.py``)."""
 
     is_differentiable = False
     higher_is_better = True
@@ -407,12 +410,15 @@ class DeepNoiseSuppressionMeanOpinionScore(_MeanScoreMetric):
     plot_upper_bound: float = 5.0
 
     def __init__(self, fs: int, personalized: bool, **kwargs: Any) -> None:
+        # the pipeline mixes device graphs with host-side calibration (np.polyval),
+        # so the update transition cannot trace
+        kwargs.setdefault("jit_update", False)
         super().__init__(**kwargs)
         self.fs = fs
         self.personalized = personalized
 
     def update(self, preds: Array) -> None:
-        """Accumulate per-sample DNSMOS scores (host callback)."""
+        """Accumulate per-sample DNSMOS scores (all hops batched on device)."""
         self._accumulate(deep_noise_suppression_mean_opinion_score(preds, self.fs, self.personalized))
 
     def _compute_group_params(self):

@@ -61,6 +61,12 @@ def main(argv=None) -> int:
         help="transformers Flax class name (e.g. FlaxCLIPModel); default FlaxAutoModel",
     )
 
+    p_onnx = sub.add_parser(
+        "onnx-flax", help="ONNX inference graph (e.g. DNSMOS model_v8/sig_bak_ovr) -> jnp graph dir"
+    )
+    p_onnx.add_argument("onnx_path", help="path to the .onnx file")
+    p_onnx.add_argument("-o", "--out", required=True, help="output directory")
+
     args = parser.parse_args(argv)
     if args.command == "inception":
         out = convert_inception(args.checkpoint, args.out)
@@ -68,6 +74,11 @@ def main(argv=None) -> int:
     elif args.command == "lpips-backbone":
         out = convert_lpips_backbone(args.checkpoint, args.net, args.out or f"{args.net}.npz")
         manifest_anchor = os.path.dirname(os.path.abspath(out))
+    elif args.command == "onnx-flax":
+        from torchmetrics_tpu.convert.onnx_flax import convert_onnx_flax
+
+        out = convert_onnx_flax(args.onnx_path, args.out)
+        manifest_anchor = os.path.abspath(out)
     else:
         out = convert_hf_flax(args.model_path, args.out, model_class=args.model_class)
         manifest_anchor = os.path.abspath(out)  # manifest lives inside the output dir

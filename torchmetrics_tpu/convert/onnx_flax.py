@@ -1,0 +1,299 @@
+"""ONNX graph → JAX: convert parsed graphs to npz+json artifacts and execute in jnp.
+
+Reference parity target: ``functional/audio/dnsmos.py`` runs the DNSMOS ONNX
+checkpoints through ``onnxruntime`` on the host. Here a converted graph executes
+as pure jnp ops — jittable, fusible, TPU-resident. The executor covers the op
+subset that small keras/tf-exported CNN scoring heads use; an unsupported op
+raises with its name so the table is one function away from extension.
+
+Shape plumbing: ONNX graphs from keras exports compute reshape targets through
+``Shape → Gather → Concat`` chains. Those must stay *concrete* under ``jit``, so
+ops whose inputs are all host numpy arrays evaluate in numpy; only tensor math on
+device arrays traces into the jaxpr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from torchmetrics_tpu.convert.onnx_reader import parse_onnx
+
+GRAPH_NAME = "graph.json"
+PARAMS_NAME = "params.npz"
+
+
+def convert_onnx_flax(onnx_path: str, out_dir: str) -> str:
+    """Convert an ONNX file to a ``graph.json`` + ``params.npz`` directory."""
+    from torchmetrics_tpu.convert import _record_manifest, sha256_file
+
+    graph = parse_onnx(onnx_path)
+    os.makedirs(out_dir, exist_ok=True)
+    params_path = os.path.join(out_dir, PARAMS_NAME)
+    np.savez(params_path, **graph["initializers"])
+    spec = {k: graph[k] for k in ("nodes", "inputs", "outputs", "name")}
+    # tensor-valued attributes (Constant nodes) move into the params file
+    consts = {}
+    for i, node in enumerate(spec["nodes"]):
+        for k, v in list(node["attrs"].items()):
+            if isinstance(v, np.ndarray):
+                key = f"__attr_{i}_{k}"
+                consts[key] = v
+                node["attrs"][k] = {"__tensor__": key}
+    if consts:
+        np.savez(params_path, **graph["initializers"], **consts)
+    graph_path = os.path.join(out_dir, GRAPH_NAME)
+    with open(graph_path, "w") as fh:
+        json.dump(spec, fh)
+    _record_manifest(
+        os.path.join(out_dir, PARAMS_NAME),
+        {
+            "kind": "onnx-flax",
+            "source": os.path.abspath(onnx_path),
+            "source_sha256": sha256_file(onnx_path),
+            "output_sha256": sha256_file(params_path),
+            "ops": sorted({n["op"] for n in spec["nodes"]}),
+        },
+    )
+    return out_dir
+
+
+def load_onnx_graph(model_dir: str):
+    """Load a converted directory -> (spec dict, params dict of numpy arrays)."""
+    with open(os.path.join(model_dir, GRAPH_NAME)) as fh:
+        spec = json.load(fh)
+    with np.load(os.path.join(model_dir, PARAMS_NAME)) as z:
+        params = {k: z[k] for k in z.files}
+    for node in spec["nodes"]:
+        for k, v in list(node["attrs"].items()):
+            if isinstance(v, dict) and "__tensor__" in v:
+                node["attrs"][k] = params.pop(v["__tensor__"])
+    return spec, params
+
+
+def _all_host(values) -> bool:
+    return all(isinstance(v, np.ndarray) or np.isscalar(v) for v in values)
+
+
+def _pool_dims(x, kernel, strides, pads, reducer, init, count_include_pad):
+    """Shared 2-D pooling: ONNX pads are [d1_begin, d2_begin, d1_end, d2_end]."""
+    rank = len(kernel)
+    pads = pads or [0] * (2 * rank)
+    strides = strides or [1] * rank
+    window = (1, 1, *kernel)
+    stride = (1, 1, *strides)
+    padding = ((0, 0), (0, 0)) + tuple((pads[i], pads[i + rank]) for i in range(rank))
+    out = lax.reduce_window(x, init, reducer, window, stride, padding)
+    if reducer is lax.add:  # average pool
+        if count_include_pad:
+            denom = float(np.prod(kernel))
+            return out / denom
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, stride, padding)
+        return out / counts
+    return out
+
+
+def _conv(x, w, b, attrs):
+    rank = w.ndim - 2
+    strides = attrs.get("strides") or [1] * rank
+    dilations = attrs.get("dilations") or [1] * rank
+    group = int(attrs.get("group") or 1)
+    pads = attrs.get("pads")
+    auto_pad = attrs.get("auto_pad") or "NOTSET"
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        padding = "SAME"
+    elif pads:
+        padding = tuple((pads[i], pads[i + rank]) for i in range(rank))
+    else:
+        padding = "VALID"
+    spec = ("NCHW", "OIHW", "NCHW") if rank == 2 else ("NCH", "OIH", "NCH")
+    out = lax.conv_general_dilated(
+        x, jnp.asarray(w), tuple(strides), padding,
+        rhs_dilation=tuple(dilations), dimension_numbers=spec, feature_group_count=group,
+    )
+    if b is not None:
+        out = out + jnp.asarray(b).reshape((1, -1) + (1,) * rank)
+    return out
+
+
+def _gemm(a, b, c, attrs):
+    alpha = attrs.get("alpha", 1.0) or 1.0
+    beta = attrs.get("beta", 1.0) or 1.0
+    if attrs.get("transA"):
+        a = a.T
+    if attrs.get("transB"):
+        b = b.T
+    out = alpha * (a @ b)
+    if c is not None:
+        out = out + beta * c
+    return out
+
+
+def _slice_op(data, ins, attrs):
+    if len(ins) > 1:  # opset >= 10: starts/ends/axes/steps are inputs
+        starts = np.asarray(ins[1]).tolist()
+        ends = np.asarray(ins[2]).tolist()
+        axes = np.asarray(ins[3]).tolist() if len(ins) > 3 and ins[3] is not None else list(range(len(starts)))
+        steps = np.asarray(ins[4]).tolist() if len(ins) > 4 and ins[4] is not None else [1] * len(starts)
+    else:  # opset 1: attributes
+        starts = attrs["starts"]
+        ends = attrs["ends"]
+        axes = attrs.get("axes") or list(range(len(starts)))
+        steps = [1] * len(starts)
+    slices = [slice(None)] * data.ndim
+    for s, e, ax, st in zip(starts, ends, axes, steps):
+        dim = data.shape[ax]
+        e = min(e, dim) if e >= 0 else e  # ONNX clamps INT64_MAX-style ends
+        slices[int(ax)] = slice(int(s), int(e), int(st))
+    return data[tuple(slices)]
+
+
+_CAST_DTYPES = {1: jnp.float32, 6: jnp.int32, 7: jnp.int64, 9: jnp.bool_, 10: jnp.float16, 11: jnp.float64}
+
+
+def run_graph(spec: Dict[str, Any], params: Dict[str, np.ndarray], inputs: Dict[str, Any]) -> List[Any]:
+    """Execute the graph on ``inputs``; returns the list of graph outputs.
+
+    Host-concrete subgraphs (all-numpy inputs) evaluate in numpy so reshape
+    targets and axes stay static under jit; tensor math runs in jnp.
+    """
+    env: Dict[str, Any] = {"": None}
+    env.update(params)
+    env.update(inputs)
+
+    for node in spec["nodes"]:
+        op = node["op"]
+        attrs = node["attrs"]
+        ins = [env[name] for name in node["inputs"]]
+        host = _all_host(ins)
+        xp = np if host else jnp
+        x = ins[0] if ins else None
+
+        if op in ("Relu",):
+            out = xp.maximum(x, 0)
+        elif op == "Sigmoid":
+            out = 1.0 / (1.0 + xp.exp(-x))
+        elif op == "Tanh":
+            out = xp.tanh(x)
+        elif op == "Softmax":
+            ax = int(attrs.get("axis", -1))
+            e = xp.exp(x - xp.max(x, axis=ax, keepdims=True))
+            out = e / xp.sum(e, axis=ax, keepdims=True)
+        elif op == "LeakyRelu":
+            out = xp.where(x >= 0, x, x * attrs.get("alpha", 0.01))
+        elif op == "Exp":
+            out = xp.exp(x)
+        elif op == "Sqrt":
+            out = xp.sqrt(x)
+        elif op == "Pow":
+            out = x ** ins[1]
+        elif op == "Clip":
+            lo = ins[1] if len(ins) > 1 and ins[1] is not None else attrs.get("min")
+            hi = ins[2] if len(ins) > 2 and ins[2] is not None else attrs.get("max")
+            out = xp.clip(x, lo, hi)
+        elif op == "Add":
+            out = x + ins[1]
+        elif op == "Sub":
+            out = x - ins[1]
+        elif op == "Mul":
+            out = x * ins[1]
+        elif op == "Div":
+            out = x / ins[1]
+        elif op == "MatMul":
+            out = x @ ins[1]
+        elif op == "Gemm":
+            out = _gemm(x, ins[1], ins[2] if len(ins) > 2 else None, attrs)
+        elif op == "Conv":
+            out = _conv(x, ins[1], ins[2] if len(ins) > 2 else None, attrs)
+        elif op == "MaxPool":
+            out = _pool_dims(x, attrs["kernel_shape"], attrs.get("strides"), attrs.get("pads"),
+                             lax.max, -jnp.inf, False)
+        elif op == "AveragePool":
+            out = _pool_dims(x, attrs["kernel_shape"], attrs.get("strides"), attrs.get("pads"),
+                             lax.add, 0.0, bool(attrs.get("count_include_pad")))
+        elif op == "GlobalAveragePool":
+            out = jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+        elif op == "GlobalMaxPool":
+            out = jnp.max(x, axis=tuple(range(2, x.ndim)), keepdims=True)
+        elif op == "BatchNormalization":
+            scale, bias, mean, var = ins[1], ins[2], ins[3], ins[4]
+            eps = attrs.get("epsilon", 1e-5)
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            out = (x - mean.reshape(shape)) / xp.sqrt(var.reshape(shape) + eps)
+            out = out * scale.reshape(shape) + bias.reshape(shape)
+        elif op == "Reshape":
+            target = [int(v) for v in np.asarray(ins[1]).tolist()]
+            target = [x.shape[i] if v == 0 else v for i, v in enumerate(target)]
+            out = x.reshape(target)
+        elif op == "Transpose":
+            perm = attrs.get("perm") or list(range(x.ndim))[::-1]
+            out = xp.transpose(x, perm)
+        elif op == "Flatten":
+            ax = int(attrs.get("axis", 1))
+            out = x.reshape((int(np.prod(x.shape[:ax])) or 1, -1))
+        elif op == "Squeeze":
+            axes = attrs.get("axes") or (np.asarray(ins[1]).tolist() if len(ins) > 1 else None)
+            out = xp.squeeze(x, axis=tuple(int(a) for a in axes) if axes else None)
+        elif op == "Unsqueeze":
+            axes = attrs.get("axes") or np.asarray(ins[1]).tolist()
+            out = x
+            for a in sorted(int(v) for v in axes):
+                out = xp.expand_dims(out, a)
+        elif op == "Concat":
+            out = xp.concatenate(ins, axis=int(attrs.get("axis", 0)))
+        elif op == "Slice":
+            out = _slice_op(x, ins, attrs)
+        elif op == "Gather":
+            out = xp.take(x, np.asarray(ins[1]) if host else ins[1], axis=int(attrs.get("axis", 0)))
+        elif op == "Shape":
+            out = np.asarray(x.shape, dtype=np.int64)
+        elif op == "Cast":
+            out = x.astype(_CAST_DTYPES.get(int(attrs["to"]), jnp.float32))
+        elif op == "ReduceMean":
+            axes = attrs.get("axes")
+            out = xp.mean(x, axis=tuple(int(a) for a in axes) if axes else None,
+                          keepdims=bool(attrs.get("keepdims", 1)))
+        elif op == "Pad":
+            mode = attrs.get("mode") or "constant"
+            if mode != "constant":
+                raise NotImplementedError(
+                    f"ONNX Pad mode {mode!r} (node {node['name']!r}) is not supported"
+                    " — extend run_graph in torchmetrics_tpu/convert/onnx_flax.py"
+                )
+            pads = attrs.get("pads") or np.asarray(ins[1]).tolist()
+            fill = attrs.get("value", 0.0)
+            if len(ins) > 2 and ins[2] is not None:
+                fill = float(np.asarray(ins[2]).reshape(-1)[0])
+            rank = x.ndim
+            width = [(int(pads[i]), int(pads[i + rank])) for i in range(rank)]
+            out = xp.pad(x, width, constant_values=fill)
+        elif op in ("Identity", "Dropout"):
+            out = x
+        elif op == "Constant":
+            val = attrs.get("value")
+            out = np.asarray(val)
+        elif op == "ConstantOfShape":
+            val = attrs.get("value")
+            fill = float(np.asarray(val).reshape(-1)[0]) if val is not None else 0.0
+            out = np.full([int(v) for v in np.asarray(x).tolist()], fill, dtype=np.float32)
+        elif op == "Expand":
+            out = xp.broadcast_to(x, [int(v) for v in np.asarray(ins[1]).tolist()])
+        else:
+            raise NotImplementedError(
+                f"ONNX op {op!r} (node {node['name']!r}) is not in the converter's op"
+                " table — extend run_graph in torchmetrics_tpu/convert/onnx_flax.py"
+            )
+
+        outputs = node["outputs"]
+        env[outputs[0]] = out
+        for extra in outputs[1:]:  # e.g. Dropout's mask output — never consumed here
+            env[extra] = None
+
+    return [env[name] for name in spec["outputs"]]

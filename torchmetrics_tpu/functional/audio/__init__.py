@@ -3,10 +3,8 @@
 Parity: reference ``src/torchmetrics/functional/audio/__init__.py``.
 """
 
-from torchmetrics_tpu.functional.audio.external import (
-    deep_noise_suppression_mean_opinion_score,
-    perceptual_evaluation_speech_quality,
-)
+from torchmetrics_tpu.functional.audio.dnsmos import deep_noise_suppression_mean_opinion_score
+from torchmetrics_tpu.functional.audio.external import perceptual_evaluation_speech_quality
 from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
 from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
 from torchmetrics_tpu.functional.audio.pit import permutation_invariant_training, pit_permutate

@@ -138,24 +138,5 @@ def _srmr_srmrpy(
     return jnp.asarray(vals, dtype=jnp.float32).reshape(preds_np.shape[:-1])
 
 
-def deep_noise_suppression_mean_opinion_score(
-    preds: Array,
-    fs: int,
-    personalized: bool,
-    device: Optional[str] = None,
-    num_threads: Optional[int] = None,
-) -> Array:
-    """Compute DNSMOS via Microsoft's ONNX models (host callback).
-
-    Raises:
-        ModuleNotFoundError: If ``onnxruntime`` (and the model assets) are not available.
-    """
-    if not _ONNXRUNTIME_AVAILABLE:
-        raise ModuleNotFoundError(
-            "DNSMOS metric requires that `onnxruntime` is installed."
-            " Install it with `pip install onnxruntime`."
-        )
-    raise ModuleNotFoundError(
-        "DNSMOS additionally requires the Microsoft DNS-challenge ONNX model assets, which are"
-        " not bundled in this environment."
-    )
+# DNSMOS runs natively from converted ONNX checkpoints — see
+# ``torchmetrics_tpu/functional/audio/dnsmos.py`` (no onnxruntime needed).

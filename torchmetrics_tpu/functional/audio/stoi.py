@@ -84,10 +84,13 @@ def _resample_filter(up: int, down: int) -> np.ndarray:
     return (h * up).astype(np.float32)
 
 
-def _resample_to_10k(x: Array, fs: int) -> Array:
-    """Polyphase resample (B, T) -> (B, ceil(T*up/down)) via one dilated strided conv."""
-    g = math.gcd(_FS, fs)
-    up, down = _FS // g, fs // g
+def resample_poly(x: Array, fs_in: int, fs_out: int) -> Array:
+    """Polyphase resample (B, T) -> (B, ceil(T*up/down)) via one dilated strided conv.
+
+    Shared by STOI (→10 kHz) and DNSMOS (→16 kHz).
+    """
+    g = math.gcd(fs_out, fs_in)
+    up, down = fs_out // g, fs_in // g
     h = jnp.asarray(_resample_filter(up, down))
     n_in = x.shape[-1]
     n_out = -(-n_in * up // down)
@@ -107,6 +110,10 @@ def _resample_to_10k(x: Array, fs: int) -> Array:
         dimension_numbers=("NCH", "OIH", "NCH"),
     )
     return out[:, 0, :n_out]
+
+
+def _resample_to_10k(x: Array, fs: int) -> Array:
+    return resample_poly(x, fs, _FS)
 
 
 def _frame_signal(x: Array, framelen: int, hop: int, n_frames: int) -> Array:
