@@ -25,7 +25,7 @@ def _np_melspec_db(x: np.ndarray) -> np.ndarray:
     n_fft, hop, n_mels, sr = 321, 160, 120, 16000
     pad = n_fft // 2
     out = []
-    win = np.hanning(n_fft)
+    win = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)  # periodic hann (librosa fftbins=True)
     fb = dnsmos_mod._mel_filterbank(sr, n_fft, n_mels)
     k = np.arange(n_fft // 2 + 1)[:, None] * np.arange(n_fft)[None, :]
     dft = np.exp(-2j * np.pi * k / n_fft)  # explicit DFT matrix, not np.fft

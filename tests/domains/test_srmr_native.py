@@ -199,6 +199,84 @@ class TestFilterDesignProperties:
             assert lower_edge == pytest.approx(cutoffs[k], rel=0.05)
 
 
+class TestFastGammatonegram:
+    def test_fast_matches_numpy_golden(self):
+        """fast=True: spectrogram + fft-weights matmul vs a straight numpy build."""
+        fs = 8000
+        rng = np.random.RandomState(11)
+        x = _speechlike(rng, fs) + 0.05 * rng.randn(fs).astype(np.float32)
+        got = float(np.asarray(
+            speech_reverberation_modulation_energy_ratio(jnp.asarray(x), fs, fast=True)
+        ).squeeze())
+
+        # numpy golden: same published pipeline, independent compute path
+        xn = x / max(np.abs(x).max(), 1.0)
+        nfft = int(2 ** np.ceil(np.log2(2 * 0.010 * fs)))
+        nwin, nhop = round(0.010 * fs), round(0.0025 * fs)
+        n_frames = (xn.size - (nwin - nhop)) // nhop
+        win = np.hanning(nwin + 2)[1:-1]
+        frames = np.stack([xn[i * nhop : i * nhop + nwin] * win for i in range(n_frames)])
+        mag = np.abs(np.fft.rfft(frames, n=nfft, axis=-1))
+        wts = srmr_mod._fft_gt_weights(fs, nfft, 23, 125.0)
+        env = (wts @ mag.T) / nfft  # [23, frames]
+
+        from scipy.signal import lfilter
+
+        mfs = 400
+        spacing = (128.0 / 4.0) ** (1.0 / 7)
+        mod_cfs = 4.0 * spacing ** np.arange(8)
+        w0 = 2 * np.pi * mod_cfs / mfs
+        W0 = np.tan(w0 / 2)
+        b0 = W0 / 2
+        cutoffs = mod_cfs - b0 * mfs / (2 * np.pi)
+        mod = np.stack(
+            [
+                lfilter([b0[m], 0, -b0[m]], [1 + b0[m] + W0[m] ** 2, 2 * W0[m] ** 2 - 2, 1 - b0[m] + W0[m] ** 2], env, axis=-1)
+                for m in range(8)
+            ],
+            axis=1,
+        )  # [23, 8, frames]
+        import math as _math
+
+        w_length, w_inc = _math.ceil(0.256 * mfs), _math.ceil(0.064 * mfs)
+        t = mod.shape[-1]
+        nfr = max(int(1 + (t - w_length) // w_inc), 1)
+        pad = max(_math.ceil(t / w_inc) * w_inc - t, w_length - t)
+        mod = np.pad(mod, ((0, 0), (0, 0), (0, pad)))
+        w = np.hamming(w_length + 1)[:-1]
+        energy = np.stack(
+            [((mod[:, :, f * w_inc : f * w_inc + w_length] * w) ** 2).sum(-1) for f in range(nfr)], axis=-1
+        )
+        avg = energy.mean(-1)
+        ac_perc = avg.sum(1) * 100 / avg.sum()
+        cum = np.cumsum(ac_perc[::-1])
+        k90 = int(np.argmax(cum > 90))
+        bw = srmr_mod._erbs(fs, 23, 125.0)[::-1][k90]
+        kstar = 5 + int(bw >= cutoffs[5]) + int(bw >= cutoffs[6]) + int(bw >= cutoffs[7])
+        want = float(avg[:, :4].sum() / avg[:, 4:kstar].sum())
+        assert got == pytest.approx(want, rel=5e-3)
+
+    def test_fast_weights_peak_at_centre_freqs(self):
+        fs = 8000
+        nfft = 256
+        wts = srmr_mod._fft_gt_weights(fs, nfft, 23, 125.0)
+        freqs = np.fft.rfftfreq(nfft, 1.0 / fs)
+        cfs = srmr_mod._centre_freqs(fs, 23, 125.0)
+        peak = freqs[np.argmax(wts, axis=-1)]
+        # bin resolution is fs/nfft = 31 Hz; peaks land on the nearest bin
+        assert np.all(np.abs(peak - cfs) <= fs / nfft)
+
+    def test_fast_jits(self):
+        rng = np.random.RandomState(12)
+        x = jnp.asarray(rng.randn(2, 8000).astype(np.float32))
+        fn = jax.jit(lambda v: speech_reverberation_modulation_energy_ratio(v, 8000, fast=True))
+        np.testing.assert_allclose(
+            np.asarray(fn(x)),
+            np.asarray(speech_reverberation_modulation_energy_ratio(x, 8000, fast=True)),
+            rtol=1e-5,
+        )
+
+
 class TestProperties:
     def test_reverberation_lowers_score(self):
         """The metric's defining property: reverberant speech scores lower."""

@@ -345,9 +345,9 @@ class ShortTimeObjectiveIntelligibility(_MeanScoreMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_MeanScoreMetric):
-    r"""SRMR, computed natively on device (reference ``audio/srmr.py:36-164`` needs
-    the external ``gammatone`` + ``torchaudio``; ``fast=True`` here delegates to the
-    optional ``srmrpy`` host callback).
+    r"""SRMR, computed natively on device — both the full filterbank path and the
+    ``fast=True`` gammatonegram path (reference ``audio/srmr.py:36-164`` needs the
+    external ``gammatone`` + ``torchaudio`` packages for either).
 
     Example:
         >>> import jax
@@ -372,8 +372,6 @@ class SpeechReverberationModulationEnergyRatio(_MeanScoreMetric):
         fast: bool = False,
         **kwargs: Any,
     ) -> None:
-        if fast:
-            kwargs.setdefault("jit_update", False)  # srmrpy host callback can't trace
         super().__init__(**kwargs)
         from torchmetrics_tpu.functional.audio.srmr import _srmr_arg_validate
 

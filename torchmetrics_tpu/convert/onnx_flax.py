@@ -36,7 +36,6 @@ def convert_onnx_flax(onnx_path: str, out_dir: str) -> str:
     graph = parse_onnx(onnx_path)
     os.makedirs(out_dir, exist_ok=True)
     params_path = os.path.join(out_dir, PARAMS_NAME)
-    np.savez(params_path, **graph["initializers"])
     spec = {k: graph[k] for k in ("nodes", "inputs", "outputs", "name")}
     # tensor-valued attributes (Constant nodes) move into the params file
     consts = {}
@@ -46,8 +45,7 @@ def convert_onnx_flax(onnx_path: str, out_dir: str) -> str:
                 key = f"__attr_{i}_{k}"
                 consts[key] = v
                 node["attrs"][k] = {"__tensor__": key}
-    if consts:
-        np.savez(params_path, **graph["initializers"], **consts)
+    np.savez(params_path, **graph["initializers"], **consts)
     graph_path = os.path.join(out_dir, GRAPH_NAME)
     with open(graph_path, "w") as fh:
         json.dump(spec, fh)
@@ -108,7 +106,16 @@ def _conv(x, w, b, attrs):
     pads = attrs.get("pads")
     auto_pad = attrs.get("auto_pad") or "NOTSET"
     if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
-        padding = "SAME"
+        # ONNX puts the odd pad sample at the END for SAME_UPPER and at the
+        # BEGINNING for SAME_LOWER; lax's "SAME" is upper-only, so build explicit
+        padding = []
+        for i in range(rank):
+            size = x.shape[2 + i]
+            eff_k = (w.shape[2 + i] - 1) * dilations[i] + 1
+            total = max(0, (-(-size // strides[i]) - 1) * strides[i] + eff_k - size)
+            small, big = total // 2, total - total // 2
+            padding.append((small, big) if auto_pad == "SAME_UPPER" else (big, small))
+        padding = tuple(padding)
     elif pads:
         padding = tuple((pads[i], pads[i + rank]) for i in range(rank))
     else:
@@ -124,8 +131,10 @@ def _conv(x, w, b, attrs):
 
 
 def _gemm(a, b, c, attrs):
-    alpha = attrs.get("alpha", 1.0) or 1.0
-    beta = attrs.get("beta", 1.0) or 1.0
+    alpha = attrs.get("alpha")
+    beta = attrs.get("beta")
+    alpha = 1.0 if alpha is None else alpha  # an explicit 0.0 must stay 0.0
+    beta = 1.0 if beta is None else beta
     if attrs.get("transA"):
         a = a.T
     if attrs.get("transB"):
@@ -197,7 +206,7 @@ def run_graph(spec: Dict[str, Any], params: Dict[str, np.ndarray], inputs: Dict[
         elif op == "Clip":
             lo = ins[1] if len(ins) > 1 and ins[1] is not None else attrs.get("min")
             hi = ins[2] if len(ins) > 2 and ins[2] is not None else attrs.get("max")
-            out = xp.clip(x, lo, hi)
+            out = x if lo is None and hi is None else xp.clip(x, lo, hi)  # boundless Clip is identity
         elif op == "Add":
             out = x + ins[1]
         elif op == "Sub":
@@ -212,12 +221,18 @@ def run_graph(spec: Dict[str, Any], params: Dict[str, np.ndarray], inputs: Dict[
             out = _gemm(x, ins[1], ins[2] if len(ins) > 2 else None, attrs)
         elif op == "Conv":
             out = _conv(x, ins[1], ins[2] if len(ins) > 2 else None, attrs)
-        elif op == "MaxPool":
-            out = _pool_dims(x, attrs["kernel_shape"], attrs.get("strides"), attrs.get("pads"),
-                             lax.max, -jnp.inf, False)
-        elif op == "AveragePool":
-            out = _pool_dims(x, attrs["kernel_shape"], attrs.get("strides"), attrs.get("pads"),
-                             lax.add, 0.0, bool(attrs.get("count_include_pad")))
+        elif op in ("MaxPool", "AveragePool"):
+            if attrs.get("ceil_mode") or (attrs.get("auto_pad") or "NOTSET") != "NOTSET":
+                raise NotImplementedError(
+                    f"ONNX {op} with ceil_mode/auto_pad (node {node['name']!r}) is not"
+                    " supported — extend run_graph in torchmetrics_tpu/convert/onnx_flax.py"
+                )
+            if op == "MaxPool":
+                out = _pool_dims(x, attrs["kernel_shape"], attrs.get("strides"), attrs.get("pads"),
+                                 lax.max, -jnp.inf, False)
+            else:
+                out = _pool_dims(x, attrs["kernel_shape"], attrs.get("strides"), attrs.get("pads"),
+                                 lax.add, 0.0, bool(attrs.get("count_include_pad")))
         elif op == "GlobalAveragePool":
             out = jnp.mean(x, axis=tuple(range(2, x.ndim)), keepdims=True)
         elif op == "GlobalMaxPool":

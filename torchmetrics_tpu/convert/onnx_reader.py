@@ -110,6 +110,9 @@ def _parse_tensor(buf: bytes) -> Tuple[str, np.ndarray]:
         raise ValueError(f"Unsupported tensor data_type {data_type} (tensor {name!r})")
     if raw is not None:
         arr = np.frombuffer(raw, dtype=np.dtype(dtype).newbyteorder("<")).astype(dtype)
+    elif data_type == 10:
+        # float16 in int32_data ships as uint16 BIT PATTERNS, not values (onnx spec)
+        arr = np.asarray(typed, dtype=np.uint16).view(np.float16)
     else:
         arr = np.asarray(typed, dtype=typed_dtype or dtype).astype(dtype)
     return name, arr.reshape([int(d) for d in dims]) if dims else arr.reshape(())

@@ -77,7 +77,8 @@ def _mel_filterbank(sr: int, n_fft: int, n_mels: int) -> np.ndarray:
 
 @functools.lru_cache(maxsize=8)
 def _hann(n: int) -> np.ndarray:
-    return np.hanning(n).astype(np.float32)  # librosa uses the symmetric window for odd n_fft
+    # librosa.stft builds its window with fftbins=True (periodic): 0.5-0.5cos(2πk/n)
+    return (0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n) / n)).astype(np.float32)
 
 
 def _melspec_db(x: Array, sr: int = SAMPLING_RATE) -> Array:

@@ -22,7 +22,6 @@ Array = jax.Array
 _PESQ_AVAILABLE = _package_available("pesq")
 _PYSTOI_AVAILABLE = _package_available("pystoi")
 _SRMRPY_AVAILABLE = _package_available("srmrpy")
-_ONNXRUNTIME_AVAILABLE = _package_available("onnxruntime")
 
 
 def perceptual_evaluation_speech_quality(
