@@ -85,4 +85,6 @@ def test_two_process_sync_battery(tmp_path):
         "f1_sharded_equals_alldata": True,
         "unbinned_prc_sharded_equals_alldata": True,
         "detection_map_sharded_equals_alldata": True,
+        "detection_segm_sharded_equals_alldata": True,
+        "empty_rank_end_to_end_prc": True,
     }

@@ -172,6 +172,45 @@ def main() -> None:
         np.testing.assert_allclose(np.asarray(got[key]), np.asarray(want[key]), atol=1e-6)
     results["detection_map_sharded_equals_alldata"] = True
 
+    # -- 11. segm mAP sharded == all-data (bit-packed mask gathers cross procs) --
+    def _segm_img(seed: int):
+        r = np.random.default_rng(seed)
+        h = w = 16
+        n_pred, n_gt = 2, 2
+        masks_p = r.random((n_pred, h, w)) > 0.6
+        masks_t = r.random((n_gt, h, w)) > 0.6
+        pred = {
+            "masks": jnp.asarray(masks_p),
+            "scores": jnp.asarray(r.random(n_pred).astype(np.float32)),
+            "labels": jnp.asarray(r.integers(0, 2, n_pred)),
+        }
+        tgt = {"masks": jnp.asarray(masks_t), "labels": jnp.asarray(r.integers(0, 2, n_gt))}
+        return pred, tgt
+
+    all_segm = [_segm_img(s) for s in range(10, 14)]
+    mine = all_segm[pid * 2 : (pid + 1) * 2]
+    dist = MeanAveragePrecision(iou_type="segm")
+    dist.update([p for p, _ in mine], [t for _, t in mine])
+    ref = MeanAveragePrecision(iou_type="segm", distributed_available_fn=lambda: False)
+    ref.update([p for p, _ in all_segm], [t for _, t in all_segm])
+    got, want = dist.compute(), ref.compute()
+    for key in ("map", "map_50", "mar_100"):
+        np.testing.assert_allclose(np.asarray(got[key]), np.asarray(want[key]), atol=1e-6)
+    results["detection_segm_sharded_equals_alldata"] = True
+
+    # -- 12. empty-rank END-TO-END: proc 1 never updates its list-state metric ----
+    # (the real-world shape of the empty-rank protocol: an imbalanced data split)
+    p_all = np.random.default_rng(42).random(30).astype(np.float32)
+    t_all = np.random.default_rng(43).integers(0, 2, 30)
+    dist = BinaryPrecisionRecallCurve(thresholds=None)  # ragged list states
+    if pid == 0:
+        dist.update(jnp.asarray(p_all), jnp.asarray(t_all))  # proc 1 saw no data
+    ref = BinaryPrecisionRecallCurve(thresholds=None, distributed_available_fn=lambda: False)
+    ref.update(jnp.asarray(p_all), jnp.asarray(t_all))
+    for got_arr, want_arr in zip(dist.compute(), ref.compute()):
+        np.testing.assert_allclose(np.asarray(got_arr), np.asarray(want_arr), atol=1e-6)
+    results["empty_rank_end_to_end_prc"] = True
+
     if pid == 0:
         with open(out_path, "w") as fh:
             json.dump(results, fh)

@@ -222,7 +222,7 @@ def run_graph(spec: Dict[str, Any], params: Dict[str, np.ndarray], inputs: Dict[
         elif op == "Conv":
             out = _conv(x, ins[1], ins[2] if len(ins) > 2 else None, attrs)
         elif op in ("MaxPool", "AveragePool"):
-            if attrs.get("ceil_mode") or (attrs.get("auto_pad") or "NOTSET") != "NOTSET":
+            if attrs.get("ceil_mode") or (attrs.get("auto_pad") or "NOTSET") not in ("NOTSET", "VALID"):
                 raise NotImplementedError(
                     f"ONNX {op} with ceil_mode/auto_pad (node {node['name']!r}) is not"
                     " supported — extend run_graph in torchmetrics_tpu/convert/onnx_flax.py"

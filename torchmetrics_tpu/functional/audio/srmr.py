@@ -79,6 +79,35 @@ def _trim_impulse(h: np.ndarray) -> np.ndarray:
     return h[:, : math.ceil(keep / 16) * 16]
 
 
+def _slaney_coefs(fs: int, n_filters: int, low_freq: float) -> dict:
+    """Slaney ERB gammatone pole/zero/gain coefficients, shared by the FIR cascade
+    and the FFT-weights (fast) path — one source for the filter-design math."""
+    cfs = _centre_freqs(fs, n_filters, low_freq)
+    T = 1.0 / fs
+    B = 1.019 * 2 * np.pi * _erbs(fs, n_filters, low_freq)
+    arg = 2 * cfs * np.pi * T
+    ebt = np.exp(B * T)
+    rt_pos = np.sqrt(3 + 2**1.5)
+    rt_neg = np.sqrt(3 - 2**1.5)
+    a11 = -(2 * T * np.cos(arg) / ebt + 2 * rt_pos * T * np.sin(arg) / ebt) / 2
+    a12 = -(2 * T * np.cos(arg) / ebt - 2 * rt_pos * T * np.sin(arg) / ebt) / 2
+    a13 = -(2 * T * np.cos(arg) / ebt + 2 * rt_neg * T * np.sin(arg) / ebt) / 2
+    a14 = -(2 * T * np.cos(arg) / ebt - 2 * rt_neg * T * np.sin(arg) / ebt) / 2
+    z = np.exp(4j * cfs * np.pi * T)
+    zb = np.exp(-(B * T) + 2j * cfs * np.pi * T)
+    gain = np.abs(
+        (-2 * z * T + 2 * zb * T * (np.cos(arg) - rt_neg * np.sin(arg)))
+        * (-2 * z * T + 2 * zb * T * (np.cos(arg) + rt_neg * np.sin(arg)))
+        * (-2 * z * T + 2 * zb * T * (np.cos(arg) - rt_pos * np.sin(arg)))
+        * (-2 * z * T + 2 * zb * T * (np.cos(arg) + rt_pos * np.sin(arg)))
+        / (-2 / np.exp(2 * B * T) - 2 * z + 2 * (1 + z) / ebt) ** 4
+    )
+    return {
+        "cfs": cfs, "T": T, "B": B, "arg": arg, "ebt": ebt,
+        "a11": a11, "a12": a12, "a13": a13, "a14": a14, "gain": gain,
+    }
+
+
 @functools.lru_cache(maxsize=32)
 def _gammatone_fir(fs: int, n_filters: int, low_freq: float) -> np.ndarray:
     """Impulse responses [n_filters, L] of the Slaney ERB gammatone cascade.
@@ -87,29 +116,11 @@ def _gammatone_fir(fs: int, n_filters: int, low_freq: float) -> np.ndarray:
     ``_erb_filterbank`` (4 biquad sections sharing one denominator, divided by the
     analytic gain), evaluated here once on host to produce an FIR for FFT conv.
     """
-    cfs = _centre_freqs(fs, n_filters, low_freq)
-    T = 1.0 / fs
-    B = 1.019 * 2 * np.pi * _erbs(fs, n_filters, low_freq)
-    arg = 2 * cfs * np.pi * T
-    ebt = np.exp(B * T)
-    rt_pos = np.sqrt(3 + 2**1.5)
-    rt_neg = np.sqrt(3 - 2**1.5)
+    c = _slaney_coefs(fs, n_filters, low_freq)
+    T, B, arg, ebt = c["T"], c["B"], c["arg"], c["ebt"]
+    a11, a12, a13, a14, gain = c["a11"], c["a12"], c["a13"], c["a14"], c["gain"]
     a0, a2 = T, 0.0
     b0, b1, b2 = 1.0, -2 * np.cos(arg) / ebt, np.exp(-2 * B * T)
-    a11 = -(2 * T * np.cos(arg) / ebt + 2 * rt_pos * T * np.sin(arg) / ebt) / 2
-    a12 = -(2 * T * np.cos(arg) / ebt - 2 * rt_pos * T * np.sin(arg) / ebt) / 2
-    a13 = -(2 * T * np.cos(arg) / ebt + 2 * rt_neg * T * np.sin(arg) / ebt) / 2
-    a14 = -(2 * T * np.cos(arg) / ebt - 2 * rt_neg * T * np.sin(arg) / ebt) / 2
-    i = 1j
-    z = np.exp(4 * i * cfs * np.pi * T)
-    zb = np.exp(-(B * T) + 2 * i * cfs * np.pi * T)
-    gain = np.abs(
-        (-2 * z * T + 2 * zb * T * (np.cos(arg) - rt_neg * np.sin(arg)))
-        * (-2 * z * T + 2 * zb * T * (np.cos(arg) + rt_neg * np.sin(arg)))
-        * (-2 * z * T + 2 * zb * T * (np.cos(arg) - rt_pos * np.sin(arg)))
-        * (-2 * z * T + 2 * zb * T * (np.cos(arg) + rt_pos * np.sin(arg)))
-        / (-2 / np.exp(2 * B * T) - 2 * z + 2 * (1 + z) / ebt) ** 4
-    )
     length = max(int(0.25 * fs), 64)
     impulse = np.zeros(length, dtype=np.float64)
     impulse[0] = 1.0
@@ -207,6 +218,11 @@ def _fft_gtgram(x: Array, fs: int, n_filters: int, low_freq: float) -> Array:
     nwin = int(round(window_time * fs))
     nhop = int(round(hop_time * fs))
     t = x.shape[-1]
+    if t < nwin:
+        raise ValueError(
+            f"SRMR fast=True needs at least one {window_time * 1e3:.0f} ms spectrogram window"
+            f" ({nwin} samples at fs={fs}), got {t} samples"
+        )
     n_frames = (t - (nwin - nhop)) // nhop
     idx = np.arange(n_frames)[:, None] * nhop + np.arange(nwin)[None, :]
     frames = x[..., idx] * jnp.asarray(_matlab_hanning(nwin))  # [B, frames, nwin]
