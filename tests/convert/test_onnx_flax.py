@@ -127,6 +127,33 @@ class TestExecutorVsOracle:
         out = fn(x)
         assert out.shape == (2, 12)
 
+    def test_optional_none_input_keeps_host_path_under_jit(self):
+        """Regression: a `Clip` with only a min bound carries ONNX's empty-string
+        (→ None) optional input. None must not force the device path, or a
+        host-concrete shape-plumbing subgraph traces into the jaxpr and a later
+        Reshape sees a tracer target."""
+        model = _model(
+            [
+                _node("Shape", ["x"], ["sh"]),
+                _node("Gather", ["sh", "idx0"], ["n"], axis=0),
+                _node("Clip", ["n", "lo", ""], ["ncl"]),  # host ints, absent max
+                _node("Unsqueeze", ["ncl"], ["n1"], axes=[0]),
+                _node("Concat", ["n1", "minus1"], ["target"], axis=0),
+                _node("Reshape", ["x", "target"], ["out"]),
+            ],
+            {
+                "idx0": np.asarray(0, np.int64),
+                "lo": np.asarray(1, np.int64),
+                "minus1": np.asarray([-1], np.int64),
+            },
+            ["x"], ["out"],
+        )
+        g = parse_onnx(model)
+        x = jnp.arange(24, dtype=jnp.float32).reshape(2, 3, 4)
+        fn = jax.jit(lambda v: run_graph(g, g["initializers"], {"x": v})[0])
+        out = fn(x)
+        assert out.shape == (2, 12)
+
     def test_elementwise_pool_norm_ops(self):
         rng = np.random.RandomState(1)
         x = rng.randn(1, 2, 6, 6).astype(np.float32)

@@ -1,0 +1,713 @@
+"""Fault-tolerance layer under deterministic injected faults.
+
+Everything here is CPU-only, deterministic, and fast: no network (fetchers are
+in-memory fakes), no real sleeps (retry ``sleep`` is injected and recorded; the
+only genuine wait is an injected collective "hang" parking on a millisecond
+test-chosen timeout), no randomness beyond fixed-seed numpy.
+
+Covers the acceptance criteria of the robustness PR:
+- a NaN burst under ``warn_skip`` leaves accumulated state equal to the
+  clean-batches-only run and increments ``updates_skipped``;
+- an injected hanging/raising eager collective degrades to local-only compute
+  with a warning and ``sync_degraded=True`` instead of hanging;
+- a truncated download is retried with (recorded, deterministic) backoff; a
+  corrupted cache file is detected, purged, and refetched;
+- with no policy configured, behavior is the legacy one (NaNs flow through,
+  exceptions propagate, state_dict has no extra keys).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+import torchmetrics_tpu.parallel.sync as sync_mod
+from torchmetrics_tpu import robust
+from torchmetrics_tpu.aggregation import CatMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.robust import faults
+from torchmetrics_tpu.robust.degraded import CollectiveError
+from torchmetrics_tpu.robust.policy import ErrorPolicy, UpdateGuardError
+from torchmetrics_tpu.robust.retry import (
+    ResourceIntegrityError,
+    RetryError,
+    RetrySchedule,
+    fetch_resource,
+    load_with_cache_recovery,
+    retry_call,
+)
+
+pytestmark = pytest.mark.faults
+
+rng = np.random.RandomState(31)
+
+
+def _mse_batches(n=5):
+    return [
+        (jnp.asarray(rng.rand(8).astype(np.float32)), jnp.asarray(rng.rand(8).astype(np.float32)))
+        for _ in range(n)
+    ]
+
+
+# ---------------------------------------------------------------- update guards
+
+
+class TestUpdateGuards:
+    def test_nan_burst_warn_skip_equals_clean_run(self):
+        batches = _mse_batches(5)
+        bad = {1, 3}
+
+        clean = MeanSquaredError()
+        for i, b in enumerate(batches):
+            if i not in bad:
+                clean.update(*b)
+
+        guarded = MeanSquaredError(error_policy="warn_skip")
+        with faults.inject_nan_updates(indices=bad):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                for b in batches:
+                    guarded.update(*b)
+
+        np.testing.assert_allclose(
+            np.asarray(guarded.compute()), np.asarray(clean.compute()), atol=0
+        )
+        assert guarded.updates_skipped == 2
+        assert guarded.updates_ok == 3
+        assert guarded.update_count == 3
+        assert guarded.last_update_ok  # last batch was clean
+        assert sum("skipped" in str(w.message) for w in caught) == 2
+
+    def test_global_policy_scope(self):
+        m = MeanSquaredError()  # no per-metric policy
+        with robust.error_policy("warn_skip"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        assert m.updates_skipped == 1 and m.update_count == 0
+        # outside the scope the legacy path is back: NaN flows into state
+        m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        assert m.updates_ok == 1
+        assert np.isnan(np.asarray(m.compute()))
+
+    def test_quarantine_retains_host_batch(self):
+        m = MeanSquaredError(error_policy="quarantine")
+        good = (jnp.ones(4), jnp.zeros(4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(*good)
+            m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        assert m.updates_quarantined == 1 and m.updates_ok == 1
+        (rec,) = m.quarantined_batches
+        assert "non-finite" in rec["reason"]
+        assert isinstance(rec["args"][0], np.ndarray) and np.isnan(rec["args"][0]).all()
+        np.testing.assert_allclose(np.asarray(m.compute()), 1.0, atol=0)
+        m.clear_quarantine()
+        assert m.quarantined_batches == []
+
+    def test_exception_inside_update_skipped_and_rolled_back(self):
+        m = MulticlassAccuracy(num_classes=3, error_policy="warn_skip")
+        m.update(jnp.asarray(rng.rand(8, 3).astype(np.float32)), jnp.asarray(rng.randint(0, 3, 8)))
+        before = np.asarray(m.compute())
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.asarray(rng.rand(8, 5).astype(np.float32)), jnp.asarray(rng.randint(0, 3, 8)))
+        assert m.updates_skipped == 1 and m.update_count == 1
+        np.testing.assert_allclose(np.asarray(m.compute()), before, atol=0)
+
+    def test_list_state_rollback(self):
+        """Ragged list states mutate in place via append — rollback must undo it."""
+        m = CatMetric(error_policy="warn_skip")
+        m.update(jnp.asarray([1.0, 2.0]))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.asarray([jnp.nan, 4.0]))
+        np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0], atol=0)
+        assert m.updates_skipped == 1
+
+    def test_raise_policy_detects_nonfinite(self):
+        m = MeanSquaredError(error_policy="raise")
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with pytest.raises(UpdateGuardError, match="non-finite"):
+            m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        # state rolled back: the failed batch contributes nothing
+        assert m.update_count == 1 and not m.last_update_ok
+        np.testing.assert_allclose(np.asarray(m.compute()), 1.0, atol=0)
+
+    def test_default_policy_is_legacy(self):
+        """No policy configured: NaNs flow through, exceptions propagate, no extra
+        state_dict keys — today's behavior byte-for-byte."""
+        assert robust.get_error_policy() is None
+        m = MeanSquaredError()
+        m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        assert np.isnan(np.asarray(m.compute()))
+        m2 = MulticlassAccuracy(num_classes=3)
+        with pytest.raises(Exception):
+            m2.update(jnp.asarray(rng.rand(8, 5).astype(np.float32)), jnp.asarray(rng.randint(0, 3, 8)))
+        sd = MeanSquaredError().state_dict(persistent_only=False)
+        assert all(not k.startswith("__robust__") for k in sd)
+
+    def test_forward_skips_bad_batch(self):
+        batches = _mse_batches(3)
+        clean = MeanSquaredError()
+        for i, b in enumerate(batches):
+            if i != 1:
+                clean(*b)
+        guarded = MeanSquaredError(error_policy="warn_skip")
+        with faults.inject_nan_updates(indices={1}):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                for b in batches:
+                    guarded(*b)
+        np.testing.assert_allclose(
+            np.asarray(guarded.compute()), np.asarray(clean.compute()), atol=0
+        )
+        assert guarded.updates_skipped == 1 and guarded.update_count == 2
+
+    def test_forward_raise_policy_restores_global_state(self):
+        m = MeanSquaredError(error_policy="raise")
+        m(jnp.ones(4), jnp.zeros(4))
+        with pytest.raises(UpdateGuardError):
+            m(jnp.full(4, jnp.nan), jnp.zeros(4))
+        # the failed forward must not strand the fresh batch state
+        assert m.update_count == 1
+        np.testing.assert_allclose(np.asarray(m.compute()), 1.0, atol=0)
+
+    def test_forward_skip_on_list_state_metric_returns_none_and_keeps_state(self):
+        """A skipped forward batch on a ragged-list-state metric must not compute on
+        the empty batch state (which raises) nor lose the accumulated global state."""
+        from torchmetrics_tpu.regression import SpearmanCorrCoef
+
+        m = SpearmanCorrCoef(error_policy="warn_skip")
+        p = jnp.asarray(rng.rand(8).astype(np.float32))
+        t = jnp.asarray(rng.rand(8).astype(np.float32))
+        m(p, t)
+        before = np.asarray(m.compute())
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = m(jnp.full(8, jnp.nan), t)
+        assert out is None  # no batch value for a skipped batch
+        assert m.updates_skipped == 1 and m.update_count == 1
+        np.testing.assert_allclose(np.asarray(m.compute()), before, atol=0)
+
+    def test_guarded_clean_run_roundtrips_updates_ok(self):
+        """All-clean guarded runs must still serialize their counters (a resume
+        would otherwise silently zero updates_ok)."""
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(4), jnp.zeros(4))
+        m.update(jnp.ones(4), jnp.zeros(4))
+        sd = m.state_dict(persistent_only=False)
+        assert "__robust__" in sd
+        m2 = MeanSquaredError()
+        m2.load_state_dict(sd)
+        assert m2.updates_ok == 2 and m2.updates_skipped == 0 and m2.last_update_ok
+
+    def test_unguarded_raise_keeps_legacy_state_dict(self):
+        """A never-guarded metric whose update raised must NOT grow a __robust__ key
+        — the legacy wire format stays byte-for-byte."""
+        m = MulticlassAccuracy(num_classes=3)
+        with pytest.raises(Exception):
+            m.update(jnp.asarray(rng.rand(8, 5).astype(np.float32)), jnp.asarray(rng.randint(0, 3, 8)))
+        assert not m.last_update_ok
+        assert "__robust__" not in m.state_dict(persistent_only=False)
+
+    def test_counters_roundtrip_state_dict(self):
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        sd = m.state_dict(persistent_only=False)
+        assert "__robust__" in sd
+        m2 = MeanSquaredError()
+        m2.load_state_dict(sd)
+        assert m2.updates_ok == 1 and m2.updates_skipped == 1
+        assert not m2.last_update_ok
+        np.testing.assert_allclose(np.asarray(m2.compute()), np.asarray(m.compute()), atol=0)
+
+    def test_counters_roundtrip_checkpoint(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from torchmetrics_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        path = save_checkpoint(m, str(tmp_path / "ckpt"))
+        m2 = load_checkpoint(MeanSquaredError(), path)
+        assert m2.updates_skipped == 1 and m2.updates_ok == 1 and not m2.last_update_ok
+
+    def test_reset_clears_counters(self):
+        m = MeanSquaredError(error_policy="warn_skip")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        m.reset()
+        assert m.updates_skipped == 0 and m.last_update_ok and m.quarantined_batches == []
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="Invalid error policy"):
+            MeanSquaredError(error_policy="explode")
+        with pytest.raises(ValueError, match="Invalid error policy"):
+            robust.set_error_policy("explode")
+
+
+# ------------------------------------------------------------- degraded sync
+
+
+def _fake_allgather(x, tiled=False):
+    x = jnp.asarray(x)
+    return jnp.stack([x, x])  # two-host world, both hosts identical
+
+
+@pytest.fixture()
+def two_host_world(monkeypatch):
+    monkeypatch.setattr(multihost_utils, "process_allgather", _fake_allgather)
+    monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+
+
+class TestDegradedSync:
+    def test_raising_collective_degrades_to_local(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        local = np.asarray(m._state_values["sum_squared_error"])
+        with robust.sync_guard(timeout=0.2, retries=1):
+            with faults.inject_collective_fault(mode="raise", times=10):
+                with pytest.warns(RuntimeWarning, match="DEGRADED"):
+                    m.sync()
+        assert m.sync_degraded
+        assert not m._is_synced  # local-only state, not a synced snapshot
+        np.testing.assert_allclose(np.asarray(m._state_values["sum_squared_error"]), local, atol=0)
+        np.testing.assert_allclose(np.asarray(m.compute()), 1.0, atol=0)  # local-only value
+
+    def test_hanging_collective_times_out_and_degrades(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with robust.sync_guard(timeout=0.01, retries=1):
+            with faults.inject_collective_fault(mode="hang", times=10):
+                with pytest.warns(RuntimeWarning, match="DEGRADED"):
+                    m.sync()
+        assert m.sync_degraded
+
+    def test_transient_failure_recovers_on_retry(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with robust.sync_guard(timeout=0.5, retries=1):
+            with faults.inject_collective_fault(mode="raise", times=1):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    m.sync()
+        assert not m.sync_degraded and m._is_synced
+        # two identical fake hosts -> SUM state doubles
+        np.testing.assert_allclose(np.asarray(m._state_values["sum_squared_error"]), 8.0, atol=0)
+        m.unsync()
+
+    def test_sync_flag_clears_on_success(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with robust.sync_guard(timeout=0.2, retries=0):
+            with faults.inject_collective_fault(mode="raise", times=1):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    m.sync()
+            assert m.sync_degraded
+            m.sync()  # fault exhausted: this one succeeds
+        assert not m.sync_degraded and m._is_synced
+        m.unsync()
+
+    def test_unconfigured_guard_is_direct_call(self, two_host_world):
+        """With no sync_guard, guarded_collective must not spawn worker threads."""
+        calls = []
+
+        def probe(x, tiled=False):
+            import threading
+
+            calls.append(threading.current_thread().name)
+            return _fake_allgather(x, tiled)
+
+        from torchmetrics_tpu.robust.degraded import guarded_collective
+
+        guarded_collective(probe, jnp.ones(2), description="probe")
+        assert calls and "guarded" not in calls[0]  # ran on the calling thread
+
+    def test_guard_exhaustion_raises_collective_error(self):
+        from torchmetrics_tpu.robust.degraded import guarded_collective
+
+        with robust.sync_guard(timeout=0.2, retries=1):
+            with faults.inject_collective_fault(mode="raise", times=10):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    with pytest.raises(CollectiveError, match="after 2 attempt"):
+                        guarded_collective(_fake_allgather, jnp.ones(2), description="x")
+
+
+# ------------------------------------------------------------ retries/fetches
+
+
+class TestRetrySchedule:
+    def test_deterministic_backoff_no_real_sleep(self):
+        sleeps = []
+        attempts = []
+
+        def flaky():
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = retry_call(
+                flaky,
+                schedule=RetrySchedule(max_attempts=4, base_delay=0.5, multiplier=2.0),
+                sleep=sleeps.append,
+                description="flaky op",
+            )
+        assert out == "ok"
+        assert sleeps == [0.5, 1.0]  # jitter-free exponential
+
+    def test_exhaustion_raises_retry_error(self):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(RetryError, match="3 attempt"):
+                retry_call(
+                    lambda: (_ for _ in ()).throw(OSError("down")),
+                    schedule=RetrySchedule(max_attempts=3),
+                    sleep=lambda _: None,
+                )
+
+    def test_deadline_stops_early(self):
+        clock = iter([0.0, 0.0, 100.0]).__next__
+        calls = []
+
+        def failing():
+            calls.append(1)
+            raise OSError("down")
+
+        with pytest.raises(RetryError):
+            retry_call(
+                failing,
+                schedule=RetrySchedule(max_attempts=10, base_delay=1.0, deadline=5.0),
+                sleep=lambda _: None,
+                clock=clock,
+            )
+        assert len(calls) == 2  # second failure is past the deadline
+
+
+class TestFetchResource:
+    PAYLOAD = b"model-weights-payload-0123456789"
+
+    def _sha(self, data):
+        import hashlib
+
+        return hashlib.sha256(data).hexdigest()
+
+    def test_truncated_download_retried_with_backoff(self, tmp_path):
+        dest = str(tmp_path / "weights.bin")
+        sleeps = []
+        with faults.inject_download_fault(mode="truncate", times=2):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                out = fetch_resource(
+                    "https://example.invalid/weights.bin",
+                    dest,
+                    fetcher=lambda url: self.PAYLOAD,
+                    expected_sha256=self._sha(self.PAYLOAD),
+                    schedule=RetrySchedule(max_attempts=4, base_delay=0.5),
+                    sleep=sleeps.append,
+                )
+        assert out == dest
+        with open(dest, "rb") as fh:
+            assert fh.read() == self.PAYLOAD
+        assert sleeps == [0.5, 1.0]  # two corrupted attempts, deterministic backoff
+
+    def test_corrupted_cache_purged_and_refetched(self, tmp_path):
+        dest = tmp_path / "weights.bin"
+        dest.write_bytes(b"garbage")
+        fetched = []
+
+        def fetcher(url):
+            fetched.append(url)
+            return self.PAYLOAD
+
+        with pytest.warns(RuntimeWarning, match="corrupted"):
+            fetch_resource(
+                "https://example.invalid/weights.bin",
+                str(dest),
+                fetcher=fetcher,
+                expected_sha256=self._sha(self.PAYLOAD),
+                sleep=lambda _: None,
+            )
+        assert fetched == ["https://example.invalid/weights.bin"]
+        assert dest.read_bytes() == self.PAYLOAD
+
+    def test_valid_cache_is_not_refetched(self, tmp_path):
+        dest = tmp_path / "weights.bin"
+        dest.write_bytes(self.PAYLOAD)
+        fetch_resource(
+            "https://example.invalid/weights.bin",
+            str(dest),
+            fetcher=lambda url: (_ for _ in ()).throw(AssertionError("must not fetch")),
+            expected_sha256=self._sha(self.PAYLOAD),
+            sleep=lambda _: None,
+        )
+
+    def test_persistent_corruption_raises(self, tmp_path):
+        with faults.inject_download_fault(mode="corrupt", times=10):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with pytest.raises(RetryError):
+                    fetch_resource(
+                        "https://example.invalid/weights.bin",
+                        str(tmp_path / "weights.bin"),
+                        fetcher=lambda url: self.PAYLOAD,
+                        expected_sha256=self._sha(self.PAYLOAD),
+                        schedule=RetrySchedule(max_attempts=3),
+                        sleep=lambda _: None,
+                    )
+        assert not (tmp_path / "weights.bin").exists()  # no torn file left behind
+
+    def test_cache_recovery_rebuilds_once(self, tmp_path):
+        path = tmp_path / "cache.json"
+        path.write_text("{corrupt")
+        rebuilt = []
+
+        def rebuild():
+            rebuilt.append(1)
+            path.write_text(json.dumps({"v": 7}))
+
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            out = load_with_cache_recovery(
+                str(path), lambda p: json.load(open(p)), rebuild=rebuild
+            )
+        assert out == {"v": 7} and rebuilt == [1]
+
+    def test_cache_recovery_without_rebuild_raises(self, tmp_path):
+        path = tmp_path / "cache.json"
+        path.write_text("{corrupt")
+        with pytest.raises(ResourceIntegrityError, match="corrupted"):
+            load_with_cache_recovery(str(path), lambda p: json.load(open(p)))
+
+
+class TestDnsmosCacheRecovery:
+    def test_corrupted_converted_cache_reconverts(self, tmp_path, monkeypatch):
+        from tests.helpers.onnx_fab import _model, _node
+        from torchmetrics_tpu.functional.audio import dnsmos as dnsmos_mod
+
+        w = np.asarray([[1.0]], np.float32)
+        b = np.asarray([0.0], np.float32)
+        onnx_bytes = _model(
+            [
+                _node("ReduceMean", ["input_1"], ["rm"], axes=[1, 2], keepdims=1),
+                _node("Flatten", ["rm"], ["fl"], axis=1),
+                _node("Gemm", ["fl", "w", "b"], ["out"]),
+            ],
+            {"w": w, "b": b},
+            ["input_1"],
+            ["out"],
+        )
+        root = tmp_path / "dnsmos"
+        (root / "DNSMOS").mkdir(parents=True)
+        (root / "DNSMOS" / "model_v8.onnx").write_bytes(onnx_bytes)
+
+        first = dnsmos_mod._resolve_model(str(root), "model_v8")
+        assert first is not None and os.path.isfile(os.path.join(first, "graph.json"))
+
+        # corrupt the converted cache; the (memoized) loader must purge + re-convert
+        with open(os.path.join(first, "params.npz"), "wb") as fh:
+            fh.write(b"truncated")
+        dnsmos_mod._load_model.cache_clear()
+        with pytest.warns(RuntimeWarning, match="rebuilding"):
+            forward = dnsmos_mod._load_model(first)
+        assert forward is not None
+        from torchmetrics_tpu.convert.onnx_flax import load_onnx_graph
+
+        spec, params = load_onnx_graph(first)  # cache is clean again on disk
+        assert "w" in params
+
+
+# ----------------------------------------------------------- checkpoint safety
+
+
+class TestCheckpointHardening:
+    def test_integrity_mismatch_raises(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from torchmetrics_tpu.utils.checkpoint import (
+            CheckpointIntegrityError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        path = save_checkpoint(m, str(tmp_path / "ckpt"))
+        with open(os.path.join(path, "INTEGRITY.json")) as fh:
+            rec = json.load(fh)
+        rec["sha256"] = "0" * 64
+        with open(os.path.join(path, "INTEGRITY.json"), "w") as fh:
+            json.dump(rec, fh)
+        with pytest.raises(CheckpointIntegrityError, match="integrity check"):
+            load_checkpoint(MeanSquaredError(), path)
+
+    def test_missing_integrity_record_never_loads_silently(self, tmp_path):
+        """Without its integrity record a new-layout checkpoint must not restore as
+        if valid (it falls through to the legacy-layout reader, whose tree shape
+        does not match a single metric)."""
+        pytest.importorskip("orbax.checkpoint")
+        from torchmetrics_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        path = save_checkpoint(m, str(tmp_path / "ckpt"))
+        os.remove(os.path.join(path, "INTEGRITY.json"))
+        with pytest.raises(Exception):
+            load_checkpoint(MeanSquaredError(), path)
+
+    def test_legacy_layout_still_loads(self, tmp_path):
+        """Checkpoints written before the hardening (orbax tree directly at path, no
+        integrity record) must keep loading — including a collection with a metric
+        literally named 'data'."""
+        ocp = pytest.importorskip("orbax.checkpoint")
+        import torchmetrics_tpu.utils.checkpoint as ckpt_mod
+        from torchmetrics_tpu.collections import MetricCollection
+
+        col = MetricCollection({"data": MeanSquaredError(), "acc": MulticlassAccuracy(num_classes=3)})
+        col["data"].update(jnp.ones(4), jnp.zeros(4))
+        col["acc"].update(
+            jnp.asarray(rng.rand(8, 3).astype(np.float32)), jnp.asarray(rng.randint(0, 3, 8))
+        )
+        legacy = str(tmp_path / "legacy")
+        ocp.PyTreeCheckpointer().save(legacy, ckpt_mod._tree_of(col), force=True)
+
+        col2 = MetricCollection({"data": MeanSquaredError(), "acc": MulticlassAccuracy(num_classes=3)})
+        ckpt_mod.load_checkpoint(col2, legacy)
+        np.testing.assert_allclose(
+            np.asarray(col2["data"].compute()), np.asarray(col["data"].compute()), atol=0
+        )
+
+    def test_truncated_integrity_record_raises_typed_error(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from torchmetrics_tpu.utils.checkpoint import (
+            CheckpointIntegrityError,
+            load_checkpoint,
+            save_checkpoint,
+        )
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        path = save_checkpoint(m, str(tmp_path / "ckpt"))
+        ip = os.path.join(path, "INTEGRITY.json")
+        with open(ip) as fh:
+            content = fh.read()
+        with open(ip, "w") as fh:
+            fh.write(content[: len(content) // 2])  # torn write
+        with pytest.raises(CheckpointIntegrityError, match="unreadable"):
+            load_checkpoint(MeanSquaredError(), path)
+
+    def test_successful_save_sweeps_stale_siblings_but_not_live_ones(self, tmp_path):
+        """Old-pid .old/.tmp leftovers from preempted saves must not accumulate —
+        but a *fresh* sibling (possibly another process's live save) is spared."""
+        pytest.importorskip("orbax.checkpoint")
+        import time as _time
+
+        import torchmetrics_tpu.utils.checkpoint as ckpt_mod
+        from torchmetrics_tpu.utils.checkpoint import save_checkpoint
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        path = str(tmp_path / "ckpt")
+        save_checkpoint(m, path)
+        stale = path + ".old.99999"  # leaked by a long-dead pid
+        live = path + ".tmp.99998"  # another process's in-flight save
+        os.makedirs(stale)
+        os.makedirs(live)
+        ancient = _time.time() - 2 * ckpt_mod._STALE_SIBLING_AGE_S
+        os.utime(stale, (ancient, ancient))
+        save_checkpoint(m, path)
+        assert not os.path.exists(stale)
+        assert os.path.exists(live)  # fresh sibling spared
+        os.rmdir(live)
+
+    def test_mid_swap_preemption_recovers_displaced_checkpoint(self, tmp_path):
+        """Preemption between save's two renames leaves no dir at `path`; load must
+        recover the complete displaced sibling instead of losing the resume point."""
+        pytest.importorskip("orbax.checkpoint")
+        from torchmetrics_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        path = save_checkpoint(m, str(tmp_path / "ckpt"))
+        # simulate: rename(path, old) happened, rename(tmp, path) did not
+        os.rename(path, path + ".old.12345")
+        with pytest.warns(RuntimeWarning, match="recovering"):
+            m2 = load_checkpoint(MeanSquaredError(), path)
+        np.testing.assert_allclose(np.asarray(m2.compute()), np.asarray(m.compute()), atol=0)
+
+    def test_overwrite_is_atomic_swap(self, tmp_path):
+        pytest.importorskip("orbax.checkpoint")
+        from torchmetrics_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        path = save_checkpoint(m, str(tmp_path / "ckpt"))
+        m.update(jnp.full(4, 2.0), jnp.zeros(4))
+        save_checkpoint(m, path)
+        m2 = load_checkpoint(MeanSquaredError(), path)
+        np.testing.assert_allclose(np.asarray(m2.compute()), np.asarray(m.compute()), atol=0)
+        leftovers = [p for p in os.listdir(tmp_path) if ".tmp." in p or ".old." in p]
+        assert leftovers == []
+
+
+# ------------------------------------------------------------ fault harness
+
+
+class TestFaultHarnessHygiene:
+    def test_faults_clear_on_exit(self):
+        with faults.inject_nan_updates(indices={0}):
+            assert faults.update_faults_active()
+        assert not faults.update_faults_active()
+        with faults.inject_collective_fault(times=1):
+            assert faults.collective_faults_active()
+        assert not faults.collective_faults_active()
+        assert faults.corrupt_download(b"abcd") == b"abcd"  # inactive: passthrough
+
+    def test_nan_every_k(self):
+        with faults.inject_nan_updates(every=2) as plan:
+            a0, _ = faults.apply_update_fault((jnp.ones(2),), {})
+            a1, _ = faults.apply_update_fault((jnp.ones(2),), {})
+            a2, _ = faults.apply_update_fault((jnp.ones(2),), {})
+        assert np.isnan(np.asarray(a0[0])).all()
+        assert not np.isnan(np.asarray(a1[0])).any()
+        assert np.isnan(np.asarray(a2[0])).all()
+        assert plan["seen"] == 3
+
+    def test_integer_arrays_pass_through_nanify(self):
+        with faults.inject_nan_updates():
+            (arr,), _ = faults.apply_update_fault((jnp.arange(3),), {})
+        np.testing.assert_array_equal(np.asarray(arr), np.arange(3))
+
+    def test_namedtuple_batches_survive_nanify_and_quarantine(self):
+        from typing import NamedTuple
+
+        class Batch(NamedTuple):
+            preds: object
+            target: object
+
+        b = Batch(jnp.ones(3), jnp.zeros(3))
+        with faults.inject_nan_updates():
+            (nb,), _ = faults.apply_update_fault((b,), {})
+        assert isinstance(nb, Batch) and np.isnan(np.asarray(nb.preds)).all()
+
+        from torchmetrics_tpu.core.metric import _host_copy
+
+        hc = _host_copy((b,))
+        assert isinstance(hc[0], Batch) and isinstance(hc[0].preds, np.ndarray)

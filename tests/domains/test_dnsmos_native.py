@@ -20,13 +20,80 @@ from torchmetrics_tpu.functional.audio import deep_noise_suppression_mean_opinio
 from torchmetrics_tpu.functional.audio import dnsmos as dnsmos_mod
 
 
+def _np_hz_to_mel(f: np.ndarray) -> np.ndarray:
+    """Slaney mel scale, written as librosa documents it (independent of the module)."""
+    f = np.asarray(f, dtype=np.float64)
+    mel = f / (200.0 / 3)
+    logstep = np.log(6.4) / 27.0
+    return np.where(f >= 1000.0, 15.0 + np.log(np.maximum(f, 1000.0) / 1000.0) / logstep, mel)
+
+
+def _np_mel_to_hz(m: np.ndarray) -> np.ndarray:
+    m = np.asarray(m, dtype=np.float64)
+    logstep = np.log(6.4) / 27.0
+    return np.where(m >= 15.0, 1000.0 * np.exp(logstep * (m - 15.0)), m * (200.0 / 3))
+
+
+def _np_mel_filterbank(sr: int = 16000, n_fft: int = 321, n_mels: int = 120) -> np.ndarray:
+    """Independent float64 slaney filterbank via the direct triangle formula.
+
+    Bin frequencies are the rfft grid ``k * sr / n_fft`` (librosa's
+    ``np.fft.rfftfreq``) — NOT ``linspace(0, sr/2)``, which differs for odd
+    ``n_fft`` — and each triangle is evaluated pointwise with its own
+    up/down slopes rather than the module's vectorized ramps.
+    """
+    freqs = np.arange(n_fft // 2 + 1, dtype=np.float64) * sr / n_fft
+    pts = _np_mel_to_hz(np.linspace(_np_hz_to_mel(0.0), _np_hz_to_mel(sr / 2), n_mels + 2))
+    fb = np.zeros((n_mels, freqs.size))
+    for m in range(n_mels):
+        lo, c, hi = pts[m], pts[m + 1], pts[m + 2]
+        up = (freqs - lo) / (c - lo)
+        down = (hi - freqs) / (hi - c)
+        fb[m] = np.maximum(0.0, np.minimum(up, down)) * 2.0 / (hi - lo)  # slaney norm
+    return fb
+
+
+class TestMelFilterbankVsLibrosa:
+    """The module's filterbank must match librosa's algorithm, validated against an
+    independent transcription + pinned spot values — not against itself."""
+
+    def test_matches_independent_float64_construction(self):
+        mod = np.asarray(dnsmos_mod._mel_filterbank(16000, 321, 120), dtype=np.float64)
+        ref = _np_mel_filterbank(16000, 321, 120)
+        np.testing.assert_allclose(mod, ref, atol=1e-9)
+
+    def test_known_values_pinned(self):
+        """Peak weights of a spread of mel channels (float64 triangle formula on the
+        rfftfreq grid — librosa's values for sr=16000, n_fft=321, n_mels=120)."""
+        fb = np.asarray(dnsmos_mod._mel_filterbank(16000, 321, 120), dtype=np.float64)
+        known = [
+            (3, 2, 4.00718227e-02),
+            (30, 16, 3.40326311e-04),
+            (60, 34, 1.43288020e-02),
+            (90, 74, 9.28220619e-03),
+            (119, 156, 4.45256848e-03),
+        ]
+        for m, j, value in known:
+            np.testing.assert_allclose(fb[m, j], value, rtol=1e-6)
+            assert j == int(np.argmax(fb[m]))
+
+    def test_bin_grid_is_rfftfreq_not_linspace(self):
+        """For odd n_fft the last rfft bin is below Nyquist; a linspace grid (the old
+        bug) puts nonzero top-channel weight AT Nyquist spacing instead."""
+        n_fft, sr = 321, 16000
+        grid = np.fft.rfftfreq(n_fft, 1.0 / sr)
+        assert grid.size == 1 + n_fft // 2
+        assert grid[-1] < sr / 2  # 160/321*16000 ≈ 7975.08 Hz
+        np.testing.assert_allclose(np.diff(grid), sr / n_fft)
+
+
 def _np_melspec_db(x: np.ndarray) -> np.ndarray:
     """Independent straight-DFT transcription of the reference mel pipeline."""
     n_fft, hop, n_mels, sr = 321, 160, 120, 16000
     pad = n_fft // 2
     out = []
     win = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)  # periodic hann (librosa fftbins=True)
-    fb = dnsmos_mod._mel_filterbank(sr, n_fft, n_mels)
+    fb = _np_mel_filterbank(sr, n_fft, n_mels).astype(np.float32)
     k = np.arange(n_fft // 2 + 1)[:, None] * np.arange(n_fft)[None, :]
     dft = np.exp(-2j * np.pi * k / n_fft)  # explicit DFT matrix, not np.fft
     for row in x:

@@ -136,6 +136,23 @@ class TestJitAndShapes:
         assert eager.shape == (2, 3)
         np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), rtol=1e-5)
 
+    def test_jit_first_then_eager(self):
+        """Regression: _HF_CACHE must hold HOST arrays. When the very first call ran
+        under jit, the cached filter-bank rfft used to be a tracer, and every later
+        eager call died with UnexpectedTracerError."""
+        from torchmetrics_tpu.functional.audio import srmr as srmr_mod
+
+        srmr_mod._HF_CACHE.clear()
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(2, 8000).astype(np.float32))
+        fn = jax.jit(lambda v: speech_reverberation_modulation_energy_ratio(v, 8000))
+        jitted = fn(x)  # first call: populates the cache under trace
+        assert all(
+            isinstance(v, np.ndarray) for v in srmr_mod._HF_CACHE.values()
+        ), "cached filter transforms must be host numpy arrays"
+        eager = speech_reverberation_modulation_energy_ratio(x, 8000)  # must not leak tracers
+        np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager), rtol=1e-5)
+
     def test_1d_returns_len1(self):
         x = jnp.asarray(np.random.RandomState(2).randn(8000).astype(np.float32))
         out = speech_reverberation_modulation_energy_ratio(x, 8000)

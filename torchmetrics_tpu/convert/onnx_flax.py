@@ -76,7 +76,14 @@ def load_onnx_graph(model_dir: str):
 
 
 def _all_host(values) -> bool:
-    return all(isinstance(v, np.ndarray) or np.isscalar(v) for v in values)
+    """True when every *present* input is host-concrete (numpy or scalar).
+
+    ``None`` entries are absent optional inputs (e.g. ``Clip`` with only a min
+    bound, ONNX's empty-string input name) — they must not force the device
+    path, or a host-concrete shape-plumbing subgraph traces into the jaxpr and
+    loses its static value under jit.
+    """
+    return all(v is None or isinstance(v, np.ndarray) or np.isscalar(v) for v in values)
 
 
 def _pool_dims(x, kernel, strides, pads, reducer, init, count_include_pad):

@@ -42,6 +42,15 @@ from torchmetrics_tpu.core.jit import jit_with_static_leaves
 from torchmetrics_tpu.parallel.reductions import Reduction, merge_states
 from torchmetrics_tpu.parallel.sync import distributed_available as _default_distributed_available
 from torchmetrics_tpu.parallel.sync import sync_state as _sync_state_fn
+from torchmetrics_tpu.robust import faults as _faults
+from torchmetrics_tpu.robust.degraded import CollectiveError
+from torchmetrics_tpu.robust.policy import (
+    ErrorPolicy,
+    UpdateGuardError,
+    coerce_policy,
+    effective_policy,
+    first_nonfinite,
+)
 from torchmetrics_tpu.utils.data import dim_zero_cat
 from torchmetrics_tpu.utils.exceptions import TorchMetricsUserError
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -49,6 +58,23 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 Array = jax.Array
 
 _METRIC_PROTECTED_ATTRS = ("is_differentiable", "higher_is_better", "full_state_update")
+
+# reserved state_dict key carrying the update-guard counters (see state_dict /
+# load_state_dict); cannot collide with states, whose names must be identifiers
+_ROBUST_STATE_KEY = "__robust__"
+
+
+def _host_copy(value: Any) -> Any:
+    """Host (numpy) copies of a quarantined batch's array leaves."""
+    if isinstance(value, tuple) and hasattr(value, "_fields"):  # NamedTuple batches
+        return type(value)(*(_host_copy(v) for v in value))
+    if isinstance(value, (list, tuple)):
+        return type(value)(_host_copy(v) for v in value)
+    if isinstance(value, dict):
+        return {k: _host_copy(v) for k, v in value.items()}
+    if isinstance(value, jax.Array):
+        return np.asarray(value)
+    return value
 
 
 def jit_distributed_available() -> bool:
@@ -74,6 +100,11 @@ class Metric(ABC):
         compute_with_cache: cache the computed value until next update/reset.
         jit_update: force-enable/disable jit of the update transition (default: auto —
             enabled unless the metric holds ragged list states).
+        error_policy: what to do with a batch that fails update guards —
+            ``"raise"`` | ``"warn_skip"`` | ``"quarantine"`` (see
+            ``torchmetrics_tpu.robust``). ``None`` (default) defers to the
+            process-global policy; with neither configured the update path is
+            the unguarded legacy one.
     """
 
     __jax_metric__ = True
@@ -98,6 +129,7 @@ class Metric(ABC):
         self.sync_on_compute = kwargs.pop("sync_on_compute", True)
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         self._jit_update_flag = kwargs.pop("jit_update", None)
+        self.error_policy = coerce_policy(kwargs.pop("error_policy", None))
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -126,6 +158,21 @@ class Metric(ABC):
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
         self._enable_grad = False
+
+        # robustness observability (torchmetrics_tpu.robust): update-guard
+        # counters and the degraded-sync flag. Plain python ints — zero cost on
+        # the unguarded default path.
+        self.updates_ok = 0
+        self.updates_skipped = 0
+        self.updates_quarantined = 0
+        self.quarantine_dropped = 0
+        self.last_update_ok = True
+        self.sync_degraded = False
+        self._quarantine: List[Dict[str, Any]] = []
+        # True once any guarded (policy-configured) update has run — gates the
+        # __robust__ state_dict key so never-guarded metrics serialize the
+        # legacy format byte-for-byte
+        self._guards_engaged = False
 
         # wrap user update/compute (reference `_wrap_update/_wrap_compute`, metric.py:476,610)
         self._update_signature = inspect.signature(self.update)
@@ -350,9 +397,103 @@ class Metric(ABC):
             raise TorchMetricsUserError(
                 "The Metric has already been synced. HINT: call unsync() before modifying state."
             )
+        if _faults.update_faults_active() and not self.__dict__.get("_fault_applied", False):
+            args, kwargs = _faults.apply_update_fault(args, kwargs)
         self._computed = None
+        policy = effective_policy(self.error_policy)
+        if policy is None:
+            # unguarded legacy path: no input screening, exceptions propagate
+            self._update_count += 1
+            try:
+                self._dispatch_update(*args, **kwargs)
+            except Exception:
+                self.last_update_ok = False
+                raise
+            self.updates_ok += 1
+            self.last_update_ok = True
+            return
+        self._guards_engaged = True
         self._update_count += 1
-        self._dispatch_update(*args, **kwargs)
+        try:
+            ok, err = self._guarded_dispatch(policy, args, kwargs)
+        except Exception:
+            self._update_count -= 1  # a failed batch never counts as an update
+            raise
+        if ok:
+            self.updates_ok += 1
+            self.last_update_ok = True
+            return
+        self._update_count -= 1  # a skipped batch never counts as an update
+        self._record_update_failure(policy, err, args, kwargs)
+
+    def _guarded_dispatch(self, policy: ErrorPolicy, args: tuple, kwargs: dict):
+        """Run one update under guards: validate inputs, dispatch, roll back on failure.
+
+        Returns ``(ok, error)``. Under the ``raise`` policy the failure (with
+        state already rolled back) propagates instead.
+        """
+        # shallow-snapshot the state: arrays are immutable, but ragged list
+        # states mutate in place via append — copy the list containers
+        snapshot = {k: (list(v) if isinstance(v, list) else v) for k, v in self._state_values.items()}
+        count_snapshot = self._update_count
+        try:
+            bad = first_nonfinite(args, kwargs)
+            if bad is not None:
+                raise UpdateGuardError(
+                    f"{type(self).__name__}.update received non-finite values in {bad}"
+                )
+            self._dispatch_update(*args, **kwargs)
+            return True, None
+        except Exception as err:
+            self.__dict__["_state_values"] = snapshot
+            self._update_count = count_snapshot
+            if policy is ErrorPolicy.RAISE:
+                self.last_update_ok = False
+                raise
+            return False, err
+
+    # retained quarantined batches are bounded: beyond this many, the oldest is
+    # dropped (counted in `quarantine_dropped`) so a persistently-bad stream
+    # cannot OOM the host the fault-tolerance layer is keeping alive
+    quarantine_max_batches: int = 16
+
+    def _record_update_failure(self, policy: ErrorPolicy, err: Exception, args: tuple, kwargs: dict) -> None:
+        """Book-keeping for a skipped/quarantined batch (state already rolled back)."""
+        self.last_update_ok = False
+        if policy is ErrorPolicy.QUARANTINE:
+            self.updates_quarantined += 1
+            self._quarantine.append(
+                {
+                    "args": _host_copy(args),
+                    "kwargs": _host_copy(kwargs),
+                    "reason": f"{type(err).__name__}: {err}",
+                    # position in the guarded update stream (0-based), stable
+                    # across both the update() and forward() entry points
+                    "update_index": self.updates_ok + self.updates_skipped + self.updates_quarantined - 1,
+                }
+            )
+            if len(self._quarantine) > self.quarantine_max_batches:
+                self._quarantine.pop(0)
+                self.quarantine_dropped += 1
+            verb = "quarantined"
+        else:
+            self.updates_skipped += 1
+            verb = "skipped"
+        rank_zero_warn(
+            f"{type(self).__name__}.update failed and the batch was {verb}"
+            f" (policy={policy.value}): {err}. Accumulated state is unchanged;"
+            " the `updates_ok`/`updates_skipped`/`updates_quarantined` counters"
+            " track totals.",
+            RuntimeWarning,
+        )
+
+    @property
+    def quarantined_batches(self) -> List[Dict[str, Any]]:
+        """Host copies of batches rejected under the ``quarantine`` policy."""
+        return list(self._quarantine)
+
+    def clear_quarantine(self) -> None:
+        self._quarantine = []
 
     def _dispatch_update(self, *args: Any, **kwargs: Any) -> None:
         """Run one update against the currently-bound state (jitted when possible)."""
@@ -410,6 +551,19 @@ class Metric(ABC):
         """
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing `forward`.")
+        if _faults.update_faults_active() and not self.__dict__.get("_fault_applied", False):
+            # injected faults apply ONCE per forward call, at the outermost
+            # entry, so the accumulate pass and the batch replay see the SAME
+            # (possibly faulted) arguments — exactly like a real bad batch
+            args, kwargs = _faults.apply_update_fault(args, kwargs)
+            self.__dict__["_fault_applied"] = True
+            try:
+                return self._forward_dispatch(*args, **kwargs)
+            finally:
+                self.__dict__["_fault_applied"] = False
+        return self._forward_dispatch(*args, **kwargs)
+
+    def _forward_dispatch(self, *args: Any, **kwargs: Any) -> Any:
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             return self._forward_full_state_update(*args, **kwargs)
         return self._forward_reduce_state_update(*args, **kwargs)
@@ -425,8 +579,17 @@ class Metric(ABC):
 
         self._state_values = self._fresh_state()
         self._update_count = 1
-        self._update_impl_via_wrapped_once(*args, **kwargs)
-        batch_val = self.compute()
+        if self.last_update_ok:
+            # replay on the fresh batch state; the guarded accumulate above
+            # succeeded, so this replay of the same args is neither re-guarded
+            # nor re-counted
+            self._computed = None
+            self._dispatch_update(*args, **kwargs)
+            batch_val = self.compute()
+        else:
+            # guarded skip: no batch value — computing on the empty batch state
+            # would raise for list-state metrics and mean nothing for the rest
+            batch_val = None
 
         # restore global state
         self._update_count = global_count
@@ -447,12 +610,31 @@ class Metric(ABC):
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
 
-        self._update_impl_via_wrapped_once(*args, **kwargs)
-        batch_val = self.compute()
+        try:
+            batch_ok = self._update_impl_via_wrapped_once(*args, **kwargs)
+        except Exception:
+            if effective_policy(self.error_policy) is not None:
+                # guarded `raise`: restore the global state before propagating,
+                # so the failed forward doesn't strand the fresh batch state
+                self._state_values = global_state
+                self._update_count = global_count
+                self._should_unsync = True
+                self._to_sync = self.sync_on_compute
+            raise
 
-        merged = self._reduce_states(global_state, dict(self._state_values), global_count)
+        if batch_ok:
+            batch_val = self.compute()
+            merged = self._reduce_states(global_state, dict(self._state_values), global_count)
+            new_count = global_count + 1
+        else:
+            # guarded skip: no batch value (the rolled-back batch state is the
+            # empty default — computing on it would raise for list-state
+            # metrics), and the bad batch contributes nothing to global state
+            batch_val = None
+            merged = global_state
+            new_count = global_count
         self._state_values = merged
-        self._update_count = global_count + 1
+        self._update_count = new_count
         self._is_synced = False
         self._cache = None
         self._should_unsync = True
@@ -460,9 +642,28 @@ class Metric(ABC):
         self._computed = None
         return batch_val
 
-    def _update_impl_via_wrapped_once(self, *args: Any, **kwargs: Any) -> None:
+    def _update_impl_via_wrapped_once(self, *args: Any, **kwargs: Any) -> bool:
+        """One update against the currently-bound (batch) state; returns success.
+
+        The guarded policies intercept here too, so ``forward`` on the reduce
+        path skips/quarantines bad batches with the same counters and rollback
+        semantics as ``update``.
+        """
         self._computed = None
-        self._dispatch_update(*args, **kwargs)
+        policy = effective_policy(self.error_policy)
+        if policy is None:
+            self._dispatch_update(*args, **kwargs)
+            self.updates_ok += 1
+            self.last_update_ok = True
+            return True
+        self._guards_engaged = True
+        ok, err = self._guarded_dispatch(policy, args, kwargs)
+        if ok:
+            self.updates_ok += 1
+            self.last_update_ok = True
+            return True
+        self._record_update_failure(policy, err, args, kwargs)
+        return False
 
     def _reduce_states(self, global_state: Dict[str, Any], batch_state: Dict[str, Any], global_count: int) -> Dict[str, Any]:
         """Merge batch state into global state (reference ``metric.py:401-433``)."""
@@ -502,8 +703,23 @@ class Metric(ABC):
         if not should_sync or not is_dist:
             return
         self._cache = dict(self._state_values)
-        self._sync_dist(dist_sync_fn)
+        try:
+            self._sync_dist(dist_sync_fn)
+        except CollectiveError as err:
+            # degraded sync: keep local-only state rather than hanging/crashing
+            # the job (see torchmetrics_tpu.robust.degraded). Loud by design.
+            self._state_values = self._cache
+            self._cache = None
+            self.sync_degraded = True
+            rank_zero_warn(
+                f"Cross-host sync of {type(self).__name__} failed and was DEGRADED"
+                f" to local-only state: {err}. Results from this process reflect"
+                " only locally-accumulated batches; `metric.sync_degraded` is set.",
+                RuntimeWarning,
+            )
+            return
         self._is_synced = True
+        self.sync_degraded = False
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore cached local state (reference ``metric.py:551-571``)."""
@@ -600,6 +816,14 @@ class Metric(ABC):
         self._cache = None
         self._is_synced = False
         self._state_values = self._fresh_state()
+        self.updates_ok = 0
+        self.updates_skipped = 0
+        self.updates_quarantined = 0
+        self.quarantine_dropped = 0
+        self.last_update_ok = True
+        self.sync_degraded = False
+        self._quarantine = []
+        self._guards_engaged = False
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference ``metric.py:709-711``)."""
@@ -629,10 +853,32 @@ class Metric(ABC):
                 }
             else:
                 destination[prefix + key] = np.asarray(value)
+        # robustness counters round-trip so degradation stays observable across
+        # checkpoint/resume. Emitted only once a guarded update has run — a
+        # never-guarded metric's state_dict is byte-for-byte the legacy one.
+        if self._guards_engaged:
+            destination[prefix + _ROBUST_STATE_KEY] = np.asarray(
+                [
+                    self.updates_ok,
+                    self.updates_skipped,
+                    self.updates_quarantined,
+                    int(self.last_update_ok),
+                    self.quarantine_dropped,
+                ],
+                dtype=np.int64,
+            )
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
         """Restore states saved by :meth:`state_dict` (reference ``metric.py:887-924``)."""
+        robust_key = prefix + _ROBUST_STATE_KEY
+        if robust_key in state_dict:
+            vals = [int(v) for v in np.asarray(state_dict[robust_key]).reshape(-1)]
+            vals += [0] * (5 - len(vals))
+            self.updates_ok, self.updates_skipped, self.updates_quarantined = vals[0], vals[1], vals[2]
+            self.last_update_ok = bool(vals[3])
+            self.quarantine_dropped = vals[4]
+            self._guards_engaged = True
         for key in self._defaults:
             full = prefix + key
             if full in state_dict:

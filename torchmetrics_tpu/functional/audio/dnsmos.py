@@ -63,8 +63,15 @@ def _mel_to_hz(m: np.ndarray) -> np.ndarray:
 
 @functools.lru_cache(maxsize=8)
 def _mel_filterbank(sr: int, n_fft: int, n_mels: int) -> np.ndarray:
-    """[n_mels, 1 + n_fft//2] triangular slaney-normalized filterbank."""
-    fftfreqs = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    """[n_mels, 1 + n_fft//2] triangular slaney-normalized filterbank.
+
+    Bin frequencies are ``np.fft.rfftfreq(n_fft, 1/sr)`` — ``k * sr / n_fft`` —
+    exactly librosa's. For the odd ``n_fft=321`` the last rfft bin sits at
+    ``160/321 * sr`` ≈ 7975 Hz, *not* at Nyquist: a ``linspace(0, sr/2, ...)``
+    grid (the old code) stretches every triangle slightly and shifts all 120
+    mel energies relative to librosa's.
+    """
+    fftfreqs = np.fft.rfftfreq(n_fft, 1.0 / sr)
     mel_pts = _mel_to_hz(np.linspace(_hz_to_mel(0.0), _hz_to_mel(sr / 2), n_mels + 2))
     fdiff = np.diff(mel_pts)
     ramps = mel_pts[:, None] - fftfreqs[None, :]
@@ -119,25 +126,52 @@ def _dnsmos_root() -> Optional[str]:
     return repo_weights if os.path.isdir(repo_weights) else None
 
 
-def _resolve_model(root: str, key: str) -> Optional[str]:
-    """Converted dir for ``key``, auto-converting a raw .onnx drop if present."""
-    converted = os.path.join(root, key)
-    if os.path.isfile(os.path.join(converted, "graph.json")):
-        return converted
+def _find_raw(root: str, key: str) -> Optional[str]:
     for rel in _RAW_LAYOUTS[key]:
         raw = os.path.join(root, rel)
         if os.path.isfile(raw):
-            from torchmetrics_tpu.convert.onnx_flax import convert_onnx_flax
-
-            return convert_onnx_flax(raw, converted)
+            return raw
     return None
+
+
+def _resolve_model(root: str, key: str) -> Optional[str]:
+    """Converted dir for ``key``, auto-converting a raw .onnx drop if present.
+
+    Records the raw source path so :func:`_load_model` can purge and re-convert
+    a corrupted converted cache (truncated ``params.npz`` after a preempted
+    conversion, say); with no raw drop to rebuild from, corruption raises at
+    load instead of executing a half-loaded graph.
+    """
+    from torchmetrics_tpu.convert.onnx_flax import convert_onnx_flax
+
+    converted = os.path.join(root, key)
+    raw = _find_raw(root, key)
+    if not os.path.isfile(os.path.join(converted, "graph.json")):
+        if raw is None:
+            return None
+        convert_onnx_flax(raw, converted)
+    if raw is not None:
+        _RAW_SOURCE[converted] = raw
+    return converted
+
+
+# converted-dir -> raw .onnx it can be rebuilt from (populated by _resolve_model)
+_RAW_SOURCE: dict = {}
 
 
 @functools.lru_cache(maxsize=8)
 def _load_model(model_dir: str):
-    from torchmetrics_tpu.convert.onnx_flax import load_onnx_graph, run_graph
+    from torchmetrics_tpu.convert.onnx_flax import convert_onnx_flax, load_onnx_graph, run_graph
+    from torchmetrics_tpu.robust.retry import load_with_cache_recovery
 
-    spec, params = load_onnx_graph(model_dir)
+    raw = _RAW_SOURCE.get(model_dir)
+    rebuild = (lambda: convert_onnx_flax(raw, model_dir)) if raw is not None else None
+    spec, params = load_with_cache_recovery(
+        model_dir,
+        load_onnx_graph,
+        rebuild=rebuild,
+        description=f"converted DNSMOS model cache {model_dir!r}",
+    )
     input_name = spec["inputs"][0]
 
     def forward(features: Array) -> Array:

@@ -31,6 +31,15 @@ reference:
   to 5 (the same denominator) instead.
 - float32 on device (f64 filter design on host), so scores match a float64 host
   implementation to ~1e-4 relative, not bit-exactly.
+- ``fast=True`` frame counts diverge from SRMRpy's fast path: the 400 Hz
+  envelope here has ``(t - (nwin - nhop)) // nhop`` frames (VALID framing, no
+  end padding), while SRMRpy's ``gammatonegram``/``specgram`` zero-pads the
+  tail to keep a partial final window, and its modulation-energy windowing then
+  inherits that longer envelope. Short signals therefore score over one or two
+  fewer 64 ms modulation frames than SRMRpy fast mode — the per-frame energies
+  that *are* computed match; only the tail-frame count (and through the mean,
+  the last decimal of the score) differs. The exact (``fast=False``) path has
+  no such divergence.
 """
 
 from __future__ import annotations
@@ -74,8 +83,11 @@ def _trim_impulse(h: np.ndarray) -> np.ndarray:
     """Truncate where the remaining tail energy < _TAIL_ENERGY of the total."""
     tail = np.cumsum((h**2)[:, ::-1], axis=-1)[:, ::-1]
     total = tail[:, :1]
-    keep = int(np.max(np.argmax(tail < _TAIL_ENERGY * total, axis=-1)))
-    keep = max(keep, 16)
+    mask = tail < _TAIL_ENERGY * total
+    # a row whose tail never decays below threshold has argmax(mask)==0 (all
+    # False) — it must keep its FULL length, not get cut to the other rows' max
+    keep_i = np.where(mask.any(-1), np.argmax(mask, -1), h.shape[-1])
+    keep = max(int(np.max(keep_i)), 16)
     return h[:, : math.ceil(keep / 16) * 16]
 
 
@@ -236,18 +248,20 @@ def _fft_conv(x: Array, h: np.ndarray, cache_key: tuple = None) -> Array:
 
     Returns ``[..., F, T]`` — the first T samples of the full convolution, matching
     what a recursive ``lfilter`` pass would produce. The filter bank's transform is
-    memoized per (design, fft length) so the eager path doesn't re-transform the
-    static filters on every update.
+    computed on HOST (numpy) and memoized per (design, fft length) as a numpy
+    array: the filters are static data, and caching the result of a ``jnp`` op
+    would capture a tracer when the first call runs under ``jit``, poisoning
+    every later eager call with a leaked-tracer error.
     """
     t = x.shape[-1]
     n = 1 << ((t + h.shape[-1] - 1) - 1).bit_length()
     hf = _HF_CACHE.get((cache_key, n)) if cache_key is not None else None
     if hf is None:
-        hf = jnp.fft.rfft(jnp.asarray(h), n=n)
+        hf = np.fft.rfft(np.asarray(h, dtype=np.float64), n=n).astype(np.complex64)
         if cache_key is not None:
             _HF_CACHE[(cache_key, n)] = hf
     xf = jnp.fft.rfft(x[..., None, :], n=n)
-    return jnp.fft.irfft(xf * hf, n=n)[..., :t]
+    return jnp.fft.irfft(xf * jnp.asarray(hf), n=n)[..., :t]
 
 
 def _hilbert_env(x: Array) -> Array:

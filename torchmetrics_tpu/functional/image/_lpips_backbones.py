@@ -269,23 +269,73 @@ def resolve_lpips_backbone_path(net_type: str, path: Optional[str] = None) -> st
     return path
 
 
+def _load_pth_backbone(path: str, net_type: str) -> Dict[str, Any]:
+    import torch
+
+    state = torch.load(path, map_location="cpu", weights_only=True)
+    return convert_torchvision_backbone({k: v.numpy() for k, v in state.items()}, net_type)
+
+
 def load_lpips_backbone_params(net_type: str, path: Optional[str] = None) -> Dict[str, Any]:
     """Load (and convert if needed) the ``net_type`` backbone parameters.
 
     ``.npz`` files are loaded with plain numpy; ``.pth`` via ``torch.load`` and
     converted on the fly. See :func:`resolve_lpips_backbone_path` for resolution.
+
+    A corrupted/truncated ``.npz`` (e.g. a conversion interrupted by preemption)
+    falls back to the raw torchvision ``.pth`` sitting in the same directory when
+    one is available; otherwise it raises ``ResourceIntegrityError`` naming the
+    file instead of scoring with garbage weights.
     """
+    from torchmetrics_tpu.robust.retry import ResourceIntegrityError
+
     path = resolve_lpips_backbone_path(net_type, path)
     if path.endswith(".npz"):
         from torchmetrics_tpu.utils.serialization import load_tree_npz
 
-        params = load_tree_npz(path)
-        _validate_backbone_params(params, net_type)
-        return params
-    import torch
+        try:
+            params = load_tree_npz(path)
+            _validate_backbone_params(params, net_type)
+            return params
+        except Exception as err:
+            import glob
 
-    state = torch.load(path, map_location="cpu", weights_only=True)
-    return convert_torchvision_backbone({k: v.numpy() for k, v in state.items()}, net_type)
+            from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+            hits = sorted(glob.glob(os.path.join(os.path.dirname(path), _CHECKPOINT_HINTS[net_type])))
+            if not hits:
+                raise ResourceIntegrityError(
+                    f"LPIPS `{net_type}` backbone weights at {path} are corrupted ({err})"
+                    " and no raw torchvision checkpoint is present to rebuild from."
+                    " Re-run `python -m torchmetrics_tpu.convert lpips-backbone` on the"
+                    " original checkpoint."
+                ) from err
+            rank_zero_warn(
+                f"LPIPS `{net_type}` backbone weights at {path} are corrupted ({err});"
+                f" rebuilding from the raw checkpoint {hits[0]}.",
+                RuntimeWarning,
+            )
+            try:
+                params = _load_pth_backbone(hits[0], net_type)
+            except ModuleNotFoundError as torch_err:
+                raise ResourceIntegrityError(
+                    f"LPIPS `{net_type}` backbone weights at {path} are corrupted ({err})"
+                    f" and rebuilding from {hits[0]} requires `torch`, which is not"
+                    " installed. Re-run the conversion on a machine with torch."
+                ) from torch_err
+            # re-materialize the npz (atomically) so later processes load the
+            # clean cache instead of re-paying the torch conversion; a read-only
+            # weights directory just keeps the in-memory fallback
+            from torchmetrics_tpu.utils.serialization import save_tree_npz
+
+            try:
+                tmp = f"{path}.tmp.{os.getpid()}.npz"
+                save_tree_npz(tmp, params)
+                os.replace(tmp, path)
+            except OSError:
+                pass
+            return params
+    return _load_pth_backbone(path, net_type)
 
 
 def make_lpips_feature_fn(

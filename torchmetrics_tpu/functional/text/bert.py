@@ -19,7 +19,6 @@ import csv
 import functools
 import math
 import os
-import urllib.request
 from collections import Counter, defaultdict
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
@@ -226,13 +225,21 @@ def _read_csv_from_local_file(baseline_path: str) -> Array:
 
 
 def _read_csv_from_url(baseline_url: str) -> Array:
-    """Baseline csv from a URL — ``bert.py:187-199`` (no egress here; fails naturally)."""
-    with urllib.request.urlopen(baseline_url) as http_request:
-        baseline_list = [
-            [float(item) for item in row.strip().decode("utf-8").split(",")]
-            for idx, row in enumerate(http_request)
-            if idx > 0
-        ]
+    """Baseline csv from a URL — ``bert.py:187-199``.
+
+    Fetched through the robust retry layer (deterministic backoff, size
+    validation), so a transient mirror failure or torn response is retried
+    rather than crashing the scoring run; on machines with no egress the final
+    attempt's error propagates wrapped in ``RetryError``.
+    """
+    from torchmetrics_tpu.robust.retry import fetch_bytes
+
+    raw = fetch_bytes(baseline_url, description=f"BERTScore baseline fetch ({baseline_url})")
+    baseline_list = [
+        [float(item) for item in row.strip().split(",")]
+        for idx, row in enumerate(raw.decode("utf-8").splitlines())
+        if idx > 0 and row.strip()
+    ]
     return jnp.asarray(baseline_list)[:, 1:]
 
 

@@ -92,9 +92,20 @@ def _split_sentence(x: str) -> Sequence[str]:
     try:
         nltk.data.find("tokenizers/punkt")
     except LookupError as err:
-        try:
+        from torchmetrics_tpu.robust.retry import RetryError, RetrySchedule, retry_call
+
+        def _download_punkt() -> None:
             nltk.download("punkt", quiet=True, force=False, halt_on_error=False, raise_on_error=True)
-        except ValueError:
+            nltk.data.find("tokenizers/punkt")  # a torn download must not count as success
+
+        try:
+            retry_call(
+                _download_punkt,
+                schedule=RetrySchedule(max_attempts=3, base_delay=1.0),
+                retry_on=(ValueError, LookupError, OSError),
+                description="nltk punkt download",
+            )
+        except RetryError:
             raise OSError(
                 "`nltk` resource `punkt` is not available on a disk and cannot be downloaded as a machine is not "
                 "connected to the internet."

@@ -74,7 +74,14 @@ class _SacreBLEUTokenizer:
         "zh": "_tokenize_zh",
         "intl": "_tokenize_international",
         "char": "_tokenize_char",
+        "ja-mecab": "_tokenize_ja_mecab",
+        "ko-mecab": "_tokenize_ko_mecab",
     }
+
+    # lazily constructed MeCab taggers (per-tokenizer); building one loads the
+    # dictionary from disk/network mounts — a retried resource init, see
+    # ``_mecab_tagger``
+    _MECAB_TAGGERS: ClassVar[dict] = {}
 
     def __init__(self, tokenize: str, lowercase: bool = False) -> None:
         self._check_tokenizers_validity(tokenize)
@@ -145,6 +152,50 @@ class _SacreBLEUTokenizer:
     def _tokenize_char(cls, line: str) -> str:
         return " ".join(char for char in line.strip())
 
+    @classmethod
+    def _mecab_tagger(cls, tokenize: str):
+        """Build (once) the MeCab tagger behind ``ja-mecab``/``ko-mecab``.
+
+        Tagger construction loads the dictionary resources — on pods these often
+        live on network mounts, so the init is retried through the robust layer
+        before giving up (reference sacrebleu downloads them outright).
+        """
+        if tokenize in cls._MECAB_TAGGERS:
+            return cls._MECAB_TAGGERS[tokenize]
+        import MeCab
+
+        from torchmetrics_tpu.robust.retry import RetrySchedule, retry_call
+
+        def _build():
+            if tokenize == "ja-mecab":
+                import ipadic
+
+                tagger = MeCab.Tagger(ipadic.MECAB_ARGS + " -Owakati")
+            else:
+                import mecab_ko_dic
+
+                tagger = MeCab.Tagger(mecab_ko_dic.MECAB_ARGS + " -Owakati")
+            tagger.parse("")  # force the dictionary load; raises on a torn resource
+            return tagger
+
+        # ModuleNotFoundError is deterministic — only I/O-shaped failures retry
+        tagger = retry_call(
+            _build,
+            schedule=RetrySchedule(max_attempts=3, base_delay=1.0),
+            retry_on=(RuntimeError, OSError),
+            description=f"MeCab dictionary init for {tokenize!r}",
+        )
+        cls._MECAB_TAGGERS[tokenize] = tagger
+        return tagger
+
+    @classmethod
+    def _tokenize_ja_mecab(cls, line: str) -> str:
+        return cls._mecab_tagger("ja-mecab").parse(line.strip()).strip()
+
+    @classmethod
+    def _tokenize_ko_mecab(cls, line: str) -> str:
+        return cls._mecab_tagger("ko-mecab").parse(line.strip()).strip()
+
     @staticmethod
     def _lower(line: str, lowercase: bool) -> str:
         return line.lower() if lowercase else line
@@ -152,10 +203,20 @@ class _SacreBLEUTokenizer:
     @classmethod
     def _check_tokenizers_validity(cls, tokenize: str) -> None:
         if tokenize in ("ja-mecab", "ko-mecab"):
-            raise ModuleNotFoundError(
-                f"The `{tokenize}` tokenizer requires the `mecab` dependency, which is not installed in this"
-                " environment. Install the matching mecab package to use it."
+            from torchmetrics_tpu.utils.imports import (
+                _IPADIC_AVAILABLE,
+                _MECAB_AVAILABLE,
+                _MECAB_KO_DIC_AVAILABLE,
             )
+
+            dic_name = "ipadic" if tokenize == "ja-mecab" else "mecab_ko_dic"
+            dic_ok = _IPADIC_AVAILABLE if tokenize == "ja-mecab" else _MECAB_KO_DIC_AVAILABLE
+            if not _MECAB_AVAILABLE or not dic_ok:
+                raise ModuleNotFoundError(
+                    f"The `{tokenize}` tokenizer requires the `mecab` and `{dic_name}`"
+                    " dependencies, which are not installed in this environment."
+                    " Install the matching mecab packages to use it."
+                )
         if tokenize not in cls._TOKENIZE_FN:
             raise ValueError(
                 f"Unsupported tokenizer selected. Please, choose one of {list(cls._TOKENIZE_FN)}"

@@ -10,6 +10,9 @@ Parity: reference ``src/torchmetrics/utilities/distributed.py:91-147``
   ``all_gather``. No barrier is needed: XLA programs are globally scheduled.
 - **Eager multi-host** (``jax.distributed``): falls back to
   ``multihost_utils.process_allgather`` per leaf, then applies the same reductions.
+  Every eager collective routes through :func:`_process_allgather`, which honors the
+  robust sync guard (timeout + bounded retries + degrade-to-local; see
+  ``torchmetrics_tpu.robust.degraded``) — unconfigured, it is a direct call.
 - **Single process, no axis**: identity.
 
 Unlike the reference's pad-to-max-then-trim for ragged ``cat`` states (which has no
@@ -37,6 +40,24 @@ def distributed_available() -> bool:
         return jax.process_count() > 1
     except Exception:  # backend not initialised
         return False
+
+
+def _process_allgather(x, tiled: bool = False, description: str = "process_allgather"):
+    """Eager multihost allgather, routed through the robust sync guard.
+
+    With no guard configured (the default) this is a direct call. Under
+    ``robust.sync_guard`` each collective gets a timeout and bounded retries;
+    exhaustion raises ``CollectiveError``, which ``Metric.sync`` turns into a
+    local-only degrade instead of a hung job. The attribute is resolved at call
+    time so tests patching ``multihost_utils.process_allgather`` keep working.
+    """
+    from jax.experimental import multihost_utils
+
+    from torchmetrics_tpu.robust.degraded import guarded_collective
+
+    return guarded_collective(
+        multihost_utils.process_allgather, x, tiled=tiled, description=description
+    )
 
 
 def world_size() -> int:
@@ -149,12 +170,11 @@ def _allgather_ragged_dim0(x: Array) -> Array:
     silent desync).
     """
     import numpy as np
-    from jax.experimental import multihost_utils
 
     x = jnp.asarray(x)
     trail = x.shape[1:]
     desc = _encode_descriptor(x.shape[0], trail, x.dtype)
-    g_desc = np.asarray(multihost_utils.process_allgather(jnp.asarray(desc), tiled=False))
+    g_desc = np.asarray(_process_allgather(jnp.asarray(desc), tiled=False, description="ragged descriptor exchange"))
     g_desc = g_desc.reshape(-1, _DESC_LEN)
     sizes = g_desc[:, 0]
     max_size = int(sizes.max()) if sizes.size else 0
@@ -179,7 +199,7 @@ def _allgather_ragged_dim0(x: Array) -> Array:
         return x  # world-wide empty, but now with a consistent spec on every host
     pad_width = [(0, max_size - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
     padded = jnp.pad(x, pad_width)
-    gathered = multihost_utils.process_allgather(padded, tiled=False)  # [world, max, ...]
+    gathered = _process_allgather(padded, tiled=False, description="ragged payload gather")  # [world, max, ...]
     pieces = [gathered[i, : int(sizes[i])] for i in range(gathered.shape[0])]
     return jnp.concatenate(pieces, axis=0)
 
@@ -216,11 +236,9 @@ def allgather_ragged_arrays(arrays: List, ndim: int, dtype=jnp.float32) -> List:
 
 
 def _sync_leaf_multihost(x: Array, reduction: Reduction) -> Array:
-    from jax.experimental import multihost_utils
-
     if reduction == Reduction.CAT:
         return _allgather_ragged_dim0(x)
-    gathered = multihost_utils.process_allgather(x, tiled=False)  # [world, ...]
+    gathered = _process_allgather(x, tiled=False, description=f"{reduction} leaf gather")  # [world, ...]
     if reduction == Reduction.SUM:
         return jnp.sum(gathered, axis=0)
     if reduction == Reduction.MEAN:
@@ -267,10 +285,8 @@ def sync_state(
                 gathered_counts = lax.all_gather(value.count, axis_name, axis=0)
                 out[name] = value.concat_gathered(gathered_data, gathered_counts)
             elif distributed_available():
-                from jax.experimental import multihost_utils
-
-                gathered_data = multihost_utils.process_allgather(value.data, tiled=False)
-                gathered_counts = multihost_utils.process_allgather(value.count, tiled=False)
+                gathered_data = _process_allgather(value.data, tiled=False, description="masked-buffer data gather")
+                gathered_counts = _process_allgather(value.count, tiled=False, description="masked-buffer count gather")
                 out[name] = value.concat_gathered(jnp.asarray(gathered_data), jnp.asarray(gathered_counts))
             else:
                 out[name] = value
@@ -309,8 +325,6 @@ def gather_all_tensors(x: Array, axis_name: Optional[str] = None) -> List[Array]
         stacked = lax.all_gather(x, axis_name, axis=0)  # [axis_size, ...]
         return [stacked[i] for i in range(stacked.shape[0])]
     if distributed_available():
-        from jax.experimental import multihost_utils
-
-        gathered = multihost_utils.process_allgather(x, tiled=False)
+        gathered = _process_allgather(x, tiled=False, description="gather_all_tensors")
         return [gathered[i] for i in range(gathered.shape[0])]
     return [x]

@@ -1,0 +1,68 @@
+"""Fault-tolerance layer: update guards, retrying fetches, degraded sync, fault injection.
+
+The reference library assumes every ``update()`` succeeds, every collective
+completes, and every pretrained-weight download arrives intact. On preemptible
+TPU pods none of that holds: hosts drop mid-run, links hang, downloads truncate,
+and one NaN batch can poison an epoch of accumulated metric state. This package
+makes each of those failure modes survivable — and *observable* — without
+touching the happy path:
+
+- :mod:`~torchmetrics_tpu.robust.policy` — per-metric / global **error
+  policies** (``raise`` | ``warn_skip`` | ``quarantine``) applied in the
+  ``Metric`` update path. The default (no policy configured) is byte-for-byte
+  today's behavior: no input screening, exceptions propagate.
+- :mod:`~torchmetrics_tpu.robust.retry` — deterministic (jitter-free)
+  exponential backoff with deadline support, plus :func:`fetch_resource` /
+  :func:`fetch_bytes` for external resources with checksum/size validation,
+  atomic writes, and corrupted-cache purge-and-refetch.
+- :mod:`~torchmetrics_tpu.robust.degraded` — a timeout + bounded-retry guard
+  around the *eager* multi-host collectives in ``parallel/sync.py``. On
+  exhaustion the metric degrades to local-only state with a loud warning and a
+  ``sync_degraded`` flag instead of hanging the job. The SPMD/jit path is
+  untouched — XLA collectives cannot be retried from Python.
+- :mod:`~torchmetrics_tpu.robust.faults` — deterministic fault-injection
+  context managers (NaN bursts, raising/hanging collectives, truncated
+  downloads) used by ``tests/core/test_fault_tolerance.py``.
+"""
+
+from torchmetrics_tpu.robust.degraded import (
+    CollectiveError,
+    CollectiveTimeoutError,
+    configure_sync_guard,
+    sync_guard,
+)
+from torchmetrics_tpu.robust.policy import (
+    ErrorPolicy,
+    UpdateGuardError,
+    error_policy,
+    get_error_policy,
+    set_error_policy,
+)
+from torchmetrics_tpu.robust.retry import (
+    ResourceIntegrityError,
+    RetryError,
+    RetrySchedule,
+    fetch_bytes,
+    fetch_resource,
+    load_with_cache_recovery,
+    retry_call,
+)
+
+__all__ = [
+    "CollectiveError",
+    "CollectiveTimeoutError",
+    "ErrorPolicy",
+    "ResourceIntegrityError",
+    "RetryError",
+    "RetrySchedule",
+    "UpdateGuardError",
+    "configure_sync_guard",
+    "error_policy",
+    "fetch_bytes",
+    "fetch_resource",
+    "get_error_policy",
+    "load_with_cache_recovery",
+    "retry_call",
+    "set_error_policy",
+    "sync_guard",
+]

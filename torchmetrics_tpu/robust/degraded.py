@@ -1,0 +1,167 @@
+"""Timeout + bounded-retry guard for *eager* multi-host collectives.
+
+``parallel/sync.py``'s eager path calls ``multihost_utils.process_allgather``,
+which blocks until every host enters the collective — one preempted host hangs
+the whole job forever. This module wraps those call sites:
+
+- **Unconfigured (default)**: a direct call, byte-for-byte today's behavior.
+- **Configured** (:func:`configure_sync_guard` / :func:`sync_guard`): each
+  collective runs in a daemon worker thread with a timeout; synchronously-raised
+  transport failures get bounded retries. A timed-out collective's thread is
+  *abandoned* (Python cannot cancel a blocked gRPC wait) — leaking one parked
+  thread is the price of not hanging the job — and a timeout is **never
+  retried**: the abandoned thread may still complete the collective with the
+  other hosts later, so a retry could pair with the world's next collective and
+  gather mismatched payloads.
+- **Exhaustion**: :class:`CollectiveError` propagates to ``Metric.sync``, which
+  degrades to local-only state with a loud warning and ``metric.sync_degraded``
+  set — observable, not fatal. The degrade is **per host**: configure the guard
+  on every host (the config is process-global), so the survivors' own guards
+  time out their now-short-handed collectives instead of hanging.
+
+Only the eager path is guarded. Inside ``jit``/``shard_map`` collectives are
+compiled XLA ops that cannot be intercepted or retried from Python; pod-level
+preemption recovery there belongs to the training loop's checkpoint/restore.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Optional
+
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = [
+    "CollectiveError",
+    "CollectiveTimeoutError",
+    "configure_sync_guard",
+    "guarded_collective",
+    "sync_guard",
+]
+
+
+class CollectiveError(RuntimeError):
+    """An eager collective failed all its guarded attempts."""
+
+
+class CollectiveTimeoutError(CollectiveError):
+    """A single guarded collective attempt exceeded its timeout."""
+
+
+# process-global guard config; None timeout = guard disabled (direct calls)
+_CONFIG = {"timeout": None, "retries": 1}
+
+
+def configure_sync_guard(timeout: Optional[float] = None, retries: int = 1) -> dict:
+    """Set the eager-sync guard: per-attempt ``timeout`` seconds and bounded
+    ``retries`` after the first attempt. ``timeout=None`` disables the guard.
+    Returns the previous configuration."""
+    if timeout is not None and timeout <= 0:
+        raise ValueError(f"Expected `timeout` to be positive or None, got {timeout}")
+    if retries < 0:
+        raise ValueError(f"Expected `retries` to be >= 0, got {retries}")
+    previous = dict(_CONFIG)
+    _CONFIG["timeout"] = timeout
+    _CONFIG["retries"] = retries
+    return previous
+
+
+@contextmanager
+def sync_guard(timeout: Optional[float], retries: int = 1):
+    """Scoped guard config: ``with sync_guard(timeout=30.0, retries=2): ...``."""
+    previous = configure_sync_guard(timeout, retries)
+    try:
+        yield
+    finally:
+        _CONFIG.update(previous)
+
+
+def _attempt(fn: Callable[..., Any], args: tuple, kwargs: dict, timeout: Optional[float], description: str) -> Any:
+    """One guarded attempt: consult fault injection, then run under ``timeout``."""
+    from torchmetrics_tpu.robust import faults
+
+    injected = faults.next_collective_fault()
+    if injected == "raise":
+        raise CollectiveError(f"injected failure in {description}")
+    if injected == "hang":
+        if timeout is None:
+            raise CollectiveTimeoutError(
+                f"injected hang in {description} with no timeout configured"
+            )
+        threading.Event().wait(timeout)  # a real (bounded) wait: exercises the timeout path
+        raise CollectiveTimeoutError(f"{description} timed out after {timeout:g}s (injected hang)")
+
+    if timeout is None:
+        return fn(*args, **kwargs)
+
+    result: list = []
+    error: list = []
+
+    def _run() -> None:
+        try:
+            result.append(fn(*args, **kwargs))
+        except BaseException as err:  # noqa: BLE001 - relayed to the caller below
+            error.append(err)
+
+    worker = threading.Thread(target=_run, daemon=True, name=f"guarded-{description}")
+    worker.start()
+    worker.join(timeout)
+    if worker.is_alive():
+        # the blocked collective cannot be cancelled; abandon its thread
+        raise CollectiveTimeoutError(f"{description} timed out after {timeout:g}s")
+    if error:
+        raise error[0]
+    return result[0]
+
+
+def guarded_collective(fn: Callable[..., Any], *args: Any, description: str = "collective", **kwargs: Any) -> Any:
+    """Run an eager collective under the configured guard.
+
+    Direct call when the guard is unconfigured and no fault is injected (the
+    default — zero overhead). Otherwise: up to ``1 + retries`` attempts, each
+    bounded by ``timeout``; on exhaustion raises :class:`CollectiveError` so the
+    caller can degrade instead of hanging.
+    """
+    from torchmetrics_tpu.robust import faults
+
+    timeout = _CONFIG["timeout"]
+    if timeout is None and not faults.collective_faults_active():
+        return fn(*args, **kwargs)
+
+    attempts = 1 + int(_CONFIG["retries"])
+    last_err: Optional[BaseException] = None
+    made = 0
+    for attempt in range(attempts):
+        made += 1
+        try:
+            return _attempt(fn, args, kwargs, timeout, description)
+        except CollectiveTimeoutError as err:
+            # NEVER retry a timed-out collective: the abandoned worker thread
+            # may still be parked inside it and could complete it later with
+            # the other hosts — a retry from this host would then pair with
+            # the world's NEXT collective and silently gather mismatched
+            # payloads. Degrading immediately keeps this host's view
+            # consistent; the other hosts' guards time out their own
+            # now-short-handed collectives in turn.
+            last_err = err
+            break
+        except _RETRYABLE as err:  # noqa: PERF203 - bounded retry loop by design
+            last_err = err
+            if attempt + 1 < attempts:
+                rank_zero_warn(
+                    f"Eager collective {description} failed (attempt {attempt + 1}/{attempts}):"
+                    f" {err}. Retrying.",
+                    RuntimeWarning,
+                )
+    raise CollectiveError(
+        f"Eager collective {description} failed after {made} attempt(s): {last_err}"
+    ) from last_err
+
+
+# only transport-shaped failures retry and degrade: timeouts, I/O errors, and
+# runtime errors (jaxlib's XlaRuntimeError subclasses RuntimeError). Determinis-
+# tic programming errors (TypeError, ValueError from mismatched shapes, ...)
+# propagate immediately — degrading those would turn a loud bug into silently
+# local-only metric values.
+_RETRYABLE = (CollectiveError, TimeoutError, OSError, RuntimeError, ConnectionError)

@@ -1,0 +1,155 @@
+"""Update-guard error policies for the ``Metric`` runtime.
+
+A policy decides what happens when a batch fails inside ``Metric.update`` —
+non-finite inputs, shape/dtype mismatches, or any exception raised by the
+subclass ``update`` body:
+
+- ``raise``: guards run and failures raise (non-finite inputs raise
+  :class:`UpdateGuardError`; update exceptions propagate). State is rolled back
+  so a failed batch never leaves partial mutations behind.
+- ``warn_skip``: the batch is dropped with a warning; accumulated state and the
+  update count are exactly what a clean-batches-only run would produce.
+- ``quarantine``: like ``warn_skip``, but the offending batch (host copies) and
+  the failure reason are retained on ``metric.quarantined_batches`` for
+  post-mortem.
+
+With **no policy configured** (the default) the update path is byte-for-byte
+the legacy one: no input screening (screening forces a host sync per batch),
+exceptions propagate, zero overhead. Policies resolve per metric first
+(``Metric(..., error_policy="warn_skip")``), then from the process-global
+default (:func:`set_error_policy` / the :func:`error_policy` context manager).
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from enum import Enum
+from typing import Any, Optional, Union
+
+import numpy as np
+
+__all__ = [
+    "ErrorPolicy",
+    "UpdateGuardError",
+    "coerce_policy",
+    "effective_policy",
+    "error_policy",
+    "first_nonfinite",
+    "get_error_policy",
+    "set_error_policy",
+]
+
+
+class ErrorPolicy(str, Enum):
+    """What a metric does with a batch that fails its update guards."""
+
+    RAISE = "raise"
+    WARN_SKIP = "warn_skip"
+    QUARANTINE = "quarantine"
+
+
+class UpdateGuardError(ValueError):
+    """Raised (under the ``raise`` policy) when update input validation fails."""
+
+
+PolicyLike = Union[None, str, ErrorPolicy]
+
+_GLOBAL_POLICY: Optional[ErrorPolicy] = None
+
+
+def coerce_policy(value: PolicyLike) -> Optional[ErrorPolicy]:
+    """Normalize ``None`` / strings / :class:`ErrorPolicy` to an optional policy."""
+    if value is None:
+        return None
+    try:
+        return ErrorPolicy(value)
+    except ValueError:
+        raise ValueError(
+            f"Invalid error policy {value!r}. Expected one of"
+            f" {[p.value for p in ErrorPolicy]} or None."
+        ) from None
+
+
+def set_error_policy(policy: PolicyLike) -> Optional[ErrorPolicy]:
+    """Set the process-global error policy; returns the previous one.
+
+    ``None`` restores the unconfigured default (legacy fast path).
+    """
+    global _GLOBAL_POLICY
+    previous = _GLOBAL_POLICY
+    _GLOBAL_POLICY = coerce_policy(policy)
+    return previous
+
+
+def get_error_policy() -> Optional[ErrorPolicy]:
+    """The process-global error policy (``None`` when unconfigured)."""
+    return _GLOBAL_POLICY
+
+
+@contextmanager
+def error_policy(policy: PolicyLike):
+    """Scoped global error policy: ``with error_policy("warn_skip"): ...``."""
+    previous = set_error_policy(policy)
+    try:
+        yield
+    finally:
+        set_error_policy(previous)
+
+
+def effective_policy(metric_policy: PolicyLike) -> Optional[ErrorPolicy]:
+    """Resolve a metric's policy: per-metric setting wins, else the global one."""
+    resolved = coerce_policy(metric_policy)
+    return resolved if resolved is not None else _GLOBAL_POLICY
+
+
+def _leaf_nonfinite(value: Any) -> bool:
+    """True when ``value`` is a floating array-like containing non-finite entries.
+
+    Forces a host readback for device arrays — only ever called on the guarded
+    (non-default) update path.
+    """
+    if value is None or isinstance(value, (bool, int, str, bytes)):
+        return False
+    if isinstance(value, float):
+        return not np.isfinite(value)
+    if hasattr(value, "dtype") and hasattr(value, "shape"):
+        import jax
+
+        if isinstance(value, jax.core.Tracer):
+            # inside a user jit the values are abstract — screening is
+            # impossible (and np.asarray would raise, which must not be
+            # mistaken for a bad batch). Skip; traced updates behave as the
+            # unscreened legacy path.
+            return False
+        host = np.asarray(value)
+        if not np.issubdtype(host.dtype, np.floating) and not np.issubdtype(host.dtype, np.complexfloating):
+            return False
+        return not bool(np.isfinite(host).all())
+    return False
+
+
+def first_nonfinite(args: tuple, kwargs: dict) -> Optional[str]:
+    """Name/position of the first update argument holding non-finite values.
+
+    Scans positional and keyword arguments, descending one level into
+    lists/tuples (the common ``update(list_of_arrays)`` signature). Returns
+    ``None`` when everything is finite.
+    """
+
+    def _scan(label: str, value: Any) -> Optional[str]:
+        if isinstance(value, (list, tuple)):
+            for i, item in enumerate(value):
+                if _leaf_nonfinite(item):
+                    return f"{label}[{i}]"
+            return None
+        return label if _leaf_nonfinite(value) else None
+
+    for i, value in enumerate(args):
+        hit = _scan(f"positional argument {i}", value)
+        if hit is not None:
+            return hit
+    for name, value in kwargs.items():
+        hit = _scan(f"argument {name!r}", value)
+        if hit is not None:
+            return hit
+    return None

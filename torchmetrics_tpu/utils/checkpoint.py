@@ -5,21 +5,44 @@ hooks, reference ``metric.py:858-924``); the TPU-native analog is an orbax pytre
 checkpoint: every state — including non-persistent ones, mid-epoch — is written as a
 host pytree and restored into a freshly constructed metric of the same spec.
 
-Layout written to ``<path>/``: one subtree per metric (collections nest by metric
-name) holding ``states`` plus ``update_count`` so a restored metric resumes exactly
-where the checkpoint was taken (no compute-before-update warning, same results).
+Preemption-safe layout (since the fault-tolerance PR): a checkpoint directory holds
+``data/`` (the orbax pytree) plus ``INTEGRITY.json`` (a SHA-256 digest over every
+leaf). Saves build the whole directory under a temp name and swap it into place with
+directory renames, so a host preempted mid-checkpoint can never leave a truncated
+tree masquerading as a valid resume point; loads verify the digest and raise
+:class:`CheckpointIntegrityError` on mismatch. Checkpoints written by older
+versions (the orbax tree directly at ``<path>``, no integrity record) still load.
+
+Layout written to ``<path>/data``: one subtree per metric (collections nest by
+metric name) holding ``states`` plus ``update_count`` so a restored metric resumes
+exactly where the checkpoint was taken (no compute-before-update warning, same
+results).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
-from typing import Any, Dict, Union
+import shutil
+import uuid
+from typing import Any, Dict, Optional, Union
 
 import numpy as np
 
-from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.core.metric import _ROBUST_STATE_KEY, Metric
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["CheckpointIntegrityError", "load_checkpoint", "save_checkpoint"]
+
+_DATA_SUBDIR = "data"
+_INTEGRITY_NAME = "INTEGRITY.json"
+# displaced .old./.tmp. siblings younger than this may belong to a live
+# concurrent save and are never swept (see save_checkpoint)
+_STALE_SIBLING_AGE_S = 3600.0
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """The checkpoint on disk is truncated, tampered, or half-written."""
 
 
 def _require_orbax():
@@ -57,6 +80,8 @@ def _restore_states(metric: Metric, tree: Dict[str, Any]) -> None:
         )
     states = tree.get("states", {}) or {}
     payload: Dict[str, Any] = {}
+    if _ROBUST_STATE_KEY in states:  # update-guard counters ride along
+        payload[_ROBUST_STATE_KEY] = states[_ROBUST_STATE_KEY]
     for key in metric._defaults:
         if key not in states:
             # empty containers are dropped by orbax on save — restore as empty
@@ -84,17 +109,151 @@ def _tree_of(target: Union[Metric, Any]) -> Dict[str, Any]:
     return {name: _host_states(m) for name, m in target.items()}
 
 
+def _tree_digest(tree: Any) -> str:
+    """Deterministic SHA-256 over every leaf (path, dtype, shape, bytes)."""
+    digest = hashlib.sha256()
+
+    def _walk(prefix: str, node: Any) -> None:
+        if isinstance(node, dict):
+            for key in sorted(node):
+                _walk(f"{prefix}/{key}", node[key])
+            return
+        leaf = np.asarray(node)
+        digest.update(prefix.encode())
+        digest.update(str(leaf.dtype).encode())
+        digest.update(str(leaf.shape).encode())
+        digest.update(np.ascontiguousarray(leaf).tobytes())
+
+    _walk("", tree)
+    return digest.hexdigest()
+
+
 def save_checkpoint(target: Union[Metric, Any], path: str) -> str:
     """Write ``target``'s full state (mid-epoch included) to ``path`` via orbax.
 
     ``target`` is a :class:`Metric` or a ``MetricCollection``. Returns the absolute
-    checkpoint path. Overwrites an existing checkpoint at the same path.
+    checkpoint path. Overwrites an existing checkpoint at the same path — atomically:
+    the new checkpoint is fully materialized (tree + integrity record) under a temp
+    directory first, then swapped in with renames, so preemption mid-save leaves
+    either the old checkpoint or the new one, never a hybrid.
     """
     ocp = _require_orbax()
 
     path = os.path.abspath(path)
-    ocp.PyTreeCheckpointer().save(path, _tree_of(target), force=True)
+    tree = _tree_of(target)
+    # tag beyond the pid: containerized pod hosts commonly share pid 1, and two
+    # hosts saving to the same shared-storage path must never collide on tmp
+    tag = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    tmp = f"{path}.tmp.{tag}"
+    try:
+        ocp.PyTreeCheckpointer().save(os.path.join(tmp, _DATA_SUBDIR), tree, force=True)
+        with open(os.path.join(tmp, _INTEGRITY_NAME), "w") as fh:
+            json.dump({"version": 1, "sha256": _tree_digest(tree)}, fh)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    # swap with a displace-then-rename loop: a concurrent saver can install a
+    # new dir at `path` between our displace and rename (ENOTEMPTY) — displace
+    # again and retry rather than stranding the fully-written tmp
+    displaced = []
+    for attempt in range(3):
+        old = f"{path}.old.{tag}.{attempt}"
+        try:
+            if os.path.exists(path):
+                os.rename(path, old)
+                displaced.append(old)
+            os.rename(tmp, path)
+            break
+        except OSError:
+            if attempt == 2:
+                raise
+    for old in displaced:
+        shutil.rmtree(old, ignore_errors=True)
+    # a successful swap supersedes siblings leaked by earlier preempted saves
+    # under other pids — but another process may be mid-save to the same path
+    # right now, so only sweep dirs old enough that no live save owns them
+    import glob
+    import time
+
+    cutoff = time.time() - _STALE_SIBLING_AGE_S
+    for stale in glob.glob(f"{path}.old.*") + glob.glob(f"{path}.tmp.*"):
+        try:
+            if os.path.getmtime(stale) < cutoff:
+                shutil.rmtree(stale, ignore_errors=True)
+        except OSError:
+            pass  # vanished under us (another sweeper won the race)
     return path
+
+
+def _recover_displaced(path: str) -> Optional[str]:
+    """Newest ``<path>.old.<pid>``/``<path>.tmp.<pid>`` sibling that verifies.
+
+    A preemption between ``save_checkpoint``'s two directory renames leaves no
+    checkpoint at ``path`` but a complete one displaced under a pid-suffixed
+    name (``.old.*`` = the previous good checkpoint; ``.tmp.*`` = the new one,
+    already fully written since INTEGRITY.json lands before any rename).
+    """
+    import glob
+
+    candidates = sorted(
+        glob.glob(f"{path}.old.*") + glob.glob(f"{path}.tmp.*"),
+        key=os.path.getmtime,
+        reverse=True,
+    )
+    for candidate in candidates:
+        if os.path.isfile(os.path.join(candidate, _INTEGRITY_NAME)):
+            return candidate
+    return None
+
+
+def _restore_verified(ocp, path: str) -> Dict[str, Any]:
+    """Restore the pytree at ``path``, verifying the integrity record when present.
+
+    Layout discrimination is on ``INTEGRITY.json``, not on a ``data/`` subdir —
+    a *legacy* MetricCollection checkpoint holding a metric literally named
+    "data" has a ``<path>/data/`` subtree but no integrity record, and must
+    restore as the legacy layout. The atomic save guarantees every new-layout
+    checkpoint reaching ``path`` carries its integrity record.
+    """
+    if not os.path.exists(path):
+        displaced = _recover_displaced(path)
+        if displaced is None:
+            raise FileNotFoundError(f"No checkpoint at {path} (and no displaced sibling to recover)")
+        from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+        rank_zero_warn(
+            f"No checkpoint at {path}, but a save interrupted mid-swap left a complete"
+            f" one at {displaced}; recovering from it. Re-save to normalize the path.",
+            RuntimeWarning,
+        )
+        path = displaced
+    integrity_path = os.path.join(path, _INTEGRITY_NAME)
+    if not os.path.isfile(integrity_path):
+        # pre-fault-tolerance layout: the orbax tree sits at `path` directly
+        return ocp.PyTreeCheckpointer().restore(path)
+    data_dir = os.path.join(path, _DATA_SUBDIR)
+    try:
+        restored = ocp.PyTreeCheckpointer().restore(data_dir)
+    except Exception as err:
+        raise CheckpointIntegrityError(
+            f"Checkpoint at {path} is unreadable (truncated or half-written?): {err}"
+        ) from err
+    try:
+        with open(integrity_path) as fh:
+            recorded = json.load(fh)
+    except (OSError, ValueError) as err:
+        raise CheckpointIntegrityError(
+            f"Checkpoint at {path} has an unreadable {_INTEGRITY_NAME} ({err}) —"
+            " the record itself is truncated or tampered; restore from an older checkpoint."
+        ) from err
+    digest = _tree_digest(restored)
+    if digest != recorded.get("sha256"):
+        raise CheckpointIntegrityError(
+            f"Checkpoint at {path} failed its integrity check (recorded"
+            f" {str(recorded.get('sha256'))[:12]}…, recomputed {digest[:12]}…) —"
+            " the data was corrupted after the save; restore from an older checkpoint."
+        )
+    return restored
 
 
 def load_checkpoint(target: Union[Metric, Any], path: str) -> Union[Metric, Any]:
@@ -102,11 +261,12 @@ def load_checkpoint(target: Union[Metric, Any], path: str) -> Union[Metric, Any]
 
     ``target`` must be constructed with the same spec (same metric classes and
     arguments) as the checkpointed one — exactly the reference's ``load_state_dict``
-    contract. Returns ``target``.
+    contract. Verifies the checkpoint's integrity record (when present) and raises
+    :class:`CheckpointIntegrityError` on corruption. Returns ``target``.
     """
     ocp = _require_orbax()
 
-    restored = ocp.PyTreeCheckpointer().restore(os.path.abspath(path))
+    restored = _restore_verified(ocp, os.path.abspath(path))
     if isinstance(target, Metric):
         _restore_states(target, restored)
         return target

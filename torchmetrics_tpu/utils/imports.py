@@ -40,6 +40,7 @@ _SENTENCEPIECE_AVAILABLE = _package_available("sentencepiece")
 _TQDM_AVAILABLE = _package_available("tqdm")
 _MECAB_AVAILABLE = _package_available("MeCab")
 _IPADIC_AVAILABLE = _package_available("ipadic")
+_MECAB_KO_DIC_AVAILABLE = _package_available("mecab_ko_dic")
 
 _PYTHON_GREATER_EQUAL_3_11 = sys.version_info >= (3, 11)
 _LATEX_AVAILABLE = shutil.which("latex") is not None
