@@ -1,0 +1,729 @@
+"""Observability layer: spans, jit-cache metrics, collective timings, exporters.
+
+Everything here is deterministic and CPU-only: the multihost world is faked the
+same way the fault-tolerance suite fakes it, the only real wait is an injected
+hanging collective parking on a millisecond guard timeout, and exporter goldens
+are asserted with the wall-clock fields stripped.
+"""
+
+import io
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import multihost_utils
+
+from torchmetrics_tpu import obs, robust
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.core.jit import StaticLeafJit
+from torchmetrics_tpu.obs import export, trace
+from torchmetrics_tpu.parallel import sync as sync_mod
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.robust import faults
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    """Every test starts and ends with tracing off and an empty recorder."""
+    trace.disable()
+    trace.get_recorder().clear()
+    trace.get_recorder().max_events = 4096
+    yield
+    trace.disable()
+    trace.get_recorder().clear()
+    trace.get_recorder().max_events = 4096
+
+
+# ------------------------------------------------------------------ span recorder
+
+
+class TestSpansAndRingBuffer:
+    def test_disabled_records_nothing(self):
+        with trace.span("outer"):
+            trace.event("ev")
+            trace.inc("count")
+        snap = trace.get_recorder().snapshot()
+        assert snap["events"] == [] and snap["counters"] == []
+
+    def test_span_nesting_depths_and_durations(self):
+        with trace.observe():
+            with trace.span("outer", metric="M"):
+                with trace.span("inner"):
+                    pass
+        events = trace.get_recorder().events()
+        by_name = {e["name"]: e for e in events}
+        assert by_name["inner"]["depth"] == 1
+        assert by_name["outer"]["depth"] == 0
+        assert by_name["outer"]["dur"] >= by_name["inner"]["dur"] >= 0
+        assert by_name["outer"]["attrs"] == {"metric": "M"}
+
+    def test_ring_buffer_bounds_and_dropped_counter(self):
+        with trace.observe(max_events=8):
+            for i in range(20):
+                trace.event("ev", i=i)
+        rec = trace.get_recorder()
+        events = rec.events()
+        assert len(events) == 8
+        assert rec.dropped_events == 12
+        # drop-oldest: the survivors are the 8 most recent
+        assert [e["attrs"]["i"] for e in events] == list(range(12, 20))
+
+    def test_observe_restores_prior_state_and_keeps_data(self):
+        assert not trace.is_enabled()
+        with trace.observe():
+            assert trace.is_enabled()
+            trace.inc("kept")
+        assert not trace.is_enabled()
+        assert trace.get_recorder().counter_value("kept") == 1
+
+    def test_nested_observe_keeps_outer_session_data(self):
+        trace.enable()
+        try:
+            trace.inc("outer_data")
+            with trace.observe():  # nested: must NOT reset the live session
+                trace.inc("inner_data")
+            assert trace.is_enabled()  # outer session still on
+            rec = trace.get_recorder()
+            assert rec.counter_value("outer_data") == 1
+            assert rec.counter_value("inner_data") == 1
+        finally:
+            trace.disable()
+
+    def test_observe_restores_max_events_override(self):
+        before = trace.get_recorder().max_events
+        with trace.observe(max_events=8):
+            assert trace.get_recorder().max_events == 8
+        assert trace.get_recorder().max_events == before
+
+    def test_raised_cap_capture_stays_exportable_after_exit(self):
+        default_cap = trace.get_recorder().max_events
+        with trace.observe(max_events=default_cap * 2) as rec:
+            for i in range(default_cap + 100):
+                trace.event("ev", i=i)
+        # exit restored the cap but did NOT evict the captured events
+        assert trace.get_recorder().max_events == default_cap
+        assert len(rec.events()) == default_cap + 100
+        assert rec.dropped_events == 0
+
+    def test_lowering_max_events_trims_live_buffer(self):
+        with trace.observe():
+            for i in range(100):
+                trace.event("ev", i=i)
+            trace.enable(max_events=16, reset=False)  # rebound without clearing
+            rec = trace.get_recorder()
+            assert len(rec.events()) == 16
+            assert rec.dropped_events == 84
+            assert [e["attrs"]["i"] for e in rec.events()] == list(range(84, 100))
+
+    def test_annotate_current_span(self):
+        with trace.observe():
+            with trace.span("s", path="jit"):
+                trace.annotate_current_span(path="eager_fallback", extra="x")
+        span_event = trace.get_recorder().events()[0]
+        assert span_event["attrs"] == {"path": "eager_fallback", "extra": "x"}
+
+    def test_warning_dedup_set_is_bounded(self):
+        rec = trace.get_recorder()
+        with trace.observe():
+            rec.max_tracked_warnings = 4
+            try:
+                for i in range(10):
+                    assert trace.record_warning(f"distinct message {i}")
+            finally:
+                del rec.max_tracked_warnings  # restore the class default
+        assert len(rec._seen_warnings) == 4  # capped, later messages still emitted
+
+    def test_nested_observe_ignores_max_events_override(self):
+        trace.enable()
+        try:
+            for i in range(50):
+                trace.event("outer", i=i)
+            with trace.observe(max_events=8):  # shared ring: override ignored
+                trace.event("inner")
+            assert len(trace.get_recorder().events()) == 51
+            assert trace.get_recorder().dropped_events == 0
+        finally:
+            trace.disable()
+
+    def test_series_cardinality_is_bounded(self):
+        rec = trace.get_recorder()
+        with trace.observe():
+            rec.max_series = 8
+            try:
+                for i in range(20):
+                    trace.inc("c", inst=str(i))
+                    trace.set_gauge("g", i, inst=str(i))
+                    trace.observe_duration("d", 0.001, inst=str(i))
+            finally:
+                del rec.max_series  # restore the class default
+        snap = rec.snapshot()
+        # 8-series cap per table (counters also hold the series.dropped counter)
+        assert len(snap["gauges"]) == 8
+        assert len(snap["histograms"]) == 8
+        assert rec.counter_value("series.dropped") > 0
+        # established series keep accumulating past the cap
+        trace.enable(reset=False)
+        trace.inc("c", inst="0")
+        trace.disable()
+        assert rec.counter_value("c", inst="0") == 2
+
+    def test_counters_with_labels_and_sum(self):
+        with trace.observe():
+            trace.inc("c", fn="a")
+            trace.inc("c", fn="a")
+            trace.inc("c", 3, fn="b")
+        rec = trace.get_recorder()
+        assert rec.counter_value("c", fn="a") == 2
+        assert rec.counter_value("c", fn="b") == 3
+        assert rec.counter_value("c") == 5
+
+    def test_histogram_buckets(self):
+        with trace.observe():
+            trace.observe_duration("d", 5e-4)
+            trace.observe_duration("d", 5e-4)
+            trace.observe_duration("d", 2.0)
+        hist = trace.get_recorder().snapshot()["histograms"][0]
+        buckets = dict((b, c) for b, c in hist["buckets"])
+        assert buckets[1e-3] == 2 and buckets[10.0] == 1
+        assert hist["count"] == 3 and hist["sum"] == pytest.approx(2.001)
+
+
+# ------------------------------------------------------------------- jit metrics
+
+
+class TestJitCacheMetrics:
+    def test_hit_miss_counts_and_compile_span(self):
+        sl = StaticLeafJit(lambda state, x, k: state + x * k)
+        with trace.observe():
+            state = jnp.zeros(3)
+            sl(state, jnp.ones(3), 2)   # miss (compile)
+            sl(state, jnp.ones(3), 2)   # hit
+            sl(state, jnp.ones(3), 3)   # miss: new static value
+        rec = trace.get_recorder()
+        assert rec.counter_value("jit.cache_miss") == 2
+        assert rec.counter_value("jit.cache_hit") == 1
+        compile_spans = [e for e in rec.events() if e["name"] == "jit.compile"]
+        assert len(compile_spans) == 2
+        assert all(e["dur"] > 0 for e in compile_spans)
+        gauges = {g["name"]: g["value"] for g in rec.snapshot()["gauges"]}
+        assert gauges["jit.cache_size"] == 2
+
+    def test_metric_update_dispatch_labels_metric_class(self):
+        m = MeanSquaredError()
+        with trace.observe():
+            m.update(jnp.ones(4), jnp.zeros(4))
+            m.update(jnp.ones(4), jnp.zeros(4))
+        rec = trace.get_recorder()
+        assert rec.counter_value("jit.cache_miss", fn="MeanSquaredError.pure_update") == 1
+        assert rec.counter_value("jit.cache_hit", fn="MeanSquaredError.pure_update") == 1
+        update_spans = [e for e in rec.events() if e["name"] == "metric.update"]
+        assert len(update_spans) == 2
+        assert update_spans[0]["attrs"] == {"metric": "MeanSquaredError", "path": "jit"}
+
+
+class _Unhashable:
+    __hash__ = None
+
+
+class TestEagerFallback:
+    def test_warns_once_and_counts_every_fallback(self):
+        calls = []
+        sl = StaticLeafJit(lambda state, x: (calls.append(1), state + 1)[1])
+        with trace.observe():
+            with pytest.warns(RuntimeWarning, match="EAGER dispatch"):
+                sl(jnp.zeros(2), _Unhashable())
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # second fallback must NOT re-warn
+                sl(jnp.zeros(2), _Unhashable())
+        assert len(calls) == 2  # both calls ran eagerly
+        rec = trace.get_recorder()
+        assert rec.counter_value("jit.eager_fallback") == 2
+        fallback_events = [e for e in rec.events() if e["name"] == "jit.eager_fallback"]
+        assert fallback_events and fallback_events[0]["attrs"]["leaf_type"] == "_Unhashable"
+
+    def test_fallback_relabels_enclosing_update_span(self):
+        sl = StaticLeafJit(lambda state, x: state + 1)
+        with trace.observe():
+            with trace.span("metric.update", metric="M", path="jit"):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    sl(jnp.zeros(2), _Unhashable())
+        span_event = [e for e in trace.get_recorder().events() if e["kind"] == "span"][0]
+        assert span_event["attrs"]["path"] == "eager_fallback"
+
+    def test_fallback_result_matches_eager(self):
+        sl = StaticLeafJit(lambda state, x: state + 1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            out = sl(jnp.zeros(2), _Unhashable())
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+class TestRecompileStormGuard:
+    def test_warns_once_past_threshold_naming_leaves(self):
+        sl = StaticLeafJit(lambda state, k: state + k)
+        sl.recompile_warn_threshold = 3
+        state = jnp.zeros(1)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for k in range(6):
+                sl(state, k)
+        storm = [w for w in caught if "compiled" in str(w.message) and "variants" in str(w.message)]
+        assert len(storm) == 1  # once, not per extra compile
+        message = str(storm[0].message)
+        assert "4 variants" in message
+        assert "distinct values" in message  # names the churning static leaf
+
+    def test_mixed_structures_reported_without_misattribution(self):
+        sl = StaticLeafJit(lambda state, k=0, extra=0: state + k + extra)
+        sl.recompile_warn_threshold = 3
+        state = jnp.zeros(1)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for k in range(3):
+                sl(state, k)            # structure A: one positional
+            sl(state, 0, extra=1)       # structure B: extra kwarg
+        storm = [w for w in caught if "variants" in str(w.message)]
+        assert len(storm) == 1
+        message = str(storm[0].message)
+        assert "2 distinct argument structures" in message
+        # per-position analysis only within the dominant structure: the churning
+        # positional is named, the constant kwarg is not blamed
+        assert "3 distinct values" in message
+
+    def test_no_warning_below_threshold(self):
+        sl = StaticLeafJit(lambda state, k: state + k)
+        state = jnp.zeros(1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            for k in range(5):  # default threshold is 32
+                sl(state, k)
+
+
+# -------------------------------------------------------------- metric lifecycle
+
+
+class TestMetricLifecycleSpans:
+    def test_compute_forward_reset_instrumented(self):
+        m = MulticlassAccuracy(num_classes=3, validate_args=False)
+        preds = jnp.asarray(np.random.rand(8, 3).astype(np.float32))
+        target = jnp.asarray(np.random.randint(0, 3, 8))
+        with trace.observe():
+            m.update(preds, target)
+            np.asarray(m.compute())
+            m.forward(preds, target)
+            m.reset()
+        rec = trace.get_recorder()
+        names = [e["name"] for e in rec.events()]
+        assert "metric.compute" in names
+        assert "metric.update" in names
+        forward_spans = [e for e in rec.events() if e["name"] == "metric.forward"]
+        assert len(forward_spans) == 1
+        assert forward_spans[0]["attrs"]["metric"] == "MulticlassAccuracy"
+        assert forward_spans[0]["attrs"]["path"] in ("full_state", "reduce_state")
+        assert rec.counter_value("metric.reset", metric="MulticlassAccuracy") == 1
+
+    def test_cached_compute_counted_not_spanned(self):
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with trace.observe():
+            np.asarray(m.compute())  # computes
+            np.asarray(m.compute())  # cache hit
+        rec = trace.get_recorder()
+        spans = [e for e in rec.events() if e["name"] == "metric.compute"]
+        assert len(spans) == 1
+        assert rec.counter_value("metric.compute_cached", metric="MeanSquaredError") == 1
+
+
+# ------------------------------------------------------------- collective timing
+
+
+def _fake_allgather(x, tiled=False):
+    x = jnp.asarray(x)
+    return jnp.stack([x, x])  # two-host world, both hosts identical
+
+
+@pytest.fixture()
+def two_host_world(monkeypatch):
+    monkeypatch.setattr(multihost_utils, "process_allgather", _fake_allgather)
+    monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+
+
+class TestSyncTelemetry:
+    def test_successful_sync_records_timing_and_bytes(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with trace.observe():
+            m.sync()
+            m.unsync()
+        rec = trace.get_recorder()
+        collectives = [e for e in rec.events() if e["name"] == "sync.collective"]
+        assert collectives and all(e["attrs"]["ok"] for e in collectives)
+        assert all(e["attrs"]["seconds"] >= 0 for e in collectives)
+        assert any(e["attrs"]["bytes"] > 0 for e in collectives)
+        assert rec.counter_value("sync.payload_bytes") > 0
+        sync_spans = [e for e in rec.events() if e["name"] == "metric.sync"]
+        assert len(sync_spans) == 1
+        assert any(e["name"] == "metric.unsync" for e in rec.events())
+        assert rec.counter_value("sync.degraded") == 0
+
+    def test_hanging_collective_times_out_with_telemetry(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with trace.observe():
+            with robust.sync_guard(timeout=0.01, retries=1):
+                with faults.inject_collective_fault(mode="hang", times=10):
+                    with pytest.warns(RuntimeWarning, match="DEGRADED"):
+                        m.sync()
+        assert m.sync_degraded
+        rec = trace.get_recorder()
+        assert rec.counter_value("sync.collective_timeout") == 1
+        assert rec.counter_value("sync.degraded", metric="MeanSquaredError") == 1
+        failed = [e for e in rec.events() if e["name"] == "sync.collective"]
+        assert failed and failed[0]["attrs"]["ok"] is False
+        # the failed attempt's wall time reflects the guard timeout actually elapsing
+        assert failed[0]["attrs"]["seconds"] >= 0.01
+        degraded_events = [e for e in rec.events() if e["name"] == "sync.degraded"]
+        assert degraded_events and "timed out" in degraded_events[0]["attrs"]["error"]
+
+    def test_transient_failure_counts_retry(self, two_host_world):
+        m = MeanSquaredError(distributed_available_fn=lambda: True)
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with trace.observe():
+            with robust.sync_guard(timeout=0.5, retries=1):
+                with faults.inject_collective_fault(mode="raise", times=1):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")
+                        m.sync()
+        assert not m.sync_degraded
+        rec = trace.get_recorder()
+        assert rec.counter_value("sync.collective_retry") == 1
+        assert rec.counter_value("sync.degraded") == 0
+        m.unsync()
+
+
+# ---------------------------------------------------------------- warning dedup
+
+
+class TestWarningRouting:
+    def test_dedup_when_tracing(self):
+        with trace.observe():
+            with pytest.warns(UserWarning, match="same message"):
+                rank_zero_warn("same message", UserWarning)
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # duplicate must be swallowed
+                rank_zero_warn("same message", UserWarning)
+            with pytest.warns(UserWarning, match="different"):
+                rank_zero_warn("a different message", UserWarning)
+        rec = trace.get_recorder()
+        warning_events = [e for e in rec.events() if e["kind"] == "warning"]
+        assert [e["attrs"]["message"] for e in warning_events] == ["same message", "a different message"]
+        assert rec.counter_value("warnings.emitted") == 2
+        assert rec.counter_value("warnings.deduplicated") == 1
+
+    def test_no_dedup_when_disabled(self):
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            rank_zero_warn("repeat me", UserWarning)
+            rank_zero_warn("repeat me", UserWarning)
+        assert len(caught) == 2  # legacy behavior untouched
+        assert trace.get_recorder().events() == []
+
+    def test_guarded_warning_reaches_export(self):
+        m = MeanSquaredError(error_policy="warn_skip")
+        with trace.observe():
+            with pytest.warns(RuntimeWarning, match="skipped"):
+                m.update(jnp.full(4, jnp.nan), jnp.zeros(4))
+        text = export.prometheus_text(metrics=[m])
+        assert 'tm_tpu_robust_updates_skipped_total{instance="0",metric="MeanSquaredError"} 1' in text
+        assert trace.get_recorder().counter_value("robust.update_skipped", metric="MeanSquaredError") == 1
+        warning_events = [e for e in trace.get_recorder().events() if e["kind"] == "warning"]
+        assert any("skipped" in e["attrs"]["message"] for e in warning_events)
+
+
+# -------------------------------------------------------------------- exporters
+
+
+def _seed_recorder_deterministically():
+    """A fixed scenario driven through the public API (no wall-clock asserts)."""
+    trace.inc("jit.cache_hit", 3, fn="M.pure_update")
+    trace.inc("jit.cache_miss", fn="M.pure_update")
+    trace.set_gauge("jit.cache_size", 1, fn="M.pure_update")
+    trace.observe_duration("sync.collective", 5e-4, op="leaf gather", ok="true")
+    trace.event("sync.collective", op="leaf gather", seconds=5e-4, bytes=64, ok=True)
+
+
+class TestExporters:
+    def test_prometheus_golden(self):
+        with trace.observe():
+            _seed_recorder_deterministically()
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(2), jnp.zeros(2))
+        text = export.prometheus_text(metrics=[m])
+        expected_lines = [
+            "# TYPE tm_tpu_jit_cache_hit_total counter",
+            'tm_tpu_jit_cache_hit_total{fn="M.pure_update"} 3',
+            'tm_tpu_jit_cache_miss_total{fn="M.pure_update"} 1',
+            "# TYPE tm_tpu_jit_cache_size gauge",
+            'tm_tpu_jit_cache_size{fn="M.pure_update"} 1',
+            "# TYPE tm_tpu_sync_collective_seconds histogram",
+            'tm_tpu_sync_collective_seconds_bucket{le="0.001",ok="true",op="leaf gather"} 1',
+            'tm_tpu_sync_collective_seconds_bucket{le="+Inf",ok="true",op="leaf gather"} 1',
+            'tm_tpu_sync_collective_seconds_count{ok="true",op="leaf gather"} 1',
+            'tm_tpu_robust_updates_ok_total{instance="0",metric="MeanSquaredError"} 1',
+            'tm_tpu_robust_updates_skipped_total{instance="0",metric="MeanSquaredError"} 0',
+            'tm_tpu_robust_sync_degraded{instance="0",metric="MeanSquaredError"} 0',
+            "tm_tpu_dropped_events_total 0",
+        ]
+        for line in expected_lines:
+            assert line in text.splitlines(), f"missing exposition line: {line}"
+
+    def test_jsonl_round_trip(self, tmp_path):
+        with trace.observe():
+            _seed_recorder_deterministically()
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(2), jnp.zeros(2))
+        path = str(tmp_path / "obs.jsonl")
+        n_lines = export.write_jsonl(path, metrics=[m])
+        with open(path) as fh:
+            records = [json.loads(line) for line in fh]
+        assert len(records) == n_lines
+        assert records[0]["type"] == "meta"
+        by_type = {}
+        for record in records:
+            by_type.setdefault(record["type"], []).append(record)
+        counters = {(c["name"], tuple(sorted(c["labels"].items()))): c["value"] for c in by_type["counter"]}
+        assert counters[("jit.cache_hit", (("fn", "M.pure_update"),))] == 3
+        events = by_type["event"]
+        assert events[0]["name"] == "sync.collective" and events[0]["attrs"]["bytes"] == 64
+        robust_rows = by_type["robust"]
+        assert robust_rows[0]["metric"] == "MeanSquaredError"
+        assert robust_rows[0]["updates_ok"] == 1 and robust_rows[0]["updates_skipped"] == 0
+
+    def test_jsonl_golden_modulo_timestamps(self):
+        with trace.observe():
+            trace.inc("c", fn="f")
+            trace.event("ev", k="v")
+        sink = io.StringIO()
+        export.write_jsonl(sink)
+        records = [json.loads(line) for line in sink.getvalue().splitlines()]
+        for record in records:
+            record.pop("ts", None)
+        assert records == [
+            {"type": "meta", "dropped_events": 0, "events": 1},
+            {"type": "event", "name": "ev", "attrs": {"k": "v"}},
+            {"type": "counter", "name": "c", "labels": {"fn": "f"}, "value": 1.0},
+        ]
+
+    def test_jsonl_attrs_cannot_clobber_structural_fields(self):
+        with trace.observe():
+            trace.event("checkpoint", ts="user-value", type="user-type")
+        sink = io.StringIO()
+        export.write_jsonl(sink)
+        record = json.loads(sink.getvalue().splitlines()[1])
+        assert record["type"] == "event" and isinstance(record["ts"], float)
+        assert record["attrs"] == {"ts": "user-value", "type": "user-type"}
+
+    def test_summary_table_mentions_everything(self):
+        with trace.observe():
+            _seed_recorder_deterministically()
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(2), jnp.zeros(2))
+        text = export.summary(metrics=[m])
+        for needle in ("jit.cache_hit", "sync.collective", "MeanSquaredError[0]: ok=1", "0 dropped"):
+            assert needle in text
+
+    def test_prometheus_escapes_newlines_in_label_values(self):
+        with trace.observe():
+            trace.inc("c", reason="line1\nline2")
+        text = export.prometheus_text()
+        assert 'tm_tpu_c_total{reason="line1\\nline2"} 1' in text.splitlines()
+
+    def test_export_works_with_tracing_off(self):
+        # robust-counter egress must not require the recorder to be live
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(2), jnp.zeros(2))
+        text = export.prometheus_text(metrics=[m])
+        assert 'tm_tpu_robust_updates_ok_total{instance="0",metric="MeanSquaredError"} 1' in text
+
+
+# ------------------------------------------------------- acceptance: 3-metric run
+
+
+class TestScriptedThreeMetricRun:
+    def test_full_egress(self, tmp_path, two_host_world):
+        """The acceptance scenario: 3 metrics, jit hits/misses, a compile span,
+        per-sync collective timings, and robust counters in BOTH exporters."""
+        rng = np.random.RandomState(0)
+        acc = MulticlassAccuracy(num_classes=4, validate_args=False)
+        mse = MeanSquaredError(error_policy="warn_skip", distributed_available_fn=lambda: True)
+        mean = MeanMetric()
+        with trace.observe():
+            for _ in range(3):
+                acc.update(jnp.asarray(rng.rand(16, 4).astype(np.float32)), jnp.asarray(rng.randint(0, 4, 16)))
+                mse.update(jnp.asarray(rng.rand(8).astype(np.float32)), jnp.asarray(rng.rand(8).astype(np.float32)))
+                mean.update(jnp.asarray(rng.rand(4).astype(np.float32)))
+            with pytest.warns(RuntimeWarning, match="skipped"):
+                mse.update(jnp.full(8, jnp.nan), jnp.zeros(8))
+            mse.sync()
+            mse.unsync()
+            for metric in (acc, mse, mean):
+                np.asarray(jax.tree_util.tree_leaves(metric.compute())[0])
+        metrics = [acc, mse, mean]
+
+        prom = export.prometheus_text(metrics=metrics)
+        assert 'tm_tpu_jit_cache_hit_total{fn="MulticlassAccuracy.pure_update"}' in prom
+        assert 'tm_tpu_jit_cache_miss_total{fn="MulticlassAccuracy.pure_update"} 1' in prom
+        assert "tm_tpu_sync_collective_seconds_count" in prom
+        assert 'tm_tpu_robust_updates_ok_total{instance="1",metric="MeanSquaredError"} 3' in prom
+        assert 'tm_tpu_robust_updates_skipped_total{instance="1",metric="MeanSquaredError"} 1' in prom
+        assert 'tm_tpu_robust_updates_quarantined_total{instance="1",metric="MeanSquaredError"} 0' in prom
+
+        path = str(tmp_path / "run.jsonl")
+        export.write_jsonl(path, metrics=metrics)
+        with open(path) as fh:
+            records = [json.loads(line) for line in fh]
+        kinds = {r["type"] for r in records}
+        assert {"meta", "span", "event", "counter", "histogram", "robust"} <= kinds
+        compile_spans = [r for r in records if r["type"] == "span" and r["name"] == "jit.compile"]
+        assert compile_spans and all(r["dur"] > 0 for r in compile_spans)
+        collective_events = [r for r in records if r["type"] == "event" and r["name"] == "sync.collective"]
+        assert collective_events and all("seconds" in r["attrs"] and "bytes" in r["attrs"] for r in collective_events)
+
+
+# -------------------------------------------------------------- profiler hooks
+
+
+class TestProfilerHooks:
+    def test_trace_capture_roundtrip(self, tmp_path):
+        from torchmetrics_tpu.obs import profile
+
+        log_dir = str(tmp_path / "tb")
+        with trace.observe():
+            started = profile.start_trace(log_dir)
+            if not started:
+                pytest.skip("jax profiler unavailable in this image")
+            jnp.sum(jnp.ones(8)).block_until_ready()
+            assert profile.stop_trace()
+        names = [e["name"] for e in trace.get_recorder().events()]
+        assert "profiler.start" in names and "profiler.stop" in names
+
+    def test_double_start_degrades_to_warning(self, tmp_path):
+        from torchmetrics_tpu.obs import profile
+
+        started = profile.start_trace(str(tmp_path / "a"))
+        if not started:
+            pytest.skip("jax profiler unavailable in this image")
+        try:
+            with pytest.warns(RuntimeWarning, match="already active"):
+                assert profile.start_trace(str(tmp_path / "b")) is False
+        finally:
+            profile.stop_trace()
+
+    def test_stop_without_start_warns(self):
+        from torchmetrics_tpu.obs import profile
+
+        with pytest.warns(RuntimeWarning, match="no active profiler"):
+            assert profile.stop_trace() is False
+
+    def test_stop_failure_keeps_trace_active_for_retry(self, tmp_path, monkeypatch):
+        from torchmetrics_tpu.obs import profile
+
+        started = profile.start_trace(str(tmp_path / "tb"))
+        if not started:
+            pytest.skip("jax profiler unavailable in this image")
+        import jax as jax_mod
+
+        real_stop = jax_mod.profiler.stop_trace
+
+        def _failing_stop():
+            raise RuntimeError("disk full")
+
+        monkeypatch.setattr(jax_mod.profiler, "stop_trace", _failing_stop)
+        with pytest.warns(RuntimeWarning, match="still marked active"):
+            assert profile.stop_trace() is False
+        monkeypatch.setattr(jax_mod.profiler, "stop_trace", real_stop)
+        assert profile.stop_trace() is True  # retry succeeds once the fault clears
+
+    def test_externally_stopped_session_clears_marker(self, tmp_path):
+        from torchmetrics_tpu.obs import profile
+
+        started = profile.start_trace(str(tmp_path / "tb"))
+        if not started:
+            pytest.skip("jax profiler unavailable in this image")
+        import jax as jax_mod
+
+        jax_mod.profiler.stop_trace()  # session torn down outside the obs API
+        with pytest.warns(RuntimeWarning, match="no active session"):
+            assert profile.stop_trace() is False
+        # marker cleared: capture is usable again, not wedged forever
+        assert profile.start_trace(str(tmp_path / "tb2"))
+        assert profile.stop_trace() is True
+
+    def test_reset_unwedges_unrecognized_stop_failure(self, tmp_path, monkeypatch):
+        from torchmetrics_tpu.obs import profile
+
+        started = profile.start_trace(str(tmp_path / "tb"))
+        if not started:
+            pytest.skip("jax profiler unavailable in this image")
+        import jax as jax_mod
+
+        real_stop = jax_mod.profiler.stop_trace
+        real_stop()  # external teardown, then a stop error with unknown wording
+
+        def _weird_error():
+            raise RuntimeError("some future jax phrasing")
+
+        monkeypatch.setattr(jax_mod.profiler, "stop_trace", _weird_error)
+        with pytest.warns(RuntimeWarning, match="still marked active"):
+            assert profile.stop_trace() is False
+        monkeypatch.setattr(jax_mod.profiler, "stop_trace", real_stop)
+        profile.reset()  # the escape hatch clears the wedged marker
+        assert profile.start_trace(str(tmp_path / "tb2"))
+        assert profile.stop_trace() is True
+
+    def test_annotate_is_usable_around_computation(self):
+        from torchmetrics_tpu.obs import profile
+
+        with profile.annotate("MyMetric.update"):
+            out = jnp.sum(jnp.ones(4))
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+
+
+# -------------------------------------------------------- disabled-path overhead
+
+
+class TestDisabledOverhead:
+    def test_disabled_dispatch_within_noise_of_uninstrumented(self):
+        """Obs-disabled instrumented dispatch vs the uninstrumented inner body
+        (the seed-equivalent dispatch): the only delta is the module-flag
+        branch, so the medians must be within noise of each other. Generous 2x
+        bound — the real overhead is well under 1%, but this host is shared."""
+        from torchmetrics_tpu.utils.checks import measure_runtime
+
+        assert not trace.is_enabled()
+        m = MeanSquaredError()
+        x, y = jnp.ones(64), jnp.zeros(64)
+        m.update(x, y)  # compile once outside the timed region
+
+        def instrumented():
+            for _ in range(200):
+                m._dispatch_update(x, y)
+
+        def seed_equivalent():
+            for _ in range(200):
+                m._dispatch_update_inner(x, y)
+
+        t_inner = measure_runtime(seed_equivalent, reps=5, warmup=1)
+        t_instr = measure_runtime(instrumented, reps=5, warmup=1)
+        assert t_instr < t_inner * 2.0 + 0.05, (
+            f"obs-disabled dispatch {t_instr:.4f}s vs seed-equivalent {t_inner:.4f}s"
+        )
+        assert trace.get_recorder().events() == []  # and it recorded nothing

@@ -14,6 +14,7 @@ _logger.addHandler(__logging.StreamHandler())
 _logger.setLevel(__logging.INFO)
 
 from torchmetrics_tpu import functional  # noqa: E402
+from torchmetrics_tpu import obs  # noqa: E402
 from torchmetrics_tpu import robust  # noqa: E402
 from torchmetrics_tpu.aggregation import (  # noqa: E402
     CatMetric,
@@ -60,6 +61,7 @@ from torchmetrics_tpu.wrappers.running import RunningMean, RunningSum  # noqa: E
 
 __all__ = [
     "functional",
+    "obs",
     "robust",
     "MaskedBuffer",
     "Metric",

@@ -5,14 +5,23 @@ through a cached :func:`jax.jit` of the *pure* state transition. Python-scalar a
 (thresholds, flags, class counts, strings) are treated as **static** — they select a
 compiled variant — while array arguments are traced. This mirrors how XLA wants metric
 hot loops expressed: one compiled program per configuration, re-used across steps.
+
+Dispatch telemetry (``torchmetrics_tpu.obs``, off by default): cache hits/misses,
+a compile-time span on every miss, a per-function cache-size gauge, and eager-
+fallback events, so hot loops that recompile per step — or never hit the jit
+cache at all — are visible instead of silently slow.
 """
 
 from __future__ import annotations
 
+import itertools
 from typing import Any, Callable, Dict, Tuple
 
 import jax
 import numpy as np
+
+import torchmetrics_tpu.obs.trace as _trace
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 def _is_traced_leaf(x: Any) -> bool:
@@ -46,6 +55,14 @@ def _hashable(x: Any) -> bool:
         return False
 
 
+def _fn_label(fn: Callable) -> str:
+    """Stable display label: owning class + method for bound methods."""
+    owner = getattr(fn, "__self__", None)
+    if owner is not None:
+        return f"{type(owner).__name__}.{getattr(fn, '__name__', 'fn')}"
+    return getattr(fn, "__qualname__", None) or getattr(fn, "__name__", None) or repr(fn)
+
+
 class StaticLeafJit:
     """``jit`` wrapper that partitions (args, kwargs) leaves into traced arrays and
     static Python values, caching one compiled program per static configuration.
@@ -54,10 +71,77 @@ class StaticLeafJit:
     ``state`` is a pytree of arrays (always traced).
     """
 
+    # one loud warning once a single wrapper holds this many compiled variants —
+    # a recompile storm (per-step-varying static leaf) otherwise goes unnoticed
+    recompile_warn_threshold: int = 32
+
+    # per-process ordinal distinguishing wrapper instances that share a label
+    # (e.g. two MeanSquaredError objects both wrap "MeanSquaredError.pure_update")
+    _instance_seq = itertools.count()
+
     def __init__(self, fn: Callable, donate_state: bool = False):
         self._fn = fn
         self._donate = donate_state
         self._cache: Dict[Any, Callable] = {}
+        self._label = _fn_label(fn)
+        self._instance = str(next(StaticLeafJit._instance_seq))
+        self._warned_unhashable = False
+        self._warned_recompile_storm = False
+
+    def _eager_fallback(self, leaf: Any, state: Any, args: tuple, kwargs: dict) -> Any:
+        """Unhashable static leaf: eager dispatch, re-taken on EVERY call — warn
+        once per wrapped function and count it, so a hot loop that never hits
+        the jit cache is visible instead of silently slow."""
+        if not self._warned_unhashable:
+            self._warned_unhashable = True
+            rank_zero_warn(
+                f"{self._label} received an unhashable static argument of type"
+                f" {type(leaf).__name__}; it cannot key the jit cache, so this call"
+                " (and every later one like it) falls back to EAGER dispatch. Pass"
+                " hashable statics (tuples, not lists) to keep the hot path compiled.",
+                RuntimeWarning,
+            )
+        if _trace.ENABLED:
+            _trace.inc("jit.eager_fallback", fn=self._label)
+            _trace.event("jit.eager_fallback", fn=self._label, leaf_type=type(leaf).__name__)
+            # the enclosing metric.update span was labeled path="jit" by the
+            # dispatcher, which could not know this call would fall back
+            _trace.annotate_current_span(path="eager_fallback")
+        return self._fn(state, *args, **kwargs)
+
+    def _check_recompile_storm(self) -> None:
+        """One loud warning when the per-static-config cache grows past the
+        threshold, naming the static leaf positions whose churn caused it."""
+        if self._warned_recompile_storm or len(self._cache) <= self.recompile_warn_threshold:
+            return
+        self._warned_recompile_storm = True
+        # positions are only comparable within one argument structure: group
+        # templates by treedef and analyze the dominant group, else "leaf i"
+        # would union unrelated arguments and name the wrong one
+        by_treedef: Dict[Any, list] = {}
+        for treedef, template in self._cache:
+            by_treedef.setdefault(treedef, []).append(template)
+        templates = max(by_treedef.values(), key=len)
+        offenders = []
+        if len(by_treedef) > 1:
+            offenders.append(f"{len(by_treedef)} distinct argument structures")
+        for position in range(len(templates[0])):
+            values = {t[position] for t in templates if not isinstance(t[position], _ArraySlot)}
+            if len(values) > 1:
+                sample = ", ".join(repr(v) for v in list(values)[:4])
+                offenders.append(f"leaf {position}: {len(values)} distinct values (e.g. {sample})")
+        detail = "; ".join(offenders) if offenders else "argument structure varies across calls"
+        rank_zero_warn(
+            f"{self._label} has compiled {len(self._cache)} variants (threshold"
+            f" {self.recompile_warn_threshold}) — a static leaf is changing every call, so"
+            f" each step pays a fresh XLA compile. Offending static leaves: {detail}."
+            " Make the varying argument an array (traced) or pin it to a fixed value.",
+            RuntimeWarning,
+        )
+        if _trace.ENABLED:
+            _trace.event(
+                "jit.recompile_storm", fn=self._label, cache_size=len(self._cache), detail=detail
+            )
 
     def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
@@ -69,7 +153,7 @@ class StaticLeafJit:
             else:
                 if not _hashable(leaf):
                     # unhashable static (e.g. list of strings) -> eager fallback
-                    return self._fn(state, *args, **kwargs)
+                    return self._eager_fallback(leaf, state, args, kwargs)
                 template.append(leaf)
         key = (treedef, tuple(template))
         jitted = self._cache.get(key)
@@ -84,6 +168,20 @@ class StaticLeafJit:
 
             jitted = jax.jit(run, donate_argnums=(0,) if self._donate else ())
             self._cache[key] = jitted
+            self._check_recompile_storm()
+            if _trace.ENABLED:
+                _trace.inc("jit.cache_miss", fn=self._label)
+                # gauge is last-write-wins, so it needs the per-instance label:
+                # two same-class metrics would otherwise overwrite each other
+                # and understate the compiled-variant total the misses report
+                _trace.set_gauge("jit.cache_size", len(self._cache), fn=self._label, inst=self._instance)
+                # first dispatch of a fresh variant = trace + XLA compile (+ one
+                # run): the span is the per-static-key compile cost
+                with _trace.span("jit.compile", fn=self._label, cache_size=len(self._cache)):
+                    return jitted(state, traced)
+            return jitted(state, traced)
+        if _trace.ENABLED:
+            _trace.inc("jit.cache_hit", fn=self._label)
         return jitted(state, traced)
 
 

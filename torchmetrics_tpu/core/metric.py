@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import torchmetrics_tpu.obs.trace as _trace
 from torchmetrics_tpu.core.buffer import MaskedBuffer
 from torchmetrics_tpu.core.jit import jit_with_static_leaves
 from torchmetrics_tpu.parallel.reductions import Reduction, merge_states
@@ -479,6 +480,8 @@ class Metric(ABC):
         else:
             self.updates_skipped += 1
             verb = "skipped"
+        if _trace.ENABLED:
+            _trace.inc(f"robust.update_{verb}", metric=type(self).__name__)
         rank_zero_warn(
             f"{type(self).__name__}.update failed and the batch was {verb}"
             f" (policy={policy.value}): {err}. Accumulated state is unchanged;"
@@ -496,7 +499,20 @@ class Metric(ABC):
         self._quarantine = []
 
     def _dispatch_update(self, *args: Any, **kwargs: Any) -> None:
-        """Run one update against the currently-bound state (jitted when possible)."""
+        """Run one update against the currently-bound state (jitted when possible).
+
+        With obs tracing enabled the dispatch is wrapped in a span recording
+        which path (jit vs eager) was taken; disabled, the extra cost is one
+        module-flag branch.
+        """
+        if _trace.ENABLED:
+            path = "jit" if self._jit_enabled() else "eager"
+            with _trace.span("metric.update", metric=type(self).__name__, path=path):
+                self._dispatch_update_inner(*args, **kwargs)
+            return
+        self._dispatch_update_inner(*args, **kwargs)
+
+    def _dispatch_update_inner(self, *args: Any, **kwargs: Any) -> None:
         if self._jit_enabled():
             if self._jitted_update is None:
                 self._jitted_update = jit_with_static_leaves(self.pure_update)
@@ -564,9 +580,13 @@ class Metric(ABC):
         return self._forward_dispatch(*args, **kwargs)
 
     def _forward_dispatch(self, *args: Any, **kwargs: Any) -> Any:
-        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
-            return self._forward_full_state_update(*args, **kwargs)
-        return self._forward_reduce_state_update(*args, **kwargs)
+        full = self.full_state_update or self.full_state_update is None or self.dist_sync_on_step
+        forward_fn = self._forward_full_state_update if full else self._forward_reduce_state_update
+        if _trace.ENABLED:
+            path = "full_state" if full else "reduce_state"
+            with _trace.span("metric.forward", metric=type(self).__name__, path=path):
+                return forward_fn(*args, **kwargs)
+        return forward_fn(*args, **kwargs)
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         self.update(*args, **kwargs)
@@ -704,13 +724,20 @@ class Metric(ABC):
             return
         self._cache = dict(self._state_values)
         try:
-            self._sync_dist(dist_sync_fn)
+            if _trace.ENABLED:
+                with _trace.span("metric.sync", metric=type(self).__name__):
+                    self._sync_dist(dist_sync_fn)
+            else:
+                self._sync_dist(dist_sync_fn)
         except CollectiveError as err:
             # degraded sync: keep local-only state rather than hanging/crashing
             # the job (see torchmetrics_tpu.robust.degraded). Loud by design.
             self._state_values = self._cache
             self._cache = None
             self.sync_degraded = True
+            if _trace.ENABLED:
+                _trace.inc("sync.degraded", metric=type(self).__name__)
+                _trace.event("sync.degraded", metric=type(self).__name__, error=str(err))
             rank_zero_warn(
                 f"Cross-host sync of {type(self).__name__} failed and was DEGRADED"
                 f" to local-only state: {err}. Results from this process reflect"
@@ -732,6 +759,8 @@ class Metric(ABC):
         self._state_values = self._cache
         self._cache = None
         self._is_synced = False
+        if _trace.ENABLED:
+            _trace.event("metric.unsync", metric=type(self).__name__)
 
     @contextmanager
     def sync_context(
@@ -767,8 +796,20 @@ class Metric(ABC):
                 UserWarning,
             )
         if self.compute_with_cache and self._computed is not None:
+            if _trace.ENABLED:
+                _trace.inc("metric.compute_cached", metric=type(self).__name__)
             return self._computed
         self._check_buffer_overflow()  # backstop for the final jitted update
+        if _trace.ENABLED:
+            with _trace.span("metric.compute", metric=type(self).__name__):
+                value = self._compute_synced_value()
+        else:
+            value = self._compute_synced_value()
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    def _compute_synced_value(self) -> Any:
         with self.sync_context(
             dist_sync_fn=self.dist_sync_fn,
             should_sync=self._to_sync,
@@ -776,10 +817,7 @@ class Metric(ABC):
         ):
             with jax.named_scope(f"{type(self).__name__}.compute"):
                 value = self._compute_impl()
-            value = _squeeze_if_scalar(value)
-        if self.compute_with_cache:
-            self._computed = value
-        return value
+            return _squeeze_if_scalar(value)
 
     # ------------------------------------------------------------------------- others
 
@@ -811,6 +849,8 @@ class Metric(ABC):
 
     def reset(self) -> None:
         """Reset state to defaults (reference ``metric.py:692-707``)."""
+        if _trace.ENABLED:
+            _trace.inc("metric.reset", metric=type(self).__name__)
         self._update_count = 0
         self._computed = None
         self._cache = None

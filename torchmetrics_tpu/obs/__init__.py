@@ -1,0 +1,76 @@
+"""Runtime telemetry for the metrics runtime: spans, counters, exporters, profiler hooks.
+
+The runtime is instrumented at its hot seams — jit dispatch cache hits/misses
+and compile times (``core/jit.py``), the ``Metric`` update/compute/forward/
+sync/reset lifecycle (``core/metric.py``), eager multihost collective wall time
+and payload bytes (``parallel/sync.py``), retry/degrade decisions
+(``robust/*``) — and everything funnels through one bounded, thread-safe
+recorder:
+
+- :mod:`~torchmetrics_tpu.obs.trace` — span/event ring buffer, counters,
+  gauges, duration histograms. **Off by default**: every instrumented call
+  site guards on a single module flag, so the unconfigured runtime behaves
+  (and times) exactly as before.
+- :mod:`~torchmetrics_tpu.obs.export` — JSONL sink, Prometheus text
+  exposition, human-readable summary; all three also surface the per-metric
+  robustness counters (``updates_ok`` / ``updates_skipped`` /
+  ``updates_quarantined`` / ``sync_degraded``) from the fault-tolerance layer.
+- :mod:`~torchmetrics_tpu.obs.profile` — guarded ``jax.profiler``
+  ``start_trace`` / ``stop_trace`` wrappers; combined with the runtime's
+  ``jax.named_scope`` annotations, device traces attribute time to metric
+  class names.
+
+Typical use::
+
+    from torchmetrics_tpu import obs
+
+    with obs.observe() as rec:          # or obs.enable() for the whole run
+        train_and_eval(...)
+    print(obs.summary(metrics=[acc, f1]))
+    obs.write_jsonl("obs.jsonl", metrics=[acc, f1])
+    print(obs.prometheus_text(metrics=[acc, f1]))
+"""
+
+from torchmetrics_tpu.obs import export, profile, trace
+from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
+from torchmetrics_tpu.obs.profile import annotate, profile_trace, start_trace, stop_trace
+from torchmetrics_tpu.obs.trace import (
+    TraceRecorder,
+    disable,
+    enable,
+    event,
+    get_recorder,
+    inc,
+    is_enabled,
+    observe,
+    observe_duration,
+    record_warning,
+    set_gauge,
+    span,
+)
+
+__all__ = [
+    "TraceRecorder",
+    "annotate",
+    "collect",
+    "disable",
+    "enable",
+    "event",
+    "export",
+    "get_recorder",
+    "inc",
+    "is_enabled",
+    "observe",
+    "observe_duration",
+    "profile",
+    "profile_trace",
+    "prometheus_text",
+    "record_warning",
+    "set_gauge",
+    "span",
+    "start_trace",
+    "stop_trace",
+    "summary",
+    "trace",
+    "write_jsonl",
+]

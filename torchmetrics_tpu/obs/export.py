@@ -1,0 +1,242 @@
+"""Telemetry egress: JSONL sink, Prometheus text exposition, summary table.
+
+All three exporters read the same :class:`~torchmetrics_tpu.obs.trace.TraceRecorder`
+snapshot and, when given live metric objects, also surface the PR-1 robustness
+counters (``updates_ok`` / ``updates_skipped`` / ``updates_quarantined`` /
+``quarantine_dropped`` / ``sync_degraded``) that previously had no export path.
+
+Pure stdlib — importable (and usable for the robust counters) even where jax is
+not initialised.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from typing import Any, Dict, IO, Iterable, List, Optional, Union
+
+import torchmetrics_tpu.obs.trace as trace
+
+__all__ = ["collect", "prometheus_text", "summary", "write_jsonl"]
+
+# every exported series is namespaced; dots in internal names become underscores
+_PROM_PREFIX = "tm_tpu_"
+
+_ROBUST_COUNTERS = ("updates_ok", "updates_skipped", "updates_quarantined", "quarantine_dropped")
+_ROBUST_FLAGS = ("sync_degraded", "last_update_ok")
+
+
+def _robust_snapshot(metrics: Iterable[Any]) -> List[Dict[str, Any]]:
+    """Duck-typed robustness-counter rows for any objects exposing them.
+
+    Each row carries an ``instance`` ordinal (the metric's position in the
+    input iterable): two metrics of the same class (train/val accuracy) must
+    not collapse into duplicate Prometheus series — a scraper rejects the
+    whole page on a duplicate name+labelset.
+    """
+    rows = []
+    for index, metric in enumerate(metrics):
+        if not hasattr(metric, "updates_ok"):
+            continue
+        row: Dict[str, Any] = {"metric": type(metric).__name__, "instance": index}
+        for name in _ROBUST_COUNTERS:
+            row[name] = int(getattr(metric, name, 0))
+        for name in _ROBUST_FLAGS:
+            row[name] = bool(getattr(metric, name, False))
+        row["update_count"] = int(getattr(metric, "update_count", 0))
+        rows.append(row)
+    return rows
+
+
+def collect(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> Dict[str, Any]:
+    """One plain-data snapshot: recorder state + per-metric robust counters."""
+    rec = recorder if recorder is not None else trace.get_recorder()
+    snap = rec.snapshot()
+    snap["robust"] = _robust_snapshot(metrics)
+    return snap
+
+
+# ------------------------------------------------------------------------- JSONL
+
+
+def write_jsonl(
+    sink: Union[str, IO[str]],
+    metrics: Iterable[Any] = (),
+    recorder: Optional[trace.TraceRecorder] = None,
+) -> int:
+    """Write the full snapshot as JSON Lines; returns the number of lines.
+
+    Line types (``"type"`` field): ``meta`` (one, first), then every ``span`` /
+    ``event`` / ``warning`` in ring-buffer order, then ``counter`` / ``gauge`` /
+    ``histogram`` series, then one ``robust`` line per metric.
+    """
+    snap = collect(metrics, recorder)
+    lines: List[str] = []
+
+    def emit(obj: Dict[str, Any]) -> None:
+        lines.append(json.dumps(obj, sort_keys=True, default=str))
+
+    emit({"type": "meta", "dropped_events": snap["dropped_events"], "events": len(snap["events"])})
+    for ev in snap["events"]:
+        # attrs stay namespaced: event attrs are free-form user data and must
+        # not clobber the structural type/name/ts/dur fields
+        record = {"type": ev["kind"], "name": ev["name"], "ts": round(ev["ts"], 6), "attrs": ev["attrs"]}
+        if "dur" in ev:
+            record["dur"] = round(ev["dur"], 6)
+            record["depth"] = ev["depth"]
+        emit(record)
+    for counter in snap["counters"]:
+        emit({"type": "counter", **counter})
+    for gauge in snap["gauges"]:
+        emit({"type": "gauge", **gauge})
+    for hist in snap["histograms"]:
+        emit(
+            {
+                "type": "histogram",
+                "name": hist["name"],
+                "labels": hist["labels"],
+                "buckets": [[("inf" if math.isinf(b) else b), c] for b, c in hist["buckets"]],
+                "sum": round(hist["sum"], 6),
+                "count": hist["count"],
+            }
+        )
+    for row in snap["robust"]:
+        emit({"type": "robust", **row})
+
+    text = "\n".join(lines) + "\n"
+    if isinstance(sink, str):
+        with open(sink, "w") as fh:
+            fh.write(text)
+    else:
+        sink.write(text)
+    return len(lines)
+
+
+# -------------------------------------------------------------------- Prometheus
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_PREFIX + "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def _prom_escape(value: Any) -> str:
+    # text-format spec: backslash, double-quote and newline must be escaped in
+    # label values; labels are public API so any string can arrive here
+    return str(value).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(f'{key}="{_prom_escape(value)}"' for key, value in sorted(labels.items()))
+    return "{" + body + "}"
+
+
+def _prom_value(value: float) -> str:
+    value = float(value)
+    if value.is_integer():
+        return str(int(value))
+    return repr(value)
+
+
+def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> str:
+    """Prometheus text exposition (0.0.4) of counters, gauges, histograms and
+    the per-metric robust counters."""
+    snap = collect(metrics, recorder)
+    out: List[str] = []
+
+    by_name: Dict[str, List[Dict[str, Any]]] = {}
+    for counter in snap["counters"]:
+        by_name.setdefault(counter["name"], []).append(counter)
+    for name in sorted(by_name):
+        prom = _prom_name(name) + "_total"
+        out.append(f"# TYPE {prom} counter")
+        for counter in by_name[name]:
+            out.append(f"{prom}{_prom_labels(counter['labels'])} {_prom_value(counter['value'])}")
+
+    by_name = {}
+    for gauge in snap["gauges"]:
+        by_name.setdefault(gauge["name"], []).append(gauge)
+    for name in sorted(by_name):
+        prom = _prom_name(name)
+        out.append(f"# TYPE {prom} gauge")
+        for gauge in by_name[name]:
+            out.append(f"{prom}{_prom_labels(gauge['labels'])} {_prom_value(gauge['value'])}")
+
+    by_name = {}
+    for hist in snap["histograms"]:
+        by_name.setdefault(hist["name"], []).append(hist)
+    for name in sorted(by_name):
+        prom = _prom_name(name) + "_seconds"
+        out.append(f"# TYPE {prom} histogram")
+        for hist in by_name[name]:
+            cumulative = 0
+            for bound, count in hist["buckets"]:
+                cumulative += count
+                le = "+Inf" if math.isinf(bound) else f"{bound:g}"
+                labels = _prom_labels({**hist["labels"], "le": le})
+                out.append(f"{prom}_bucket{labels} {cumulative}")
+            out.append(f"{prom}_sum{_prom_labels(hist['labels'])} {_prom_value(hist['sum'])}")
+            out.append(f"{prom}_count{_prom_labels(hist['labels'])} {hist['count']}")
+
+    if snap["robust"]:
+        for name in _ROBUST_COUNTERS:
+            prom = _prom_name("robust." + name) + "_total"
+            out.append(f"# TYPE {prom} counter")
+            for row in snap["robust"]:
+                labels = {"instance": str(row["instance"]), "metric": row["metric"]}
+                out.append(f"{prom}{_prom_labels(labels)} {row[name]}")
+        for name in _ROBUST_FLAGS:
+            prom = _prom_name("robust." + name)
+            out.append(f"# TYPE {prom} gauge")
+            for row in snap["robust"]:
+                labels = {"instance": str(row["instance"]), "metric": row["metric"]}
+                out.append(f"{prom}{_prom_labels(labels)} {int(row[name])}")
+
+    out.append(f"# TYPE {_prom_name('dropped_events')}_total counter")
+    out.append(f"{_prom_name('dropped_events')}_total {snap['dropped_events']}")
+    return "\n".join(out) + "\n"
+
+
+# ----------------------------------------------------------------- summary table
+
+
+def summary(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> str:
+    """Human-readable summary of the recorded telemetry."""
+    snap = collect(metrics, recorder)
+    lines: List[str] = ["== torchmetrics_tpu obs summary =="]
+
+    if snap["counters"]:
+        lines.append("-- counters --")
+        width = max(len(c["name"]) for c in snap["counters"])
+        for counter in snap["counters"]:
+            label = " ".join(f"{k}={v}" for k, v in sorted(counter["labels"].items()))
+            lines.append(f"  {counter['name']:<{width}}  {_prom_value(counter['value']):>10}  {label}")
+
+    if snap["gauges"]:
+        lines.append("-- gauges --")
+        width = max(len(g["name"]) for g in snap["gauges"])
+        for gauge in snap["gauges"]:
+            label = " ".join(f"{k}={v}" for k, v in sorted(gauge["labels"].items()))
+            lines.append(f"  {gauge['name']:<{width}}  {_prom_value(gauge['value']):>10}  {label}")
+
+    if snap["histograms"]:
+        lines.append("-- durations --")
+        width = max(len(h["name"]) for h in snap["histograms"])
+        for hist in snap["histograms"]:
+            label = " ".join(f"{k}={v}" for k, v in sorted(hist["labels"].items()))
+            mean = hist["sum"] / hist["count"] if hist["count"] else 0.0
+            lines.append(
+                f"  {hist['name']:<{width}}  n={hist['count']:<6} total={hist['sum'] * 1e3:9.3f}ms"
+                f" mean={mean * 1e6:9.1f}us  {label}"
+            )
+
+    if snap["robust"]:
+        lines.append("-- robust --")
+        for row in snap["robust"]:
+            flags = " ".join(f"{name}={int(row[name])}" for name in _ROBUST_FLAGS)
+            counts = " ".join(f"{name.split('_', 1)[1]}={row[name]}" for name in _ROBUST_COUNTERS)
+            lines.append(f"  {row['metric']}[{row['instance']}]: {counts} {flags}")
+
+    lines.append(f"-- events: {len(snap['events'])} recorded, {snap['dropped_events']} dropped --")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,121 @@
+"""Device-trace capture: thin, fault-tolerant wrappers over ``jax.profiler``.
+
+``start_trace``/``stop_trace`` bracket a region of the run with an XLA device
+trace (viewable in TensorBoard / Perfetto); the ``Metric`` runtime already
+annotates ``pure_update`` / ``pure_compute`` / ``sync_state`` with
+``jax.named_scope``, so captured traces attribute device time to metric class
+names (e.g. ``MulticlassAccuracy.update``) rather than anonymous XLA fusions.
+
+Wrappers rather than raw calls because profiling must never take down the run
+it is observing: an unavailable/duplicate profiler session degrades to a
+warning and a ``False`` return. Start/stop also land in the obs event log when
+tracing is enabled, so exported telemetry shows *when* a device trace was
+captured and where it was written.
+
+jax is imported lazily — importing :mod:`torchmetrics_tpu.obs` stays
+stdlib-only.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any, Iterator, Optional
+
+import torchmetrics_tpu.obs.trace as trace
+
+__all__ = ["annotate", "profile_trace", "reset", "start_trace", "stop_trace"]
+
+# path of the in-flight capture; None when no trace is active
+_ACTIVE: dict = {"log_dir": None}
+
+
+def _warn(message: str) -> None:
+    from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+    rank_zero_warn(message, RuntimeWarning)
+
+
+def start_trace(log_dir: str) -> bool:
+    """Begin a ``jax.profiler`` device trace into ``log_dir``; True on success."""
+    if _ACTIVE["log_dir"] is not None:
+        _warn(f"A profiler trace into {_ACTIVE['log_dir']} is already active; ignoring start_trace.")
+        return False
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+    except Exception as err:
+        _warn(f"jax.profiler.start_trace({log_dir!r}) failed: {err}. Continuing without a device trace.")
+        return False
+    _ACTIVE["log_dir"] = log_dir
+    if trace.ENABLED:
+        trace.event("profiler.start", log_dir=log_dir)
+    return True
+
+
+def stop_trace() -> bool:
+    """End the in-flight device trace; True on success.
+
+    On failure the active-trace marker is KEPT, so a later retry can attempt
+    the stop again — clearing it eagerly would leave the underlying jax
+    session running with no way to close it through this API.
+    """
+    log_dir = _ACTIVE["log_dir"]
+    if log_dir is None:
+        _warn("stop_trace called with no active profiler trace; ignoring.")
+        return False
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception as err:
+        # "no session" means the jax profiler was stopped outside this API:
+        # keeping the marker would wedge start/stop forever, so clear it.
+        # Any other failure (e.g. disk full writing the trace) keeps the
+        # marker so the stop can be retried.
+        message = str(err).lower()
+        if "no profile" in message or "not started" in message or "no active" in message:
+            _ACTIVE["log_dir"] = None
+            _warn(f"jax.profiler.stop_trace() found no active session ({err}); cleared the trace marker.")
+        else:
+            _warn(f"jax.profiler.stop_trace() failed: {err}. The trace is still marked active; retry stop_trace().")
+        return False
+    _ACTIVE["log_dir"] = None
+    if trace.ENABLED:
+        trace.event("profiler.stop", log_dir=log_dir)
+    return True
+
+
+def reset() -> None:
+    """Forget the active-trace marker without touching the jax profiler.
+
+    Escape hatch: if the underlying session was torn down outside this API and
+    the stop error's wording wasn't recognized by :func:`stop_trace`, the
+    marker would otherwise block every later :func:`start_trace` forever.
+    """
+    _ACTIVE["log_dir"] = None
+
+
+@contextmanager
+def profile_trace(log_dir: str) -> Iterator[bool]:
+    """Scoped device trace: ``with profile_trace("/tmp/tb"): run_epoch(...)``.
+
+    Yields whether the capture actually started; the block runs either way.
+    """
+    started = start_trace(log_dir)
+    try:
+        yield started
+    finally:
+        if started:
+            stop_trace()
+
+
+def annotate(name: str) -> Any:
+    """Named scope for attributing device time in captured traces.
+
+    Usable as a context manager around traced computation, mirroring the
+    runtime's built-in per-metric annotations.
+    """
+    import jax
+
+    return jax.named_scope(name)

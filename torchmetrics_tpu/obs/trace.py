@@ -1,0 +1,417 @@
+"""Low-overhead runtime telemetry: spans, events, counters, duration histograms.
+
+The runtime instruments its hot seams — jit dispatch (``core/jit.py``), the
+``Metric`` update/compute/forward/sync lifecycle (``core/metric.py``), and the
+eager multihost collectives (``parallel/sync.py``) — through this module. The
+design constraints, in order:
+
+1. **Disabled is free.** A single module-level flag (:data:`ENABLED`); every
+   instrumented call site is guarded by ``if trace.ENABLED:`` so the default
+   path costs one attribute load and one branch. Nothing here imports jax or
+   numpy — pure stdlib — so merely importing the runtime never pays for
+   telemetry either.
+2. **Enabled is bounded.** Events land in a ring buffer (``max_events``,
+   default 4096, drop-oldest with a ``dropped_events`` counter); counters,
+   gauges and histograms are small dicts. A week-long run cannot OOM the host
+   through its own telemetry.
+3. **Thread-safe.** The guarded eager collectives run in worker threads
+   (``robust/degraded.py``) and user code may drive metrics from several
+   threads; all recorder mutation is lock-protected, and span nesting depth is
+   tracked per-thread.
+
+Spans additionally feed a duration histogram (log-scale second buckets) keyed
+by the span name plus its *string-valued* attributes — string attributes are
+treated as bounded-cardinality labels (metric class, dispatch path), while
+numeric attributes (payload sizes, cache sizes) stay event-only so an unbounded
+value stream can never explode the histogram key space.
+
+Egress lives in :mod:`torchmetrics_tpu.obs.export` (JSONL, Prometheus text,
+summary table) and :mod:`torchmetrics_tpu.obs.profile` (``jax.profiler``
+device-trace capture).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "ENABLED",
+    "TraceRecorder",
+    "annotate_current_span",
+    "disable",
+    "enable",
+    "event",
+    "get_recorder",
+    "inc",
+    "is_enabled",
+    "observe",
+    "observe_duration",
+    "record_warning",
+    "set_gauge",
+    "span",
+]
+
+# THE enabled flag. Hot call sites guard with ``if trace.ENABLED:`` — the
+# disabled path is one module-attribute load and one branch.
+ENABLED = False
+
+_DEFAULT_MAX_EVENTS = 4096
+
+LabelsKey = Tuple[Tuple[str, Any], ...]
+
+
+def _labels_key(labels: Dict[str, Any]) -> LabelsKey:
+    return tuple(sorted(labels.items()))
+
+
+class _Histogram:
+    """Fixed log-scale duration histogram (seconds), Prometheus-compatible."""
+
+    # non-cumulative per-bucket upper bounds; export computes cumulative counts
+    BOUNDS: Tuple[float, ...] = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, float("inf"))
+
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self) -> None:
+        self.counts = [0] * len(self.BOUNDS)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        for i, bound in enumerate(self.BOUNDS):
+            if value <= bound:
+                self.counts[i] += 1
+                break
+        self.sum += value
+        self.count += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "buckets": [[bound, count] for bound, count in zip(self.BOUNDS, self.counts)],
+            "sum": self.sum,
+            "count": self.count,
+        }
+
+
+class TraceRecorder:
+    """Bounded, thread-safe sink for spans/events/counters/gauges/histograms."""
+
+    def __init__(self, max_events: int = _DEFAULT_MAX_EVENTS) -> None:
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self.max_events = int(max_events)
+        self.clear()
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def clear(self) -> None:
+        """Drop all recorded data and restart the session clock."""
+        with self._lock:
+            self._events: deque = deque()
+            self.dropped_events = 0
+            self._counters: Dict[Tuple[str, LabelsKey], float] = {}
+            self._gauges: Dict[Tuple[str, LabelsKey], float] = {}
+            self._hists: Dict[Tuple[str, LabelsKey], _Histogram] = {}
+            self._seen_warnings: set = set()
+            self._t0 = time.monotonic()
+
+    def _span_stack(self) -> List[Tuple[str, Dict[str, Any]]]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _append(self, record: Dict[str, Any]) -> None:
+        # caller holds the lock; while (not if): the cap may have been lowered
+        # below the current length via set_max_events on a live recorder
+        while len(self._events) >= self.max_events:
+            self._events.popleft()
+            self.dropped_events += 1
+        self._events.append(record)
+
+    def set_max_events(self, max_events: int) -> None:
+        """Rebound the ring buffer, evicting (and counting) the oldest events
+        immediately when the new cap is below the current length."""
+        if max_events <= 0:
+            raise ValueError(f"Expected `max_events` to be positive, got {max_events}")
+        with self._lock:
+            self.max_events = int(max_events)
+            while len(self._events) > self.max_events:
+                self._events.popleft()
+                self.dropped_events += 1
+
+    def _restore_max_events(self, max_events: int) -> None:
+        """Exit-path restore for ``observe``: reset the cap WITHOUT evicting.
+
+        A scoped capture that raised the cap must stay exportable after the
+        block ('recorded data is kept on exit'); ``_append``'s while-eviction
+        re-establishes the bound at the next recording instead.
+        """
+        with self._lock:
+            self.max_events = int(max_events)
+
+    # ------------------------------------------------------------------ recording
+
+    def add_event(self, name: str, kind: str = "event", **attrs: Any) -> None:
+        with self._lock:
+            self._append(
+                {"kind": kind, "name": name, "ts": time.monotonic() - self._t0, "attrs": attrs}
+            )
+
+    def add_span(self, name: str, start: float, duration: float, depth: int, attrs: Dict[str, Any]) -> None:
+        with self._lock:
+            self._append(
+                {
+                    "kind": "span",
+                    "name": name,
+                    "ts": start - self._t0,
+                    "dur": duration,
+                    "depth": depth,
+                    "attrs": attrs,
+                }
+            )
+            labels = {k: v for k, v in attrs.items() if isinstance(v, str)}
+            key = (name, _labels_key(labels))
+            if not self._series_slot(self._hists, key):
+                return
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram()
+            hist.observe(duration)
+
+    def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            if self._series_slot(self._counters, key):
+                self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels: Any) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            if self._series_slot(self._gauges, key):
+                self._gauges[key] = value
+
+    def observe_duration(self, name: str, seconds: float, **labels: Any) -> None:
+        key = (name, _labels_key(labels))
+        with self._lock:
+            if not self._series_slot(self._hists, key):
+                return
+            hist = self._hists.get(key)
+            if hist is None:
+                hist = self._hists[key] = _Histogram()
+            hist.observe(seconds)
+
+    # dedup tracks at most this many distinct warning messages: warnings with
+    # per-occurrence dynamic text (embedded errors, attempt counts) would
+    # otherwise grow the seen-set without bound on a long flaky run. Past the
+    # cap, new messages still emit and land in the event log — they just stop
+    # being dedup-tracked.
+    max_tracked_warnings: int = 1024
+
+    # cardinality cap across counter/gauge/histogram series: a long-lived
+    # session that keeps constructing metric objects (fresh per-instance
+    # labels) must not grow the recorder without bound. New series past the
+    # cap are dropped and counted under `series.dropped`.
+    max_series: int = 4096
+
+    def _series_slot(self, table: Dict, key: Tuple[str, LabelsKey]) -> bool:
+        """True when ``key`` exists or may be created; counts refused series.
+
+        Caller holds the lock.
+        """
+        if key in table or len(table) < self.max_series:
+            return True
+        dropped = ("series.dropped", ())
+        self._counters[dropped] = self._counters.get(dropped, 0.0) + 1.0
+        return False
+
+    def record_warning(self, message: str) -> bool:
+        """Log a warning into the event stream; returns False for a duplicate.
+
+        First occurrence of a message is recorded as a ``warning`` event (and
+        should still be emitted through ``warnings.warn`` by the caller);
+        repeats only bump the ``warnings.deduplicated`` counter.
+        """
+        with self._lock:
+            if message in self._seen_warnings:
+                key = ("warnings.deduplicated", ())
+                self._counters[key] = self._counters.get(key, 0.0) + 1.0
+                return False
+            if len(self._seen_warnings) < self.max_tracked_warnings:
+                self._seen_warnings.add(message)
+            key = ("warnings.emitted", ())
+            self._counters[key] = self._counters.get(key, 0.0) + 1.0
+            self._append(
+                {
+                    "kind": "warning",
+                    "name": "warning",
+                    "ts": time.monotonic() - self._t0,
+                    "attrs": {"message": message},
+                }
+            )
+            return True
+
+    # ------------------------------------------------------------------ inspection
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def counter_value(self, name: str, **labels: Any) -> float:
+        """Value of one counter (0.0 when never incremented). With no labels
+        given, sums across every label set of ``name``."""
+        with self._lock:
+            if labels:
+                return self._counters.get((name, _labels_key(labels)), 0.0)
+            return sum(v for (n, _), v in self._counters.items() if n == name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Point-in-time copy of everything recorded, as plain python data."""
+        with self._lock:
+            return {
+                "events": list(self._events),
+                "dropped_events": self.dropped_events,
+                "counters": [
+                    {"name": name, "labels": dict(labels), "value": value}
+                    for (name, labels), value in sorted(self._counters.items())
+                ],
+                "gauges": [
+                    {"name": name, "labels": dict(labels), "value": value}
+                    for (name, labels), value in sorted(self._gauges.items())
+                ],
+                "histograms": [
+                    {"name": name, "labels": dict(labels), **hist.snapshot()}
+                    for (name, labels), hist in sorted(self._hists.items())
+                ],
+            }
+
+
+_RECORDER = TraceRecorder()
+
+
+def get_recorder() -> TraceRecorder:
+    return _RECORDER
+
+
+def is_enabled() -> bool:
+    return ENABLED
+
+
+def enable(max_events: Optional[int] = None, reset: bool = True) -> None:
+    """Turn tracing on. ``reset`` (default) clears previously recorded data."""
+    global ENABLED
+    if max_events is not None:
+        _RECORDER.set_max_events(max_events)
+    if reset:
+        _RECORDER.clear()
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+@contextmanager
+def observe(max_events: Optional[int] = None, reset: Optional[bool] = None) -> Iterator[TraceRecorder]:
+    """Scoped tracing: enabled inside the block, prior state restored on exit
+    (both the enabled flag and any ``max_events`` override).
+
+    ``reset`` defaults to True when tracing was off (a fresh scoped capture)
+    and False when tracing is already on — a nested ``observe`` inside a
+    process-wide ``enable()`` session must not destroy the outer session's
+    recorded data; for the same reason a nested observe IGNORES a
+    ``max_events`` override (the ring buffer is shared, so lowering it would
+    evict the outer session's events). Recorded data is *kept* on exit so the
+    caller can export it::
+
+        with obs.observe() as rec: run_epoch(...)
+        print(obs.export.summary())
+    """
+    global ENABLED
+    previous = ENABLED
+    previous_max = _RECORDER.max_events
+    if reset is None:
+        reset = not previous
+    if previous:
+        max_events = None  # shared ring: never rebound under an outer session
+    enable(max_events=max_events, reset=reset)
+    try:
+        yield _RECORDER
+    finally:
+        ENABLED = previous
+        _RECORDER._restore_max_events(previous_max)
+
+
+@contextmanager
+def span(name: str, **attrs: Any) -> Iterator[None]:
+    """Record a wall-clock span (monotonic clock) around the enclosed block.
+
+    Hot call sites should guard entry with ``if trace.ENABLED:`` so the
+    disabled path never pays the context-manager machinery; calling this with
+    tracing off is still correct (it no-ops).
+    """
+    if not ENABLED:
+        yield
+        return
+    rec = _RECORDER
+    stack = rec._span_stack()
+    depth = len(stack)
+    stack.append((name, attrs))
+    start = time.monotonic()
+    try:
+        yield
+    finally:
+        duration = time.monotonic() - start
+        stack.pop()
+        rec.add_span(name, start, duration, depth, attrs)
+
+
+def annotate_current_span(**attrs: Any) -> None:
+    """Amend the innermost open span's attributes (recorded at span exit).
+
+    Lets a callee correct a label the caller could not know — e.g. the jit
+    dispatcher rewriting ``path="jit"`` to ``path="eager_fallback"`` on the
+    enclosing ``metric.update`` span when an unhashable static forces eager
+    dispatch. No-op with tracing off or outside any span.
+    """
+    if not ENABLED:
+        return
+    stack = _RECORDER._span_stack()
+    if stack:
+        stack[-1][1].update(attrs)
+
+
+def event(name: str, **attrs: Any) -> None:
+    """Record an instant event (no duration)."""
+    if ENABLED:
+        _RECORDER.add_event(name, **attrs)
+
+
+def inc(name: str, value: float = 1.0, **labels: Any) -> None:
+    """Increment a counter."""
+    if ENABLED:
+        _RECORDER.inc(name, value, **labels)
+
+
+def set_gauge(name: str, value: float, **labels: Any) -> None:
+    """Set a gauge to its current value (last write wins)."""
+    if ENABLED:
+        _RECORDER.set_gauge(name, value, **labels)
+
+
+def observe_duration(name: str, seconds: float, **labels: Any) -> None:
+    """Feed one duration sample into a histogram."""
+    if ENABLED:
+        _RECORDER.observe_duration(name, seconds, **labels)
+
+
+def record_warning(message: str) -> bool:
+    """Route a warning through the event log; False means duplicate (suppress)."""
+    if not ENABLED:
+        return True
+    return _RECORDER.record_warning(message)

@@ -22,12 +22,14 @@ data uses :func:`pad_dim0` + a validity-mask convention.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+import torchmetrics_tpu.obs.trace as _trace
 from torchmetrics_tpu.parallel.reductions import Reduction
 from torchmetrics_tpu.utils.data import dim_zero_cat
 
@@ -55,9 +57,39 @@ def _process_allgather(x, tiled: bool = False, description: str = "process_allga
 
     from torchmetrics_tpu.robust.degraded import guarded_collective
 
-    return guarded_collective(
-        multihost_utils.process_allgather, x, tiled=tiled, description=description
-    )
+    if not _trace.ENABLED:
+        return guarded_collective(
+            multihost_utils.process_allgather, x, tiled=tiled, description=description
+        )
+    payload = _payload_bytes(x)
+    start = time.perf_counter()
+    try:
+        out = guarded_collective(
+            multihost_utils.process_allgather, x, tiled=tiled, description=description
+        )
+    except Exception:
+        elapsed = time.perf_counter() - start
+        _trace.inc("sync.collective_failed", op=description)
+        _trace.observe_duration("sync.collective", elapsed, op=description, ok="false")
+        _trace.event("sync.collective", op=description, seconds=round(elapsed, 6), bytes=payload, ok=False)
+        raise
+    elapsed = time.perf_counter() - start
+    _trace.inc("sync.collectives", op=description)
+    _trace.inc("sync.payload_bytes", value=payload, op=description)
+    _trace.observe_duration("sync.collective", elapsed, op=description, ok="true")
+    _trace.event("sync.collective", op=description, seconds=round(elapsed, 6), bytes=payload, ok=True)
+    return out
+
+
+def _payload_bytes(x: Any) -> int:
+    """Best-effort byte size of one collective's local payload."""
+    try:
+        nbytes = getattr(x, "nbytes", None)
+        if nbytes is not None:
+            return int(nbytes)
+        return int(x.size) * int(x.dtype.itemsize)
+    except Exception:
+        return 0
 
 
 def world_size() -> int:

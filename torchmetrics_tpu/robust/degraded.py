@@ -30,6 +30,7 @@ import threading
 from contextlib import contextmanager
 from typing import Any, Callable, Optional
 
+import torchmetrics_tpu.obs.trace as _trace
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = [
@@ -145,10 +146,14 @@ def guarded_collective(fn: Callable[..., Any], *args: Any, description: str = "c
             # consistent; the other hosts' guards time out their own
             # now-short-handed collectives in turn.
             last_err = err
+            if _trace.ENABLED:
+                _trace.inc("sync.collective_timeout", op=description)
             break
         except _RETRYABLE as err:  # noqa: PERF203 - bounded retry loop by design
             last_err = err
             if attempt + 1 < attempts:
+                if _trace.ENABLED:
+                    _trace.inc("sync.collective_retry", op=description)
                 rank_zero_warn(
                     f"Eager collective {description} failed (attempt {attempt + 1}/{attempts}):"
                     f" {err}. Retrying.",

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple, Type, Union
 
+import torchmetrics_tpu.obs.trace as _trace
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = [
@@ -91,12 +92,20 @@ def retry_call(
                 break
             if on_retry is not None:
                 on_retry(attempt, err)
+            if _trace.ENABLED:
+                _trace.inc("retry.attempts", op=description)
             rank_zero_warn(
                 f"{description} failed (attempt {attempt + 1}/{schedule.max_attempts}):"
                 f" {err}. Retrying in {delay:g}s.",
                 RuntimeWarning,
             )
             sleep(delay)
+    # `retry.exhausted` signals a retry LOOP giving up, so only schedules that
+    # actually retried count: fetch_resource nests a max_attempts=1 fetch_bytes
+    # inside its own retry loop, and counting that inner single-shot failure
+    # would report exhaustion for fetches the outer loop then recovers.
+    if _trace.ENABLED and schedule.max_attempts > 1:
+        _trace.inc("retry.exhausted", op=description)
     raise RetryError(
         f"{description} failed after {schedule.max_attempts} attempt(s): {last_err}"
     ) from last_err

@@ -7,13 +7,31 @@ under jit, so they never trigger recompilation or device sync.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import time
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 Array = jax.Array
+
+
+def measure_runtime(fn: Callable[[], object], reps: int = 5, warmup: int = 0) -> float:
+    """Median wall-clock seconds of ``fn()`` over ``reps`` timed repetitions.
+
+    The shared perf timer behind :func:`check_forward_full_state_property` and
+    the obs disabled-path overhead smoke test: median (not mean) so one noisy
+    repetition on a shared host cannot dominate the measurement.
+    """
+    for _ in range(max(0, warmup)):
+        fn()
+    times = []
+    for _ in range(max(1, reps)):
+        start = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - start)
+    return float(np.median(times))
 
 
 def _check_same_shape(preds, target) -> None:
@@ -55,8 +73,6 @@ def check_forward_full_state_property(
     Parity: reference ``utilities/checks.py:636``. Prints timing for both paths and
     asserts result equality, so metric authors can set the class attribute safely.
     """
-    import time
-
     init_args = init_args or {}
     input_args = input_args or {}
 
@@ -84,12 +100,12 @@ def check_forward_full_state_property(
         )
 
     def _time(m):
-        start = time.perf_counter()
-        for _ in range(reps):
+        def _one_rep():
             for _ in range(num_update_to_compare):
                 m(**input_args)
             m.reset()
-        return (time.perf_counter() - start) / reps
+
+        return measure_runtime(_one_rep, reps=reps)
 
     t_full, t_part = _time(FullState(**init_args)), _time(PartialState(**init_args))
     print(f"Full state for {num_update_to_compare} steps took: {t_full}")  # noqa: T201

@@ -13,6 +13,8 @@ import warnings
 from functools import partial, wraps
 from typing import Any, Callable
 
+import torchmetrics_tpu.obs.trace as _trace
+
 log = logging.getLogger("torchmetrics_tpu")
 
 
@@ -47,6 +49,12 @@ def rank_zero_only(fn: Callable) -> Callable:
 @rank_zero_only
 def rank_zero_warn(message: str, *args: Any, **kwargs: Any) -> None:
     kwargs.setdefault("stacklevel", 5)
+    # With obs tracing enabled, warnings also land in the telemetry event log
+    # (so degraded-sync/quarantine warnings reach exported JSONL/Prometheus,
+    # not only stderr) and repeated identical messages are deduplicated: the
+    # repeat bumps the `warnings.deduplicated` counter instead of re-warning.
+    if _trace.ENABLED and not _trace.record_warning(str(message)):
+        return
     warnings.warn(message, *args, **kwargs)
 
 
