@@ -17,6 +17,11 @@ each config with tracing enabled and attaches per-config summaries — such a
 round's timings include the tracing overhead and are not comparable with
 untraced rounds (hence off by default).
 
+Every run also appends its per-config results to ``BENCH_HISTORY.jsonl`` (atomic
+append via the obs regression sentinel); ``python bench.py --check-regressions``
+additionally judges the fresh run against that history with noise-aware
+tolerances and exits 1 on a breach (see ``torchmetrics_tpu/obs/regress.py``).
+
 Backend policy: the host pins ``JAX_PLATFORMS=axon`` (tunneled TPU) and the tunnel has
 been wedged at bench time in past rounds. We probe the backend *in a subprocess* (a
 wedged tunnel hangs forever, it doesn't error), retry with backoff at bench time, and
@@ -34,6 +39,11 @@ import numpy as np
 BATCH = 4096
 NUM_CLASSES = 100
 STEPS = 120
+
+# every run's per-config results append here (one JSON line per run); the
+# regression sentinel (torchmetrics_tpu.obs.regress) judges the newest run
+# against this history — `python bench.py --check-regressions` gates on it
+_HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl")
 
 
 # --------------------------------------------------------------------------- backend
@@ -1044,7 +1054,52 @@ def _run_pallas_ab() -> dict:
     return ab
 
 
-def main() -> None:
+def _record_history(result: dict, check: bool) -> None:
+    """Append this run to BENCH_HISTORY.jsonl; with ``check``, gate on regressions.
+
+    Without ``check`` the append is best-effort (a default bench round must
+    never die on its bookkeeping). With ``check`` this IS the CI gate, so a
+    sentinel that cannot run is itself a failure: exits 2 on import/load/append
+    errors (matching the standalone CLI) and 1 on a breach. The regression
+    table goes to stderr so the one-JSON-line stdout contract holds.
+    """
+    try:
+        from torchmetrics_tpu.obs import regress
+    except Exception as err:
+        sys.stderr.write(f"bench history: obs.regress unavailable ({err!r})\n")
+        if check:
+            sys.exit(2)  # a gate that cannot run must not pass
+        return
+    try:
+        history = (
+            regress.load_history(_HISTORY_PATH)
+            if check and os.path.exists(_HISTORY_PATH)
+            else []
+        )
+        # traced rounds (TM_TPU_BENCH_OBS=1) carry tracing overhead in their
+        # timings: recorded for the telemetry, tagged so they are never used
+        # as baselines and never judged
+        record = regress.append_history(result, path=_HISTORY_PATH, traced=_BENCH_OBS)
+    except Exception as err:
+        sys.stderr.write(f"bench history append failed: {err!r}\n")
+        if check:
+            sys.exit(2)
+        return
+    if not check:
+        return
+    if record.get("traced"):
+        sys.stderr.write(
+            "bench regression check skipped: traced round (TM_TPU_BENCH_OBS=1) timings"
+            " are not comparable with untraced history.\n"
+        )
+        return
+    rows = regress.check_regressions(record, history)
+    sys.stderr.write(regress.format_table(rows, hardware=record.get("hardware")))
+    if any(row.get("regressed") for row in rows):
+        sys.exit(1)
+
+
+def main(check_regressions: bool = False) -> None:
     hardware = _acquire_backend()
     if hardware == "cpu-fallback":
         ours = _run_fallback_via_workers()
@@ -1169,10 +1224,11 @@ def main() -> None:
         "obs": obs_summary,
     }
     print(json.dumps(result))
+    _record_history(result, check=check_regressions)
 
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         _worker_main(sys.argv[2])
     else:
-        main()
+        main(check_regressions="--check-regressions" in sys.argv[1:])

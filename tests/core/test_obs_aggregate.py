@@ -1,0 +1,234 @@
+"""Cross-host telemetry aggregation (obs/aggregate.py).
+
+The multihost world is faked the same way the sync suites fake it (patched
+``multihost_utils.process_allgather`` + forced ``distributed_available``); the
+degraded path runs the real guard machinery against an injected hanging
+collective with a millisecond timeout. The REAL two-process validation lives
+in ``tests/multiproc/test_aggregate_two_process.py``.
+"""
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import multihost_utils
+
+import torchmetrics_tpu.parallel.sync as sync_mod
+from torchmetrics_tpu import robust
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.obs.aggregate import aggregate, host_snapshot, merge_snapshots, summarize
+from torchmetrics_tpu.robust import faults
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    yield
+    trace.disable()
+    trace.get_recorder().clear()
+
+
+def _meta(index: int, count: int = 2):
+    return {"process_index": index, "process_count": count, "host_id": f"fake-host-{index}:1"}
+
+
+def _recorder_for_host(index: int) -> trace.TraceRecorder:
+    """A recorder holding deterministic, host-distinct telemetry."""
+    rec = trace.TraceRecorder()
+    rec.inc("work.items", 10.0 * (index + 1))
+    rec.inc("jit.cache_hit", 2.0, fn="M.pure_update")
+    rec.set_gauge("cache.size", float(index + 3))
+    rec.observe_duration("sync.collective", 5e-4 * (index + 1), op="gather")
+    rec.record_warning("everywhere")
+    rec.record_warning(f"only-host-{index}")
+    rec.add_span("metric.update", start=rec._t0 + 0.001, duration=0.002, depth=0, attrs={"metric": "M"})
+    return rec
+
+
+def _snapshot_for_host(index: int, monkeypatch, include_events=True, count: int = 2):
+    monkeypatch.setattr(trace, "_host_meta", lambda: _meta(index, count))
+    return host_snapshot(_recorder_for_host(index), include_events=include_events)
+
+
+class TestHostSnapshot:
+    def test_rank_aware_fields(self):
+        snap = host_snapshot(_recorder_for_host(0))
+        assert snap["schema_version"] == trace.SCHEMA_VERSION
+        for key in ("process_index", "process_count", "host_id"):
+            assert key in snap["host"]
+        assert snap["wall_clock_anchor"] > 0
+        assert snap["elapsed"] >= 0
+        assert snap["warnings"] == ["everywhere", "only-host-0"]
+        assert snap["n_events"] == len(snap["events"]) > 0
+
+    def test_include_events_false_keeps_warnings(self):
+        snap = host_snapshot(_recorder_for_host(1), include_events=False)
+        assert snap["events"] == []
+        assert snap["n_events"] > 0  # the count survives the strip
+        assert "only-host-1" in snap["warnings"]
+
+    def test_snapshot_json_round_trips(self):
+        snap = host_snapshot(_recorder_for_host(0))
+        assert json.loads(json.dumps(snap, default=str))["host"]["process_index"] == snap["host"]["process_index"]
+
+
+class TestMergeSnapshots:
+    def test_counters_sum(self, monkeypatch):
+        snaps = [_snapshot_for_host(i, monkeypatch) for i in range(2)]
+        merged = merge_snapshots(snaps)
+        assert merged["n_hosts"] == 2 and merged["aggregate"] is True
+        counters = {c["name"]: c["value"] for c in merged["counters"] if not c["labels"]}
+        assert counters["work.items"] == 30.0
+        labeled = [c for c in merged["counters"] if c["name"] == "jit.cache_hit"]
+        assert labeled[0]["labels"] == {"fn": "M.pure_update"} and labeled[0]["value"] == 4.0
+
+    def test_gauges_keep_per_host_values_plus_max(self, monkeypatch):
+        merged = merge_snapshots([_snapshot_for_host(i, monkeypatch) for i in range(2)])
+        gauge = [g for g in merged["gauges"] if g["name"] == "cache.size"][0]
+        assert gauge["per_host"] == {"0": 3.0, "1": 4.0}
+        assert gauge["max"] == 4.0
+
+    def test_histograms_merge_bucket_wise(self, monkeypatch):
+        merged = merge_snapshots([_snapshot_for_host(i, monkeypatch) for i in range(2)])
+        hist = [h for h in merged["histograms"] if h["name"] == "sync.collective"][0]
+        assert hist["count"] == 2
+        assert hist["sum"] == pytest.approx(1.5e-3)
+        by_bound = {bound: count for bound, count in hist["buckets"]}
+        assert by_bound[1e-3] == 2  # both samples land in the same log bucket
+
+    def test_warnings_carry_host_lists(self, monkeypatch):
+        merged = merge_snapshots([_snapshot_for_host(i, monkeypatch) for i in range(2)])
+        by_message = {w["message"]: w["hosts"] for w in merged["warnings"]}
+        assert by_message["everywhere"] == [0, 1]
+        assert by_message["only-host-0"] == [0]
+        assert by_message["only-host-1"] == [1]
+
+    def test_schema_mismatch_host_excluded_not_misparsed(self, monkeypatch):
+        good = _snapshot_for_host(0, monkeypatch)
+        bad = _snapshot_for_host(1, monkeypatch)
+        bad["schema_version"] = trace.SCHEMA_VERSION + 1
+        merged = merge_snapshots([good, bad])
+        assert merged["n_hosts"] == 1
+        assert merged["schema_mismatch_hosts"] == [
+            {"process_index": 1, "schema_version": trace.SCHEMA_VERSION + 1}
+        ]
+        counters = {c["name"]: c["value"] for c in merged["counters"] if not c["labels"]}
+        assert counters["work.items"] == 10.0  # host 1's data never merged
+
+    def test_summarize_mentions_everything(self, monkeypatch):
+        merged = merge_snapshots([_snapshot_for_host(i, monkeypatch) for i in range(2)])
+        text = summarize(merged)
+        for needle in ("2 host(s)", "work.items", "cache.size", "max=4", "hosts [0, 1]"):
+            assert needle in text, f"missing {needle!r} in:\n{text}"
+
+
+def _fake_world_for_peer(peer_payload: bytes):
+    """A process_allgather fake acting as the 2-host payload transport."""
+
+    def fake(x, tiled=False):
+        x = np.asarray(x)
+        if x.dtype == np.int32 and x.shape == (1,):  # length exchange
+            return jnp.asarray(np.stack([x, np.asarray([len(peer_payload)], np.int32)]))
+        width = x.shape[0]
+        padded = np.zeros(width, np.uint8)
+        padded[: len(peer_payload)] = np.frombuffer(peer_payload, np.uint8)
+        return jnp.asarray(np.stack([x.astype(np.uint8), padded]))
+
+    return fake
+
+
+class TestAggregate:
+    def test_single_host_fallback_is_clean(self):
+        rec = _recorder_for_host(0)
+        agg = aggregate(rec)
+        assert agg["n_hosts"] == 1
+        assert agg["aggregate_degraded"] is False and agg["missing_hosts"] == []
+        counters = {c["name"]: c["value"] for c in agg["counters"] if not c["labels"]}
+        assert counters["work.items"] == 10.0
+
+    def test_two_host_world_over_guarded_transport(self, monkeypatch):
+        peer_snap = _snapshot_for_host(1, monkeypatch, include_events=False)
+        peer_payload = json.dumps(peer_snap, default=str).encode("utf-8")
+        monkeypatch.setattr(trace, "_host_meta", lambda: _meta(0))
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        monkeypatch.setattr(multihost_utils, "process_allgather", _fake_world_for_peer(peer_payload))
+        agg = aggregate(_recorder_for_host(0), include_events=False)
+        assert agg["n_hosts"] == 2 and not agg["aggregate_degraded"]
+        counters = {c["name"]: c["value"] for c in agg["counters"] if not c["labels"]}
+        assert counters["work.items"] == 30.0
+        gauge = [g for g in agg["gauges"] if g["name"] == "cache.size"][0]
+        assert gauge["per_host"] == {"0": 3.0, "1": 4.0} and gauge["max"] == 4.0
+        by_message = {w["message"]: w["hosts"] for w in agg["warnings"]}
+        assert by_message["everywhere"] == [0, 1]
+
+    def test_hung_host_degrades_to_loud_partial_aggregate(self, monkeypatch):
+        monkeypatch.setattr(trace, "_host_meta", lambda: _meta(0, count=3))
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        rec = _recorder_for_host(0)
+        with robust.sync_guard(timeout=0.02, retries=1):
+            with faults.inject_collective_fault(mode="hang", times=10):
+                with pytest.warns(RuntimeWarning, match="DEGRADED"):
+                    agg = aggregate(rec)
+        assert agg["aggregate_degraded"] is True
+        assert agg["missing_hosts"] == [1, 2]
+        assert "timed out" in agg["degraded_error"]
+        # partial: the local host's view is fully present
+        counters = {c["name"]: c["value"] for c in agg["counters"] if not c["labels"]}
+        assert counters["work.items"] == 10.0
+        assert "[DEGRADED/PARTIAL]" in summarize(agg)
+
+    def test_raising_transport_also_degrades(self, monkeypatch):
+        monkeypatch.setattr(trace, "_host_meta", lambda: _meta(0))
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        with robust.sync_guard(timeout=0.5, retries=1):
+            with faults.inject_collective_fault(mode="raise", times=10):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    agg = aggregate(_recorder_for_host(0))
+        assert agg["aggregate_degraded"] is True and agg["missing_hosts"] == [1]
+
+    def test_degrade_is_counted_when_tracing(self, monkeypatch):
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        with trace.observe() as rec:
+            with robust.sync_guard(timeout=0.02, retries=0):
+                with faults.inject_collective_fault(mode="hang", times=10):
+                    with warnings.catch_warnings():
+                        warnings.simplefilter("ignore")
+                        aggregate(rec)
+            assert rec.counter_value("aggregate.degraded") == 1
+            assert any(e["name"] == "aggregate.degraded" for e in rec.events())
+
+    def test_corrupt_peer_payload_degrades_loudly_not_fatally(self, monkeypatch):
+        monkeypatch.setattr(trace, "_host_meta", lambda: _meta(0))
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather", _fake_world_for_peer(b"\xff\xfenot json")
+        )
+        with pytest.warns(RuntimeWarning, match="PARTIAL/DEGRADED"):
+            agg = aggregate(_recorder_for_host(0), include_events=False)
+        assert agg["corrupt_hosts"] == [1]
+        assert agg["n_hosts"] == 1
+        assert agg["missing_hosts"] == [1]
+        # a non-merged peer makes the aggregate partial: the one documented
+        # signal for that must fire
+        assert agg["aggregate_degraded"] is True
+
+    def test_schema_mismatch_peer_degrades_loudly(self, monkeypatch):
+        peer_snap = _snapshot_for_host(1, monkeypatch, include_events=False)
+        peer_snap["schema_version"] = trace.SCHEMA_VERSION + 7
+        peer_payload = json.dumps(peer_snap, default=str).encode("utf-8")
+        monkeypatch.setattr(trace, "_host_meta", lambda: _meta(0))
+        monkeypatch.setattr(sync_mod, "distributed_available", lambda: True)
+        monkeypatch.setattr(multihost_utils, "process_allgather", _fake_world_for_peer(peer_payload))
+        with pytest.warns(RuntimeWarning, match="schema mismatch"):
+            agg = aggregate(_recorder_for_host(0), include_events=False)
+        assert agg["aggregate_degraded"] is True
+        assert agg["missing_hosts"] == [1]
+        assert agg["schema_mismatch_hosts"] == [
+            {"process_index": 1, "schema_version": trace.SCHEMA_VERSION + 7}
+        ]

@@ -8,6 +8,7 @@ are asserted with the wall-clock fields stripped.
 
 import io
 import json
+import re
 import warnings
 
 import jax
@@ -516,8 +517,13 @@ class TestExporters:
         records = [json.loads(line) for line in sink.getvalue().splitlines()]
         for record in records:
             record.pop("ts", None)
+        # the meta line is rank-aware: strip its nondeterministic identity
+        # fields after checking they exist
+        meta = records[0]
+        assert meta.pop("host_id") and meta.pop("wall_clock_anchor") > 0
+        assert meta.pop("process_index") == 0
         assert records == [
-            {"type": "meta", "dropped_events": 0, "events": 1},
+            {"type": "meta", "schema_version": 1, "dropped_events": 0, "events": 1},
             {"type": "event", "name": "ev", "attrs": {"k": "v"}},
             {"type": "counter", "name": "c", "labels": {"fn": "f"}, "value": 1.0},
         ]
@@ -552,6 +558,182 @@ class TestExporters:
         m.update(jnp.ones(2), jnp.zeros(2))
         text = export.prometheus_text(metrics=[m])
         assert 'tm_tpu_robust_updates_ok_total{instance="0",metric="MeanSquaredError"} 1' in text
+
+    def test_jsonl_write_failure_never_leaves_partial_file(self, tmp_path, monkeypatch):
+        """Telemetry file writes are atomic: an injected rename failure leaves
+        the previous export intact and no temp litter behind."""
+        import os as os_mod
+
+        import torchmetrics_tpu.utils.fileio as fileio
+
+        path = str(tmp_path / "obs.jsonl")
+        with trace.observe():
+            trace.inc("c")
+        export.write_jsonl(path)
+        before = open(path).read()
+        assert before.splitlines()[0].startswith('{"dropped_events"')
+
+        monkeypatch.setattr(
+            fileio.os, "replace", lambda s, d: (_ for _ in ()).throw(OSError("disk full"))
+        )
+        with trace.observe():
+            trace.inc("c", 41)
+        with pytest.raises(OSError, match="disk full"):
+            export.write_jsonl(path)
+        assert open(path).read() == before  # old export intact, not truncated
+        assert os_mod.listdir(tmp_path) == ["obs.jsonl"]  # temp file cleaned up
+
+
+# ------------------------------------------------- Prometheus exposition audit
+
+
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.+)$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"  # metric name
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"  # labels
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:e-?[0-9]+)?|\+Inf|-Inf|NaN))$"  # value
+)
+
+
+def _parse_exposition(text: str):
+    """Strict line-format parse of a Prometheus 0.0.4 page.
+
+    Returns (families, samples): family name -> {type, help}, and a list of
+    (family, labels-dict, value). Raises AssertionError on any malformed line.
+    """
+    families, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            match = _HELP_RE.match(line)
+            assert match, f"malformed HELP line: {line!r}"
+            families.setdefault(match.group(1), {})["help"] = match.group(2)
+            continue
+        if line.startswith("# TYPE "):
+            match = _TYPE_RE.match(line)
+            assert match, f"malformed TYPE line: {line!r}"
+            families.setdefault(match.group(1), {})["type"] = match.group(2)
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"malformed sample line: {line!r}"
+        name, label_body, value = match.groups()
+        labels = {}
+        if label_body:
+            for pair in re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"', label_body):
+                labels[pair[0]] = pair[1]
+        samples.append((name, labels, value))
+    return families, samples
+
+
+def _family_of(sample_name: str, families) -> str:
+    for suffix in ("_bucket", "_sum", "_count"):
+        if sample_name.endswith(suffix) and sample_name[: -len(suffix)] in families:
+            base = sample_name[: -len(suffix)]
+            if families[base].get("type") == "histogram":
+                return base
+    return sample_name
+
+
+class TestPrometheusExpositionAudit:
+    """Lock the text exposition with a strict line-format checker."""
+
+    def _page(self):
+        with trace.observe():
+            _seed_recorder_deterministically()
+            trace.observe_duration("sync.collective", 2.0, op="leaf gather", ok="true")
+            trace.inc("c", reason="line1\nline2")
+        m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(2), jnp.zeros(2))
+        return export.prometheus_text(metrics=[m])
+
+    def test_every_line_parses_and_every_family_has_help_and_type(self):
+        families, samples = _parse_exposition(self._page())
+        assert samples, "page must not be empty"
+        for name, info in families.items():
+            assert "type" in info, f"family {name} missing # TYPE"
+            assert "help" in info, f"family {name} missing # HELP"
+        for name, _, _ in samples:
+            assert _family_of(name, families) in families, f"sample {name} has no family header"
+
+    def test_counter_families_end_in_total(self):
+        families, _ = _parse_exposition(self._page())
+        for name, info in families.items():
+            if info["type"] == "counter":
+                assert name.endswith("_total"), name
+
+    def test_histograms_cumulative_with_inf_sum_and_count(self):
+        families, samples = _parse_exposition(self._page())
+        hist_families = [name for name, info in families.items() if info["type"] == "histogram"]
+        assert "tm_tpu_sync_collective_seconds" in hist_families
+        for family in hist_families:
+            series = {}
+            for name, labels, value in samples:
+                if name == f"{family}_bucket":
+                    key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+                    series.setdefault(key, []).append((labels["le"], float(value)))
+            assert series, f"histogram {family} emitted no buckets"
+            sample_names = {name for name, _, _ in samples}
+            assert f"{family}_sum" in sample_names and f"{family}_count" in sample_names
+            counts = {
+                tuple(sorted(labels.items())): float(value)
+                for name, labels, value in samples
+                if name == f"{family}_count"
+            }
+            for key, buckets in series.items():
+                assert buckets[-1][0] == "+Inf", f"{family}{dict(key)} le ladder must end at +Inf"
+                values = [count for _, count in buckets]
+                assert values == sorted(values), f"{family}{dict(key)} buckets not cumulative"
+                assert counts[key] == values[-1], f"{family}_count != +Inf bucket for {dict(key)}"
+
+    def test_label_escaping_survives_strict_parse(self):
+        families, samples = _parse_exposition(self._page())
+        escaped = [labels for name, labels, _ in samples if name == "tm_tpu_c_total"]
+        assert escaped and escaped[0]["reason"] == "line1\\nline2"
+
+
+# ---------------------------------------------------- warning-drop visibility
+
+
+class TestWarningDropVisibility:
+    def test_past_cap_messages_counted_not_silent(self):
+        rec = trace.get_recorder()
+        with trace.observe():
+            rec.max_tracked_warnings = 3
+            try:
+                for i in range(8):
+                    assert trace.record_warning(f"distinct {i}")
+            finally:
+                del rec.max_tracked_warnings
+        # 3 tracked; 5 past the cap -> counted, still emitted + event-logged
+        assert rec.counter_value("warnings.dropped") == 5
+        assert rec.counter_value("warnings.emitted") == 8
+        assert len([e for e in rec.events() if e["kind"] == "warning"]) == 8
+
+    def test_surfaced_in_summary_and_prometheus(self):
+        rec = trace.get_recorder()
+        with trace.observe():
+            rec.max_tracked_warnings = 1
+            try:
+                trace.record_warning("first")
+                trace.record_warning("second (past cap)")
+            finally:
+                del rec.max_tracked_warnings
+        text = export.summary()
+        assert "1 past dedup cap (warnings_dropped)" in text
+        prom = export.prometheus_text()
+        assert "tm_tpu_warnings_dropped_total 1" in prom.splitlines()
+        assert "# TYPE tm_tpu_warnings_dropped_total counter" in prom.splitlines()
+
+    def test_no_drop_counter_below_cap(self):
+        with trace.observe():
+            trace.record_warning("one")
+            trace.record_warning("one")  # duplicate, not a drop
+        rec = trace.get_recorder()
+        assert rec.counter_value("warnings.dropped") == 0
+        assert rec.counter_value("warnings.deduplicated") == 1
 
 
 # ------------------------------------------------------- acceptance: 3-metric run

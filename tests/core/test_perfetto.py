@@ -1,0 +1,201 @@
+"""Perfetto / Chrome trace-event export of the obs span ring buffer.
+
+The golden here is structural: every emitted event must be a valid trace-event
+(``ph``/``ts``/``pid`` at minimum), span nesting must be preserved through the
+``X`` complete-event encoding, and the whole document must be plain JSON (no
+Infinity/NaN) so Perfetto and ``chrome://tracing`` accept the file.
+"""
+
+import json
+import os
+
+import pytest
+
+from torchmetrics_tpu.obs import perfetto, trace
+from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    yield
+    trace.disable()
+    trace.get_recorder().clear()
+
+
+def _validate_chrome_trace(doc):
+    """Strict structural validation of a Chrome trace-event JSON document."""
+    assert set(doc) >= {"traceEvents", "displayTimeUnit"}
+    # strict JSON: Perfetto rejects Infinity/NaN literals
+    json.loads(json.dumps(doc, allow_nan=False))
+    for event in doc["traceEvents"]:
+        assert "ph" in event and "ts" in event and "pid" in event, event
+        assert isinstance(event["ts"], (int, float)) and event["ts"] >= 0
+        if event["ph"] == "X":
+            assert "dur" in event and event["dur"] >= 0
+            assert "tid" in event and "name" in event
+        if event["ph"] == "C":
+            assert all(isinstance(v, (int, float)) for v in event["args"].values())
+    return doc["traceEvents"]
+
+
+def _record_scenario():
+    with trace.observe():
+        with trace.span("metric.update", metric="Acc", path="jit"):
+            with trace.span("jit.compile", fn="Acc.pure_update"):
+                pass
+        trace.inc("jit.cache_miss", fn="Acc.pure_update")
+        trace.set_gauge("jit.cache_size", 1, fn="Acc.pure_update")
+        trace.event("sync.collective", bytes=64)
+        trace.record_warning("watch out")
+
+
+class TestSingleHostExport:
+    def test_every_event_has_ph_ts_pid(self):
+        _record_scenario()
+        events = _validate_chrome_trace(perfetto.chrome_trace())
+        assert events, "export must not be empty"
+
+    def test_span_nesting_preserved(self):
+        _record_scenario()
+        events = _validate_chrome_trace(perfetto.chrome_trace())
+        spans = {e["name"]: e for e in events if e["ph"] == "X"}
+        outer, inner = spans["metric.update"], spans["jit.compile"]
+        assert outer["pid"] == inner["pid"] and outer["tid"] == inner["tid"]
+        # X-event nesting: the inner span's interval sits inside the outer's
+        eps = 0.5  # us rounding slack
+        assert inner["ts"] >= outer["ts"] - eps
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + eps
+        assert outer["args"] == {"metric": "Acc", "path": "jit"}
+
+    def test_counters_and_gauges_become_counter_tracks(self):
+        _record_scenario()
+        events = _validate_chrome_trace(perfetto.chrome_trace())
+        tracks = {e["name"]: e for e in events if e["ph"] == "C"}
+        assert tracks['jit.cache_miss{fn=Acc.pure_update}']["args"]["value"] == 1.0
+        assert tracks['jit.cache_size{fn=Acc.pure_update}']["args"]["value"] == 1.0
+
+    def test_instants_and_warnings(self):
+        _record_scenario()
+        events = _validate_chrome_trace(perfetto.chrome_trace())
+        instants = [e for e in events if e["ph"] == "i"]
+        assert any(e["name"] == "sync.collective" and e["args"]["bytes"] == 64 for e in instants)
+        assert any(e["cat"] == "warning" and e["args"]["message"] == "watch out" for e in instants)
+
+    def test_process_metadata_present(self):
+        _record_scenario()
+        events = _validate_chrome_trace(perfetto.chrome_trace())
+        meta = [e for e in events if e["ph"] == "M" and e["name"] == "process_name"]
+        assert meta and "host 0" in meta[0]["args"]["name"]
+
+
+def _two_host_snapshots(monkeypatch):
+    snaps = []
+    for index in range(2):
+        monkeypatch.setattr(
+            trace,
+            "_host_meta",
+            lambda index=index: {
+                "process_index": index,
+                "process_count": 2,
+                "host_id": f"host{index}:1",
+            },
+        )
+        rec = trace.TraceRecorder()
+        rec.add_span("metric.update", start=rec._t0 + 0.001, duration=0.002, depth=0, attrs={"h": str(index)})
+        rec.inc("work.items", 5.0 * (index + 1))
+        snap = host_snapshot(rec)
+        snap["wall_clock_anchor"] = 1000.0 + 0.5 * index  # deterministic skew
+        snaps.append(snap)
+    return snaps
+
+
+class TestMultiHostExport:
+    def test_one_pid_per_host(self, monkeypatch):
+        snaps = _two_host_snapshots(monkeypatch)
+        events = _validate_chrome_trace(perfetto.chrome_trace(snaps))
+        assert {e["pid"] for e in events} == {0, 1}
+        names = {e["pid"]: e["args"]["name"] for e in events if e["ph"] == "M" and e["name"] == "process_name"}
+        assert names == {0: "host 0 (host0:1)", 1: "host 1 (host1:1)"}
+
+    def test_hosts_align_on_wall_clock_anchor(self, monkeypatch):
+        snaps = _two_host_snapshots(monkeypatch)
+        events = _validate_chrome_trace(perfetto.chrome_trace(snaps))
+        spans = {e["pid"]: e for e in events if e["ph"] == "X"}
+        # host 1's anchor is 0.5s later -> its identical-relative-ts span
+        # lands 5e5 us later on the shared timeline
+        assert spans[1]["ts"] - spans[0]["ts"] == pytest.approx(5e5, abs=1.0)
+
+    def test_aggregate_with_events_exports(self, monkeypatch):
+        snaps = _two_host_snapshots(monkeypatch)
+        agg = merge_snapshots(snaps)
+        assert "host_snapshots" in agg
+        events = _validate_chrome_trace(perfetto.chrome_trace(agg))
+        assert {e["pid"] for e in events} == {0, 1}
+
+    def test_counters_only_aggregate_with_events_included_exports(self, monkeypatch):
+        """include_events=True with an empty ring buffer (counters-only
+        workload) must still export — one counter track per host, no error."""
+        snaps = []
+        for index in range(2):
+            monkeypatch.setattr(
+                trace,
+                "_host_meta",
+                lambda index=index: {
+                    "process_index": index,
+                    "process_count": 2,
+                    "host_id": f"host{index}:1",
+                },
+            )
+            rec = trace.TraceRecorder()
+            rec.inc("work.items", 5.0 * (index + 1))  # counters only, no events
+            snap = host_snapshot(rec, include_events=True)
+            assert snap["events"] == [] and snap["events_included"] is True
+            snaps.append(snap)
+        agg = merge_snapshots(snaps)
+        assert "host_snapshots" in agg  # shipped-but-empty events still qualify
+        events = _validate_chrome_trace(perfetto.chrome_trace(agg))
+        tracks = [e for e in events if e["ph"] == "C" and e["name"] == "work.items"]
+        assert {e["pid"] for e in tracks} == {0, 1}
+
+    def test_aggregate_without_events_raises_clear_error(self, monkeypatch):
+        snaps = _two_host_snapshots(monkeypatch)
+        for snap in snaps:
+            snap["events"] = []
+        agg = merge_snapshots(snaps)
+        agg.pop("host_snapshots", None)
+        with pytest.raises(ValueError, match="include_events=True"):
+            perfetto.chrome_trace(agg)
+
+
+class TestWriteTrace:
+    def test_file_round_trip(self, tmp_path):
+        _record_scenario()
+        path = str(tmp_path / "trace.json")
+        n = perfetto.write_trace(path)
+        with open(path) as fh:
+            doc = json.load(fh)
+        assert len(doc["traceEvents"]) == n > 0
+        _validate_chrome_trace(doc)
+
+    def test_write_failure_never_leaves_partial_file(self, tmp_path, monkeypatch):
+        _record_scenario()
+        path = str(tmp_path / "trace.json")
+        with open(path, "w") as fh:
+            fh.write('{"traceEvents": []}')  # pre-existing good export
+
+        import torchmetrics_tpu.utils.fileio as fileio
+
+        def _boom(src, dst):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(fileio.os, "replace", _boom)
+        with pytest.raises(OSError, match="disk full"):
+            perfetto.write_trace(path)
+        # the old file is intact and no temp siblings leak
+        with open(path) as fh:
+            assert json.load(fh) == {"traceEvents": []}
+        assert os.listdir(tmp_path) == ["trace.json"]

@@ -1,0 +1,304 @@
+"""Bench-history regression sentinel (obs/regress.py).
+
+All synthetic: fabricated histories with known noise, an injected 2x slowdown
+that must flag, within-spread drift that must stay quiet, and the module CLI
+driven both in-process and via ``python -m`` (the documented CI entry point).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from torchmetrics_tpu.obs import regress
+
+pytestmark = pytest.mark.obs
+
+
+def _run(value, unit="us/step", name="stateful", hardware="cpu-fallback", spread=None, **extra):
+    cfg = {"value": value, "unit": unit}
+    if spread is not None:
+        cfg["spread"] = spread
+    configs = {name: cfg}
+    configs.update(extra)
+    return regress.run_record({"hardware": hardware, "configs": configs})
+
+
+class TestRunRecord:
+    def test_distills_bench_result(self):
+        result = {
+            "hardware": "cpu-fallback",
+            "configs": {
+                "a": {"value": 10.5, "unit": "us/step", "baseline": 99.0, "note": "x"},
+                "b": {"value": None, "unit": "us/step"},  # failed config: dropped
+                "c": "not a dict",
+                "d": {"value": 3.0, "unit": "% of step time", "spread": {"min": 1.0, "max": 5.0, "reps": 5}},
+            },
+        }
+        record = regress.run_record(result, label="r06")
+        assert record["hardware"] == "cpu-fallback" and record["label"] == "r06"
+        assert set(record["configs"]) == {"a", "d"}
+        assert record["configs"]["a"] == {"value": 10.5, "unit": "us/step"}
+        assert record["configs"]["d"]["spread"] == {"min": 1.0, "max": 5.0, "reps": 5.0}
+
+
+class TestCheckRegressions:
+    def test_injected_2x_slowdown_is_flagged(self):
+        history = [_run(v) for v in (100.0, 110.0, 95.0)]
+        current = _run(190.0)  # 2x the best (95)
+        rows = regress.check_regressions(current, history)
+        assert len(rows) == 1 and rows[0]["regressed"] is True
+        assert rows[0]["baseline"] == 95.0 and rows[0]["ratio"] == 2.0
+
+    def test_within_observed_noise_stays_quiet(self):
+        # history itself drifts 100 -> 140 (40%); drifting there again is noise
+        history = [_run(v) for v in (100.0, 140.0)]
+        rows = regress.check_regressions(_run(140.0), history)
+        assert rows[0]["regressed"] is False
+        # ... but 2x the best is beyond noise * headroom
+        rows = regress.check_regressions(_run(210.0), history)
+        assert rows[0]["regressed"] is True
+
+    def test_throughput_direction(self):
+        history = [_run(v, unit="samples/sec") for v in (50.0, 45.0)]
+        assert regress.check_regressions(_run(48.0, unit="samples/sec"), history)[0]["regressed"] is False
+        rows = regress.check_regressions(_run(20.0, unit="samples/sec"), history)
+        assert rows[0]["regressed"] is True and rows[0]["ratio"] == pytest.approx(2.5)
+
+    def test_recorded_spread_widens_tolerance(self):
+        spread = {"min": 0.0, "max": 4.84, "reps": 5}
+        history = [_run(1.18, unit="% of step time", spread=spread)]
+        # within the recorded rep spread: quiet even though 4.5/1.18 > 1.5x
+        assert regress.check_regressions(
+            _run(4.5, unit="% of step time"), history
+        )[0]["regressed"] is False
+        assert regress.check_regressions(
+            _run(10.0, unit="% of step time"), history
+        )[0]["regressed"] is True
+
+    def test_other_hardware_history_is_ignored(self):
+        history = [_run(100.0, hardware="tpu-v4")]
+        rows = regress.check_regressions(_run(500.0, hardware="cpu-fallback"), history)
+        assert rows[0]["baseline"] is None and rows[0]["regressed"] is False
+        rows = regress.check_regressions(
+            _run(500.0, hardware="cpu-fallback"), history, same_hardware=False
+        )
+        assert rows[0]["regressed"] is True
+
+    def test_unknown_units_are_skipped(self):
+        history = [_run(1.0, unit="furlongs")]
+        assert regress.check_regressions(_run(99.0, unit="furlongs"), history) == []
+
+    def test_non_dict_config_entries_never_crash_the_gate(self):
+        # hand-edited / foreign-tool history lines: {"configs": {"stateful": 5}}
+        history = [_run(100.0), {"schema": 1, "hardware": "cpu-fallback", "configs": {"stateful": 5}}]
+        rows = regress.check_regressions(_run(105.0), history)
+        assert rows[0]["regressed"] is False and rows[0]["n_history"] == 1
+        mangled_current = {"hardware": "cpu-fallback", "configs": {"stateful": 5}}
+        assert regress.check_regressions(mangled_current, history) == []
+
+
+class TestHistoryFile:
+    def test_append_and_load_round_trip(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        regress.append_history({"hardware": "h", "configs": {"a": {"value": 1.0, "unit": "us/step"}}}, path=path)
+        regress.append_history({"hardware": "h", "configs": {"a": {"value": 2.0, "unit": "us/step"}}}, path=path)
+        runs = regress.load_history(path)
+        assert [r["configs"]["a"]["value"] for r in runs] == [1.0, 2.0]
+
+    def test_append_never_damages_prior_lines(self, tmp_path):
+        """O_APPEND contract: a torn trailing line (crash mid-append) is healed
+        on the next append and skipped on load; earlier lines are untouched."""
+        path = str(tmp_path / "hist.jsonl")
+        regress.append_history({"hardware": "h", "configs": {"a": {"value": 1.0, "unit": "us/step"}}}, path=path)
+        good_line = open(path).read()
+        with open(path, "a") as fh:
+            fh.write('{"schema": 1, "configs": {"a": {"val')  # torn write, no newline
+        regress.append_history({"hardware": "h", "configs": {"a": {"value": 2.0, "unit": "us/step"}}}, path=path)
+        content = open(path).read()
+        assert content.startswith(good_line)  # prior line byte-identical
+        runs = regress.load_history(path)  # torn line skipped with a warning
+        assert [r["configs"]["a"]["value"] for r in runs] == [1.0, 2.0]
+        assert os.listdir(tmp_path) == ["hist.jsonl"]  # no temp litter
+
+    def test_malformed_lines_are_skipped(self, tmp_path, capsys):
+        path = tmp_path / "hist.jsonl"
+        good = json.dumps({"schema": 1, "hardware": "h", "configs": {}})
+        path.write_text(good + "\n{truncated\n" + good + "\n")
+        assert len(regress.load_history(str(path))) == 2
+
+
+class TestTracedRuns:
+    def test_traced_runs_never_judged_nor_used_as_baselines(self):
+        history = [_run(100.0), regress.run_record(
+            {"hardware": "cpu-fallback", "configs": {"stateful": {"value": 50.0, "unit": "us/step"}}},
+            traced=True,
+        )]
+        # the traced 50.0 must NOT become the baseline: 140 vs best=100 is quiet
+        rows = regress.check_regressions(_run(140.0), history)
+        assert rows[0]["baseline"] == 100.0 and rows[0]["regressed"] is False
+        # a traced current run is never judged at all
+        traced_current = regress.run_record(
+            {"hardware": "cpu-fallback", "configs": {"stateful": {"value": 900.0, "unit": "us/step"}}},
+            traced=True,
+        )
+        assert regress.check_regressions(traced_current, history) == []
+
+    def test_cli_skips_traced_newest_run(self, tmp_path, capsys):
+        path = str(tmp_path / "hist.jsonl")
+        for v in (100.0, 98.0):
+            regress.append_history(
+                {"hardware": "h", "configs": {"stateful": {"value": v, "unit": "us/step"}}}, path=path
+            )
+        regress.append_history(
+            {"hardware": "h", "configs": {"stateful": {"value": 500.0, "unit": "us/step"}}},
+            path=path,
+            traced=True,
+        )
+        # the traced 500.0 is skipped; newest untraced (98) vs (100) is quiet
+        assert regress.main(["--history", path]) == 0
+
+
+class TestBootstrapGuard:
+    def test_refuses_to_overwrite_existing_history(self, tmp_path):
+        path = str(tmp_path / "hist.jsonl")
+        regress.append_history({"hardware": "h", "configs": {"a": {"value": 1.0, "unit": "us/step"}}}, path=path)
+        before = open(path).read()
+        with pytest.raises(FileExistsError, match="would destroy"):
+            regress.bootstrap_history("BENCH_r0*.json", path=path)
+        assert open(path).read() == before
+        assert regress.main(["--history", path, "--bootstrap", "BENCH_r0*.json"]) == 2
+
+    def test_default_history_resolves_repo_root_from_elsewhere(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)  # no BENCH_HISTORY.jsonl here
+        resolved = regress._resolve_default_history()
+        assert os.path.isabs(resolved) and os.path.exists(resolved)
+        assert resolved.endswith("BENCH_HISTORY.jsonl")
+
+
+class TestSalvage:
+    def test_recovers_complete_objects_from_truncated_tail(self):
+        text = (
+            'lue": 852.52, "unit": "us/step"},'  # cut mid-object: unrecoverable
+            ' "curve": {"value": 338.09, "unit": "ms/epoch", "baseline": 5525.91},'
+            ' "rouge": {"value": 5240.25, "unit": "samples/sec"}}'
+        )
+        configs = regress.salvage_configs(text)
+        assert set(configs) == {"curve", "rouge"}
+        assert configs["curve"]["value"] == 338.09
+
+    def test_repo_history_was_bootstrapped(self):
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        runs = regress.load_history(os.path.join(repo, "BENCH_HISTORY.jsonl"))
+        assert len(runs) >= 3  # r03..r05 salvage
+        assert any(r.get("label") == "BENCH_r05" for r in runs)
+
+
+class TestCli:
+    def _history(self, tmp_path, values, name="stateful", unit="us/step"):
+        path = str(tmp_path / "hist.jsonl")
+        for v in values:
+            regress.append_history(
+                {"hardware": "cpu-fallback", "configs": {name: {"value": v, "unit": unit}}},
+                path=path,
+            )
+        return path
+
+    def test_exit_0_when_clean(self, tmp_path, capsys):
+        path = self._history(tmp_path, [100.0, 105.0, 98.0])
+        assert regress.main(["--history", path]) == 0
+        assert "0 regression(s)" in capsys.readouterr().out
+
+    def test_exit_1_on_injected_slowdown(self, tmp_path, capsys):
+        path = self._history(tmp_path, [100.0, 105.0, 98.0, 196.0])  # newest = 2x best
+        assert regress.main(["--history", path]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSED" in out and "stateful" in out
+
+    def test_exit_0_with_insufficient_history(self, tmp_path, capsys):
+        path = self._history(tmp_path, [100.0])
+        assert regress.main(["--history", path]) == 0
+        assert "not enough untraced history" in capsys.readouterr().out
+
+    def test_exit_2_on_missing_history(self, tmp_path):
+        assert regress.main(["--history", str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_current_flag_judges_external_run(self, tmp_path):
+        path = self._history(tmp_path, [100.0, 98.0])
+        current = tmp_path / "run.json"
+        current.write_text(
+            json.dumps(
+                {"hardware": "cpu-fallback", "configs": {"stateful": {"value": 400.0, "unit": "us/step"}}}
+            )
+        )
+        assert regress.main(["--history", path, "--current", str(current)]) == 1
+
+    @pytest.mark.parametrize("bad", [True, False])
+    def test_python_dash_m_module_entry(self, tmp_path, bad):
+        """The documented CI entry: ``python -m torchmetrics_tpu.obs.regress``."""
+        values = [100.0, 98.0] + ([210.0] if bad else [101.0])
+        path = self._history(tmp_path, values)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchmetrics_tpu.obs.regress", "--history", path],
+            capture_output=True,
+            text=True,
+            timeout=240,
+            env=env,
+        )
+        assert proc.returncode == (1 if bad else 0), proc.stdout + proc.stderr
+        if bad:
+            assert "REGRESSED" in proc.stdout
+
+
+class TestBenchWiring:
+    def test_bench_history_path_and_flag(self):
+        """bench.py exposes the history path and honors --check-regressions."""
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        sys.path.insert(0, repo)
+        try:
+            import bench
+        finally:
+            sys.path.remove(repo)
+        assert bench._HISTORY_PATH.endswith("BENCH_HISTORY.jsonl")
+        assert callable(bench._record_history)
+        import inspect
+
+        assert "check_regressions" in inspect.signature(bench.main).parameters
+
+    def test_record_history_appends_and_gates(self, tmp_path, monkeypatch, capsys):
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        sys.path.insert(0, repo)
+        try:
+            import bench
+        finally:
+            sys.path.remove(repo)
+        path = str(tmp_path / "hist.jsonl")
+        monkeypatch.setattr(bench, "_HISTORY_PATH", path)
+        result = {"hardware": "cpu-fallback", "configs": {"stateful": {"value": 100.0, "unit": "us/step"}}}
+        bench._record_history(result, check=False)
+        bench._record_history(dict(result, configs={"stateful": {"value": 101.0, "unit": "us/step"}}), check=True)
+        assert len(regress.load_history(path)) == 2
+        slow = dict(result, configs={"stateful": {"value": 300.0, "unit": "us/step"}})
+        with pytest.raises(SystemExit) as err:
+            bench._record_history(slow, check=True)
+        assert err.value.code == 1
+        assert len(regress.load_history(path)) == 3  # the breaching run is still recorded
+
+    def test_gate_that_cannot_run_exits_2_not_0(self, tmp_path, monkeypatch, capsys):
+        """A broken sentinel must fail the --check-regressions gate, not pass it."""
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        sys.path.insert(0, repo)
+        try:
+            import bench
+        finally:
+            sys.path.remove(repo)
+        monkeypatch.setattr(bench, "_HISTORY_PATH", str(tmp_path / "dir-not-file"))
+        os.makedirs(str(tmp_path / "dir-not-file"))  # append will raise IsADirectoryError
+        result = {"hardware": "h", "configs": {"stateful": {"value": 1.0, "unit": "us/step"}}}
+        bench._record_history(result, check=False)  # best-effort path: no exit
+        with pytest.raises(SystemExit) as err:
+            bench._record_history(result, check=True)
+        assert err.value.code == 2

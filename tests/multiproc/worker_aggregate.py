@@ -1,0 +1,129 @@
+"""Worker for the REAL two-process cross-host telemetry aggregation battery.
+
+Launched twice (process_id 0 and 1) by ``test_aggregate_two_process.py``; the
+two processes connect to one coordinator and run the *actual*
+``obs.aggregate`` stack over JAX's gloo-backed CPU collectives — counters sum
+across the world, gauges keep per-host attribution, histograms merge
+bucket-wise, warnings carry host lists, and the Perfetto export renders one
+pid per host. Then both hosts inject a hanging collective under a guard
+timeout and assert the DEGRADED partial-aggregate path (no real collective is
+entered while a fault is injected, so neither host can wedge the other).
+
+Usage: ``python worker_aggregate.py <process_id> <port> <result_json_path>``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import traceback
+import warnings
+
+assert os.environ.get("JAX_PLATFORMS") == "cpu", "launcher must pass the CPU-force env"
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    out_path = sys.argv[3]
+
+    import jax
+
+    try:
+        # jax >= 0.4.34 defaults the CPU backend to no cross-process collectives;
+        # gloo must be selected before jax.distributed.initialize
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: option absent, gloo already the default
+    jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
+    assert jax.process_count() == 2 and jax.process_index() == pid
+
+    from torchmetrics_tpu import robust
+    from torchmetrics_tpu.obs import perfetto, trace
+    from torchmetrics_tpu.obs.aggregate import aggregate
+    from torchmetrics_tpu.robust import faults
+
+    results = {"world": jax.process_count()}
+
+    # host-distinct telemetry through the public API
+    trace.enable()
+    trace.inc("work.items", 10.0 * (pid + 1))
+    trace.inc("jit.cache_hit", 2.0, fn="M.pure_update")
+    trace.set_gauge("cache.size", float(pid + 3))
+    trace.observe_duration("step", 1e-3 * (pid + 1))
+    with trace.span("metric.update", metric="M"):
+        pass
+    trace.record_warning("everywhere")
+    trace.record_warning(f"only-host-{pid}")
+
+    # -- 1. full cross-host aggregate over the real collectives ---------------
+    agg = aggregate(include_events=True)
+    assert agg["n_hosts"] == 2, agg["hosts"]
+    assert agg["aggregate_degraded"] is False and agg["missing_hosts"] == []
+    assert [h["process_index"] for h in agg["hosts"]] == [0, 1]
+    counters = {c["name"]: c["value"] for c in agg["counters"] if not c["labels"]}
+    assert counters["work.items"] == 30.0, counters
+    labeled = [c for c in agg["counters"] if c["name"] == "jit.cache_hit"]
+    assert labeled[0]["value"] == 4.0
+    results["counters_sum_across_hosts"] = True
+
+    gauge = [g for g in agg["gauges"] if g["name"] == "cache.size"][0]
+    assert gauge["per_host"] == {"0": 3.0, "1": 4.0} and gauge["max"] == 4.0
+    results["gauges_keep_per_host_attribution"] = True
+
+    hist = [h for h in agg["histograms"] if h["name"] == "step"][0]
+    assert hist["count"] == 2
+    results["histograms_merge_bucket_wise"] = True
+
+    by_message = {w["message"]: w["hosts"] for w in agg["warnings"]}
+    assert by_message["everywhere"] == [0, 1]
+    assert by_message["only-host-0"] == [0] and by_message["only-host-1"] == [1]
+    results["warnings_carry_host_lists"] = True
+
+    # -- 2. cross-host Perfetto export: one pid per host ----------------------
+    doc = perfetto.chrome_trace(agg)
+    events = doc["traceEvents"]
+    assert all("ph" in e and "ts" in e and "pid" in e for e in events)
+    assert {e["pid"] for e in events} == {0, 1}
+    spans = [e for e in events if e["ph"] == "X" and e["name"] == "metric.update"]
+    assert len(spans) == 2 and {e["pid"] for e in spans} == {0, 1}
+    json.dumps(doc)  # valid plain JSON
+    results["perfetto_one_pid_per_host"] = True
+
+    # -- 3. degraded path: both hosts inject a hang under a guard timeout -----
+    # (the injected fault raises before any real collective is entered, so the
+    # peer cannot be wedged; each host degrades to its own partial aggregate)
+    with robust.sync_guard(timeout=0.5, retries=1):
+        with faults.inject_collective_fault(mode="hang", times=10):
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                partial = aggregate()
+    assert partial["aggregate_degraded"] is True
+    assert partial["missing_hosts"] == [1 - pid]
+    counters = {c["name"]: c["value"] for c in partial["counters"] if not c["labels"]}
+    assert counters["work.items"] == 10.0 * (pid + 1)  # local view only
+    assert any("DEGRADED" in str(w.message) for w in caught)
+    results["degraded_partial_aggregate"] = True
+
+    # -- 4. the world is still usable after the degrade (faults cleared) ------
+    healthy = aggregate()
+    assert healthy["aggregate_degraded"] is False and healthy["n_hosts"] == 2
+    # the degrade itself was counted on this host and is now fleet-visible
+    degraded_counter = [c for c in healthy["counters"] if c["name"] == "aggregate.degraded"]
+    assert degraded_counter and degraded_counter[0]["value"] == 2.0  # one per host
+    results["recovers_after_degrade"] = True
+
+    trace.disable()
+    if pid == 0:
+        with open(out_path, "w") as fh:
+            json.dump(results, fh)
+    print(f"WORKER {pid} OK", flush=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception:
+        traceback.print_exc()
+        sys.exit(1)

@@ -28,6 +28,12 @@ def main() -> None:
 
     import jax
 
+    try:
+        # jax >= 0.4.34 defaults the CPU backend to no cross-process collectives;
+        # gloo must be selected before jax.distributed.initialize
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jax: option absent, gloo already the default
     jax.distributed.initialize(f"localhost:{port}", num_processes=2, process_id=pid)
 
     import numpy as np

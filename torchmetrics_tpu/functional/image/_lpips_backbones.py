@@ -325,13 +325,15 @@ def load_lpips_backbone_params(net_type: str, path: Optional[str] = None) -> Dic
                 ) from torch_err
             # re-materialize the npz (atomically) so later processes load the
             # clean cache instead of re-paying the torch conversion; a read-only
-            # weights directory just keeps the in-memory fallback
-            from torchmetrics_tpu.utils.serialization import save_tree_npz
+            # weights directory just keeps the in-memory fallback. mkstemp-based
+            # temp naming: two pod hosts rebuilding the same shared-storage path
+            # commonly share pid 1 and must never interleave into one temp file
+            from torchmetrics_tpu.utils.fileio import atomic_open
+            from torchmetrics_tpu.utils.serialization import flatten_tree
 
             try:
-                tmp = f"{path}.tmp.{os.getpid()}.npz"
-                save_tree_npz(tmp, params)
-                os.replace(tmp, path)
+                with atomic_open(path, "wb") as fh:
+                    np.savez(fh, **flatten_tree(params))
             except OSError:
                 pass
             return params

@@ -19,6 +19,17 @@ recorder:
   ``start_trace`` / ``stop_trace`` wrappers; combined with the runtime's
   ``jax.named_scope`` annotations, device traces attribute time to metric
   class names.
+- :mod:`~torchmetrics_tpu.obs.aggregate` — cross-host merge of rank-aware
+  snapshots over the guarded eager collective path: counters sum, gauges keep
+  per-host attribution, histograms merge bucket-wise; a hung host degrades to
+  a loud partial aggregate instead of a hang.
+- :mod:`~torchmetrics_tpu.obs.perfetto` — Chrome trace-event JSON export of
+  the span ring buffer (one pid per host), loadable in Perfetto /
+  ``chrome://tracing`` next to ``jax.profiler`` device traces.
+- :mod:`~torchmetrics_tpu.obs.regress` — bench-history regression sentinel
+  over ``BENCH_HISTORY.jsonl`` with noise-aware tolerances
+  (``python -m torchmetrics_tpu.obs.regress``; wired into
+  ``bench.py --check-regressions``).
 
 Typical use::
 
@@ -31,8 +42,12 @@ Typical use::
     print(obs.prometheus_text(metrics=[acc, f1]))
 """
 
-from torchmetrics_tpu.obs import export, profile, trace
+# note: `obs.aggregate` stays the *submodule* (its entry point is
+# `obs.aggregate.aggregate()`); only the clash-free helper names are re-exported
+from torchmetrics_tpu.obs import aggregate, export, perfetto, profile, regress, trace
+from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
 from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
+from torchmetrics_tpu.obs.perfetto import chrome_trace, write_trace
 from torchmetrics_tpu.obs.profile import annotate, profile_trace, start_trace, stop_trace
 from torchmetrics_tpu.obs.trace import (
     TraceRecorder,
@@ -51,21 +66,27 @@ from torchmetrics_tpu.obs.trace import (
 
 __all__ = [
     "TraceRecorder",
+    "aggregate",
     "annotate",
+    "chrome_trace",
     "collect",
     "disable",
     "enable",
     "event",
     "export",
     "get_recorder",
+    "host_snapshot",
     "inc",
     "is_enabled",
+    "merge_snapshots",
     "observe",
     "observe_duration",
+    "perfetto",
     "profile",
     "profile_trace",
     "prometheus_text",
     "record_warning",
+    "regress",
     "set_gauge",
     "span",
     "start_trace",
@@ -73,4 +94,5 @@ __all__ = [
     "summary",
     "trace",
     "write_jsonl",
+    "write_trace",
 ]

@@ -1,0 +1,303 @@
+"""Cross-host aggregation of telemetry snapshots: per-process → fleet-level.
+
+Every process in a multi-host pjit mesh records telemetry in isolation
+(:mod:`~torchmetrics_tpu.obs.trace` is process-local by design), so rank 0's
+exporters can only answer for rank 0 — while the numbers that matter at fleet
+scale (jit-cache miss storms, per-host collective wall time, degraded syncs)
+are exactly the ones that diverge per host. This module closes that gap:
+
+- :func:`host_snapshot` — one rank-aware snapshot of the local recorder
+  (schema version, process index, host id, wall-clock anchor; see
+  ``TraceRecorder.snapshot``).
+- :func:`merge_snapshots` — pure merge math over any list of host snapshots:
+  counters **sum**, gauges keep **per-host values plus the max**, log-scale
+  duration histograms merge **bucket-wise**, deduplicated warnings carry the
+  **list of hosts** that hit them.
+- :func:`aggregate` — the distributed entry point: ships the local snapshot
+  as JSON bytes over the guarded eager collective path
+  (``parallel.sync.allgather_host_payloads`` →
+  ``robust.degraded.guarded_collective``) and merges the world's snapshots.
+  Under a configured ``robust.sync_guard`` a hung host degrades to a **loud
+  partial aggregate** (``aggregate_degraded=True``, the missing ranks listed)
+  instead of hanging the job; single-process worlds take a clean local-only
+  path with no collective at all.
+
+The aggregate is plain JSON-able data; feed it to
+:func:`obs.perfetto.chrome_trace` (one Perfetto pid per host — pass
+``include_events=True``) or summarize with :func:`summarize`.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from typing import Any, Dict, List, Optional
+
+import torchmetrics_tpu.obs.trace as trace
+
+__all__ = ["aggregate", "host_snapshot", "merge_snapshots", "summarize"]
+
+
+def host_snapshot(
+    recorder: Optional[trace.TraceRecorder] = None, include_events: bool = True
+) -> Dict[str, Any]:
+    """This process's rank-aware snapshot, ready for cross-host transport.
+
+    Adds a ``warnings`` list (distinct messages from the event log, in order)
+    so warning attribution survives ``include_events=False`` — the cheap wire
+    shape that ships only series, not the span ring buffer.
+    """
+    rec = recorder if recorder is not None else trace.get_recorder()
+    snap = rec.snapshot()
+    seen: set = set()
+    messages: List[str] = []
+    for ev in snap["events"]:
+        if ev["kind"] == "warning":
+            message = ev["attrs"].get("message", "")
+            if message not in seen:
+                seen.add(message)
+                messages.append(message)
+    snap["warnings"] = messages
+    snap["n_events"] = len(snap["events"])
+    # distinguishes "events were shipped (possibly zero)" from "events were
+    # stripped for the cheap wire shape" — the merge keys host_snapshots (and
+    # therefore Perfetto exportability) off this, not off event counts
+    snap["events_included"] = include_events
+    if not include_events:
+        snap["events"] = []
+    return snap
+
+
+def _series_key(name: str, labels: Dict[str, Any]) -> tuple:
+    return (name, json.dumps(labels, sort_keys=True, default=str))
+
+
+def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge host snapshots into one fleet-level aggregate (pure function).
+
+    Hosts whose ``schema_version`` differs from this build's are excluded from
+    the merge and reported under ``schema_mismatch_hosts`` — a mixed-version
+    fleet yields a partial-but-correct aggregate, never a mis-parsed one.
+    """
+    usable: List[Dict[str, Any]] = []
+    mismatched: List[Dict[str, Any]] = []
+    for snap in snaps:
+        if snap.get("schema_version") == trace.SCHEMA_VERSION:
+            usable.append(snap)
+        else:
+            mismatched.append(
+                {
+                    "process_index": snap.get("host", {}).get("process_index"),
+                    "schema_version": snap.get("schema_version"),
+                }
+            )
+    usable.sort(key=lambda s: s.get("host", {}).get("process_index", 0))
+
+    hosts: List[Dict[str, Any]] = []
+    counters: Dict[tuple, Dict[str, Any]] = {}
+    gauges: Dict[tuple, Dict[str, Any]] = {}
+    hists: Dict[tuple, Dict[str, Any]] = {}
+    warn_rows: Dict[str, Dict[str, Any]] = {}
+    host_snaps: List[Dict[str, Any]] = []
+    dropped_events = 0
+    events_recorded = 0
+    any_events = False
+
+    for snap in usable:
+        meta = snap.get("host", {})
+        pidx = int(meta.get("process_index", 0))
+        hosts.append(
+            {
+                "process_index": pidx,
+                "host_id": meta.get("host_id", "?"),
+                "wall_clock_anchor": snap.get("wall_clock_anchor"),
+                "elapsed": snap.get("elapsed"),
+            }
+        )
+        dropped_events += int(snap.get("dropped_events", 0))
+        events_recorded += int(snap.get("n_events", len(snap.get("events", ()))))
+        # foreign/legacy snapshots without the marker: fall back to presence
+        if snap.get("events_included", bool(snap.get("events"))):
+            any_events = True
+        for counter in snap["counters"]:
+            key = _series_key(counter["name"], counter["labels"])
+            row = counters.setdefault(
+                key, {"name": counter["name"], "labels": counter["labels"], "value": 0.0}
+            )
+            row["value"] += counter["value"]
+        for gauge in snap["gauges"]:
+            key = _series_key(gauge["name"], gauge["labels"])
+            row = gauges.setdefault(
+                key, {"name": gauge["name"], "labels": gauge["labels"], "per_host": {}}
+            )
+            row["per_host"][str(pidx)] = gauge["value"]
+        for hist in snap["histograms"]:
+            key = _series_key(hist["name"], hist["labels"])
+            row = hists.setdefault(
+                key,
+                {
+                    "name": hist["name"],
+                    "labels": hist["labels"],
+                    "buckets": [[bound, 0] for bound, _ in hist["buckets"]],
+                    "sum": 0.0,
+                    "count": 0,
+                },
+            )
+            # bucket-wise merge: the bounds are a protocol constant
+            # (_Histogram.BOUNDS) and schema-gated above, so same-name series
+            # always align slot for slot
+            for slot, (_, count) in zip(row["buckets"], hist["buckets"]):
+                slot[1] += count
+            row["sum"] += hist["sum"]
+            row["count"] += hist["count"]
+        for message in snap.get("warnings", ()):
+            row = warn_rows.setdefault(message, {"message": message, "hosts": []})
+            if pidx not in row["hosts"]:
+                row["hosts"].append(pidx)
+        host_snaps.append(snap)
+
+    for row in gauges.values():
+        row["max"] = max(row["per_host"].values()) if row["per_host"] else None
+
+    out: Dict[str, Any] = {
+        "schema_version": trace.SCHEMA_VERSION,
+        "aggregate": True,
+        "n_hosts": len(hosts),
+        "hosts": hosts,
+        "missing_hosts": [],
+        "aggregate_degraded": False,
+        "schema_mismatch_hosts": mismatched,
+        "counters": [counters[key] for key in sorted(counters)],
+        "gauges": [gauges[key] for key in sorted(gauges)],
+        "histograms": [hists[key] for key in sorted(hists)],
+        "warnings": [warn_rows[message] for message in sorted(warn_rows)],
+        "dropped_events": dropped_events,
+        "events_recorded": events_recorded,
+    }
+    if any_events:
+        # keep the raw per-host snapshots only when the caller shipped events:
+        # that is what obs.perfetto needs to draw one pid per host
+        out["host_snapshots"] = host_snaps
+    return out
+
+
+def aggregate(
+    recorder: Optional[trace.TraceRecorder] = None,
+    include_events: bool = False,
+    description: str = "obs aggregate",
+) -> Dict[str, Any]:
+    """Fleet-level aggregate of every host's telemetry (the distributed entry).
+
+    Single-process worlds merge the local snapshot with no collective. In a
+    multi-host world, each host JSON-encodes its snapshot and all snapshots
+    cross over the guarded eager collective path; with a ``robust.sync_guard``
+    configured, a hung or failing host turns into a **partial** aggregate —
+    ``aggregate_degraded=True``, a loud ``RuntimeWarning``, the unreachable
+    ranks listed in ``missing_hosts`` — rather than a hung job. Pass
+    ``include_events=True`` to also ship the span ring buffers (needed for the
+    cross-host Perfetto export; costs world-size × ring-buffer bytes).
+    """
+    local = host_snapshot(recorder, include_events=include_events)
+    from torchmetrics_tpu.parallel import sync as sync_mod
+
+    if not sync_mod.distributed_available():
+        return merge_snapshots([local])
+
+    from torchmetrics_tpu.robust.degraded import CollectiveError
+
+    payload = json.dumps(local, default=str).encode("utf-8")
+    try:
+        payloads = sync_mod.allgather_host_payloads(payload, description=description)
+    except CollectiveError as err:
+        if trace.ENABLED:
+            trace.get_recorder().inc("aggregate.degraded")
+            trace.get_recorder().add_event("aggregate.degraded", error=str(err))
+        warnings.warn(
+            f"Cross-host telemetry aggregation DEGRADED to this host's local view:"
+            f" {err}. The aggregate is partial (aggregate_degraded=True).",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        out = merge_snapshots([local])
+        out["aggregate_degraded"] = True
+        out["degraded_error"] = str(err)
+        mine = local["host"]["process_index"]
+        out["missing_hosts"] = [
+            index for index in range(local["host"]["process_count"]) if index != mine
+        ]
+        return out
+
+    snaps: List[Dict[str, Any]] = []
+    corrupt: List[int] = []
+    for index, raw in enumerate(payloads):
+        try:
+            snaps.append(json.loads(raw.decode("utf-8")))
+        except (UnicodeDecodeError, ValueError):
+            corrupt.append(index)
+    out = merge_snapshots(snaps)
+    if corrupt or out["schema_mismatch_hosts"]:
+        # a peer that gathered but could not be merged still makes the
+        # aggregate PARTIAL — aggregate_degraded is the one documented signal
+        # for "this is not the whole fleet", so it must fire here too
+        out["aggregate_degraded"] = True
+        if corrupt:
+            out["corrupt_hosts"] = corrupt
+        expected = {index for index in range(len(payloads))}
+        present = {h["process_index"] for h in out["hosts"]}
+        out["missing_hosts"] = sorted(expected - present)
+        warnings.warn(
+            f"Cross-host telemetry aggregation is PARTIAL/DEGRADED: hosts {out['missing_hosts']}"
+            f" gathered but could not be merged"
+            f" ({len(corrupt)} corrupt payload(s),"
+            f" {len(out['schema_mismatch_hosts'])} schema mismatch(es)).",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+    return out
+
+
+def summarize(agg: Dict[str, Any]) -> str:
+    """Human-readable table of a fleet aggregate."""
+    lines = [
+        f"== torchmetrics_tpu obs aggregate: {agg['n_hosts']} host(s)"
+        + (" [DEGRADED/PARTIAL]" if agg.get("aggregate_degraded") else "")
+        + " =="
+    ]
+    for host in agg["hosts"]:
+        lines.append(f"  host {host['process_index']}: {host['host_id']}")
+    if agg.get("missing_hosts"):
+        lines.append(f"  MISSING hosts: {agg['missing_hosts']}")
+    if agg["counters"]:
+        lines.append("-- counters (summed across hosts) --")
+        width = max(len(c["name"]) for c in agg["counters"])
+        for counter in agg["counters"]:
+            label = " ".join(f"{k}={v}" for k, v in sorted(counter["labels"].items()))
+            lines.append(f"  {counter['name']:<{width}}  {counter['value']:>10g}  {label}")
+    if agg["gauges"]:
+        lines.append("-- gauges (per-host | max) --")
+        width = max(len(g["name"]) for g in agg["gauges"])
+        for gauge in agg["gauges"]:
+            label = " ".join(f"{k}={v}" for k, v in sorted(gauge["labels"].items()))
+            per_host = " ".join(
+                f"{h}:{v:g}" for h, v in sorted(gauge["per_host"].items(), key=lambda kv: int(kv[0]))
+            )
+            lines.append(f"  {gauge['name']:<{width}}  {per_host} | max={gauge['max']:g}  {label}")
+    if agg["histograms"]:
+        lines.append("-- durations (bucket-merged) --")
+        width = max(len(h["name"]) for h in agg["histograms"])
+        for hist in agg["histograms"]:
+            label = " ".join(f"{k}={v}" for k, v in sorted(hist["labels"].items()))
+            mean = hist["sum"] / hist["count"] if hist["count"] else 0.0
+            lines.append(
+                f"  {hist['name']:<{width}}  n={hist['count']:<6} total={hist['sum'] * 1e3:9.3f}ms"
+                f" mean={mean * 1e6:9.1f}us  {label}"
+            )
+    if agg["warnings"]:
+        lines.append("-- warnings (hosts that hit them) --")
+        for row in agg["warnings"]:
+            lines.append(f"  hosts {row['hosts']}: {row['message']}")
+    lines.append(
+        f"-- events: {agg['events_recorded']} recorded, {agg['dropped_events']} dropped,"
+        f" across {agg['n_hosts']} host(s) --"
+    )
+    return "\n".join(lines) + "\n"

@@ -16,6 +16,7 @@ import math
 from typing import Any, Dict, IO, Iterable, List, Optional, Union
 
 import torchmetrics_tpu.obs.trace as trace
+from torchmetrics_tpu.utils.fileio import atomic_write_text
 
 __all__ = ["collect", "prometheus_text", "summary", "write_jsonl"]
 
@@ -68,7 +69,9 @@ def write_jsonl(
 
     Line types (``"type"`` field): ``meta`` (one, first), then every ``span`` /
     ``event`` / ``warning`` in ring-buffer order, then ``counter`` / ``gauge`` /
-    ``histogram`` series, then one ``robust`` line per metric.
+    ``histogram`` series, then one ``robust`` line per metric. Writing to a
+    path is atomic (temp file + rename): a crash mid-export never leaves a
+    truncated JSONL masquerading as a complete one.
     """
     snap = collect(metrics, recorder)
     lines: List[str] = []
@@ -76,7 +79,17 @@ def write_jsonl(
     def emit(obj: Dict[str, Any]) -> None:
         lines.append(json.dumps(obj, sort_keys=True, default=str))
 
-    emit({"type": "meta", "dropped_events": snap["dropped_events"], "events": len(snap["events"])})
+    emit(
+        {
+            "type": "meta",
+            "schema_version": snap["schema_version"],
+            "process_index": snap["host"]["process_index"],
+            "host_id": snap["host"]["host_id"],
+            "wall_clock_anchor": snap["wall_clock_anchor"],
+            "dropped_events": snap["dropped_events"],
+            "events": len(snap["events"]),
+        }
+    )
     for ev in snap["events"]:
         # attrs stay namespaced: event attrs are free-form user data and must
         # not clobber the structural type/name/ts/dur fields
@@ -105,8 +118,7 @@ def write_jsonl(
 
     text = "\n".join(lines) + "\n"
     if isinstance(sink, str):
-        with open(sink, "w") as fh:
-            fh.write(text)
+        atomic_write_text(sink, text)
     else:
         sink.write(text)
     return len(lines)
@@ -139,9 +151,23 @@ def _prom_value(value: float) -> str:
     return repr(value)
 
 
+def _prom_help_escape(text: str) -> str:
+    # text-format spec: only backslash and newline are escaped in HELP text
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_header(out: List[str], prom: str, kind: str, help_text: str) -> None:
+    """Well-formed family header: one ``# HELP`` then one ``# TYPE`` line."""
+    out.append(f"# HELP {prom} {_prom_help_escape(help_text)}")
+    out.append(f"# TYPE {prom} {kind}")
+
+
 def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> str:
     """Prometheus text exposition (0.0.4) of counters, gauges, histograms and
-    the per-metric robust counters."""
+    the per-metric robust counters. Every family gets a ``# HELP`` + ``# TYPE``
+    header; histograms emit cumulative ``_bucket`` lines whose ``le`` labels
+    end in ``+Inf`` plus ``_sum``/``_count``.
+    """
     snap = collect(metrics, recorder)
     out: List[str] = []
 
@@ -150,7 +176,7 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
         by_name.setdefault(counter["name"], []).append(counter)
     for name in sorted(by_name):
         prom = _prom_name(name) + "_total"
-        out.append(f"# TYPE {prom} counter")
+        _prom_header(out, prom, "counter", f"Cumulative count of `{name}` events (torchmetrics_tpu.obs)")
         for counter in by_name[name]:
             out.append(f"{prom}{_prom_labels(counter['labels'])} {_prom_value(counter['value'])}")
 
@@ -159,7 +185,7 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
         by_name.setdefault(gauge["name"], []).append(gauge)
     for name in sorted(by_name):
         prom = _prom_name(name)
-        out.append(f"# TYPE {prom} gauge")
+        _prom_header(out, prom, "gauge", f"Last recorded value of `{name}` (torchmetrics_tpu.obs)")
         for gauge in by_name[name]:
             out.append(f"{prom}{_prom_labels(gauge['labels'])} {_prom_value(gauge['value'])}")
 
@@ -168,7 +194,7 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
         by_name.setdefault(hist["name"], []).append(hist)
     for name in sorted(by_name):
         prom = _prom_name(name) + "_seconds"
-        out.append(f"# TYPE {prom} histogram")
+        _prom_header(out, prom, "histogram", f"Duration distribution of `{name}` in seconds (torchmetrics_tpu.obs)")
         for hist in by_name[name]:
             cumulative = 0
             for bound, count in hist["buckets"]:
@@ -182,19 +208,20 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
     if snap["robust"]:
         for name in _ROBUST_COUNTERS:
             prom = _prom_name("robust." + name) + "_total"
-            out.append(f"# TYPE {prom} counter")
+            _prom_header(out, prom, "counter", f"Per-metric robustness counter `{name}` (torchmetrics_tpu.robust)")
             for row in snap["robust"]:
                 labels = {"instance": str(row["instance"]), "metric": row["metric"]}
                 out.append(f"{prom}{_prom_labels(labels)} {row[name]}")
         for name in _ROBUST_FLAGS:
             prom = _prom_name("robust." + name)
-            out.append(f"# TYPE {prom} gauge")
+            _prom_header(out, prom, "gauge", f"Per-metric robustness flag `{name}` (torchmetrics_tpu.robust)")
             for row in snap["robust"]:
                 labels = {"instance": str(row["instance"]), "metric": row["metric"]}
                 out.append(f"{prom}{_prom_labels(labels)} {int(row[name])}")
 
-    out.append(f"# TYPE {_prom_name('dropped_events')}_total counter")
-    out.append(f"{_prom_name('dropped_events')}_total {snap['dropped_events']}")
+    prom = _prom_name("dropped_events") + "_total"
+    _prom_header(out, prom, "counter", "Events evicted from the telemetry ring buffer (torchmetrics_tpu.obs)")
+    out.append(f"{prom} {snap['dropped_events']}")
     return "\n".join(out) + "\n"
 
 
@@ -238,5 +265,17 @@ def summary(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder]
             counts = " ".join(f"{name.split('_', 1)[1]}={row[name]}" for name in _ROBUST_COUNTERS)
             lines.append(f"  {row['metric']}[{row['instance']}]: {counts} {flags}")
 
+    counters = {
+        (c["name"], tuple(sorted(c["labels"].items()))): c["value"] for c in snap["counters"]
+    }
+    emitted = counters.get(("warnings.emitted", ()), 0)
+    deduped = counters.get(("warnings.deduplicated", ()), 0)
+    dropped_tracking = counters.get(("warnings.dropped", ()), 0)
+    if emitted or deduped or dropped_tracking:
+        lines.append(
+            f"-- warnings: {_prom_value(emitted)} emitted,"
+            f" {_prom_value(deduped)} deduplicated,"
+            f" {_prom_value(dropped_tracking)} past dedup cap (warnings_dropped) --"
+        )
     lines.append(f"-- events: {len(snap['events'])} recorded, {snap['dropped_events']} dropped --")
     return "\n".join(lines) + "\n"

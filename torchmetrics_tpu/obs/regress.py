@@ -1,0 +1,460 @@
+"""Bench-history regression sentinel: noise-aware gating over BENCH_HISTORY.jsonl.
+
+Five rounds of ``BENCH_r*.json`` accumulated with zero automated regression
+detection — a config could silently double in cost between rounds. This module
+closes the loop: ``bench.py`` appends each run's per-config results as one JSON
+line to ``BENCH_HISTORY.jsonl`` (a single ``O_APPEND`` write — prior lines can
+never be lost or corrupted, and a torn trailing line is skipped on load), and
+the checker compares the newest run against the prior history with noise-aware
+tolerances:
+
+- the **baseline is the best** historical value per config (min for
+  lower-is-better units, max for throughput) — the min-of-reps principle
+  extended across runs: the best observed run is the machine's capability,
+  everything above it is noise or regression;
+- the **tolerance widens with observed noise**: the allowed ratio is
+  ``max(1 + rel_tol, hist_worst/hist_best * (1 + headroom))``, so a config
+  that historically drifts ±40% on the shared host is not flagged for
+  drifting ±40% again;
+- configs that carry a recorded ``spread`` (e.g. ``mesh_sync_overhead_pct``
+  with its min/max over interleaved reps) are additionally allowed anything
+  under ``max(recorded spread maxima) * (1 + headroom)``;
+- runs are only compared against history from the **same hardware tag**
+  (a cpu-fallback round must not be judged against TPU numbers).
+
+CLI (``python -m torchmetrics_tpu.obs.regress``) exit codes:
+
+- ``0`` — no regression (including "not enough history to judge")
+- ``1`` — at least one config regressed beyond its tolerance
+- ``2`` — usage or load error (missing/unreadable history)
+
+``bench.py --check-regressions`` runs the same checker after appending the
+fresh run, so CI can gate on the bench flow directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import re
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from torchmetrics_tpu.utils.fileio import atomic_write_text
+
+__all__ = [
+    "append_history",
+    "bootstrap_history",
+    "check_regressions",
+    "format_table",
+    "load_history",
+    "main",
+    "run_record",
+    "salvage_configs",
+]
+
+DEFAULT_HISTORY = "BENCH_HISTORY.jsonl"
+HISTORY_SCHEMA = 1
+
+
+def _resolve_default_history() -> str:
+    """The CLI's default history path.
+
+    ``bench.py`` anchors its appends next to itself (the repo root); the CLI
+    must find that file regardless of the CI step's working directory. CWD
+    wins when the file exists there (explicit local histories, tests); else
+    the repo-root-anchored candidate is used when it exists; else the bare
+    CWD name (so error messages point somewhere sensible).
+    """
+    if os.path.exists(DEFAULT_HISTORY):
+        return DEFAULT_HISTORY
+    package_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    anchored = os.path.join(package_root, DEFAULT_HISTORY)
+    if os.path.exists(anchored):
+        return anchored
+    return DEFAULT_HISTORY
+
+# direction by unit: lower-is-better costs vs higher-is-better throughputs;
+# configs with unknown units are not judged (omitted from the table entirely)
+_LOWER_UNITS = {"us/step", "us", "ms/epoch", "ms", "s", "% of step time"}
+_HIGHER_UNITS = {"samples/sec", "imgs/sec", "items/sec", "steps/sec"}
+
+_REL_TOL = 0.5  # a config must cost >1.5x its best history to flag (pre-noise)
+_NOISE_HEADROOM = 0.1  # margin multiplied onto the observed historical spread
+
+
+def _direction(unit: Optional[str]) -> Optional[str]:
+    if unit in _LOWER_UNITS:
+        return "lower"
+    if unit in _HIGHER_UNITS:
+        return "higher"
+    return None
+
+
+# --------------------------------------------------------------------- history
+
+
+def run_record(
+    result: Dict[str, Any],
+    label: Optional[str] = None,
+    ts: Optional[float] = None,
+    traced: bool = False,
+) -> Dict[str, Any]:
+    """Distill one bench result line into a history record (configs only).
+
+    Accepts either a full ``bench.py`` output object (with ``configs``) or an
+    already-distilled record. Non-numeric config values are dropped; a
+    recorded ``spread`` dict rides along for the tolerance logic. ``traced``
+    marks a run whose timings include obs tracing overhead
+    (``TM_TPU_BENCH_OBS=1``): it is recorded for the telemetry it carries but
+    never used as a regression baseline and never judged.
+    """
+    configs: Dict[str, Any] = {}
+    for name, cfg in (result.get("configs") or {}).items():
+        if not isinstance(cfg, dict):
+            continue
+        value = cfg.get("value")
+        if not isinstance(value, (int, float)) or isinstance(value, bool):
+            continue
+        entry: Dict[str, Any] = {"value": float(value), "unit": cfg.get("unit")}
+        spread = cfg.get("spread")
+        if isinstance(spread, dict):
+            clean = {
+                key: float(spread[key])
+                for key in ("min", "max", "reps")
+                if isinstance(spread.get(key), (int, float))
+            }
+            if clean:
+                entry["spread"] = clean
+        configs[name] = entry
+    record = {
+        "schema": HISTORY_SCHEMA,
+        "label": label,
+        "ts": float(ts) if ts is not None else time.time(),
+        "hardware": result.get("hardware"),
+        "configs": configs,
+    }
+    if traced or result.get("traced"):
+        record["traced"] = True
+    return record
+
+
+def append_history(
+    result: Dict[str, Any],
+    path: str = DEFAULT_HISTORY,
+    label: Optional[str] = None,
+    ts: Optional[float] = None,
+    traced: bool = False,
+) -> Dict[str, Any]:
+    """Append one run to the history file as a single ``O_APPEND`` line.
+
+    One newline-terminated write: prior lines can never be lost or corrupted
+    (a crash mid-append at worst leaves one torn trailing line, which
+    :func:`load_history` skips), and two concurrent appenders interleave whole
+    lines instead of overwriting each other the way a read-modify-rewrite
+    would. A pre-existing torn tail is healed with a leading newline so the
+    new record never merges into it.
+    """
+    record = run_record(result, label=label, ts=ts, traced=traced)
+    heal_torn_tail = False
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        with open(path, "rb") as fh:
+            fh.seek(-1, os.SEEK_END)
+            heal_torn_tail = fh.read(1) != b"\n"
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(("\n" if heal_torn_tail else "") + json.dumps(record, sort_keys=True) + "\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    return record
+
+
+def load_history(path: str) -> List[Dict[str, Any]]:
+    """Parse the history file; malformed lines are skipped with a warning."""
+    runs: List[Dict[str, Any]] = []
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except ValueError:
+                sys.stderr.write(f"{path}:{lineno}: skipping malformed history line\n")
+                continue
+            if isinstance(record, dict) and isinstance(record.get("configs"), dict):
+                runs.append(record)
+    return runs
+
+
+# -------------------------------------------------------------------- checking
+
+
+def _spread_max(entries: List[Dict[str, Any]]) -> Optional[float]:
+    values = [
+        entry["spread"]["max"]
+        for entry in entries
+        if isinstance(entry.get("spread"), dict)
+        and isinstance(entry["spread"].get("max"), (int, float))
+    ]
+    return max(values) if values else None
+
+
+def check_regressions(
+    current: Dict[str, Any],
+    history: List[Dict[str, Any]],
+    rel_tol: float = _REL_TOL,
+    noise_headroom: float = _NOISE_HEADROOM,
+    same_hardware: bool = True,
+) -> List[Dict[str, Any]]:
+    """Judge ``current`` (a run record) against ``history`` (earlier records).
+
+    Returns one row per judgeable config:
+    ``{config, unit, value, baseline, allowed, ratio, n_history, regressed}``.
+    ``ratio`` is current-vs-best in the *bad* direction (>1 means worse).
+    """
+    rows: List[Dict[str, Any]] = []
+    if current.get("traced"):
+        return []  # tracing overhead makes the timings incomparable — never judged
+    baseline_runs = [
+        run
+        for run in history
+        if not run.get("traced")  # traced runs never serve as baselines either
+        and (not same_hardware or run.get("hardware") == current.get("hardware"))
+    ]
+    for name, cfg in sorted(current.get("configs", {}).items()):
+        if not isinstance(cfg, dict):
+            continue  # hand-edited / foreign-tool history lines must not crash the gate
+        unit = cfg.get("unit")
+        direction = _direction(unit)
+        value = cfg.get("value")
+        if direction is None or not isinstance(value, (int, float)):
+            continue
+        entries = [
+            run["configs"][name]
+            for run in baseline_runs
+            if isinstance(run.get("configs", {}).get(name), dict)
+        ]
+        values = [
+            e["value"] for e in entries if isinstance(e.get("value"), (int, float)) and e["value"] > 0
+        ]
+        row: Dict[str, Any] = {
+            "config": name,
+            "unit": unit,
+            "value": float(value),
+            "n_history": len(values),
+        }
+        if not values or value <= 0:
+            row.update({"baseline": None, "allowed": None, "ratio": None, "regressed": False})
+            rows.append(row)
+            continue
+        if direction == "lower":
+            best, worst = min(values), max(values)
+            noise_ratio = worst / best
+            allowed_ratio = max(1.0 + rel_tol, noise_ratio * (1.0 + noise_headroom))
+            allowed = best * allowed_ratio
+            spread_cap = _spread_max(entries)
+            if spread_cap is not None:
+                allowed = max(allowed, spread_cap * (1.0 + noise_headroom))
+            ratio = value / best
+            regressed = value > allowed
+        else:
+            best, worst = max(values), min(values)
+            noise_ratio = best / worst if worst > 0 else 1.0
+            allowed_ratio = max(1.0 + rel_tol, noise_ratio * (1.0 + noise_headroom))
+            allowed = best / allowed_ratio
+            ratio = best / value
+            regressed = value < allowed
+        row.update(
+            {
+                "baseline": round(best, 4),
+                "allowed": round(allowed, 4),
+                "ratio": round(ratio, 3),
+                "regressed": bool(regressed),
+            }
+        )
+        rows.append(row)
+    return rows
+
+
+def format_table(rows: List[Dict[str, Any]], hardware: Optional[str] = None) -> str:
+    """Aligned regression table; breaches are marked ``REGRESSED``."""
+    header = f"== bench regression check ({hardware or 'any hardware'}) =="
+    if not rows:
+        return header + "\n  (no judgeable configs)\n"
+    width = max(len(r["config"]) for r in rows)
+    lines = [header]
+    for row in rows:
+        if row["baseline"] is None:
+            verdict = "no-history"
+            detail = f"value={row['value']:g} {row['unit']}"
+        else:
+            verdict = "REGRESSED" if row["regressed"] else "ok"
+            detail = (
+                f"value={row['value']:g} best={row['baseline']:g} allowed={row['allowed']:g}"
+                f" ratio={row['ratio']:g}x (n={row['n_history']}) {row['unit']}"
+            )
+        lines.append(f"  {row['config']:<{width}}  {verdict:<10}  {detail}")
+    n_bad = sum(1 for r in rows if r.get("regressed"))
+    lines.append(f"-- {n_bad} regression(s) across {len(rows)} judged config(s) --")
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------------- bootstrap
+
+
+def salvage_configs(text: str) -> Dict[str, Any]:
+    """Best-effort per-config extraction from a (possibly front-truncated) line.
+
+    The historical ``BENCH_r*.json`` files keep only the *tail* of the bench
+    stdout, so early bytes of the JSON line may be missing. Complete
+    ``"<name>": {"value": ...}`` objects are recovered individually with a
+    raw decoder; anything cut mid-object is skipped.
+    """
+    decoder = json.JSONDecoder()
+    configs: Dict[str, Any] = {}
+    for match in re.finditer(r'"([A-Za-z0-9_]+)":\s*(\{"value")', text):
+        name = match.group(1)
+        try:
+            obj, _ = decoder.raw_decode(text, match.start(2))
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and isinstance(obj.get("value"), (int, float)):
+            configs[name] = obj
+    return configs
+
+
+def bootstrap_history(pattern: str, path: str = DEFAULT_HISTORY) -> int:
+    """Seed a history file from historical ``BENCH_r*.json`` round files.
+
+    Returns the number of runs written. Rounds whose tails hold no complete
+    config objects are skipped (the tail is truncated storage, not a format).
+    Refuses (``FileExistsError``) when ``path`` already holds history —
+    re-seeding must never silently destroy appended run records.
+    """
+    if os.path.exists(path) and os.path.getsize(path) > 0:
+        raise FileExistsError(
+            f"{path} already holds history; bootstrap would destroy it."
+            " Move or delete the file first if re-seeding is really intended."
+        )
+    lines: List[str] = []
+    for round_path in sorted(_glob.glob(pattern)):
+        try:
+            with open(round_path, encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        tail = doc.get("tail") or ""
+        configs = salvage_configs(tail)
+        if not configs:
+            continue
+        hw_match = re.search(r'"hardware":\s*"([^"]+)"', tail)
+        label = os.path.splitext(os.path.basename(round_path))[0]
+        record = run_record(
+            {"configs": configs, "hardware": hw_match.group(1) if hw_match else None},
+            label=label,
+            ts=os.path.getmtime(round_path),
+        )
+        lines.append(json.dumps(record, sort_keys=True))
+    if lines:
+        atomic_write_text(path, "\n".join(lines) + "\n")
+    return len(lines)
+
+
+# ------------------------------------------------------------------------- CLI
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.obs.regress",
+        description=(
+            "Compare the newest bench run in BENCH_HISTORY.jsonl against prior history"
+            " with noise-aware tolerances. Exit codes: 0 = clean, 1 = regression,"
+            " 2 = usage/load error."
+        ),
+    )
+    parser.add_argument(
+        "--history",
+        default=None,
+        help="history JSONL path (default: ./BENCH_HISTORY.jsonl, falling back to the"
+        " copy next to bench.py at the repo root)",
+    )
+    parser.add_argument(
+        "--current",
+        default=None,
+        help="JSON file holding the run to judge (a bench output line or a history"
+        " record); default: the newest history line, judged against the rest",
+    )
+    parser.add_argument("--rel-tol", type=float, default=_REL_TOL, help="base relative tolerance")
+    parser.add_argument(
+        "--noise-headroom", type=float, default=_NOISE_HEADROOM, help="margin over observed spread"
+    )
+    parser.add_argument(
+        "--all-hardware",
+        action="store_true",
+        help="compare across hardware tags (default: same-hardware history only)",
+    )
+    parser.add_argument(
+        "--bootstrap",
+        metavar="GLOB",
+        default=None,
+        help="seed the history file from historical BENCH_r*.json round files, then exit",
+    )
+    parser.add_argument("--quiet", action="store_true", help="suppress the table on success")
+    args = parser.parse_args(argv)
+    history_path = args.history or _resolve_default_history()
+
+    if args.bootstrap:
+        try:
+            n = bootstrap_history(args.bootstrap, path=history_path)
+        except FileExistsError as err:
+            sys.stderr.write(f"{err}\n")
+            return 2
+        print(f"bootstrapped {n} run(s) into {history_path}")
+        return 0 if n else 2
+
+    try:
+        history = load_history(history_path)
+    except OSError as err:
+        sys.stderr.write(f"cannot read history {history_path}: {err}\n")
+        return 2
+
+    if args.current:
+        try:
+            with open(args.current, encoding="utf-8") as fh:
+                current = run_record(json.load(fh))
+        except (OSError, ValueError) as err:
+            sys.stderr.write(f"cannot read current run {args.current}: {err}\n")
+            return 2
+        baseline = history
+    else:
+        judgeable = [run for run in history if not run.get("traced")]
+        if len(judgeable) < 2:
+            print(
+                f"not enough untraced history in {history_path} ({len(judgeable)} run(s));"
+                " need >= 2 to judge — passing."
+            )
+            return 0
+        current, baseline = judgeable[-1], judgeable[:-1]
+
+    if current.get("traced"):
+        print("current run is traced (TM_TPU_BENCH_OBS=1): recorded, never judged — passing.")
+        return 0
+
+    rows = check_regressions(
+        current,
+        baseline,
+        rel_tol=args.rel_tol,
+        noise_headroom=args.noise_headroom,
+        same_hardware=not args.all_hardware,
+    )
+    regressed = any(row.get("regressed") for row in rows)
+    if regressed or not args.quiet:
+        print(format_table(rows, hardware=current.get("hardware")), end="")
+    return 1 if regressed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

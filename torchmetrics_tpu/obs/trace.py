@@ -32,6 +32,9 @@ device-trace capture).
 
 from __future__ import annotations
 
+import os
+import socket
+import sys
 import threading
 import time
 from collections import deque
@@ -40,6 +43,7 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
     "ENABLED",
+    "SCHEMA_VERSION",
     "TraceRecorder",
     "annotate_current_span",
     "disable",
@@ -59,7 +63,47 @@ __all__ = [
 # disabled path is one module-attribute load and one branch.
 ENABLED = False
 
+# Wire-format version of TraceRecorder.snapshot(). The cross-host aggregation
+# (obs/aggregate.py) ships snapshots between processes that may run different
+# builds; a host whose schema differs is excluded from the merge (and reported)
+# instead of being mis-parsed. Bump on any structural snapshot change.
+SCHEMA_VERSION = 1
+
 _DEFAULT_MAX_EVENTS = 4096
+
+
+def _host_meta() -> Dict[str, Any]:
+    """Rank identity of this process: process index/count plus a stable host id.
+
+    Snapshotting telemetry must never be the thing that *initializes* a jax
+    backend (on a host with a wedged TPU tunnel, first-touch backend init
+    hangs forever) — so jax is consulted only when something else has already
+    imported it AND either ``jax.distributed`` is initialized (its global
+    state is plain data) or a backend already exists; ``jax.process_index()``
+    itself is only called in the latter, already-initialized case. Otherwise
+    this is process 0 of 1.
+    """
+    index, count = 0, 1
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            from jax._src import distributed as _distributed  # plain state, no backend touch
+
+            state = _distributed.global_state
+            if getattr(state, "coordinator_address", None) is not None:
+                index, count = int(state.process_id), int(state.num_processes)
+            else:
+                from jax._src import xla_bridge as _xla_bridge
+
+                if getattr(_xla_bridge, "_backends", None):  # already initialized
+                    index, count = int(jax_mod.process_index()), int(jax_mod.process_count())
+        except Exception:  # private-API drift across jax versions: single-process view
+            pass
+    return {
+        "process_index": index,
+        "process_count": count,
+        "host_id": f"{socket.gethostname()}:{os.getpid()}",
+    }
 
 LabelsKey = Tuple[Tuple[str, Any], ...]
 
@@ -118,6 +162,10 @@ class TraceRecorder:
             self._hists: Dict[Tuple[str, LabelsKey], _Histogram] = {}
             self._seen_warnings: set = set()
             self._t0 = time.monotonic()
+            # wall-clock anchor paired with the monotonic session clock: lets
+            # cross-host exports place hosts on one shared timeline (each
+            # host's event `ts` is monotonic-relative; anchor + ts ≈ wall time)
+            self._wall0 = time.time()
 
     def _span_stack(self) -> List[Tuple[str, Dict[str, Any]]]:
         stack = getattr(self._local, "stack", None)
@@ -159,7 +207,13 @@ class TraceRecorder:
     def add_event(self, name: str, kind: str = "event", **attrs: Any) -> None:
         with self._lock:
             self._append(
-                {"kind": kind, "name": name, "ts": time.monotonic() - self._t0, "attrs": attrs}
+                {
+                    "kind": kind,
+                    "name": name,
+                    "ts": time.monotonic() - self._t0,
+                    "tid": threading.get_ident(),
+                    "attrs": attrs,
+                }
             )
 
     def add_span(self, name: str, start: float, duration: float, depth: int, attrs: Dict[str, Any]) -> None:
@@ -171,6 +225,7 @@ class TraceRecorder:
                     "ts": start - self._t0,
                     "dur": duration,
                     "depth": depth,
+                    "tid": threading.get_ident(),
                     "attrs": attrs,
                 }
             )
@@ -243,6 +298,13 @@ class TraceRecorder:
                 return False
             if len(self._seen_warnings) < self.max_tracked_warnings:
                 self._seen_warnings.add(message)
+            else:
+                # past the dedup-tracking cap: the message still emits and
+                # lands in the event log, but repeats of it can no longer be
+                # deduplicated — count that loss instead of hiding it
+                # (surfaced as `warnings_dropped` in summary/Prometheus)
+                key = ("warnings.dropped", ())
+                self._counters[key] = self._counters.get(key, 0.0) + 1.0
             key = ("warnings.emitted", ())
             self._counters[key] = self._counters.get(key, 0.0) + 1.0
             self._append(
@@ -250,6 +312,7 @@ class TraceRecorder:
                     "kind": "warning",
                     "name": "warning",
                     "ts": time.monotonic() - self._t0,
+                    "tid": threading.get_ident(),
                     "attrs": {"message": message},
                 }
             )
@@ -270,9 +333,20 @@ class TraceRecorder:
             return sum(v for (n, _), v in self._counters.items() if n == name)
 
     def snapshot(self) -> Dict[str, Any]:
-        """Point-in-time copy of everything recorded, as plain python data."""
+        """Point-in-time copy of everything recorded, as plain python data.
+
+        Rank-aware: carries the snapshot schema version, this process's rank
+        identity (``host``), the wall-clock anchor of the session clock, and
+        the elapsed session time — everything :mod:`~torchmetrics_tpu.obs.aggregate`
+        needs to merge snapshots from many hosts onto one timeline.
+        """
+        host = _host_meta()  # resolved outside the lock: may consult jax
         with self._lock:
             return {
+                "schema_version": SCHEMA_VERSION,
+                "host": host,
+                "wall_clock_anchor": self._wall0,
+                "elapsed": time.monotonic() - self._t0,
                 "events": list(self._events),
                 "dropped_events": self.dropped_events,
                 "counters": [

@@ -99,6 +99,45 @@ def world_size() -> int:
         return 1
 
 
+def allgather_host_payloads(payload: bytes, description: str = "host payload gather") -> List[bytes]:
+    """Gather one variable-length byte payload from every host, in rank order.
+
+    Generic eager-multihost transport for host-side (non-array) data — the
+    cross-host telemetry aggregation (``obs/aggregate.py``) ships JSON
+    snapshots through this. Two collectives, both routed through
+    :func:`_process_allgather` and therefore through the robust sync guard: a
+    fixed-width int32 length exchange, then the padded uint8 payload gather.
+    A hung host surfaces as ``CollectiveError`` for the caller to degrade on,
+    not as a hang. Single-process worlds return ``[payload]`` without touching
+    any collective.
+    """
+    import numpy as np
+
+    if not distributed_available():
+        return [bytes(payload)]
+    data = np.frombuffer(bytes(payload), dtype=np.uint8)
+    sizes = np.asarray(
+        _process_allgather(
+            jnp.asarray([data.size], dtype=jnp.int32),
+            tiled=False,
+            description=f"{description} (sizes)",
+        )
+    ).reshape(-1)
+    max_size = int(sizes.max()) if sizes.size else 0
+    if max_size == 0:
+        # world-wide empty: sizes agree on every host, so skipping the payload
+        # collective is consistent across the world
+        return [b"" for _ in range(len(sizes))]
+    padded = np.zeros((max_size,), dtype=np.uint8)
+    padded[: data.size] = data
+    gathered = np.asarray(
+        _process_allgather(
+            jnp.asarray(padded), tiled=False, description=f"{description} (payload)"
+        )
+    ).reshape(len(sizes), max_size)
+    return [gathered[i, : int(sizes[i])].tobytes() for i in range(len(sizes))]
+
+
 def pad_dim0(x: Array, capacity: int, fill_value=0) -> tuple[Array, Array]:
     """Pad ``x`` along dim 0 to ``capacity``; returns (padded, validity_mask).
 

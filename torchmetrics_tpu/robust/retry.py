@@ -15,12 +15,12 @@ from __future__ import annotations
 
 import os
 import shutil
-import tempfile
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple, Type, Union
 
 import torchmetrics_tpu.obs.trace as _trace
+from torchmetrics_tpu.utils.fileio import atomic_write_bytes
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = [
@@ -232,17 +232,13 @@ def fetch_resource(
             sleep=sleep,
             description=description,
         )
-        os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
-        fd, tmp = tempfile.mkstemp(prefix=os.path.basename(dest) + ".", dir=os.path.dirname(dest))
-        try:
-            with os.fdopen(fd, "wb") as fh:
-                fh.write(data)
-            _validate_file(tmp, min_size=min_size, expected_sha256=expected_sha256, validate=validate)
-            os.replace(tmp, dest)
-        except BaseException:
-            if os.path.exists(tmp):
-                os.remove(tmp)
-            raise
+        atomic_write_bytes(
+            dest,
+            data,
+            validate=lambda tmp: _validate_file(
+                tmp, min_size=min_size, expected_sha256=expected_sha256, validate=validate
+            ),
+        )
         return dest
 
     return retry_call(_once, schedule=schedule, sleep=sleep, description=description)

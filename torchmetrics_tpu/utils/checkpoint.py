@@ -195,12 +195,13 @@ def _recover_displaced(path: str) -> Optional[str]:
     """
     import glob
 
-    candidates = sorted(
-        glob.glob(f"{path}.old.*") + glob.glob(f"{path}.tmp.*"),
-        key=os.path.getmtime,
-        reverse=True,
-    )
-    for candidate in candidates:
+    stamped = []
+    for candidate in glob.glob(f"{path}.old.*") + glob.glob(f"{path}.tmp.*"):
+        try:
+            stamped.append((os.path.getmtime(candidate), candidate))
+        except OSError:
+            pass  # vanished under us (a concurrent save's stale-sibling sweep)
+    for _, candidate in sorted(stamped, reverse=True):
         if os.path.isfile(os.path.join(candidate, _INTEGRITY_NAME)):
             return candidate
     return None
