@@ -21,6 +21,9 @@ Every run also appends its per-config results to ``BENCH_HISTORY.jsonl`` (atomic
 append via the obs regression sentinel); ``python bench.py --check-regressions``
 additionally judges the fresh run against that history with noise-aware
 tolerances and exits 1 on a breach (see ``torchmetrics_tpu/obs/regress.py``).
+A ``memory`` key (``peak_rss_bytes``, and ``device_peak_bytes_in_use`` when the
+backend reports memory stats) rides in the JSON line and the history record as
+recorded-but-never-judged fields, so memory trends accumulate without gating.
 
 Backend policy: the host pins ``JAX_PLATFORMS=axon`` (tunneled TPU) and the tunnel has
 been wedged at bench time in past rounds. We probe the backend *in a subprocess* (a
@@ -805,6 +808,50 @@ def _safe(fn, *args):
         return None
 
 
+# ---------------------------------------------------------------------- memory
+
+
+def _memory_snapshot() -> dict:
+    """Peak memory of this process: host RSS always, device HBM when reported.
+
+    ``ru_maxrss`` is KiB on Linux and bytes on macOS; device peak comes from
+    the guarded ``obs.memory`` poll (CPU backends report nothing → the key is
+    simply absent). These ride along in the bench JSON and the history lines
+    as recorded-but-never-judged fields (like ``traced``), so memory trends
+    accumulate across rounds without gating anything.
+    """
+    out: dict = {}
+    try:
+        import resource
+
+        rss = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        out["peak_rss_bytes"] = rss if sys.platform == "darwin" else rss * 1024
+    except Exception:
+        pass
+    try:
+        from torchmetrics_tpu.obs import memory as obs_memory
+
+        peak = obs_memory.peak_device_bytes()
+        if peak is not None:
+            out["device_peak_bytes_in_use"] = int(peak)
+    except Exception:
+        pass
+    return out
+
+
+def _merge_memory(*snaps) -> dict:
+    """Elementwise max across per-process memory snapshots (peaks combine as max)."""
+    out: dict = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for key, value in snap.items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                if key not in out or value > out[key]:
+                    out[key] = value
+    return out
+
+
 # ------------------------------------------------------------------ observability
 
 # TM_TPU_BENCH_OBS=1 runs each config WITH obs tracing enabled and attaches
@@ -1004,6 +1051,7 @@ def _worker_main(mode: str) -> None:
         # NO force_cpu: inherits the pinned TPU backend; TM_TPU_USE_PALLAS comes
         # from the spawning process's env (the A/B lever)
         out = bench_hotops()
+    out["memory"] = _memory_snapshot()  # the worker did the work; its peaks count
     print(json.dumps(out))
 
 
@@ -1017,7 +1065,10 @@ def _run_fallback_via_workers() -> dict:
                 capture_output=True, text=True, timeout=1200,
             )
             if proc.returncode == 0 and proc.stdout.strip():
-                merged.update(json.loads(proc.stdout.strip().splitlines()[-1]))
+                data = json.loads(proc.stdout.strip().splitlines()[-1])
+                # peaks combine as max across workers, not last-writer-wins
+                merged["memory"] = _merge_memory(merged.get("memory"), data.pop("memory", None))
+                merged.update(data)
             else:
                 sys.stderr.write(f"bench worker {mode} rc={proc.returncode}: {proc.stderr[-500:]}\n")
         except Exception as err:
@@ -1222,6 +1273,10 @@ def main(check_regressions: bool = False) -> None:
         "configs": configs,
         "pallas_ab": pallas_ab,
         "obs": obs_summary,
+        # peak host RSS (+ device HBM peak when the backend reports it), max
+        # across this process and the workers; recorded in the history line,
+        # never judged by the regression gate
+        "memory": _merge_memory(_memory_snapshot(), ours.get("memory")),
     }
     print(json.dumps(result))
     _record_history(result, check=check_regressions)

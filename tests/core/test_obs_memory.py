@@ -1,0 +1,379 @@
+"""State-memory accounting goldens: hand-computed nbytes for every state kind,
+wrapper/collection rollups with alias dedup, gauges through the exporters, and
+the ragged list-state growth guard.
+
+Deterministic, CPU-only, no sleeps, no network.
+"""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.buffer import MaskedBuffer
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.obs import export, memory, trace
+from torchmetrics_tpu.wrappers import BootStrapper, MetricTracker, Running
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    yield
+    trace.disable()
+    trace.get_recorder().clear()
+
+
+class ArrayState(Metric):
+    """One (4, 8) float32 device-array state: 128 data bytes."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros((4, 8), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.zeros((4, 8), dtype=jnp.float32)
+
+    def compute(self):
+        return self.total.sum()
+
+
+class ListState(Metric):
+    """Ragged list state appending (3,) float32 arrays: 12 bytes per item."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        kwargs.setdefault("jit_update", False)
+        super().__init__(**kwargs)
+        self.add_state("items", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.items.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        return jnp.concatenate(self.items).sum()
+
+
+class BufferState(Metric):
+    """MaskedBuffer state: capacity 16 x (2,) float32 = 128 bytes preallocated."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("buf", MaskedBuffer.create(16, (2,), jnp.float32), dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.buf = self.buf.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        return self.buf.values().sum()
+
+
+def _state(fp, name):
+    return next(row for row in fp["states"] if row["state"] == name)
+
+
+# ---------------------------------------------------------------- leaf goldens
+
+
+class TestFootprintGoldens:
+    def test_device_array_state_nbytes(self):
+        m = ArrayState()
+        fp = memory.footprint(m)
+        row = _state(fp, "total")
+        assert row["kind"] == "device_array"
+        assert row["nbytes"] == 4 * 8 * 4  # hand-computed: shape (4,8) float32
+        assert row["shape"] == (4, 8) and row["dtype"] == "float32"
+        # __defaults__ keeps a host copy of the same array for reset
+        assert _state(fp, "__defaults__")["nbytes"] == 128
+        assert fp["unique_bytes"] == 128 + 128
+        assert fp["device_bytes"] == 128 and fp["host_bytes"] == 128
+
+    def test_list_state_items_and_nbytes(self):
+        m = ListState()
+        for _ in range(3):
+            m.update(jnp.ones(3))
+        row = _state(memory.footprint(m), "items")
+        assert row["kind"] == "list_state"
+        assert row["items"] == 3
+        assert row["nbytes"] == 3 * 3 * 4  # three (3,) float32 arrays
+        assert row["device_items"] == 3 and row["host_items"] == 0
+
+    def test_list_state_host_items_after_compute_on_cpu(self):
+        m = ListState(compute_on_cpu=True)
+        m.update(jnp.ones(3))
+        m.update(jnp.ones(3))
+        fp = memory.footprint(m)
+        row = _state(fp, "items")
+        assert row["host_items"] == 2 and row["device_items"] == 0
+        assert row["nbytes"] == 2 * 12
+        assert fp["host_bytes"] >= 24  # list bytes attributed to host residency
+
+    def test_masked_buffer_capacity_vs_fill(self):
+        m = BufferState()
+        m.update(jnp.ones((2, 2)))  # two items of 8 bytes each filled
+        row = _state(memory.footprint(m), "buf")
+        assert row["kind"] == "masked_buffer"
+        assert row["capacity"] == 16
+        assert row["capacity_bytes"] == 16 * 2 * 4  # preallocated-but-mostly-empty
+        assert row["fill_items"] == 2
+        assert row["fill_bytes"] == 2 * 2 * 4
+        assert row["nbytes"] == row["capacity_bytes"] + 4  # + int32 count scalar
+
+    def test_empty_buffer_is_visible_at_full_capacity(self):
+        m = BufferState()
+        row = _state(memory.footprint(m), "buf")
+        assert row["fill_items"] == 0 and row["fill_bytes"] == 0
+        assert row["capacity_bytes"] == 128  # preallocated bytes visible while empty
+
+    def test_sync_cache_hidden_copy_accounted(self):
+        m = ArrayState()
+        m.update(jnp.ones(1))
+        m._cache = dict(m._state_values)  # what sync() stashes while synced
+        fp = memory.footprint(m)
+        cache_row = _state(fp, "__sync_cache__.total")
+        assert cache_row["nbytes"] == 128
+        # the cache aliases the live state arrays: total counts both, unique once
+        assert cache_row["unique_bytes"] == 0
+        assert fp["total_bytes"] > fp["unique_bytes"]
+
+    def test_quarantine_host_copies_accounted(self):
+        m = ArrayState(error_policy="quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.full((4,), jnp.nan))
+        row = _state(memory.footprint(m), "__quarantine__")
+        assert row["items"] == 1
+        assert row["nbytes"] == 4 * 4  # one (4,) float32 batch kept on host
+
+
+# ------------------------------------------------------------------- rollups
+
+
+class TestRollups:
+    def test_collection_compute_group_alias_dedup(self):
+        # macro accuracy and macro precision share an update transition, so the
+        # static compute-group machinery aliases their state arrays
+        col = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=3, average="macro"),
+                "prec": MulticlassPrecision(num_classes=3, average="macro"),
+            }
+        )
+        col.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 2, 2]))
+        fp = memory.footprint(col)
+        assert len(fp["children"]) == 2
+        # the second member aliases the leader's state arrays: total double-counts,
+        # unique does not
+        assert fp["total_bytes"] > fp["unique_bytes"]
+        assert any(child["unique_bytes"] == 0 or child["unique_bytes"] < child["total_bytes"]
+                   for child in fp["children"])
+
+    def test_running_wrapper_window_copies_accounted(self):
+        m = Running(SumMetric(), window=3)
+        for i in range(3):
+            m.update(jnp.asarray([float(i)]))
+        fp = memory.footprint(m)
+        # the wrapper's own ring holds window copies of every base state
+        ring_states = [r for r in fp["states"] if not r["state"].startswith("__")]
+        base = SumMetric()
+        base_states = len(base._defaults)
+        assert len(ring_states) == 3 * base_states
+        assert [c["label"] for c in fp["children"]] == ["base_metric"]
+
+    def test_bootstrapper_replicas_accounted(self):
+        m = BootStrapper(MeanMetric(), num_bootstraps=4)
+        fp = memory.footprint(m)
+        labels = [c["label"] for c in fp["children"]]
+        assert labels == [f"metrics[{i}]" for i in range(4)]
+        single = memory.footprint(MeanMetric())
+        assert fp["unique_bytes"] >= 4 * single["unique_bytes"]
+
+    def test_tracker_increments_accounted(self):
+        tracker = MetricTracker(MeanMetric())
+        for _ in range(3):
+            tracker.increment()
+            tracker.update(jnp.ones(2))
+        fp = memory.footprint(tracker)
+        labels = [c["label"] for c in fp["children"]]
+        assert labels[0] == "base_metric"
+        assert labels[1:] == ["increment[0]", "increment[1]", "increment[2]"]
+        # N increments + the base: strictly more than a lone metric
+        assert fp["unique_bytes"] > memory.footprint(MeanMetric())["unique_bytes"] * 3
+
+    def test_metric_and_collection_convenience_methods(self):
+        m = MeanMetric()
+        assert m.memory_footprint()["name"] == "MeanMetric"
+        col = MetricCollection([MeanMetric()])
+        assert col.memory_footprint()["name"] == "MetricCollection"
+
+    def test_multitask_wrapper_collection_tasks_accounted(self):
+        # MultitaskWrapper explicitly allows MetricCollection task values —
+        # they are not Metric subclasses but must not vanish from the rollup
+        from torchmetrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+        from torchmetrics_tpu.wrappers import MultitaskWrapper
+
+        wrapper = MultitaskWrapper(
+            {
+                "t1": MetricCollection([MeanSquaredError(), MeanAbsoluteError()]),
+                "t2": MeanSquaredError(),
+            }
+        )
+        fp = memory.footprint(wrapper)
+        labels = sorted(c["label"] for c in fp["children"])
+        assert labels == ["task_metrics[t1]", "task_metrics[t2]"]
+        t1 = next(c for c in fp["children"] if c["label"] == "task_metrics[t1]")
+        assert t1["name"] == "MetricCollection"
+        assert len(t1["children"]) == 2
+        assert fp["unique_bytes"] > memory.footprint(MeanSquaredError())["unique_bytes"] * 2
+
+
+# ----------------------------------------------------------- gauges + report
+
+
+class TestGaugesAndReport:
+    def test_record_gauges_families(self):
+        m = ListState()
+        m.update(jnp.ones(3))
+        rec = trace.get_recorder()
+        memory.record_gauges([m], recorder=rec)
+        snap = rec.snapshot()
+        names = {g["name"] for g in snap["gauges"]}
+        assert {"memory.state_bytes", "memory.state_device_bytes",
+                "memory.state_host_bytes", "state.list_items"} <= names
+        by_name = {g["name"]: g for g in snap["gauges"]}
+        assert by_name["state.list_items"]["value"] == 1
+        labels = by_name["memory.state_bytes"]["labels"]
+        assert labels["metric"] == "ListState"
+        assert labels["inst"] == m._obs_instance  # stable per-instance ordinal
+
+    def test_same_class_instances_get_distinct_series(self):
+        a, b = ListState(), ListState()
+        a.update(jnp.ones(3))
+        rec = trace.get_recorder()
+        memory.record_gauges([a, b], recorder=rec)
+        rows = [g for g in rec.snapshot()["gauges"] if g["name"] == "state.list_items"]
+        assert len(rows) == 2  # NOT last-write-wins collapsed
+        assert {row["labels"]["inst"] for row in rows} == {a._obs_instance, b._obs_instance}
+        by_inst = {row["labels"]["inst"]: row["value"] for row in rows}
+        assert by_inst[a._obs_instance] == 1 and by_inst[b._obs_instance] == 0
+
+    def test_inst_label_stable_across_registration_order(self):
+        a, b = ArrayState(), ListState()
+        rec = trace.get_recorder()
+        first = memory.record_gauges([a, b], recorder=rec)
+        second = memory.record_gauges([b], recorder=rec)  # a unregistered
+        assert first["metrics"][1]["inst"] == second["metrics"][0]["inst"]
+
+    def test_record_gauges_works_with_tracing_disabled(self):
+        # explicit accounting is its own opt-in: the /metrics endpoint must
+        # show memory series even when span tracing is off
+        assert not trace.is_enabled()
+        m = ArrayState()
+        memory.record_gauges([m])
+        text = export.prometheus_text()
+        assert "tm_tpu_memory_state_bytes" in text
+
+    def test_device_memory_stats_clean_skip_on_cpu(self):
+        # CPU backends report no memory stats: accounting skips them cleanly
+        assert memory.device_memory_stats() == {}
+        assert memory.peak_device_bytes() is None
+
+    def test_report_top_k_and_totals(self):
+        metrics = [ArrayState(), BufferState(), MeanMetric()]
+        rep = memory.report(metrics, top_k=2)
+        assert rep["n_metrics"] == 3
+        assert len(rep["metrics"]) == 2  # truncated to top-K
+        # sorted by unique_bytes descending
+        sizes = [fp["unique_bytes"] for fp in rep["metrics"]]
+        assert sizes == sorted(sizes, reverse=True)
+        assert rep["totals"]["unique_bytes"] == sum(
+            memory.footprint(m)["unique_bytes"] for m in metrics
+        )
+        assert "unique_bytes" in rep["totals_human"]
+
+    def test_footprint_matches_gauge_value(self):
+        m = BufferState()
+        m.update(jnp.ones((2, 2)))
+        rec = trace.get_recorder()
+        memory.record_gauges([m], recorder=rec)
+        by_name = {g["name"]: g for g in rec.snapshot()["gauges"]}
+        assert by_name["memory.state_bytes"]["value"] == memory.footprint(m)["unique_bytes"]
+
+    def test_format_bytes(self):
+        assert memory.format_bytes(0) == "0B"
+        assert memory.format_bytes(2048) == "2.0KiB"
+        assert memory.format_bytes(3 * 1024 * 1024) == "3.0MiB"
+        assert memory.format_bytes(None) == "?"
+
+
+# -------------------------------------------------- ragged list growth guard
+
+
+class TestListStateGrowthGuard:
+    def test_gauge_tracks_item_count_under_tracing(self):
+        m = ListState()
+        with trace.observe() as rec:
+            for _ in range(5):
+                m.update(jnp.ones(3))
+        by_name = {g["name"]: g for g in rec.snapshot()["gauges"]}
+        assert by_name["state.list_items"]["value"] == 5
+        assert by_name["state.list_items"]["labels"] == {
+            "metric": "ListState", "inst": m._obs_instance
+        }
+
+    def test_one_shot_warning_past_threshold(self):
+        m = ListState()
+        m.list_state_warn_threshold = 3
+        for _ in range(3):
+            m.update(jnp.ones(3))
+        with pytest.warns(RuntimeWarning, match="ragged list-state items"):
+            m.update(jnp.ones(3))
+        # one-shot: continued growth does not re-warn
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            m.update(jnp.ones(3))
+
+    def test_warning_names_state_and_count(self):
+        m = ListState()
+        m.list_state_warn_threshold = 1
+        m.update(jnp.ones(3))
+        with pytest.warns(RuntimeWarning, match=r"items: 2 items"):
+            m.update(jnp.ones(3))
+
+    def test_no_warning_below_threshold(self):
+        m = ListState()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            for _ in range(5):
+                m.update(jnp.ones(3))
+
+    def test_growth_event_recorded_when_tracing(self):
+        m = ListState()
+        m.list_state_warn_threshold = 1
+        with trace.observe() as rec:
+            m.update(jnp.ones(3))
+            with pytest.warns(RuntimeWarning):
+                m.update(jnp.ones(3))
+        growth = [e for e in rec.events() if e["name"] == "state.list_growth"]
+        assert len(growth) == 1
+        assert growth[0]["attrs"]["metric"] == "ListState"
+        assert growth[0]["attrs"]["items"] == 2
+
+    def test_compute_on_cpu_lists_also_guarded(self):
+        m = ListState(compute_on_cpu=True)
+        m.list_state_warn_threshold = 1
+        m.update(jnp.ones(3))
+        with pytest.warns(RuntimeWarning, match="ragged list-state"):
+            m.update(jnp.ones(3))

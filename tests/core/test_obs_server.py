@@ -1,0 +1,362 @@
+"""Live introspection server battery: lifecycle, routes, concurrency, health.
+
+Every test binds an ephemeral port (``port=0``), drives it with stdlib HTTP
+clients, and asserts clean teardown — no sleeps, no leaked threads, CPU-only.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.robust import faults
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_server.stop()
+    yield
+    obs_server.stop()
+    trace.disable()
+    trace.get_recorder().clear()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def _get_json(url, timeout=10):
+    status, body = _get(url, timeout=timeout)
+    return status, json.loads(body)
+
+
+@pytest.fixture()
+def server():
+    srv = obs_server.IntrospectionServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+# ------------------------------------------------------------------ lifecycle
+
+
+class TestLifecycle:
+    def test_ephemeral_port_bound_and_serving(self, server):
+        assert server.running
+        assert server.port > 0
+        status, body = _get_json(server.url + "/")
+        assert status == 200
+        assert set(obs_server.ROUTES) <= set(body["routes"])
+
+    def test_start_is_idempotent(self, server):
+        again = server.start()
+        assert again is server
+        assert server.running
+
+    def test_stop_twice_is_idempotent_and_leaks_no_thread(self):
+        srv = obs_server.IntrospectionServer(port=0).start()
+        thread = srv._thread
+        assert thread.is_alive()
+        srv.stop()
+        srv.stop()  # second stop must be a clean no-op
+        assert not srv.running
+        assert not thread.is_alive()
+        assert all("tm-tpu-obs-server" not in t.name for t in threading.enumerate())
+
+    def test_stop_never_started_is_noop(self):
+        srv = obs_server.IntrospectionServer(port=0)
+        srv.stop()
+        assert not srv.running
+
+    def test_restart_after_stop(self):
+        srv = obs_server.IntrospectionServer(port=0).start()
+        first_port = srv.port
+        srv.stop()
+        srv.start()
+        try:
+            assert srv.running and srv.port > 0
+            status, _ = _get(srv.url + "/readyz")
+            assert status == 200
+        finally:
+            srv.stop()
+        assert first_port > 0
+
+    def test_context_manager(self):
+        with obs_server.IntrospectionServer(port=0) as srv:
+            status, _ = _get(srv.url + "/readyz")
+            assert status == 200
+        assert not srv.running
+
+    def test_module_singleton_start_stop(self):
+        srv = obs_server.start(port=0)
+        assert obs_server.get_server() is srv
+        again = obs_server.start(port=0)
+        assert again is srv  # idempotent: second start returns the running server
+        obs_server.stop()
+        assert obs_server.get_server() is None
+        obs_server.stop()  # idempotent
+
+    def test_env_port_parsing(self, monkeypatch):
+        monkeypatch.setenv(obs_server.ENV_PORT, "0")
+        srv = obs_server.IntrospectionServer()  # port=None -> env
+        assert srv.requested_port == 0
+        monkeypatch.setenv(obs_server.ENV_PORT, "not-a-port")
+        with pytest.raises(ValueError, match="TM_TPU_OBS_PORT"):
+            obs_server.IntrospectionServer()
+        monkeypatch.delenv(obs_server.ENV_PORT)
+        assert obs_server.IntrospectionServer().requested_port == obs_server.DEFAULT_PORT
+
+
+# --------------------------------------------------------------------- routes
+
+
+class TestRoutes:
+    def test_metrics_prometheus_content_type_and_families(self, server):
+        m = MeanMetric()
+        m.update(jnp.ones(4))
+        server.register(m)
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain; version=0.0.4")
+            body = resp.read().decode()
+        # memory gauges are refreshed on every scrape, even with tracing off
+        assert "tm_tpu_memory_state_bytes" in body
+        assert 'metric="MeanMetric"' in body
+        # robust counters for the registered metric ride along
+        assert "tm_tpu_robust_updates_ok_total" in body
+
+    def test_healthz_ok_when_clean(self, server):
+        status, body = _get_json(server.url + "/healthz")
+        assert status == 200
+        assert body["status"] == "ok" and body["reasons"] == []
+
+    def test_readyz(self, server):
+        status, body = _get_json(server.url + "/readyz")
+        assert status == 200
+        assert body["ready"] is True
+        assert body["url"] == server.url
+
+    def test_snapshot_is_rank_aware(self, server):
+        with trace.observe():
+            trace.inc("some.counter")
+        status, body = _get_json(server.url + "/snapshot")
+        assert status == 200
+        assert body["schema_version"] == trace.SCHEMA_VERSION
+        assert "process_index" in body["host"] and "host_id" in body["host"]
+        assert any(c["name"] == "some.counter" for c in body["counters"])
+
+    def test_memory_report_and_top_param(self, server):
+        for _ in range(3):
+            server.register(MeanMetric())
+        status, body = _get_json(server.url + "/memory?top=2")
+        assert status == 200
+        assert body["n_metrics"] == 3
+        assert len(body["metrics"]) == 2
+        assert body["totals"]["unique_bytes"] > 0
+        status, body = _get_json(server.url + "/memory")
+        assert len(body["metrics"]) == 3
+
+    def test_memory_bad_top_is_400(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url + "/memory?top=banana")
+        assert err.value.code == 400
+
+    def test_unknown_route_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.url + "/nope")
+        assert err.value.code == 404
+        assert json.loads(err.value.read().decode())["routes"]
+
+    def test_trailing_slash_normalized(self, server):
+        status, _ = _get(server.url + "/healthz/")
+        assert status == 200
+
+
+# ------------------------------------------------------------------- health
+
+
+class TestHealthDegradation:
+    def test_quarantine_via_fault_harness_flips_healthz(self, server):
+        metric = MeanSquaredError(error_policy="quarantine")
+        server.register(metric)
+        metric.update(jnp.ones(8), jnp.zeros(8))
+        status, body = _get_json(server.url + "/healthz")
+        assert body["status"] == "ok"
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates():
+                metric.update(jnp.ones(8), jnp.zeros(8))
+        status, body = _get_json(server.url + "/healthz")
+        assert status == 200  # degraded is NOT dead
+        assert body["status"] == "degraded"
+        assert any("MeanSquaredError" in reason for reason in body["reasons"])
+        assert body["quarantined"] == [
+            {"metric": "MeanSquaredError", "updates_quarantined": 1, "quarantine_dropped": 0}
+        ]
+
+    def test_collection_member_named_individually(self, server):
+        col = MetricCollection({"train_mse": MeanSquaredError(error_policy="quarantine")})
+        server.register(col)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates():
+                col.update(jnp.ones(4), jnp.zeros(4))
+        _, body = _get_json(server.url + "/healthz")
+        assert body["status"] == "degraded"
+        assert body["quarantined"][0]["metric"] == "MetricCollection/train_mse"
+
+    def test_sync_degraded_flag_flips_healthz(self, server):
+        metric = MeanSquaredError()
+        metric.sync_degraded = True  # what Metric.sync sets after a degraded collective
+        server.register(metric)
+        _, body = _get_json(server.url + "/healthz")
+        assert body["status"] == "degraded"
+        assert body["sync_degraded"] == ["MeanSquaredError"]
+
+    def test_skipped_updates_reported_but_not_degraded(self, server):
+        metric = MeanSquaredError(error_policy="warn_skip")
+        server.register(metric)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            metric.update(jnp.full((4,), jnp.nan), jnp.zeros(4))
+        _, body = _get_json(server.url + "/healthz")
+        # a skipped batch is the policy working, not a degradation
+        assert body["status"] == "ok"
+        assert body["skipped"] == [{"metric": "MeanSquaredError", "updates_skipped": 1}]
+
+    def test_wrapped_metric_quarantine_visible(self, server):
+        # the health walk recurses the _memory_children hierarchy: a
+        # quarantine inside a tracker increment must not be invisible
+        from torchmetrics_tpu.wrappers import MetricTracker
+
+        tracker = MetricTracker(MeanSquaredError(error_policy="quarantine"))
+        server.register(tracker)
+        tracker.increment()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates():
+                tracker.update(jnp.ones(4), jnp.zeros(4))
+        _, body = _get_json(server.url + "/healthz")
+        assert body["status"] == "degraded"
+        assert body["quarantined"][0]["metric"] == "MetricTracker/increment[0]"
+
+    def test_collection_robust_counters_reach_metrics_page(self, server):
+        # /metrics and /healthz must agree about a registered collection:
+        # robust rows come from the flattened leaves
+        col = MetricCollection({"mse": MeanSquaredError(error_policy="quarantine")})
+        server.register(col)
+        col.update(jnp.ones(4), jnp.zeros(4))
+        _, body = _get(server.url + "/metrics")
+        assert "tm_tpu_robust_updates_ok_total" in body
+        assert 'metric="MeanSquaredError"' in body
+
+    def test_request_counters_land_in_own_recorder(self):
+        own = trace.TraceRecorder()
+        srv = obs_server.IntrospectionServer(port=0, recorder=own).start()
+        try:
+            with trace.observe():  # gate open; global recorder watched for pollution
+                _get(srv.url + "/healthz")
+            assert own.counter_value("server.requests", route="/healthz") == 1
+            assert trace.get_recorder().counter_value("server.requests") == 0
+        finally:
+            srv.stop()
+
+    def test_recovery_after_reset(self, server):
+        metric = MeanSquaredError(error_policy="quarantine")
+        server.register(metric)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates():
+                metric.update(jnp.ones(4), jnp.zeros(4))
+        _, body = _get_json(server.url + "/healthz")
+        assert body["status"] == "degraded"
+        metric.reset()
+        _, body = _get_json(server.url + "/healthz")
+        assert body["status"] == "ok"
+
+
+# -------------------------------------------------------------- concurrency
+
+
+class TestConcurrentScrapes:
+    def test_scrapes_during_active_updates(self, server):
+        """N scraper threads hammer every route while the main thread keeps
+        updating a registered metric — every response must be well-formed."""
+        metric = MeanMetric()
+        server.register(metric)
+        routes = ["/metrics", "/healthz", "/readyz", "/snapshot", "/memory"]
+        errors = []
+        results = []
+
+        def scrape(route):
+            try:
+                for _ in range(5):
+                    status, body = _get(server.url + route)
+                    assert status == 200 and body
+                    results.append(route)
+            except Exception as err:  # pragma: no cover - failure reporting
+                errors.append((route, repr(err)))
+
+        threads = [threading.Thread(target=scrape, args=(route,)) for route in routes for _ in range(2)]
+        with trace.observe():
+            for thread in threads:
+                thread.start()
+            for _ in range(50):
+                metric.update(jnp.ones(16))
+            for thread in threads:
+                thread.join(30)
+        assert not errors, errors
+        assert len(results) == len(routes) * 2 * 5
+        assert float(metric.compute()) == 1.0  # updates survived the scraping
+
+    def test_register_during_scrapes_is_safe(self, server):
+        errors = []
+
+        def scrape():
+            try:
+                for _ in range(10):
+                    _get(server.url + "/memory")
+            except Exception as err:  # pragma: no cover
+                errors.append(repr(err))
+
+        thread = threading.Thread(target=scrape)
+        thread.start()
+        for _ in range(10):
+            server.register(MeanMetric())
+        thread.join(30)
+        assert not errors, errors
+        assert len(server.metrics()) == 10
+
+
+# ----------------------------------------------------------------------- CLI
+
+
+class TestServeCLI:
+    def test_serve_main_duration_zero(self, capsys):
+        from torchmetrics_tpu.obs import serve
+
+        rc = serve.main(["--port", "0", "--duration", "0", "--no-trace"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "serving torchmetrics_tpu introspection on http://127.0.0.1:" in out
+        assert obs_server.get_server() is None  # stopped on exit
+
+    def test_serve_main_demo_registers_metric(self):
+        from torchmetrics_tpu.obs import serve
+
+        rc = serve.main(["--port", "0", "--duration", "0", "--no-trace", "--demo"])
+        assert rc == 0

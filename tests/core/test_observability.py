@@ -641,12 +641,17 @@ class TestPrometheusExpositionAudit:
     """Lock the text exposition with a strict line-format checker."""
 
     def _page(self):
+        from torchmetrics_tpu.obs import memory as obs_memory
+
         with trace.observe():
             _seed_recorder_deterministically()
             trace.observe_duration("sync.collective", 2.0, op="leaf gather", ok="true")
             trace.inc("c", reason="line1\nline2")
         m = MeanSquaredError(error_policy="warn_skip")
         m.update(jnp.ones(2), jnp.zeros(2))
+        # memory-accounting gauge families (tm_tpu_memory_* / tm_tpu_state_*)
+        # must survive the same strict audit as everything else
+        obs_memory.record_gauges([m])
         return export.prometheus_text(metrics=[m])
 
     def test_every_line_parses_and_every_family_has_help_and_type(self):
@@ -692,6 +697,31 @@ class TestPrometheusExpositionAudit:
         families, samples = _parse_exposition(self._page())
         escaped = [labels for name, labels, _ in samples if name == "tm_tpu_c_total"]
         assert escaped and escaped[0]["reason"] == "line1\\nline2"
+
+    def test_memory_and_state_families_present_with_headers(self):
+        families, samples = _parse_exposition(self._page())
+        for family in (
+            "tm_tpu_memory_state_bytes",
+            "tm_tpu_memory_state_device_bytes",
+            "tm_tpu_memory_state_host_bytes",
+            "tm_tpu_state_list_items",
+        ):
+            assert families[family]["type"] == "gauge", family
+            assert families[family]["help"], family
+        by_family = {}
+        for name, labels, value in samples:
+            by_family.setdefault(name, []).append((labels, value))
+        labels, value = by_family["tm_tpu_memory_state_bytes"][0]
+        assert labels["metric"] == "MeanSquaredError" and "inst" in labels
+        assert float(value) > 0
+
+    def test_gauge_families_never_end_in_total(self):
+        # the counter/gauge naming audit: _total is the counter suffix; a gauge
+        # family carrying it would read as a counter to a scraper
+        families, _ = _parse_exposition(self._page())
+        for name, info in families.items():
+            if info["type"] == "gauge":
+                assert not name.endswith("_total"), name
 
 
 # ---------------------------------------------------- warning-drop visibility
@@ -909,3 +939,36 @@ class TestDisabledOverhead:
             f"obs-disabled dispatch {t_instr:.4f}s vs seed-equivalent {t_inner:.4f}s"
         )
         assert trace.get_recorder().events() == []  # and it recorded nothing
+
+    def test_server_off_accounting_off_dispatch_within_noise(self):
+        """Importing the introspection server and the memory accounting must
+        not change the disabled dispatch path at all: with the server off and
+        no accounting call ever made, instrumented dispatch stays within noise
+        of the seed-equivalent inner body (same bound as above)."""
+        from torchmetrics_tpu.obs import memory as obs_memory
+        from torchmetrics_tpu.obs import server as obs_server
+        from torchmetrics_tpu.utils.checks import measure_runtime
+
+        assert obs_server.get_server() is None  # server off
+        assert not trace.is_enabled()  # accounting/tracing off
+        m = MeanSquaredError()
+        x, y = jnp.ones(64), jnp.zeros(64)
+        m.update(x, y)
+
+        def instrumented():
+            for _ in range(200):
+                m._dispatch_update(x, y)
+
+        def seed_equivalent():
+            for _ in range(200):
+                m._dispatch_update_inner(x, y)
+
+        t_inner = measure_runtime(seed_equivalent, reps=5, warmup=1)
+        t_instr = measure_runtime(instrumented, reps=5, warmup=1)
+        assert t_instr < t_inner * 2.0 + 0.05, (
+            f"server-off/accounting-off dispatch {t_instr:.4f}s vs seed-equivalent {t_inner:.4f}s"
+        )
+        # and neither module left anything behind in the recorder
+        snap = trace.get_recorder().snapshot()
+        assert snap["events"] == [] and snap["gauges"] == []
+        assert obs_memory.device_memory_stats() == {}  # CPU: clean skip, no gauges

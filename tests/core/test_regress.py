@@ -43,6 +43,42 @@ class TestRunRecord:
         assert record["configs"]["a"] == {"value": 10.5, "unit": "us/step"}
         assert record["configs"]["d"]["spread"] == {"min": 1.0, "max": 5.0, "reps": 5.0}
 
+    def test_memory_fields_ride_along_recorded_never_judged(self):
+        result = {
+            "hardware": "cpu-fallback",
+            "configs": {"a": {"value": 10.0, "unit": "us/step"}},
+            "memory": {
+                "peak_rss_bytes": 123456789,
+                "device_peak_bytes_in_use": 42,
+                "bogus": "not-a-number",  # non-numeric fields are dropped
+            },
+        }
+        record = regress.run_record(result)
+        assert record["memory"] == {"peak_rss_bytes": 123456789.0, "device_peak_bytes_in_use": 42.0}
+        # like `traced`: carried through, but the gate only walks `configs` —
+        # a 100x memory jump must not flag anything
+        history = [regress.run_record({**result, "memory": {"peak_rss_bytes": 1}})]
+        rows = regress.check_regressions(record, history)
+        assert [row["config"] for row in rows] == ["a"]
+        assert not any(row["regressed"] for row in rows)
+
+    def test_memory_fields_survive_history_round_trip(self, tmp_path):
+        path = str(tmp_path / "history.jsonl")
+        result = {
+            "hardware": "cpu-fallback",
+            "configs": {"a": {"value": 10.0, "unit": "us/step"}},
+            "memory": {"peak_rss_bytes": 2048},
+        }
+        regress.append_history(result, path=path)
+        (loaded,) = regress.load_history(path)
+        assert loaded["memory"] == {"peak_rss_bytes": 2048.0}
+
+    def test_absent_memory_key_stays_absent(self):
+        record = regress.run_record(
+            {"hardware": "x", "configs": {"a": {"value": 1.0, "unit": "us/step"}}}
+        )
+        assert "memory" not in record
+
 
 class TestCheckRegressions:
     def test_injected_2x_slowdown_is_flagged(self):

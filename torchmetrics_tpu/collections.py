@@ -511,6 +511,23 @@ class MetricCollection:
             m.to_device(device)
         return self
 
+    # -------------------------------------------------------------- memory accounting
+
+    def _memory_children(self) -> List[Tuple[str, Metric]]:
+        """Member metrics, for state-memory accounting (``obs/memory.py``).
+
+        Compute-group members alias their leader's immutable state arrays; the
+        accounting dedups shared buffers by identity, so a collection's
+        ``unique_bytes`` reflects what the grouping actually saves.
+        """
+        return list(self._modules.items())
+
+    def memory_footprint(self) -> Dict[str, Any]:
+        """Recursive state-memory footprint of the collection (see ``obs.memory``)."""
+        from torchmetrics_tpu.obs import memory as _memory
+
+        return _memory.footprint(self)
+
     # --------------------------------------------------------------------------- misc
 
     def plot(self, val: Any = None, ax: Any = None, together: bool = False):

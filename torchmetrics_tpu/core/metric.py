@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import itertools
 from abc import ABC, abstractmethod
 from contextlib import contextmanager
 from copy import deepcopy
@@ -110,6 +111,11 @@ class Metric(ABC):
 
     __jax_metric__ = True
 
+    # per-process construction ordinal distinguishing same-class instances in
+    # last-write-wins gauge series (the StaticLeafJit `inst` label pattern);
+    # clones/unpickles get a fresh ordinal in __setstate__
+    _obs_instance_seq = itertools.count()
+
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = None
@@ -174,6 +180,9 @@ class Metric(ABC):
         # __robust__ state_dict key so never-guarded metrics serialize the
         # legacy format byte-for-byte
         self._guards_engaged = False
+        # one-shot flag for the ragged list-state growth warning
+        self._warned_list_growth = False
+        self._obs_instance = str(next(Metric._obs_instance_seq))
 
         # wrap user update/compute (reference `_wrap_update/_wrap_compute`, metric.py:476,610)
         self._update_signature = inspect.signature(self.update)
@@ -268,6 +277,24 @@ class Metric(ABC):
     def metric_state(self) -> Dict[str, Any]:
         """Current values of all registered states (reference ``metric.py:192-195``)."""
         return dict(self._state_values)
+
+    # -------------------------------------------------------------- memory accounting
+
+    def _memory_children(self) -> List[tuple]:
+        """``(label, metric)`` pairs of nested metrics holding extra state.
+
+        The state-memory accounting (``obs/memory.py``) recurses through this
+        hook so wrapper-held hidden copies (tracker increments, running-window
+        rings, bootstrap replicas) are billed to their owner. Plain metrics
+        own no children.
+        """
+        return []
+
+    def memory_footprint(self) -> Dict[str, Any]:
+        """Recursive state-memory footprint of this metric (see ``obs.memory``)."""
+        from torchmetrics_tpu.obs import memory as _memory
+
+        return _memory.footprint(self)
 
     # ------------------------------------------------------------------ compute groups
 
@@ -528,6 +555,7 @@ class Metric(ABC):
                 self._update_impl(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
+            self._check_list_state_growth()
 
     # how often the jitted-update path syncs MaskedBuffer counts back to the host
     _buffer_overflow_check_every: int = 16
@@ -551,6 +579,54 @@ class Metric(ABC):
         for key, value in self._state_values.items():
             if isinstance(value, list):
                 self._state_values[key] = [np.asarray(v) for v in value]
+
+    # ragged list states grow one array per update with no bound; past this
+    # many total items the metric warns ONCE, loudly (same pattern as the
+    # jit recompile-storm guard) — configurable per class or per instance
+    list_state_warn_threshold: int = 10_000
+
+    def _check_list_state_growth(self) -> None:
+        """Surface unbounded ragged-list growth: gauge per update, one-shot warning.
+
+        Runs on the eager update path only (the only path that can grow list
+        states); cost is a ``len`` per list state. With obs tracing enabled the
+        total lands in the ``state.list_items`` gauge so Prometheus/snapshot
+        egress tracks the growth curve; the warning fires regardless of
+        tracing, once per metric instance.
+        """
+        items = 0
+        per_state = None
+        for key, value in self._state_values.items():
+            if isinstance(value, list):
+                items += len(value)
+                if per_state is None:
+                    per_state = []
+                per_state.append((key, len(value)))
+        if not items:
+            return
+        if _trace.ENABLED:
+            # per-instance label: two same-class metrics must not overwrite
+            # each other's last-write-wins growth curve
+            _trace.set_gauge(
+                "state.list_items", items, metric=type(self).__name__, inst=self._obs_instance
+            )
+        if items > self.list_state_warn_threshold and not self._warned_list_growth:
+            self._warned_list_growth = True
+            detail = ", ".join(f"{key}: {count} items" for key, count in per_state)
+            if _trace.ENABLED:
+                _trace.event(
+                    "state.list_growth", metric=type(self).__name__, items=items, detail=detail
+                )
+            rank_zero_warn(
+                f"{type(self).__name__} holds {items} ragged list-state items"
+                f" (threshold {self.list_state_warn_threshold}): {detail}. List states"
+                " grow one array per update with no bound — on a long run this is an"
+                " OOM in waiting. Call compute()+reset() periodically, use a"
+                " MaskedBuffer-backed binned variant, or raise"
+                " `list_state_warn_threshold` if the growth is intended"
+                " (`obs.memory.footprint(metric)` shows the accumulated bytes).",
+                RuntimeWarning,
+            )
 
     # ------------------------------------------------------------------------ forward
 
@@ -1000,6 +1076,9 @@ class Metric(ABC):
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        # a clone/unpickle is a distinct live instance: give it its own gauge
+        # series instead of inheriting (and overwriting) the original's
+        self._obs_instance = str(next(Metric._obs_instance_seq))
         self._update_signature = inspect.signature(self.update)
         self._update_impl = self.update
         self._compute_impl = self.compute
@@ -1186,6 +1265,14 @@ class CompositionalMetric(Metric):
         # compute entirely, ``metric.py:1186``): a child metric updating would leave
         # a stale composite cache, and children already run their own sync_context.
         return self._compute_impl()
+
+    def _memory_children(self) -> List[tuple]:
+        children = []
+        if isinstance(self.metric_a, Metric):
+            children.append(("metric_a", self.metric_a))
+        if isinstance(self.metric_b, Metric):
+            children.append(("metric_b", self.metric_b))
+        return children
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):

@@ -30,6 +30,15 @@ recorder:
   over ``BENCH_HISTORY.jsonl`` with noise-aware tolerances
   (``python -m torchmetrics_tpu.obs.regress``; wired into
   ``bench.py --check-regressions``).
+- :mod:`~torchmetrics_tpu.obs.memory` — state-memory accounting: per-metric
+  footprints (device array / host numpy / ragged list / MaskedBuffer states,
+  wrapper and collection rollups with alias dedup), guarded
+  ``device.memory_stats()`` polling, all recordable as ``memory.*`` /
+  ``state.*`` gauges.
+- :mod:`~torchmetrics_tpu.obs.server` — live introspection over HTTP
+  (``/metrics``, ``/healthz``, ``/readyz``, ``/snapshot``, ``/memory``) on a
+  stdlib daemon-thread server; ``python -m torchmetrics_tpu.obs.serve``
+  for a standalone endpoint.
 
 Typical use::
 
@@ -44,11 +53,13 @@ Typical use::
 
 # note: `obs.aggregate` stays the *submodule* (its entry point is
 # `obs.aggregate.aggregate()`); only the clash-free helper names are re-exported
-from torchmetrics_tpu.obs import aggregate, export, perfetto, profile, regress, trace
+from torchmetrics_tpu.obs import aggregate, export, memory, perfetto, profile, regress, server, trace
 from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
 from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
+from torchmetrics_tpu.obs.memory import device_memory_stats, footprint, record_gauges
 from torchmetrics_tpu.obs.perfetto import chrome_trace, write_trace
 from torchmetrics_tpu.obs.profile import annotate, profile_trace, start_trace, stop_trace
+from torchmetrics_tpu.obs.server import IntrospectionServer, start_server, stop_server
 from torchmetrics_tpu.obs.trace import (
     TraceRecorder,
     disable,
@@ -65,19 +76,23 @@ from torchmetrics_tpu.obs.trace import (
 )
 
 __all__ = [
+    "IntrospectionServer",
     "TraceRecorder",
     "aggregate",
     "annotate",
     "chrome_trace",
     "collect",
+    "device_memory_stats",
     "disable",
     "enable",
     "event",
     "export",
+    "footprint",
     "get_recorder",
     "host_snapshot",
     "inc",
     "is_enabled",
+    "memory",
     "merge_snapshots",
     "observe",
     "observe_duration",
@@ -85,11 +100,15 @@ __all__ = [
     "profile",
     "profile_trace",
     "prometheus_text",
+    "record_gauges",
     "record_warning",
     "regress",
+    "server",
     "set_gauge",
     "span",
+    "start_server",
     "start_trace",
+    "stop_server",
     "stop_trace",
     "summary",
     "trace",

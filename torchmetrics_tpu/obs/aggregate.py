@@ -273,15 +273,33 @@ def summarize(agg: Dict[str, Any]) -> str:
         for counter in agg["counters"]:
             label = " ".join(f"{k}={v}" for k, v in sorted(counter["labels"].items()))
             lines.append(f"  {counter['name']:<{width}}  {counter['value']:>10g}  {label}")
-    if agg["gauges"]:
+    # memory-accounting gauges (obs/memory.py) get their own fleet table with
+    # human-readable byte columns; everything else stays in the generic table
+    memory_gauges = [g for g in agg["gauges"] if g["name"].startswith("memory.")]
+    other_gauges = [g for g in agg["gauges"] if not g["name"].startswith("memory.")]
+    if other_gauges:
         lines.append("-- gauges (per-host | max) --")
-        width = max(len(g["name"]) for g in agg["gauges"])
-        for gauge in agg["gauges"]:
+        width = max(len(g["name"]) for g in other_gauges)
+        for gauge in other_gauges:
             label = " ".join(f"{k}={v}" for k, v in sorted(gauge["labels"].items()))
             per_host = " ".join(
                 f"{h}:{v:g}" for h, v in sorted(gauge["per_host"].items(), key=lambda kv: int(kv[0]))
             )
             lines.append(f"  {gauge['name']:<{width}}  {per_host} | max={gauge['max']:g}  {label}")
+    if memory_gauges:
+        from torchmetrics_tpu.obs.memory import format_bytes
+
+        lines.append("-- memory (per-host bytes | max) --")
+        width = max(len(g["name"]) for g in memory_gauges)
+        for gauge in memory_gauges:
+            label = " ".join(f"{k}={v}" for k, v in sorted(gauge["labels"].items()))
+            per_host = " ".join(
+                f"{h}:{format_bytes(v)}"
+                for h, v in sorted(gauge["per_host"].items(), key=lambda kv: int(kv[0]))
+            )
+            lines.append(
+                f"  {gauge['name']:<{width}}  {per_host} | max={format_bytes(gauge['max'])}  {label}"
+            )
     if agg["histograms"]:
         lines.append("-- durations (bucket-merged) --")
         width = max(len(h["name"]) for h in agg["histograms"])

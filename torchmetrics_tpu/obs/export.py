@@ -162,6 +162,26 @@ def _prom_header(out: List[str], prom: str, kind: str, help_text: str) -> None:
     out.append(f"# TYPE {prom} {kind}")
 
 
+# specific HELP text for the memory-accounting gauge families (obs/memory.py);
+# everything else gets the generic last-recorded-value wording below
+_GAUGE_HELP = {
+    "memory.state_bytes": "Unique accumulated metric-state bytes (children included, aliased buffers deduped)",
+    "memory.state_device_bytes": "Device-resident share of the unique metric-state bytes (incl. MaskedBuffer capacity)",
+    "memory.state_host_bytes": "Host-resident share of the unique metric-state bytes (numpy states, defaults, quarantine)",
+    "state.list_items": "Ragged list-state items currently held (grows unbounded without compute+reset)",
+    "memory.device_bytes_in_use": "jax device.memory_stats() bytes_in_use (absent on backends without memory stats)",
+    "memory.device_peak_bytes_in_use": "jax device.memory_stats() peak_bytes_in_use (absent on backends without memory stats)",
+    "memory.snapshot_payload_bytes": "Bytes of the last cross-host telemetry snapshot shipped by this host",
+}
+
+
+def _gauge_help(name: str) -> str:
+    specific = _GAUGE_HELP.get(name)
+    if specific is not None:
+        return f"{specific} (torchmetrics_tpu.obs)"
+    return f"Last recorded value of `{name}` (torchmetrics_tpu.obs)"
+
+
 def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> str:
     """Prometheus text exposition (0.0.4) of counters, gauges, histograms and
     the per-metric robust counters. Every family gets a ``# HELP`` + ``# TYPE``
@@ -185,7 +205,7 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
         by_name.setdefault(gauge["name"], []).append(gauge)
     for name in sorted(by_name):
         prom = _prom_name(name)
-        _prom_header(out, prom, "gauge", f"Last recorded value of `{name}` (torchmetrics_tpu.obs)")
+        _prom_header(out, prom, "gauge", _gauge_help(name))
         for gauge in by_name[name]:
             out.append(f"{prom}{_prom_labels(gauge['labels'])} {_prom_value(gauge['value'])}")
 

@@ -110,7 +110,11 @@ def run_record(
     recorded ``spread`` dict rides along for the tolerance logic. ``traced``
     marks a run whose timings include obs tracing overhead
     (``TM_TPU_BENCH_OBS=1``): it is recorded for the telemetry it carries but
-    never used as a regression baseline and never judged.
+    never used as a regression baseline and never judged. A ``memory`` dict
+    (``peak_rss_bytes`` / ``device_peak_bytes_in_use`` from the bench run)
+    rides along the same way — recorded so memory trends accumulate across
+    rounds, never judged by :func:`check_regressions` (which walks ``configs``
+    only).
     """
     configs: Dict[str, Any] = {}
     for name, cfg in (result.get("configs") or {}).items():
@@ -139,6 +143,15 @@ def run_record(
     }
     if traced or result.get("traced"):
         record["traced"] = True
+    memory = result.get("memory")
+    if isinstance(memory, dict):
+        clean_memory = {
+            key: float(value)
+            for key, value in memory.items()
+            if isinstance(value, (int, float)) and not isinstance(value, bool)
+        }
+        if clean_memory:
+            record["memory"] = clean_memory
     return record
 
 

@@ -1,0 +1,105 @@
+"""CLI for the live introspection service: ``python -m torchmetrics_tpu.obs.serve``.
+
+Starts the :mod:`torchmetrics_tpu.obs.server` endpoint in the current process
+and keeps it up until interrupted (or for ``--duration`` seconds) — the
+smallest way to point a browser or a Prometheus scraper at the obs layer:
+
+.. code-block:: console
+
+    $ python -m torchmetrics_tpu.obs.serve --port 9464 &
+    serving torchmetrics_tpu introspection on http://127.0.0.1:9464
+    $ curl -s localhost:9464/healthz
+    {"status": "ok", ...}
+
+Standalone the process has no metrics of its own, so ``/metrics`` shows only
+recorder series (plus a demo metric with ``--demo``); in a real job you embed
+the server instead (``obs.server.start(metrics=[...])``) and this CLI is the
+smoke-test mirror of ``python -m torchmetrics_tpu.obs.regress``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+from torchmetrics_tpu.obs import server as _server
+from torchmetrics_tpu.obs import trace as _trace
+
+__all__ = ["main"]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m torchmetrics_tpu.obs.serve",
+        description=(
+            "Serve the obs introspection endpoints (/metrics, /healthz, /readyz,"
+            " /snapshot, /memory) over HTTP until interrupted."
+        ),
+    )
+    parser.add_argument("--host", default="127.0.0.1", help="bind address (default: localhost)")
+    parser.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help=f"bind port (default: ${_server.ENV_PORT} or {_server.DEFAULT_PORT}; 0 = ephemeral)",
+    )
+    parser.add_argument(
+        "--duration",
+        type=float,
+        default=None,
+        help="serve for this many seconds then exit (default: until Ctrl-C)",
+    )
+    parser.add_argument(
+        "--no-trace",
+        action="store_true",
+        help="do not enable obs tracing (scrapes then show only explicitly recorded series)",
+    )
+    parser.add_argument(
+        "--demo",
+        action="store_true",
+        help="register a demo metric and update it once, so /metrics and /memory have content",
+    )
+    args = parser.parse_args(argv)
+
+    if not args.no_trace:
+        _trace.enable(reset=False)
+
+    metrics = []
+    if args.demo:
+        try:
+            import jax.numpy as jnp
+
+            from torchmetrics_tpu.aggregation import MeanMetric
+
+            demo = MeanMetric()
+            demo.update(jnp.arange(8.0))
+            metrics.append(demo)
+        except Exception as err:  # demo is a convenience, never a hard failure
+            sys.stderr.write(f"demo metric unavailable: {err!r}\n")
+
+    try:
+        server = _server.start(metrics, host=args.host, port=args.port)
+    except OSError as err:
+        sys.stderr.write(f"cannot bind introspection server: {err}\n")
+        return 2
+    print(f"serving torchmetrics_tpu introspection on {server.url}", flush=True)
+    print(f"routes: {', '.join(_server.ROUTES)}", flush=True)
+    try:
+        if args.duration is not None:
+            deadline = time.monotonic() + args.duration
+            while time.monotonic() < deadline and server.running:
+                time.sleep(min(0.2, max(0.0, deadline - time.monotonic())))
+        else:
+            while server.running:
+                time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        _server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

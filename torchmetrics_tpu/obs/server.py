@@ -1,0 +1,429 @@
+"""Live introspection service: /metrics, /healthz, /readyz, /snapshot, /memory.
+
+Everything the obs layer records was pull-after-the-fact — JSONL files,
+Perfetto dumps, bench history. An operator running this runtime under real
+traffic needs to *scrape* it: point a Prometheus collector at the process,
+probe its health from a load balancer, and see what the metric states cost in
+memory, all without stopping the job. This module is that endpoint — a stdlib
+:class:`~http.server.ThreadingHTTPServer` on a daemon thread, zero
+dependencies, localhost by default:
+
+- ``GET /metrics`` — Prometheus text exposition
+  (:func:`~torchmetrics_tpu.obs.export.prometheus_text`) of every recorded
+  counter/gauge/histogram plus the per-metric robust counters; memory gauges
+  are refreshed from the registered metrics on each scrape.
+- ``GET /healthz`` — liveness + degradation, JSON. **Degraded is not dead**:
+  a process whose robust counters show quarantined metrics or a degraded
+  cross-host sync answers ``200`` with ``status: "degraded"`` and the
+  offending metrics named — the operator decides whether to drain it.
+- ``GET /readyz`` — readiness (the server answering *is* the signal), JSON.
+- ``GET /snapshot`` — the rank-aware recorder snapshot
+  (:func:`~torchmetrics_tpu.obs.aggregate.host_snapshot`), JSON.
+- ``GET /memory`` — top-K state-memory footprint report
+  (:func:`~torchmetrics_tpu.obs.memory.report`; ``?top=K`` to re-rank), JSON.
+
+Lifecycle contract: :func:`start` is idempotent (a second call returns the
+running server), :meth:`IntrospectionServer.stop` is idempotent and leaves no
+thread behind, and a process that never starts the server pays nothing — no
+import-time side effects, no extra branch on any metric hot path. Binding is
+synchronous (the socket listens before ``start`` returns), so tests on an
+ephemeral port (``port=0``) need no sleeps.
+
+Configuration: ``host``/``port`` arguments, else the ``TM_TPU_OBS_PORT``
+environment variable, else port 9464 on ``127.0.0.1``. The server binds
+localhost by default on purpose — the exposition includes host ids and metric
+class names; bind a routable interface explicitly only on networks where that
+is acceptable.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+import torchmetrics_tpu.obs.trace as trace
+from torchmetrics_tpu.obs import aggregate as _aggregate
+from torchmetrics_tpu.obs import export as _export
+from torchmetrics_tpu.obs import memory as _memory
+
+__all__ = [
+    "DEFAULT_PORT",
+    "ENV_PORT",
+    "IntrospectionServer",
+    "get_server",
+    "serve",
+    "start",
+    "start_server",
+    "stop",
+    "stop_server",
+]
+
+ENV_PORT = "TM_TPU_OBS_PORT"
+DEFAULT_PORT = 9464  # the conventional OpenMetrics/collector exporter port
+
+ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory")
+
+
+def _resolve_port(port: Optional[int]) -> int:
+    if port is not None:
+        return int(port)
+    env = os.environ.get(ENV_PORT)
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(f"{ENV_PORT} must be an integer port, got {env!r}") from None
+    return DEFAULT_PORT
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One request → one JSON/text response off the owning server's state."""
+
+    server: "_HTTPServer"  # typing aid; set by the socketserver machinery
+
+    # the default handler logs every request to stderr — route through the
+    # owning server's recorder instead (visible in ITS /snapshot, silent when
+    # tracing is off)
+    def log_message(self, format: str, *args: Any) -> None:
+        self.server.owner._rec_event("obs.server.request", message=format % args)
+
+    def _send(self, status: int, body: bytes, content_type: str) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, payload: Dict[str, Any], status: int = 200) -> None:
+        body = json.dumps(payload, sort_keys=True, default=str).encode("utf-8")
+        self._send(status, body, "application/json; charset=utf-8")
+
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        owner: "IntrospectionServer" = self.server.owner
+        parsed = urlparse(self.path)
+        route = parsed.path.rstrip("/") or "/"
+        owner._rec_inc("server.requests", route=route)
+        try:
+            if route == "/metrics":
+                self._send(200, owner.render_metrics().encode("utf-8"),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif route == "/healthz":
+                self._send_json(owner.health())
+            elif route == "/readyz":
+                self._send_json(owner.ready())
+            elif route == "/snapshot":
+                self._send_json(_aggregate.host_snapshot(owner.recorder))
+            elif route == "/memory":
+                query = parse_qs(parsed.query)
+                try:
+                    top_k = int(query.get("top", ["20"])[0])
+                except ValueError:
+                    self._send_json({"error": "top must be an integer"}, status=400)
+                    return
+                self._send_json(_memory.report(owner.metrics(), top_k=top_k))
+            elif route == "/":
+                self._send_json({"routes": list(ROUTES), "service": "torchmetrics_tpu.obs"})
+            else:
+                self._send_json({"error": f"unknown route {route!r}", "routes": list(ROUTES)}, status=404)
+        except BrokenPipeError:  # client went away mid-response: not our problem
+            pass
+        except Exception as err:  # never kill the serving thread on a handler bug
+            owner._rec_inc("server.errors", route=route)
+            try:
+                self._send_json({"error": f"{type(err).__name__}: {err}"}, status=500)
+            except Exception:
+                pass
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True  # request threads must never pin process exit
+    # don't wait for in-flight daemon request threads on close: stop() must
+    # return promptly even if a slow client is mid-download
+    block_on_close = False
+
+    owner: "IntrospectionServer"
+
+
+class IntrospectionServer:
+    """The live introspection endpoint; one instance per process is typical.
+
+    Args:
+        metrics: initial metric objects to expose (robust counters on
+            ``/metrics``/``/healthz``, footprints on ``/memory``). Collections
+            and wrappers are accepted — accounting recurses into them. More can
+            be registered later with :meth:`register`.
+        host: bind address (default localhost; see the module docstring).
+        port: bind port; ``None`` → ``TM_TPU_OBS_PORT`` env → 9464; ``0`` → an
+            ephemeral port (tests), readable as :attr:`port` after start.
+        recorder: recorder to expose (default: the process-global one).
+    """
+
+    def __init__(
+        self,
+        metrics: Iterable[Any] = (),
+        host: str = "127.0.0.1",
+        port: Optional[int] = None,
+        recorder: Optional[trace.TraceRecorder] = None,
+    ) -> None:
+        self._metrics: List[Any] = list(metrics)
+        self._metrics_lock = threading.Lock()
+        self.host = host
+        self.requested_port = _resolve_port(port)
+        self.recorder = recorder if recorder is not None else trace.get_recorder()
+        self._httpd: Optional[_HTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # server telemetry goes to THIS server's recorder (not the process-global
+    # one — a custom-recorder server's request counters must show up in its
+    # own /metrics and /snapshot, not pollute an unrelated session), with the
+    # same trace.ENABLED gate as every other instrumented site
+    def _rec_inc(self, name: str, **labels: Any) -> None:
+        if trace.ENABLED:
+            self.recorder.inc(name, **labels)
+
+    def _rec_event(self, name: str, **attrs: Any) -> None:
+        if trace.ENABLED:
+            self.recorder.add_event(name, **attrs)
+
+    # ------------------------------------------------------------------ lifecycle
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    @property
+    def port(self) -> Optional[int]:
+        """The bound port (resolves ``port=0`` to the real ephemeral port)."""
+        return self._httpd.server_address[1] if self._httpd is not None else None
+
+    @property
+    def url(self) -> Optional[str]:
+        return f"http://{self.host}:{self.port}" if self._httpd is not None else None
+
+    def start(self) -> "IntrospectionServer":
+        """Bind and serve on a daemon thread; idempotent."""
+        if self.running:
+            return self
+        if self._httpd is not None:  # stale socket from a stopped instance
+            self._httpd.server_close()
+            self._httpd = None
+        httpd = _HTTPServer((self.host, self.requested_port), _Handler)
+        httpd.owner = self
+        self._httpd = httpd
+        self._thread = threading.Thread(
+            target=httpd.serve_forever,
+            name=f"tm-tpu-obs-server:{self.port}",
+            daemon=True,
+        )
+        self._thread.start()
+        self._rec_event("obs.server.started", url=self.url)
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Shut down and join the serving thread; idempotent, leaks nothing."""
+        thread, httpd = self._thread, self._httpd
+        self._thread = None
+        self._httpd = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout)
+        if httpd is not None:
+            self._rec_event("obs.server.stopped")
+
+    def __enter__(self) -> "IntrospectionServer":
+        return self.start()
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------- registry
+
+    def register(self, *metrics: Any) -> "IntrospectionServer":
+        """Expose more metric objects on /metrics, /healthz and /memory."""
+        with self._metrics_lock:
+            for metric in metrics:
+                if all(existing is not metric for existing in self._metrics):
+                    self._metrics.append(metric)
+        return self
+
+    def unregister(self, *metrics: Any) -> "IntrospectionServer":
+        with self._metrics_lock:
+            self._metrics = [
+                existing for existing in self._metrics
+                if all(existing is not metric for metric in metrics)
+            ]
+        return self
+
+    def metrics(self) -> List[Any]:
+        with self._metrics_lock:
+            return list(self._metrics)
+
+    # ------------------------------------------------------------------- payloads
+
+    def render_metrics(self) -> str:
+        """The /metrics page: refresh memory gauges, then Prometheus text.
+
+        Memory gauges are recorded against the *registered* objects (a
+        collection footprints as one rollup), while the robust-counter rows go
+        to the recursively flattened leaves — a quarantine counter on a metric
+        inside a registered collection/wrapper must reach the scraper.
+        """
+        metrics = self.metrics()
+        try:
+            _memory.record_gauges(metrics, recorder=self.recorder)
+        except Exception:  # accounting must never break the scrape
+            self._rec_inc("server.errors", route="/metrics(accounting)")
+        robust_leaves = [metric for _, metric in self._flat_metrics()]
+        return _export.prometheus_text(metrics=robust_leaves, recorder=self.recorder)
+
+    def _flat_metrics(self) -> List[Tuple[str, Any]]:
+        """Registered metrics recursively flattened into (path, metric) pairs.
+
+        Walks the same ``_memory_children`` hierarchy the memory accounting
+        uses, so a quarantined metric *inside* a collection, wrapper or
+        tracker increment is named individually — health and the robust
+        Prometheus rows must not be blind to exactly the nesting this PR
+        taught the footprint walker to see.
+        """
+        flat: List[Tuple[str, Any]] = []
+        seen: set = set()
+
+        def walk(path: str, obj: Any) -> None:
+            if id(obj) in seen:
+                return
+            seen.add(id(obj))
+            if hasattr(obj, "updates_ok"):  # a robust-counter-bearing metric
+                flat.append((path, obj))
+            hook = getattr(obj, "_memory_children", None)
+            if callable(hook):
+                try:
+                    children = list(hook())
+                except Exception:
+                    return
+                for label, child in children:
+                    walk(f"{path}/{label}", child)
+
+        for metric in self.metrics():
+            walk(type(metric).__name__, metric)
+        return flat
+
+    def health(self) -> Dict[str, Any]:
+        """Liveness + degradation. Degraded — not dead — when robust counters
+        show quarantined/skipped batches or a degraded cross-host sync."""
+        reasons: List[str] = []
+        quarantined: List[Dict[str, Any]] = []
+        degraded_sync: List[str] = []
+        skipped: List[Dict[str, Any]] = []
+        for name, metric in self._flat_metrics():
+            n_quarantined = int(getattr(metric, "updates_quarantined", 0) or 0)
+            n_dropped = int(getattr(metric, "quarantine_dropped", 0) or 0)
+            n_skipped = int(getattr(metric, "updates_skipped", 0) or 0)
+            if n_quarantined or n_dropped:
+                quarantined.append(
+                    {"metric": name, "updates_quarantined": n_quarantined, "quarantine_dropped": n_dropped}
+                )
+            if n_skipped:
+                skipped.append({"metric": name, "updates_skipped": n_skipped})
+            if bool(getattr(metric, "sync_degraded", False)):
+                degraded_sync.append(name)
+        if quarantined:
+            names = ", ".join(row["metric"] for row in quarantined)
+            reasons.append(f"quarantined updates on: {names}")
+        if degraded_sync:
+            reasons.append(f"sync degraded to local-only state on: {', '.join(degraded_sync)}")
+        # recorder-level signals cover unregistered metrics and the aggregate path
+        rec_sync_degraded = self.recorder.counter_value("sync.degraded")
+        rec_agg_degraded = self.recorder.counter_value("aggregate.degraded")
+        if rec_sync_degraded and not degraded_sync:
+            reasons.append(f"{int(rec_sync_degraded)} degraded sync(s) recorded")
+        if rec_agg_degraded:
+            reasons.append(f"{int(rec_agg_degraded)} degraded telemetry aggregation(s)")
+        status = "degraded" if reasons else "ok"
+        return {
+            "status": status,
+            "reasons": reasons,
+            "quarantined": quarantined,
+            "skipped": skipped,
+            "sync_degraded": degraded_sync,
+            "n_metrics": len(self.metrics()),
+            "trace_enabled": trace.is_enabled(),
+        }
+
+    def ready(self) -> Dict[str, Any]:
+        return {
+            "ready": True,
+            "url": self.url,
+            "n_metrics": len(self.metrics()),
+            "trace_enabled": trace.is_enabled(),
+        }
+
+
+# ------------------------------------------------------- module-level singleton
+
+_SERVER: Optional[IntrospectionServer] = None
+_SERVER_LOCK = threading.Lock()
+
+
+def get_server() -> Optional[IntrospectionServer]:
+    """The process-wide server started via :func:`start`, or ``None``."""
+    return _SERVER
+
+
+def start(
+    metrics: Iterable[Any] = (),
+    host: str = "127.0.0.1",
+    port: Optional[int] = None,
+    recorder: Optional[trace.TraceRecorder] = None,
+) -> IntrospectionServer:
+    """Start (or return) the process-wide introspection server.
+
+    Idempotent: a second call returns the already-running server after
+    registering any newly passed metrics — it does NOT rebind, so differing
+    host/port arguments on the second call are ignored.
+    """
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None and _SERVER.running:
+            return _SERVER.register(*metrics)
+        _SERVER = IntrospectionServer(metrics, host=host, port=port, recorder=recorder).start()
+        return _SERVER
+
+
+def stop(timeout: float = 5.0) -> None:
+    """Stop the process-wide server; idempotent (no-op when never started)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        server, _SERVER = _SERVER, None
+    if server is not None:
+        server.stop(timeout=timeout)
+
+
+# aliases for the package namespace (`obs.start_server(...)`), where the bare
+# verbs would read as ambiguous next to profile.start_trace / trace.enable
+start_server = start
+stop_server = stop
+
+
+class serve:
+    """Context manager: process-wide server up inside the block, down after.
+
+    >>> from torchmetrics_tpu.obs import server as obs_server
+    >>> with obs_server.serve(port=0) as srv:   # doctest: +SKIP
+    ...     print(srv.url)
+    """
+
+    def __init__(self, metrics: Iterable[Any] = (), host: str = "127.0.0.1", port: Optional[int] = None) -> None:
+        self._args = (metrics, host, port)
+
+    def __enter__(self) -> IntrospectionServer:
+        metrics, host, port = self._args
+        return start(metrics, host=host, port=port)
+
+    def __exit__(self, *exc_info: Any) -> None:
+        stop()

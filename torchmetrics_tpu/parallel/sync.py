@@ -113,6 +113,11 @@ def allgather_host_payloads(payload: bytes, description: str = "host payload gat
     """
     import numpy as np
 
+    if _trace.ENABLED:
+        # how many bytes this host contributes to a fleet snapshot: lets the
+        # memory accounting see telemetry transport itself (a host whose ring
+        # buffer balloons shows up as an outlier per-host gauge)
+        _trace.set_gauge("memory.snapshot_payload_bytes", float(len(payload)), op=description)
     if not distributed_available():
         return [bytes(payload)]
     data = np.frombuffer(bytes(payload), dtype=np.uint8)

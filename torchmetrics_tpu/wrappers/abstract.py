@@ -42,6 +42,40 @@ class WrapperMetric(Metric):
     def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
         """No-op: the wrapped metric syncs itself."""
 
+    @staticmethod
+    def _is_memory_child(value: Any) -> bool:
+        # Metric subclasses AND anything exposing the accounting hook itself —
+        # MultitaskWrapper explicitly allows MetricCollection task values,
+        # which is not a Metric but must not vanish from the rollup
+        return isinstance(value, Metric) or (
+            not isinstance(value, type) and callable(getattr(value, "_memory_children", None))
+        )
+
+    def _memory_children(self) -> list:
+        """Nested metrics this wrapper holds, for state-memory accounting.
+
+        Wrappers keep their base metric(s) in instance attributes under
+        several shapes — a single metric (``Running.base_metric``,
+        ``ClasswiseWrapper.metric``), a replica list (``BootStrapper.metrics``,
+        ``MultioutputWrapper.metrics``) or a task dict of metrics or
+        collections (``MultitaskWrapper.task_metrics``). One generic scan
+        covers them all, so every wrapper's hidden copies are billed without
+        per-class hooks.
+        """
+        children = []
+        for key, value in self.__dict__.items():
+            if self._is_memory_child(value):
+                children.append((key, value))
+            elif isinstance(value, (list, tuple)):
+                children.extend(
+                    (f"{key}[{i}]", v) for i, v in enumerate(value) if self._is_memory_child(v)
+                )
+            elif isinstance(value, dict):
+                children.extend(
+                    (f"{key}[{k}]", v) for k, v in value.items() if self._is_memory_child(v)
+                )
+        return children
+
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Each wrapper defines its own forward."""
         raise NotImplementedError

@@ -206,3 +206,22 @@ class MetricTracker:
     def _check_for_increment(self, method: str) -> None:
         if not self._increment_called:
             raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def _memory_children(self) -> List[Tuple[str, Union[Metric, MetricCollection]]]:
+        """Base metric + every tracked increment, for state-memory accounting.
+
+        Each :meth:`increment` deep-copies the base metric — a tracker run over
+        N epochs holds N+1 full state copies. The accounting must see them
+        all, or a leaking tracker reads as a constant-size metric.
+        """
+        children: List[Tuple[str, Union[Metric, MetricCollection]]] = [
+            ("base_metric", self._base_metric)
+        ]
+        children.extend((f"increment[{i}]", m) for i, m in enumerate(self._increments))
+        return children
+
+    def memory_footprint(self) -> Dict[str, Any]:
+        """Recursive state-memory footprint of the tracker (see ``obs.memory``)."""
+        from torchmetrics_tpu.obs import memory as _memory
+
+        return _memory.footprint(self)
