@@ -209,6 +209,68 @@ def bench_acc_stateful(preds, target) -> float:
     return elapsed / STEPS * 1e6
 
 
+def bench_acc_engine(preds, target, fuse: int):
+    """Engine configs: the config #1 hot loop driven through the streaming engine.
+
+    ``fuse=1`` is the pipelined per-batch path (prefetch + bounded async window,
+    one dispatch per step — measures the engine's overhead over the bare loop);
+    ``fuse=8`` fuses 8 batches per ``lax.scan`` dispatch. Both AOT-warmup first
+    (``MetricPipeline.warmup``), so the timed region contains zero XLA compiles.
+    Returns ``(us_per_step, stats)`` where ``stats`` carries the timed run's
+    dispatch accounting plus warmup/persistent-compile-cache totals — recorded
+    in the bench JSON and history lines, never judged by the regression gate.
+    """
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.engine import MetricPipeline, PipelineConfig, persistent_cache_stats
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    pipe = MetricPipeline(metric, PipelineConfig(fuse=fuse, max_in_flight=4, prefetch=2))
+    n_distinct = 8
+    batches = [(preds[i], target[i]) for i in range(n_distinct)]
+    jax.block_until_ready(batches)
+    cache_before = persistent_cache_stats()
+    manifest = pipe.warmup(*batches[0])
+    pipe.run(batches)  # warm run: every remaining dispatch path executes once
+    jax.block_until_ready(metric.compute())
+    metric.reset()
+
+    before = pipe.report().asdict()
+    start = time.perf_counter()
+    pipe.run(batches[i % n_distinct] for i in range(STEPS))
+    jax.block_until_ready(metric.compute())
+    elapsed = time.perf_counter() - start
+    after = pipe.close().asdict()
+    cache_after = persistent_cache_stats()
+    timed = {
+        key: after[key] - before[key]
+        for key in after
+        if isinstance(after[key], int) and isinstance(before.get(key), int)
+        and key not in ("max_chunk", "last_chunk")  # gauges, not counters: diffing lies
+    }
+    timed["max_chunk"] = after["max_chunk"]
+    timed["dispatches_per_batch"] = (
+        round(timed["host_dispatches"] / timed["batches"], 4) if timed.get("batches") else None
+    )
+    stats = {
+        "fuse": fuse,
+        "timed_run": timed,
+        "warmup": {
+            "variants": manifest["variants"],
+            "fresh_compiles": manifest["fresh_compiles"],
+            "total_compile_seconds": manifest["total_compile_seconds"],
+            "cache_dir": manifest["cache_dir"],
+        },
+        "compile_cache": {
+            "entries": cache_after["entries"],
+            "hits": cache_after["hits"] - cache_before["hits"],
+            "requests": cache_after["requests"] - cache_before["requests"],
+        },
+    }
+    return elapsed / STEPS * 1e6, stats
+
+
 def bench_acc_scan(preds, target) -> float:
     """Config #2: whole epoch folded through ``lax.scan`` in ONE XLA program."""
     import jax
@@ -975,6 +1037,19 @@ def _obs_demo() -> dict:
         return {"error": repr(err)}
 
 
+def _engine_configs(obs_by_config: dict, preds, target) -> dict:
+    """Both engine configs as flat keys + an `engine_stats` side-channel dict."""
+    out: dict = {}
+    stats: dict = {}
+    for name, fuse in (("engine_pipelined", 1), ("engine_fused", 8)):
+        res = _safe_obs(obs_by_config, name, bench_acc_engine, preds, target, fuse)
+        if res is not None:
+            out[name], stats[name] = res
+    if stats:
+        out["engine_stats"] = stats
+    return out
+
+
 def _run_ours(hardware: str) -> dict:
     """Measure our configs in THIS process (backend already chosen)."""
     preds, target = _stage_data()
@@ -982,6 +1057,7 @@ def _run_ours(hardware: str) -> dict:
     out = {
         "stateful": _safe_obs(obs_by_config, "stateful", bench_acc_stateful, preds, target),
         "scan": _safe_obs(obs_by_config, "scan", bench_acc_scan, preds, target),
+        **_engine_configs(obs_by_config, preds, target),
         **(_safe(bench_sync_overhead_stats) or {}),
         "curve": _safe_obs(obs_by_config, "curve", bench_pr_curve),
         "inception": _safe_obs(obs_by_config, "inception", bench_inception, hardware),
@@ -1037,6 +1113,9 @@ def _worker_main(mode: str) -> None:
             "rouge": _safe_obs(obs_by_config, "rouge", bench_rouge),
             "ref_rouge": _safe(ref_rouge),
         })
+        # engine configs carry a non-numeric stats dict, so they stay outside
+        # the min-merge (their timings are single-round like the model configs)
+        out.update(_engine_configs(obs_by_config, preds, target))
         out["obs_demo"] = _obs_demo()
         if obs_by_config:
             out["obs_configs"] = obs_by_config
@@ -1199,6 +1278,19 @@ def main(check_regressions: bool = False) -> None:
             "value": ours_scan, "unit": "us/step", "baseline": ref_stateful,
             "vs_baseline": ratio(ref_stateful, ours_scan),
         },
+        "acc_update_engine_pipelined": {
+            "value": ours.get("engine_pipelined"), "unit": "us/step", "baseline": ref_stateful,
+            "vs_baseline": ratio(ref_stateful, ours.get("engine_pipelined")),
+            "note": "config #1 loop through the streaming engine, fuse=1: prefetch +"
+                    " bounded async window, one dispatch per step (engine overhead floor)",
+        },
+        "acc_update_engine_fused": {
+            "value": ours.get("engine_fused"), "unit": "us/step", "baseline": ref_stateful,
+            "vs_baseline": ratio(ref_stateful, ours.get("engine_fused")),
+            "note": "config #1 loop through the streaming engine, fuse=8: 8 batches per"
+                    " lax.scan dispatch after AOT warmup; dispatch/warmup/compile-cache"
+                    " stats ride in the top-level `engine` key (recorded, never judged)",
+        },
         "collection_acc_f1_auroc_mesh_sync": {
             "value": ours_collection, "unit": "us/step", "baseline": ref_col,
             "vs_baseline": ratio(ref_col, ours_collection),
@@ -1273,6 +1365,10 @@ def main(check_regressions: bool = False) -> None:
         "configs": configs,
         "pallas_ab": pallas_ab,
         "obs": obs_summary,
+        # streaming-engine accounting (timed-run dispatch counts, fused chunk
+        # sizes, AOT-warmup compile totals, persistent-compile-cache hits):
+        # recorded in the JSON line and the history record, never judged
+        "engine": ours.get("engine_stats"),
         # peak host RSS (+ device HBM peak when the backend reports it), max
         # across this process and the workers; recorded in the history line,
         # never judged by the regression gate

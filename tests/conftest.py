@@ -17,6 +17,19 @@ from _jax_cpu_force import force_cpu  # noqa: E402
 
 force_cpu(8)
 
+# hermetic persistent compile cache: tier-1 runs exercise the engine's
+# persistent-cache code paths (TM_TPU_COMPILE_CACHE wiring, warmup manifests,
+# cache-hit accounting) against a throwaway directory instead of polluting —
+# or depending on — the developer's real cache. An externally-set value wins.
+if "TM_TPU_COMPILE_CACHE" not in os.environ:
+    import atexit  # noqa: E402
+    import shutil  # noqa: E402
+    import tempfile  # noqa: E402
+
+    _compile_cache_dir = tempfile.mkdtemp(prefix="tm_tpu_test_compile_cache_")
+    os.environ["TM_TPU_COMPILE_CACHE"] = _compile_cache_dir
+    atexit.register(shutil.rmtree, _compile_cache_dir, ignore_errors=True)
+
 import jax  # noqa: E402
 
 import numpy as np  # noqa: E402

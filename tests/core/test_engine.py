@@ -1,0 +1,696 @@
+"""Streaming evaluation engine suite (marker: ``engine``).
+
+Covers the ``torchmetrics_tpu.engine`` subsystem: fused scan chunks produce
+bit-identical state vs per-batch eager updates across metric families (incl.
+MaskedBuffer and ragged-list states), shape-bucket padding with masked tails,
+degrade-to-per-batch replay isolating injected poisoned batches, prefetch and
+in-flight bounds, AOT warmup + persistent-compile-cache wiring with manifest
+round-trip, the StaticLeafJit AOT compile/first-run split, and the
+disabled-path overhead smoke (engine imported but unused).
+
+Everything is CPU-deterministic and fast: tiny batches, no sleeps, no network.
+"""
+
+import json
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric, SumMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.core.jit import StaticLeafJit
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.engine import (
+    MetricPipeline,
+    PipelineConfig,
+    load_manifest,
+    persistent_cache_stats,
+    save_manifest,
+)
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.robust import faults
+
+pytestmark = pytest.mark.engine
+
+
+def _class_batches(n, batch=16, classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _value_batches(n, size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.rand(size).astype(np.float32)),) for _ in range(n)]
+
+
+def _pair_batches(n, size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(size).astype(np.float32)),
+            jnp.asarray(rng.rand(size).astype(np.float32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _assert_states_identical(reference: Metric, engine_driven: Metric):
+    for key in reference._defaults:
+        a, b = reference._state_values[key], engine_driven._state_values[key]
+        if isinstance(a, list):
+            assert len(a) == len(b)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        elif hasattr(a, "data") and hasattr(a, "count"):  # MaskedBuffer
+            np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+            np.testing.assert_array_equal(np.asarray(a.count), np.asarray(b.count))
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ----------------------------------------------------------- fusion bit-identity
+
+
+class TestFusionBitIdentical:
+    @pytest.mark.parametrize(
+        "maker, batches",
+        [
+            (lambda: MulticlassAccuracy(num_classes=5, validate_args=False), _class_batches(7)),
+            (lambda: MulticlassAUROC(num_classes=5, thresholds=20, validate_args=False), _class_batches(6, seed=3)),
+            (lambda: MeanSquaredError(), _pair_batches(9, seed=1)),
+            (lambda: MeanMetric(nan_strategy="ignore"), _value_batches(7, seed=2)),
+            (lambda: SumMetric(nan_strategy="ignore"), _value_batches(5, seed=4)),
+            (lambda: CatMetric(capacity=128, nan_strategy=0.0), _value_batches(6, seed=5)),  # MaskedBuffer state
+        ],
+        ids=["accuracy", "auroc_binned", "mse", "mean", "sum", "cat_masked_buffer"],
+    )
+    def test_fused_equals_per_batch(self, maker, batches):
+        reference, driven = maker(), maker()
+        for args in batches:
+            reference.update(*args)
+        pipe = MetricPipeline(driven, PipelineConfig(fuse=4))
+        report = pipe.run(batches)
+        _assert_states_identical(reference, driven)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+        assert driven._update_count == reference._update_count == len(batches)
+        assert driven.updates_ok == len(batches)
+        assert report.fused_batches == len(batches)
+        assert report.dispatches < len(batches)  # fusion actually fused
+
+    def test_ragged_list_state_degrades_to_eager_and_matches(self):
+        batches = _value_batches(6, seed=6)
+        reference, driven = CatMetric(), CatMetric()
+        for args in batches:
+            reference.update(*args)
+        report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(batches)
+        _assert_states_identical(reference, driven)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+        assert report.eager_batches == len(batches)
+        assert report.fused_batches == 0 and report.dispatches == 0
+
+    def test_fuse_1_is_per_batch_pipelining(self):
+        batches = _pair_batches(5)
+        reference, driven = MeanSquaredError(), MeanSquaredError()
+        for args in batches:
+            reference.update(*args)
+        report = MetricPipeline(driven, fuse=1).run(batches)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+        assert report.eager_batches == len(batches)
+        assert report.dispatches == 0
+
+    def test_single_array_and_dict_batches(self):
+        vals = [v[0] for v in _value_batches(4, seed=7)]
+        reference, driven = MeanMetric(), MeanMetric()
+        for v in vals:
+            reference.update(v)
+        MetricPipeline(driven, fuse=2).run(vals)  # bare arrays, not tuples
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+        reference2, driven2 = MeanMetric(), MeanMetric()
+        for v in vals:
+            reference2.update(value=v)
+        MetricPipeline(driven2, fuse=2).run([{"value": v} for v in vals])
+        np.testing.assert_array_equal(np.asarray(reference2.compute()), np.asarray(driven2.compute()))
+
+
+class TestCollections:
+    def test_fused_groups_identical_and_aliased(self):
+        batches = _class_batches(6, seed=8)
+
+        def build():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=5, validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=5, validate_args=False),
+                    "auroc": MulticlassAUROC(num_classes=5, thresholds=20, validate_args=False),
+                }
+            )
+
+        reference, driven = build(), build()
+        for args in batches:
+            reference.update(*args)
+        report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(batches)
+        ref_res, drv_res = reference.compute(), driven.compute()
+        assert sorted(ref_res) == sorted(drv_res)
+        for key in ref_res:
+            np.testing.assert_array_equal(np.asarray(ref_res[key]), np.asarray(drv_res[key]))
+        # acc and f1 share a stat-scores compute group: the member must alias the
+        # leader's state arrays after engine commits, exactly like update()
+        groups = [g for g in driven.compute_groups.values() if len(g) > 1]
+        assert groups, "expected acc/f1 to share a compute group"
+        leader, member = groups[0][0], groups[0][1]
+        for state in driven[leader]._defaults:
+            assert driven[member]._state_values[state] is driven[leader]._state_values[state]
+        # one fused dispatch advances BOTH group leaders
+        assert report.dispatches == 2  # 6 batches, fuse=4 -> chunks of 4 and 2
+        assert report.fused_batches == 6
+
+    def test_collection_with_unfusable_member(self):
+        batches = _value_batches(5, seed=9)
+
+        def build():
+            return MetricCollection({"mean": MeanMetric(nan_strategy="ignore"), "cat": CatMetric()})
+
+        reference, driven = build(), build()
+        for args in batches:
+            reference.update(*args)
+        report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(batches)
+        ref_res, drv_res = reference.compute(), driven.compute()
+        for key in ref_res:
+            np.testing.assert_array_equal(np.asarray(ref_res[key]), np.asarray(drv_res[key]))
+        # the list-state leader took per-batch updates; the fusable one fused
+        assert report.dispatches >= 1
+        assert driven["cat"]._update_count == len(batches)
+        assert driven["mean"]._update_count == len(batches)
+
+
+# ------------------------------------------------------- buckets, padding, shapes
+
+
+class TestBucketsAndPadding:
+    def test_default_buckets_are_powers_of_two(self):
+        assert PipelineConfig(fuse=8).buckets() == (1, 2, 4, 8)
+        assert PipelineConfig(fuse=6).buckets() == (1, 2, 4, 6)
+        assert PipelineConfig(fuse=1).buckets() == (1,)
+        assert PipelineConfig(fuse=8, fuse_buckets=(4, 8)).buckets() == (4, 8)
+
+    def test_partial_flush_pads_to_bucket_with_masked_tail(self):
+        batches = _class_batches(7, seed=10)  # fuse=4 -> chunks of 4 and 3 (pads to 4)
+        reference, driven = (
+            MulticlassAccuracy(num_classes=5, validate_args=False),
+            MulticlassAccuracy(num_classes=5, validate_args=False),
+        )
+        for args in batches:
+            reference.update(*args)
+        report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(batches)
+        assert report.padded_steps == 1
+        _assert_states_identical(reference, driven)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+
+    def test_masked_tail_on_masked_buffer_state(self):
+        # padding must not leak the repeated pad batch into a MaskedBuffer append
+        vals = _value_batches(3, seed=11)  # fuse=4 -> one padded chunk
+        reference, driven = (
+            CatMetric(capacity=64, nan_strategy=0.0),
+            CatMetric(capacity=64, nan_strategy=0.0),
+        )
+        for args in vals:
+            reference.update(*args)
+        report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(vals)
+        assert report.padded_steps == 1
+        assert int(driven.value.count) == int(reference.value.count)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+
+    def test_bucket_variants_stay_bounded(self):
+        # many distinct partial-chunk lengths must reuse the bucket programs
+        metric = MulticlassAccuracy(num_classes=5, validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=8))
+        batches = _class_batches(8, seed=12)
+        for n in (3, 5, 6, 7, 2, 1):  # six distinct flush lengths
+            for args in batches[:n]:
+                pipe.feed(*args)
+            pipe.flush()
+        fused = list(pipe._fused_fns.values())
+        assert len(fused) == 1
+        info = fused[0].cache_info()
+        # lengths bucket to {4, 8, 2, 1}: at most one compiled program per bucket
+        assert info["compiled_variants"] <= len(pipe.config.buckets())
+
+    def test_masked_buffer_overflow_detected_mid_stream(self):
+        # inside the fused scan the MaskedBuffer write clamps silently (counts
+        # are tracers); the engine must still surface the overflow with the
+        # same ~16-update detection bound as the per-batch dispatch, not at
+        # the end of the epoch
+        driven = CatMetric(capacity=8, nan_strategy=0.0)
+        pipe = MetricPipeline(driven, PipelineConfig(fuse=4))
+        with pytest.raises(ValueError, match="overflowed"):
+            pipe.run(_value_batches(20, size=8, seed=40))
+
+    def test_shape_change_flushes_and_stays_correct(self):
+        small = _class_batches(3, batch=8, seed=13)
+        large = _class_batches(3, batch=24, seed=14)
+        stream = [small[0], small[1], large[0], large[1], small[2], large[2]]
+        reference, driven = (
+            MulticlassAccuracy(num_classes=5, validate_args=False),
+            MulticlassAccuracy(num_classes=5, validate_args=False),
+        )
+        for args in stream:
+            reference.update(*args)
+        report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(stream)
+        assert report.shape_flushes >= 2  # signature changes forced early flushes
+        _assert_states_identical(reference, driven)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+
+
+# --------------------------------------------------------------- robust policies
+
+
+class TestRobustReplay:
+    def test_poisoned_batch_is_quarantined_not_the_chunk(self):
+        data = _pair_batches(8, seed=15)
+        clean = MeanSquaredError()
+        for i, args in enumerate(data):
+            if i != 5:
+                clean.update(*args)
+        driven = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(driven, PipelineConfig(fuse=4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[5]):
+                report = pipe.run(data)
+        # exactly the poisoned batch was isolated; its chunk-mates still landed
+        assert driven.updates_quarantined == 1
+        assert driven.updates_ok == len(data) - 1
+        assert len(driven.quarantined_batches) == 1
+        assert "non-finite" in driven.quarantined_batches[0]["reason"]
+        assert report.chunks_replayed == 1
+        assert report.replayed_batches == 4  # only the poisoned chunk replayed
+        assert report.fused_batches == 4  # the clean chunk still fused
+        np.testing.assert_array_equal(np.asarray(clean.compute()), np.asarray(driven.compute()))
+
+    def test_warn_skip_policy_skips_poisoned_batch(self):
+        data = _pair_batches(4, seed=16)
+        driven = MeanSquaredError(error_policy="warn_skip")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[2]):
+                MetricPipeline(driven, PipelineConfig(fuse=4)).run(data)
+        assert driven.updates_skipped == 1
+        assert driven.updates_ok == 3
+        assert driven.updates_quarantined == 0
+
+    def test_raise_policy_propagates_from_replay(self):
+        data = _pair_batches(4, seed=17)
+        driven = MeanSquaredError(error_policy="raise")
+        pipe = MetricPipeline(driven, PipelineConfig(fuse=4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[1]):
+                with pytest.raises(Exception, match="non-finite"):
+                    pipe.run(data)
+        # the batch before the poisoned one was committed by the replay
+        assert driven.updates_ok == 1
+
+    def test_no_policy_chunk_is_never_screened(self):
+        # unguarded default path: NaNs flow into state exactly like eager updates
+        data = _pair_batches(4, seed=18)
+        clean_style = MeanSquaredError()
+        driven = MeanSquaredError()
+        with faults.inject_nan_updates(indices=[1]):
+            # apply the same faulted stream to the eager reference
+            pipe_ref = MetricPipeline(clean_style, fuse=1)
+            pipe_ref.run(data)
+        with faults.inject_nan_updates(indices=[1]):
+            report = MetricPipeline(driven, PipelineConfig(fuse=4)).run(data)
+        assert report.chunks_replayed == 0
+        np.testing.assert_array_equal(np.asarray(clean_style.compute()), np.asarray(driven.compute()))
+
+    def test_degrade_event_recorded(self):
+        data = _pair_batches(4, seed=19)
+        driven = MeanSquaredError(error_policy="quarantine")
+        with trace.observe() as rec:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with faults.inject_nan_updates(indices=[0]):
+                    MetricPipeline(driven, PipelineConfig(fuse=4)).run(data)
+        degraded = [e for e in rec.events() if e["name"] == "engine.chunk_degraded"]
+        assert degraded and degraded[0]["attrs"]["reason"] == "nonfinite"
+        assert degraded[0]["attrs"]["steps"] == "0"
+        assert rec.counter_value("engine.chunks_replayed") == 1
+        assert rec.counter_value("engine.replayed_batches") == 4
+
+
+# --------------------------------------------------------- prefetch and in-flight
+
+
+class TestPrefetchInflight:
+    def test_prefetch_hits_for_steady_stream(self):
+        batches = _pair_batches(6, seed=20)
+        report = MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=2, prefetch=2)).run(batches)
+        # every batch after the first was device-put before its turn came
+        assert report.prefetch_misses == 1
+        assert report.prefetch_hits == len(batches) - 1
+
+    def test_feed_path_counts_no_prefetch(self):
+        pipe = MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=2))
+        for args in _pair_batches(4, seed=21):
+            pipe.feed(*args)
+        report = pipe.close()
+        assert report.prefetch_hits == 0 and report.prefetch_misses == 0
+        assert report.batches == 4
+
+    def test_in_flight_window_stays_bounded(self):
+        config = PipelineConfig(fuse=1, max_in_flight=2)
+        pipe = MetricPipeline(MeanSquaredError(), config)
+        for args in _pair_batches(8, seed=22):
+            pipe.feed(*args)
+            assert len(pipe._inflight) <= config.max_in_flight
+        report = pipe.close()
+        assert len(pipe._inflight) == 0
+        assert report.batches == 8
+
+    def test_inflight_gauge_and_counters(self):
+        with trace.observe() as rec:
+            MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=2, prefetch=2)).run(
+                _pair_batches(6, seed=23)
+            )
+        assert rec.counter_value("engine.batches") == 6
+        assert rec.counter_value("engine.prefetch_hit") == 5
+        assert rec.counter_value("engine.dispatches") == 3
+        gauges = {g["name"] for g in rec.snapshot()["gauges"]}
+        assert {"engine.queue_depth", "engine.fused_chunk_size", "engine.in_flight"} <= gauges
+
+
+# ---------------------------------------------------------------- dispatch counts
+
+
+class TestDispatchCounts:
+    def test_fused_engine_issues_fewer_host_dispatches_than_per_step(self):
+        """Acceptance: the fused engine path advances state with FEWER host
+        dispatches per step than the per-step baseline, asserted via obs
+        counters (the same accounting bench.py records)."""
+        batches = _class_batches(8, seed=24)
+        baseline = MulticlassAccuracy(num_classes=5, validate_args=False)
+        with trace.observe() as rec_base:
+            for args in batches:
+                baseline.update(*args)
+        baseline_dispatches = len(
+            [e for e in rec_base.events() if e["kind"] == "span" and e["name"] == "metric.update"]
+        )
+        assert baseline_dispatches == len(batches)
+
+        driven = MulticlassAccuracy(num_classes=5, validate_args=False)
+        pipe = MetricPipeline(driven, PipelineConfig(fuse=4))
+        pipe.warmup(*batches[0])
+        with trace.observe() as rec_engine:
+            pipe.run(batches)
+        engine_dispatches = rec_engine.counter_value("engine.dispatches")
+        assert engine_dispatches == 2
+        assert engine_dispatches < baseline_dispatches
+        np.testing.assert_array_equal(np.asarray(baseline.compute()), np.asarray(driven.compute()))
+
+
+# -------------------------------------------------------------- warmup and cache
+
+
+class TestWarmup:
+    def test_warmup_precompiles_every_bucket_no_compiles_in_loop(self):
+        batches = _class_batches(7, seed=25)
+        metric = MulticlassAccuracy(num_classes=5, validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=4))
+        manifest = pipe.warmup(*batches[0])
+        fused_entries = [e for e in manifest["entries"] if e["kind"] == "fused"]
+        assert [e["bucket"] for e in fused_entries] == [1, 2, 4]
+        assert all(e["fresh"] for e in manifest["entries"])
+        assert manifest["total_compile_seconds"] > 0
+        with trace.observe() as rec:
+            pipe.run(batches)
+        compile_spans = [e for e in rec.events() if e["name"] == "jit.compile"]
+        assert compile_spans == []  # the hot loop never compiled anything
+        assert rec.counter_value("jit.cache_miss") == 0
+
+    def test_warmup_accepts_abstract_specs(self):
+        metric = MeanSquaredError()
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2))
+        spec = jax.ShapeDtypeStruct((8,), np.float32)
+        manifest = pipe.warmup(spec, spec)
+        assert manifest["fresh_compiles"] == manifest["variants"] > 0
+        with trace.observe() as rec:
+            pipe.run(_pair_batches(4, seed=26))
+        assert rec.counter_value("jit.cache_miss") == 0
+
+    def test_repeat_warmup_is_free(self):
+        metric = MeanSquaredError()
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2))
+        args = _pair_batches(1, seed=27)[0]
+        first = pipe.warmup(*args)
+        second = pipe.warmup(*args)
+        assert first["fresh_compiles"] > 0
+        assert second["fresh_compiles"] == 0
+        assert second["total_compile_seconds"] == 0
+
+    def test_manifest_round_trip(self, tmp_path):
+        metric = MeanSquaredError()
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2))
+        path = str(tmp_path / "warmup_manifest.json")
+        manifest = pipe.warmup(*_pair_batches(1, seed=28)[0], manifest_path=path)
+        loaded = load_manifest(path)
+        assert loaded == json.loads(json.dumps(manifest))  # JSON-faithful round-trip
+        assert loaded["schema_version"] == 1
+        assert loaded["variants"] == len(loaded["entries"])
+        # re-save and corrupt-schema detection
+        loaded["schema_version"] = 99
+        save_manifest(loaded, path)
+        with pytest.raises(ValueError, match="not a warmup manifest"):
+            load_manifest(path)
+
+    def test_persistent_cache_populated_and_hit(self):
+        """The hermetic TM_TPU_COMPILE_CACHE dir (tests/conftest.py) must receive
+        entries from a warmup, and a *fresh* pipeline compiling the same programs
+        must hit the disk cache — the restart story, inside one process."""
+        batches = _class_batches(2, batch=12, classes=3, seed=29)
+
+        def build():
+            m = MulticlassAccuracy(num_classes=3, validate_args=False)
+            return MetricPipeline(m, PipelineConfig(fuse=2))
+
+        first = build()
+        first.warmup(*batches[0])
+        stats = persistent_cache_stats()
+        assert stats["dir"] is not None  # conftest wired the env var
+        assert stats["entries"] > 0  # warmup compiles landed on disk
+        before_hits = stats["hits"]
+        second = build()  # fresh StaticLeafJit instances: XLA must recompile...
+        second.warmup(*batches[0])
+        after = persistent_cache_stats()
+        assert after["hits"] > before_hits  # ...and recompiles hit the disk cache
+
+    def test_manifest_records_cache_dir(self):
+        pipe = MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=2))
+        manifest = pipe.warmup(*_pair_batches(1, seed=30)[0])
+        assert manifest["cache_dir"] == persistent_cache_stats()["dir"]
+
+
+# --------------------------------------------------------- StaticLeafJit AOT API
+
+
+class TestStaticLeafJitAOT:
+    def test_compile_and_first_run_get_distinct_spans(self):
+        sl = StaticLeafJit(lambda state, x: state + x)
+        with trace.observe() as rec:
+            sl(jnp.zeros(3), jnp.ones(3))
+            sl(jnp.zeros(3), jnp.ones(3))
+        compile_spans = [e for e in rec.events() if e["name"] == "jit.compile"]
+        first_runs = [e for e in rec.events() if e["name"] == "jit.first_run"]
+        assert len(compile_spans) == 1 and len(first_runs) == 1
+        assert rec.counter_value("jit.cache_miss") == 1
+        assert rec.counter_value("jit.cache_hit") == 1
+
+    def test_shape_change_is_a_counted_miss(self):
+        # the pre-AOT dispatcher silently recompiled on a shape change; now it
+        # is a counted miss with its own compile span
+        sl = StaticLeafJit(lambda state, x: state + x.sum())
+        with trace.observe() as rec:
+            sl(jnp.zeros(()), jnp.ones(4))
+            sl(jnp.zeros(()), jnp.ones(8))
+        assert rec.counter_value("jit.cache_miss") == 2
+        assert len([e for e in rec.events() if e["name"] == "jit.compile"]) == 2
+
+    def test_warmup_then_call_is_pure_hit(self):
+        sl = StaticLeafJit(lambda state, x: state + x)
+        info = sl.warmup(
+            jax.ShapeDtypeStruct((3,), np.float32), jax.ShapeDtypeStruct((3,), np.float32)
+        )
+        assert info["fresh"] and info["seconds"] > 0
+        with trace.observe() as rec:
+            out = sl(jnp.zeros(3, dtype=jnp.float32), jnp.ones(3, dtype=jnp.float32))
+        np.testing.assert_array_equal(np.asarray(out), np.ones(3, dtype=np.float32))
+        assert rec.counter_value("jit.cache_miss") == 0
+        assert rec.counter_value("jit.cache_hit") == 1
+        assert sl.warmup(
+            jax.ShapeDtypeStruct((3,), np.float32), jax.ShapeDtypeStruct((3,), np.float32)
+        ) == {"fresh": False, "seconds": 0.0, "fn": info["fn"]}
+
+    def test_cache_info_accounting(self):
+        sl = StaticLeafJit(lambda state, x, k: state + x * k)
+        sl(jnp.zeros(3), jnp.ones(3), 2)
+        sl(jnp.zeros(3), jnp.ones(3), 2)
+        sl(jnp.zeros(3), jnp.ones(3), 3)
+        info = sl.cache_info()
+        assert info["static_variants"] == 2
+        assert info["compiled_variants"] == 2
+        assert info["hits"] == 1 and info["misses"] == 2
+
+    def test_warmup_rejects_unhashable_statics(self):
+        sl = StaticLeafJit(lambda state, x, opts: state + x)
+        with pytest.raises(TypeError, match="unhashable"):
+            sl.warmup(jnp.zeros(3), jax.ShapeDtypeStruct((3,), np.float32), type("U", (), {"__hash__": None})())
+
+
+# --------------------------------------------------- compute_on_cpu regression
+
+
+class _JitListMetric(Metric):
+    """List-state metric with jit forced ON: exercises the fused/jitted append
+    path whose items must still land as host numpy under compute_on_cpu."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(jit_update=True, compute_on_cpu=True, **kwargs)
+        self.add_state("items", default=[], dist_reduce_fx="cat")
+
+    def update(self, value):
+        self.items = self.items + [2.0 * value]
+
+    def compute(self):
+        return jnp.concatenate([jnp.asarray(v) for v in self.items]).sum()
+
+
+class TestComputeOnCpuListStates:
+    def test_engine_driven_list_states_land_as_host_numpy(self):
+        vals = _value_batches(5, seed=31)
+        driven = CatMetric(compute_on_cpu=True)
+        MetricPipeline(driven, PipelineConfig(fuse=4)).run(vals)
+        assert len(driven.value) == 5
+        assert all(isinstance(item, np.ndarray) for item in driven.value)
+        reference = CatMetric(compute_on_cpu=True)
+        for args in vals:
+            reference.update(*args)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+
+    def test_forced_jit_list_append_lands_as_host_numpy(self):
+        # regression for the jit-dispatch branch: appended items used to stay
+        # device arrays, ignoring compute_on_cpu
+        m = _JitListMetric()
+        m.update(jnp.ones(4))
+        m.update(jnp.ones(4))
+        assert len(m.items) == 2
+        assert all(isinstance(item, np.ndarray) for item in m.items)
+        np.testing.assert_allclose(np.asarray(m.compute()), 16.0)
+
+
+# ------------------------------------------------------------- disabled overhead
+
+
+class TestDisabledOverhead:
+    def test_engine_imported_but_unused_keeps_dispatch_within_noise(self):
+        """Extends the obs disabled-path smoke: with the engine modules imported
+        but no pipeline constructed, the plain metric dispatch path must stay
+        within noise of the seed-equivalent inner body (same 2x shared-host
+        bound as tests/core/test_observability.py)."""
+        import torchmetrics_tpu.engine  # noqa: F401  (imported-but-unused is the point)
+        import torchmetrics_tpu.engine.pipeline  # noqa: F401
+        import torchmetrics_tpu.engine.warmup  # noqa: F401
+        from torchmetrics_tpu.utils.checks import measure_runtime
+
+        assert not trace.is_enabled()
+        m = MeanSquaredError()
+        x, y = jnp.ones(64), jnp.zeros(64)
+        m.update(x, y)
+
+        def instrumented():
+            for _ in range(200):
+                m._dispatch_update(x, y)
+
+        def seed_equivalent():
+            for _ in range(200):
+                m._dispatch_update_inner(x, y)
+
+        t_inner = measure_runtime(seed_equivalent, reps=5, warmup=1)
+        t_instr = measure_runtime(instrumented, reps=5, warmup=1)
+        assert t_instr < t_inner * 2.0 + 0.05, (
+            f"engine-imported dispatch {t_instr:.4f}s vs seed-equivalent {t_inner:.4f}s"
+        )
+        assert trace.get_recorder().events() == []
+
+
+# ------------------------------------------------------------------ misc plumbing
+
+
+class TestPlumbing:
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="fuse"):
+            PipelineConfig(fuse=0)
+        with pytest.raises(ValueError, match="max_in_flight"):
+            PipelineConfig(max_in_flight=0)
+        with pytest.raises(ValueError, match="prefetch"):
+            PipelineConfig(prefetch=-1)
+        with pytest.raises(ValueError, match="fuse_buckets"):
+            PipelineConfig(fuse_buckets=(0, 2))
+        with pytest.raises(ValueError, match="Metric or MetricCollection"):
+            MetricPipeline(object())  # type: ignore[arg-type]
+
+    def test_context_manager_flushes(self):
+        reference = MeanSquaredError()
+        data = _pair_batches(3, seed=32)
+        for args in data:
+            reference.update(*args)
+        driven = MeanSquaredError()
+        with MetricPipeline(driven, PipelineConfig(fuse=4)) as pipe:
+            for args in data:
+                pipe.feed(*args)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(driven.compute()))
+
+    def test_pipeline_compute_flushes(self):
+        reference = MeanSquaredError()
+        data = _pair_batches(3, seed=33)
+        for args in data:
+            reference.update(*args)
+        pipe = MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=4))
+        for args in data:
+            pipe.feed(*args)
+        np.testing.assert_array_equal(np.asarray(reference.compute()), np.asarray(pipe.compute()))
+
+    def test_report_is_a_snapshot(self):
+        pipe = MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=2))
+        snap = pipe.report()
+        pipe.run(_pair_batches(2, seed=34))
+        assert snap.batches == 0
+        assert pipe.report().batches == 2
+        d = pipe.report().asdict()
+        assert d["host_dispatches"] == d["dispatches"] + d["eager_dispatches"]
+
+    def test_regress_record_carries_engine_stats(self):
+        from torchmetrics_tpu.obs import regress
+
+        record = regress.run_record(
+            {"configs": {}, "hardware": "cpu", "engine": {"fused": {"timed_run": {"dispatches": 15}}}}
+        )
+        assert record["engine"] == {"fused": {"timed_run": {"dispatches": 15}}}
+        # and the sentinel never judges it
+        assert regress.check_regressions(record, [record]) == []

@@ -377,3 +377,27 @@ class TestListStateGrowthGuard:
         m.update(jnp.ones(3))
         with pytest.warns(RuntimeWarning, match="ragged list-state"):
             m.update(jnp.ones(3))
+
+    def test_engine_driven_compute_on_cpu_lists_land_as_numpy_and_guarded(self):
+        """Regression (engine fused path): list items appended while a metric is
+        driven through the streaming engine must land as HOST numpy under
+        compute_on_cpu — and the growth gauge/guard must keep seeing them."""
+        from torchmetrics_tpu.engine import MetricPipeline, PipelineConfig
+
+        m = ListState(compute_on_cpu=True)
+        with trace.observe() as rec:
+            MetricPipeline(m, PipelineConfig(fuse=2)).run([(jnp.ones(3),) for _ in range(4)])
+        assert len(m.items) == 4
+        assert all(isinstance(item, np.ndarray) for item in m.items)
+        by_name = {g["name"]: g for g in rec.snapshot()["gauges"]}
+        assert by_name["state.list_items"]["value"] == 4
+
+    def test_forced_jit_compute_on_cpu_lists_land_as_numpy(self):
+        """Regression (jit dispatch branch): with ``jit_update=True`` forced on a
+        list-state metric, appended items came back as device arrays and
+        compute_on_cpu was silently ignored — they must be host numpy."""
+        m = ListState(compute_on_cpu=True, jit_update=True)
+        m.update(jnp.ones(3))
+        m.update(jnp.ones(3))
+        assert len(m.items) == 2
+        assert all(isinstance(item, np.ndarray) for item in m.items)

@@ -511,6 +511,34 @@ class MetricCollection:
             m.to_device(device)
         return self
 
+    # ------------------------------------------------------------- engine integration
+
+    def _engine_fusable_leaders(self) -> Tuple[List[str], List[str]]:
+        """Partition compute-group leaders for the streaming engine
+        (``torchmetrics_tpu.engine``): fusable leaders ride the fused ``lax.scan``
+        chunk (one dispatch advances them all), the rest take per-batch updates.
+        Members alias their leader's state either way, exactly as in
+        :meth:`update`."""
+        fused, eager = [], []
+        for members in self._groups.values():
+            name = members[0]
+            (fused if self._modules[name]._engine_fusable() else eager).append(name)
+        return fused, eager
+
+    def _engine_commit(self, new_states: Dict[str, Dict[str, Any]], n_batches: int) -> None:
+        """Install fused-chunk results for the given leaders and re-alias members.
+
+        Mirrors what ``n_batches`` :meth:`update` calls would have done: every
+        metric's compute cache is invalidated (group members never updated
+        directly would otherwise serve stale values) and member states re-point
+        at their leader's fresh arrays.
+        """
+        for name, state in new_states.items():
+            self._modules[name]._engine_commit_state(state, n_batches)
+        for m in self._modules.values():
+            m._computed = None
+        self._sync_group_states()
+
     # -------------------------------------------------------------- memory accounting
 
     def _memory_children(self) -> List[Tuple[str, Metric]]:

@@ -6,6 +6,16 @@ through a cached :func:`jax.jit` of the *pure* state transition. Python-scalar a
 compiled variant — while array arguments are traced. This mirrors how XLA wants metric
 hot loops expressed: one compiled program per configuration, re-used across steps.
 
+Compilation is **ahead-of-time** on the miss path: a fresh (static-config, input-aval)
+variant is ``jit(...).lower(...).compile()``d first and only then executed, so the XLA
+compile and the first execution are separate costs (distinct ``jit.compile`` /
+``jit.first_run`` telemetry spans) and the streaming engine
+(:mod:`torchmetrics_tpu.engine`) can precompile every variant *before* the hot loop via
+:meth:`StaticLeafJit.warmup` — abstract ``jax.ShapeDtypeStruct`` leaves are accepted in
+place of real batches. With JAX's persistent compilation cache configured
+(``engine.warmup.configure_compile_cache`` / ``TM_TPU_COMPILE_CACHE``), those AOT
+compiles become disk-cache hits across process restarts.
+
 Dispatch telemetry (``torchmetrics_tpu.obs``, off by default): cache hits/misses,
 a compile-time span on every miss, a per-function cache-size gauge, and eager-
 fallback events, so hot loops that recompile per step — or never hit the jit
@@ -15,7 +25,8 @@ cache at all — are visible instead of silently slow.
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Dict, Tuple
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -25,8 +36,16 @@ from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 
 def _is_traced_leaf(x: Any) -> bool:
-    """Leaves traced as arrays: jax/numpy arrays (python scalars stay static)."""
-    return isinstance(x, (jax.Array, np.ndarray)) or hasattr(x, "__jax_array__") or isinstance(x, jax.core.Tracer)
+    """Leaves traced as arrays: jax/numpy arrays (python scalars stay static).
+
+    ``jax.ShapeDtypeStruct`` counts as traced so abstract batch specs can drive
+    the AOT warmup path through the same partitioning as real calls.
+    """
+    return (
+        isinstance(x, (jax.Array, np.ndarray, jax.ShapeDtypeStruct))
+        or hasattr(x, "__jax_array__")
+        or isinstance(x, jax.core.Tracer)
+    )
 
 
 class _ArraySlot:
@@ -46,6 +65,10 @@ class _ArraySlot:
 
 _SLOT = _ArraySlot()
 
+# sentinel memoizing "AOT unavailable for this signature": later calls go straight
+# to the generic jit wrapper instead of re-tracing + re-failing the compile
+_AOT_UNAVAILABLE = object()
+
 
 def _hashable(x: Any) -> bool:
     try:
@@ -53,6 +76,28 @@ def _hashable(x: Any) -> bool:
         return True
     except TypeError:
         return False
+
+
+def partition_static_leaves(leaves) -> Tuple[list, list, Any]:
+    """Split flattened leaves into (traced, template, first_unhashable_static).
+
+    The single implementation of the traced-vs-static partition rule shared by
+    the dispatcher, its warmup, and the streaming engine's chunk signatures:
+    array(-like) and ``ShapeDtypeStruct`` leaves are traced (``_SLOT`` in the
+    template), everything else is a static template entry. The first unhashable
+    static encountered is returned (partition incomplete) — callers decide
+    whether that means eager fallback, an error, or a per-batch dispatch.
+    """
+    traced, template = [], []
+    for leaf in leaves:
+        if _is_traced_leaf(leaf):
+            traced.append(leaf)
+            template.append(_SLOT)
+        else:
+            if not _hashable(leaf):
+                return traced, template, leaf
+            template.append(leaf)
+    return traced, template, None
 
 
 def _fn_label(fn: Callable) -> str:
@@ -63,16 +108,42 @@ def _fn_label(fn: Callable) -> str:
     return getattr(fn, "__qualname__", None) or getattr(fn, "__name__", None) or repr(fn)
 
 
+def _aval_signature(leaves) -> Tuple[tuple, ...]:
+    """Hashable (shape, dtype, weak_type) triple per leaf — the AOT executable key.
+
+    An AOT-compiled executable is specialized to exact input avals (unlike the
+    ``jax.jit`` wrapper, which re-specializes internally), so the compiled-variant
+    cache must key on them.
+    """
+    sig = []
+    for leaf in leaves:
+        aval = getattr(leaf, "aval", None)
+        if aval is not None:
+            sig.append((tuple(aval.shape), str(aval.dtype), bool(getattr(aval, "weak_type", False))))
+        elif isinstance(leaf, jax.ShapeDtypeStruct):
+            sig.append((tuple(leaf.shape), str(np.dtype(leaf.dtype)), False))
+        else:
+            arr = np.asarray(leaf)
+            sig.append((tuple(arr.shape), str(arr.dtype), False))
+    return tuple(sig)
+
+
 class StaticLeafJit:
     """``jit`` wrapper that partitions (args, kwargs) leaves into traced arrays and
     static Python values, caching one compiled program per static configuration.
 
     ``fn`` must have signature ``fn(state, *args, **kwargs) -> state_or_value`` where
     ``state`` is a pytree of arrays (always traced).
+
+    Compiled variants are AOT executables keyed by (static template, input avals);
+    :meth:`warmup` precompiles a variant from abstract specs without running it, and
+    :meth:`cache_info` reports variant/hit/miss totals for warmup manifests and bench
+    dispatch accounting.
     """
 
     # one loud warning once a single wrapper holds this many compiled variants —
-    # a recompile storm (per-step-varying static leaf) otherwise goes unnoticed
+    # a recompile storm (per-step-varying static leaf OR unbounded input-shape
+    # churn) otherwise goes unnoticed
     recompile_warn_threshold: int = 32
 
     # per-process ordinal distinguishing wrapper instances that share a label
@@ -82,11 +153,15 @@ class StaticLeafJit:
     def __init__(self, fn: Callable, donate_state: bool = False):
         self._fn = fn
         self._donate = donate_state
-        self._cache: Dict[Any, Callable] = {}
+        self._cache: Dict[Any, Callable] = {}  # static key -> jax.jit wrapper
+        self._compiled: Dict[Any, Any] = {}  # (static key, aval sig) -> AOT executable
         self._label = _fn_label(fn)
         self._instance = str(next(StaticLeafJit._instance_seq))
+        self._hits = 0
+        self._misses = 0
         self._warned_unhashable = False
         self._warned_recompile_storm = False
+        self._warned_aot_unavailable = False
 
     def _eager_fallback(self, leaf: Any, state: Any, args: tuple, kwargs: dict) -> Any:
         """Unhashable static leaf: eager dispatch, re-taken on EVERY call — warn
@@ -112,7 +187,8 @@ class StaticLeafJit:
     def _check_recompile_storm(self) -> None:
         """One loud warning when the per-static-config cache grows past the
         threshold, naming the static leaf positions whose churn caused it."""
-        if self._warned_recompile_storm or len(self._cache) <= self.recompile_warn_threshold:
+        variants = max(len(self._cache), len(self._compiled))
+        if self._warned_recompile_storm or variants <= self.recompile_warn_threshold:
             return
         self._warned_recompile_storm = True
         # positions are only comparable within one argument structure: group
@@ -130,37 +206,33 @@ class StaticLeafJit:
             if len(values) > 1:
                 sample = ", ".join(repr(v) for v in list(values)[:4])
                 offenders.append(f"leaf {position}: {len(values)} distinct values (e.g. {sample})")
+        if len(self._compiled) > len(self._cache):
+            # more compiled executables than static configs: the extra variants
+            # come from input-shape churn (e.g. an unbucketed batch stream)
+            shapes = {sig for (_, sig) in self._compiled}
+            offenders.append(f"{len(shapes)} distinct input-shape signatures")
         detail = "; ".join(offenders) if offenders else "argument structure varies across calls"
         rank_zero_warn(
-            f"{self._label} has compiled {len(self._cache)} variants (threshold"
-            f" {self.recompile_warn_threshold}) — a static leaf is changing every call, so"
-            f" each step pays a fresh XLA compile. Offending static leaves: {detail}."
-            " Make the varying argument an array (traced) or pin it to a fixed value.",
+            f"{self._label} has compiled {variants} variants (threshold"
+            f" {self.recompile_warn_threshold}) — a static leaf or input shape is changing"
+            " across calls, so steps keep paying fresh XLA compiles. Offending leaves:"
+            f" {detail}. Make the varying argument an array (traced), pin it to a fixed"
+            " value, or bucket input shapes (the streaming engine's shape buckets do"
+            " this for batch streams).",
             RuntimeWarning,
         )
         if _trace.ENABLED:
             _trace.event(
-                "jit.recompile_storm", fn=self._label, cache_size=len(self._cache), detail=detail
+                "jit.recompile_storm", fn=self._label, cache_size=variants, detail=detail
             )
 
-    def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
-        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        traced, template = [], []
-        for leaf in leaves:
-            if _is_traced_leaf(leaf):
-                traced.append(leaf)
-                template.append(_SLOT)
-            else:
-                if not _hashable(leaf):
-                    # unhashable static (e.g. list of strings) -> eager fallback
-                    return self._eager_fallback(leaf, state, args, kwargs)
-                template.append(leaf)
-        key = (treedef, tuple(template))
+    def _get_jitted(self, key: Any, treedef: Any, template: tuple) -> Callable:
+        """The generic ``jax.jit`` wrapper for one static configuration."""
         jitted = self._cache.get(key)
         if jitted is None:
-            fn, tmpl = self._fn, tuple(template)
+            fn = self._fn
 
-            def run(state, traced_leaves, _treedef=treedef, _tmpl=tmpl):
+            def run(state, traced_leaves, _treedef=treedef, _tmpl=template):
                 it = iter(traced_leaves)
                 full = [next(it) if isinstance(t, _ArraySlot) else t for t in _tmpl]
                 r_args, r_kwargs = jax.tree_util.tree_unflatten(_treedef, full)
@@ -168,21 +240,153 @@ class StaticLeafJit:
 
             jitted = jax.jit(run, donate_argnums=(0,) if self._donate else ())
             self._cache[key] = jitted
+            # every fresh static variant feeds the storm guard, whichever path
+            # inserted it (AOT miss, tracer inlining, AOT-unavailable fallback)
             self._check_recompile_storm()
-            if _trace.ENABLED:
-                _trace.inc("jit.cache_miss", fn=self._label)
-                # gauge is last-write-wins, so it needs the per-instance label:
-                # two same-class metrics would otherwise overwrite each other
-                # and understate the compiled-variant total the misses report
-                _trace.set_gauge("jit.cache_size", len(self._cache), fn=self._label, inst=self._instance)
-                # first dispatch of a fresh variant = trace + XLA compile (+ one
-                # run): the span is the per-static-key compile cost
-                with _trace.span("jit.compile", fn=self._label, cache_size=len(self._cache)):
-                    return jitted(state, traced)
-            return jitted(state, traced)
+        return jitted
+
+    def _aot_compile(self, jitted: Callable, state: Any, traced: list, reraise: bool = False):
+        """AOT ``lower + compile`` of one variant; ``None`` when AOT is unavailable.
+
+        Errors raised while *tracing* (``lower``) come from the wrapped function
+        itself — input validation, shape errors — and propagate exactly as the
+        on-demand dispatch would have raised them. Only a failing ``compile``
+        falls back to the generic jit dispatch (which compiles on first
+        execution instead), losing just the compile/first-run span separation.
+        """
+
+        def _lower_and_compile():
+            lowered = jitted.lower(state, traced)  # tracing errors are the caller's, propagate
+            try:
+                return lowered.compile()
+            except Exception as err:
+                if reraise:
+                    raise
+                if not self._warned_aot_unavailable:
+                    self._warned_aot_unavailable = True
+                    rank_zero_warn(
+                        f"{self._label}: ahead-of-time compilation failed ({type(err).__name__}:"
+                        f" {err}); falling back to on-demand jit compilation for this function."
+                        " Dispatch still works — compile time is just folded into the first run.",
+                        RuntimeWarning,
+                    )
+                if _trace.ENABLED:
+                    _trace.event(
+                        "jit.aot_unavailable", fn=self._label, error=f"{type(err).__name__}: {err}"
+                    )
+                return None
+
         if _trace.ENABLED:
-            _trace.inc("jit.cache_hit", fn=self._label)
-        return jitted(state, traced)
+            with _trace.span("jit.compile", fn=self._label, cache_size=len(self._compiled) + 1):
+                return _lower_and_compile()
+        return _lower_and_compile()
+
+    def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template, unhashable = partition_static_leaves(leaves)
+        if unhashable is not None:
+            # unhashable static (e.g. list of strings) -> eager fallback
+            return self._eager_fallback(unhashable, state, args, kwargs)
+        has_tracer = any(isinstance(leaf, jax.core.Tracer) for leaf in traced)
+        key = (treedef, tuple(template))
+        state_leaves = jax.tree_util.tree_leaves(state)
+        if has_tracer or any(isinstance(x, jax.core.Tracer) for x in state_leaves):
+            # inside an outer transformation (grad/vmap/jit): an AOT executable
+            # cannot be applied to tracers — the generic jit wrapper inlines
+            # into the enclosing trace instead, exactly like the pre-AOT path
+            fresh = key not in self._cache
+            jitted = self._get_jitted(key, treedef, tuple(template))
+            if fresh:
+                self._misses += 1
+                if _trace.ENABLED:
+                    _trace.inc("jit.cache_miss", fn=self._label)
+            else:
+                self._hits += 1
+                if _trace.ENABLED:
+                    _trace.inc("jit.cache_hit", fn=self._label)
+            return jitted(state, traced)
+        csig = (key, _aval_signature(state_leaves) + _aval_signature(traced))
+        compiled = self._compiled.get(csig)
+        if compiled is not None:
+            self._hits += 1
+            if _trace.ENABLED:
+                _trace.inc("jit.cache_hit", fn=self._label)
+            if compiled is _AOT_UNAVAILABLE:
+                # memoized "AOT cannot compile this signature": the generic jit
+                # wrapper (already compiled on demand at first use) dispatches
+                return self._get_jitted(key, treedef, tuple(template))(state, traced)
+            try:
+                return compiled(state, traced)
+            except Exception:
+                # input layout/sharding drifted from what the executable was
+                # specialized to (e.g. the state moved devices): drop the stale
+                # specialization and let the generic jit dispatch handle it — a
+                # genuine execution error re-raises identically from there
+                self._compiled.pop(csig, None)
+                return self._get_jitted(key, treedef, tuple(template))(state, traced)
+        self._misses += 1
+        jitted = self._get_jitted(key, treedef, tuple(template))  # before the gauge: it reports post-insert size
+        if _trace.ENABLED:
+            _trace.inc("jit.cache_miss", fn=self._label)
+            # gauge is last-write-wins, so it needs the per-instance label:
+            # two same-class metrics would otherwise overwrite each other
+            # and understate the compiled-variant total the misses report
+            _trace.set_gauge("jit.cache_size", len(self._cache), fn=self._label, inst=self._instance)
+        compiled = self._aot_compile(jitted, state, traced)
+        if compiled is None:
+            # memoize the unavailability: later same-signature calls must not
+            # re-trace + re-fail the compile on every step
+            self._compiled[csig] = _AOT_UNAVAILABLE
+            return jitted(state, traced)  # on-demand path: compile folds into this call
+        self._compiled[csig] = compiled
+        self._check_recompile_storm()
+        if _trace.ENABLED:
+            with _trace.span("jit.first_run", fn=self._label):
+                return compiled(state, traced)
+        return compiled(state, traced)
+
+    # ------------------------------------------------------------------ warmup / info
+
+    def warmup(self, state: Any, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """AOT-compile the variant selected by ``(state, args, kwargs)`` without
+        running it.
+
+        Array leaves may be real arrays or abstract ``jax.ShapeDtypeStruct`` specs
+        (``state`` likewise). Returns ``{"fresh": bool, "seconds": float}`` —
+        ``fresh=False`` means the variant was already compiled (zero cost). Raises
+        on unhashable statics or a genuinely failing compile: a warmup pass must
+        surface problems, not defer them to the hot loop.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template, unhashable = partition_static_leaves(leaves)
+        if unhashable is not None:
+            raise TypeError(
+                f"{self._label}.warmup received an unhashable static argument of type"
+                f" {type(unhashable).__name__}; such calls dispatch eagerly and cannot be"
+                " precompiled."
+            )
+        key = (treedef, tuple(template))
+        csig = (key, _aval_signature(jax.tree_util.tree_leaves(state)) + _aval_signature(traced))
+        if csig in self._compiled:
+            return {"fresh": False, "seconds": 0.0, "fn": self._label}
+        jitted = self._get_jitted(key, treedef, tuple(template))
+        start = time.perf_counter()
+        self._compiled[csig] = self._aot_compile(jitted, state, traced, reraise=True)
+        self._check_recompile_storm()
+        return {"fresh": True, "seconds": time.perf_counter() - start, "fn": self._label}
+
+    def cache_info(self) -> Dict[str, Any]:
+        """Dispatch-cache accounting: static variants, compiled executables, hit/miss
+        totals since construction. Plain ints — available without obs tracing."""
+        return {
+            "fn": self._label,
+            "static_variants": len(self._cache),
+            "compiled_variants": sum(
+                1 for v in self._compiled.values() if v is not _AOT_UNAVAILABLE
+            ),
+            "hits": self._hits,
+            "misses": self._misses,
+        }
 
 
 def jit_with_static_leaves(fn: Callable, donate_state: bool = False) -> StaticLeafJit:

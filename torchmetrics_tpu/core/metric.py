@@ -156,6 +156,9 @@ class Metric(ABC):
         self._custom_fx: Dict[str, Callable] = {}
         self._persistent: Dict[str, bool] = {}
         self._state_values: Dict[str, Any] = {}
+        # kept in lockstep with _defaults so the hot dispatch path can branch on
+        # "any ragged list state?" without walking the registry every update
+        self._has_list_defaults = False
 
         # lifecycle
         self._update_count = 0
@@ -225,6 +228,7 @@ class Metric(ABC):
         # keep defaults on host so reset never aliases device buffers
         if is_list:
             self._defaults[name] = []
+            self._has_list_defaults = True
         elif is_buffer:
             self._defaults[name] = ("__masked_buffer__", default.capacity, default.data.shape[1:], default.data.dtype)
         else:
@@ -270,6 +274,7 @@ class Metric(ABC):
             del d["_state_values"][name]
             del d["_defaults"][name]
             del d["_reductions"][name]
+            d["_has_list_defaults"] = any(isinstance(v, list) for v in d["_defaults"].values())
             return
         object.__delattr__(self, name)
 
@@ -550,6 +555,13 @@ class Metric(ABC):
             if self._update_count % self._buffer_overflow_check_every == 0:
                 self._check_buffer_overflow()
             self._state_values = self._jitted_update(dict(self._state_values), *args, **kwargs)
+            if self._has_list_defaults:
+                # jit_update was forced on a list-state metric: the appended
+                # items came back as device arrays — compute_on_cpu still means
+                # host numpy, and the growth guard still applies
+                if self.compute_on_cpu:
+                    self._move_list_states_to_cpu()
+                self._check_list_state_growth()
         else:
             with jax.named_scope(f"{type(self).__name__}.update"):
                 self._update_impl(*args, **kwargs)
@@ -627,6 +639,47 @@ class Metric(ABC):
                 " (`obs.memory.footprint(metric)` shows the accumulated bytes).",
                 RuntimeWarning,
             )
+
+    # ------------------------------------------------------------- engine integration
+
+    def _engine_fusable(self) -> bool:
+        """Whether the streaming engine may fold this metric's updates through a
+        fused ``lax.scan`` chunk (``torchmetrics_tpu.engine``): the update must be
+        jittable and the state free of ragged lists (a scan carry needs a fixed
+        pytree structure across steps)."""
+        return self._jit_enabled() and not self._has_list_defaults
+
+    def _engine_commit_state(self, state: Dict[str, Any], n_batches: int) -> None:
+        """Install a fused-chunk result as the accumulated state.
+
+        The engine advanced ``n_batches`` updates in one dispatch via
+        ``pure_update`` under ``lax.scan``; this mirrors what ``n_batches``
+        successful ``update`` calls would have done to the lifecycle counters,
+        so quarantine indices, ``update_count`` and checkpoints stay consistent
+        with the per-batch path.
+        """
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric has already been synced. HINT: call unsync() before modifying state."
+            )
+        self._computed = None
+        self.__dict__["_state_values"] = dict(state)
+        before = self._update_count
+        self._update_count += n_batches
+        self.updates_ok += n_batches
+        self.last_update_ok = True
+        # same detection-latency bound as the per-batch dispatch: whenever a
+        # chunk carries the count past a check boundary, read the (MaskedBuffer)
+        # counts back. Metrics without buffer states pay a no-op loop; buffer
+        # metrics pay one readback per ~K updates, exactly like the eager path.
+        if (before // self._buffer_overflow_check_every) != (
+            self._update_count // self._buffer_overflow_check_every
+        ):
+            self._check_buffer_overflow()
+        if self._has_list_defaults:
+            if self.compute_on_cpu:
+                self._move_list_states_to_cpu()
+            self._check_list_state_growth()
 
     # ------------------------------------------------------------------------ forward
 
@@ -1079,6 +1132,8 @@ class Metric(ABC):
         # a clone/unpickle is a distinct live instance: give it its own gauge
         # series instead of inheriting (and overwriting) the original's
         self._obs_instance = str(next(Metric._obs_instance_seq))
+        if "_has_list_defaults" not in self.__dict__:  # pickles from older builds
+            self._has_list_defaults = any(isinstance(v, list) for v in self._defaults.values())
         self._update_signature = inspect.signature(self.update)
         self._update_impl = self.update
         self._compute_impl = self.compute

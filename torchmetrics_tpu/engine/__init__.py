@@ -1,0 +1,50 @@
+"""Streaming evaluation engine: pipelined updates, fused scan chunks, AOT warmup.
+
+The execution layer between user batch streams and the ``Metric`` /
+``MetricCollection`` machinery:
+
+- :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline` — consumes a batch
+  iterator with host→device **prefetch**, **bounded async dispatch** (never
+  ``block_until_ready`` per step), and **micro-batch fusion**: N same-signature
+  batches advance the state with one ``lax.scan`` dispatch, chunk lengths padded
+  to a small set of buckets with masked tails so the compiled-variant count
+  stays bounded. Robust error policies still apply per fused chunk, with
+  degrade-to-per-batch replay isolating poisoned batches.
+- :mod:`~torchmetrics_tpu.engine.warmup` — AOT precompilation of every
+  (metric, shape-bucket, static-config) variant before the loop, JAX
+  **persistent compilation cache** wiring (``TM_TPU_COMPILE_CACHE``), and the
+  warmup manifest recording what startup compiled.
+
+Quick start::
+
+    from torchmetrics_tpu.engine import MetricPipeline, PipelineConfig
+
+    pipe = MetricPipeline(metric, PipelineConfig(fuse=8, prefetch=2))
+    pipe.warmup(example_preds, example_target)       # AOT + persistent cache
+    pipe.run((p, t) for p, t in eval_loader)         # fused, prefetched
+    value = metric.compute()
+"""
+
+from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig, PipelineReport
+from torchmetrics_tpu.engine.warmup import (
+    CACHE_ENV_VAR,
+    build_manifest,
+    configure_compile_cache,
+    configured_cache_dir,
+    load_manifest,
+    persistent_cache_stats,
+    save_manifest,
+)
+
+__all__ = [
+    "CACHE_ENV_VAR",
+    "MetricPipeline",
+    "PipelineConfig",
+    "PipelineReport",
+    "build_manifest",
+    "configure_compile_cache",
+    "configured_cache_dir",
+    "load_manifest",
+    "persistent_cache_stats",
+    "save_manifest",
+]

@@ -1,0 +1,715 @@
+"""Streaming evaluation pipeline: prefetch, bounded async dispatch, fused scan chunks.
+
+The stateful ``Metric`` API pays one host dispatch per ``update`` call. That is
+already jit-cached and async, but a long evaluation stream still spends host time
+issuing thousands of small dispatches, and nothing overlaps the host→device copy
+of batch *k+1* with the device compute of batch *k*. :class:`MetricPipeline` sits
+between a user's batch stream and the existing ``Metric`` / ``MetricCollection``
+machinery and turns the hot loop into what XLA wants:
+
+- **Micro-batch fusion** — up to ``fuse`` same-signature batches are accumulated
+  into a chunk, stacked along a leading step axis, and folded into the state with
+  ONE ``lax.scan`` dispatch (driving the same ``pure_update`` transitions the
+  per-step path uses, so results are bit-identical). Chunk *lengths* are padded
+  up to a small set of buckets (powers of two up to ``fuse``) with the padded
+  tail masked out of the state inside the scan — a flush of 5 batches and a
+  flush of 8 batches share compiled programs instead of each compiling their own,
+  so the compiled-variant count feeds the jit layer's recompile-storm guard
+  instead of fighting it. A batch whose shapes/statics differ from the open chunk
+  flushes it first, preserving stream order exactly.
+- **Prefetch** — :meth:`run` keeps ``prefetch`` upcoming batches device-resident
+  (``jax.device_put`` issued ahead of use), overlapping host→device transfer with
+  device compute.
+- **Bounded in-flight dispatch** — the pipeline never calls
+  ``block_until_ready`` per step; it holds tickets for up to ``max_in_flight``
+  dispatched chunks and only blocks on the oldest when the window is full, so
+  the host stays ahead of the device without unbounded queueing.
+- **Fault isolation per chunk** — when an error policy is configured
+  (``torchmetrics_tpu.robust``), each chunk is screened once for non-finite
+  inputs (one host sync per chunk instead of per batch); a poisoned or failing
+  chunk degrades to a per-batch replay through the metric's own guarded
+  ``update``, so exactly the poisoned batches are skipped/quarantined and the
+  rest of the chunk still lands.
+- **AOT warmup** — :meth:`warmup` precompiles every (shape-bucket, static-config)
+  variant from abstract specs before the loop and wires JAX's persistent
+  compilation cache (``TM_TPU_COMPILE_CACHE``, see
+  :mod:`torchmetrics_tpu.engine.warmup`), recording a manifest of what was
+  compiled and for how long.
+
+Telemetry (``torchmetrics_tpu.obs``, off by default): ``engine.dispatch`` spans,
+queue-depth / in-flight / fused-chunk-size gauges, prefetch hit/miss and
+padded-step counters, degrade-to-replay events. :meth:`report` returns the same
+accounting as plain ints, available without tracing.
+
+Semantics: the pipeline drives **update-only** accumulation (the epoch pattern —
+N updates, one ``compute``). Per-batch ``forward`` values are inherently
+per-step; streams that need them should call the metric directly.
+"""
+
+from __future__ import annotations
+
+import itertools
+from collections import deque
+from dataclasses import dataclass, fields, replace
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import torchmetrics_tpu.obs.trace as _trace
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.jit import (
+    StaticLeafJit,
+    _ArraySlot,
+    _aval_signature,
+    jit_with_static_leaves,
+    partition_static_leaves,
+)
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.engine import warmup as _warmup
+from torchmetrics_tpu.robust import faults as _faults
+from torchmetrics_tpu.robust.policy import effective_policy, nonfinite_step_indices
+
+__all__ = ["MetricPipeline", "PipelineConfig", "PipelineReport"]
+
+_SLOT = _ArraySlot()
+
+
+@dataclass
+class PipelineConfig:
+    """Tuning knobs for :class:`MetricPipeline`.
+
+    Args:
+        fuse: max batches fused into one ``lax.scan`` dispatch. ``1`` disables
+            fusion (per-batch updates, still prefetched and in-flight-bounded).
+        max_in_flight: max dispatched-but-unawaited chunks before the pipeline
+            blocks on the oldest.
+        prefetch: how many upcoming batches :meth:`MetricPipeline.run` keeps
+            device-resident ahead of use.
+        fuse_buckets: explicit chunk-length buckets (ascending). Default: powers
+            of two up to ``fuse`` — a partial flush pads up to the next bucket
+            with a masked tail so compiled-variant count stays ``O(log fuse)``
+            per batch signature.
+        device: target device for prefetched batches (``None``: default device).
+    """
+
+    fuse: int = 8
+    max_in_flight: int = 4
+    prefetch: int = 2
+    fuse_buckets: Optional[Tuple[int, ...]] = None
+    device: Any = None
+
+    def __post_init__(self) -> None:
+        if self.fuse < 1:
+            raise ValueError(f"Expected `fuse` >= 1, got {self.fuse}")
+        if self.max_in_flight < 1:
+            raise ValueError(f"Expected `max_in_flight` >= 1, got {self.max_in_flight}")
+        if self.prefetch < 0:
+            raise ValueError(f"Expected `prefetch` >= 0, got {self.prefetch}")
+        if self.fuse_buckets is not None:
+            buckets = tuple(sorted(set(int(b) for b in self.fuse_buckets)))
+            if not buckets or buckets[0] < 1:
+                raise ValueError(f"Expected positive `fuse_buckets`, got {self.fuse_buckets}")
+            if buckets[-1] < self.fuse:
+                buckets = buckets + (self.fuse,)
+            self.fuse_buckets = buckets
+
+    def buckets(self) -> Tuple[int, ...]:
+        if self.fuse_buckets is not None:
+            return self.fuse_buckets
+        out, b = [], 1
+        while b < self.fuse:
+            out.append(b)
+            b *= 2
+        out.append(self.fuse)
+        return tuple(out)
+
+
+@dataclass
+class PipelineReport:
+    """Plain-int accounting of one pipeline's work (no obs tracing required)."""
+
+    batches: int = 0  # batches ingested
+    fused_batches: int = 0  # batches that landed via a fused scan dispatch
+    eager_batches: int = 0  # batches driven through per-batch `update`
+    replayed_batches: int = 0  # per-batch replays after a chunk degraded
+    dispatches: int = 0  # fused scan dispatches issued
+    eager_dispatches: int = 0  # per-batch update dispatches (incl. replays)
+    chunks_replayed: int = 0  # chunks degraded to per-batch replay
+    padded_steps: int = 0  # masked tail steps added by bucket padding
+    shape_flushes: int = 0  # chunks flushed early by a signature change
+    max_chunk: int = 0
+    last_chunk: int = 0
+    prefetch_hits: int = 0
+    prefetch_misses: int = 0
+    inflight_waits: int = 0
+
+    def host_dispatches(self) -> int:
+        """Total host dispatches that advanced metric state."""
+        return self.dispatches + self.eager_dispatches
+
+    def dispatches_per_batch(self) -> Optional[float]:
+        """Host dispatches per ingested batch (< 1.0 once fusion engages)."""
+        if not self.batches:
+            return None
+        return self.host_dispatches() / self.batches
+
+    def asdict(self) -> Dict[str, Any]:
+        out = {f.name: getattr(self, f.name) for f in fields(self)}
+        out["host_dispatches"] = self.host_dispatches()
+        out["dispatches_per_batch"] = self.dispatches_per_batch()
+        return out
+
+
+def _normalize_batch(batch: Any) -> Tuple[tuple, dict]:
+    """Accept ``(args...)`` tuples, ``{kwarg: value}`` dicts, or a single array."""
+    if isinstance(batch, tuple):
+        return batch, {}
+    if isinstance(batch, dict):
+        return (), dict(batch)
+    return (batch,), {}
+
+
+class _Chunk:
+    """One open fusion chunk: same-signature batches awaiting a fused dispatch."""
+
+    __slots__ = ("sig", "treedef", "template", "traced", "originals")
+
+    def __init__(self, sig: tuple, treedef: Any, template: tuple) -> None:
+        self.sig = sig
+        self.treedef = treedef
+        self.template = template
+        self.traced: List[list] = []  # per batch: traced leaves, template order
+        self.originals: List[Tuple[tuple, dict]] = []  # per batch: (args, kwargs)
+
+    def __len__(self) -> int:
+        return len(self.traced)
+
+
+class MetricPipeline:
+    """Drive a ``Metric`` or ``MetricCollection`` from a batch stream with
+    prefetch, bounded async dispatch and fused scan chunks.
+
+    Usage::
+
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=8, prefetch=2))
+        pipe.warmup(example_preds, example_target)   # optional AOT precompile
+        report = pipe.run(batch_iterator)            # or pipe.feed(...) per batch
+        value = metric.compute()                     # pipe.run/close flushed already
+
+    Metrics with ragged list states (or ``jit_update=False``) cannot ride the
+    fused scan; the pipeline degrades them to per-batch updates automatically
+    (collections: per compute-group leader, so fusable groups still fuse).
+    """
+
+    _instance_seq = itertools.count()
+
+    def __init__(
+        self,
+        metric: Union[Metric, MetricCollection],
+        config: Optional[PipelineConfig] = None,
+        **overrides: Any,
+    ) -> None:
+        if config is None:
+            config = PipelineConfig(**overrides)
+        elif overrides:
+            config = replace(config, **overrides)
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise ValueError(
+                f"MetricPipeline drives a Metric or MetricCollection, got {type(metric).__name__}"
+            )
+        self.config = config
+        self._target = metric
+        self._is_collection = isinstance(metric, MetricCollection)
+        self._label = type(metric).__name__
+        self._instance = str(next(MetricPipeline._instance_seq))
+        if self._is_collection:
+            self._fused_leaders, self._eager_leaders = metric._engine_fusable_leaders()
+        else:
+            self._fused_leaders, self._eager_leaders = ([], [])
+            if metric._engine_fusable():
+                self._fused_leaders = [None]  # sentinel: the metric itself fuses
+        self._fusable = bool(self._fused_leaders) and config.fuse > 1
+        self._buckets = config.buckets()
+        self._chunk: Optional[_Chunk] = None
+        self._fused_fns: Dict[tuple, StaticLeafJit] = {}
+        self._inflight: deque = deque()
+        self._ingested = 0
+        self._report = PipelineReport()
+        self._warmup_manifest: Optional[Dict[str, Any]] = None
+        # wiring the persistent compile cache is part of engine startup: no-op
+        # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
+        _warmup.configure_compile_cache()
+
+    # ------------------------------------------------------------------ public API
+
+    @property
+    def metric(self) -> Union[Metric, MetricCollection]:
+        return self._target
+
+    def report(self) -> PipelineReport:
+        """Copy of the accounting so far (safe to keep across further feeds)."""
+        return replace(self._report)
+
+    @property
+    def warmup_manifest(self) -> Optional[Dict[str, Any]]:
+        return self._warmup_manifest
+
+    def feed(self, *args: Any, **kwargs: Any) -> None:
+        """Ingest one batch (positional/keyword update arguments)."""
+        self._ingest(args, kwargs)
+
+    def run(self, batches: Iterable[Any]) -> PipelineReport:
+        """Consume a stream of batches with device prefetch; flushes at the end.
+
+        Each item is a tuple of positional update args, a dict of keyword args,
+        or a single array. Returns the accumulated :class:`PipelineReport`.
+        """
+        lookahead = max(1, self.config.prefetch)
+        it = iter(batches)
+        pending: deque = deque()  # (args, kwargs, ingested-count at enqueue)
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < lookahead:
+                try:
+                    raw = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                args, kwargs = _normalize_batch(raw)
+                args, kwargs = self._device_put(args, kwargs)
+                pending.append((args, kwargs, self._ingested))
+            if pending:
+                args, kwargs, stamp = pending.popleft()
+                if stamp < self._ingested:
+                    # its transfer was issued before the previous batch was even
+                    # ingested — the copy overlapped compute
+                    self._report.prefetch_hits += 1
+                    if _trace.ENABLED:
+                        _trace.inc("engine.prefetch_hit", pipeline=self._label)
+                else:
+                    self._report.prefetch_misses += 1
+                    if _trace.ENABLED:
+                        _trace.inc("engine.prefetch_miss", pipeline=self._label)
+                self._ingest(args, kwargs)
+        self.flush()
+        return self.report()
+
+    def flush(self) -> None:
+        """Dispatch the open partial chunk (padded up to its bucket)."""
+        if self._chunk is not None and len(self._chunk):
+            self._dispatch_chunk()
+        self._check_buffer_overflow()
+
+    def close(self) -> PipelineReport:
+        """Flush, drain the in-flight window, and return the final report."""
+        self.flush()
+        while self._inflight:
+            jax.block_until_ready(self._inflight.popleft())
+        if _trace.ENABLED:
+            _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
+        return self.report()
+
+    def compute(self) -> Any:
+        """Flush then compute the target — the epoch-end convenience."""
+        self.flush()
+        return self._target.compute()
+
+    def __enter__(self) -> "MetricPipeline":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    # ---------------------------------------------------------------------- warmup
+
+    def warmup(
+        self, *args: Any, manifest_path: Optional[str] = None, **kwargs: Any
+    ) -> Dict[str, Any]:
+        """AOT-precompile every (shape-bucket, static-config) variant for an example
+        batch, before the loop runs.
+
+        ``args``/``kwargs`` are one example batch — concrete arrays or abstract
+        ``jax.ShapeDtypeStruct`` specs. Compiles the fused scan program for every
+        chunk-length bucket plus the per-batch update path (the replay/eager
+        fallback), through :meth:`StaticLeafJit.warmup`, so the hot loop's first
+        steps are pure cache hits. With the persistent compilation cache wired
+        (``TM_TPU_COMPILE_CACHE``), a restarted process's warmup turns into disk
+        reads. Returns (and stores) the warmup manifest; ``manifest_path`` also
+        writes it as JSON.
+        """
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template, unhashable = partition_static_leaves(leaves)
+        if unhashable is not None:
+            raise TypeError(
+                f"MetricPipeline.warmup received an unhashable static argument of type"
+                f" {type(unhashable).__name__}; such batches dispatch per-batch/eagerly"
+                " and cannot be precompiled."
+            )
+        traced_specs = []
+        for leaf in traced:
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                traced_specs.append(leaf)
+            else:
+                dtype = leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype
+                traced_specs.append(jax.ShapeDtypeStruct(np.shape(leaf), dtype))
+        entries: List[Dict[str, Any]] = []
+        shapes = [list(map(int, s.shape)) for s in traced_specs]
+        if self._fusable:
+            state = self._current_fused_state()
+            fused = self._get_fused_fn(treedef, tuple(template))
+            for bucket in self._buckets:
+                stacked = [
+                    jax.ShapeDtypeStruct((bucket, *spec.shape), spec.dtype) for spec in traced_specs
+                ]
+                valid = jax.ShapeDtypeStruct((bucket,), np.bool_)
+                info = fused.warmup(state, stacked, valid)
+                entries.append({**info, "kind": "fused", "bucket": bucket, "shapes": shapes})
+        # the per-batch path (replay fallback for degraded chunks, eager group
+        # leaders, and the whole path when fusion is off) — the metrics' own
+        # jitted updates
+        it = iter(traced_specs)
+        abstract_full = [next(it) if isinstance(t, _ArraySlot) else t for t in template]
+        a_args, a_kwargs = jax.tree_util.tree_unflatten(treedef, abstract_full)
+        per_batch = list(self._per_batch_metrics())
+        if self._is_collection:
+            # unfusable leaders still dispatch per batch through their own
+            # jitted update when they have one (e.g. jit forced on a list-state
+            # metric) — the zero-compiles-in-the-loop promise covers them too
+            per_batch += [self._target._modules[name] for name in self._eager_leaders]
+        for m in per_batch:
+            if not m._jit_enabled():
+                continue
+            if m._jitted_update is None:
+                m._jitted_update = jit_with_static_leaves(m.pure_update)
+            filtered = m._filter_kwargs(**a_kwargs) if self._is_collection else a_kwargs
+            info = m._jitted_update.warmup(dict(m._state_values), *a_args, **filtered)
+            entries.append({**info, "kind": "per_batch", "bucket": None, "shapes": shapes})
+        manifest = _warmup.build_manifest(entries, cache_dir=_warmup.configured_cache_dir())
+        self._warmup_manifest = manifest
+        if _trace.ENABLED:
+            _trace.event(
+                "engine.warmup",
+                pipeline=self._label,
+                variants=manifest["variants"],
+                fresh=manifest["fresh_compiles"],
+                seconds=manifest["total_compile_seconds"],
+            )
+        if manifest_path is not None:
+            _warmup.save_manifest(manifest, manifest_path)
+        return manifest
+
+    # ------------------------------------------------------------------- ingestion
+
+    def _device_put(self, args: tuple, kwargs: dict) -> Tuple[tuple, dict]:
+        def _put(x: Any) -> Any:
+            if isinstance(x, (jax.Array, np.ndarray)):
+                return jax.device_put(x, self.config.device)
+            return x
+
+        return jax.tree_util.tree_map(_put, (args, kwargs))
+
+    def _ingest(self, args: tuple, kwargs: dict) -> None:
+        if _faults.update_faults_active():
+            # injected faults apply ONCE per ingested batch, at the pipeline
+            # seam; downstream metric.update calls are told not to re-apply
+            args, kwargs = _faults.apply_update_fault(args, kwargs)
+        self._ingested += 1
+        self._report.batches += 1
+        if _trace.ENABLED:
+            _trace.inc("engine.batches", pipeline=self._label)
+        if not self._fusable:
+            self._drive_per_batch(args, kwargs)
+            return
+        if self._eager_leaders:
+            # unfusable group leaders advance per batch, in stream order
+            self._drive_eager_leaders(args, kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template, unhashable = partition_static_leaves(leaves)
+        if unhashable is not None:
+            # unhashable statics cannot key a chunk signature: flush and fall
+            # through to the per-batch path for this batch
+            if self._chunk is not None and len(self._chunk):
+                self._dispatch_chunk()
+            self._drive_fused_leaders_eagerly(args, kwargs)
+            return
+        sig = (treedef, tuple(template), _aval_signature(traced))
+        if self._chunk is not None and self._chunk.sig != sig:
+            self._report.shape_flushes += 1
+            if _trace.ENABLED:
+                _trace.inc("engine.shape_flush", pipeline=self._label)
+            self._dispatch_chunk()
+        if self._chunk is None:
+            self._chunk = _Chunk(sig, treedef, tuple(template))
+        self._chunk.traced.append(traced)
+        self._chunk.originals.append((args, kwargs))
+        if _trace.ENABLED:
+            _trace.set_gauge(
+                "engine.queue_depth", len(self._chunk), pipeline=self._label, inst=self._instance
+            )
+        if len(self._chunk) >= self.config.fuse:
+            self._dispatch_chunk()
+
+    # ------------------------------------------------------------------ fused path
+
+    def _per_batch_metrics(self) -> List[Metric]:
+        """The metrics the per-batch (eager/replay) path drives directly."""
+        if not self._is_collection:
+            return [self._target]
+        return [self._target._modules[name] for name in self._fused_leaders if name is not None]
+
+    def _current_fused_state(self) -> Any:
+        if not self._is_collection:
+            return dict(self._target._state_values)
+        return {
+            name: dict(self._target._modules[name]._state_values) for name in self._fused_leaders
+        }
+
+    def _get_fused_fn(self, treedef: Any, template: tuple) -> StaticLeafJit:
+        key = (treedef, template)
+        fused = self._fused_fns.get(key)
+        if fused is not None:
+            return fused
+        if self._is_collection:
+            leaders = [(name, self._target._modules[name]) for name in self._fused_leaders]
+        else:
+            leaders = None
+        target = self._target
+
+        def fused_update(state, stacked, valid):
+            def body(st, xs):
+                step_leaves, ok = xs
+                it = iter(step_leaves)
+                full = [next(it) if isinstance(t, _ArraySlot) else t for t in template]
+                a, kw = jax.tree_util.tree_unflatten(treedef, full)
+                if leaders is None:
+                    new = target.pure_update(st, *a, **kw)
+                else:
+                    new = {
+                        name: m.pure_update(st[name], *a, **m._filter_kwargs(**kw))
+                        for name, m in leaders
+                    }
+                # masked tail: padded steps pass the state through unchanged, so
+                # a partial chunk padded up to its bucket stays bit-identical to
+                # the unpadded per-batch run
+                merged = jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, st)
+                return merged, None
+
+            out, _ = jax.lax.scan(body, state, (stacked, valid))
+            return out
+
+        fused_update.__name__ = "fused_update"
+        fused_update.__qualname__ = f"{self._label}.fused_update"
+        fused = jit_with_static_leaves(fused_update)
+        self._fused_fns[key] = fused
+        return fused
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _chunk_policy(self):
+        """The error policy guarding this chunk (any fused metric's, else global)."""
+        for m in self._per_batch_metrics():
+            policy = effective_policy(m.error_policy)
+            if policy is not None:
+                return policy
+        return None
+
+    def _dispatch_chunk(self) -> None:
+        chunk, self._chunk = self._chunk, None
+        n = len(chunk.traced)
+        bucket = self._bucket_for(n)
+        pad = bucket - n
+        rows = chunk.traced + [chunk.traced[-1]] * pad  # repeat-last padding, masked out
+        stacked = [jnp.stack([row[i] for row in rows]) for i in range(len(chunk.traced[0]))]
+        valid = jnp.asarray(np.arange(bucket) < n)
+        policy = self._chunk_policy()
+        if policy is not None:
+            # one host sync per CHUNK (the guarded eager path pays one per batch)
+            bad_steps = [i for i in nonfinite_step_indices(stacked) if i < n]
+            if bad_steps:
+                if _trace.ENABLED:
+                    _trace.event(
+                        "engine.chunk_degraded",
+                        pipeline=self._label,
+                        reason="nonfinite",
+                        steps=",".join(map(str, bad_steps)),
+                        chunk=n,
+                    )
+                self._replay_chunk(chunk)
+                return
+        fused = self._get_fused_fn(chunk.treedef, chunk.template)
+        state = self._current_fused_state()
+        try:
+            if _trace.ENABLED:
+                with _trace.span("engine.dispatch", pipeline=self._label, path="fused"):
+                    new_state = fused(state, stacked, valid)
+            else:
+                new_state = fused(state, stacked, valid)
+        except Exception as err:
+            if policy is None:
+                raise
+            # state was never committed; the guarded per-batch replay isolates
+            # exactly the failing batches
+            if _trace.ENABLED:
+                _trace.event(
+                    "engine.chunk_degraded",
+                    pipeline=self._label,
+                    reason=f"{type(err).__name__}",
+                    chunk=n,
+                )
+            self._replay_chunk(chunk)
+            return
+        self._commit(new_state, n)
+        self._report.dispatches += 1
+        self._report.fused_batches += n
+        self._report.padded_steps += pad
+        self._report.max_chunk = max(self._report.max_chunk, n)
+        self._report.last_chunk = n
+        if _trace.ENABLED:
+            _trace.inc("engine.dispatches", pipeline=self._label)
+            _trace.inc("engine.fused_batches", n, pipeline=self._label)
+            if pad:
+                _trace.inc("engine.padded_steps", pad, pipeline=self._label)
+            _trace.set_gauge(
+                "engine.fused_chunk_size", n, pipeline=self._label, inst=self._instance
+            )
+            _trace.set_gauge(
+                "engine.queue_depth", 0, pipeline=self._label, inst=self._instance
+            )
+        self._ticket(new_state)
+
+    def _commit(self, new_state: Any, n: int) -> None:
+        if self._is_collection:
+            self._target._engine_commit(
+                {name: new_state[name] for name in self._fused_leaders}, n
+            )
+        else:
+            self._target._engine_commit_state(new_state, n)
+
+    # ------------------------------------------------------------- per-batch paths
+
+    def _suppressing_refault(self, fn: Callable[[], Any]) -> Any:
+        """Run a downstream ``update`` without re-applying an armed fault plan
+        (the pipeline already applied it at ingestion)."""
+        if not _faults.update_faults_active():
+            return fn()
+        metrics = (
+            list(self._target._modules.values()) if self._is_collection else [self._target]
+        )
+        previous = [m.__dict__.get("_fault_applied", False) for m in metrics]
+        for m in metrics:
+            m.__dict__["_fault_applied"] = True
+        try:
+            return fn()
+        finally:
+            for m, prev in zip(metrics, previous):
+                m.__dict__["_fault_applied"] = prev
+
+    def _drive_per_batch(self, args: tuple, kwargs: dict) -> None:
+        """Whole-target per-batch update (fusion off or target unfusable)."""
+        if _trace.ENABLED:
+            with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
+                self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
+        else:
+            self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
+        self._report.eager_batches += 1
+        self._report.eager_dispatches += 1
+        if _trace.ENABLED:
+            _trace.inc("engine.eager_batches", pipeline=self._label)
+        self._ticket(self._current_any_state())
+
+    def _drive_eager_leaders(self, args: tuple, kwargs: dict) -> None:
+        def _run() -> None:
+            for name in self._eager_leaders:
+                m = self._target._modules[name]
+                m.update(*args, **m._filter_kwargs(**kwargs))
+
+        self._suppressing_refault(_run)
+        self._report.eager_dispatches += len(self._eager_leaders)
+
+    def _drive_fused_leaders_eagerly(self, args: tuple, kwargs: dict) -> None:
+        """Per-batch fallback for a batch that cannot join a chunk."""
+
+        def _run() -> None:
+            for m in self._per_batch_metrics():
+                filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
+                m.update(*args, **filtered)
+
+        if _trace.ENABLED:
+            with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
+                self._suppressing_refault(_run)
+        else:
+            self._suppressing_refault(_run)
+        if self._is_collection:
+            self._target._sync_group_states()
+        self._report.eager_batches += 1
+        # one host dispatch per driven metric (multi-group collections issue
+        # several updates per batch), matching _drive_eager_leaders' accounting
+        self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
+
+    def _replay_chunk(self, chunk: _Chunk) -> None:
+        """Per-batch replay of a degraded chunk: the metrics' own guarded updates
+        isolate (skip/quarantine) exactly the poisoned batches."""
+        self._report.chunks_replayed += 1
+        if _trace.ENABLED:
+            _trace.inc("engine.chunks_replayed", pipeline=self._label)
+        for args, kwargs in chunk.originals:
+            def _run(args=args, kwargs=kwargs) -> None:
+                for m in self._per_batch_metrics():
+                    filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
+                    m.update(*args, **filtered)
+
+            if _trace.ENABLED:
+                with _trace.span("engine.dispatch", pipeline=self._label, path="replay"):
+                    self._suppressing_refault(_run)
+            else:
+                self._suppressing_refault(_run)
+            self._report.replayed_batches += 1
+            self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
+            if _trace.ENABLED:
+                _trace.inc("engine.replayed_batches", pipeline=self._label)
+        if self._is_collection:
+            self._target._sync_group_states()
+        self._ticket(self._current_any_state())
+
+    # -------------------------------------------------------------------- plumbing
+
+    def _current_any_state(self) -> Any:
+        if self._is_collection:
+            return {name: m._state_values for name, m in self._target._modules.items()}
+        return self._target._state_values
+
+    def _ticket(self, state_like: Any) -> None:
+        """Bound the async window: hold a leaf of each dispatched state, block on
+        the oldest once more than ``max_in_flight`` are outstanding."""
+        ticket = None
+        for leaf in jax.tree_util.tree_leaves(state_like):
+            if isinstance(leaf, jax.Array):
+                ticket = leaf
+                break
+        if ticket is None:
+            return  # host-only state (e.g. compute_on_cpu lists): nothing async
+        self._inflight.append(ticket)
+        while len(self._inflight) > self.config.max_in_flight:
+            oldest = self._inflight.popleft()
+            is_ready = getattr(oldest, "is_ready", None)
+            if is_ready is None or not is_ready():
+                self._report.inflight_waits += 1
+                if _trace.ENABLED:
+                    _trace.inc("engine.inflight_waits", pipeline=self._label)
+            jax.block_until_ready(oldest)
+        if _trace.ENABLED:
+            _trace.set_gauge(
+                "engine.in_flight", len(self._inflight), pipeline=self._label, inst=self._instance
+            )
+
+    def _check_buffer_overflow(self) -> None:
+        for m in self._per_batch_metrics():
+            m._check_buffer_overflow()
+        for name in self._eager_leaders:
+            self._target._modules[name]._check_buffer_overflow()

@@ -1,0 +1,220 @@
+"""AOT warmup + persistent compile cache for the streaming evaluation engine.
+
+Two jobs, both about paying XLA compiles *before* the hot loop instead of inside
+it:
+
+- :func:`configure_compile_cache` wires JAX's **persistent compilation cache**
+  (``jax_compilation_cache_dir``) to a directory — explicit argument, or the
+  ``TM_TPU_COMPILE_CACHE`` environment variable. Once configured, every XLA
+  compile this process performs is written to (and on restart, read back from)
+  disk, so a re-run of the same metric configuration skips compilation entirely.
+  A monitoring listener counts persistent-cache hits so
+  :func:`persistent_cache_stats` can report hit/miss totals (surfaced in
+  ``bench.py``'s engine configs and the warmup manifest).
+- The **warmup manifest** records what a warmup pass precompiled — one entry per
+  (function, shape-bucket) variant with its compile wall time and whether it was
+  fresh — and round-trips through :func:`save_manifest` / :func:`load_manifest`
+  (atomic writes via ``utils/fileio``). A manifest next to a run's output answers
+  "what did startup compile, and how long did it take" without a profiler.
+
+The actual precompiles are driven by :meth:`MetricPipeline.warmup
+<torchmetrics_tpu.engine.pipeline.MetricPipeline.warmup>` (which lowers every
+fused shape-bucket variant plus the per-batch replay path through
+:meth:`StaticLeafJit.warmup <torchmetrics_tpu.core.jit.StaticLeafJit.warmup>`),
+using the helpers here for cache wiring and manifest assembly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import torchmetrics_tpu.obs.trace as _trace
+from torchmetrics_tpu.utils.fileio import atomic_write_text
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = [
+    "CACHE_ENV_VAR",
+    "MANIFEST_SCHEMA",
+    "build_manifest",
+    "configure_compile_cache",
+    "configured_cache_dir",
+    "load_manifest",
+    "persistent_cache_stats",
+    "save_manifest",
+]
+
+CACHE_ENV_VAR = "TM_TPU_COMPILE_CACHE"
+MANIFEST_SCHEMA = 1
+
+_lock = threading.Lock()
+_configured_dir: Optional[str] = None
+_listener_installed = False
+_warned_cache_unavailable = False
+# persistent-cache monitoring totals (plain ints: readable without obs tracing)
+_cache_events = {"requests": 0, "hits": 0}
+
+
+def _install_cache_listener() -> None:
+    """Count JAX's persistent-compilation-cache monitoring events.
+
+    JAX records ``/jax/compilation_cache/cache_hits`` on every disk-cache hit and
+    ``.../compile_requests_use_cache`` on every compile that consulted the cache;
+    the listener keeps plain-int totals (misses = requests - hits). Guarded:
+    monitoring is a private-ish surface and its absence only costs the stats.
+    """
+    global _listener_installed
+    if _listener_installed:
+        return
+    try:
+        from jax._src import monitoring as _monitoring
+
+        def _on_event(event: str, **kwargs: Any) -> None:
+            if event == "/jax/compilation_cache/compile_requests_use_cache":
+                _cache_events["requests"] += 1
+            elif event == "/jax/compilation_cache/cache_hits":
+                _cache_events["hits"] += 1
+                if _trace.ENABLED:
+                    _trace.inc("engine.compile_cache_hit")
+
+        _monitoring.register_event_listener(_on_event)
+        _listener_installed = True
+    except Exception:  # pragma: no cover - monitoring API drift
+        _listener_installed = True  # do not retry per call
+
+
+def configure_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``.
+
+    Resolution order: explicit argument, then ``$TM_TPU_COMPILE_CACHE``; with
+    neither set this is a no-op returning ``None`` (the in-memory-only default).
+    The entry-size/compile-time floors are dropped so even the small CPU-backend
+    programs metric updates compile to are cached — without that, warmup on the
+    test/bench hosts would never exercise the disk path the TPU runs rely on.
+    Idempotent per directory; safe to call from every pipeline constructor.
+    """
+    global _configured_dir, _warned_cache_unavailable
+    resolved = cache_dir or os.environ.get(CACHE_ENV_VAR) or None
+    if resolved is None:
+        return _configured_dir
+    resolved = os.path.abspath(resolved)
+    with _lock:
+        if _configured_dir == resolved:
+            return resolved
+        try:
+            import jax
+
+            os.makedirs(resolved, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", resolved)
+            for knob, value in (
+                ("jax_persistent_cache_min_compile_time_secs", 0.0),
+                ("jax_persistent_cache_min_entry_size_bytes", -1),
+            ):
+                try:
+                    jax.config.update(knob, value)
+                except Exception:  # knob renamed/removed: floors stay at defaults
+                    pass
+            try:
+                # any compile that ran before the dir was set latches the cache
+                # module as initialized-with-no-store (_cache_initialized=True,
+                # _cache=None) and jax 0.4.x does NOT reset it on config update —
+                # without this reset a late configure silently caches nothing
+                from jax._src import compilation_cache as _compilation_cache
+
+                _compilation_cache.reset_cache()
+            except Exception:  # private-API drift: a pre-config compile keeps the latch
+                pass
+        except Exception as err:
+            if not _warned_cache_unavailable:
+                _warned_cache_unavailable = True
+                rank_zero_warn(
+                    f"Persistent compilation cache could not be configured at {resolved!r}:"
+                    f" {type(err).__name__}: {err}. Compiles stay in-memory only; restarts"
+                    " will recompile from scratch.",
+                    RuntimeWarning,
+                )
+            return None
+        _install_cache_listener()
+        _configured_dir = resolved
+    if _trace.ENABLED:
+        _trace.event("engine.compile_cache_configured", dir=resolved)
+    return resolved
+
+
+def configured_cache_dir() -> Optional[str]:
+    """The directory the persistent cache was wired to (``None`` when unwired)."""
+    return _configured_dir
+
+
+def persistent_cache_stats() -> Dict[str, Any]:
+    """Persistent-cache accounting: directory, on-disk entries, hit/miss totals.
+
+    ``entries`` counts the ``*-cache`` payload files in the configured directory
+    (what a restart can hit); ``hits``/``misses`` count this process's lookups.
+    All zeros/None when no cache is configured.
+    """
+    entries = 0
+    if _configured_dir is not None and os.path.isdir(_configured_dir):
+        try:
+            entries = sum(1 for name in os.listdir(_configured_dir) if name.endswith("-cache"))
+        except OSError:
+            entries = 0
+    requests, hits = _cache_events["requests"], _cache_events["hits"]
+    return {
+        "dir": _configured_dir,
+        "entries": entries,
+        "requests": requests,
+        "hits": hits,
+        "misses": max(0, requests - hits),
+    }
+
+
+# ------------------------------------------------------------------------ manifest
+
+
+def build_manifest(entries: List[Dict[str, Any]], cache_dir: Optional[str] = None) -> Dict[str, Any]:
+    """Assemble a warmup manifest from per-variant entries.
+
+    Each entry comes from :meth:`StaticLeafJit.warmup` plus the pipeline's
+    bucket/shape annotations; the manifest adds schema/backend/cache context and
+    the compile-time total so one record describes the whole warmup pass.
+    """
+    backend = None
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:  # pragma: no cover - warmup without an initializable backend
+        pass
+    fresh = [e for e in entries if e.get("fresh")]
+    return {
+        "schema_version": MANIFEST_SCHEMA,
+        "created_unix": time.time(),
+        "backend": backend,
+        "cache_dir": cache_dir if cache_dir is not None else _configured_dir,
+        "entries": list(entries),
+        "variants": len(entries),
+        "fresh_compiles": len(fresh),
+        "total_compile_seconds": round(sum(float(e.get("seconds", 0.0)) for e in fresh), 6),
+    }
+
+
+def save_manifest(manifest: Dict[str, Any], path: str) -> str:
+    """Atomically write ``manifest`` as JSON; returns the absolute path."""
+    return atomic_write_text(path, json.dumps(manifest, indent=2, sort_keys=True) + "\n")
+
+
+def load_manifest(path: str) -> Dict[str, Any]:
+    """Load a manifest written by :func:`save_manifest`, validating the schema."""
+    with open(path, "r", encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    if not isinstance(manifest, dict) or manifest.get("schema_version") != MANIFEST_SCHEMA:
+        raise ValueError(
+            f"{path!r} is not a warmup manifest (schema_version"
+            f" {manifest.get('schema_version') if isinstance(manifest, dict) else None!r},"
+            f" expected {MANIFEST_SCHEMA})"
+        )
+    return manifest

@@ -172,6 +172,9 @@ _GAUGE_HELP = {
     "memory.device_bytes_in_use": "jax device.memory_stats() bytes_in_use (absent on backends without memory stats)",
     "memory.device_peak_bytes_in_use": "jax device.memory_stats() peak_bytes_in_use (absent on backends without memory stats)",
     "memory.snapshot_payload_bytes": "Bytes of the last cross-host telemetry snapshot shipped by this host",
+    "engine.queue_depth": "Batches accumulated in the streaming engine's open fusion chunk",
+    "engine.in_flight": "Dispatched-but-unawaited chunks in the streaming engine's async window",
+    "engine.fused_chunk_size": "Batch count of the streaming engine's last fused scan dispatch",
 }
 
 

@@ -152,6 +152,13 @@ def run_record(
         }
         if clean_memory:
             record["memory"] = clean_memory
+    engine = result.get("engine")
+    if isinstance(engine, dict):
+        # streaming-engine stats (fused chunk sizes, dispatch ratios, warmup and
+        # persistent-compile-cache hit totals): recorded so the engine's
+        # trajectory accumulates across rounds, never judged by
+        # check_regressions — exactly the `memory` passthrough pattern
+        record["engine"] = engine
     return record
 
 

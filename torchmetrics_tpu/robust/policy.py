@@ -36,6 +36,7 @@ __all__ = [
     "error_policy",
     "first_nonfinite",
     "get_error_policy",
+    "nonfinite_step_indices",
     "set_error_policy",
 ]
 
@@ -153,3 +154,29 @@ def first_nonfinite(args: tuple, kwargs: dict) -> Optional[str]:
         if hit is not None:
             return hit
     return None
+
+
+def nonfinite_step_indices(stacked_leaves) -> list:
+    """Leading-axis indices of a stacked chunk's steps holding non-finite values.
+
+    The streaming engine (``torchmetrics_tpu.engine``) screens a whole fused
+    chunk with ONE host sync instead of one per batch: each leaf carries a
+    leading step axis, non-finite entries are reduced per step, and the union
+    across leaves names exactly the poisoned steps (the batches the per-batch
+    replay will then skip/quarantine). Non-floating and traced leaves are
+    skipped, mirroring :func:`first_nonfinite`'s screening rules.
+    """
+    bad: set = set()
+    for leaf in stacked_leaves:
+        if not (hasattr(leaf, "dtype") and hasattr(leaf, "shape")) or not getattr(leaf, "shape", ()):
+            continue
+        import jax
+
+        if isinstance(leaf, jax.core.Tracer):
+            continue
+        host = np.asarray(leaf)
+        if not np.issubdtype(host.dtype, np.floating) and not np.issubdtype(host.dtype, np.complexfloating):
+            continue
+        finite = np.isfinite(host).reshape(host.shape[0], -1).all(axis=1)
+        bad.update(int(i) for i in np.nonzero(~finite)[0])
+    return sorted(bad)
