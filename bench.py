@@ -914,6 +914,79 @@ def _merge_memory(*snaps) -> dict:
     return out
 
 
+# ------------------------------------------------------------------------- cost
+
+# per-config XLA cost-ledger summaries (variants compiled + their estimated
+# flops/bytes + compile seconds), captured unconditionally by _safe_obs — the
+# ledger records at compile time, so unlike TM_TPU_BENCH_OBS this perturbs no
+# timed region. Recorded in the JSON line and history, never judged.
+_COST_BY_CONFIG: dict = {}
+
+
+def _cost_mark():
+    try:
+        from torchmetrics_tpu.obs import cost as obs_cost
+
+        return obs_cost.get_ledger().mark()
+    except Exception:
+        return None
+
+
+def _cost_since(name: str, mark) -> None:
+    """Accumulate the ledger delta since ``mark`` under config ``name``."""
+    if mark is None:
+        return
+    try:
+        from torchmetrics_tpu.obs import cost as obs_cost
+
+        delta = obs_cost.get_ledger().since(mark)
+    except Exception:
+        return
+    if not delta.get("variants_compiled"):
+        return
+    seen = _COST_BY_CONFIG.setdefault(name, {})
+    for key, value in delta.items():
+        if isinstance(value, (int, float)):
+            seen[key] = round(seen.get(key, 0) + value, 6)
+
+
+def _cost_snapshot() -> dict:
+    """This process's cost view: whole-ledger totals + per-config deltas."""
+    out: dict = {}
+    try:
+        from torchmetrics_tpu.obs import cost as obs_cost
+
+        out["totals"] = obs_cost.get_ledger().totals()
+    except Exception:
+        pass
+    if _COST_BY_CONFIG:
+        out["by_config"] = {k: dict(v) for k, v in _COST_BY_CONFIG.items()}
+    return out
+
+
+def _merge_cost(*snaps) -> dict:
+    """Combine per-process cost snapshots: totals sum, per-config dicts union."""
+    totals: dict = {}
+    by_config: dict = {}
+    for snap in snaps:
+        if not isinstance(snap, dict):
+            continue
+        for key, value in (snap.get("totals") or {}).items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                totals[key] = round(totals.get(key, 0) + value, 6)
+        for name, delta in (snap.get("by_config") or {}).items():
+            seen = by_config.setdefault(name, {})
+            for key, value in (delta or {}).items():
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    seen[key] = round(seen.get(key, 0) + value, 6)
+    out: dict = {}
+    if totals:
+        out["totals"] = totals
+    if by_config:
+        out["by_config"] = by_config
+    return out
+
+
 # ------------------------------------------------------------------ observability
 
 # TM_TPU_BENCH_OBS=1 runs each config WITH obs tracing enabled and attaches
@@ -950,14 +1023,21 @@ def _safe_obs(obs_out, name, fn, *args):
     Interleaved timing rounds run each config more than once; the summaries
     AGGREGATE across rounds (counters/span totals summed) so the attached
     telemetry describes every run of the config, not just the last (warm-cache)
-    round, while the timed numbers remain per-config minima.
+    round, while the timed numbers remain per-config minima. Independent of
+    TM_TPU_BENCH_OBS, the per-config XLA cost-ledger delta (variants compiled,
+    estimated flops/bytes) is always captured — ledger capture is compile-time
+    only, so it cannot perturb the timed region.
     """
+    cost_mark = _cost_mark()
     if not _BENCH_OBS:
-        return _safe(fn, *args)
+        value = _safe(fn, *args)
+        _cost_since(name, cost_mark)
+        return value
     from torchmetrics_tpu import obs
 
     with obs.observe() as rec:
         value = _safe(fn, *args)
+    _cost_since(name, cost_mark)
     summary = _obs_counters_summary(rec)
     seen = obs_out.get(name)
     if seen is None:
@@ -1131,6 +1211,7 @@ def _worker_main(mode: str) -> None:
         # from the spawning process's env (the A/B lever)
         out = bench_hotops()
     out["memory"] = _memory_snapshot()  # the worker did the work; its peaks count
+    out["cost"] = _cost_snapshot()  # the worker's ledger holds its configs' compiles
     print(json.dumps(out))
 
 
@@ -1147,6 +1228,8 @@ def _run_fallback_via_workers() -> dict:
                 data = json.loads(proc.stdout.strip().splitlines()[-1])
                 # peaks combine as max across workers, not last-writer-wins
                 merged["memory"] = _merge_memory(merged.get("memory"), data.pop("memory", None))
+                # cost ledgers are per-process: totals sum, config deltas union
+                merged["cost"] = _merge_cost(merged.get("cost"), data.pop("cost", None))
                 merged.update(data)
             else:
                 sys.stderr.write(f"bench worker {mode} rc={proc.returncode}: {proc.stderr[-500:]}\n")
@@ -1373,6 +1456,10 @@ def main(check_regressions: bool = False) -> None:
         # across this process and the workers; recorded in the history line,
         # never judged by the regression gate
         "memory": _merge_memory(_memory_snapshot(), ours.get("memory")),
+        # XLA cost-ledger summary (per-config variants compiled + estimated
+        # flops/bytes, whole-run compile/dispatch totals across this process
+        # and the workers); recorded in the history line, never judged
+        "cost": _merge_cost(_cost_snapshot(), ours.get("cost")),
     }
     print(json.dumps(result))
     _record_history(result, check=check_regressions)

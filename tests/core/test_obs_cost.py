@@ -1,0 +1,643 @@
+"""XLA cost ledger + pipeline flight recorder battery.
+
+Covers the attribution layer end to end: ledger population from the
+``StaticLeafJit`` AOT miss path and warmups (CPU backend reports real
+flops/bytes, so entries are asserted non-degenerate), per-metric rollups and
+derived gauges, the ``/costs`` endpoint, the flight-recorder ring + its
+dump-on-fault contract (dump exactly on quarantine/replay, poisoned batch
+named, preceding context present, file atomic), and the
+``python -m torchmetrics_tpu.obs.cost`` CLI. CPU-only, no sleeps, no network
+beyond localhost.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.jit import StaticLeafJit, signature_str
+from torchmetrics_tpu.engine import MetricPipeline, PipelineConfig
+from torchmetrics_tpu.obs import cost
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu.robust import faults
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _cost_clean():
+    """Each test sees a fresh (enabled) ledger and a clean recorder."""
+    cost.enable()
+    cost.get_ledger().clear()
+    trace.disable()
+    trace.get_recorder().clear()
+    yield
+    cost.enable()
+    cost.get_ledger().clear()
+    trace.disable()
+    trace.get_recorder().clear()
+
+
+def _pair_batches(n, size=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(size).astype("float32")),
+            jnp.asarray(rng.rand(size).astype("float32")),
+        )
+        for _ in range(n)
+    ]
+
+
+class _FakeMemoryStats:
+    argument_size_in_bytes = 96
+    output_size_in_bytes = 8
+    temp_size_in_bytes = 24
+    generated_code_size_in_bytes = 4
+
+
+class _FakeCompiled:
+    """Duck-typed stand-in for a jax ``Compiled`` (deterministic costs)."""
+
+    def __init__(self, flops=1000.0, bytes_accessed=500.0, memory=True):
+        self._flops = flops
+        self._bytes = bytes_accessed
+        self._memory = memory
+
+    def cost_analysis(self):
+        out = {}
+        if self._flops is not None:
+            out["flops"] = self._flops
+        if self._bytes is not None:
+            out["bytes accessed"] = self._bytes
+        return [out]
+
+    def memory_analysis(self):
+        return _FakeMemoryStats() if self._memory else None
+
+
+def _record_fake(ledger, fn="M.pure_update", inst="0", **kwargs):
+    return ledger.record(
+        fn=fn,
+        inst=inst,
+        static_key="()",
+        input_signature="float32[8]",
+        compiled=_FakeCompiled(**kwargs),
+        compile_seconds=0.01,
+    )
+
+
+# --------------------------------------------------------------- ledger basics
+
+
+class TestLedgerPopulation:
+    def test_metric_dispatch_miss_records_entry_with_real_costs(self):
+        m = MeanSquaredError()
+        m.update(jnp.ones(32), jnp.zeros(32))
+        entries = [e for e in cost.get_ledger().entries() if e.fn == "MeanSquaredError.pure_update"]
+        assert len(entries) == 1
+        entry = entries[0]
+        assert entry.source == "dispatch"
+        assert entry.compile_seconds > 0
+        # acceptance criterion: at least one of flops/bytes present on CPU
+        assert entry.flops is not None or entry.bytes_accessed is not None
+        assert entry.input_signature  # e.g. "float32[],...,float32[32],float32[32]"
+        assert "float32[32]" in entry.input_signature
+
+    def test_static_leaf_jit_warmup_records_entry(self):
+        sl = StaticLeafJit(lambda state, x: state + x)
+        info = sl.warmup(jax.ShapeDtypeStruct((8,), np.float32), jax.ShapeDtypeStruct((8,), np.float32))
+        entries = cost.get_ledger().entries()
+        assert len(entries) == 1
+        assert entries[0].source == "warmup"
+        assert entries[0].compile_seconds > 0
+        assert entries[0].dispatches == 0  # warmed up, never run
+        # the warmup info carries the ledger costs for the manifest
+        assert info.get("flops") == entries[0].flops
+
+    def test_dispatch_counting_attributes_executions_to_the_variant(self):
+        m = MeanSquaredError()
+        for _ in range(4):
+            m.update(jnp.ones(16), jnp.zeros(16))
+        (entry,) = [e for e in cost.get_ledger().entries() if e.fn == "MeanSquaredError.pure_update"]
+        assert entry.dispatches == 4  # miss first-run + 3 hits
+        assert entry.total_flops == (entry.flops * 4 if entry.flops is not None else None)
+
+    def test_pipeline_warmup_populates_fused_bucket_variants(self):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4))
+        manifest = pipe.warmup(jnp.ones(16), jnp.zeros(16))
+        fused = [e for e in cost.get_ledger().entries() if e.fn == "MeanSquaredError.fused_update"]
+        # one fused variant per chunk-length bucket (1, 2, 4)
+        assert len(fused) == len(PipelineConfig(fuse=4).buckets())
+        assert all(e.source == "warmup" and e.compile_seconds > 0 for e in fused)
+        assert all(e.flops is not None or e.bytes_accessed is not None for e in fused)
+        # and the manifest sums the same estimates
+        assert manifest["estimated_flops"] is not None and manifest["estimated_flops"] > 0
+        assert manifest["estimated_bytes"] is not None and manifest["estimated_bytes"] > 0
+
+    def test_disabled_ledger_records_nothing(self):
+        cost.disable()
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        assert len(cost.get_ledger()) == 0
+
+    def test_ring_bound_drop_oldest_counted(self):
+        ledger = cost.CostLedger()
+        ledger.max_entries = 4
+        for i in range(7):
+            _record_fake(ledger, fn=f"M{i}.pure_update")
+        assert len(ledger) == 4
+        assert ledger.dropped == 3
+        assert [e.fn for e in ledger.entries()] == [f"M{i}.pure_update" for i in (3, 4, 5, 6)]
+
+    def test_mark_since_isolates_new_entries(self):
+        ledger = cost.CostLedger()
+        _record_fake(ledger, flops=100.0, bytes_accessed=10.0)
+        mark = ledger.mark()
+        _record_fake(ledger, flops=7.0, bytes_accessed=3.0)
+        delta = ledger.since(mark)
+        assert delta["variants_compiled"] == 1
+        assert delta["estimated_flops"] == 7.0
+        assert delta["estimated_bytes"] == 3.0
+
+
+# ----------------------------------------------------------- degradation policy
+
+
+class TestPartialBackendDegradation:
+    def test_missing_cost_analysis_warns_once_then_silent(self):
+        ledger = cost.CostLedger()
+
+        class NoAnalysis:
+            pass
+
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            first = ledger.record(
+                fn="M.pure_update", inst="0", static_key="()", input_signature="f32[2]",
+                compiled=NoAnalysis(), compile_seconds=0.5,
+            )
+            second = ledger.record(
+                fn="M.pure_update", inst="0", static_key="()", input_signature="f32[4]",
+                compiled=NoAnalysis(), compile_seconds=0.25,
+            )
+        partial = [w for w in caught if "cost analysis is partial" in str(w.message)]
+        assert len(partial) == 1  # one-shot, recompile-storm pattern
+        # entries still recorded: compile seconds are backend-independent
+        assert first.flops is None and first.bytes_accessed is None
+        assert second is not None and len(ledger) == 2
+        assert ledger.totals()["compile_seconds"] == 0.75
+
+    def test_partial_fields_degrade_to_none_not_garbage(self):
+        ledger = cost.CostLedger()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            entry = _record_fake(ledger, flops=None, bytes_accessed=123.0, memory=False)
+        assert entry.flops is None
+        assert entry.bytes_accessed == 123.0
+        assert entry.peak_bytes is None  # no memory_analysis -> no fabricated peak
+
+
+# ------------------------------------------------------------ rollups and gauges
+
+
+class TestRollupsAndGauges:
+    def test_by_metric_rollup_derives_per_step_cost(self):
+        ledger = cost.CostLedger()
+        a = _record_fake(ledger, fn="Acc.pure_update", flops=100.0, bytes_accessed=10.0)
+        b = _record_fake(ledger, fn="Acc.pure_update", flops=200.0, bytes_accessed=20.0)
+        _record_fake(ledger, fn="Mse.pure_update", flops=50.0, bytes_accessed=5.0)
+        a.dispatches = 3
+        b.dispatches = 1
+        rollup = ledger.by_metric()
+        acc = rollup["Acc"]
+        assert acc["variants"] == 2 and acc["dispatches"] == 4
+        assert acc["estimated_flops"] == 3 * 100.0 + 1 * 200.0
+        assert acc["flops_per_dispatch"] == pytest.approx(500.0 / 4)
+        assert rollup["Mse"]["flops_per_dispatch"] is None  # never dispatched
+
+    def test_record_gauges_feeds_recorder_and_prometheus(self):
+        m = MeanSquaredError()
+        with trace.observe() as rec:
+            for _ in range(3):
+                m.update(jnp.ones(16), jnp.zeros(16))
+            rollup = cost.record_gauges(recorder=rec)
+        assert rollup["MeanSquaredError"]["achieved_flops_per_second"] is not None
+        snap = rec.snapshot()
+        gauges = {g["name"]: g for g in snap["gauges"] if g["labels"].get("metric") == "MeanSquaredError"}
+        assert gauges["cost.compiled_variants"]["value"] >= 1
+        assert gauges["cost.compile_seconds"]["value"] > 0
+        assert gauges["cost.flops_per_dispatch"]["value"] > 0
+        assert gauges["cost.achieved_flops_per_second"]["value"] > 0
+        from torchmetrics_tpu.obs import export
+
+        prom = export.prometheus_text(recorder=rec)
+        assert 'tm_tpu_cost_estimated_flops{metric="MeanSquaredError"}' in prom
+        assert "# HELP tm_tpu_cost_achieved_flops_per_second" in prom
+
+    def test_gauges_without_tracing_still_write_to_recorder(self):
+        # same contract as memory.record_gauges: a scrape-time refresh works
+        # even while hot-path tracing is off
+        m = MeanSquaredError()
+        m.update(jnp.ones(8), jnp.zeros(8))
+        rec = trace.TraceRecorder()
+        cost.record_gauges(recorder=rec)
+        assert any(g["name"] == "cost.compiled_variants" for g in rec.snapshot()["gauges"])
+
+    def test_report_sorts_and_bounds(self):
+        ledger = cost.CostLedger()
+        _record_fake(ledger, fn="A.pure_update", flops=1.0, bytes_accessed=900.0)
+        _record_fake(ledger, fn="B.pure_update", flops=500.0, bytes_accessed=1.0)
+        doc = cost.report(sort="bytes", top_k=1, ledger=ledger)
+        assert [e["fn"] for e in doc["entries"]] == ["A.pure_update"]
+        doc = cost.report(sort="flops", top_k=5, ledger=ledger)
+        assert [e["fn"] for e in doc["entries"]] == ["B.pure_update", "A.pure_update"]
+        with pytest.raises(ValueError, match="sort"):
+            cost.report(sort="bogus", ledger=ledger)
+
+    def test_summary_renders_table(self):
+        ledger = cost.CostLedger()
+        _record_fake(ledger, fn="Acc.pure_update")
+        text = cost.summary(ledger=ledger)
+        assert "cost ledger" in text
+        assert "Acc" in text and "variants=1" in text
+
+
+# --------------------------------------------------------------- /costs endpoint
+
+
+class TestCostsEndpoint:
+    @pytest.fixture(autouse=True)
+    def _server_clean(self):
+        obs_server.stop()
+        yield
+        obs_server.stop()
+
+    def _get_json(self, url):
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+
+    def test_costs_route_serves_topk_sorted(self):
+        m = MeanSquaredError()
+        for _ in range(2):
+            m.update(jnp.ones(16), jnp.zeros(16))
+        srv = obs_server.IntrospectionServer([m], port=0).start()
+        try:
+            status, doc = self._get_json(srv.url + "/costs?sort=bytes&top=3")
+            assert status == 200
+            assert doc["sort"] == "bytes" and doc["top_k"] == 3
+            assert doc["totals"]["entries"] >= 1
+            assert any(r["metric"] == "MeanSquaredError" for r in doc["by_metric"])
+            assert len(doc["entries"]) <= 3
+            ranked = [e["bytes_accessed"] or -1 for e in doc["entries"]]
+            assert ranked == sorted(ranked, reverse=True)
+        finally:
+            srv.stop()
+
+    def test_costs_route_rejects_bad_params(self):
+        srv = obs_server.IntrospectionServer(port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(srv.url + "/costs?sort=bogus", timeout=10)
+            assert err.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(srv.url + "/costs?top=nope", timeout=10)
+            assert err.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_costs_route_leaks_no_threads(self):
+        srv = obs_server.IntrospectionServer(port=0).start()
+        thread = srv._thread
+        self._get_json(srv.url + "/costs")
+        srv.stop()
+        assert not thread.is_alive()
+        assert all("tm-tpu-obs-server" not in t.name for t in threading.enumerate())
+
+    def test_root_lists_costs_route(self):
+        srv = obs_server.IntrospectionServer(port=0).start()
+        try:
+            _, doc = self._get_json(srv.url + "/")
+            assert "/costs" in doc["routes"]
+        finally:
+            srv.stop()
+
+
+# --------------------------------------------------------------- flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, tmp_path):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(
+            m, PipelineConfig(fuse=2, flight_records=5, flight_dump_dir=str(tmp_path))
+        )
+        pipe.run(_pair_batches(12))
+        records = pipe.flight_records()
+        assert len(records) == 5
+        assert [r["batch_index"] for r in records] == [7, 8, 9, 10, 11]  # oldest dropped
+
+    def test_records_carry_lineage_and_stage_timings(self, tmp_path):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(
+            m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path))
+        )
+        pipe.run(_pair_batches(8))
+        records = pipe.flight_records()
+        assert len(records) == 8
+        for record in records:
+            assert record["path"] == "fused"
+            assert record["chunk_id"] in (0, 1)
+            assert record["signature"] == "float32[16],float32[16]"
+            stages = record["stages"]
+            # run()-fed batches time every stage
+            for stage in ("prefetch_wait", "device_put", "dispatch", "commit", "blocked_on_inflight"):
+                assert isinstance(stages[stage], float), stage
+        # chunk membership matches the fuse boundary
+        assert [r["chunk_id"] for r in records] == [0] * 4 + [1] * 4
+
+    def test_feed_path_records_without_run_stage_timings(self, tmp_path):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(m, PipelineConfig(fuse=2, flight_dump_dir=str(tmp_path)))
+        for args in _pair_batches(2):
+            pipe.feed(*args)
+        records = pipe.flight_records()
+        assert len(records) == 2
+        assert records[0]["stages"]["prefetch_wait"] is None  # no run() loop, no producer wait
+        assert records[0]["stages"]["dispatch"] is not None
+
+    def test_flight_disabled_keeps_nothing(self):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(m, PipelineConfig(fuse=2, flight_records=0))
+        pipe.run(_pair_batches(4))
+        assert pipe.flight_records() == []
+        assert pipe.flight_dumps == []
+
+    def test_clean_run_never_dumps(self, tmp_path):
+        m = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        pipe.run(_pair_batches(8))
+        assert pipe.flight_dumps == []
+        assert list(tmp_path.iterdir()) == []
+
+
+class TestFlightDumpOnFault:
+    def test_quarantined_batch_dumps_with_context(self, tmp_path):
+        data = _pair_batches(8, seed=3)
+        m = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[5]):
+                report = pipe.run(data)
+        assert m.updates_quarantined == 1
+        assert report.flight_dumps == 1
+        assert len(pipe.flight_dumps) == 1
+        lines = [json.loads(line) for line in open(pipe.flight_dumps[0], encoding="utf-8")]
+        meta, batches = lines[0], lines[1:]
+        assert meta["type"] == "meta"
+        assert meta["reason"] == "chunk_replay"
+        assert meta["poisoned_batches"] == [5]  # the poisoned batch is NAMED
+        assert meta["pipeline"] == "MeanSquaredError"
+        # ≥1 preceding batch of context rides along
+        indices = [b["batch_index"] for b in batches]
+        assert 5 in indices and min(indices) < 5
+        (poisoned,) = [b for b in batches if b["batch_index"] == 5]
+        assert poisoned["fault"] == "quarantined" and poisoned["path"] == "replay"
+        clean = [b for b in batches if b["batch_index"] != 5]
+        assert all(b["fault"] is None for b in clean)
+
+    def test_warn_skip_replay_dumps_with_skip_named(self, tmp_path):
+        data = _pair_batches(4, seed=4)
+        m = MeanSquaredError(error_policy="warn_skip")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[2]):
+                pipe.run(data)
+        lines = [json.loads(line) for line in open(pipe.flight_dumps[0], encoding="utf-8")]
+        assert lines[0]["poisoned_batches"] == [2]
+        (skipped,) = [b for b in lines[1:] if b["batch_index"] == 2]
+        assert skipped["fault"] == "skipped"
+
+    def test_raise_policy_dumps_before_propagating(self, tmp_path):
+        data = _pair_batches(4, seed=5)
+        m = MeanSquaredError(error_policy="raise")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[1]):
+                with pytest.raises(Exception, match="non-finite"):
+                    pipe.run(data)
+        assert len(pipe.flight_dumps) == 1
+        lines = [json.loads(line) for line in open(pipe.flight_dumps[0], encoding="utf-8")]
+        assert lines[0]["poisoned_batches"] == [1]
+        (raised,) = [b for b in lines[1:] if b["batch_index"] == 1]
+        assert raised["fault"] == "raised"
+
+    def test_eager_path_quarantine_dumps(self, tmp_path):
+        # fuse=1: no chunks, no replay — the quarantine itself must dump
+        data = _pair_batches(4, seed=6)
+        m = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=1, flight_dump_dir=str(tmp_path)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[2]):
+                pipe.run(data)
+        assert m.updates_quarantined == 1
+        assert len(pipe.flight_dumps) == 1
+        lines = [json.loads(line) for line in open(pipe.flight_dumps[0], encoding="utf-8")]
+        assert lines[0]["reason"] == "quarantine"
+        assert lines[0]["poisoned_batches"] == [2]
+
+    def test_dump_is_atomic_valid_jsonl_no_temp_litter(self, tmp_path):
+        data = _pair_batches(6, seed=7)
+        m = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[1]):
+                pipe.run(data)
+        files = sorted(os.listdir(tmp_path))
+        assert len(files) == 1 and files[0].endswith(".jsonl")  # no .tmp litter
+        text = open(pipe.flight_dumps[0], encoding="utf-8").read()
+        assert text.endswith("\n")
+        parsed = [json.loads(line) for line in text.splitlines()]
+        assert parsed[0]["schema"] == 1
+        assert all(p["type"] == "batch" for p in parsed[1:])
+
+    def test_dump_cap_suppresses_then_counts(self, tmp_path):
+        data = _pair_batches(6, seed=8)
+        m = MeanSquaredError(error_policy="warn_skip")
+        pipe = MetricPipeline(
+            m, PipelineConfig(fuse=2, flight_dump_dir=str(tmp_path), flight_max_dumps=1)
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with faults.inject_nan_updates(indices=[0, 3]):  # two chunks degrade
+                pipe.run(data)
+        assert len(pipe.flight_dumps) == 1  # capped
+        assert pipe._flight.dumps_suppressed >= 1
+
+    def test_dump_events_and_counters_when_tracing(self, tmp_path):
+        data = _pair_batches(4, seed=9)
+        m = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with trace.observe() as rec:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with faults.inject_nan_updates(indices=[0]):
+                    pipe.run(data)
+        assert rec.counter_value("flight.dumps") == 1
+        dumps = [e for e in rec.events() if e["name"] == "engine.flight_dump"]
+        assert dumps and dumps[0]["attrs"]["poisoned"] == "0"
+        assert dumps[0]["attrs"]["path"] == pipe.flight_dumps[0]
+
+
+class TestDispatchSpanCorrelation:
+    def test_engine_dispatch_spans_carry_batch_and_chunk_ids(self, tmp_path):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with trace.observe() as rec:
+            pipe.run(_pair_batches(8, seed=10))
+        spans = [e for e in rec.events() if e["name"] == "engine.dispatch"]
+        assert len(spans) == 2
+        assert [s["attrs"]["chunk_id"] for s in spans] == [0, 1]
+        assert [s["attrs"]["batch_index"] for s in spans] == [0, 4]
+        # numeric attrs must NOT label the duration histograms (cardinality)
+        for name, labels, _sum, _count in rec.histogram_totals():
+            if name == "engine.dispatch":
+                assert "chunk_id" not in labels and "batch_index" not in labels
+
+    def test_perfetto_places_pipeline_spans_on_named_track(self, tmp_path):
+        from torchmetrics_tpu.obs import perfetto
+
+        m = MeanSquaredError()
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, flight_dump_dir=str(tmp_path)))
+        with trace.observe() as rec:
+            pipe.run(_pair_batches(4, seed=11))
+            doc = perfetto.chrome_trace(rec)
+        names = {
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert "pipeline MeanSquaredError" in names
+        track = [
+            e
+            for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+            and e["args"]["name"] == "pipeline MeanSquaredError"
+        ][0]["tid"]
+        dispatch = [e for e in doc["traceEvents"] if e.get("name") == "engine.dispatch"]
+        assert dispatch and all(e["tid"] == track for e in dispatch)
+
+
+# ----------------------------------------------------- collections + bench glue
+
+
+class TestCollectionsAndPassthrough:
+    def test_collection_pipeline_attributes_to_collection_class(self, tmp_path):
+        from torchmetrics_tpu.classification import MulticlassF1Score
+
+        collection = MetricCollection(
+            {
+                "acc": MulticlassAccuracy(num_classes=4, validate_args=False),
+                "f1": MulticlassF1Score(num_classes=4, validate_args=False),
+            }
+        )
+        pipe = MetricPipeline(collection, PipelineConfig(fuse=2, flight_dump_dir=str(tmp_path)))
+        rng = np.random.RandomState(12)
+        preds = jnp.asarray(rng.rand(8, 4).astype("float32"))
+        target = jnp.asarray(rng.randint(0, 4, size=8))
+        pipe.feed(preds, target)
+        pipe.feed(preds, target)
+        pipe.flush()
+        fused = [e for e in cost.get_ledger().entries() if e.fn == "MetricCollection.fused_update"]
+        assert fused and fused[0].metric == "MetricCollection"
+
+    def test_regress_run_record_passes_cost_through_unjudged(self):
+        from torchmetrics_tpu.obs.regress import check_regressions, run_record
+
+        result = {
+            "configs": {"stateful": {"value": 10.0, "unit": "us/step"}},
+            "hardware": "cpu",
+            "cost": {"totals": {"entries": 5, "estimated_flops": 123.0}},
+        }
+        record = run_record(result)
+        assert record["cost"]["totals"]["entries"] == 5
+        rows = check_regressions(record, [run_record(result)])
+        assert all(row["config"] == "stateful" for row in rows)  # cost never judged
+
+    def test_aggregate_summarize_renders_cost_section(self):
+        from torchmetrics_tpu.obs import aggregate
+
+        with trace.observe() as rec:
+            rec.set_gauge("cost.estimated_flops", 2.5e9, metric="Acc")
+            agg = aggregate.merge_snapshots([aggregate.host_snapshot(rec)])
+        text = aggregate.summarize(agg)
+        assert "estimated cost" in text
+        assert "2.5G" in text
+
+
+# --------------------------------------------------------------------------- CLI
+
+
+class TestCostCLI:
+    def test_cli_demo_prints_table_exit_zero(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchmetrics_tpu.obs.cost", "--demo", "--top", "5"],
+            capture_output=True,
+            text=True,
+            timeout=300,
+            env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "cost ledger" in proc.stdout
+        assert "MeanSquaredError" in proc.stdout or "MeanMetric" in proc.stdout
+
+    def test_cli_empty_ledger_exits_zero(self):
+        assert cost.main([]) == 0
+
+    def test_cli_json_mode_round_trips(self, capsys):
+        ledger = cost.get_ledger()
+        _record_fake(ledger, fn="Acc.pure_update")
+        assert cost.main(["--json", "--sort", "bytes"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["sort"] == "bytes" and doc["totals"]["entries"] == 1
+
+    def test_cli_bad_sort_exits_two(self):
+        with pytest.raises(SystemExit) as err:
+            cost.main(["--sort", "bogus"])
+        assert err.value.code == 2
+
+
+# ------------------------------------------------------------- helper coverage
+
+
+class TestHelpers:
+    def test_signature_str_renders_compact(self):
+        sig = (((4, 100), "float32", False), ((4,), "int32", False))
+        assert signature_str(sig) == "float32[4,100],int32[4]"
+
+    def test_format_count(self):
+        assert cost.format_count(None) == "?"
+        assert cost.format_count(1234) == "1.2k"
+        assert cost.format_count(2.5e9) == "2.5G"
+        assert cost.format_count(12) == "12"

@@ -641,17 +641,24 @@ class TestPrometheusExpositionAudit:
     """Lock the text exposition with a strict line-format checker."""
 
     def _page(self):
+        from torchmetrics_tpu.obs import cost as obs_cost
         from torchmetrics_tpu.obs import memory as obs_memory
 
         with trace.observe():
             _seed_recorder_deterministically()
             trace.observe_duration("sync.collective", 2.0, op="leaf gather", ok="true")
             trace.inc("c", reason="line1\nline2")
+            # flight-recorder families as the pipeline records them
+            trace.set_gauge("flight.records", 3, pipeline="MeanSquaredError", inst="0")
+            trace.inc("flight.dumps", pipeline="MeanSquaredError")
         m = MeanSquaredError(error_policy="warn_skip")
         m.update(jnp.ones(2), jnp.zeros(2))
         # memory-accounting gauge families (tm_tpu_memory_* / tm_tpu_state_*)
         # must survive the same strict audit as everything else
         obs_memory.record_gauges([m])
+        # cost-ledger gauge families off the real process ledger (the update
+        # above AOT-compiled, so the rollup is non-empty on this backend)
+        obs_cost.record_gauges()
         return export.prometheus_text(metrics=[m])
 
     def test_every_line_parses_and_every_family_has_help_and_type(self):
@@ -722,6 +729,30 @@ class TestPrometheusExpositionAudit:
         for name, info in families.items():
             if info["type"] == "gauge":
                 assert not name.endswith("_total"), name
+
+    def test_cost_and_flight_families_present_with_headers(self):
+        # the tm_tpu_cost_* / tm_tpu_flight_* families: HELP on every family,
+        # gauges never _total, and the per-metric cost rollup labels by class
+        families, samples = _parse_exposition(self._page())
+        for family in (
+            "tm_tpu_cost_compiled_variants",
+            "tm_tpu_cost_compile_seconds",
+            "tm_tpu_cost_flops_per_dispatch",
+            "tm_tpu_cost_estimated_flops",
+            "tm_tpu_flight_records",
+        ):
+            assert families[family]["type"] == "gauge", family
+            assert families[family]["help"], family
+            assert not family.endswith("_total")
+        assert families["tm_tpu_flight_dumps_total"]["type"] == "counter"
+        cost_samples = [
+            labels for name, labels, value in samples if name == "tm_tpu_cost_compiled_variants"
+        ]
+        assert any(labels.get("metric") == "MeanSquaredError" for labels in cost_samples)
+        flight = [
+            (labels, value) for name, labels, value in samples if name == "tm_tpu_flight_records"
+        ]
+        assert flight and flight[0][0]["pipeline"] == "MeanSquaredError"
 
 
 # ---------------------------------------------------- warning-drop visibility
@@ -972,3 +1003,37 @@ class TestDisabledOverhead:
         snap = trace.get_recorder().snapshot()
         assert snap["events"] == [] and snap["gauges"] == []
         assert obs_memory.device_memory_stats() == {}  # CPU: clean skip, no gauges
+
+    def test_cost_ledger_imported_but_off_dispatch_within_noise(self):
+        """With the cost ledger imported but disabled, the hot dispatch path
+        must stay within noise of the seed-equivalent inner body: capture is
+        compile-time only, and `disable()` removes even the per-variant
+        dispatch increment. Same 2x shared-host bound as the smokes above."""
+        from torchmetrics_tpu.obs import cost as obs_cost
+        from torchmetrics_tpu.utils.checks import measure_runtime
+
+        assert not trace.is_enabled()
+        obs_cost.disable()
+        try:
+            m = MeanSquaredError()
+            x, y = jnp.ones(64), jnp.zeros(64)
+            m.update(x, y)  # compile once outside the timed region (off: unrecorded)
+            before = len(obs_cost.get_ledger())
+
+            def instrumented():
+                for _ in range(200):
+                    m._dispatch_update(x, y)
+
+            def seed_equivalent():
+                for _ in range(200):
+                    m._dispatch_update_inner(x, y)
+
+            t_inner = measure_runtime(seed_equivalent, reps=5, warmup=1)
+            t_instr = measure_runtime(instrumented, reps=5, warmup=1)
+            assert t_instr < t_inner * 2.0 + 0.05, (
+                f"cost-off dispatch {t_instr:.4f}s vs seed-equivalent {t_inner:.4f}s"
+            )
+            # the disabled ledger recorded nothing across compile or dispatch
+            assert len(obs_cost.get_ledger()) == before
+        finally:
+            obs_cost.enable()

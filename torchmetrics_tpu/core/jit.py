@@ -20,6 +20,12 @@ Dispatch telemetry (``torchmetrics_tpu.obs``, off by default): cache hits/misses
 a compile-time span on every miss, a per-function cache-size gauge, and eager-
 fallback events, so hot loops that recompile per step — or never hit the jit
 cache at all — are visible instead of silently slow.
+
+Cost attribution (``torchmetrics_tpu.obs.cost``, on by default): every AOT
+compile registers its XLA ``cost_analysis()`` / ``memory_analysis()`` (flops,
+bytes accessed, buffer sizes) and compile seconds with the process-wide cost
+ledger, and every executable run counts against its variant's ledger entry —
+per-metric per-step estimated cost falls out of the ledger instead of a profiler.
 """
 
 from __future__ import annotations
@@ -31,6 +37,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+import torchmetrics_tpu.obs.cost as _cost
 import torchmetrics_tpu.obs.trace as _trace
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -128,6 +135,29 @@ def _aval_signature(leaves) -> Tuple[tuple, ...]:
     return tuple(sig)
 
 
+def signature_str(sig: Tuple[tuple, ...]) -> str:
+    """Compact human form of an :func:`_aval_signature`: ``float32[8,4],int32[8]``.
+
+    The cost ledger and the pipeline flight recorder both render signatures
+    through this, so the *format* matches — but the rendered inputs differ
+    (ledger rows cover state + traced avals, and fused variants the stacked
+    bucket shapes; flight records just the batch's traced leaves). Correlate
+    flight records with spans via ``batch_index``/``chunk_id``, not by exact
+    signature equality.
+    """
+    parts = []
+    for shape, dtype, _weak in sig:
+        dims = ",".join(str(d) for d in shape)
+        parts.append(f"{dtype}[{dims}]")
+    return ",".join(parts)
+
+
+def _static_repr(template: tuple, limit: int = 160) -> str:
+    """Bounded repr of a static template for ledger rows (arrays show as ``<array>``)."""
+    text = repr(template)
+    return text if len(text) <= limit else text[: limit - 3] + "..."
+
+
 class StaticLeafJit:
     """``jit`` wrapper that partitions (args, kwargs) leaves into traced arrays and
     static Python values, caching one compiled program per static configuration.
@@ -155,6 +185,7 @@ class StaticLeafJit:
         self._donate = donate_state
         self._cache: Dict[Any, Callable] = {}  # static key -> jax.jit wrapper
         self._compiled: Dict[Any, Any] = {}  # (static key, aval sig) -> AOT executable
+        self._cost_entries: Dict[Any, Any] = {}  # (static key, aval sig) -> CostEntry
         self._label = _fn_label(fn)
         self._instance = str(next(StaticLeafJit._instance_seq))
         self._hits = 0
@@ -281,6 +312,38 @@ class StaticLeafJit:
                 return _lower_and_compile()
         return _lower_and_compile()
 
+    def _record_cost(self, csig: Any, compiled: Any, seconds: float, source: str) -> None:
+        """Register a fresh executable with the process-wide cost ledger.
+
+        The ledger keeps the XLA ``cost_analysis`` / ``memory_analysis`` this
+        compile produced (previously discarded) plus the compile wall time; the
+        returned entry is kept per variant so the dispatch paths can count
+        executions against it. Ledger failures never break dispatch.
+        """
+        if compiled is None or not _cost.ENABLED:
+            return
+        try:
+            entry = _cost.get_ledger().record(
+                fn=self._label,
+                inst=self._instance,
+                static_key=_static_repr(csig[0][1]),
+                input_signature=signature_str(csig[1]),
+                compiled=compiled,
+                compile_seconds=seconds,
+                source=source,
+            )
+        except Exception:  # pragma: no cover - attribution must never cost correctness
+            return
+        if entry is not None:
+            self._cost_entries[csig] = entry
+
+    def _count_dispatch(self, csig: Any) -> None:
+        """Per-variant execution count for the ledger (one guarded int increment)."""
+        if _cost.ENABLED:
+            entry = self._cost_entries.get(csig)
+            if entry is not None:
+                entry.dispatches += 1
+
     def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         traced, template, unhashable = partition_static_leaves(leaves)
@@ -316,14 +379,17 @@ class StaticLeafJit:
                 # wrapper (already compiled on demand at first use) dispatches
                 return self._get_jitted(key, treedef, tuple(template))(state, traced)
             try:
-                return compiled(state, traced)
+                result = compiled(state, traced)
             except Exception:
                 # input layout/sharding drifted from what the executable was
                 # specialized to (e.g. the state moved devices): drop the stale
                 # specialization and let the generic jit dispatch handle it — a
                 # genuine execution error re-raises identically from there
                 self._compiled.pop(csig, None)
+                self._cost_entries.pop(csig, None)  # its dispatch stream ended with it
                 return self._get_jitted(key, treedef, tuple(template))(state, traced)
+            self._count_dispatch(csig)
+            return result
         self._misses += 1
         jitted = self._get_jitted(key, treedef, tuple(template))  # before the gauge: it reports post-insert size
         if _trace.ENABLED:
@@ -332,6 +398,7 @@ class StaticLeafJit:
             # two same-class metrics would otherwise overwrite each other
             # and understate the compiled-variant total the misses report
             _trace.set_gauge("jit.cache_size", len(self._cache), fn=self._label, inst=self._instance)
+        compile_start = time.perf_counter()
         compiled = self._aot_compile(jitted, state, traced)
         if compiled is None:
             # memoize the unavailability: later same-signature calls must not
@@ -339,7 +406,9 @@ class StaticLeafJit:
             self._compiled[csig] = _AOT_UNAVAILABLE
             return jitted(state, traced)  # on-demand path: compile folds into this call
         self._compiled[csig] = compiled
+        self._record_cost(csig, compiled, time.perf_counter() - compile_start, source="dispatch")
         self._check_recompile_storm()
+        self._count_dispatch(csig)
         if _trace.ENABLED:
             with _trace.span("jit.first_run", fn=self._label):
                 return compiled(state, traced)
@@ -368,12 +437,25 @@ class StaticLeafJit:
         key = (treedef, tuple(template))
         csig = (key, _aval_signature(jax.tree_util.tree_leaves(state)) + _aval_signature(traced))
         if csig in self._compiled:
-            return {"fresh": False, "seconds": 0.0, "fn": self._label}
+            return self._with_cost_fields(csig, {"fresh": False, "seconds": 0.0, "fn": self._label})
         jitted = self._get_jitted(key, treedef, tuple(template))
         start = time.perf_counter()
         self._compiled[csig] = self._aot_compile(jitted, state, traced, reraise=True)
+        seconds = time.perf_counter() - start
+        self._record_cost(csig, self._compiled[csig], seconds, source="warmup")
         self._check_recompile_storm()
-        return {"fresh": True, "seconds": time.perf_counter() - start, "fn": self._label}
+        return self._with_cost_fields(csig, {"fresh": True, "seconds": seconds, "fn": self._label})
+
+    def _with_cost_fields(self, csig: Any, info: Dict[str, Any]) -> Dict[str, Any]:
+        """Attach the variant's ledger costs to a warmup info dict (when known),
+        so warmup manifests carry estimated flops/bytes without re-analysis."""
+        entry = self._cost_entries.get(csig)
+        if entry is not None:
+            if entry.flops is not None:
+                info["flops"] = entry.flops
+            if entry.bytes_accessed is not None:
+                info["bytes_accessed"] = entry.bytes_accessed
+        return info
 
     def cache_info(self) -> Dict[str, Any]:
         """Dispatch-cache accounting: static variants, compiled executables, hit/miss

@@ -25,7 +25,12 @@ Quick start::
     value = metric.compute()
 """
 
-from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig, PipelineReport
+from torchmetrics_tpu.engine.pipeline import (
+    FLIGHT_DIR_ENV,
+    MetricPipeline,
+    PipelineConfig,
+    PipelineReport,
+)
 from torchmetrics_tpu.engine.warmup import (
     CACHE_ENV_VAR,
     build_manifest,
@@ -38,6 +43,7 @@ from torchmetrics_tpu.engine.warmup import (
 
 __all__ = [
     "CACHE_ENV_VAR",
+    "FLIGHT_DIR_ENV",
     "MetricPipeline",
     "PipelineConfig",
     "PipelineReport",

@@ -36,9 +36,20 @@ machinery and turns the hot loop into what XLA wants:
   :mod:`torchmetrics_tpu.engine.warmup`), recording a manifest of what was
   compiled and for how long.
 
-Telemetry (``torchmetrics_tpu.obs``, off by default): ``engine.dispatch`` spans,
-queue-depth / in-flight / fused-chunk-size gauges, prefetch hit/miss and
-padded-step counters, degrade-to-replay events. :meth:`report` returns the same
+- **Flight recorder** — a bounded ring of per-batch lineage records (batch
+  index, input signature, fused-chunk id, per-stage timings: prefetch wait /
+  device put / dispatch / commit / blocked-on-inflight). When a chunk degrades
+  to replay or a batch is quarantined, the ring is dumped as JSONL (atomic,
+  ``utils/fileio``) with the poisoned batch named — a fault in production
+  arrives with its last-K-batch context, not a bare counter increment.
+  ``PipelineConfig.flight_records=0`` disables it; dumps land in
+  ``flight_dump_dir`` / ``$TM_TPU_FLIGHT_DIR`` / ``<tempdir>/tm_tpu_flight``.
+
+Telemetry (``torchmetrics_tpu.obs``, off by default): ``engine.dispatch`` spans
+(carrying numeric ``batch_index``/``chunk_id`` attrs correlatable with the
+flight records and Perfetto tracks), queue-depth / in-flight / fused-chunk-size
+/ flight-ring gauges, prefetch hit/miss and padded-step counters,
+degrade-to-replay and flight-dump events. :meth:`report` returns the same
 accounting as plain ints, available without tracing.
 
 Semantics: the pipeline drives **update-only** accumulation (the epoch pattern —
@@ -49,6 +60,10 @@ per-step; streams that need them should call the metric directly.
 from __future__ import annotations
 
 import itertools
+import json
+import os
+import tempfile
+import time
 from collections import deque
 from dataclasses import dataclass, fields, replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
@@ -65,15 +80,23 @@ from torchmetrics_tpu.core.jit import (
     _aval_signature,
     jit_with_static_leaves,
     partition_static_leaves,
+    signature_str,
 )
 from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.engine import warmup as _warmup
 from torchmetrics_tpu.robust import faults as _faults
 from torchmetrics_tpu.robust.policy import effective_policy, nonfinite_step_indices
+from torchmetrics_tpu.utils.fileio import atomic_write_text
+from torchmetrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["MetricPipeline", "PipelineConfig", "PipelineReport"]
+__all__ = ["FLIGHT_DIR_ENV", "FLIGHT_SCHEMA", "MetricPipeline", "PipelineConfig", "PipelineReport"]
 
 _SLOT = _ArraySlot()
+
+# where flight-recorder dumps land when the config does not name a directory
+FLIGHT_DIR_ENV = "TM_TPU_FLIGHT_DIR"
+# wire format of a dump file (meta line `schema` field); bump on structure change
+FLIGHT_SCHEMA = 1
 
 
 @dataclass
@@ -92,6 +115,15 @@ class PipelineConfig:
             with a masked tail so compiled-variant count stays ``O(log fuse)``
             per batch signature.
         device: target device for prefetched batches (``None``: default device).
+        flight_records: flight-recorder ring capacity — the last this-many
+            batches keep their lineage (per-stage timings, chunk membership)
+            for a dump-on-fault. ``0`` disables the recorder entirely.
+        flight_dump_dir: where fault dumps land. ``None``: the
+            ``TM_TPU_FLIGHT_DIR`` environment variable, else
+            ``<tempdir>/tm_tpu_flight``.
+        flight_max_dumps: hard cap on dump files one pipeline writes — a stream
+            where *every* chunk degrades must not fill the disk; suppressed
+            dumps are counted (``flight.dumps_suppressed``).
     """
 
     fuse: int = 8
@@ -99,6 +131,9 @@ class PipelineConfig:
     prefetch: int = 2
     fuse_buckets: Optional[Tuple[int, ...]] = None
     device: Any = None
+    flight_records: int = 64
+    flight_dump_dir: Optional[str] = None
+    flight_max_dumps: int = 16
 
     def __post_init__(self) -> None:
         if self.fuse < 1:
@@ -107,6 +142,10 @@ class PipelineConfig:
             raise ValueError(f"Expected `max_in_flight` >= 1, got {self.max_in_flight}")
         if self.prefetch < 0:
             raise ValueError(f"Expected `prefetch` >= 0, got {self.prefetch}")
+        if self.flight_records < 0:
+            raise ValueError(f"Expected `flight_records` >= 0, got {self.flight_records}")
+        if self.flight_max_dumps < 0:
+            raise ValueError(f"Expected `flight_max_dumps` >= 0, got {self.flight_max_dumps}")
         if self.fuse_buckets is not None:
             buckets = tuple(sorted(set(int(b) for b in self.fuse_buckets)))
             if not buckets or buckets[0] < 1:
@@ -144,6 +183,7 @@ class PipelineReport:
     prefetch_hits: int = 0
     prefetch_misses: int = 0
     inflight_waits: int = 0
+    flight_dumps: int = 0  # flight-recorder fault dumps written
 
     def host_dispatches(self) -> int:
         """Total host dispatches that advanced metric state."""
@@ -174,17 +214,112 @@ def _normalize_batch(batch: Any) -> Tuple[tuple, dict]:
 class _Chunk:
     """One open fusion chunk: same-signature batches awaiting a fused dispatch."""
 
-    __slots__ = ("sig", "treedef", "template", "traced", "originals")
+    __slots__ = ("sig", "treedef", "template", "traced", "originals", "records", "first_index")
 
-    def __init__(self, sig: tuple, treedef: Any, template: tuple) -> None:
+    def __init__(self, sig: tuple, treedef: Any, template: tuple, first_index: int) -> None:
         self.sig = sig
         self.treedef = treedef
         self.template = template
         self.traced: List[list] = []  # per batch: traced leaves, template order
         self.originals: List[Tuple[tuple, dict]] = []  # per batch: (args, kwargs)
+        self.records: List[dict] = []  # per batch: flight-recorder record (flight on only)
+        self.first_index = first_index  # ingest ordinal of the chunk's first batch
 
     def __len__(self) -> int:
         return len(self.traced)
+
+
+class _FlightRecorder:
+    """Bounded per-batch lineage ring with atomic JSONL dump-on-fault.
+
+    One record per ingested batch (drop-oldest past ``capacity``): batch index,
+    input signature, fused-chunk id, dispatch path, per-stage timings
+    (prefetch wait / device put / dispatch / commit / blocked-on-inflight) and,
+    after a fault, which batch was poisoned. When a chunk degrades to replay or
+    a batch is quarantined, the whole ring is dumped as JSONL — a poisoned
+    batch in production arrives with its last-K-batch context instead of a bare
+    counter increment. Dumping never raises into the pipeline: an unwritable
+    dump directory warns once and the stream keeps flowing.
+    """
+
+    _STAGES = ("prefetch_wait", "device_put", "dispatch", "commit", "blocked_on_inflight")
+
+    def __init__(self, pipeline: str, inst: str, capacity: int, dump_dir: str, max_dumps: int) -> None:
+        self.pipeline = pipeline
+        self.inst = inst
+        self.dump_dir = dump_dir
+        self.max_dumps = max_dumps
+        self._ring: deque = deque(maxlen=capacity)
+        self.dump_paths: List[str] = []
+        self.dumps_suppressed = 0
+        self._warned_unwritable = False
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def open_record(self, batch_index: int, stages: Optional[Dict[str, float]] = None) -> dict:
+        record = {
+            "batch_index": batch_index,
+            "chunk_id": None,
+            "signature": None,
+            "path": None,
+            "fault": None,
+            "stages": dict.fromkeys(self._STAGES),
+        }
+        if stages:
+            record["stages"].update(stages)
+        self._ring.append(record)
+        return record
+
+    def records(self) -> List[dict]:
+        """Copies of the live ring, oldest first (safe to mutate/serialize)."""
+        return [{**r, "stages": dict(r["stages"])} for r in self._ring]
+
+    def dump(self, reason: str, poisoned: List[int], config: Dict[str, Any]) -> Optional[str]:
+        """Write the ring as JSONL (meta line first, then batches oldest-first).
+
+        Atomic via :func:`~torchmetrics_tpu.utils.fileio.atomic_write_text` — a
+        crash mid-dump never leaves a truncated file masquerading as evidence.
+        Returns the path, or ``None`` when suppressed (cap) or unwritable.
+        """
+        if len(self.dump_paths) >= self.max_dumps:
+            self.dumps_suppressed += 1
+            if _trace.ENABLED:
+                _trace.inc("flight.dumps_suppressed", pipeline=self.pipeline)
+            return None
+        meta = {
+            "type": "meta",
+            "schema": FLIGHT_SCHEMA,
+            "pipeline": self.pipeline,
+            "inst": self.inst,
+            "reason": reason,
+            "poisoned_batches": sorted(set(poisoned)),
+            "records": len(self._ring),
+            "ts_unix": time.time(),
+            "config": config,
+        }
+        lines = [json.dumps(meta, sort_keys=True, default=str)]
+        for record in self.records():
+            lines.append(json.dumps({"type": "batch", **record}, sort_keys=True, default=str))
+        name = (
+            f"flight_{self.pipeline}_{os.getpid()}_{self.inst}_{len(self.dump_paths):03d}.jsonl"
+        )
+        path = os.path.join(self.dump_dir, name)
+        try:
+            atomic_write_text(path, "\n".join(lines) + "\n")
+        except OSError as err:
+            if not self._warned_unwritable:
+                self._warned_unwritable = True
+                rank_zero_warn(
+                    f"Flight-recorder dump could not be written to {path!r}:"
+                    f" {type(err).__name__}: {err}. Faults keep their counters but lose"
+                    " their batch-lineage dumps; point `PipelineConfig.flight_dump_dir`"
+                    f" (or ${FLIGHT_DIR_ENV}) at a writable directory.",
+                    RuntimeWarning,
+                )
+            return None
+        self.dump_paths.append(path)
+        return path
 
 
 class MetricPipeline:
@@ -236,8 +371,24 @@ class MetricPipeline:
         self._fused_fns: Dict[tuple, StaticLeafJit] = {}
         self._inflight: deque = deque()
         self._ingested = 0
+        self._chunk_seq = 0
         self._report = PipelineReport()
         self._warmup_manifest: Optional[Dict[str, Any]] = None
+        if config.flight_records > 0:
+            dump_dir = (
+                config.flight_dump_dir
+                or os.environ.get(FLIGHT_DIR_ENV)
+                or os.path.join(tempfile.gettempdir(), "tm_tpu_flight")
+            )
+            self._flight: Optional[_FlightRecorder] = _FlightRecorder(
+                self._label,
+                self._instance,
+                config.flight_records,
+                dump_dir,
+                config.flight_max_dumps,
+            )
+        else:
+            self._flight = None
         # wiring the persistent compile cache is part of engine startup: no-op
         # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
         _warmup.configure_compile_cache()
@@ -256,6 +407,15 @@ class MetricPipeline:
     def warmup_manifest(self) -> Optional[Dict[str, Any]]:
         return self._warmup_manifest
 
+    def flight_records(self) -> List[dict]:
+        """Copies of the flight-recorder ring (empty when ``flight_records=0``)."""
+        return self._flight.records() if self._flight is not None else []
+
+    @property
+    def flight_dumps(self) -> List[str]:
+        """Paths of the fault dumps this pipeline has written."""
+        return list(self._flight.dump_paths) if self._flight is not None else []
+
     def feed(self, *args: Any, **kwargs: Any) -> None:
         """Ingest one batch (positional/keyword update arguments)."""
         self._ingest(args, kwargs)
@@ -268,20 +428,31 @@ class MetricPipeline:
         """
         lookahead = max(1, self.config.prefetch)
         it = iter(batches)
-        pending: deque = deque()  # (args, kwargs, ingested-count at enqueue)
+        pending: deque = deque()  # (args, kwargs, ingested-count at enqueue, stage timings)
         exhausted = False
+        timed = self._flight is not None
         while pending or not exhausted:
             while not exhausted and len(pending) < lookahead:
+                start = time.perf_counter() if timed else 0.0
                 try:
                     raw = next(it)
                 except StopIteration:
                     exhausted = True
                     break
+                produced = time.perf_counter() if timed else 0.0
                 args, kwargs = _normalize_batch(raw)
                 args, kwargs = self._device_put(args, kwargs)
-                pending.append((args, kwargs, self._ingested))
+                stages = None
+                if timed:
+                    # prefetch_wait: host time the source iterator took to yield
+                    # (the producer-bound stall); device_put: transfer issue time
+                    stages = {
+                        "prefetch_wait": round(produced - start, 6),
+                        "device_put": round(time.perf_counter() - produced, 6),
+                    }
+                pending.append((args, kwargs, self._ingested, stages))
             if pending:
-                args, kwargs, stamp = pending.popleft()
+                args, kwargs, stamp, stages = pending.popleft()
                 if stamp < self._ingested:
                     # its transfer was issued before the previous batch was even
                     # ingested — the copy overlapped compute
@@ -292,7 +463,7 @@ class MetricPipeline:
                     self._report.prefetch_misses += 1
                     if _trace.ENABLED:
                         _trace.inc("engine.prefetch_miss", pipeline=self._label)
-                self._ingest(args, kwargs)
+                self._ingest(args, kwargs, stages)
         self.flush()
         return self.report()
 
@@ -410,17 +581,25 @@ class MetricPipeline:
 
         return jax.tree_util.tree_map(_put, (args, kwargs))
 
-    def _ingest(self, args: tuple, kwargs: dict) -> None:
+    def _ingest(self, args: tuple, kwargs: dict, stages: Optional[Dict[str, float]] = None) -> None:
         if _faults.update_faults_active():
             # injected faults apply ONCE per ingested batch, at the pipeline
             # seam; downstream metric.update calls are told not to re-apply
             args, kwargs = _faults.apply_update_fault(args, kwargs)
+        batch_index = self._ingested
         self._ingested += 1
         self._report.batches += 1
+        record = None
+        if self._flight is not None:
+            record = self._flight.open_record(batch_index, stages)
         if _trace.ENABLED:
             _trace.inc("engine.batches", pipeline=self._label)
+            if record is not None:
+                _trace.set_gauge(
+                    "flight.records", len(self._flight), pipeline=self._label, inst=self._instance
+                )
         if not self._fusable:
-            self._drive_per_batch(args, kwargs)
+            self._drive_per_batch(args, kwargs, record)
             return
         if self._eager_leaders:
             # unfusable group leaders advance per batch, in stream order
@@ -432,18 +611,22 @@ class MetricPipeline:
             # through to the per-batch path for this batch
             if self._chunk is not None and len(self._chunk):
                 self._dispatch_chunk()
-            self._drive_fused_leaders_eagerly(args, kwargs)
+            self._drive_fused_leaders_eagerly(args, kwargs, record)
             return
         sig = (treedef, tuple(template), _aval_signature(traced))
+        if record is not None:
+            record["signature"] = signature_str(sig[2])
         if self._chunk is not None and self._chunk.sig != sig:
             self._report.shape_flushes += 1
             if _trace.ENABLED:
                 _trace.inc("engine.shape_flush", pipeline=self._label)
             self._dispatch_chunk()
         if self._chunk is None:
-            self._chunk = _Chunk(sig, treedef, tuple(template))
+            self._chunk = _Chunk(sig, treedef, tuple(template), batch_index)
         self._chunk.traced.append(traced)
         self._chunk.originals.append((args, kwargs))
+        if record is not None:
+            self._chunk.records.append(record)
         if _trace.ENABLED:
             _trace.set_gauge(
                 "engine.queue_depth", len(self._chunk), pipeline=self._label, inst=self._instance
@@ -521,6 +704,8 @@ class MetricPipeline:
 
     def _dispatch_chunk(self) -> None:
         chunk, self._chunk = self._chunk, None
+        cid = self._chunk_seq
+        self._chunk_seq += 1
         n = len(chunk.traced)
         bucket = self._bucket_for(n)
         pad = bucket - n
@@ -539,14 +724,26 @@ class MetricPipeline:
                         reason="nonfinite",
                         steps=",".join(map(str, bad_steps)),
                         chunk=n,
+                        chunk_id=cid,
                     )
-                self._replay_chunk(chunk)
+                self._replay_chunk(chunk, cid)
                 return
         fused = self._get_fused_fn(chunk.treedef, chunk.template)
         state = self._current_fused_state()
+        timed = bool(chunk.records)
+        start = time.perf_counter() if timed else 0.0
         try:
             if _trace.ENABLED:
-                with _trace.span("engine.dispatch", pipeline=self._label, path="fused"):
+                # batch_index/chunk_id are numeric attrs: they land on the span
+                # (correlatable with flight-recorder records and Perfetto) but
+                # never become histogram labels, so cardinality stays bounded
+                with _trace.span(
+                    "engine.dispatch",
+                    pipeline=self._label,
+                    path="fused",
+                    chunk_id=cid,
+                    batch_index=chunk.first_index,
+                ):
                     new_state = fused(state, stacked, valid)
             else:
                 new_state = fused(state, stacked, valid)
@@ -561,10 +758,14 @@ class MetricPipeline:
                     pipeline=self._label,
                     reason=f"{type(err).__name__}",
                     chunk=n,
+                    chunk_id=cid,
                 )
-            self._replay_chunk(chunk)
+            self._replay_chunk(chunk, cid)
             return
+        dispatch_seconds = (time.perf_counter() - start) if timed else 0.0
+        commit_start = time.perf_counter() if timed else 0.0
         self._commit(new_state, n)
+        commit_seconds = (time.perf_counter() - commit_start) if timed else 0.0
         self._report.dispatches += 1
         self._report.fused_batches += n
         self._report.padded_steps += pad
@@ -581,7 +782,13 @@ class MetricPipeline:
             _trace.set_gauge(
                 "engine.queue_depth", 0, pipeline=self._label, inst=self._instance
             )
-        self._ticket(new_state)
+        waited = self._ticket(new_state)
+        for record in chunk.records:
+            record["chunk_id"] = cid
+            record["path"] = "fused"
+            record["stages"]["dispatch"] = round(dispatch_seconds, 6)
+            record["stages"]["commit"] = round(commit_seconds, 6)
+            record["stages"]["blocked_on_inflight"] = round(waited, 6)
 
     def _commit(self, new_state: Any, n: int) -> None:
         if self._is_collection:
@@ -610,10 +817,64 @@ class MetricPipeline:
             for m, prev in zip(metrics, previous):
                 m.__dict__["_fault_applied"] = prev
 
-    def _drive_per_batch(self, args: tuple, kwargs: dict) -> None:
+    def _all_metrics(self) -> List[Metric]:
+        """Every metric the target holds (fault attribution walks them all)."""
+        if self._is_collection:
+            return list(self._target._modules.values())
+        return [self._target]
+
+    def _robust_counts(self) -> Tuple[int, int]:
+        """(quarantined, skipped) totals across the driven metrics — diffed
+        around an update to attribute a fault to the batch that caused it."""
+        quarantined = skipped = 0
+        for m in self._all_metrics():
+            quarantined += int(getattr(m, "updates_quarantined", 0) or 0)
+            skipped += int(getattr(m, "updates_skipped", 0) or 0)
+        return quarantined, skipped
+
+    def _mark_fault(self, record: Optional[dict], before: Tuple[int, int]) -> Optional[str]:
+        """Stamp a flight record with the fault its update triggered, if any."""
+        if record is None:
+            return None
+        quarantined, skipped = self._robust_counts()
+        if quarantined > before[0]:
+            record["fault"] = "quarantined"
+        elif skipped > before[1]:
+            record["fault"] = "skipped"
+        return record["fault"]
+
+    def _dump_flight(self, reason: str, poisoned: List[int]) -> Optional[str]:
+        """Dump the flight ring on a fault; telemetry rides along when tracing."""
+        if self._flight is None:
+            return None
+        config = {
+            "fuse": self.config.fuse,
+            "max_in_flight": self.config.max_in_flight,
+            "prefetch": self.config.prefetch,
+            "buckets": list(self._buckets),
+        }
+        path = self._flight.dump(reason, poisoned, config)
+        if path is not None:
+            self._report.flight_dumps += 1
+            if _trace.ENABLED:
+                _trace.inc("flight.dumps", pipeline=self._label)
+                _trace.event(
+                    "engine.flight_dump",
+                    pipeline=self._label,
+                    reason=reason,
+                    path=path,
+                    poisoned=",".join(map(str, sorted(set(poisoned)))),
+                )
+        return path
+
+    def _drive_per_batch(self, args: tuple, kwargs: dict, record: Optional[dict] = None) -> None:
         """Whole-target per-batch update (fusion off or target unfusable)."""
+        before = self._robust_counts() if record is not None else (0, 0)
+        start = time.perf_counter() if record is not None else 0.0
         if _trace.ENABLED:
-            with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
+            with _trace.span(
+                "engine.dispatch", pipeline=self._label, path="eager", batch_index=self._ingested - 1
+            ):
                 self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
         else:
             self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
@@ -621,7 +882,15 @@ class MetricPipeline:
         self._report.eager_dispatches += 1
         if _trace.ENABLED:
             _trace.inc("engine.eager_batches", pipeline=self._label)
-        self._ticket(self._current_any_state())
+        waited = self._ticket(self._current_any_state())
+        if record is not None:
+            record["path"] = "eager"
+            record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
+            record["stages"]["blocked_on_inflight"] = round(waited, 6)
+            if self._mark_fault(record, before) == "quarantined":
+                # the per-batch path has no replay step: the quarantine itself
+                # is the fault event, so it dumps the lineage directly
+                self._dump_flight("quarantine", [record["batch_index"]])
 
     def _drive_eager_leaders(self, args: tuple, kwargs: dict) -> None:
         def _run() -> None:
@@ -632,7 +901,9 @@ class MetricPipeline:
         self._suppressing_refault(_run)
         self._report.eager_dispatches += len(self._eager_leaders)
 
-    def _drive_fused_leaders_eagerly(self, args: tuple, kwargs: dict) -> None:
+    def _drive_fused_leaders_eagerly(
+        self, args: tuple, kwargs: dict, record: Optional[dict] = None
+    ) -> None:
         """Per-batch fallback for a batch that cannot join a chunk."""
 
         def _run() -> None:
@@ -640,8 +911,12 @@ class MetricPipeline:
                 filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
                 m.update(*args, **filtered)
 
+        before = self._robust_counts() if record is not None else (0, 0)
+        start = time.perf_counter() if record is not None else 0.0
         if _trace.ENABLED:
-            with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
+            with _trace.span(
+                "engine.dispatch", pipeline=self._label, path="eager", batch_index=self._ingested - 1
+            ):
                 self._suppressing_refault(_run)
         else:
             self._suppressing_refault(_run)
@@ -651,31 +926,73 @@ class MetricPipeline:
         # one host dispatch per driven metric (multi-group collections issue
         # several updates per batch), matching _drive_eager_leaders' accounting
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
+        if record is not None:
+            record["path"] = "eager"
+            record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
+            if self._mark_fault(record, before) == "quarantined":
+                self._dump_flight("quarantine", [record["batch_index"]])
 
-    def _replay_chunk(self, chunk: _Chunk) -> None:
+    def _replay_chunk(self, chunk: _Chunk, cid: int) -> None:
         """Per-batch replay of a degraded chunk: the metrics' own guarded updates
-        isolate (skip/quarantine) exactly the poisoned batches."""
+        isolate (skip/quarantine) exactly the poisoned batches.
+
+        The flight recorder dumps the ring exactly once per degraded chunk —
+        after the replay has named the poisoned batches (or immediately when a
+        ``raise`` policy propagates mid-replay), so the dump always carries the
+        fault attribution alongside the preceding batches' lineage.
+        """
         self._report.chunks_replayed += 1
         if _trace.ENABLED:
             _trace.inc("engine.chunks_replayed", pipeline=self._label)
-        for args, kwargs in chunk.originals:
+        poisoned: List[int] = []
+        for step, (args, kwargs) in enumerate(chunk.originals):
+            record = chunk.records[step] if step < len(chunk.records) else None
+            before = self._robust_counts() if record is not None else (0, 0)
+            start = time.perf_counter() if record is not None else 0.0
+
             def _run(args=args, kwargs=kwargs) -> None:
                 for m in self._per_batch_metrics():
                     filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
                     m.update(*args, **filtered)
 
-            if _trace.ENABLED:
-                with _trace.span("engine.dispatch", pipeline=self._label, path="replay"):
+            try:
+                if _trace.ENABLED:
+                    with _trace.span(
+                        "engine.dispatch",
+                        pipeline=self._label,
+                        path="replay",
+                        chunk_id=cid,
+                        batch_index=chunk.first_index + step,
+                    ):
+                        self._suppressing_refault(_run)
+                else:
                     self._suppressing_refault(_run)
-            else:
-                self._suppressing_refault(_run)
+            except BaseException:
+                # raise policy (or an unguarded failure): the faulting batch is
+                # named and the lineage dumped BEFORE the exception propagates
+                if record is not None:
+                    record["chunk_id"] = cid
+                    record["path"] = "replay"
+                    record["fault"] = "raised"
+                    poisoned.append(record["batch_index"])
+                    self._dump_flight("chunk_replay", poisoned)
+                raise
             self._report.replayed_batches += 1
             self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
             if _trace.ENABLED:
                 _trace.inc("engine.replayed_batches", pipeline=self._label)
+            if record is not None:
+                record["chunk_id"] = cid
+                record["path"] = "replay"
+                record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
+                if self._mark_fault(record, before) is not None:
+                    poisoned.append(record["batch_index"])
         if self._is_collection:
             self._target._sync_group_states()
-        self._ticket(self._current_any_state())
+        waited = self._ticket(self._current_any_state())
+        for record in chunk.records:
+            record["stages"]["blocked_on_inflight"] = round(waited, 6)
+        self._dump_flight("chunk_replay", poisoned)
 
     # -------------------------------------------------------------------- plumbing
 
@@ -684,16 +1001,18 @@ class MetricPipeline:
             return {name: m._state_values for name, m in self._target._modules.items()}
         return self._target._state_values
 
-    def _ticket(self, state_like: Any) -> None:
+    def _ticket(self, state_like: Any) -> float:
         """Bound the async window: hold a leaf of each dispatched state, block on
-        the oldest once more than ``max_in_flight`` are outstanding."""
+        the oldest once more than ``max_in_flight`` are outstanding. Returns the
+        seconds spent blocked (the flight recorder's ``blocked_on_inflight``)."""
         ticket = None
         for leaf in jax.tree_util.tree_leaves(state_like):
             if isinstance(leaf, jax.Array):
                 ticket = leaf
                 break
         if ticket is None:
-            return  # host-only state (e.g. compute_on_cpu lists): nothing async
+            return 0.0  # host-only state (e.g. compute_on_cpu lists): nothing async
+        waited = 0.0
         self._inflight.append(ticket)
         while len(self._inflight) > self.config.max_in_flight:
             oldest = self._inflight.popleft()
@@ -702,11 +1021,14 @@ class MetricPipeline:
                 self._report.inflight_waits += 1
                 if _trace.ENABLED:
                     _trace.inc("engine.inflight_waits", pipeline=self._label)
+            start = time.perf_counter()
             jax.block_until_ready(oldest)
+            waited += time.perf_counter() - start
         if _trace.ENABLED:
             _trace.set_gauge(
                 "engine.in_flight", len(self._inflight), pipeline=self._label, inst=self._instance
             )
+        return waited
 
     def _check_buffer_overflow(self) -> None:
         for m in self._per_batch_metrics():

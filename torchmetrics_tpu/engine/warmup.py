@@ -181,6 +181,12 @@ def build_manifest(entries: List[Dict[str, Any]], cache_dir: Optional[str] = Non
     Each entry comes from :meth:`StaticLeafJit.warmup` plus the pipeline's
     bucket/shape annotations; the manifest adds schema/backend/cache context and
     the compile-time total so one record describes the whole warmup pass.
+
+    Entries carry per-variant ``flops`` / ``bytes_accessed`` when the cost
+    ledger could read them off the compiled executable (cached variants
+    included); the summed ``estimated_flops`` / ``estimated_bytes`` answer
+    "what does one pass over every precompiled variant cost" next to "what did
+    compiling them cost" — ``None`` when the backend reported no cost analysis.
     """
     backend = None
     try:
@@ -190,6 +196,10 @@ def build_manifest(entries: List[Dict[str, Any]], cache_dir: Optional[str] = Non
     except Exception:  # pragma: no cover - warmup without an initializable backend
         pass
     fresh = [e for e in entries if e.get("fresh")]
+    flops = [e["flops"] for e in entries if isinstance(e.get("flops"), (int, float))]
+    bytes_accessed = [
+        e["bytes_accessed"] for e in entries if isinstance(e.get("bytes_accessed"), (int, float))
+    ]
     return {
         "schema_version": MANIFEST_SCHEMA,
         "created_unix": time.time(),
@@ -199,6 +209,8 @@ def build_manifest(entries: List[Dict[str, Any]], cache_dir: Optional[str] = Non
         "variants": len(entries),
         "fresh_compiles": len(fresh),
         "total_compile_seconds": round(sum(float(e.get("seconds", 0.0)) for e in fresh), 6),
+        "estimated_flops": sum(flops) if flops else None,
+        "estimated_bytes": sum(bytes_accessed) if bytes_accessed else None,
     }
 
 

@@ -35,10 +35,15 @@ recorder:
   wrapper and collection rollups with alias dedup), guarded
   ``device.memory_stats()`` polling, all recordable as ``memory.*`` /
   ``state.*`` gauges.
+- :mod:`~torchmetrics_tpu.obs.cost` — the XLA cost ledger: every AOT-compiled
+  variant's ``cost_analysis()`` / ``memory_analysis()`` (flops, bytes accessed,
+  buffer sizes) plus compile seconds and per-variant dispatch counts, rolled up
+  into per-metric per-step estimated cost and achieved-throughput gauges;
+  ``python -m torchmetrics_tpu.obs.cost`` prints the ledger table.
 - :mod:`~torchmetrics_tpu.obs.server` — live introspection over HTTP
-  (``/metrics``, ``/healthz``, ``/readyz``, ``/snapshot``, ``/memory``) on a
-  stdlib daemon-thread server; ``python -m torchmetrics_tpu.obs.serve``
-  for a standalone endpoint.
+  (``/metrics``, ``/healthz``, ``/readyz``, ``/snapshot``, ``/memory``,
+  ``/costs``) on a stdlib daemon-thread server;
+  ``python -m torchmetrics_tpu.obs.serve`` for a standalone endpoint.
 
 Typical use::
 
@@ -53,8 +58,19 @@ Typical use::
 
 # note: `obs.aggregate` stays the *submodule* (its entry point is
 # `obs.aggregate.aggregate()`); only the clash-free helper names are re-exported
-from torchmetrics_tpu.obs import aggregate, export, memory, perfetto, profile, regress, server, trace
+from torchmetrics_tpu.obs import (
+    aggregate,
+    cost,
+    export,
+    memory,
+    perfetto,
+    profile,
+    regress,
+    server,
+    trace,
+)
 from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
+from torchmetrics_tpu.obs.cost import get_ledger as cost_ledger
 from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
 from torchmetrics_tpu.obs.memory import device_memory_stats, footprint, record_gauges
 from torchmetrics_tpu.obs.perfetto import chrome_trace, write_trace
@@ -82,6 +98,8 @@ __all__ = [
     "annotate",
     "chrome_trace",
     "collect",
+    "cost",
+    "cost_ledger",
     "device_memory_stats",
     "disable",
     "enable",

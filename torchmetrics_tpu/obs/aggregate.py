@@ -273,10 +273,14 @@ def summarize(agg: Dict[str, Any]) -> str:
         for counter in agg["counters"]:
             label = " ".join(f"{k}={v}" for k, v in sorted(counter["labels"].items()))
             lines.append(f"  {counter['name']:<{width}}  {counter['value']:>10g}  {label}")
-    # memory-accounting gauges (obs/memory.py) get their own fleet table with
-    # human-readable byte columns; everything else stays in the generic table
+    # memory-accounting gauges (obs/memory.py) and cost-ledger gauges
+    # (obs/cost.py) get their own fleet tables with human-readable columns;
+    # everything else stays in the generic table
     memory_gauges = [g for g in agg["gauges"] if g["name"].startswith("memory.")]
-    other_gauges = [g for g in agg["gauges"] if not g["name"].startswith("memory.")]
+    cost_gauges = [g for g in agg["gauges"] if g["name"].startswith("cost.")]
+    other_gauges = [
+        g for g in agg["gauges"] if not g["name"].startswith(("memory.", "cost."))
+    ]
     if other_gauges:
         lines.append("-- gauges (per-host | max) --")
         width = max(len(g["name"]) for g in other_gauges)
@@ -299,6 +303,20 @@ def summarize(agg: Dict[str, Any]) -> str:
             )
             lines.append(
                 f"  {gauge['name']:<{width}}  {per_host} | max={format_bytes(gauge['max'])}  {label}"
+            )
+    if cost_gauges:
+        from torchmetrics_tpu.obs.cost import format_count
+
+        lines.append("-- estimated cost (per-host | max) --")
+        width = max(len(g["name"]) for g in cost_gauges)
+        for gauge in cost_gauges:
+            label = " ".join(f"{k}={v}" for k, v in sorted(gauge["labels"].items()))
+            per_host = " ".join(
+                f"{h}:{format_count(v)}"
+                for h, v in sorted(gauge["per_host"].items(), key=lambda kv: int(kv[0]))
+            )
+            lines.append(
+                f"  {gauge['name']:<{width}}  {per_host} | max={format_count(gauge['max'])}  {label}"
             )
     if agg["histograms"]:
         lines.append("-- durations (bucket-merged) --")

@@ -175,6 +175,17 @@ _GAUGE_HELP = {
     "engine.queue_depth": "Batches accumulated in the streaming engine's open fusion chunk",
     "engine.in_flight": "Dispatched-but-unawaited chunks in the streaming engine's async window",
     "engine.fused_chunk_size": "Batch count of the streaming engine's last fused scan dispatch",
+    # XLA cost-ledger families (obs/cost.py): per-metric-class rollups of what
+    # the compiled programs are estimated to cost vs what they measurably achieve
+    "cost.compiled_variants": "AOT-compiled executables in the XLA cost ledger for this metric class",
+    "cost.compile_seconds": "Summed XLA compile wall-seconds the metric class's variants cost",
+    "cost.flops_per_dispatch": "Estimated flops per dispatch (dispatch-weighted mean over the class's compiled variants)",
+    "cost.bytes_per_dispatch": "Estimated bytes accessed per dispatch (dispatch-weighted mean over the class's compiled variants)",
+    "cost.estimated_flops": "Cumulative estimated flops dispatched (per-variant XLA cost_analysis x dispatch count)",
+    "cost.estimated_bytes": "Cumulative estimated bytes accessed (per-variant XLA cost_analysis x dispatch count)",
+    "cost.peak_memory_bytes": "Max argument+output+temp bytes any of the class's compiled variants holds live at once",
+    "cost.achieved_flops_per_second": "Estimated flops divided by measured update/dispatch span seconds",
+    "flight.records": "Per-batch lineage records currently held in the pipeline flight-recorder ring",
 }
 
 

@@ -8,7 +8,9 @@ ring buffer. This module renders that ring buffer as Chrome trace-event JSON
 so host spans load *next to* device traces:
 
 - spans → complete ``"X"`` events (``ts``/``dur`` in microseconds) on their
-  recording thread's track, so nesting is preserved exactly;
+  recording thread's track, so nesting is preserved exactly; pipeline stage
+  spans (``engine.*`` with a ``pipeline`` label) instead get their own named
+  track per pipeline, so multiple streams' dispatch cadences read side by side;
 - instant events and warnings → ``"i"`` events;
 - counters and gauges → ``"C"`` counter tracks;
 - **one pid per host**: a single-host export uses the local process index; a
@@ -90,7 +92,25 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
         tids: Dict[Any, int] = {}
 
         def _tid(record: Dict[str, Any]) -> int:
-            raw = record.get("tid", 0)
+            # pipeline stage spans (engine.dispatch etc., labeled by pipeline)
+            # get their own NAMED track per pipeline label, so a trace with
+            # several pipelines shows each stream's dispatch cadence separately
+            # instead of interleaving them all on the recording thread's track
+            attrs = record.get("attrs") or {}
+            if (
+                record.get("kind") == "span"
+                and str(record.get("name", "")).startswith("engine.")
+                and "pipeline" in attrs
+            ):
+                # keyed by (label, recording thread): two same-class pipelines
+                # driven concurrently from different threads emit overlapping
+                # spans, which on ONE track would render as garbled false
+                # nesting — they get separate (identically named) tracks
+                raw: Any = ("pipeline", str(attrs["pipeline"]), record.get("tid", 0))
+                display = f"pipeline {attrs['pipeline']}"
+            else:
+                raw = record.get("tid", 0)
+                display = None
             if raw not in tids:
                 tids[raw] = len(tids)
                 events.append(
@@ -100,7 +120,7 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
                         "pid": pid,
                         "tid": tids[raw],
                         "ts": 0,
-                        "args": {"name": f"thread {tids[raw]}"},
+                        "args": {"name": display or f"thread {tids[raw]}"},
                     }
                 )
             return tids[raw]

@@ -159,6 +159,13 @@ def run_record(
         # trajectory accumulates across rounds, never judged by
         # check_regressions — exactly the `memory` passthrough pattern
         record["engine"] = engine
+    cost = result.get("cost")
+    if isinstance(cost, dict):
+        # XLA cost-ledger summary (per-config variants compiled + estimated
+        # flops/bytes, whole-run totals): the predicted side of the
+        # predicted-vs-measured story accumulates across rounds, never judged
+        # by check_regressions — same passthrough contract as memory/engine
+        record["cost"] = cost
     return record
 
 

@@ -21,6 +21,10 @@ dependencies, localhost by default:
   (:func:`~torchmetrics_tpu.obs.aggregate.host_snapshot`), JSON.
 - ``GET /memory`` — top-K state-memory footprint report
   (:func:`~torchmetrics_tpu.obs.memory.report`; ``?top=K`` to re-rank), JSON.
+- ``GET /costs`` — the XLA cost ledger (:func:`~torchmetrics_tpu.obs.cost.report`):
+  totals, per-metric estimated cost rollups, top-K compiled variants
+  (``?sort=flops|bytes|compile_seconds|dispatches|peak_bytes|total_flops|total_bytes``,
+  ``?top=K``), JSON.
 
 Lifecycle contract: :func:`start` is idempotent (a second call returns the
 running server), :meth:`IntrospectionServer.stop` is idempotent and leaves no
@@ -47,6 +51,7 @@ from urllib.parse import parse_qs, urlparse
 
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import aggregate as _aggregate
+from torchmetrics_tpu.obs import cost as _cost
 from torchmetrics_tpu.obs import export as _export
 from torchmetrics_tpu.obs import memory as _memory
 
@@ -65,7 +70,7 @@ __all__ = [
 ENV_PORT = "TM_TPU_OBS_PORT"
 DEFAULT_PORT = 9464  # the conventional OpenMetrics/collector exporter port
 
-ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory")
+ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory", "/costs")
 
 
 def _resolve_port(port: Optional[int]) -> int:
@@ -125,6 +130,20 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"error": "top must be an integer"}, status=400)
                     return
                 self._send_json(_memory.report(owner.metrics(), top_k=top_k))
+            elif route == "/costs":
+                query = parse_qs(parsed.query)
+                sort = query.get("sort", ["flops"])[0]
+                try:
+                    top_k = int(query.get("top", ["20"])[0])
+                except ValueError:
+                    self._send_json({"error": "top must be an integer"}, status=400)
+                    return
+                try:
+                    payload = _cost.report(sort=sort, top_k=top_k, recorder=owner.recorder)
+                except ValueError as err:  # unknown sort key names the valid ones
+                    self._send_json({"error": str(err)}, status=400)
+                    return
+                self._send_json(payload)
             elif route == "/":
                 self._send_json({"routes": list(ROUTES), "service": "torchmetrics_tpu.obs"})
             else:
@@ -279,6 +298,12 @@ class IntrospectionServer:
             _memory.record_gauges(metrics, recorder=self.recorder)
         except Exception:  # accounting must never break the scrape
             self._rec_inc("server.errors", route="/metrics(accounting)")
+        try:
+            # per-metric estimated-cost + achieved-throughput gauges refresh per
+            # scrape too, so /metrics always carries the current ledger rollup
+            _cost.record_gauges(recorder=self.recorder)
+        except Exception:
+            self._rec_inc("server.errors", route="/metrics(cost)")
         robust_leaves = [metric for _, metric in self._flat_metrics()]
         return _export.prometheus_text(metrics=robust_leaves, recorder=self.recorder)
 

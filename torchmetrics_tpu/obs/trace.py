@@ -324,6 +324,19 @@ class TraceRecorder:
         with self._lock:
             return list(self._events)
 
+    def histogram_totals(self) -> List[Tuple[str, Dict[str, Any], float, int]]:
+        """Per-histogram ``(name, labels, sum_seconds, count)`` rows.
+
+        A cheap read for scrape-time derivations (the cost ledger's achieved-
+        throughput gauges divide estimated flops by these measured span
+        seconds) — ``snapshot()`` would copy the whole event ring for nothing.
+        """
+        with self._lock:
+            return [
+                (name, dict(labels), hist.sum, hist.count)
+                for (name, labels), hist in self._hists.items()
+            ]
+
     def counter_value(self, name: str, **labels: Any) -> float:
         """Value of one counter (0.0 when never incremented). With no labels
         given, sums across every label set of ``name``."""
