@@ -175,6 +175,26 @@ class TestRoutes:
             _get(server.url + "/memory?top=banana")
         assert err.value.code == 400
 
+    def test_memory_nonpositive_top_is_400(self, server):
+        """Zero/negative ?top= used to slip through as a silently-empty report;
+        now it 400s with a clear error, like the /costs bad-sort handling."""
+        for bad in ("0", "-1"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + f"/memory?top={bad}")
+            assert err.value.code == 400
+            assert "positive integer" in json.loads(err.value.read().decode())["error"]
+        status, _ = _get(server.url + "/memory?top=1")  # boundary still serves
+        assert status == 200
+
+    def test_costs_nonpositive_top_is_400(self, server):
+        for bad in ("0", "-7"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + f"/costs?top={bad}")
+            assert err.value.code == 400
+            assert "positive integer" in json.loads(err.value.read().decode())["error"]
+        status, _ = _get(server.url + "/costs?top=1")
+        assert status == 200
+
     def test_unknown_route_404(self, server):
         with pytest.raises(urllib.error.HTTPError) as err:
             _get(server.url + "/nope")

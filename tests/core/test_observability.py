@@ -522,6 +522,8 @@ class TestExporters:
         meta = records[0]
         assert meta.pop("host_id") and meta.pop("wall_clock_anchor") > 0
         assert meta.pop("process_index") == 0
+        build = meta.pop("build_info")
+        assert set(build) == {"version", "jax", "backend", "process_index"}
         assert records == [
             {"type": "meta", "schema_version": 1, "dropped_events": 0, "events": 1},
             {"type": "event", "name": "ev", "attrs": {"k": "v"}},
@@ -571,7 +573,7 @@ class TestExporters:
             trace.inc("c")
         export.write_jsonl(path)
         before = open(path).read()
-        assert before.splitlines()[0].startswith('{"dropped_events"')
+        assert before.splitlines()[0].startswith('{"build_info"')
 
         monkeypatch.setattr(
             fileio.os, "replace", lambda s, d: (_ for _ in ()).throw(OSError("disk full"))
@@ -704,6 +706,52 @@ class TestPrometheusExpositionAudit:
         families, samples = _parse_exposition(self._page())
         escaped = [labels for name, labels, _ in samples if name == "tm_tpu_c_total"]
         assert escaped and escaped[0]["reason"] == "line1\\nline2"
+
+    def test_build_info_gauge_present_with_identity_labels(self):
+        """The standard build-identity gauge: constant 1, labels carry the
+        package/jax versions, backend and process index; strict-parse audited
+        like every other family."""
+        families, samples = _parse_exposition(self._page())
+        assert families["tm_tpu_build_info"]["type"] == "gauge"
+        assert "Build identity" in families["tm_tpu_build_info"]["help"]
+        ((labels, value),) = [
+            (labels, value) for name, labels, value in samples if name == "tm_tpu_build_info"
+        ]
+        assert value == "1"
+        assert set(labels) == {"version", "jax", "backend", "process_index"}
+        from torchmetrics_tpu import __version__
+
+        assert labels["version"] == __version__
+        import jax as jax_mod
+
+        assert labels["jax"] == jax_mod.__version__
+        assert labels["backend"] == "cpu" and labels["process_index"] == "0"
+
+    def test_value_and_alerts_families_survive_strict_parse(self):
+        from torchmetrics_tpu.obs import alerts as obs_alerts
+        from torchmetrics_tpu.obs import values as obs_values
+
+        log = obs_values.ValueLog()
+        rec = trace.TraceRecorder()
+        engine = obs_alerts.AlertEngine(
+            rules=[obs_alerts.AlertRule(name="nf", kind="non_finite", metric="M")],
+            value_log=log,
+            recorder=rec,
+        )
+        log.record("M", "0", "value", 1, float("nan"))
+        rec.set_gauge("value.current", 0.5, metric="M", inst="0", leaf="value")
+        engine.evaluate()
+        engine.record_gauges()
+        families, samples = _parse_exposition(export.prometheus_text(recorder=rec))
+        for family in ("tm_tpu_value_current", "tm_tpu_alerts", "tm_tpu_alerts_firing"):
+            assert families[family]["type"] == "gauge", family
+            assert families[family]["help"], family
+        assert families["tm_tpu_alerts_fired_total"]["type"] == "counter"
+        ((labels, value),) = [
+            (labels, value) for name, labels, value in samples if name == "tm_tpu_alerts"
+        ]
+        assert labels["alertname"] == "nf" and labels["alertstate"] == "firing"
+        assert value == "1"
 
     def test_memory_and_state_families_present_with_headers(self):
         families, samples = _parse_exposition(self._page())

@@ -77,4 +77,6 @@ def test_two_process_aggregate_battery(tmp_path):
         "perfetto_one_pid_per_host": True,
         "degraded_partial_aggregate": True,
         "recovers_after_degrade": True,
+        "alert_fires_fleet_wide_with_host_list": True,
+        "degraded_keeps_partial_alert_state": True,
     }

@@ -8,6 +8,9 @@ bucket-wise, warnings carry host lists, and the Perfetto export renders one
 pid per host. Then both hosts inject a hanging collective under a guard
 timeout and assert the DEGRADED partial-aggregate path (no real collective is
 entered while a fault is injected, so neither host can wedge the other).
+Finally the value-health alert scenario: a watchdog fires on rank 1 only, the
+fleet aggregate reports it firing with the host list attached, and the
+degraded-aggregate path keeps each host's partial alert state loud.
 
 Usage: ``python worker_aggregate.py <process_id> <port> <result_json_path>``
 """
@@ -40,7 +43,7 @@ def main() -> None:
     assert jax.process_count() == 2 and jax.process_index() == pid
 
     from torchmetrics_tpu import robust
-    from torchmetrics_tpu.obs import perfetto, trace
+    from torchmetrics_tpu.obs import alerts, perfetto, trace, values
     from torchmetrics_tpu.obs.aggregate import aggregate
     from torchmetrics_tpu.robust import faults
 
@@ -113,6 +116,43 @@ def main() -> None:
     degraded_counter = [c for c in healthy["counters"] if c["name"] == "aggregate.degraded"]
     assert degraded_counter and degraded_counter[0]["value"] == 2.0  # one per host
     results["recovers_after_degrade"] = True
+
+    # -- 5. cross-host alerts: a watchdog fires on rank 1 ONLY ----------------
+    # (a NaN accuracy on one host must surface fleet-wide with the host named)
+    engine = alerts.configure(
+        alerts.AlertRule(name="acc-nan", kind="non_finite", metric="DemoAccuracy")
+    )
+    if pid == 1:
+        values.get_log().record("DemoAccuracy", "0", "value", 1, float("nan"))
+    engine.evaluate()
+    assert bool(engine.firing()) is (pid == 1)
+    fleet = aggregate()
+    assert fleet["aggregate_degraded"] is False
+    (alert_row,) = fleet["alerts"]
+    assert alert_row["rule"] == "acc-nan" and alert_row["state"] == "firing"
+    assert alert_row["hosts"] == [1]  # firing on any host -> firing fleet-wide
+    assert alert_row["per_host"]["1"]["state"] == "firing"
+    assert fleet["alerts_firing"] == 1
+    results["alert_fires_fleet_wide_with_host_list"] = True
+
+    # -- 6. degraded aggregation keeps partial alert state LOUD ---------------
+    with robust.sync_guard(timeout=0.5, retries=1):
+        with faults.inject_collective_fault(mode="hang", times=10):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                partial = aggregate()
+    assert partial["aggregate_degraded"] is True
+    if pid == 1:
+        # the sick host's local view still carries its own firing alert
+        (alert_row,) = partial["alerts"]
+        assert alert_row["rule"] == "acc-nan" and alert_row["state"] == "firing"
+        assert alert_row["hosts"] == [1]
+    else:
+        # rank 0 cannot see rank 1's alert while degraded — but the aggregate
+        # says so loudly instead of reporting a clean empty fleet
+        assert partial["alerts"] == [] and partial["missing_hosts"] == [1]
+    results["degraded_keeps_partial_alert_state"] = True
+    alerts.uninstall()
 
     trace.disable()
     if pid == 0:

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import torchmetrics_tpu.obs.trace as _trace
+import torchmetrics_tpu.obs.values as _values
 from torchmetrics_tpu.core.buffer import MaskedBuffer
 from torchmetrics_tpu.core.jit import jit_with_static_leaves
 from torchmetrics_tpu.parallel.reductions import Reduction, merge_states
@@ -123,6 +124,12 @@ class Metric(ABC):
     plot_lower_bound: Optional[float] = None
     plot_upper_bound: Optional[float] = None
     plot_legend_name: Optional[str] = None
+
+    # declared range of the computed value, e.g. ``(0.0, 1.0)`` for accuracy
+    # — consumed by the out-of-bounds value watchdog (obs/alerts.py). ``None``
+    # defers to the plot bounds (which already declare the value range for
+    # most metrics); either endpoint may be None for a half-open range.
+    value_bounds: Optional[Sequence[Optional[float]]] = None
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None
@@ -300,6 +307,28 @@ class Metric(ABC):
         from torchmetrics_tpu.obs import memory as _memory
 
         return _memory.footprint(self)
+
+    # ------------------------------------------------------------- value health
+
+    def _resolved_value_bounds(self) -> Optional[tuple]:
+        """Declared ``(lo, hi)`` range of the computed value, or ``None``.
+
+        Explicit :attr:`value_bounds` wins; otherwise the plot bounds double as
+        the declared range (they ARE the metric's value range — e.g. ``[0, 1]``
+        for accuracy/F1/AUROC). Consumed by the value timeline
+        (``obs/values.py``) and the out-of-bounds watchdog (``obs/alerts.py``).
+        """
+        bounds = self.value_bounds
+        if bounds is None:
+            lo, hi = self.plot_lower_bound, self.plot_upper_bound
+            if lo is None and hi is None:
+                return None
+            return (lo, hi)
+        lo, hi = bounds[0], bounds[1]
+        return (
+            None if lo is None else float(lo),
+            None if hi is None else float(hi),
+        )
 
     # ------------------------------------------------------------------ compute groups
 
@@ -936,6 +965,10 @@ class Metric(ABC):
             value = self._compute_synced_value()
         if self.compute_with_cache:
             self._computed = value
+        if _values.ENABLED:
+            # value-health timeline (obs/values.py): fresh computes only —
+            # a cache hit above is the same evaluation, not a new sample
+            _values.record_compute(self, value)
         return value
 
     def _compute_synced_value(self) -> Any:

@@ -45,6 +45,13 @@ machinery and turns the hot loop into what XLA wants:
   ``PipelineConfig.flight_records=0`` disables it; dumps land in
   ``flight_dump_dir`` / ``$TM_TPU_FLIGHT_DIR`` / ``<tempdir>/tm_tpu_flight``.
 
+- **Value-health seam** — with ``PipelineConfig.alert_engine`` set
+  (:mod:`torchmetrics_tpu.obs.alerts`), every committed chunk samples the
+  target's values sync-free (``obs.values.sample_local``) and evaluates the
+  declarative watchdogs; a value rule newly firing mid-stream triggers a
+  flight-recorder dump (reason ``value_alert:<rules>``) so a NaN or frozen
+  metric arrives with the batch lineage that produced it.
+
 Telemetry (``torchmetrics_tpu.obs``, off by default): ``engine.dispatch`` spans
 (carrying numeric ``batch_index``/``chunk_id`` attrs correlatable with the
 flight records and Perfetto tracks), queue-depth / in-flight / fused-chunk-size
@@ -73,6 +80,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import torchmetrics_tpu.obs.trace as _trace
+import torchmetrics_tpu.obs.values as _values
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.core.jit import (
     StaticLeafJit,
@@ -124,6 +132,15 @@ class PipelineConfig:
         flight_max_dumps: hard cap on dump files one pipeline writes — a stream
             where *every* chunk degrades must not fill the disk; suppressed
             dumps are counted (``flight.dumps_suppressed``).
+        alert_engine: an :class:`~torchmetrics_tpu.obs.alerts.AlertEngine` to
+            evaluate per committed chunk — the mid-stream value-health seam.
+            The pipeline samples the target's values **sync-free**
+            (``obs.values.sample_local``: ``pure_compute`` over local state, no
+            collectives, no cache pollution), runs the rules, and triggers a
+            flight-recorder dump when a *value* watchdog newly fires. ``None``
+            (default) disables the seam entirely.
+        alert_every: evaluate the alert engine every Nth committed chunk
+            (``close()`` always runs a final evaluation).
     """
 
     fuse: int = 8
@@ -134,6 +151,8 @@ class PipelineConfig:
     flight_records: int = 64
     flight_dump_dir: Optional[str] = None
     flight_max_dumps: int = 16
+    alert_engine: Any = None
+    alert_every: int = 1
 
     def __post_init__(self) -> None:
         if self.fuse < 1:
@@ -146,6 +165,8 @@ class PipelineConfig:
             raise ValueError(f"Expected `flight_records` >= 0, got {self.flight_records}")
         if self.flight_max_dumps < 0:
             raise ValueError(f"Expected `flight_max_dumps` >= 0, got {self.flight_max_dumps}")
+        if self.alert_every < 1:
+            raise ValueError(f"Expected `alert_every` >= 1, got {self.alert_every}")
         if self.fuse_buckets is not None:
             buckets = tuple(sorted(set(int(b) for b in self.fuse_buckets)))
             if not buckets or buckets[0] < 1:
@@ -389,6 +410,9 @@ class MetricPipeline:
             )
         else:
             self._flight = None
+        self._alert_engine = config.alert_engine
+        self._alert_commits = 0
+        self._alert_warned = False
         # wiring the persistent compile cache is part of engine startup: no-op
         # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
         _warmup.configure_compile_cache()
@@ -480,6 +504,7 @@ class MetricPipeline:
             jax.block_until_ready(self._inflight.popleft())
         if _trace.ENABLED:
             _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
+        self._evaluate_alerts(force=True)
         return self.report()
 
     def compute(self) -> Any:
@@ -789,6 +814,7 @@ class MetricPipeline:
             record["stages"]["dispatch"] = round(dispatch_seconds, 6)
             record["stages"]["commit"] = round(commit_seconds, 6)
             record["stages"]["blocked_on_inflight"] = round(waited, 6)
+        self._evaluate_alerts()
 
     def _commit(self, new_state: Any, n: int) -> None:
         if self._is_collection:
@@ -891,6 +917,7 @@ class MetricPipeline:
                 # the per-batch path has no replay step: the quarantine itself
                 # is the fault event, so it dumps the lineage directly
                 self._dump_flight("quarantine", [record["batch_index"]])
+        self._evaluate_alerts()
 
     def _drive_eager_leaders(self, args: tuple, kwargs: dict) -> None:
         def _run() -> None:
@@ -931,6 +958,7 @@ class MetricPipeline:
             record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
             if self._mark_fault(record, before) == "quarantined":
                 self._dump_flight("quarantine", [record["batch_index"]])
+        self._evaluate_alerts()
 
     def _replay_chunk(self, chunk: _Chunk, cid: int) -> None:
         """Per-batch replay of a degraded chunk: the metrics' own guarded updates
@@ -993,6 +1021,62 @@ class MetricPipeline:
         for record in chunk.records:
             record["stages"]["blocked_on_inflight"] = round(waited, 6)
         self._dump_flight("chunk_replay", poisoned)
+        self._evaluate_alerts()
+
+    # ------------------------------------------------------------ alerting seam
+
+    def _evaluate_alerts(self, force: bool = False) -> None:
+        """Per-committed-chunk value-health evaluation (``config.alert_engine``).
+
+        Samples the target's values sync-free (``pure_update`` streams must not
+        trigger cross-host collectives mid-epoch), runs the rules, and — when a
+        *value* watchdog newly fires — dumps the flight-recorder ring so the
+        bad value arrives with the batch lineage that produced it. A broken
+        engine warns once and the stream keeps flowing.
+        """
+        engine = self._alert_engine
+        if engine is None:
+            return
+        self._alert_commits += 1
+        if not force and self._alert_commits % self.config.alert_every:
+            return
+        try:
+            # sample into the ENGINE's value log (an AlertEngine built with a
+            # custom `value_log=` reads only that log; the global one is just
+            # the default), so mid-stream samples always reach the rules
+            log_hook = getattr(engine, "_log", None)
+            _values.sample_local(
+                self._target, log=log_hook() if callable(log_hook) else None
+            )
+            transitions = engine.evaluate()
+        except Exception as err:
+            if not self._alert_warned:
+                self._alert_warned = True
+                rank_zero_warn(
+                    f"Alert evaluation failed on the {self._label} pipeline and is"
+                    f" disabled for this warning ({type(err).__name__}: {err});"
+                    " the stream keeps flowing but value watchdogs may be stale.",
+                    RuntimeWarning,
+                )
+            return
+        fired = [
+            t for t in transitions if t["to"] == "firing" and t.get("source") == "values"
+        ]
+        if not fired:
+            return
+        rules = sorted({t["rule"] for t in fired})
+        if _trace.ENABLED:
+            _trace.inc("engine.value_alerts", len(fired), pipeline=self._label)
+            _trace.event(
+                "engine.value_alert",
+                pipeline=self._label,
+                rules=",".join(rules),
+                series=",".join(sorted({t["series"] for t in fired})),
+            )
+        # a value watchdog firing mid-stream IS a fault: ship the last-K-batch
+        # lineage with the alert names attached (no poisoned batch to name —
+        # the value, not an input, is what broke)
+        self._dump_flight("value_alert:" + ",".join(rules), [])
 
     # -------------------------------------------------------------------- plumbing
 

@@ -40,9 +40,18 @@ recorder:
   buffer sizes) plus compile seconds and per-variant dispatch counts, rolled up
   into per-metric per-step estimated cost and achieved-throughput gauges;
   ``python -m torchmetrics_tpu.obs.cost`` prints the ledger table.
+- :mod:`~torchmetrics_tpu.obs.values` — per-metric **value** timelines: every
+  fresh ``compute()`` result recorded as labeled scalar leaves with
+  step/wall-clock anchors (bounded rings), surfaced as ``value.current``
+  gauges; plus sync-free mid-stream sampling for the engine's alert seam.
+- :mod:`~torchmetrics_tpu.obs.alerts` — declarative value-health watchdogs
+  over the timelines and the recorder's counters/gauges (non-finite,
+  out-of-declared-bounds, frozen, jump/z-score, absence, threshold) with a
+  pending→firing→resolved state machine, JSONL transition sink, Prometheus
+  ``ALERTS``-style series and fleet-wide cross-host merge.
 - :mod:`~torchmetrics_tpu.obs.server` — live introspection over HTTP
   (``/metrics``, ``/healthz``, ``/readyz``, ``/snapshot``, ``/memory``,
-  ``/costs``) on a stdlib daemon-thread server;
+  ``/costs``, ``/alerts``) on a stdlib daemon-thread server;
   ``python -m torchmetrics_tpu.obs.serve`` for a standalone endpoint.
 
 Typical use::
@@ -60,6 +69,7 @@ Typical use::
 # `obs.aggregate.aggregate()`); only the clash-free helper names are re-exported
 from torchmetrics_tpu.obs import (
     aggregate,
+    alerts,
     cost,
     export,
     memory,
@@ -68,8 +78,10 @@ from torchmetrics_tpu.obs import (
     regress,
     server,
     trace,
+    values,
 )
 from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
+from torchmetrics_tpu.obs.alerts import AlertEngine, AlertRule
 from torchmetrics_tpu.obs.cost import get_ledger as cost_ledger
 from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
 from torchmetrics_tpu.obs.memory import device_memory_stats, footprint, record_gauges
@@ -92,9 +104,12 @@ from torchmetrics_tpu.obs.trace import (
 )
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "IntrospectionServer",
     "TraceRecorder",
     "aggregate",
+    "alerts",
     "annotate",
     "chrome_trace",
     "collect",
@@ -130,6 +145,7 @@ __all__ = [
     "stop_trace",
     "summary",
     "trace",
+    "values",
     "write_jsonl",
     "write_trace",
 ]

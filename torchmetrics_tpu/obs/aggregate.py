@@ -12,7 +12,9 @@ are exactly the ones that diverge per host. This module closes that gap:
 - :func:`merge_snapshots` — pure merge math over any list of host snapshots:
   counters **sum**, gauges keep **per-host values plus the max**, log-scale
   duration histograms merge **bucket-wise**, deduplicated warnings carry the
-  **list of hosts** that hit them.
+  **list of hosts** that hit them, and value-health alerts
+  (:mod:`~torchmetrics_tpu.obs.alerts`) go fleet-wide: **firing on any host →
+  firing in the aggregate**, with the affected hosts listed per alert.
 - :func:`aggregate` — the distributed entry point: ships the local snapshot
   as JSON bytes over the guarded eager collective path
   (``parallel.sync.allgather_host_payloads`` →
@@ -34,8 +36,12 @@ import warnings
 from typing import Any, Dict, List, Optional
 
 import torchmetrics_tpu.obs.trace as trace
+from torchmetrics_tpu.obs import alerts as _alerts
 
 __all__ = ["aggregate", "host_snapshot", "merge_snapshots", "summarize"]
+
+# firing beats pending: a fleet row's state is the worst any host reports
+_ALERT_STATE_RANK = {"pending": 1, "firing": 2}
 
 
 def host_snapshot(
@@ -49,6 +55,9 @@ def host_snapshot(
     """
     rec = recorder if recorder is not None else trace.get_recorder()
     snap = rec.snapshot()
+    from torchmetrics_tpu.obs.export import build_info
+
+    snap["build_info"] = build_info()
     seen: set = set()
     messages: List[str] = []
     for ev in snap["events"]:
@@ -58,6 +67,10 @@ def host_snapshot(
                 seen.add(message)
                 messages.append(message)
     snap["warnings"] = messages
+    # active value-health alerts ride the snapshot so the fleet merge can say
+    # "firing on host 3" — read-only: snapshotting never evaluates rules
+    engine = _alerts.get_engine()
+    snap["alerts"] = engine.active() if engine is not None else []
     snap["n_events"] = len(snap["events"])
     # distinguishes "events were shipped (possibly zero)" from "events were
     # stripped for the cheap wire shape" — the merge keys host_snapshots (and
@@ -98,6 +111,7 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     gauges: Dict[tuple, Dict[str, Any]] = {}
     hists: Dict[tuple, Dict[str, Any]] = {}
     warn_rows: Dict[str, Dict[str, Any]] = {}
+    alert_rows: Dict[tuple, Dict[str, Any]] = {}
     host_snaps: List[Dict[str, Any]] = []
     dropped_events = 0
     events_recorded = 0
@@ -106,14 +120,17 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     for snap in usable:
         meta = snap.get("host", {})
         pidx = int(meta.get("process_index", 0))
-        hosts.append(
-            {
-                "process_index": pidx,
-                "host_id": meta.get("host_id", "?"),
-                "wall_clock_anchor": snap.get("wall_clock_anchor"),
-                "elapsed": snap.get("elapsed"),
-            }
-        )
+        host_row = {
+            "process_index": pidx,
+            "host_id": meta.get("host_id", "?"),
+            "wall_clock_anchor": snap.get("wall_clock_anchor"),
+            "elapsed": snap.get("elapsed"),
+        }
+        if snap.get("build_info"):
+            # build identity per host: a mixed-version fleet is visible in the
+            # aggregate even before the schema gate would exclude anyone
+            host_row["build_info"] = snap["build_info"]
+        hosts.append(host_row)
         dropped_events += int(snap.get("dropped_events", 0))
         events_recorded += int(snap.get("n_events", len(snap.get("events", ()))))
         # foreign/legacy snapshots without the marker: fall back to presence
@@ -154,6 +171,35 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
             row = warn_rows.setdefault(message, {"message": message, "hosts": []})
             if pidx not in row["hosts"]:
                 row["hosts"].append(pidx)
+        for alert in snap.get("alerts", ()):
+            # firing on ANY host makes the fleet row firing, with every
+            # affected host listed — a per-tenant rollout gate must not
+            # average a sick host away
+            key = (str(alert.get("rule")), str(alert.get("series")))
+            row = alert_rows.setdefault(
+                key,
+                {
+                    "rule": alert.get("rule"),
+                    "kind": alert.get("kind"),
+                    "series": alert.get("series"),
+                    "severity": alert.get("severity"),
+                    "state": alert.get("state"),
+                    "hosts": [],
+                    "per_host": {},
+                    "detail": alert.get("detail"),
+                },
+            )
+            state = str(alert.get("state"))
+            if _ALERT_STATE_RANK.get(state, 0) > _ALERT_STATE_RANK.get(str(row["state"]), 0):
+                row["state"] = state
+                row["detail"] = alert.get("detail")
+            if pidx not in row["hosts"]:
+                row["hosts"].append(pidx)
+            row["per_host"][str(pidx)] = {
+                "state": state,
+                "value": alert.get("value"),
+                "detail": alert.get("detail"),
+            }
         host_snaps.append(snap)
 
     for row in gauges.values():
@@ -171,6 +217,8 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
         "gauges": [gauges[key] for key in sorted(gauges)],
         "histograms": [hists[key] for key in sorted(hists)],
         "warnings": [warn_rows[message] for message in sorted(warn_rows)],
+        "alerts": [alert_rows[key] for key in sorted(alert_rows)],
+        "alerts_firing": sum(1 for row in alert_rows.values() if row["state"] == "firing"),
         "dropped_events": dropped_events,
         "events_recorded": events_recorded,
     }
@@ -319,14 +367,23 @@ def summarize(agg: Dict[str, Any]) -> str:
                 f"  {gauge['name']:<{width}}  {per_host} | max={format_count(gauge['max'])}  {label}"
             )
     if agg["histograms"]:
-        lines.append("-- durations (bucket-merged) --")
+        from torchmetrics_tpu.obs.export import _quantile_cols
+
+        lines.append("-- durations (bucket-merged; p50/p95 ~ bucket midpoints) --")
         width = max(len(h["name"]) for h in agg["histograms"])
         for hist in agg["histograms"]:
             label = " ".join(f"{k}={v}" for k, v in sorted(hist["labels"].items()))
             mean = hist["sum"] / hist["count"] if hist["count"] else 0.0
             lines.append(
                 f"  {hist['name']:<{width}}  n={hist['count']:<6} total={hist['sum'] * 1e3:9.3f}ms"
-                f" mean={mean * 1e6:9.1f}us  {label}"
+                f" mean={mean * 1e6:9.1f}us{_quantile_cols(hist)}  {label}"
+            )
+    if agg.get("alerts"):
+        lines.append("-- alerts (worst state across hosts) --")
+        for row in agg["alerts"]:
+            lines.append(
+                f"  {str(row['state']).upper():<8} {row['rule']} ({row['kind']})"
+                f" on {row['series']} — hosts {row['hosts']}: {row['detail']}"
             )
     if agg["warnings"]:
         lines.append("-- warnings (hosts that hit them) --")

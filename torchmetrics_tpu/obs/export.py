@@ -13,12 +13,20 @@ from __future__ import annotations
 
 import json
 import math
+import sys
 from typing import Any, Dict, IO, Iterable, List, Optional, Union
 
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.utils.fileio import atomic_write_text
 
-__all__ = ["collect", "prometheus_text", "summary", "write_jsonl"]
+__all__ = [
+    "build_info",
+    "collect",
+    "histogram_quantile",
+    "prometheus_text",
+    "summary",
+    "write_jsonl",
+]
 
 # every exported series is namespaced; dots in internal names become underscores
 _PROM_PREFIX = "tm_tpu_"
@@ -49,11 +57,46 @@ def _robust_snapshot(metrics: Iterable[Any]) -> List[Dict[str, Any]]:
     return rows
 
 
+def build_info() -> Dict[str, str]:
+    """Identity labels of this process's build — the ``tm_tpu_build_info`` gauge.
+
+    Follows the node-exporter convention: a constant ``1`` gauge whose labels
+    carry the versions, so dashboards can join fleet series against build
+    identity. jax facts are probed lazily and safely: version only when jax is
+    already imported, backend only when one is already initialized (exporting
+    telemetry must never first-touch-initialize a wedged backend — the
+    ``trace._host_meta`` contract).
+    """
+    try:
+        from torchmetrics_tpu import __version__ as version
+    except Exception:  # pragma: no cover - partial installs
+        version = "unknown"
+    jax_version = "not-imported"
+    backend = "uninitialized"
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        jax_version = str(getattr(jax_mod, "__version__", "unknown"))
+        try:
+            from jax._src import xla_bridge as _xla_bridge
+
+            if getattr(_xla_bridge, "_backends", None):  # already initialized
+                backend = str(jax_mod.default_backend())
+        except Exception:  # private-API drift: stay at "uninitialized"
+            pass
+    return {
+        "version": str(version),
+        "jax": jax_version,
+        "backend": backend,
+        "process_index": str(trace._host_meta()["process_index"]),
+    }
+
+
 def collect(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> Dict[str, Any]:
     """One plain-data snapshot: recorder state + per-metric robust counters."""
     rec = recorder if recorder is not None else trace.get_recorder()
     snap = rec.snapshot()
     snap["robust"] = _robust_snapshot(metrics)
+    snap["build_info"] = build_info()
     return snap
 
 
@@ -88,6 +131,7 @@ def write_jsonl(
             "wall_clock_anchor": snap["wall_clock_anchor"],
             "dropped_events": snap["dropped_events"],
             "events": len(snap["events"]),
+            "build_info": snap["build_info"],
         }
     )
     for ev in snap["events"]:
@@ -186,6 +230,11 @@ _GAUGE_HELP = {
     "cost.peak_memory_bytes": "Max argument+output+temp bytes any of the class's compiled variants holds live at once",
     "cost.achieved_flops_per_second": "Estimated flops divided by measured update/dispatch span seconds",
     "flight.records": "Per-batch lineage records currently held in the pipeline flight-recorder ring",
+    # value-health + alerting families (obs/values.py, obs/alerts.py)
+    "value.current": "Latest computed metric value per scalar leaf (the value-health timeline's head)",
+    "alerts": "ALERTS-style series: 1 while the named alert is pending/firing, 0 on resolve",
+    "alerts.firing": "Alerts currently in the firing state",
+    "alerts.pending": "Alerts currently dwelling in the pending state (for_seconds not yet met)",
 }
 
 
@@ -256,7 +305,59 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
     prom = _prom_name("dropped_events") + "_total"
     _prom_header(out, prom, "counter", "Events evicted from the telemetry ring buffer (torchmetrics_tpu.obs)")
     out.append(f"{prom} {snap['dropped_events']}")
+
+    # node-exporter-style identity gauge: constant 1, labels carry the build
+    prom = _prom_name("build_info")
+    _prom_header(
+        out, prom, "gauge",
+        "Build identity of this process: package/jax versions, backend, process index (torchmetrics_tpu.obs)",
+    )
+    out.append(f"{prom}{_prom_labels(snap['build_info'])} 1")
     return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------------------------- quantiles
+
+
+def histogram_quantile(buckets: List[List[float]], q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile of a bucketed duration histogram (seconds).
+
+    ``buckets`` is the snapshot shape — ``[[upper_bound, count], ...]`` with
+    *non-cumulative* per-bucket counts, bounds ascending and ending ``+Inf``.
+    Estimation is **bucket-midpoint interpolation**: the quantile lands in the
+    first bucket whose cumulative count reaches ``q * total`` and is reported
+    as that bucket's midpoint (``(lower + upper) / 2``); the open-ended
+    ``+Inf`` bucket reports its lower bound (the only defensible point).
+    With log-scale buckets this is a coarse-but-honest estimate — the error is
+    bounded by the bucket width, which the summary tables document.
+    Returns ``None`` for an empty histogram.
+    """
+    if not 0.0 < q <= 1.0:
+        raise ValueError(f"Expected quantile in (0, 1], got {q}")
+    total = sum(count for _, count in buckets)
+    if not total:
+        return None
+    target = q * total
+    cumulative = 0.0
+    lower = 0.0
+    for bound, count in buckets:
+        cumulative += count
+        if cumulative >= target and count:
+            if math.isinf(bound):
+                return lower
+            return (lower + bound) / 2.0
+        if not math.isinf(bound):
+            lower = bound
+    return lower  # pragma: no cover - cumulative always reaches target above
+
+
+def _quantile_cols(hist: Dict[str, Any]) -> str:
+    """`` p50=...us p95=...us`` columns for a summary-table histogram row."""
+    p50 = histogram_quantile(hist["buckets"], 0.50)
+    p95 = histogram_quantile(hist["buckets"], 0.95)
+    if p50 is None or p95 is None:
+        return ""
+    return f" p50~{p50 * 1e6:9.1f}us p95~{p95 * 1e6:9.1f}us"
 
 
 # ----------------------------------------------------------------- summary table
@@ -289,7 +390,7 @@ def summary(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder]
             mean = hist["sum"] / hist["count"] if hist["count"] else 0.0
             lines.append(
                 f"  {hist['name']:<{width}}  n={hist['count']:<6} total={hist['sum'] * 1e3:9.3f}ms"
-                f" mean={mean * 1e6:9.1f}us  {label}"
+                f" mean={mean * 1e6:9.1f}us{_quantile_cols(hist)}  {label}"
             )
 
     if snap["robust"]:

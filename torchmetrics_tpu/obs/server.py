@@ -25,6 +25,11 @@ dependencies, localhost by default:
   totals, per-metric estimated cost rollups, top-K compiled variants
   (``?sort=flops|bytes|compile_seconds|dispatches|peak_bytes|total_flops|total_bytes``,
   ``?top=K``), JSON.
+- ``GET /alerts`` — the value-health watchdogs
+  (:mod:`~torchmetrics_tpu.obs.alerts`): rules, pending/firing alerts, bounded
+  transition history, JSON. Scraping evaluates the rules (the Prometheus
+  model); firing alerts also flip ``/healthz`` to degraded with the offending
+  metric and rule named.
 
 Lifecycle contract: :func:`start` is idempotent (a second call returns the
 running server), :meth:`IntrospectionServer.stop` is idempotent and leaves no
@@ -51,6 +56,7 @@ from urllib.parse import parse_qs, urlparse
 
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import aggregate as _aggregate
+from torchmetrics_tpu.obs import alerts as _alerts
 from torchmetrics_tpu.obs import cost as _cost
 from torchmetrics_tpu.obs import export as _export
 from torchmetrics_tpu.obs import memory as _memory
@@ -70,7 +76,24 @@ __all__ = [
 ENV_PORT = "TM_TPU_OBS_PORT"
 DEFAULT_PORT = 9464  # the conventional OpenMetrics/collector exporter port
 
-ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory", "/costs")
+ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory", "/costs", "/alerts")
+
+
+def _parse_top(query: Dict[str, list], default: int = 20) -> int:
+    """``?top=K`` for the top-K report routes: a positive integer or ValueError.
+
+    Zero/negative used to slip through silently (an empty report that looked
+    like "nothing to show"); now they 400 with the same clear-error contract
+    as the ``/costs`` bad-sort handling.
+    """
+    raw = query.get("top", [str(default)])[0]
+    try:
+        top_k = int(raw)
+    except ValueError:
+        raise ValueError("top must be an integer") from None
+    if top_k <= 0:
+        raise ValueError(f"top must be a positive integer, got {top_k}")
+    return top_k
 
 
 def _resolve_port(port: Optional[int]) -> int:
@@ -125,18 +148,18 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/memory":
                 query = parse_qs(parsed.query)
                 try:
-                    top_k = int(query.get("top", ["20"])[0])
-                except ValueError:
-                    self._send_json({"error": "top must be an integer"}, status=400)
+                    top_k = _parse_top(query)
+                except ValueError as err:
+                    self._send_json({"error": str(err)}, status=400)
                     return
                 self._send_json(_memory.report(owner.metrics(), top_k=top_k))
             elif route == "/costs":
                 query = parse_qs(parsed.query)
                 sort = query.get("sort", ["flops"])[0]
                 try:
-                    top_k = int(query.get("top", ["20"])[0])
-                except ValueError:
-                    self._send_json({"error": "top must be an integer"}, status=400)
+                    top_k = _parse_top(query)
+                except ValueError as err:
+                    self._send_json({"error": str(err)}, status=400)
                     return
                 try:
                     payload = _cost.report(sort=sort, top_k=top_k, recorder=owner.recorder)
@@ -144,6 +167,8 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json({"error": str(err)}, status=400)
                     return
                 self._send_json(payload)
+            elif route == "/alerts":
+                self._send_json(owner.alerts_report())
             elif route == "/":
                 self._send_json({"routes": list(ROUTES), "service": "torchmetrics_tpu.obs"})
             else:
@@ -187,12 +212,16 @@ class IntrospectionServer:
         host: str = "127.0.0.1",
         port: Optional[int] = None,
         recorder: Optional[trace.TraceRecorder] = None,
+        alert_engine: Optional[Any] = None,
     ) -> None:
         self._metrics: List[Any] = list(metrics)
         self._metrics_lock = threading.Lock()
         self.host = host
         self.requested_port = _resolve_port(port)
         self.recorder = recorder if recorder is not None else trace.get_recorder()
+        # explicit engine wins; else the process-global one is resolved lazily
+        # per request, so installing an engine after server start still works
+        self._alert_engine = alert_engine
         self._httpd: Optional[_HTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -283,6 +312,32 @@ class IntrospectionServer:
         with self._metrics_lock:
             return list(self._metrics)
 
+    # -------------------------------------------------------------------- alerts
+
+    def alert_engine(self) -> Optional[Any]:
+        """The engine this server reports: explicit, else the process-global."""
+        return self._alert_engine if self._alert_engine is not None else _alerts.get_engine()
+
+    def _evaluated_engine(self, route: str) -> Optional[Any]:
+        """The engine, freshly evaluated (scrape-driven evaluation, the
+        Prometheus model); a broken evaluation is counted, never fatal."""
+        engine = self.alert_engine()
+        if engine is not None:
+            try:
+                # egress lands on THIS server's recorder: a custom-recorder
+                # server's alert counters/events belong on its own page
+                engine.evaluate(recorder=self.recorder)
+            except Exception:
+                self._rec_inc("server.errors", route=f"{route}(alerts)")
+        return engine
+
+    def alerts_report(self) -> Dict[str, Any]:
+        """The /alerts page: rules, active/firing alerts, bounded history."""
+        engine = self._evaluated_engine("/alerts")
+        if engine is None:
+            return {"enabled": False, "n_rules": 0, "rules": [], "active": [], "firing": [], "history": []}
+        return {"enabled": True, **engine.report()}
+
     # ------------------------------------------------------------------- payloads
 
     def render_metrics(self) -> str:
@@ -304,6 +359,14 @@ class IntrospectionServer:
             _cost.record_gauges(recorder=self.recorder)
         except Exception:
             self._rec_inc("server.errors", route="/metrics(cost)")
+        engine = self._evaluated_engine("/metrics")
+        if engine is not None:
+            try:
+                # ALERTS-style series refresh per scrape (alertstate edges
+                # included: resolved labelsets drop to 0)
+                engine.record_gauges(recorder=self.recorder)
+            except Exception:
+                self._rec_inc("server.errors", route="/metrics(alerts)")
         robust_leaves = [metric for _, metric in self._flat_metrics()]
         return _export.prometheus_text(metrics=robust_leaves, recorder=self.recorder)
 
@@ -369,6 +432,20 @@ class IntrospectionServer:
             reasons.append(f"{int(rec_sync_degraded)} degraded sync(s) recorded")
         if rec_agg_degraded:
             reasons.append(f"{int(rec_agg_degraded)} degraded telemetry aggregation(s)")
+        # value-health watchdogs (obs/alerts.py): a firing alert degrades — not
+        # kills — the process, with the offending metric AND rule named
+        firing: List[Dict[str, Any]] = []
+        engine = self._evaluated_engine("/healthz")
+        if engine is not None:
+            try:
+                firing = engine.firing()
+            except Exception:
+                self._rec_inc("server.errors", route="/healthz(alerts)")
+        for alert in firing:
+            reasons.append(
+                f"alert {alert['rule']!r} ({alert['kind']}) firing on {alert['series']}:"
+                f" {alert['detail']}"
+            )
         status = "degraded" if reasons else "ok"
         return {
             "status": status,
@@ -376,6 +453,7 @@ class IntrospectionServer:
             "quarantined": quarantined,
             "skipped": skipped,
             "sync_degraded": degraded_sync,
+            "alerts_firing": firing,
             "n_metrics": len(self.metrics()),
             "trace_enabled": trace.is_enabled(),
         }

@@ -1,0 +1,309 @@
+"""Per-metric value timelines: what the metrics *produce*, recorded over time.
+
+Every observability layer before this one watches the runtime — spans, sync
+payloads, state memory, XLA cost — but none watches the **values** the metrics
+actually compute: a NaN accuracy, a frozen F1 or a drifting AUROC sails
+straight through ``/healthz`` as "ok". This module is the missing timeline:
+
+- :class:`ValueLog` — a bounded, thread-safe registry of per-metric value
+  series. Each ``compute()`` result is flattened into labeled scalar leaves
+  (dict keys become leaf labels, nested containers dot-join) and appended as
+  ``(step, wall_time, value)`` with the metric's ``update_count`` as the step
+  anchor. Rings are bounded (``max_points`` per series, ``max_series``
+  overall, drop-oldest / drop-new-series with counters) so a week-long run
+  cannot OOM the host through its own value history.
+- :func:`record_compute` — the ``core/metric.py`` hook: called from
+  ``Metric._wrapped_compute`` on every *fresh* compute (cache hits are not new
+  evaluations) behind the module flag :data:`ENABLED`, so the disabled path is
+  one attribute load and one branch. Collections and wrappers roll up for
+  free: ``MetricCollection.compute`` drives every member's wrapped compute, so
+  each member records under its own class/instance labels.
+- :func:`sample_local` — a **sync-free** sample of a live metric or
+  collection: values come from ``pure_compute`` over the current local state,
+  so the streaming-engine alert seam (``engine/pipeline.py``) can watch values
+  mid-stream without triggering cross-host collectives or polluting the
+  compute cache. Like ``obs.memory.record_gauges``, an explicit call is its
+  own opt-in and works regardless of :data:`ENABLED`.
+
+Recorded leaves also land as ``value.current`` gauges in the
+:class:`~torchmetrics_tpu.obs.trace.TraceRecorder`, so Prometheus text,
+``/snapshot``, cross-host aggregation and Perfetto counter tracks pick the
+latest values up with no further wiring. The declarative watchdogs over these
+timelines live in :mod:`torchmetrics_tpu.obs.alerts`.
+
+Pure stdlib — values arrive as duck-typed scalars (``.item()`` / ``float()``),
+so importing this module never imports jax or numpy.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import torchmetrics_tpu.obs.trace as trace
+
+__all__ = [
+    "ENABLED",
+    "ValueLog",
+    "disable",
+    "enable",
+    "get_log",
+    "is_enabled",
+    "iter_scalar_leaves",
+    "record_compute",
+    "sample_local",
+]
+
+# THE enabled flag for the passive compute hook; `if values.ENABLED:` is the
+# whole cost of the disabled path in `Metric._wrapped_compute`.
+ENABLED = False
+
+_DEFAULT_MAX_POINTS = 512
+_DEFAULT_MAX_SERIES = 1024
+
+# leaf label for a bare scalar compute() result (no dict/tuple structure)
+ROOT_LEAF = "value"
+
+
+def _as_scalar(value: Any) -> Optional[float]:
+    """Duck-typed scalar extraction: python numbers and size-1 arrays only."""
+    if isinstance(value, bool):
+        return float(value)
+    if isinstance(value, (int, float)):
+        return float(value)
+    size = getattr(value, "size", None)
+    if size == 1:
+        try:
+            item = value.item() if hasattr(value, "item") else value
+            return float(item)
+        except Exception:
+            return None
+    if size is None and getattr(value, "shape", None) == ():
+        try:
+            return float(value)
+        except Exception:
+            return None
+    return None
+
+
+def iter_scalar_leaves(value: Any, prefix: str = "") -> Iterator[Tuple[str, float]]:
+    """Yield ``(leaf_label, float)`` for every scalar leaf of a compute result.
+
+    Dict keys become leaf labels (nested dicts dot-join), tuple/list positions
+    become numeric labels, and a bare scalar gets the label ``"value"``.
+    Non-scalar array leaves (curves, per-class vectors) are skipped — the
+    timeline tracks *scalar* health signals by design.
+    """
+    if isinstance(value, dict):
+        for key in value:
+            yield from iter_scalar_leaves(value[key], f"{prefix}{key}.")
+        return
+    if isinstance(value, (list, tuple)):
+        for index, item in enumerate(value):
+            yield from iter_scalar_leaves(item, f"{prefix}{index}.")
+        return
+    scalar = _as_scalar(value)
+    if scalar is None:
+        return
+    label = prefix[:-1] if prefix else ROOT_LEAF
+    yield (label, scalar)
+
+
+class ValueLog:
+    """Bounded, thread-safe per-metric value timelines."""
+
+    def __init__(
+        self, max_points: int = _DEFAULT_MAX_POINTS, max_series: int = _DEFAULT_MAX_SERIES
+    ) -> None:
+        if max_points < 1:
+            raise ValueError(f"Expected `max_points` >= 1, got {max_points}")
+        self._lock = threading.Lock()
+        self.max_points = int(max_points)
+        self.max_series = int(max_series)
+        self.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            # key -> {"metric", "inst", "leaf", "bounds", "points": deque[(step, wall, value)]}
+            self._series: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+            self.dropped_series = 0
+            self.skipped_nonscalar = 0
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def record(
+        self,
+        metric: str,
+        inst: str,
+        leaf: str,
+        step: int,
+        value: float,
+        bounds: Optional[Tuple[Optional[float], Optional[float]]] = None,
+        wall: Optional[float] = None,
+    ) -> bool:
+        """Append one point; returns False when the series cap refused it."""
+        key = (str(metric), str(inst), str(leaf))
+        wall = time.time() if wall is None else wall
+        with self._lock:
+            row = self._series.get(key)
+            if row is None:
+                if len(self._series) >= self.max_series:
+                    self.dropped_series += 1
+                    return False
+                row = self._series[key] = {
+                    "metric": key[0],
+                    "inst": key[1],
+                    "leaf": key[2],
+                    "bounds": None,
+                    "points": deque(maxlen=self.max_points),
+                }
+            if bounds is not None:
+                row["bounds"] = (bounds[0], bounds[1])
+            row["points"].append((int(step), float(wall), float(value)))
+        return True
+
+    def series(self) -> List[Dict[str, Any]]:
+        """Copies of every series (points as lists, safe to mutate/serialize)."""
+        with self._lock:
+            return [
+                {
+                    "metric": row["metric"],
+                    "inst": row["inst"],
+                    "leaf": row["leaf"],
+                    "bounds": row["bounds"],
+                    "points": list(row["points"]),
+                }
+                for row in self._series.values()
+            ]
+
+    def latest(self, metric: str, leaf: str = ROOT_LEAF, inst: Optional[str] = None) -> Optional[float]:
+        """Most recent value of one series (first matching inst when omitted)."""
+        with self._lock:
+            for (m, i, l), row in self._series.items():
+                if m == metric and l == leaf and (inst is None or i == inst) and row["points"]:
+                    return row["points"][-1][2]
+        return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-data snapshot (the shape behind value sections in exports)."""
+        return {
+            "series": self.series(),
+            "n_series": len(self),
+            "dropped_series": self.dropped_series,
+            "skipped_nonscalar": self.skipped_nonscalar,
+        }
+
+
+_LOG = ValueLog()
+
+
+def get_log() -> ValueLog:
+    return _LOG
+
+
+def is_enabled() -> bool:
+    return ENABLED
+
+
+def enable(reset: bool = True) -> None:
+    """Turn the passive compute hook on; ``reset`` (default) clears history."""
+    global ENABLED
+    if reset:
+        _LOG.clear()
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def _record_value_leaves(
+    metric_label: str,
+    inst: str,
+    step: int,
+    value: Any,
+    bounds: Optional[Tuple[Optional[float], Optional[float]]],
+    recorder: Optional[trace.TraceRecorder],
+    log: Optional[ValueLog],
+) -> int:
+    rec = recorder if recorder is not None else trace.get_recorder()
+    target = log if log is not None else _LOG
+    recorded = 0
+    found_any = False
+    for leaf, scalar in iter_scalar_leaves(value):
+        found_any = True
+        if target.record(metric_label, inst, leaf, step, scalar, bounds=bounds):
+            recorded += 1
+            # latest value as a gauge: Prometheus/snapshot/aggregate/Perfetto
+            # pick it up with no further wiring. Written straight to the
+            # recorder (NOT gated on trace.ENABLED): recording values is its
+            # own opt-in, like the explicit memory-accounting calls.
+            rec.set_gauge("value.current", scalar, metric=metric_label, inst=inst, leaf=leaf)
+            if not math.isfinite(scalar):
+                rec.inc("value.nonfinite", metric=metric_label, leaf=leaf)
+    if not found_any:
+        with target._lock:
+            target.skipped_nonscalar += 1
+    return recorded
+
+
+def record_compute(
+    metric: Any,
+    value: Any,
+    recorder: Optional[trace.TraceRecorder] = None,
+    log: Optional[ValueLog] = None,
+) -> int:
+    """Record one metric's fresh ``compute()`` result into the timeline.
+
+    The ``core/metric.py`` hook (which records into the process-global log;
+    callers holding their own :class:`ValueLog` pass it as ``log``). Defensive
+    end to end — a recording failure must never break ``compute`` — and
+    returns the number of leaves recorded.
+    """
+    try:
+        label = type(metric).__name__
+        inst = str(getattr(metric, "_obs_instance", "0"))
+        step = int(getattr(metric, "_update_count", 0) or 0)
+        resolver = getattr(metric, "_resolved_value_bounds", None)
+        bounds = resolver() if callable(resolver) else None
+        return _record_value_leaves(label, inst, step, value, bounds, recorder, log)
+    except Exception:  # pragma: no cover - recording must never raise into compute
+        return 0
+
+
+def sample_local(
+    obj: Any,
+    recorder: Optional[trace.TraceRecorder] = None,
+    log: Optional[ValueLog] = None,
+) -> int:
+    """Sample a live metric/collection's values WITHOUT sync or cache effects.
+
+    Values come from ``pure_compute`` over the current local state — no
+    cross-host collectives (safe per committed chunk in a multihost stream),
+    no ``_computed`` cache pollution. Metrics that have never been updated are
+    skipped (their defaults are not an evaluation). Works regardless of
+    :data:`ENABLED` — an explicit sampling call is its own opt-in. Returns the
+    number of leaves recorded.
+    """
+    recorded = 0
+    modules = getattr(obj, "_modules", None)
+    metrics = list(modules.values()) if isinstance(modules, dict) else [obj]
+    for metric in metrics:
+        if not int(getattr(metric, "_update_count", 0) or 0):
+            continue
+        pure_compute = getattr(metric, "pure_compute", None)
+        state = getattr(metric, "_state_values", None)
+        if not callable(pure_compute) or not isinstance(state, dict):
+            continue
+        try:
+            value = pure_compute(dict(state))
+        except Exception:  # a broken compute is its own (absent) signal
+            continue
+        recorded += record_compute(metric, value, recorder=recorder, log=log)
+    return recorded
