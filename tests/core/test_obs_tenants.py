@@ -1,0 +1,872 @@
+"""Tenant-scoped observability battery: scope contexts, registry, propagation.
+
+Covers the tenancy tentpole end to end — ``obs/scope.py`` (the contextvar
+scope, the bounded :class:`TenantRegistry`, the ``__overflow__`` collapse) and
+its propagation through every obs layer: recorder label injection, value
+timelines, alert rules with ``tenant=`` globs, memory/cost attribution, the
+``GET /tenants`` route and ``?tenant=`` scoped views (404 on unknown), the
+tenant-naming degraded ``/healthz``, fleet-wide tenant-row merging, and the
+``PipelineConfig.tenant`` session seam. Includes the acceptance demo (two
+pipelines under distinct tenants, one fed a NaN) and the concurrent-scrape
+no-cross-contamination check. CPU-only, deterministic, no sleeps.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig
+from torchmetrics_tpu.obs import aggregate as obs_aggregate
+from torchmetrics_tpu.obs import alerts, export, scope, trace, values
+from torchmetrics_tpu.obs import cost as obs_cost
+from torchmetrics_tpu.obs import memory as obs_memory
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.obs.alerts import AlertEngine, AlertRule
+from torchmetrics_tpu.regression import MeanSquaredError
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    scope.reset()
+    values.disable()
+    values.get_log().clear()
+    alerts.uninstall()
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_server.stop()
+    yield
+    obs_server.stop()
+    alerts.uninstall()
+    values.disable()
+    values.get_log().clear()
+    trace.disable()
+    trace.get_recorder().clear()
+    scope.reset()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def _get_json(url, timeout=10):
+    status, body = _get(url, timeout=timeout)
+    return status, json.loads(body)
+
+
+# ------------------------------------------------------------------ the scope
+
+
+class TestScope:
+    def test_disabled_until_first_scope(self):
+        assert not scope.ENABLED
+        assert scope.current_tenant() is None
+        with scope.scope("acme") as tenant:
+            assert tenant == "acme"
+            assert scope.ENABLED and scope.current_tenant() == "acme"
+        assert scope.current_tenant() is None  # context exited
+        assert scope.ENABLED  # but the feature stays in use (registry live)
+
+    def test_nesting_innermost_wins(self):
+        with scope.scope("outer"):
+            with scope.scope("inner"):
+                assert scope.current_tenant() == "inner"
+            assert scope.current_tenant() == "outer"
+
+    def test_invalid_names_rejected(self):
+        for bad in ("", "   ", None, 7, "__reserved", "__anything"):
+            with pytest.raises((ValueError, TypeError)):
+                with scope.scope(bad):
+                    pass
+        # the one reserved name that round-trips: the runtime hands it back as
+        # an effective label, so it must be re-enterable
+        with scope.scope(scope.OVERFLOW_TENANT) as label:
+            assert label == scope.OVERFLOW_TENANT
+
+    def test_threads_do_not_inherit_ambient_tenant(self):
+        seen = {}
+        with scope.scope("main-tenant"):
+            t = threading.Thread(target=lambda: seen.update(t=scope.current_tenant()))
+            t.start()
+            t.join()
+        assert seen["t"] is None  # fresh thread = fresh context
+
+    def test_registry_tracks_liveness_counts(self):
+        with scope.scope("acct"):
+            m = MeanSquaredError()
+            m.update(jnp.ones(4), jnp.zeros(4))
+            m.update(jnp.ones(4), jnp.zeros(4))
+            m.compute()
+        (row,) = scope.get_registry().rows()
+        assert row["tenant"] == "acct"
+        assert row["updates"] == 2 and row["computes"] == 1
+        assert row["last_step"] > row["first_step"]
+        assert row["last_seen_unix"] >= row["first_seen_unix"]
+
+    def test_captured_tenant_covers_eager_paths_outside_scope(self):
+        with scope.scope("sticky"):
+            m = MeanSquaredError()
+        assert m._obs_tenant == "sticky"
+        m.update(jnp.ones(2), jnp.zeros(2))  # no ambient scope here
+        (row,) = scope.get_registry().rows()
+        assert row["updates"] == 1  # billed to the captured tenant
+
+    def test_ambient_scope_wins_over_captured(self):
+        with scope.scope("a"):
+            m = MeanSquaredError()
+        with scope.scope("b"):
+            m.update(jnp.ones(2), jnp.zeros(2))
+        rows = {r["tenant"]: r for r in scope.get_registry().rows()}
+        assert rows["b"]["updates"] == 1 and rows["a"]["updates"] == 0
+
+    def test_collection_members_inherit_collection_tenant(self):
+        member = MeanSquaredError()  # constructed outside any scope
+        assert member._obs_tenant is None
+        with scope.scope("team"):
+            col = MetricCollection([member])
+        assert col._obs_tenant == "team" and member._obs_tenant == "team"
+
+
+class TestOverflow:
+    def test_past_cap_collapses_to_overflow_with_one_loud_warning(self):
+        scope.configure(max_tenants=3)
+        for i in range(3):
+            with scope.scope(f"t{i}"):
+                pass
+        with pytest.warns(RuntimeWarning, match="registry is FULL"):
+            with scope.scope("t3") as label:
+                assert label == scope.OVERFLOW_TENANT
+        # second overflow tenant: counted, but no second warning
+        with warnings_none():
+            with scope.scope("t4") as label:
+                assert label == scope.OVERFLOW_TENANT
+        reg = scope.get_registry()
+        assert reg.overflow_names == 2 and reg.overflow_registrations == 2
+        rows = {r["tenant"]: r for r in reg.rows()}
+        assert rows[scope.OVERFLOW_TENANT]["collapsed_names"] == 2
+        assert len(rows) == 4  # 3 real + overflow
+
+    def test_overflow_bucket_is_loud_in_gauges(self):
+        scope.configure(max_tenants=1)
+        with scope.scope("only"):
+            pass
+        with pytest.warns(RuntimeWarning):
+            with scope.scope("extra"):
+                pass
+        rec = trace.TraceRecorder()
+        scope.record_gauges(recorder=rec)
+        gauges = {
+            (g["name"], g["labels"].get("tenant")): g["value"]
+            for g in rec.snapshot()["gauges"]
+        }
+        assert gauges[("tenant.overflow_collapsed", None)] == 1.0
+        assert ("tenant.updates", scope.OVERFLOW_TENANT) in gauges
+
+    def test_known_tenant_keeps_its_row_past_cap(self):
+        scope.configure(max_tenants=1)
+        with scope.scope("keeper"):
+            pass
+        with pytest.warns(RuntimeWarning):
+            with scope.scope("spill"):
+                pass
+        with scope.scope("keeper") as label:  # already registered: no overflow
+            assert label == "keeper"
+
+    def test_overflowed_pipeline_still_works(self):
+        """A pipeline whose tenant collapsed into __overflow__ must keep
+        streaming (the collapse is graceful degradation, not a crash)."""
+        scope.configure(max_tenants=1)
+        with scope.scope("only"):
+            pass
+        with pytest.warns(RuntimeWarning):
+            pipe = MetricPipeline(
+                MeanSquaredError(), PipelineConfig(fuse=2, prefetch=0, tenant="spillover")
+            )
+        assert pipe._tenant == scope.OVERFLOW_TENANT
+        pipe.feed(jnp.ones(4), jnp.zeros(4))
+        pipe.feed(jnp.ones(4), jnp.zeros(4))
+        pipe.close()
+        rows = {r["tenant"]: r for r in scope.get_registry().rows()}
+        assert rows[scope.OVERFLOW_TENANT]["updates"] == 2
+        assert rows[scope.OVERFLOW_TENANT]["active_pipelines"] == 0
+
+    def test_overflow_distinct_count_saturates_not_inflates(self):
+        """Past the tracking-set cap, re-registering the same untracked name
+        must not inflate the distinct-name count (honest lower bound)."""
+        scope.configure(max_tenants=1)
+        with scope.scope("only"):
+            pass
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            for _ in range(5):
+                with scope.scope("repeat-offender"):
+                    pass
+        reg = scope.get_registry()
+        assert reg.overflow_names == 1
+        assert reg.overflow_registrations == 5
+        # tracking set is full (cap 1): further distinct names saturate the
+        # count instead of bumping it on every repeat hit
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            for _ in range(3):
+                with scope.scope("untracked-name"):
+                    pass
+        assert reg.overflow_names == 1  # saturated, not 4
+        assert reg.overflow_registrations == 8
+
+
+class warnings_none:
+    """Assert no warnings inside the block (pytest.warns(None) is removed)."""
+
+    def __enter__(self):
+        import warnings as _w
+
+        self._cm = _w.catch_warnings(record=True)
+        self._caught = self._cm.__enter__()
+        _w.simplefilter("always")
+        return self._caught
+
+    def __exit__(self, *exc):
+        self._cm.__exit__(*exc)
+        assert self._caught == [], [str(w.message) for w in self._caught]
+        return False
+
+
+# -------------------------------------------------------- recorder propagation
+
+
+class TestRecorderPropagation:
+    def test_counters_gauges_histograms_spans_events_all_tagged(self):
+        rec = trace.get_recorder()
+        with trace.observe():
+            with scope.scope("acme"):
+                trace.inc("work.items", 2.0)
+                trace.set_gauge("queue.depth", 3.0)
+                trace.observe_duration("step", 1e-3)
+                trace.event("something", detail="x")
+                with trace.span("metric.update", metric="M"):
+                    pass
+            trace.inc("work.items", 1.0)  # outside: untagged
+        snap = rec.snapshot()
+        counters = {(c["name"], c["labels"].get("tenant")): c["value"] for c in snap["counters"]}
+        assert counters[("work.items", "acme")] == 2.0
+        assert counters[("work.items", None)] == 1.0
+        gauges = {(g["name"], g["labels"].get("tenant")) for g in snap["gauges"]}
+        assert ("queue.depth", "acme") in gauges
+        hists = {(h["name"], h["labels"].get("tenant")) for h in snap["histograms"]}
+        assert ("step", "acme") in hists and ("metric.update", "acme") in hists
+        tagged_events = [
+            e for e in snap["events"] if e["attrs"].get("tenant") == "acme"
+        ]
+        assert {e["name"] for e in tagged_events} >= {"something", "metric.update"}
+
+    def test_explicit_tenant_label_never_overwritten(self):
+        rec = trace.TraceRecorder()
+        with scope.scope("ambient"):
+            rec.set_gauge("g", 1.0, tenant="explicit")
+        (gauge,) = rec.snapshot()["gauges"]
+        assert gauge["labels"]["tenant"] == "explicit"
+
+    def test_series_counts_by_label(self):
+        rec = trace.TraceRecorder()
+        with scope.scope("a"):
+            rec.inc("c1")
+            rec.set_gauge("g1", 1.0)
+        with scope.scope("b"):
+            rec.inc("c1")
+        rec.inc("untagged")
+        counts = rec.series_counts_by_label("tenant")
+        assert counts == {"a": 2, "b": 1}
+
+
+# ------------------------------------------------------------- values + alerts
+
+
+class TestValuesAndAlerts:
+    def test_value_timeline_split_per_tenant(self):
+        values.enable()
+        m = MeanSquaredError()
+        with scope.scope("a"):
+            m.update(jnp.ones(2), jnp.zeros(2))
+            m.compute()
+        m.update(jnp.ones(2), jnp.full(2, 3.0))
+        with scope.scope("b"):
+            m.compute()
+        rows = {s["tenant"]: s for s in values.get_log().series()}
+        assert set(rows) == {"a", "b"}
+        assert values.get_log().latest("MeanSquaredError", tenant="a") == 1.0
+
+    def test_value_current_gauge_carries_tenant(self):
+        values.enable()
+        with scope.scope("acct"):
+            m = MeanSquaredError()
+            m.update(jnp.ones(2), jnp.zeros(2))
+            m.compute()
+        gauges = [
+            g for g in trace.get_recorder().snapshot()["gauges"] if g["name"] == "value.current"
+        ]
+        assert gauges and gauges[0]["labels"]["tenant"] == "acct"
+
+    def test_rule_tenant_glob_targets_one_tenant(self):
+        log = values.ValueLog()
+        rec = trace.TraceRecorder()
+        engine = AlertEngine(
+            rules=[AlertRule(name="nf-a", kind="non_finite", metric="*", tenant="tenant-a")],
+            value_log=log,
+            recorder=rec,
+        )
+        log.record("M", "0", "value", 1, float("nan"), tenant="tenant-a")
+        log.record("M", "1", "value", 1, float("nan"), tenant="tenant-b")
+        log.record("M", "2", "value", 1, float("nan"))  # untenanted
+        engine.evaluate()
+        (alert,) = engine.firing()
+        assert alert["tenant"] == "tenant-a" and "@tenant-a" in alert["series"]
+
+    def test_rule_tenant_glob_targets_cohort(self):
+        log = values.ValueLog()
+        engine = AlertEngine(
+            rules=[AlertRule(name="nf", kind="non_finite", metric="*", tenant="team-*")],
+            value_log=log,
+            recorder=trace.TraceRecorder(),
+        )
+        log.record("M", "0", "value", 1, float("nan"), tenant="team-red")
+        log.record("M", "1", "value", 1, float("nan"), tenant="team-blue")
+        log.record("M", "2", "value", 1, float("nan"), tenant="other")
+        engine.evaluate()
+        assert {a["tenant"] for a in engine.firing()} == {"team-red", "team-blue"}
+
+    def test_same_metric_two_tenants_independent_state_machines(self):
+        log = values.ValueLog()
+        engine = AlertEngine(
+            rules=[AlertRule(name="nf", kind="non_finite", metric="M")],
+            value_log=log,
+            recorder=trace.TraceRecorder(),
+        )
+        log.record("M", "0", "value", 1, float("nan"), tenant="a")
+        log.record("M", "0", "value", 1, 0.5, tenant="b")
+        engine.evaluate()
+        (alert,) = engine.firing()
+        assert alert["tenant"] == "a"
+        # tenant a recovers; b goes bad — the machines move independently
+        log.record("M", "0", "value", 2, 0.5, tenant="a")
+        log.record("M", "0", "value", 2, float("nan"), tenant="b")
+        engine.evaluate()
+        (alert,) = engine.firing()
+        assert alert["tenant"] == "b"
+
+    def test_alerts_gauge_series_carry_tenant_label(self):
+        log = values.ValueLog()
+        rec = trace.TraceRecorder()
+        engine = AlertEngine(
+            rules=[AlertRule(name="nf", kind="non_finite", metric="*")],
+            value_log=log,
+            recorder=rec,
+        )
+        log.record("M", "0", "value", 1, float("nan"), tenant="acct")
+        engine.evaluate()
+        engine.record_gauges()
+        rows = [g for g in rec.snapshot()["gauges"] if g["name"] == "alerts"]
+        assert rows and rows[0]["labels"]["tenant"] == "acct"
+
+    def test_tenant_star_glob_excludes_untenanted_series(self):
+        """tenant="*" watches tenanted traffic ONLY — untenanted series must
+        not sweep into a tenant-targeted rule."""
+        log = values.ValueLog()
+        engine = AlertEngine(
+            rules=[AlertRule(name="nf", kind="non_finite", metric="*", tenant="*")],
+            value_log=log,
+            recorder=trace.TraceRecorder(),
+        )
+        log.record("M", "0", "value", 1, float("nan"))  # untenanted NaN
+        log.record("M", "1", "value", 1, float("nan"), tenant="acct")
+        engine.evaluate()
+        assert [a["tenant"] for a in engine.firing()] == ["acct"]
+
+    def test_untenanted_alert_egress_not_mis_attributed_inside_scope(self):
+        """An untenanted alert evaluated inside an ambient tenant scope must
+        keep its egress counters and ALERTS gauges unlabeled."""
+        log = values.ValueLog()
+        rec = trace.TraceRecorder()
+        engine = AlertEngine(
+            rules=[AlertRule(name="nf", kind="non_finite", metric="*")],
+            value_log=log,
+            recorder=rec,
+        )
+        log.record("M", "0", "value", 1, float("nan"))  # untenanted
+        with scope.scope("bystander"):
+            engine.evaluate()
+            engine.record_gauges()
+        snap = rec.snapshot()
+        fired = [c for c in snap["counters"] if c["name"] == "alerts.fired"]
+        assert fired and "tenant" not in fired[0]["labels"]
+        alerts_rows = [g for g in snap["gauges"] if g["name"] == "alerts"]
+        assert alerts_rows and "tenant" not in alerts_rows[0]["labels"]
+        totals = [g for g in snap["gauges"] if g["name"] == "alerts.firing"]
+        assert totals and "tenant" not in totals[0]["labels"]
+
+    def test_tenant_series_gauge_excludes_its_own_meta_families(self):
+        """A tenant owning zero real series must report series=0 even after
+        scrapes wrote the tenant.* meta-gauges (no self-counting)."""
+        rec = trace.TraceRecorder()
+        with scope.scope("idle"):
+            pass
+        scope.record_gauges(recorder=rec)  # writes the 5 meta-gauges for "idle"
+        scope.record_gauges(recorder=rec)  # second scrape must still read 0
+        rows = {
+            g["labels"].get("tenant"): g["value"]
+            for g in rec.snapshot()["gauges"]
+            if g["name"] == "tenant.series"
+        }
+        assert rows["idle"] == 0.0
+
+    def test_registry_wide_gauges_stay_unlabeled_inside_scope(self):
+        rec = trace.TraceRecorder()
+        with scope.scope("acct"):
+            scope.record_gauges(recorder=rec)
+        rows = {g["name"]: g["labels"] for g in rec.snapshot()["gauges"]}
+        assert "tenant" not in rows["tenant.registered"]
+        assert "tenant" not in rows["tenant.overflow_collapsed"]
+
+    def test_untenanted_memory_gauges_stay_unlabeled_inside_scope(self):
+        m = MeanSquaredError()  # no tenant
+        m.update(jnp.ones(4), jnp.zeros(4))
+        rec = trace.TraceRecorder()
+        with scope.scope("bystander"):
+            obs_memory.record_gauges([m], recorder=rec)
+        rows = [g for g in rec.snapshot()["gauges"] if g["name"] == "memory.state_bytes"]
+        assert rows and "tenant" not in rows[0]["labels"]
+
+    def test_absent_rule_placeholder_names_its_tenant(self):
+        """A non-glob tenant= absence rule whose series never existed must
+        still NAME the tenant it watches — the silent-death case is exactly
+        when attribution matters most."""
+        engine = AlertEngine(
+            rules=[
+                AlertRule(
+                    name="acme-gone", kind="absent", metric="Acc",
+                    tenant="acme", max_age_seconds=60.0,
+                )
+            ],
+            value_log=values.ValueLog(),
+            recorder=trace.TraceRecorder(),
+        )
+        engine.evaluate()
+        (alert,) = engine.firing()
+        assert alert["tenant"] == "acme"
+
+    def test_series_rules_filter_on_tenant_label(self):
+        rec = trace.TraceRecorder()
+        engine = AlertEngine(
+            rules=[
+                AlertRule(
+                    name="hot", kind="threshold", series="queue.depth", above=5.0, tenant="a"
+                )
+            ],
+            recorder=rec,
+        )
+        rec.set_gauge("queue.depth", 10.0, tenant="a")
+        rec.set_gauge("queue.depth", 99.0, tenant="b")
+        engine.evaluate()
+        (alert,) = engine.firing()
+        assert alert["tenant"] == "a"
+
+
+# --------------------------------------------------------- memory + cost + export
+
+
+class TestAttribution:
+    def test_memory_gauges_and_report_carry_tenant(self):
+        with scope.scope("acct"):
+            m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        rec = trace.TraceRecorder()
+        obs_memory.record_gauges([m], recorder=rec)
+        rows = [g for g in rec.snapshot()["gauges"] if g["name"] == "memory.state_bytes"]
+        assert rows and rows[0]["labels"]["tenant"] == "acct"
+        report = obs_memory.report([m], tenant="acct")
+        assert report["n_metrics"] == 1 and report["metrics"][0]["tenant"] == "acct"
+        assert obs_memory.report([m], tenant="other")["n_metrics"] == 0
+
+    def test_cost_ledger_entries_and_by_tenant_rollup(self):
+        ledger = obs_cost.get_ledger()
+        mark = ledger.mark()
+        with scope.scope("payer"):
+            m = MeanSquaredError()
+            m.update(jnp.ones(16), jnp.zeros(16))  # AOT compile under the scope
+        entries = [e for e in ledger.entries() if e.seq >= mark]
+        assert entries and all(e.tenant == "payer" for e in entries)
+        rollup = ledger.by_tenant()
+        assert rollup["payer"]["variants"] >= 1
+        assert any(row["tenant"] == "payer" for row in obs_cost.report()["by_tenant"])
+
+    def test_prometheus_tenant_filter_scopes_series(self):
+        rec = trace.TraceRecorder()
+        with scope.scope("a"):
+            rec.inc("work.items", 1.0)
+        with scope.scope("b"):
+            rec.inc("work.items", 2.0)
+        page = export.prometheus_text(recorder=rec, tenant="a")
+        assert 'tenant="a"' in page and 'tenant="b"' not in page
+        assert "tm_tpu_build_info" in page  # meta families stay on scoped pages
+
+    def test_robust_rows_carry_tenant_label(self):
+        with scope.scope("acct"):
+            m = MeanSquaredError(error_policy="warn_skip")
+        m.update(jnp.ones(2), jnp.zeros(2))
+        page = export.prometheus_text(metrics=[m])
+        assert 'tm_tpu_robust_updates_ok_total{instance="0",metric="MeanSquaredError",tenant="acct"} 1' in page
+
+
+# ------------------------------------------------------------------- pipeline
+
+
+class TestPipelineTenant:
+    def test_pipeline_is_a_session(self):
+        m = MeanSquaredError()
+        pipe = MetricPipeline(m, PipelineConfig(fuse=2, prefetch=0, tenant="sess"))
+        assert m._obs_tenant == "sess"
+        rows = {r["tenant"]: r for r in scope.get_registry().rows()}
+        assert rows["sess"]["active_pipelines"] == 1
+        for _ in range(4):
+            pipe.feed(jnp.ones(8), jnp.zeros(8))
+        pipe.close()
+        rows = {r["tenant"]: r for r in scope.get_registry().rows()}
+        assert rows["sess"]["active_pipelines"] == 0
+        assert rows["sess"]["updates"] == 4  # fused commits billed per batch
+        # registration happened ONCE (adopt at construction); per-feed scope
+        # re-entry is contextvar-only and must not read as a batch counter
+        assert rows["sess"]["registrations"] == 1
+        pipe.close()  # idempotent: the session ends exactly once
+        assert scope.get_registry().rows()[0]["active_pipelines"] == 0
+
+    def test_pipeline_spans_and_flight_meta_tagged(self, tmp_path):
+        m = MeanSquaredError(error_policy="quarantine")
+        pipe = MetricPipeline(
+            m,
+            PipelineConfig(
+                fuse=2,
+                prefetch=0,
+                tenant="sess",
+                flight_records=8,
+                flight_dump_dir=str(tmp_path),
+            ),
+        )
+        with trace.observe():
+            pipe.feed(jnp.ones(8), jnp.zeros(8))
+            pipe.feed(jnp.full(8, float("nan")), jnp.zeros(8))  # poisons the chunk
+            pipe.close()
+        snap = trace.get_recorder().snapshot()
+        dispatch_spans = [
+            e for e in snap["events"] if e["kind"] == "span" and e["name"] == "engine.dispatch"
+        ]
+        assert dispatch_spans and all(
+            s["attrs"].get("tenant") == "sess" for s in dispatch_spans
+        )
+        assert pipe.flight_dumps, "poisoned chunk must have dumped"
+        meta = json.loads(open(pipe.flight_dumps[0]).readline())
+        assert meta["tenant"] == "sess"
+
+    def test_close_decrements_session_even_when_flush_raises(self):
+        """A raise-policy failure during the final flush must not leak
+        active_pipelines=1 forever."""
+        m = MeanSquaredError(error_policy="raise")
+        pipe = MetricPipeline(m, PipelineConfig(fuse=4, prefetch=0, tenant="doomed"))
+        pipe.feed(jnp.full(4, float("nan")), jnp.zeros(4))  # poisons the open chunk
+        with pytest.raises(Exception):
+            pipe.close()
+        rows = {r["tenant"]: r for r in scope.get_registry().rows()}
+        assert rows["doomed"]["active_pipelines"] == 0
+
+    def test_invalid_tenant_rejected_at_config(self):
+        with pytest.raises(ValueError):
+            PipelineConfig(tenant="")
+        with pytest.raises(ValueError):
+            PipelineConfig(tenant="__reserved")
+
+
+# --------------------------------------------------------------------- server
+
+
+def _two_tenant_server():
+    """Two pipelines under distinct tenants, tenant-a poisoned with one NaN."""
+    values.enable()
+    engine = alerts.configure(AlertRule(name="non_finite", kind="non_finite", metric="*"))
+    a = MeanSquaredError()
+    b = MeanSquaredError()
+    pipe_a = MetricPipeline(
+        a, PipelineConfig(fuse=2, prefetch=0, tenant="tenant-a", alert_engine=engine)
+    )
+    pipe_b = MetricPipeline(b, PipelineConfig(fuse=2, prefetch=0, tenant="tenant-b"))
+    pipe_a.feed(jnp.ones(8), jnp.zeros(8))
+    pipe_a.feed(jnp.full(8, float("nan")), jnp.zeros(8))  # the injected NaN batch
+    for _ in range(3):
+        pipe_b.feed(jnp.ones(8), jnp.full(8, 2.0))
+    pipe_a.close()
+    pipe_b.close()
+    with scope.scope("tenant-a"):
+        a.compute()
+    with scope.scope("tenant-b"):
+        b.compute()
+    server = obs_server.start([a, b], port=0)
+    return server, a, b
+
+
+class TestServerTenants:
+    def test_acceptance_demo_end_to_end(self):
+        """The ISSUE acceptance scenario, minus the cross-host half (below)."""
+        server, a, b = _two_tenant_server()
+        # GET /tenants: both tenants with correct liveness/series counts
+        status, doc = _get_json(f"{server.url}/tenants")
+        assert status == 200 and doc["enabled"]
+        rows = {r["tenant"]: r for r in doc["tenants"]}
+        assert set(rows) == {"tenant-a", "tenant-b"}
+        assert rows["tenant-a"]["updates"] == 2 and rows["tenant-b"]["updates"] == 3
+        assert rows["tenant-a"]["computes"] >= 1 and rows["tenant-b"]["computes"] >= 1
+        assert rows["tenant-a"]["active_pipelines"] == 0
+        assert rows["tenant-a"]["memory_bytes"] > 0
+        assert rows["tenant-a"]["alerts_firing"] >= 1
+        assert "non_finite" in rows["tenant-a"]["firing_rules"]
+        assert rows["tenant-b"]["alerts_firing"] == 0
+        # series cardinality is per tenant and nonzero once values recorded
+        assert rows["tenant-a"]["series"] > 0
+        # GET /alerts?tenant=tenant-a fires non_finite for tenant A only
+        status, doc = _get_json(f"{server.url}/alerts?tenant=tenant-a")
+        assert status == 200
+        assert any(al["rule"] == "non_finite" for al in doc["firing"])
+        assert all(al["tenant"] == "tenant-a" for al in doc["firing"])
+        status, doc = _get_json(f"{server.url}/alerts?tenant=tenant-b")
+        assert doc["firing"] == [] and doc["active"] == []
+        # /healthz degraded payload names the tenant
+        status, health = _get_json(f"{server.url}/healthz")
+        assert health["status"] == "degraded"
+        assert health["tenants_degraded"] == ["tenant-a"]
+        assert any("tenant-a" in reason for reason in health["reasons"])
+        # tenant B's scoped views stay clean
+        status, page = _get(f"{server.url}/metrics?tenant=tenant-b")
+        assert status == 200
+        assert 'tenant="tenant-b"' in page and 'tenant="tenant-a"' not in page
+        value_lines = [
+            line for line in page.splitlines()
+            if line.startswith("tm_tpu_value_current{")
+        ]
+        assert value_lines and all(not line.endswith(" nan") for line in value_lines)
+        status, mem = _get_json(f"{server.url}/memory?tenant=tenant-b")
+        assert mem["n_metrics"] == 1 and mem["metrics"][0]["tenant"] == "tenant-b"
+        status, snap = _get_json(f"{server.url}/snapshot?tenant=tenant-b")
+        assert snap["tenant_filter"] == "tenant-b"
+        assert all(g["labels"].get("tenant") == "tenant-b" for g in snap["gauges"])
+        # fleet aggregate merges per-tenant alert state across hosts
+        local = obs_aggregate.host_snapshot(server.recorder)
+        remote = json.loads(json.dumps(local))  # a second, healthy-ish host
+        remote["host"] = dict(remote["host"], process_index=1, host_id="peer:1")
+        remote["alerts"] = []
+        merged = obs_aggregate.merge_snapshots([local, remote])
+        trows = {r["tenant"]: r for r in merged["tenants"]}
+        assert trows["tenant-a"]["hosts"] == [0, 1]
+        firing_rows = [r for r in merged["alerts"] if r["state"] == "firing"]
+        assert any(r["tenant"] == "tenant-a" and r["hosts"] == [0] for r in firing_rows)
+        assert merged["tenants_firing"] == ["tenant-a"]
+
+    def test_unknown_tenant_404s_on_every_scoped_route(self):
+        server, _, _ = _two_tenant_server()
+        for route in ("/metrics", "/alerts", "/memory", "/snapshot"):
+            try:
+                urllib.request.urlopen(f"{server.url}{route}?tenant=nope", timeout=10)
+                raise AssertionError(f"{route} did not 404")
+            except urllib.error.HTTPError as err:
+                assert err.code == 404
+                body = json.loads(err.read().decode("utf-8"))
+                assert "unknown tenant" in body["error"]
+                assert "tenant-a" in body["tenants"]
+
+    def test_metrics_scrape_refreshes_tenant_gauges(self):
+        server, _, _ = _two_tenant_server()
+        status, page = _get(f"{server.url}/metrics")
+        assert status == 200
+        assert "tm_tpu_tenant_updates" in page
+        assert "tm_tpu_tenant_series" in page
+        assert 'tm_tpu_tenant_registered' in page
+
+    def test_tenants_route_present_on_index(self):
+        server = obs_server.start(port=0)
+        status, doc = _get_json(f"{server.url}/")
+        assert "/tenants" in doc["routes"]
+
+    def test_concurrent_scrapes_no_cross_contamination(self):
+        """Satellite: concurrent /tenants + /metrics?tenant= scrapes while two
+        tenant pipelines stream updates — scoped pages never leak the other
+        tenant's labels, and nothing stalls."""
+        values.enable()
+        a, b = MeanSquaredError(), MeanSquaredError()
+        pipe_a = MetricPipeline(a, PipelineConfig(fuse=2, prefetch=0, tenant="tenant-a"))
+        pipe_b = MetricPipeline(b, PipelineConfig(fuse=2, prefetch=0, tenant="tenant-b"))
+        server = obs_server.start([a, b], port=0)
+        trace.enable()
+        stop = threading.Event()
+        errors: list = []
+
+        def stream(pipe):
+            rng = np.random.RandomState(0)
+            while not stop.is_set():
+                pipe.feed(jnp.asarray(rng.rand(8).astype("float32")), jnp.zeros(8))
+            pipe.close()
+
+        def scrape():
+            try:
+                for _ in range(25):
+                    status, doc = _get_json(f"{server.url}/tenants")
+                    assert status == 200
+                    names = {r["tenant"] for r in doc["tenants"]}
+                    assert names <= {"tenant-a", "tenant-b"}
+                    status, page = _get(f"{server.url}/metrics?tenant=tenant-a")
+                    assert status == 200 and 'tenant="tenant-b"' not in page
+            except Exception as err:  # surfaced by the main thread
+                errors.append(err)
+
+        feeders = [threading.Thread(target=stream, args=(p,)) for p in (pipe_a, pipe_b)]
+        scraper = threading.Thread(target=scrape)
+        for t in feeders:
+            t.start()
+        scraper.start()
+        scraper.join(120)
+        stop.set()
+        for t in feeders:
+            t.join(120)
+        assert not scraper.is_alive() and not any(t.is_alive() for t in feeders)
+        assert errors == []
+        rows = {r["tenant"]: r for r in scope.get_registry().rows()}
+        assert rows["tenant-a"]["updates"] > 0 and rows["tenant-b"]["updates"] > 0
+
+
+# ---------------------------------------------------------------- aggregation
+
+
+class TestAggregateTenants:
+    def _snap(self, pidx, tenants, alerts_rows=()):
+        base = {
+            "schema_version": trace.SCHEMA_VERSION,
+            "host": {"process_index": pidx, "process_count": 2, "host_id": f"h{pidx}"},
+            "wall_clock_anchor": 100.0 + pidx,
+            "elapsed": 1.0,
+            "events": [],
+            "n_events": 0,
+            "events_included": False,
+            "dropped_events": 0,
+            "counters": [],
+            "gauges": [],
+            "histograms": [],
+            "warnings": [],
+            "alerts": list(alerts_rows),
+            "tenants": tenants,
+        }
+        return base
+
+    def _row(self, tenant, updates=1):
+        return {
+            "tenant": tenant,
+            "first_seen_unix": 1.0,
+            "last_seen_unix": 2.0,
+            "first_step": 1,
+            "last_step": 2,
+            "updates": updates,
+            "computes": 0,
+            "active_pipelines": 1,
+            "registrations": 1,
+            "collapsed_names": 0,
+        }
+
+    def test_tenant_rows_merge_with_host_lists(self):
+        merged = obs_aggregate.merge_snapshots(
+            [
+                self._snap(0, [self._row("shared", 2), self._row("only-0")]),
+                self._snap(1, [self._row("shared", 3)]),
+            ]
+        )
+        rows = {r["tenant"]: r for r in merged["tenants"]}
+        assert rows["shared"]["hosts"] == [0, 1] and rows["shared"]["updates"] == 5
+        assert rows["shared"]["per_host"]["1"]["updates"] == 3
+        assert rows["only-0"]["hosts"] == [0]
+
+    def test_overflow_collapsed_names_merge_by_max_not_sum(self):
+        # the same overflowed name on two hosts is ONE lost tenant: the fleet
+        # view takes max (honest lower bound), never the sum
+        row0 = dict(self._row(scope.OVERFLOW_TENANT), collapsed_names=1)
+        row1 = dict(self._row(scope.OVERFLOW_TENANT), collapsed_names=3)
+        merged = obs_aggregate.merge_snapshots(
+            [self._snap(0, [row0]), self._snap(1, [row1])]
+        )
+        (trow,) = merged["tenants"]
+        assert trow["collapsed_names"] == 3
+
+    def test_tenant_alert_firing_on_any_host_fires_fleet_wide(self):
+        alert = {
+            "rule": "nf",
+            "kind": "non_finite",
+            "series": "M[0].value@acct",
+            "tenant": "acct",
+            "severity": "warning",
+            "state": "firing",
+            "value": float("nan"),
+            "detail": "value is nan",
+        }
+        merged = obs_aggregate.merge_snapshots(
+            [
+                self._snap(0, [self._row("acct")]),
+                self._snap(1, [self._row("acct")], alerts_rows=[alert]),
+            ]
+        )
+        (row,) = merged["alerts"]
+        assert row["tenant"] == "acct" and row["state"] == "firing" and row["hosts"] == [1]
+        assert merged["tenants_firing"] == ["acct"]
+
+    def test_degraded_single_host_merge_keeps_local_tenant_rows(self):
+        # the degraded path merges only the surviving host's snapshot: its
+        # tenant rows must survive, and the hung host's tenant is MISSING
+        # (absent rows + aggregate_degraded + missing_hosts), never silent
+        merged = obs_aggregate.merge_snapshots([self._snap(0, [self._row("survivor")])])
+        merged["aggregate_degraded"] = True
+        merged["missing_hosts"] = [1]
+        assert [r["tenant"] for r in merged["tenants"]] == ["survivor"]
+
+    def test_summarize_renders_tenant_table(self):
+        merged = obs_aggregate.merge_snapshots([self._snap(0, [self._row("acct", 7)])])
+        text = obs_aggregate.summarize(merged)
+        assert "tenants" in text and "acct" in text and "updates=7" in text
+
+    def test_host_snapshot_carries_registry_rows(self):
+        with scope.scope("local-tenant"):
+            pass
+        snap = obs_aggregate.host_snapshot(trace.TraceRecorder())
+        assert [r["tenant"] for r in snap["tenants"]] == ["local-tenant"]
+
+
+# ----------------------------------------------------------------- perfetto
+
+
+class TestPerfettoTenantTracks:
+    def test_tenant_spans_get_named_tracks(self):
+        from torchmetrics_tpu.obs import perfetto
+
+        rec = trace.get_recorder()
+        with trace.observe():
+            with scope.scope("acme"):
+                with trace.span("metric.update", metric="M"):
+                    pass
+        doc = perfetto.chrome_trace(rec)
+        names = [
+            e["args"]["name"]
+            for e in doc["traceEvents"]
+            if e.get("ph") == "M" and e["name"] == "thread_name"
+        ]
+        assert any(n == "tenant acme" for n in names)

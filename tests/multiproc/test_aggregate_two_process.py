@@ -79,4 +79,6 @@ def test_two_process_aggregate_battery(tmp_path):
         "recovers_after_degrade": True,
         "alert_fires_fleet_wide_with_host_list": True,
         "degraded_keeps_partial_alert_state": True,
+        "tenant_rows_merge_fleet_wide": True,
+        "degraded_keeps_tenant_attribution": True,
     }

@@ -154,6 +154,59 @@ def main() -> None:
     results["degraded_keeps_partial_alert_state"] = True
     alerts.uninstall()
 
+    # -- 7. per-tenant rows merge fleet-wide (obs/scope.py) --------------------
+    # (one shared tenant on both hosts, one tenant per host; rank 1's private
+    # tenant carries a firing value watchdog targeted by a tenant glob)
+    import torchmetrics_tpu.obs.scope as scope
+
+    with scope.scope("t-shared"):
+        trace.inc("tenant.work", 1.0)
+    with scope.scope(f"t-host-{pid}"):
+        trace.inc("tenant.work", 1.0)
+    if pid == 1:
+        values.get_log().record("TenantAcc", "0", "value", 1, float("nan"), tenant="t-host-1")
+    engine = alerts.configure(
+        alerts.AlertRule(name="tenant-nan", kind="non_finite", metric="TenantAcc", tenant="t-host-*")
+    )
+    engine.evaluate()
+    assert bool(engine.firing()) is (pid == 1)
+    fleet = aggregate()
+    assert fleet["aggregate_degraded"] is False
+    tenants = {row["tenant"]: row for row in fleet["tenants"]}
+    assert tenants["t-shared"]["hosts"] == [0, 1]
+    assert tenants["t-host-0"]["hosts"] == [0] and tenants["t-host-1"]["hosts"] == [1]
+    (alert_row,) = fleet["alerts"]
+    assert alert_row["tenant"] == "t-host-1" and alert_row["state"] == "firing"
+    assert alert_row["hosts"] == [1]
+    assert fleet["tenants_firing"] == ["t-host-1"]
+    results["tenant_rows_merge_fleet_wide"] = True
+
+    # -- 8. degraded aggregation keeps tenant attribution LOUD -----------------
+    # (a tenant active only on the hung host must appear MISSING — absent rows
+    # under aggregate_degraded=True with the host listed — never silently clean)
+    with robust.sync_guard(timeout=0.5, retries=1):
+        with faults.inject_collective_fault(mode="hang", times=10):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                partial = aggregate()
+    assert partial["aggregate_degraded"] is True
+    assert partial["missing_hosts"] == [1 - pid]
+    partial_tenants = {row["tenant"] for row in partial["tenants"]}
+    # the surviving host's own tenant rows came through the degraded path...
+    assert {"t-shared", f"t-host-{pid}"} <= partial_tenants
+    # ...while the hung host's private tenant is MISSING, not silently merged
+    assert f"t-host-{1 - pid}" not in partial_tenants
+    if pid == 0:
+        # rank 0 cannot see rank 1's tenant alert while degraded — but the
+        # degraded flag + missing host say so instead of a clean empty fleet
+        assert partial["alerts"] == [] and partial["tenants_firing"] == []
+    else:
+        (alert_row,) = partial["alerts"]
+        assert alert_row["tenant"] == "t-host-1" and alert_row["hosts"] == [1]
+    results["degraded_keeps_tenant_attribution"] = True
+    alerts.uninstall()
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

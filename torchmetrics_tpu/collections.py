@@ -28,6 +28,7 @@ from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequ
 
 import jax
 
+import torchmetrics_tpu.obs.scope as _scope
 from torchmetrics_tpu.core.metric import Metric, _squeeze_if_scalar
 from torchmetrics_tpu.utils.data import _flatten_dict
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -76,6 +77,10 @@ class MetricCollection:
         self.postfix = self._check_arg(postfix, "postfix")
         self._enable_compute_groups = compute_groups
         self._groups: Dict[int, List[str]] = {}
+        # tenant attribution (obs/scope.py): a collection constructed under a
+        # tenant scope is that tenant's session; members registered without
+        # their own tenant inherit it (see add_metrics)
+        self._obs_tenant = _scope.current_tenant() if _scope.ENABLED else None
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -148,6 +153,13 @@ class MetricCollection:
                 "Unknown input to MetricCollection. Expected `Metric`, `MetricCollection` or"
                 f" `dict`/`sequence` of the previous, but got {metrics}"
             )
+
+        if getattr(self, "_obs_tenant", None) is not None:
+            # members constructed outside the scope inherit the collection's
+            # tenant, so the whole session footprints/alerts under one label
+            for member in self._modules.values():
+                if getattr(member, "_obs_tenant", None) is None:
+                    member._obs_tenant = self._obs_tenant
 
         self._init_compute_groups()
 

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
 import torchmetrics_tpu.obs.values as _values
 from torchmetrics_tpu.core.buffer import MaskedBuffer
@@ -193,6 +194,10 @@ class Metric(ABC):
         # one-shot flag for the ragged list-state growth warning
         self._warned_list_growth = False
         self._obs_instance = str(next(Metric._obs_instance_seq))
+        # tenant/session attribution (obs/scope.py): the ambient tenant at
+        # construction time sticks to the instance, so scope-less eager paths
+        # stay attributed; an ambient scope at call time wins over the capture
+        self._obs_tenant = _scope.current_tenant() if _scope.ENABLED else None
 
         # wrap user update/compute (reference `_wrap_update/_wrap_compute`, metric.py:476,610)
         self._update_signature = inspect.signature(self.update)
@@ -307,6 +312,22 @@ class Metric(ABC):
         from torchmetrics_tpu.obs import memory as _memory
 
         return _memory.footprint(self)
+
+    # ---------------------------------------------------------- tenant scoping
+
+    def _obs_labels(self) -> Dict[str, str]:
+        """Tenant label for span/counter call sites (``obs/scope.py``).
+
+        Ambient scope wins (a shared metric driven under several tenants
+        attributes each call correctly), falling back to the tenant captured
+        at construction; ``{}`` while tenancy is idle — and every call site
+        sits behind ``if _trace.ENABLED:``, so the uninstrumented hot path
+        never even builds the dict.
+        """
+        if not _scope.ENABLED:
+            return {}
+        tenant = _scope.current_tenant() or self._obs_tenant
+        return {"tenant": tenant} if tenant else {}
 
     # ------------------------------------------------------------- value health
 
@@ -473,6 +494,8 @@ class Metric(ABC):
                 raise
             self.updates_ok += 1
             self.last_update_ok = True
+            if _scope.ENABLED:
+                _scope.note_update(self._obs_tenant)
             return
         self._guards_engaged = True
         self._update_count += 1
@@ -484,6 +507,8 @@ class Metric(ABC):
         if ok:
             self.updates_ok += 1
             self.last_update_ok = True
+            if _scope.ENABLED:
+                _scope.note_update(self._obs_tenant)
             return
         self._update_count -= 1  # a skipped batch never counts as an update
         self._record_update_failure(policy, err, args, kwargs)
@@ -542,7 +567,7 @@ class Metric(ABC):
             self.updates_skipped += 1
             verb = "skipped"
         if _trace.ENABLED:
-            _trace.inc(f"robust.update_{verb}", metric=type(self).__name__)
+            _trace.inc(f"robust.update_{verb}", metric=type(self).__name__, **self._obs_labels())
         rank_zero_warn(
             f"{type(self).__name__}.update failed and the batch was {verb}"
             f" (policy={policy.value}): {err}. Accumulated state is unchanged;"
@@ -568,7 +593,9 @@ class Metric(ABC):
         """
         if _trace.ENABLED:
             path = "jit" if self._jit_enabled() else "eager"
-            with _trace.span("metric.update", metric=type(self).__name__, path=path):
+            with _trace.span(
+                "metric.update", metric=type(self).__name__, path=path, **self._obs_labels()
+            ):
                 self._dispatch_update_inner(*args, **kwargs)
             return
         self._dispatch_update_inner(*args, **kwargs)
@@ -649,7 +676,11 @@ class Metric(ABC):
             # per-instance label: two same-class metrics must not overwrite
             # each other's last-write-wins growth curve
             _trace.set_gauge(
-                "state.list_items", items, metric=type(self).__name__, inst=self._obs_instance
+                "state.list_items",
+                items,
+                metric=type(self).__name__,
+                inst=self._obs_instance,
+                **self._obs_labels(),
             )
         if items > self.list_state_warn_threshold and not self._warned_list_growth:
             self._warned_list_growth = True
@@ -697,6 +728,10 @@ class Metric(ABC):
         self._update_count += n_batches
         self.updates_ok += n_batches
         self.last_update_ok = True
+        if _scope.ENABLED:
+            # a fused chunk is n_batches tenant updates, exactly like the
+            # per-batch path would have billed them
+            _scope.note_update(self._obs_tenant, n_batches)
         # same detection-latency bound as the per-batch dispatch: whenever a
         # chunk carries the count past a check boundary, read the (MaskedBuffer)
         # counts back. Metrics without buffer states pay a no-op loop; buffer
@@ -742,7 +777,9 @@ class Metric(ABC):
         forward_fn = self._forward_full_state_update if full else self._forward_reduce_state_update
         if _trace.ENABLED:
             path = "full_state" if full else "reduce_state"
-            with _trace.span("metric.forward", metric=type(self).__name__, path=path):
+            with _trace.span(
+                "metric.forward", metric=type(self).__name__, path=path, **self._obs_labels()
+            ):
                 return forward_fn(*args, **kwargs)
         return forward_fn(*args, **kwargs)
 
@@ -883,7 +920,7 @@ class Metric(ABC):
         self._cache = dict(self._state_values)
         try:
             if _trace.ENABLED:
-                with _trace.span("metric.sync", metric=type(self).__name__):
+                with _trace.span("metric.sync", metric=type(self).__name__, **self._obs_labels()):
                     self._sync_dist(dist_sync_fn)
             else:
                 self._sync_dist(dist_sync_fn)
@@ -894,8 +931,10 @@ class Metric(ABC):
             self._cache = None
             self.sync_degraded = True
             if _trace.ENABLED:
-                _trace.inc("sync.degraded", metric=type(self).__name__)
-                _trace.event("sync.degraded", metric=type(self).__name__, error=str(err))
+                _trace.inc("sync.degraded", metric=type(self).__name__, **self._obs_labels())
+                _trace.event(
+                    "sync.degraded", metric=type(self).__name__, error=str(err), **self._obs_labels()
+                )
             rank_zero_warn(
                 f"Cross-host sync of {type(self).__name__} failed and was DEGRADED"
                 f" to local-only state: {err}. Results from this process reflect"
@@ -959,12 +998,16 @@ class Metric(ABC):
             return self._computed
         self._check_buffer_overflow()  # backstop for the final jitted update
         if _trace.ENABLED:
-            with _trace.span("metric.compute", metric=type(self).__name__):
+            with _trace.span("metric.compute", metric=type(self).__name__, **self._obs_labels()):
                 value = self._compute_synced_value()
         else:
             value = self._compute_synced_value()
         if self.compute_with_cache:
             self._computed = value
+        if _scope.ENABLED:
+            # fresh computes only (a cache hit above is the same evaluation):
+            # per-tenant liveness in the registry, ambient scope wins
+            _scope.note_compute(self._obs_tenant)
         if _values.ENABLED:
             # value-health timeline (obs/values.py): fresh computes only —
             # a cache hit above is the same evaluation, not a new sample
@@ -1167,6 +1210,8 @@ class Metric(ABC):
         self._obs_instance = str(next(Metric._obs_instance_seq))
         if "_has_list_defaults" not in self.__dict__:  # pickles from older builds
             self._has_list_defaults = any(isinstance(v, list) for v in self._defaults.values())
+        if "_obs_tenant" not in self.__dict__:  # pickles from pre-tenancy builds
+            self._obs_tenant = _scope.current_tenant() if _scope.ENABLED else None
         self._update_signature = inspect.signature(self.update)
         self._update_impl = self.update
         self._compute_impl = self.compute

@@ -72,6 +72,7 @@ import os
 import tempfile
 import time
 from collections import deque
+from contextlib import nullcontext
 from dataclasses import dataclass, fields, replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
@@ -79,6 +80,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
 import torchmetrics_tpu.obs.values as _values
 from torchmetrics_tpu.collections import MetricCollection
@@ -132,6 +134,14 @@ class PipelineConfig:
         flight_max_dumps: hard cap on dump files one pipeline writes — a stream
             where *every* chunk degrades must not fill the disk; suppressed
             dumps are counted (``flight.dumps_suppressed``).
+        tenant: name this pipeline a **tenant session**
+            (:mod:`torchmetrics_tpu.obs.scope`). Every dispatch, commit,
+            flight record and value sample runs under ``scope(tenant)``, so
+            spans/counters/timelines/alerts/cost entries carry the tenant
+            label automatically; the driven metrics adopt the tenant for their
+            eager paths, and the registry tracks the session's liveness
+            (``active_pipelines``). ``None`` (default) keeps the untenanted
+            single-session behavior, one branch of overhead.
         alert_engine: an :class:`~torchmetrics_tpu.obs.alerts.AlertEngine` to
             evaluate per committed chunk — the mid-stream value-health seam.
             The pipeline samples the target's values **sync-free**
@@ -151,10 +161,13 @@ class PipelineConfig:
     flight_records: int = 64
     flight_dump_dir: Optional[str] = None
     flight_max_dumps: int = 16
+    tenant: Optional[str] = None
     alert_engine: Any = None
     alert_every: int = 1
 
     def __post_init__(self) -> None:
+        if self.tenant is not None:
+            _scope.validate_tenant(self.tenant)
         if self.fuse < 1:
             raise ValueError(f"Expected `fuse` >= 1, got {self.fuse}")
         if self.max_in_flight < 1:
@@ -268,6 +281,7 @@ class _FlightRecorder:
     def __init__(self, pipeline: str, inst: str, capacity: int, dump_dir: str, max_dumps: int) -> None:
         self.pipeline = pipeline
         self.inst = inst
+        self.tenant: Optional[str] = None  # set when the pipeline is a tenant session
         self.dump_dir = dump_dir
         self.max_dumps = max_dumps
         self._ring: deque = deque(maxlen=capacity)
@@ -313,6 +327,7 @@ class _FlightRecorder:
             "schema": FLIGHT_SCHEMA,
             "pipeline": self.pipeline,
             "inst": self.inst,
+            "tenant": self.tenant,
             "reason": reason,
             "poisoned_batches": sorted(set(poisoned)),
             "records": len(self._ring),
@@ -413,9 +428,32 @@ class MetricPipeline:
         self._alert_engine = config.alert_engine
         self._alert_commits = 0
         self._alert_warned = False
+        self._tenant: Optional[str] = None
+        self._tenant_closed = False
+        if config.tenant is not None:
+            # a tenant-scoped pipeline IS a session: register liveness, and
+            # adopt the tenant onto the driven metrics so their eager paths
+            # (direct compute, robust counters, memory gauges) stay attributed
+            self._tenant = _scope.adopt(config.tenant)
+            _scope.get_registry().pipeline_started(self._tenant)
+            targets: List[Any] = [self._target]
+            if self._is_collection:
+                targets += list(self._target._modules.values())
+            for m in targets:
+                if getattr(m, "_obs_tenant", None) is None:
+                    m._obs_tenant = self._tenant
+            if self._flight is not None:
+                self._flight.tenant = self._tenant
         # wiring the persistent compile cache is part of engine startup: no-op
         # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
         _warmup.configure_compile_cache()
+
+    def _tenant_ctx(self):
+        """The session scope every public entry point runs under (no-op when
+        the pipeline is untenanted). ``scope.session`` sets only the
+        contextvar — registration happened once at construction via
+        ``adopt()``, so the hot path pays no registry lock per call."""
+        return _scope.session(self._tenant) if self._tenant is not None else nullcontext()
 
     # ------------------------------------------------------------------ public API
 
@@ -442,7 +480,8 @@ class MetricPipeline:
 
     def feed(self, *args: Any, **kwargs: Any) -> None:
         """Ingest one batch (positional/keyword update arguments)."""
-        self._ingest(args, kwargs)
+        with self._tenant_ctx():
+            self._ingest(args, kwargs)
 
     def run(self, batches: Iterable[Any]) -> PipelineReport:
         """Consume a stream of batches with device prefetch; flushes at the end.
@@ -450,6 +489,10 @@ class MetricPipeline:
         Each item is a tuple of positional update args, a dict of keyword args,
         or a single array. Returns the accumulated :class:`PipelineReport`.
         """
+        with self._tenant_ctx():
+            return self._run(batches)
+
+    def _run(self, batches: Iterable[Any]) -> PipelineReport:
         lookahead = max(1, self.config.prefetch)
         it = iter(batches)
         pending: deque = deque()  # (args, kwargs, ingested-count at enqueue, stage timings)
@@ -493,24 +536,35 @@ class MetricPipeline:
 
     def flush(self) -> None:
         """Dispatch the open partial chunk (padded up to its bucket)."""
-        if self._chunk is not None and len(self._chunk):
-            self._dispatch_chunk()
-        self._check_buffer_overflow()
+        with self._tenant_ctx():
+            if self._chunk is not None and len(self._chunk):
+                self._dispatch_chunk()
+            self._check_buffer_overflow()
 
     def close(self) -> PipelineReport:
         """Flush, drain the in-flight window, and return the final report."""
-        self.flush()
-        while self._inflight:
-            jax.block_until_ready(self._inflight.popleft())
-        if _trace.ENABLED:
-            _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
-        self._evaluate_alerts(force=True)
+        try:
+            with self._tenant_ctx():
+                self.flush()
+                while self._inflight:
+                    jax.block_until_ready(self._inflight.popleft())
+                if _trace.ENABLED:
+                    _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
+                self._evaluate_alerts(force=True)
+        finally:
+            # the session ends exactly once, however many times close() runs —
+            # INCLUDING when a raise-policy flush or a deferred XLA error
+            # propagates, else the registry leaks active_pipelines=1 forever
+            if self._tenant is not None and not self._tenant_closed:
+                self._tenant_closed = True
+                _scope.get_registry().pipeline_finished(self._tenant)
         return self.report()
 
     def compute(self) -> Any:
         """Flush then compute the target — the epoch-end convenience."""
-        self.flush()
-        return self._target.compute()
+        with self._tenant_ctx():
+            self.flush()
+            return self._target.compute()
 
     def __enter__(self) -> "MetricPipeline":
         return self
@@ -535,6 +589,14 @@ class MetricPipeline:
         reads. Returns (and stores) the warmup manifest; ``manifest_path`` also
         writes it as JSON.
         """
+        with self._tenant_ctx():
+            return self._warmup_scoped(args, kwargs, manifest_path)
+
+    def _warmup_scoped(
+        self, args: tuple, kwargs: dict, manifest_path: Optional[str]
+    ) -> Dict[str, Any]:
+        # runs under the tenant scope so the cost ledger bills this session's
+        # AOT compiles (including every fused-scan bucket variant) to its tenant
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         traced, template, unhashable = partition_static_leaves(leaves)
         if unhashable is not None:
@@ -878,6 +940,7 @@ class MetricPipeline:
             "max_in_flight": self.config.max_in_flight,
             "prefetch": self.config.prefetch,
             "buckets": list(self._buckets),
+            "tenant": self._tenant,
         }
         path = self._flight.dump(reason, poisoned, config)
         if path is not None:
@@ -1053,9 +1116,11 @@ class MetricPipeline:
             if not self._alert_warned:
                 self._alert_warned = True
                 rank_zero_warn(
-                    f"Alert evaluation failed on the {self._label} pipeline and is"
-                    f" disabled for this warning ({type(err).__name__}: {err});"
-                    " the stream keeps flowing but value watchdogs may be stale.",
+                    f"Alert evaluation failed on the {self._label} pipeline"
+                    f" ({type(err).__name__}: {err}). The stream keeps flowing and"
+                    " evaluation will keep being attempted per chunk, but further"
+                    " failures are silent (this warning fires once) and value"
+                    " watchdogs may be stale.",
                     RuntimeWarning,
                 )
             return

@@ -49,10 +49,17 @@ recorder:
   out-of-declared-bounds, frozen, jump/z-score, absence, threshold) with a
   pending→firing→resolved state machine, JSONL transition sink, Prometheus
   ``ALERTS``-style series and fleet-wide cross-host merge.
+- :mod:`~torchmetrics_tpu.obs.scope` — tenant/session attribution: a
+  contextvar-based ``scope(tenant=...)`` context manager stamping every
+  recorder write, value point, alert and cost entry with a bounded-cardinality
+  ``tenant`` label, plus a capped :class:`TenantRegistry` of per-tenant
+  liveness (past-cap tenants collapse into a counted ``__overflow__`` bucket,
+  loudly).
 - :mod:`~torchmetrics_tpu.obs.server` — live introspection over HTTP
   (``/metrics``, ``/healthz``, ``/readyz``, ``/snapshot``, ``/memory``,
-  ``/costs``, ``/alerts``) on a stdlib daemon-thread server;
-  ``python -m torchmetrics_tpu.obs.serve`` for a standalone endpoint.
+  ``/costs``, ``/alerts``, ``/tenants``; ``?tenant=`` scoped views) on a
+  stdlib daemon-thread server; ``python -m torchmetrics_tpu.obs.serve`` for a
+  standalone endpoint.
 
 Typical use::
 
@@ -76,6 +83,7 @@ from torchmetrics_tpu.obs import (
     perfetto,
     profile,
     regress,
+    scope,
     server,
     trace,
     values,
@@ -87,6 +95,7 @@ from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write
 from torchmetrics_tpu.obs.memory import device_memory_stats, footprint, record_gauges
 from torchmetrics_tpu.obs.perfetto import chrome_trace, write_trace
 from torchmetrics_tpu.obs.profile import annotate, profile_trace, start_trace, stop_trace
+from torchmetrics_tpu.obs.scope import TenantRegistry
 from torchmetrics_tpu.obs.server import IntrospectionServer, start_server, stop_server
 from torchmetrics_tpu.obs.trace import (
     TraceRecorder,
@@ -107,6 +116,7 @@ __all__ = [
     "AlertEngine",
     "AlertRule",
     "IntrospectionServer",
+    "TenantRegistry",
     "TraceRecorder",
     "aggregate",
     "alerts",
@@ -136,6 +146,7 @@ __all__ = [
     "record_gauges",
     "record_warning",
     "regress",
+    "scope",
     "server",
     "set_gauge",
     "span",

@@ -35,6 +35,7 @@ import json
 import warnings
 from typing import Any, Dict, List, Optional
 
+import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import alerts as _alerts
 
@@ -71,6 +72,10 @@ def host_snapshot(
     # "firing on host 3" — read-only: snapshotting never evaluates rules
     engine = _alerts.get_engine()
     snap["alerts"] = engine.active() if engine is not None else []
+    # tenant liveness rows ride too (read-only registry copy), so the fleet
+    # merge can say "tenant acme is active on hosts 0 and 3" — and a degraded
+    # partial aggregate keeps the surviving hosts' tenant attribution
+    snap["tenants"] = _scope.get_registry().rows() if _scope.ENABLED else []
     snap["n_events"] = len(snap["events"])
     # distinguishes "events were shipped (possibly zero)" from "events were
     # stripped for the cheap wire shape" — the merge keys host_snapshots (and
@@ -112,6 +117,7 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     hists: Dict[tuple, Dict[str, Any]] = {}
     warn_rows: Dict[str, Dict[str, Any]] = {}
     alert_rows: Dict[tuple, Dict[str, Any]] = {}
+    tenant_rows: Dict[str, Dict[str, Any]] = {}
     host_snaps: List[Dict[str, Any]] = []
     dropped_events = 0
     events_recorded = 0
@@ -175,13 +181,14 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
             # firing on ANY host makes the fleet row firing, with every
             # affected host listed — a per-tenant rollout gate must not
             # average a sick host away
-            key = (str(alert.get("rule")), str(alert.get("series")))
+            key = (str(alert.get("rule")), str(alert.get("series")), str(alert.get("tenant")))
             row = alert_rows.setdefault(
                 key,
                 {
                     "rule": alert.get("rule"),
                     "kind": alert.get("kind"),
                     "series": alert.get("series"),
+                    "tenant": alert.get("tenant"),
                     "severity": alert.get("severity"),
                     "state": alert.get("state"),
                     "hosts": [],
@@ -199,6 +206,52 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
                 "state": state,
                 "value": alert.get("value"),
                 "detail": alert.get("detail"),
+            }
+        for trow in snap.get("tenants", ()):
+            # per-tenant liveness merges like gauges: hosts listed, activity
+            # summed, first/last seen widened. A tenant active only on a host
+            # that fell out of the merge is simply not here — which is why the
+            # degraded flag + missing_hosts travel with the same aggregate
+            tenant = str(trow.get("tenant"))
+            merged = tenant_rows.setdefault(
+                tenant,
+                {
+                    "tenant": tenant,
+                    "hosts": [],
+                    "per_host": {},
+                    "updates": 0,
+                    "computes": 0,
+                    "active_pipelines": 0,
+                    "registrations": 0,
+                    "collapsed_names": 0,
+                    "first_seen_unix": None,
+                    "last_seen_unix": None,
+                },
+            )
+            for field in ("updates", "computes", "active_pipelines", "registrations"):
+                merged[field] += int(trow.get(field, 0) or 0)
+            # distinct-name counts cannot be summed across hosts (the same
+            # overflowed name on two hosts is ONE lost tenant): max is the
+            # honest fleet lower bound, like first/last_seen widening
+            merged["collapsed_names"] = max(
+                merged["collapsed_names"], int(trow.get("collapsed_names", 0) or 0)
+            )
+            first = trow.get("first_seen_unix")
+            if first is not None:
+                merged["first_seen_unix"] = (
+                    first if merged["first_seen_unix"] is None else min(merged["first_seen_unix"], first)
+                )
+            last = trow.get("last_seen_unix")
+            if last is not None:
+                merged["last_seen_unix"] = (
+                    last if merged["last_seen_unix"] is None else max(merged["last_seen_unix"], last)
+                )
+            if pidx not in merged["hosts"]:
+                merged["hosts"].append(pidx)
+            merged["per_host"][str(pidx)] = {
+                "updates": int(trow.get("updates", 0) or 0),
+                "computes": int(trow.get("computes", 0) or 0),
+                "active_pipelines": int(trow.get("active_pipelines", 0) or 0),
             }
         host_snaps.append(snap)
 
@@ -219,6 +272,14 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
         "warnings": [warn_rows[message] for message in sorted(warn_rows)],
         "alerts": [alert_rows[key] for key in sorted(alert_rows)],
         "alerts_firing": sum(1 for row in alert_rows.values() if row["state"] == "firing"),
+        "tenants": [tenant_rows[key] for key in sorted(tenant_rows)],
+        "tenants_firing": sorted(
+            {
+                str(row["tenant"])
+                for row in alert_rows.values()
+                if row["state"] == "firing" and row.get("tenant")
+            }
+        ),
         "dropped_events": dropped_events,
         "events_recorded": events_recorded,
     }
@@ -378,12 +439,22 @@ def summarize(agg: Dict[str, Any]) -> str:
                 f"  {hist['name']:<{width}}  n={hist['count']:<6} total={hist['sum'] * 1e3:9.3f}ms"
                 f" mean={mean * 1e6:9.1f}us{_quantile_cols(hist)}  {label}"
             )
+    if agg.get("tenants"):
+        lines.append("-- tenants (activity summed; hosts where seen) --")
+        width = max(len(str(row["tenant"])) for row in agg["tenants"])
+        for row in agg["tenants"]:
+            lines.append(
+                f"  {row['tenant']:<{width}}  hosts {row['hosts']}"
+                f" updates={row['updates']} computes={row['computes']}"
+                f" pipelines={row['active_pipelines']}"
+            )
     if agg.get("alerts"):
         lines.append("-- alerts (worst state across hosts) --")
         for row in agg["alerts"]:
+            tenant = f" [tenant {row['tenant']}]" if row.get("tenant") else ""
             lines.append(
                 f"  {str(row['state']).upper():<8} {row['rule']} ({row['kind']})"
-                f" on {row['series']} — hosts {row['hosts']}: {row['detail']}"
+                f" on {row['series']}{tenant} — hosts {row['hosts']}: {row['detail']}"
             )
     if agg["warnings"]:
         lines.append("-- warnings (hosts that hit them) --")

@@ -92,6 +92,12 @@ class AlertRule:
     recorder counter/gauge names, with ``labels=`` a required label subset).
     Value kinds default to ``metric="*"`` when neither is given;
     ``threshold`` requires ``series=``.
+
+    ``tenant=`` is a glob over the tenant attribution
+    (:mod:`~torchmetrics_tpu.obs.scope`) of either source: ``tenant="acme"``
+    targets one tenant, ``tenant="team-*"`` a cohort, and the default
+    ``None`` watches everything — tenanted and untenanted alike. A rule with
+    ``tenant=`` set only ever matches series that *carry* a tenant label.
     """
 
     name: str
@@ -100,6 +106,7 @@ class AlertRule:
     leaf: str = "*"
     series: Optional[str] = None
     labels: Optional[Dict[str, str]] = None
+    tenant: Optional[str] = None
     for_seconds: float = 0.0
     severity: str = "warning"
     # bounds
@@ -251,11 +258,23 @@ class AlertEngine:
                 continue
             if not fnmatch.fnmatchcase(series["leaf"], rule.leaf):
                 continue
+            tenant = series.get("tenant") or None
+            if rule.tenant is not None and (
+                tenant is None or not fnmatch.fnmatchcase(tenant, rule.tenant)
+            ):
+                # a tenant= rule only ever matches series that CARRY a tenant
+                # (tenant="*" must not sweep in untenanted traffic)
+                continue
             key = f"{series['metric']}[{series['inst']}].{series['leaf']}"
+            if tenant:
+                # tenant is a series dimension: the same metric under two
+                # tenants drives two independent alert state machines
+                key += f"@{tenant}"
             rows.append(
                 {
                     "key": key,
                     "metric": series["metric"],
+                    "tenant": tenant,
                     "points": series["points"],  # (step, wall, value)
                     "bounds": series["bounds"],
                 }
@@ -278,6 +297,12 @@ class AlertEngine:
                     continue
                 if rule.labels and any(label_dict.get(k) != v for k, v in rule.labels.items()):
                     continue
+                series_tenant = label_dict.get("tenant")
+                if rule.tenant is not None and (
+                    series_tenant is None
+                    or not fnmatch.fnmatchcase(str(series_tenant), rule.tenant)
+                ):
+                    continue
                 snap_rows.append((name, label_dict, float(value)))
         rows = []
         for name, label_dict, value in snap_rows:
@@ -299,6 +324,7 @@ class AlertEngine:
                 {
                     "key": key,
                     "metric": name,
+                    "tenant": label_dict.get("tenant") or None,
                     "points": list(points),
                     "bounds": None,
                     "last_change": sample["last_change"],
@@ -406,15 +432,29 @@ class AlertEngine:
                 placeholder_key = rule.metric or rule.series or "*"
                 if not observations and rule.kind == "absent":
                     # nothing matched at all: the silent-death case the absence
-                    # watchdog exists for
+                    # watchdog exists for. A non-glob tenant= rule carries its
+                    # tenant onto the placeholder, so the never-recorded tenant
+                    # is still NAMED on ?tenant= views, /healthz and the fleet
+                    # merge — the one tenant an absence watchdog exists to name
+                    placeholder_tenant = None
+                    if rule.tenant is not None and not any(c in rule.tenant for c in "*?["):
+                        placeholder_tenant = rule.tenant
                     observations = [
-                        {"key": placeholder_key, "metric": placeholder_key, "points": [], "bounds": None}
+                        {
+                            "key": placeholder_key,
+                            "metric": placeholder_key,
+                            "tenant": placeholder_tenant,
+                            "points": [],
+                            "bounds": None,
+                        }
                     ]
                 observed = set()
                 for obs in observations:
                     observed.add(obs["key"])
                     breached, value, detail = self._breach(rule, obs, now)
-                    transition = self._advance(rule, obs["key"], breached, value, detail, now)
+                    transition = self._advance(
+                        rule, obs["key"], breached, value, detail, now, tenant=obs.get("tenant")
+                    )
                     if transition is not None:
                         transitions.append(transition)
                 # an active alert whose series was NOT observed this pass can
@@ -431,7 +471,9 @@ class AlertEngine:
                         continue
                     if rule.kind == "absent" and key != placeholder_key:
                         continue
-                    transition = self._advance(rule, key, False, alert["value"], "", now)
+                    transition = self._advance(
+                        rule, key, False, alert["value"], "", now, tenant=alert.get("tenant")
+                    )
                     if transition is not None:
                         transitions.append(transition)
         for transition in transitions:
@@ -446,6 +488,7 @@ class AlertEngine:
         value: Optional[float],
         detail: str,
         now: float,
+        tenant: Optional[str] = None,
     ) -> Optional[Dict[str, Any]]:
         """Drive one (rule, series) through the state machine; returns the
         transition record when the state changed. Caller holds the lock."""
@@ -460,6 +503,7 @@ class AlertEngine:
                 "source": rule.source,
                 "severity": rule.severity,
                 "series": series_key,
+                "tenant": tenant,
                 "state": STATE_INACTIVE,
                 "since": None,
                 "fired_at": None,
@@ -503,6 +547,7 @@ class AlertEngine:
             "source": alert["source"],
             "severity": alert["severity"],
             "series": alert["series"],
+            "tenant": alert.get("tenant"),
             "from": prev,
             "to": to,
             "at": now,
@@ -517,9 +562,13 @@ class AlertEngine:
     ) -> None:
         """Transition fan-out: trace counters/events + the JSONL sink."""
         rec = recorder if recorder is not None else self._rec()
-        rec.inc("alerts.transitions", rule=transition["rule"], to=transition["to"])
+        # tenant always explicit (None = stripped by scope.tag): an untenanted
+        # alert evaluated inside a pipeline's tenant scope must NOT have its
+        # egress counters mis-attributed to that ambient tenant
+        tenant = transition.get("tenant")
+        rec.inc("alerts.transitions", rule=transition["rule"], to=transition["to"], tenant=tenant)
         if transition["to"] == STATE_FIRING:
-            rec.inc("alerts.fired", rule=transition["rule"])
+            rec.inc("alerts.fired", rule=transition["rule"], tenant=tenant)
         if trace.ENABLED:
             rec.add_event(
                 "alerts.transition",
@@ -622,18 +671,24 @@ class AlertEngine:
                 "kind": alert["kind"],
                 "severity": alert["severity"],
             }
+            if alert.get("tenant"):
+                labels["tenant"] = alert["tenant"]
             live.add(tuple(sorted(labels.items())))
-            rec.set_gauge("alerts", 1.0, **labels)
+            # tenant=None for untenanted alerts = the ambient-injection opt-out
+            # (scope.tag strips it), so a scrape from inside a tenant scope
+            # cannot mis-attribute another alert — and the written labelset
+            # matches the `live` key exactly, keeping zero-on-clear correct
+            rec.set_gauge("alerts", 1.0, **{"tenant": None, **labels})
             if alert["state"] == STATE_FIRING:
                 n_firing += 1
             else:
                 n_pending += 1
         with self._lock:
             for stale in self._gauge_keys - live:
-                rec.set_gauge("alerts", 0.0, **dict(stale))
+                rec.set_gauge("alerts", 0.0, **{"tenant": None, **dict(stale)})
             self._gauge_keys = live
-        rec.set_gauge("alerts.firing", float(n_firing))
-        rec.set_gauge("alerts.pending", float(n_pending))
+        rec.set_gauge("alerts.firing", float(n_firing), tenant=None)
+        rec.set_gauge("alerts.pending", float(n_pending), tenant=None)
         return {"firing": n_firing, "pending": n_pending}
 
 

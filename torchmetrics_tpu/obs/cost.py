@@ -42,6 +42,7 @@ import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
 
 __all__ = [
@@ -178,6 +179,7 @@ class CostEntry:
         "fn",
         "inst",
         "metric",
+        "tenant",
         "static_key",
         "input_signature",
         "source",
@@ -272,6 +274,11 @@ class CostLedger:
             fn=fn,
             inst=inst,
             metric=fn.split(".", 1)[0],
+            # tenant attribution (obs/scope.py): the ambient tenant at compile
+            # time. Shared compiled variants (shape-bucket reuse) bill their
+            # one-off compile cost to whichever tenant triggered it — the
+            # honest attribution for a shared-executable serving design.
+            tenant=_scope.current_tenant() if _scope.ENABLED else None,
             static_key=static_key,
             input_signature=input_signature,
             source=source,
@@ -430,6 +437,52 @@ class CostLedger:
             )
         return rollup
 
+    def by_tenant(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant rollup of **compile-time** attribution.
+
+        Variants compiled under the tenant's scope, their summed compile
+        seconds, and the summed per-dispatch cost estimates of those variants
+        (``flops_per_dispatch``/``bytes_per_dispatch``: what one pass over the
+        tenant's compiled programs is estimated to cost). Deliberately NOT
+        dispatch-weighted: per-variant dispatch counters are tenant-blind —
+        shared executables (the shape-bucket reuse design) serve every tenant
+        — so runtime-usage totals cannot be honestly attributed per tenant
+        without per-dispatch tenant accounting the hot path does not pay for.
+        Only entries compiled under a tenant scope contribute (untenanted
+        entries stay in :meth:`by_metric`/:meth:`totals` alone).
+        """
+        rollup: Dict[str, Dict[str, Any]] = {}
+        for entry in self.entries():
+            if entry.tenant is None:
+                continue
+            row = rollup.setdefault(
+                entry.tenant,
+                {
+                    "tenant": entry.tenant,
+                    "variants": 0,
+                    "compile_seconds": 0.0,
+                    "flops_per_dispatch": 0.0,
+                    "bytes_per_dispatch": 0.0,
+                    "_flops_known": False,
+                    "_bytes_known": False,
+                },
+            )
+            row["variants"] += 1
+            row["compile_seconds"] += entry.compile_seconds or 0.0
+            if entry.flops is not None:
+                row["flops_per_dispatch"] += entry.flops
+                row["_flops_known"] = True
+            if entry.bytes_accessed is not None:
+                row["bytes_per_dispatch"] += entry.bytes_accessed
+                row["_bytes_known"] = True
+        for row in rollup.values():
+            row["compile_seconds"] = round(row["compile_seconds"], 6)
+            if not row.pop("_flops_known"):
+                row["flops_per_dispatch"] = None
+            if not row.pop("_bytes_known"):
+                row["bytes_per_dispatch"] = None
+        return rollup
+
     def top(self, sort: str = "flops", top_k: int = 20) -> List[Dict[str, Any]]:
         """Top-K variant rows by ``sort`` (see :data:`SORT_KEYS`), largest first."""
         attr = SORT_KEYS.get(sort)
@@ -509,22 +562,28 @@ def record_gauges(
     rollup = led.by_metric()
     measured = _measured_seconds_by_metric(rec)
     for metric, row in rollup.items():
-        rec.set_gauge("cost.compiled_variants", row["variants"], metric=metric)
-        rec.set_gauge("cost.compile_seconds", row["compile_seconds"], metric=metric)
+        # per-CLASS rollups are deliberately cross-tenant: tenant=None is the
+        # scope.tag opt-out so a scrape from inside a tenant scope cannot
+        # split them into mis-attributed per-tenant variants
+        rec.set_gauge("cost.compiled_variants", row["variants"], metric=metric, tenant=None)
+        rec.set_gauge("cost.compile_seconds", row["compile_seconds"], metric=metric, tenant=None)
         for field in ("flops_per_dispatch", "bytes_per_dispatch"):
             if row[field] is not None:
-                rec.set_gauge(f"cost.{field}", row[field], metric=metric)
+                rec.set_gauge(f"cost.{field}", row[field], metric=metric, tenant=None)
         if row["estimated_flops"] is not None:
-            rec.set_gauge("cost.estimated_flops", row["estimated_flops"], metric=metric)
+            rec.set_gauge("cost.estimated_flops", row["estimated_flops"], metric=metric, tenant=None)
         if row["estimated_bytes"] is not None:
-            rec.set_gauge("cost.estimated_bytes", row["estimated_bytes"], metric=metric)
+            rec.set_gauge("cost.estimated_bytes", row["estimated_bytes"], metric=metric, tenant=None)
         if row["peak_bytes"] is not None:
-            rec.set_gauge("cost.peak_memory_bytes", row["peak_bytes"], metric=metric)
+            rec.set_gauge("cost.peak_memory_bytes", row["peak_bytes"], metric=metric, tenant=None)
         seconds = measured.get(metric)
         if seconds and row["estimated_flops"]:
             row["achieved_flops_per_second"] = row["estimated_flops"] / seconds
             rec.set_gauge(
-                "cost.achieved_flops_per_second", row["achieved_flops_per_second"], metric=metric
+                "cost.achieved_flops_per_second",
+                row["achieved_flops_per_second"],
+                metric=metric,
+                tenant=None,
             )
         else:
             row["achieved_flops_per_second"] = None
@@ -559,6 +618,7 @@ def report(
         "top_k": int(top_k),
         "totals": led.totals(),
         "by_metric": sorted(rollup.values(), key=lambda r: r["metric"]),
+        "by_tenant": sorted(led.by_tenant().values(), key=lambda r: r["tenant"]),
         "entries": entries,
     }
 

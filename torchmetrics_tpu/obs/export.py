@@ -22,6 +22,7 @@ from torchmetrics_tpu.utils.fileio import atomic_write_text
 __all__ = [
     "build_info",
     "collect",
+    "filter_tenant",
     "histogram_quantile",
     "prometheus_text",
     "summary",
@@ -48,6 +49,9 @@ def _robust_snapshot(metrics: Iterable[Any]) -> List[Dict[str, Any]]:
         if not hasattr(metric, "updates_ok"):
             continue
         row: Dict[str, Any] = {"metric": type(metric).__name__, "instance": index}
+        tenant = getattr(metric, "_obs_tenant", None)
+        if tenant:
+            row["tenant"] = str(tenant)
         for name in _ROBUST_COUNTERS:
             row[name] = int(getattr(metric, name, 0))
         for name in _ROBUST_FLAGS:
@@ -91,12 +95,46 @@ def build_info() -> Dict[str, str]:
     }
 
 
-def collect(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> Dict[str, Any]:
-    """One plain-data snapshot: recorder state + per-metric robust counters."""
+def filter_tenant(snap: Dict[str, Any], tenant: str) -> Dict[str, Any]:
+    """Project a snapshot onto one tenant's series, in place.
+
+    Keeps only counters/gauges/histograms labeled ``tenant=<tenant>``, events
+    whose attrs carry it, robust rows of metrics registered under it, and (when
+    present) that tenant's registry row — the ``?tenant=`` scoped view. Meta
+    fields (host identity, build info, dropped-event counts) stay: a scoped
+    page is still a page about *this* process.
+    """
+    for kind in ("counters", "gauges", "histograms"):
+        snap[kind] = [row for row in snap.get(kind, ()) if row["labels"].get("tenant") == tenant]
+    snap["events"] = [
+        ev for ev in snap.get("events", ()) if (ev.get("attrs") or {}).get("tenant") == tenant
+    ]
+    if "robust" in snap:
+        snap["robust"] = [row for row in snap["robust"] if row.get("tenant") == tenant]
+    if "tenants" in snap:
+        snap["tenants"] = [row for row in snap["tenants"] if row.get("tenant") == tenant]
+    if "alerts" in snap:
+        snap["alerts"] = [row for row in snap["alerts"] if row.get("tenant") == tenant]
+    snap["tenant_filter"] = tenant
+    return snap
+
+
+def collect(
+    metrics: Iterable[Any] = (),
+    recorder: Optional[trace.TraceRecorder] = None,
+    tenant: Optional[str] = None,
+) -> Dict[str, Any]:
+    """One plain-data snapshot: recorder state + per-metric robust counters.
+
+    ``tenant`` scopes the snapshot to one tenant's series (see
+    :func:`filter_tenant`).
+    """
     rec = recorder if recorder is not None else trace.get_recorder()
     snap = rec.snapshot()
     snap["robust"] = _robust_snapshot(metrics)
     snap["build_info"] = build_info()
+    if tenant is not None:
+        filter_tenant(snap, tenant)
     return snap
 
 
@@ -235,6 +273,15 @@ _GAUGE_HELP = {
     "alerts": "ALERTS-style series: 1 while the named alert is pending/firing, 0 on resolve",
     "alerts.firing": "Alerts currently in the firing state",
     "alerts.pending": "Alerts currently dwelling in the pending state (for_seconds not yet met)",
+    # tenant/session attribution families (obs/scope.py): bounded-cardinality
+    # per-tenant liveness, with the overflow bucket loud by design
+    "tenant.updates": "Metric updates billed to this tenant (ambient scope or captured attribution)",
+    "tenant.computes": "Fresh metric computes billed to this tenant",
+    "tenant.active_pipelines": "Live MetricPipeline sessions currently registered under this tenant",
+    "tenant.series": "Recorder series (counters+gauges+histograms) carrying this tenant's label",
+    "tenant.last_activity_age_seconds": "Wall-clock seconds since this tenant's last recorded activity",
+    "tenant.registered": "Tenants currently in the bounded tenant registry (cap: max_tenants)",
+    "tenant.overflow_collapsed": "Distinct past-cap tenant names collapsed into the __overflow__ bucket",
 }
 
 
@@ -245,13 +292,19 @@ def _gauge_help(name: str) -> str:
     return f"Last recorded value of `{name}` (torchmetrics_tpu.obs)"
 
 
-def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceRecorder] = None) -> str:
+def prometheus_text(
+    metrics: Iterable[Any] = (),
+    recorder: Optional[trace.TraceRecorder] = None,
+    tenant: Optional[str] = None,
+) -> str:
     """Prometheus text exposition (0.0.4) of counters, gauges, histograms and
     the per-metric robust counters. Every family gets a ``# HELP`` + ``# TYPE``
     header; histograms emit cumulative ``_bucket`` lines whose ``le`` labels
-    end in ``+Inf`` plus ``_sum``/``_count``.
+    end in ``+Inf`` plus ``_sum``/``_count``. ``tenant`` scopes the page to one
+    tenant's series (``/metrics?tenant=``); meta families (build info, dropped
+    events) stay on the scoped page.
     """
-    snap = collect(metrics, recorder)
+    snap = collect(metrics, recorder, tenant=tenant)
     out: List[str] = []
 
     by_name: Dict[str, List[Dict[str, Any]]] = {}
@@ -289,18 +342,23 @@ def prometheus_text(metrics: Iterable[Any] = (), recorder: Optional[trace.TraceR
             out.append(f"{prom}_count{_prom_labels(hist['labels'])} {hist['count']}")
 
     if snap["robust"]:
+
+        def _robust_labels(row: Dict[str, Any]) -> Dict[str, Any]:
+            labels = {"instance": str(row["instance"]), "metric": row["metric"]}
+            if row.get("tenant"):
+                labels["tenant"] = row["tenant"]
+            return labels
+
         for name in _ROBUST_COUNTERS:
             prom = _prom_name("robust." + name) + "_total"
             _prom_header(out, prom, "counter", f"Per-metric robustness counter `{name}` (torchmetrics_tpu.robust)")
             for row in snap["robust"]:
-                labels = {"instance": str(row["instance"]), "metric": row["metric"]}
-                out.append(f"{prom}{_prom_labels(labels)} {row[name]}")
+                out.append(f"{prom}{_prom_labels(_robust_labels(row))} {row[name]}")
         for name in _ROBUST_FLAGS:
             prom = _prom_name("robust." + name)
             _prom_header(out, prom, "gauge", f"Per-metric robustness flag `{name}` (torchmetrics_tpu.robust)")
             for row in snap["robust"]:
-                labels = {"instance": str(row["instance"]), "metric": row["metric"]}
-                out.append(f"{prom}{_prom_labels(labels)} {int(row[name])}")
+                out.append(f"{prom}{_prom_labels(_robust_labels(row))} {int(row[name])}")
 
     prom = _prom_name("dropped_events") + "_total"
     _prom_header(out, prom, "counter", "Events evicted from the telemetry ring buffer (torchmetrics_tpu.obs)")

@@ -431,6 +431,16 @@ def record_gauges(
         fp = footprint(metric)
         inst = getattr(metric, "_obs_instance", None) or f"r{index}"
         labels = {"metric": fp["name"], "inst": str(inst)}
+        tenant = getattr(metric, "_obs_tenant", None)
+        if tenant:
+            # tenant attribution (obs/scope.py): a metric registered under a
+            # tenant bills its state bytes to that tenant's label
+            labels["tenant"] = str(tenant)
+            fp["tenant"] = str(tenant)
+        else:
+            # explicit opt-out (scope.tag strips None): an accounting call made
+            # inside someone's scope must not mis-bill an untenanted metric
+            labels["tenant"] = None
         rec.set_gauge("memory.state_bytes", float(fp["unique_bytes"]), **labels)
         rec.set_gauge("memory.state_device_bytes", float(fp["device_bytes"]), **labels)
         rec.set_gauge("memory.state_host_bytes", float(fp["host_bytes"]), **labels)
@@ -464,17 +474,23 @@ def format_bytes(n: Optional[float]) -> str:
     return f"{n:.1f}GiB"  # pragma: no cover - unreachable
 
 
-def report(metrics: Iterable[Any] = (), top_k: int = 20) -> Dict[str, Any]:
+def report(metrics: Iterable[Any] = (), top_k: int = 20, tenant: Optional[str] = None) -> Dict[str, Any]:
     """Top-K footprint report — the payload behind ``GET /memory``.
 
     Per-metric footprints sorted by ``unique_bytes`` (largest first), each
     metric's state rows likewise sorted and truncated to ``top_k``, plus
-    fleet-relevant totals and the guarded device stats.
+    fleet-relevant totals and the guarded device stats. ``tenant`` narrows the
+    report to metrics registered under that tenant (the ``?tenant=`` view).
     """
     rows = []
     for index, metric in enumerate(metrics):
+        metric_tenant = getattr(metric, "_obs_tenant", None)
+        if tenant is not None and metric_tenant != tenant:
+            continue
         fp = footprint(metric)
         fp["instance"] = index
+        if metric_tenant:
+            fp["tenant"] = str(metric_tenant)
         fp["states"] = sorted(fp["states"], key=lambda r: -r["nbytes"])[: max(0, top_k)]
         rows.append(fp)
     rows.sort(key=lambda fp: -fp["unique_bytes"])
@@ -482,10 +498,13 @@ def report(metrics: Iterable[Any] = (), top_k: int = 20) -> Dict[str, Any]:
         key: sum(fp[key] for fp in rows)
         for key in ("total_bytes", "unique_bytes", "device_bytes", "host_bytes", "list_items")
     }
-    return {
+    out = {
         "metrics": rows[: max(0, top_k)],
         "n_metrics": len(rows),
         "totals": totals,
         "totals_human": {k: format_bytes(v) for k, v in totals.items() if k != "list_items"},
         "device_memory_stats": device_memory_stats(),
     }
+    if tenant is not None:
+        out["tenant_filter"] = tenant
+    return out

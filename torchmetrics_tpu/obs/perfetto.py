@@ -97,6 +97,7 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
             # several pipelines shows each stream's dispatch cadence separately
             # instead of interleaving them all on the recording thread's track
             attrs = record.get("attrs") or {}
+            tenant = attrs.get("tenant")
             if (
                 record.get("kind") == "span"
                 and str(record.get("name", "")).startswith("engine.")
@@ -106,8 +107,18 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
                 # driven concurrently from different threads emit overlapping
                 # spans, which on ONE track would render as garbled false
                 # nesting — they get separate (identically named) tracks
-                raw: Any = ("pipeline", str(attrs["pipeline"]), record.get("tid", 0))
+                raw: Any = ("pipeline", str(attrs["pipeline"]), tenant, record.get("tid", 0))
                 display = f"pipeline {attrs['pipeline']}"
+                if tenant:
+                    # tenant-scoped pipelines read as distinct sessions: two
+                    # tenants driving the same metric class get separate tracks
+                    display += f" (tenant {tenant})"
+            elif record.get("kind") == "span" and tenant:
+                # tenant-attributed metric spans group per (tenant, thread): a
+                # serving trace reads per-session instead of one interleaved
+                # wall of same-named update spans
+                raw = ("tenant", str(tenant), record.get("tid", 0))
+                display = f"tenant {tenant}"
             else:
                 raw = record.get("tid", 0)
                 display = None

@@ -1,0 +1,424 @@
+"""Tenant/session scoping: who a span, value, alert or cost entry belongs to.
+
+Every observability layer before this one records into a flat, process-wide
+namespace: a NaN stream, a memory blowup or a compile storm is visible but not
+*attributable* — in a serving process with thousands of concurrent tenants,
+"something is quarantining batches" is useless until it becomes "tenant
+acme-prod is quarantining batches". This module is that attribution plane:
+
+- :func:`scope` — a contextvar-based context manager. Inside
+  ``with scope(tenant="acme-prod"):`` every recorder write (counters, gauges,
+  histogram labels, span/event attrs — see ``TraceRecorder``), every value
+  timeline point (:mod:`~torchmetrics_tpu.obs.values`), every alert
+  observation (:mod:`~torchmetrics_tpu.obs.alerts`) and every cost-ledger
+  entry (:mod:`~torchmetrics_tpu.obs.cost`) picks up the ambient tenant as a
+  first-class ``tenant`` label. Contextvars make this thread- and
+  task-correct: a scrape thread never inherits the training loop's tenant.
+- :class:`TenantRegistry` — a **bounded** registry of tenant liveness:
+  first/last activity (wall clock + a monotonic activity step), update and
+  compute counts, active pipelines. Past the cap (``max_tenants``, default
+  1024) new tenants collapse into a counted ``__overflow__`` bucket with ONE
+  loud warning — the recorder's series-cap pattern. Cardinality is the
+  central risk of tenant labels, so the bound is the central feature.
+- :func:`record_gauges` — per-tenant liveness/cardinality gauges
+  (``tenant.*`` families) written straight into the recorder, so Prometheus
+  ``/metrics``, ``/snapshot``, the cross-host aggregate and Perfetto counter
+  tracks pick them up with no further wiring; ``GET /tenants``
+  (:mod:`~torchmetrics_tpu.obs.server`) serves the registry table live.
+
+The disabled path is one branch: :data:`ENABLED` stays ``False`` until the
+first tenant is registered (a scope entered, a metric adopted, a pipeline
+configured), and every hook in the hot paths guards on it — a process that
+never names a tenant behaves and times exactly as before. Pure stdlib:
+importing this module never imports jax or numpy (the ``trace`` contract).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = [
+    "DEFAULT_MAX_TENANTS",
+    "ENABLED",
+    "OVERFLOW_TENANT",
+    "TenantRegistry",
+    "adopt",
+    "configure",
+    "current_tenant",
+    "get_registry",
+    "note_compute",
+    "note_update",
+    "record_gauges",
+    "reset",
+    "scope",
+    "session",
+    "tag",
+    "validate_tenant",
+]
+
+# THE in-use flag. False until the first tenant registration anywhere in the
+# process; every hot-path hook guards with ``if scope.ENABLED:`` so the
+# never-scoped runtime pays one module-attribute load and one branch.
+ENABLED = False
+
+# the counted collapse bucket for tenants past the registry cap; reserved
+# (user tenant names may not start with ``__``)
+OVERFLOW_TENANT = "__overflow__"
+
+DEFAULT_MAX_TENANTS = 1024
+
+# the ambient tenant of the current context (always an *effective* label:
+# past-cap tenants were already collapsed to OVERFLOW_TENANT at scope entry)
+_TENANT: ContextVar[Optional[str]] = ContextVar("tm_tpu_tenant", default=None)
+
+
+def validate_tenant(tenant: Any) -> str:
+    """A usable tenant name: non-empty string, ``__``-prefix reserved.
+
+    :data:`OVERFLOW_TENANT` itself is accepted — it is the one label the
+    runtime hands back (``adopt``/``scope`` return effective labels), and a
+    pipeline whose tenant collapsed must still be able to enter its scope.
+    """
+    if not isinstance(tenant, str) or not tenant.strip():
+        raise ValueError(f"Expected a non-empty string tenant name, got {tenant!r}")
+    if tenant.startswith("__") and tenant != OVERFLOW_TENANT:
+        raise ValueError(
+            f"Tenant names starting with '__' are reserved;"
+            f" got {tenant!r} (only {OVERFLOW_TENANT!r} may round-trip)"
+        )
+    return tenant
+
+
+class TenantRegistry:
+    """Bounded, thread-safe table of per-tenant liveness and activity.
+
+    One row per tenant: first/last activity as wall clock AND a registry-wide
+    monotonic activity step (so "which tenant went quiet first" is answerable
+    without trusting wall-clock monotonicity), update/compute counts fed by
+    the ``core/metric.py`` hooks, and the number of currently-active
+    :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline` sessions.
+
+    Cardinality bound: at most ``max_tenants`` real rows. The registration
+    that would create row ``max_tenants + 1`` lands in the counted
+    :data:`OVERFLOW_TENANT` row instead (``collapsed_names`` distinct names,
+    ``overflow_registrations`` total hits) with one loud ``RuntimeWarning`` —
+    the overflow bucket is deliberately visible everywhere a real tenant is.
+    """
+
+    def __init__(self, max_tenants: int = DEFAULT_MAX_TENANTS) -> None:
+        if max_tenants < 1:
+            raise ValueError(f"Expected `max_tenants` >= 1, got {max_tenants}")
+        self._lock = threading.Lock()
+        self.max_tenants = int(max_tenants)
+        self.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rows: Dict[str, Dict[str, Any]] = {}
+            self._step = 0
+            # distinct names collapsed into the overflow bucket; the tracking
+            # set is itself bounded (a hostile name stream must not grow it)
+            self.overflow_names = 0
+            self._overflow_seen: set = set()
+            self.overflow_registrations = 0
+            self._warned_overflow = False
+
+    def _new_row(self, tenant: str, now: float) -> Dict[str, Any]:
+        return {
+            "tenant": tenant,
+            "first_seen_unix": now,
+            "last_seen_unix": now,
+            "first_step": self._step,
+            "last_step": self._step,
+            "updates": 0,
+            "computes": 0,
+            "active_pipelines": 0,
+            "registrations": 0,
+            "collapsed_names": 0,
+        }
+
+    # ---------------------------------------------------------------- activity
+
+    def activate(self, tenant: str) -> str:
+        """Register (or touch) ``tenant``; returns the **effective** label —
+        the tenant itself, or :data:`OVERFLOW_TENANT` past the cap."""
+        warn = False
+        with self._lock:
+            self._step += 1
+            now = time.time()
+            row = self._rows.get(tenant)
+            if row is None:
+                live = len(self._rows) - (1 if OVERFLOW_TENANT in self._rows else 0)
+                if tenant != OVERFLOW_TENANT and live >= self.max_tenants:
+                    self.overflow_registrations += 1
+                    if tenant not in self._overflow_seen:
+                        if len(self._overflow_seen) < self.max_tenants:
+                            # distinct-name count SATURATES at the tracking-set
+                            # cap: once full, re-registrations of an untracked
+                            # name cannot be told apart from new names, so the
+                            # count stops (an honest lower bound) instead of
+                            # inflating on every repeat hit
+                            self._overflow_seen.add(tenant)
+                            self.overflow_names += 1
+                    tenant = OVERFLOW_TENANT
+                    row = self._rows.get(tenant)
+                    if row is None:
+                        row = self._rows[tenant] = self._new_row(tenant, now)
+                    row["collapsed_names"] = self.overflow_names
+                    warn = not self._warned_overflow
+                    self._warned_overflow = True
+                else:
+                    row = self._rows[tenant] = self._new_row(tenant, now)
+            row["registrations"] += 1
+            row["last_seen_unix"] = now
+            row["last_step"] = self._step
+        if warn:
+            warnings.warn(
+                f"Tenant registry is FULL ({self.max_tenants} tenants): new tenants now"
+                f" collapse into the counted {OVERFLOW_TENANT!r} bucket and lose"
+                " individual attribution (liveness, series labels, per-tenant alerts)."
+                " Raise the cap with `obs.scope.configure(max_tenants=...)` if the"
+                " tenant population is legitimate; this is reported once per process.",
+                RuntimeWarning,
+                stacklevel=4,
+            )
+            import torchmetrics_tpu.obs.trace as trace  # lazy: avoid import cycles
+
+            if trace.ENABLED:
+                trace.event(
+                    "tenant.overflow", max_tenants=self.max_tenants, collapsed=self.overflow_names
+                )
+        return tenant
+
+    def _touch(self, tenant: Optional[str], field: str, n: int = 1) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            row = self._rows.get(tenant)
+            if row is None:
+                return  # labels only come from activate(); an unknown name is stale
+            self._step += 1
+            row[field] += n
+            row["last_seen_unix"] = time.time()
+            row["last_step"] = self._step
+
+    def note_update(self, tenant: Optional[str], n: int = 1) -> None:
+        self._touch(tenant, "updates", n)
+
+    def note_compute(self, tenant: Optional[str]) -> None:
+        self._touch(tenant, "computes", 1)
+
+    def pipeline_started(self, tenant: Optional[str]) -> None:
+        self._touch(tenant, "active_pipelines", 1)
+
+    def pipeline_finished(self, tenant: Optional[str]) -> None:
+        self._touch(tenant, "active_pipelines", -1)
+
+    # -------------------------------------------------------------- inspection
+
+    def known(self, tenant: str) -> bool:
+        with self._lock:
+            return tenant in self._rows
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def rows(self) -> List[Dict[str, Any]]:
+        """Copies of every row, oldest-registered first (overflow row last)."""
+        with self._lock:
+            rows = [dict(row) for row in self._rows.values()]
+        rows.sort(key=lambda r: (r["tenant"] == OVERFLOW_TENANT, r["first_step"]))
+        return rows
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Plain-data registry snapshot (rides ``host_snapshot`` cross-host)."""
+        return {
+            "max_tenants": self.max_tenants,
+            "n_tenants": len(self),
+            "overflow_names": self.overflow_names,
+            "overflow_registrations": self.overflow_registrations,
+            "tenants": self.rows(),
+        }
+
+
+_REGISTRY = TenantRegistry()
+
+
+def get_registry() -> TenantRegistry:
+    return _REGISTRY
+
+
+def configure(max_tenants: Optional[int] = None) -> TenantRegistry:
+    """Adjust the process-wide registry (currently: the tenant cap)."""
+    if max_tenants is not None:
+        if max_tenants < 1:
+            raise ValueError(f"Expected `max_tenants` >= 1, got {max_tenants}")
+        _REGISTRY.max_tenants = int(max_tenants)
+    return _REGISTRY
+
+
+def reset() -> None:
+    """Drop all tenant state and return to the never-entered (free) path.
+
+    Test hygiene: the registry and the :data:`ENABLED` flag are process-global,
+    so suites that exercise tenancy call this to leave the next suite the
+    pristine one-branch disabled path.
+    """
+    global ENABLED
+    _REGISTRY.clear()
+    _REGISTRY.max_tenants = DEFAULT_MAX_TENANTS
+    ENABLED = False
+
+
+def current_tenant() -> Optional[str]:
+    """The ambient (effective) tenant of the calling context, or ``None``."""
+    return _TENANT.get()
+
+
+@contextmanager
+def scope(tenant: str) -> Iterator[str]:
+    """Enter a tenant scope: everything recorded inside belongs to ``tenant``.
+
+    Yields the *effective* label — the tenant itself, or
+    :data:`OVERFLOW_TENANT` once the registry cap collapsed it. Nesting is
+    allowed (innermost wins); contextvars keep concurrent threads/tasks
+    isolated.
+    """
+    global ENABLED
+    effective = _REGISTRY.activate(validate_tenant(tenant))
+    ENABLED = True
+    token = _TENANT.set(effective)
+    try:
+        yield effective
+    finally:
+        _TENANT.reset(token)
+
+
+@contextmanager
+def session(effective: str) -> Iterator[str]:
+    """Re-enter an ALREADY-REGISTERED effective label: contextvar only.
+
+    The pipeline hot path: :func:`adopt` registered the tenant once at
+    construction, so per-call re-entry needs no registry lock and no
+    ``registrations`` bump — just the ambient label for :func:`tag` and the
+    liveness hooks. Pass only labels the runtime handed back (``adopt`` /
+    ``scope`` return values); an unregistered label would tag series the
+    registry cannot explain.
+    """
+    token = _TENANT.set(effective)
+    try:
+        yield effective
+    finally:
+        _TENANT.reset(token)
+
+
+def adopt(tenant: Optional[str] = None) -> Optional[str]:
+    """Resolve a tenant for sticky capture (no context entered).
+
+    With ``tenant`` given: register it and return the effective label (the
+    ``PipelineConfig.tenant`` path). Without: return the ambient tenant, if
+    any (the ``Metric.__init__`` capture path).
+    """
+    global ENABLED
+    if tenant is None:
+        return _TENANT.get()
+    effective = _REGISTRY.activate(validate_tenant(tenant))
+    ENABLED = True
+    return effective
+
+
+def note_update(fallback: Optional[str] = None, n: int = 1) -> None:
+    """Count ``n`` metric updates against the ambient tenant (else ``fallback``).
+
+    Callers guard with ``if scope.ENABLED:`` — this function assumes tenancy
+    is in use and only resolves which tenant to bill.
+    """
+    tenant = _TENANT.get() or fallback
+    if tenant is not None:
+        _REGISTRY.note_update(tenant, n)
+
+
+def note_compute(fallback: Optional[str] = None) -> None:
+    """Count one fresh ``compute()`` against the ambient tenant (else ``fallback``)."""
+    tenant = _TENANT.get() or fallback
+    if tenant is not None:
+        _REGISTRY.note_compute(tenant)
+
+
+def tag(labels: Dict[str, Any]) -> Dict[str, Any]:
+    """Inject the ambient tenant into a label/attr dict (idempotent, in place).
+
+    THE propagation seam: every ``TraceRecorder`` write passes its labels
+    through here, so counters, gauges, histogram keys and span/event attrs all
+    pick up ``tenant=...`` while a scope is active. An explicit ``tenant``
+    label is never overwritten — and an explicit ``tenant=None`` is the
+    opt-OUT: the key is stripped and no ambient injection happens, so
+    deliberately-global series (registry totals, per-class cost rollups,
+    untenanted alert egress) stay unlabeled even when written inside a scope.
+    The never-entered path is one branch.
+    """
+    if "tenant" in labels and labels["tenant"] is None:
+        del labels["tenant"]
+        return labels
+    if not ENABLED:
+        return labels
+    tenant = _TENANT.get()
+    if tenant is not None and "tenant" not in labels:
+        labels["tenant"] = tenant
+    return labels
+
+
+def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
+    """Write per-tenant liveness/cardinality gauges into the recorder.
+
+    Families (dots become underscores under the ``tm_tpu_`` Prometheus
+    prefix), all labeled ``{tenant}`` except the two totals:
+
+    - ``tenant.updates`` / ``tenant.computes`` — lifetime activity counts;
+    - ``tenant.active_pipelines`` — live :class:`MetricPipeline` sessions;
+    - ``tenant.series`` — recorder series currently carrying this tenant's
+      label (the per-tenant cardinality gauge: the central risk, measured);
+    - ``tenant.last_activity_age_seconds`` — wall-clock staleness;
+    - ``tenant.registered`` (unlabeled) — tenants in the registry;
+    - ``tenant.overflow_collapsed`` (unlabeled) — distinct names collapsed
+      into the overflow bucket (loud by design: a nonzero value means
+      attribution is being lost).
+
+    Like the memory-accounting gauges, writes go straight to the recorder —
+    an explicit call (or a ``/metrics`` scrape) is its own opt-in.
+    """
+    import torchmetrics_tpu.obs.trace as trace  # lazy: scope stays import-cycle-free
+
+    rec = recorder if recorder is not None else trace.get_recorder()
+    rows = _REGISTRY.rows()
+    counts = (
+        # the tenant.* meta-gauges this function writes must not count
+        # themselves as the tenant's own cardinality
+        rec.series_counts_by_label("tenant", exclude_name_prefix="tenant.")
+        if hasattr(rec, "series_counts_by_label")
+        else {}
+    )
+    now = time.time()
+    for row in rows:
+        labels = {"tenant": row["tenant"]}
+        rec.set_gauge("tenant.updates", float(row["updates"]), **labels)
+        rec.set_gauge("tenant.computes", float(row["computes"]), **labels)
+        rec.set_gauge("tenant.active_pipelines", float(row["active_pipelines"]), **labels)
+        rec.set_gauge("tenant.series", float(counts.get(row["tenant"], 0)), **labels)
+        rec.set_gauge(
+            "tenant.last_activity_age_seconds",
+            max(0.0, now - float(row["last_seen_unix"])),
+            **labels,
+        )
+    # registry-wide totals stay UNLABELED even when this runs inside a scope:
+    # tenant=None is the tag() opt-out, preventing an ambient tenant from
+    # splitting the totals into per-tenant variants
+    rec.set_gauge("tenant.registered", float(len(rows)), tenant=None)
+    rec.set_gauge("tenant.overflow_collapsed", float(_REGISTRY.overflow_names), tenant=None)
+    return {"tenants": len(rows), "overflow_collapsed": _REGISTRY.overflow_names}

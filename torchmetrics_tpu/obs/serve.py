@@ -35,7 +35,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m torchmetrics_tpu.obs.serve",
         description=(
             "Serve the obs introspection endpoints (/metrics, /healthz, /readyz,"
-            " /snapshot, /memory) over HTTP until interrupted."
+            " /snapshot, /memory, /costs, /alerts, /tenants) over HTTP until interrupted."
         ),
     )
     parser.add_argument("--host", default="127.0.0.1", help="bind address (default: localhost)")
@@ -59,7 +59,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument(
         "--demo",
         action="store_true",
-        help="register a demo metric and update it once, so /metrics and /memory have content",
+        help=(
+            "run two named tenants (tenant-a healthy, tenant-b fed one NaN batch) with"
+            " values+alerts enabled, so /tenants, ?tenant= filters and a firing"
+            " non_finite alert are demonstrable out of the box"
+        ),
     )
     args = parser.parse_args(argv)
 
@@ -72,12 +76,29 @@ def main(argv: Optional[List[str]] = None) -> int:
             import jax.numpy as jnp
 
             from torchmetrics_tpu.aggregation import MeanMetric
+            from torchmetrics_tpu.obs import alerts as _alerts
+            from torchmetrics_tpu.obs import scope as _scope
+            from torchmetrics_tpu.obs import values as _values
+            from torchmetrics_tpu.regression import MeanSquaredError
 
-            demo = MeanMetric()
-            demo.update(jnp.arange(8.0))
-            metrics.append(demo)
+            _values.enable()
+            _alerts.configure(
+                _alerts.AlertRule(name="non_finite", kind="non_finite", metric="*")
+            )
+            with _scope.scope("tenant-a"):
+                healthy = MeanMetric()
+                healthy.update(jnp.arange(8.0))
+                healthy.compute()
+            with _scope.scope("tenant-b"):
+                # one injected NaN: tenant-b's MSE goes non-finite, the
+                # non_finite watchdog fires on the next /alerts or /healthz
+                # scrape, and /healthz names tenant-b as the offender
+                poisoned = MeanSquaredError()
+                poisoned.update(jnp.asarray([1.0, float("nan")]), jnp.zeros(2))
+                poisoned.compute()
+            metrics.extend([healthy, poisoned])
         except Exception as err:  # demo is a convenience, never a hard failure
-            sys.stderr.write(f"demo metric unavailable: {err!r}\n")
+            sys.stderr.write(f"demo metrics unavailable: {err!r}\n")
 
     try:
         server = _server.start(metrics, host=args.host, port=args.port)
@@ -86,6 +107,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     print(f"serving torchmetrics_tpu introspection on {server.url}", flush=True)
     print(f"routes: {', '.join(_server.ROUTES)}", flush=True)
+    if args.demo:
+        print(
+            f"demo tenants: curl -s {server.url}/tenants | python -m json.tool;"
+            f" scoped views: {server.url}/metrics?tenant=tenant-b,"
+            f" {server.url}/alerts?tenant=tenant-b (non_finite fires there)",
+            flush=True,
+        )
     try:
         if args.duration is not None:
             deadline = time.monotonic() + args.duration

@@ -30,6 +30,12 @@ dependencies, localhost by default:
   transition history, JSON. Scraping evaluates the rules (the Prometheus
   model); firing alerts also flip ``/healthz`` to degraded with the offending
   metric and rule named.
+- ``GET /tenants`` — the tenant registry (:mod:`~torchmetrics_tpu.obs.scope`):
+  per-tenant liveness, series cardinality, state-memory bytes, estimated cost
+  and firing alerts, JSON. ``/metrics``, ``/alerts``, ``/memory`` and
+  ``/snapshot`` additionally accept ``?tenant=<name>`` for a scoped view
+  (404 on a tenant the registry has never seen), and a degraded ``/healthz``
+  names the offending tenant(s) under ``tenants_degraded``.
 
 Lifecycle contract: :func:`start` is idempotent (a second call returns the
 running server), :meth:`IntrospectionServer.stop` is idempotent and leaves no
@@ -54,6 +60,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import aggregate as _aggregate
 from torchmetrics_tpu.obs import alerts as _alerts
@@ -76,7 +83,10 @@ __all__ = [
 ENV_PORT = "TM_TPU_OBS_PORT"
 DEFAULT_PORT = 9464  # the conventional OpenMetrics/collector exporter port
 
-ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory", "/costs", "/alerts")
+ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory", "/costs", "/alerts", "/tenants")
+
+# routes that accept a ``?tenant=`` scoped view (unknown tenants 404)
+_TENANT_ROUTES = ("/metrics", "/alerts", "/memory", "/snapshot")
 
 
 def _parse_top(query: Dict[str, list], default: int = 20) -> int:
@@ -134,27 +144,42 @@ class _Handler(BaseHTTPRequestHandler):
         owner: "IntrospectionServer" = self.server.owner
         parsed = urlparse(self.path)
         route = parsed.path.rstrip("/") or "/"
+        query = parse_qs(parsed.query)
         owner._rec_inc("server.requests", route=route)
         try:
+            tenant = query.get("tenant", [None])[0]
+            if tenant is not None and route in _TENANT_ROUTES:
+                # scoped views 404 on a tenant the registry has never seen — a
+                # typo'd tenant must not render as a clean empty page
+                if not _scope.get_registry().known(tenant):
+                    self._send_json(
+                        {
+                            "error": f"unknown tenant {tenant!r}",
+                            "tenants": [row["tenant"] for row in _scope.get_registry().rows()],
+                        },
+                        status=404,
+                    )
+                    return
             if route == "/metrics":
-                self._send(200, owner.render_metrics().encode("utf-8"),
+                self._send(200, owner.render_metrics(tenant=tenant).encode("utf-8"),
                            "text/plain; version=0.0.4; charset=utf-8")
             elif route == "/healthz":
                 self._send_json(owner.health())
             elif route == "/readyz":
                 self._send_json(owner.ready())
             elif route == "/snapshot":
-                self._send_json(_aggregate.host_snapshot(owner.recorder))
+                snap = _aggregate.host_snapshot(owner.recorder)
+                if tenant is not None:
+                    _export.filter_tenant(snap, tenant)
+                self._send_json(snap)
             elif route == "/memory":
-                query = parse_qs(parsed.query)
                 try:
                     top_k = _parse_top(query)
                 except ValueError as err:
                     self._send_json({"error": str(err)}, status=400)
                     return
-                self._send_json(_memory.report(owner.metrics(), top_k=top_k))
+                self._send_json(_memory.report(owner.metrics(), top_k=top_k, tenant=tenant))
             elif route == "/costs":
-                query = parse_qs(parsed.query)
                 sort = query.get("sort", ["flops"])[0]
                 try:
                     top_k = _parse_top(query)
@@ -168,7 +193,9 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self._send_json(payload)
             elif route == "/alerts":
-                self._send_json(owner.alerts_report())
+                self._send_json(owner.alerts_report(tenant=tenant))
+            elif route == "/tenants":
+                self._send_json(owner.tenants_report())
             elif route == "/":
                 self._send_json({"routes": list(ROUTES), "service": "torchmetrics_tpu.obs"})
             else:
@@ -331,22 +358,90 @@ class IntrospectionServer:
                 self._rec_inc("server.errors", route=f"{route}(alerts)")
         return engine
 
-    def alerts_report(self) -> Dict[str, Any]:
-        """The /alerts page: rules, active/firing alerts, bounded history."""
+    def alerts_report(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The /alerts page: rules, active/firing alerts, bounded history.
+
+        ``tenant`` scopes the active/firing/history rows to one tenant's
+        alerts (rules stay — they are configuration, not per-tenant state).
+        """
         engine = self._evaluated_engine("/alerts")
         if engine is None:
             return {"enabled": False, "n_rules": 0, "rules": [], "active": [], "firing": [], "history": []}
-        return {"enabled": True, **engine.report()}
+        report = {"enabled": True, **engine.report()}
+        if tenant is not None:
+            for key in ("active", "firing", "history"):
+                report[key] = [row for row in report[key] if row.get("tenant") == tenant]
+            report["tenant_filter"] = tenant
+        return report
+
+    def tenants_report(self) -> Dict[str, Any]:
+        """The /tenants page: the bounded registry joined with per-tenant
+        series cardinality, state-memory bytes, estimated cost and firing
+        alerts — the table an operator scans to name a noisy tenant."""
+        registry = _scope.get_registry()
+        series_counts = self.recorder.series_counts_by_label("tenant", exclude_name_prefix="tenant.")
+        engine = self._evaluated_engine("/tenants")
+        firing: List[Dict[str, Any]] = []
+        if engine is not None:
+            try:
+                firing = engine.firing()
+            except Exception:
+                self._rec_inc("server.errors", route="/tenants(alerts)")
+        memory_bytes: Dict[str, int] = {}
+        for metric in self.metrics():
+            metric_tenant = getattr(metric, "_obs_tenant", None)
+            if metric_tenant is None:
+                continue
+            try:
+                fp = _memory.footprint(metric)
+            except Exception:  # accounting must never break the page
+                self._rec_inc("server.errors", route="/tenants(memory)")
+                continue
+            memory_bytes[metric_tenant] = memory_bytes.get(metric_tenant, 0) + int(fp["unique_bytes"])
+        cost_rows = _cost.get_ledger().by_tenant()
+        rows: List[Dict[str, Any]] = []
+        for row in registry.rows():
+            tenant = row["tenant"]
+            tenant_firing = [alert for alert in firing if alert.get("tenant") == tenant]
+            cost_row = cost_rows.get(tenant, {})
+            rows.append(
+                {
+                    **row,
+                    "series": series_counts.get(tenant, 0),
+                    "memory_bytes": memory_bytes.get(tenant, 0),
+                    # compile-time attribution (see CostLedger.by_tenant): what
+                    # the tenant's compiled variants cost to build, and what
+                    # ONE dispatch over them is estimated to cost — runtime
+                    # totals would need tenant-aware dispatch counters
+                    "compiled_variants": cost_row.get("variants", 0),
+                    "compile_seconds": cost_row.get("compile_seconds", 0.0),
+                    "est_flops_per_dispatch": cost_row.get("flops_per_dispatch"),
+                    "est_bytes_per_dispatch": cost_row.get("bytes_per_dispatch"),
+                    "alerts_firing": len(tenant_firing),
+                    "firing_rules": sorted({alert["rule"] for alert in tenant_firing}),
+                }
+            )
+        return {
+            "enabled": _scope.ENABLED,
+            "n_tenants": len(rows),
+            "max_tenants": registry.max_tenants,
+            "overflow": {
+                "collapsed_names": registry.overflow_names,
+                "registrations": registry.overflow_registrations,
+            },
+            "tenants": rows,
+        }
 
     # ------------------------------------------------------------------- payloads
 
-    def render_metrics(self) -> str:
+    def render_metrics(self, tenant: Optional[str] = None) -> str:
         """The /metrics page: refresh memory gauges, then Prometheus text.
 
         Memory gauges are recorded against the *registered* objects (a
         collection footprints as one rollup), while the robust-counter rows go
         to the recursively flattened leaves — a quarantine counter on a metric
         inside a registered collection/wrapper must reach the scraper.
+        ``tenant`` scopes the page to one tenant's series.
         """
         metrics = self.metrics()
         try:
@@ -359,6 +454,12 @@ class IntrospectionServer:
             _cost.record_gauges(recorder=self.recorder)
         except Exception:
             self._rec_inc("server.errors", route="/metrics(cost)")
+        if _scope.ENABLED:
+            try:
+                # per-tenant liveness/cardinality gauges (tenant.* families)
+                _scope.record_gauges(recorder=self.recorder)
+            except Exception:
+                self._rec_inc("server.errors", route="/metrics(tenants)")
         engine = self._evaluated_engine("/metrics")
         if engine is not None:
             try:
@@ -368,7 +469,7 @@ class IntrospectionServer:
             except Exception:
                 self._rec_inc("server.errors", route="/metrics(alerts)")
         robust_leaves = [metric for _, metric in self._flat_metrics()]
-        return _export.prometheus_text(metrics=robust_leaves, recorder=self.recorder)
+        return _export.prometheus_text(metrics=robust_leaves, recorder=self.recorder, tenant=tenant)
 
     def _flat_metrics(self) -> List[Tuple[str, Any]]:
         """Registered metrics recursively flattened into (path, metric) pairs.
@@ -408,20 +509,27 @@ class IntrospectionServer:
         quarantined: List[Dict[str, Any]] = []
         degraded_sync: List[str] = []
         skipped: List[Dict[str, Any]] = []
+        tenants_degraded: set = set()
         for name, metric in self._flat_metrics():
             n_quarantined = int(getattr(metric, "updates_quarantined", 0) or 0)
             n_dropped = int(getattr(metric, "quarantine_dropped", 0) or 0)
             n_skipped = int(getattr(metric, "updates_skipped", 0) or 0)
+            tenant = getattr(metric, "_obs_tenant", None)
             if n_quarantined or n_dropped:
-                quarantined.append(
-                    {"metric": name, "updates_quarantined": n_quarantined, "quarantine_dropped": n_dropped}
-                )
+                row = {"metric": name, "updates_quarantined": n_quarantined, "quarantine_dropped": n_dropped}
+                if tenant:
+                    row["tenant"] = tenant
+                    tenants_degraded.add(tenant)
+                quarantined.append(row)
             if n_skipped:
                 skipped.append({"metric": name, "updates_skipped": n_skipped})
             if bool(getattr(metric, "sync_degraded", False)):
                 degraded_sync.append(name)
         if quarantined:
-            names = ", ".join(row["metric"] for row in quarantined)
+            names = ", ".join(
+                row["metric"] + (f" [tenant {row['tenant']}]" if row.get("tenant") else "")
+                for row in quarantined
+            )
             reasons.append(f"quarantined updates on: {names}")
         if degraded_sync:
             reasons.append(f"sync degraded to local-only state on: {', '.join(degraded_sync)}")
@@ -442,9 +550,13 @@ class IntrospectionServer:
             except Exception:
                 self._rec_inc("server.errors", route="/healthz(alerts)")
         for alert in firing:
+            tenant = alert.get("tenant")
+            if tenant:
+                tenants_degraded.add(tenant)
             reasons.append(
-                f"alert {alert['rule']!r} ({alert['kind']}) firing on {alert['series']}:"
-                f" {alert['detail']}"
+                f"alert {alert['rule']!r} ({alert['kind']}) firing on {alert['series']}"
+                + (f" [tenant {tenant}]" if tenant else "")
+                + f": {alert['detail']}"
             )
         status = "degraded" if reasons else "ok"
         return {
@@ -454,6 +566,9 @@ class IntrospectionServer:
             "skipped": skipped,
             "sync_degraded": degraded_sync,
             "alerts_firing": firing,
+            # the offending tenant(s), named: a degraded serving process must
+            # say WHO is sick, not just that someone is
+            "tenants_degraded": sorted(tenants_degraded),
             "n_metrics": len(self.metrics()),
             "trace_enabled": trace.is_enabled(),
         }

@@ -41,6 +41,12 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+# tenant/session attribution (pure stdlib, no package-internal imports): every
+# recorder write passes its labels through scope.tag so an ambient
+# `scope(tenant=...)` context stamps counters/gauges/histograms/spans/events
+# with a bounded-cardinality `tenant` label; never-entered cost is one branch
+import torchmetrics_tpu.obs.scope as _scope
+
 __all__ = [
     "ENABLED",
     "SCHEMA_VERSION",
@@ -205,6 +211,7 @@ class TraceRecorder:
     # ------------------------------------------------------------------ recording
 
     def add_event(self, name: str, kind: str = "event", **attrs: Any) -> None:
+        attrs = _scope.tag(attrs)
         with self._lock:
             self._append(
                 {
@@ -217,6 +224,7 @@ class TraceRecorder:
             )
 
     def add_span(self, name: str, start: float, duration: float, depth: int, attrs: Dict[str, Any]) -> None:
+        attrs = _scope.tag(attrs)
         with self._lock:
             self._append(
                 {
@@ -239,19 +247,19 @@ class TraceRecorder:
             hist.observe(duration)
 
     def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
-        key = (name, _labels_key(labels))
+        key = (name, _labels_key(_scope.tag(labels)))
         with self._lock:
             if self._series_slot(self._counters, key):
                 self._counters[key] = self._counters.get(key, 0.0) + value
 
     def set_gauge(self, name: str, value: float, **labels: Any) -> None:
-        key = (name, _labels_key(labels))
+        key = (name, _labels_key(_scope.tag(labels)))
         with self._lock:
             if self._series_slot(self._gauges, key):
                 self._gauges[key] = value
 
     def observe_duration(self, name: str, seconds: float, **labels: Any) -> None:
-        key = (name, _labels_key(labels))
+        key = (name, _labels_key(_scope.tag(labels)))
         with self._lock:
             if not self._series_slot(self._hists, key):
                 return
@@ -336,6 +344,26 @@ class TraceRecorder:
                 (name, dict(labels), hist.sum, hist.count)
                 for (name, labels), hist in self._hists.items()
             ]
+
+    def series_counts_by_label(
+        self, label: str, exclude_name_prefix: Optional[str] = None
+    ) -> Dict[str, int]:
+        """Distinct recorded series (counters + gauges + histograms) per value
+        of ``label`` — the per-tenant cardinality read behind ``GET /tenants``
+        and the ``tenant.series`` gauge family. ``exclude_name_prefix`` drops
+        series families from the count (the tenant meta-gauges must not count
+        themselves as tenant-owned cardinality)."""
+        counts: Dict[str, int] = {}
+        with self._lock:
+            for table in (self._counters, self._gauges, self._hists):
+                for name, labels in table:
+                    if exclude_name_prefix is not None and name.startswith(exclude_name_prefix):
+                        continue
+                    for key, value in labels:
+                        if key == label:
+                            counts[str(value)] = counts.get(str(value), 0) + 1
+                            break
+        return counts
 
     def counter_value(self, name: str, **labels: Any) -> float:
         """Value of one counter (0.0 when never incremented). With no labels

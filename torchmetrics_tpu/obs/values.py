@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
 
 __all__ = [
@@ -127,8 +128,9 @@ class ValueLog:
 
     def clear(self) -> None:
         with self._lock:
-            # key -> {"metric", "inst", "leaf", "bounds", "points": deque[(step, wall, value)]}
-            self._series: Dict[Tuple[str, str, str], Dict[str, Any]] = {}
+            # key (metric, inst, leaf, tenant-or-"") -> {"metric", "inst",
+            # "leaf", "tenant", "bounds", "points": deque[(step, wall, value)]}
+            self._series: Dict[Tuple[str, str, str, str], Dict[str, Any]] = {}
             self.dropped_series = 0
             self.skipped_nonscalar = 0
 
@@ -145,9 +147,16 @@ class ValueLog:
         value: float,
         bounds: Optional[Tuple[Optional[float], Optional[float]]] = None,
         wall: Optional[float] = None,
+        tenant: Optional[str] = None,
     ) -> bool:
-        """Append one point; returns False when the series cap refused it."""
-        key = (str(metric), str(inst), str(leaf))
+        """Append one point; returns False when the series cap refused it.
+
+        ``tenant`` is an extra series dimension: the same metric instance
+        computed under two tenants keeps two independent timelines (the
+        multi-tenant serving case), and ``None`` keeps the untenanted series
+        the single-tenant world always had.
+        """
+        key = (str(metric), str(inst), str(leaf), str(tenant) if tenant else "")
         wall = time.time() if wall is None else wall
         with self._lock:
             row = self._series.get(key)
@@ -159,6 +168,7 @@ class ValueLog:
                     "metric": key[0],
                     "inst": key[1],
                     "leaf": key[2],
+                    "tenant": tenant if tenant else None,
                     "bounds": None,
                     "points": deque(maxlen=self.max_points),
                 }
@@ -175,17 +185,30 @@ class ValueLog:
                     "metric": row["metric"],
                     "inst": row["inst"],
                     "leaf": row["leaf"],
+                    "tenant": row["tenant"],
                     "bounds": row["bounds"],
                     "points": list(row["points"]),
                 }
                 for row in self._series.values()
             ]
 
-    def latest(self, metric: str, leaf: str = ROOT_LEAF, inst: Optional[str] = None) -> Optional[float]:
-        """Most recent value of one series (first matching inst when omitted)."""
+    def latest(
+        self,
+        metric: str,
+        leaf: str = ROOT_LEAF,
+        inst: Optional[str] = None,
+        tenant: Optional[str] = None,
+    ) -> Optional[float]:
+        """Most recent value of one series (first matching inst/tenant when omitted)."""
         with self._lock:
-            for (m, i, l), row in self._series.items():
-                if m == metric and l == leaf and (inst is None or i == inst) and row["points"]:
+            for (m, i, l, t), row in self._series.items():
+                if (
+                    m == metric
+                    and l == leaf
+                    and (inst is None or i == inst)
+                    and (tenant is None or t == tenant)
+                    and row["points"]
+                ):
                     return row["points"][-1][2]
         return None
 
@@ -231,22 +254,26 @@ def _record_value_leaves(
     bounds: Optional[Tuple[Optional[float], Optional[float]]],
     recorder: Optional[trace.TraceRecorder],
     log: Optional[ValueLog],
+    tenant: Optional[str] = None,
 ) -> int:
     rec = recorder if recorder is not None else trace.get_recorder()
     target = log if log is not None else _LOG
+    tenant_label = {"tenant": tenant} if tenant else {}
     recorded = 0
     found_any = False
     for leaf, scalar in iter_scalar_leaves(value):
         found_any = True
-        if target.record(metric_label, inst, leaf, step, scalar, bounds=bounds):
+        if target.record(metric_label, inst, leaf, step, scalar, bounds=bounds, tenant=tenant):
             recorded += 1
             # latest value as a gauge: Prometheus/snapshot/aggregate/Perfetto
             # pick it up with no further wiring. Written straight to the
             # recorder (NOT gated on trace.ENABLED): recording values is its
             # own opt-in, like the explicit memory-accounting calls.
-            rec.set_gauge("value.current", scalar, metric=metric_label, inst=inst, leaf=leaf)
+            rec.set_gauge(
+                "value.current", scalar, metric=metric_label, inst=inst, leaf=leaf, **tenant_label
+            )
             if not math.isfinite(scalar):
-                rec.inc("value.nonfinite", metric=metric_label, leaf=leaf)
+                rec.inc("value.nonfinite", metric=metric_label, leaf=leaf, **tenant_label)
     if not found_any:
         with target._lock:
             target.skipped_nonscalar += 1
@@ -272,7 +299,13 @@ def record_compute(
         step = int(getattr(metric, "_update_count", 0) or 0)
         resolver = getattr(metric, "_resolved_value_bounds", None)
         bounds = resolver() if callable(resolver) else None
-        return _record_value_leaves(label, inst, step, value, bounds, recorder, log)
+        tenant = None
+        if _scope.ENABLED:
+            # ambient scope wins (a shared metric computed under several
+            # tenants splits per tenant); a metric constructed/adopted under a
+            # tenant stays attributed even on scope-less eager paths
+            tenant = _scope.current_tenant() or getattr(metric, "_obs_tenant", None)
+        return _record_value_leaves(label, inst, step, value, bounds, recorder, log, tenant)
     except Exception:  # pragma: no cover - recording must never raise into compute
         return 0
 
