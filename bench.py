@@ -25,6 +25,15 @@ A ``memory`` key (``peak_rss_bytes``, and ``device_peak_bytes_in_use`` when the
 backend reports memory stats) rides in the JSON line and the history record as
 recorded-but-never-judged fields, so memory trends accumulate without gating.
 
+``python bench.py --chaos`` runs the OTHER bench: the traffic-replay chaos
+scenario (``torchmetrics_tpu/chaos/``) — a seeded multi-tenant schedule with
+poisoned batches and a hung host, replayed through tenant pipeline sessions
+while the obs server is scraped concurrently, judged against declarative SLOs
+(throughput, p95/p99 scrape latency, time-to-fire/resolve, compiled-variant
+churn, flight-dump correctness) and recorded in the same history with
+``kind: "slo"`` configs the regression sentinel gates. Exits non-zero on an
+outright SLO failure, or (with ``--check-regressions``) on a history breach.
+
 Backend policy: the host pins ``JAX_PLATFORMS=axon`` (tunneled TPU) and the tunnel has
 been wedged at bench time in past rounds. We probe the backend *in a subprocess* (a
 wedged tunnel hangs forever, it doesn't error), retry with backoff at bench time, and
@@ -859,6 +868,111 @@ def ref_pr_curve() -> float:
     return (time.perf_counter() - start) * 1e3
 
 
+# ------------------------------------------------------------------------ chaos
+
+
+def _chaos_main(argv) -> None:
+    """``python bench.py --chaos``: the traffic-replay chaos bench.
+
+    Generates (or loads) a seeded deterministic schedule, replays it through
+    per-tenant pipeline sessions under concurrent obs-server scrape, judges
+    the SLOs (torchmetrics_tpu/chaos/), prints ONE JSON line, appends the run
+    to BENCH_HISTORY.jsonl (configs carry ``kind: "slo"`` so the regression
+    sentinel judges them), and exits non-zero when an SLO fails outright —
+    or, with ``--check-regressions``, when a judged number regresses past its
+    noise-aware tolerance. The SLO table goes to stderr (the one-JSON-line
+    stdout contract holds).
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(prog="python bench.py --chaos")
+    parser.add_argument("--chaos", action="store_true", help=argparse.SUPPRESS)
+    parser.add_argument("--check-regressions", action="store_true")
+    parser.add_argument("--chaos-tenants", type=int, default=8)
+    parser.add_argument("--chaos-seed", type=int, default=0)
+    parser.add_argument(
+        "--chaos-schedule", default=None,
+        help="replay a recorded schedule JSONL instead of generating one",
+    )
+    parser.add_argument(
+        "--chaos-save-schedule", default=None,
+        help="also record the (generated) schedule JSONL here (atomic write)",
+    )
+    parser.add_argument(
+        "--chaos-report", default=None,
+        help="write the full SLO report JSON here (atomic write; the CI artifact)",
+    )
+    args = parser.parse_args(argv)
+
+    # fast backend choice (the chaos loop runs in THIS process): honor an
+    # explicit CPU pin, else one bounded probe of the pinned backend, else the
+    # shared force-cpu recipe — never the full 3-probe bench backoff, and
+    # never a first-touch init that can hang on a wedged tunnel
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        hardware = "cpu-fallback"
+    else:
+        platform = _probe_once(timeout_s=45)
+        if platform is None or platform.startswith("cpu"):
+            from _jax_cpu_force import force_cpu
+
+            force_cpu(1)
+            hardware = "cpu-fallback"
+        else:
+            hardware = platform
+
+    from torchmetrics_tpu import chaos
+    from torchmetrics_tpu.utils.fileio import atomic_write_text
+
+    if args.chaos_schedule:
+        sched = chaos.load(args.chaos_schedule)
+    else:
+        sched = chaos.generate(
+            chaos.ScheduleConfig(seed=args.chaos_seed, tenants=args.chaos_tenants)
+        )
+    if args.chaos_save_schedule:
+        sched.save(args.chaos_save_schedule)
+
+    result = chaos.replay(sched)
+    report = chaos.judge(result)
+    sys.stderr.write(chaos.format_report(report))
+
+    line = {
+        "metric": (
+            f"chaos replay bench ({len(sched.tenants)} tenants,"
+            f" {result['batches_fed']} batches, seed {sched.config.seed})"
+        ),
+        "value": 1.0 if report["passed"] else 0.0,
+        "unit": "slo_pass",
+        "vs_baseline": None,
+        "hardware": hardware,
+        "configs": report["configs"],
+        "slo": {k: report[k] for k in ("passed", "n_slos", "failed")},
+        "chaos": {
+            "schedule": result["schedule"],
+            "wall_seconds": result["wall_seconds"],
+            # driver-side (client-observed) scrape summaries only: the server
+            # histograms carry +Inf bucket bounds that are not strict JSON —
+            # the full detail lands in --chaos-report, judged numbers in configs
+            "scrapes": {
+                "driver": result["scrapes"]["driver"],
+                "degraded_healthz_seen": result["scrapes"]["degraded_healthz_seen"],
+            },
+            "faults": result["faults"],
+            "robust": result["robust"],
+            "cost": result["cost"],
+        },
+    }
+    print(json.dumps(line, sort_keys=True, default=str))
+    if args.chaos_report:
+        atomic_write_text(
+            args.chaos_report,
+            json.dumps({"report": report, "result": result}, sort_keys=True, default=str, indent=2),
+        )
+    _record_history(line, check=args.check_regressions)
+    if not report["passed"]:
+        sys.exit(1)
+
+
 # ------------------------------------------------------------------------------ main
 
 
@@ -1468,5 +1582,7 @@ def main(check_regressions: bool = False) -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         _worker_main(sys.argv[2])
+    elif "--chaos" in sys.argv[1:]:
+        _chaos_main(sys.argv[1:])
     else:
         main(check_regressions="--check-regressions" in sys.argv[1:])

@@ -1,0 +1,461 @@
+"""Traffic-replay chaos bench battery (torchmetrics_tpu/chaos/).
+
+Three layers, matching the subsystem:
+
+- **schedule** — seeded determinism down to the byte (same seed → identical
+  JSONL through generate→save→load), and loud rejection of anything that
+  cannot be trusted: schema mismatches, truncated/reordered/blank lines,
+  meta/event-count disagreement.
+- **slo** — the judge over fabricated replay results: thresholds in both
+  directions, faults whose alerts never fired/resolved, flight-dump
+  correctness, and the bench-config emission (``kind: "slo"``, strict
+  ``slo_pass``, bucket-error spreads).
+- **replay (end to end)** — one real seeded chaos run: 8 tenants, a poisoned
+  batch, a hung-host window, concurrent scraping — asserting every injected
+  fault gets a measured time-to-fire/time-to-resolve, per-route scrape
+  latencies exist on both the driver and the server side, and the poisoned
+  batch is named in a flight dump. CPU-only; the only sleeps are the
+  schedule's own (sub-second) chaos windows.
+"""
+
+import json
+
+import pytest
+
+import torchmetrics_tpu.chaos.schedule as chaos_schedule
+import torchmetrics_tpu.chaos.slo as chaos_slo
+# NB: the package re-exports replay() the FUNCTION, which shadows the replay
+# submodule as a package attribute — import its names directly
+from torchmetrics_tpu.chaos.replay import ReplayConfig, replay
+from torchmetrics_tpu.chaos.schedule import ScheduleConfig, ScheduleError
+from torchmetrics_tpu.obs import scope, trace, values
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    values.disable()
+    values.get_log().clear()
+    scope.reset()
+    yield
+    trace.disable()
+    trace.get_recorder().clear()
+    values.disable()
+    values.get_log().clear()
+    scope.reset()
+
+
+# ---------------------------------------------------------------- determinism
+
+
+class TestScheduleDeterminism:
+    def test_same_seed_is_byte_identical(self):
+        a = chaos_schedule.generate(ScheduleConfig(seed=7))
+        b = chaos_schedule.generate(ScheduleConfig(seed=7))
+        assert a.to_jsonl() == b.to_jsonl()
+
+    def test_different_seed_differs(self):
+        a = chaos_schedule.generate(ScheduleConfig(seed=7))
+        b = chaos_schedule.generate(ScheduleConfig(seed=8))
+        assert a.to_jsonl() != b.to_jsonl()
+
+    def test_save_load_save_round_trip_is_byte_identical(self, tmp_path):
+        sched = chaos_schedule.generate(ScheduleConfig(seed=3))
+        path = str(tmp_path / "sched.jsonl")
+        sched.save(path)
+        with open(path, encoding="utf-8") as fh:
+            first = fh.read()
+        loaded = chaos_schedule.load(path)
+        assert loaded.to_jsonl() == first == sched.to_jsonl()
+
+    def test_roles_cover_the_three_fault_surfaces(self):
+        sched = chaos_schedule.generate(ScheduleConfig(seed=0, tenants=8))
+        assert len(sched.tenants) == 8
+        assert sched.victim != sched.hung
+        assert len(sched.guarded) == 6
+        poisoned = sched.poisoned()
+        assert sched.victim in poisoned  # the value-watchdog fault
+        assert any(t in poisoned for t in sched.guarded)  # the quarantine fault
+
+    def test_hung_tenant_is_silent_inside_the_window(self):
+        sched = chaos_schedule.generate(ScheduleConfig(seed=0))
+        inside = False
+        for ev in sched.events:
+            if ev["kind"] == "hang_start":
+                inside = True
+            elif ev["kind"] == "hang_end":
+                inside = False
+            elif inside and ev["kind"] == "batch":
+                assert ev["tenant"] != sched.hung
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="tenants"):
+            ScheduleConfig(tenants=2)
+        with pytest.raises(ValueError, match="batch_sizes"):
+            ScheduleConfig(batch_sizes=())
+        with pytest.raises(ValueError, match="hang_seconds"):
+            ScheduleConfig(hang_seconds=0.1, absent_after_seconds=0.2)
+
+
+# ------------------------------------------------------------- loud rejection
+
+
+class TestScheduleRejection:
+    def _text(self, seed=0):
+        return chaos_schedule.generate(ScheduleConfig(seed=seed)).to_jsonl()
+
+    def test_schema_mismatch_rejected(self):
+        lines = self._text().splitlines()
+        meta = json.loads(lines[0])
+        meta["schema"] = chaos_schedule.SCHEDULE_SCHEMA + 1
+        lines[0] = json.dumps(meta, sort_keys=True)
+        with pytest.raises(ScheduleError, match="schema"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+
+    def test_truncated_event_line_rejected(self):
+        text = self._text()
+        with pytest.raises(ScheduleError, match="truncated"):
+            chaos_schedule.loads(text[: len(text) - 30])
+
+    def test_missing_tail_rejected_via_event_count(self):
+        lines = self._text().splitlines()
+        with pytest.raises(ScheduleError, match="truncated schedule rejected"):
+            chaos_schedule.loads("\n".join(lines[:-1]) + "\n")
+
+    def test_reordered_events_rejected(self):
+        lines = self._text().splitlines()
+        lines[1], lines[2] = lines[2], lines[1]
+        with pytest.raises(ScheduleError, match="ordinal"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+
+    def test_blank_line_inside_stream_rejected(self):
+        lines = self._text().splitlines()
+        lines.insert(3, "")
+        with pytest.raises(ScheduleError, match="blank line"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+
+    def test_empty_and_missing_meta_rejected(self):
+        with pytest.raises(ScheduleError, match="empty"):
+            chaos_schedule.loads("")
+        with pytest.raises(ScheduleError, match="meta"):
+            chaos_schedule.loads('{"type": "event", "i": 0}\n')
+
+    def test_unknown_event_kind_rejected(self):
+        lines = self._text().splitlines()
+        record = json.loads(lines[1])
+        record["kind"] = "comet-strike"
+        lines[1] = json.dumps(record, sort_keys=True)
+        with pytest.raises(ScheduleError, match="comet-strike"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+
+    def test_unreadable_path_rejected(self, tmp_path):
+        with pytest.raises(ScheduleError, match="cannot read"):
+            chaos_schedule.load(str(tmp_path / "nope.jsonl"))
+
+    def test_corrupt_roles_rejected_at_load_not_replay(self):
+        # a roles map missing a fault surface must fail HERE with
+        # ScheduleError, not deep in replay with an IndexError
+        lines = self._text().splitlines()
+        meta = json.loads(lines[0])
+        meta["roles"] = {t: "guarded" for t in meta["roles"]}  # no victim/hung
+        lines[0] = json.dumps(meta, sort_keys=True)
+        with pytest.raises(ScheduleError, match="exactly one victim"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+        meta = json.loads(self._text().splitlines()[0])
+        meta["roles"] = dict(meta["roles"], extra="supervisor")
+        lines = self._text().splitlines()
+        lines[0] = json.dumps({**json.loads(lines[0]), "roles": meta["roles"]}, sort_keys=True)
+        with pytest.raises(ScheduleError, match="unknown tenant role"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+
+    def test_event_referencing_unknown_tenant_rejected(self):
+        lines = self._text().splitlines()
+        record = json.loads(lines[1])
+        record["tenant"] = "tenant-99"
+        lines[1] = json.dumps(record, sort_keys=True)
+        with pytest.raises(ScheduleError, match="tenant-99"):
+            chaos_schedule.loads("\n".join(lines) + "\n")
+
+
+# ------------------------------------------------------------------ SLO judge
+
+
+def _fake_result(**overrides):
+    """A minimal passing replay result the judge accepts."""
+    buckets = [[1e-06, 0], [1e-05, 0], [1e-04, 0], [1e-03, 40], [1e-02, 2],
+               [1e-01, 0], [1.0, 0], [10.0, 0], [float("inf"), 0]]
+    result = {
+        "schedule": {
+            "victim": "tenant-00",
+            "poisoned": {"tenant-00": [3], "tenant-04": [5]},
+        },
+        "batches_fed": 100,
+        "wall_seconds": 4.0,
+        "sleep_seconds": 1.0,
+        "updates_per_second": 25.0,
+        "faults": [
+            {"fault": "poison", "tenant": "tenant-00", "rule": "chaos_poison_nonfinite",
+             "injected_at": 100.0},
+            {"fault": "hang", "tenant": "tenant-01", "rule": "chaos_hang_absent",
+             "injected_at": 110.0, "ended_at": 110.8},
+        ],
+        "alerts": {
+            "episodes": [
+                {"rule": "chaos_poison_nonfinite", "series": "mse@tenant-00",
+                 "fired_at": 100.2, "resolved_at": 102.0,
+                 "time_to_fire": 0.0, "time_to_resolve": 1.8},
+                {"rule": "chaos_hang_absent", "series": "acc@tenant-01",
+                 "fired_at": 110.3, "resolved_at": 111.5,
+                 "time_to_fire": 0.0, "time_to_resolve": 1.2},
+            ],
+        },
+        "scrapes": {
+            "driver": {route: {"count": 42, "errors": 0, "p95_seconds": 0.002,
+                               "p99_seconds": 0.004}
+                       for route in ("/metrics", "/alerts", "/tenants")},
+            "server": {route: {"count": 42, "errors": 0, "sum_seconds": 0.05,
+                               "buckets": [list(b) for b in buckets]}
+                       for route in ("/metrics", "/alerts", "/tenants")},
+        },
+        "cost": {"compiled_variants": 20, "compile_seconds": 1.5},
+        "flight": {"dumps": [
+            {"path": "x", "tenant": "tenant-04", "reason": "chunk_replay",
+             "poisoned_batches": [5]},
+        ]},
+    }
+    result.update(overrides)
+    return result
+
+
+class TestSLOJudge:
+    def test_passing_run(self):
+        report = chaos_slo.judge(_fake_result())
+        assert report["passed"] and not report["failed"]
+        assert report["configs"]["chaos_slo_pass"]["value"] == 1.0
+        assert report["configs"]["chaos_slo_pass"]["unit"] == "slo_pass"
+        # every emitted config is slo-kind with its judged threshold attached
+        for cfg in report["configs"].values():
+            assert cfg["kind"] == "slo"
+
+    def test_fault_fire_and_resolve_times_measured(self):
+        report = chaos_slo.judge(_fake_result())
+        configs = report["configs"]
+        assert configs["chaos_time_to_fire_poison"]["value"] == pytest.approx(0.2)
+        assert configs["chaos_time_to_resolve_poison"]["value"] == pytest.approx(1.8)
+        assert configs["chaos_time_to_fire_hang"]["value"] == pytest.approx(0.3)
+        assert configs["chaos_time_to_resolve_hang"]["value"] == pytest.approx(1.2)
+
+    def test_alert_that_never_fired_fails_with_detail(self):
+        result = _fake_result()
+        result["alerts"] = {"episodes": [result["alerts"]["episodes"][0]]}
+        report = chaos_slo.judge(result)
+        assert not report["passed"]
+        assert "time_to_fire_hang" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "time_to_fire_hang")
+        assert "never fired" in row["detail"]
+        assert report["configs"]["chaos_slo_pass"]["value"] == 0.0
+
+    def test_resolved_episode_before_injection_is_not_credited(self):
+        # an earlier fire of the same rule that RESOLVED before the fault
+        # landed must not pass as the fault's response
+        result = _fake_result()
+        result["alerts"]["episodes"][1].update(fired_at=105.0, resolved_at=106.0)
+        report = chaos_slo.judge(result)
+        assert "time_to_fire_hang" in report["failed"]
+
+    def test_fault_landing_under_a_firing_alert_is_covered(self):
+        # still-firing at injection = the operator was already paged: ttf is
+        # zero by definition, recovery measured from THIS fault's injection
+        result = _fake_result()
+        result["alerts"]["episodes"][1].update(fired_at=109.0, resolved_at=111.5)
+        report = chaos_slo.judge(result)
+        assert report["passed"]
+        assert report["configs"]["chaos_time_to_fire_hang"]["value"] == 0.0
+        assert report["configs"]["chaos_time_to_resolve_hang"]["value"] == pytest.approx(1.5)
+
+    def test_duplicate_fault_kinds_get_distinct_rows(self):
+        # a recorded schedule may poison twice: the second occurrence gets an
+        # ordinal-suffixed row/config instead of overwriting the first
+        result = _fake_result()
+        result["faults"].append(
+            {"fault": "poison", "tenant": "tenant-00",
+             "rule": "chaos_poison_nonfinite", "injected_at": 100.5}
+        )
+        report = chaos_slo.judge(result)
+        assert report["passed"]
+        assert report["configs"]["chaos_time_to_fire_poison"]["value"] == pytest.approx(0.2)
+        assert report["configs"]["chaos_time_to_fire_poison_2"]["value"] == 0.0
+        assert report["configs"]["chaos_time_to_resolve_poison_2"]["value"] == pytest.approx(1.5)
+
+    def test_still_firing_at_end_fails_resolve(self):
+        result = _fake_result()
+        result["alerts"]["episodes"][0]["resolved_at"] = None
+        report = chaos_slo.judge(result)
+        assert "time_to_resolve_poison" in report["failed"]
+
+    def test_unnamed_poisoned_batch_fails(self):
+        result = _fake_result()
+        result["flight"] = {"dumps": []}
+        report = chaos_slo.judge(result)
+        assert "flight_dump_names_poisoned" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "flight_dump_names_poisoned")
+        assert "tenant-04" in row["detail"]
+
+    def test_victim_poison_needs_no_dump(self):
+        # the victim's NaN is the value watchdog's job, not the quarantine's
+        report = chaos_slo.judge(_fake_result())
+        assert report["passed"]
+
+    def test_throughput_floor(self):
+        report = chaos_slo.judge(
+            _fake_result(updates_per_second=1.0), chaos_slo.SLOSpec(min_updates_per_second=5.0)
+        )
+        assert "update_throughput" in report["failed"]
+
+    def test_compiled_variant_ceiling(self):
+        report = chaos_slo.judge(
+            _fake_result(), chaos_slo.SLOSpec(max_compiled_variants=10)
+        )
+        assert "compiled_variants" in report["failed"]
+
+    def test_none_threshold_reports_without_judging(self):
+        spec = chaos_slo.SLOSpec(min_updates_per_second=None)
+        report = chaos_slo.judge(_fake_result(updates_per_second=0.001), spec)
+        row = next(r for r in report["slos"] if r["slo"] == "update_throughput")
+        assert row["passed"] and "not judged" in row["detail"]
+
+    def test_scrape_spread_spans_bucket_plus_one(self):
+        report = chaos_slo.judge(_fake_result())
+        cfg = report["configs"]["chaos_scrape_p95_metrics"]
+        # samples sit in the (1e-4, 1e-3] bucket: estimate 550us, spread up to
+        # the NEXT bound (1e-2) so an adjacent-bucket hop never flags
+        assert cfg["value"] == pytest.approx(550.0)
+        assert cfg["spread"]["min"] == pytest.approx(100.0)
+        assert cfg["spread"]["max"] == pytest.approx(10000.0)
+
+    def test_format_report_marks_failures(self):
+        result = _fake_result()
+        result["alerts"] = {"episodes": []}
+        text = chaos_slo.format_report(chaos_slo.judge(result))
+        assert "FAILED" in text and "FAIL:" in text
+        assert "ok" in text
+
+
+# ------------------------------------------------------------------ end to end
+
+
+class TestReplayEndToEnd:
+    @pytest.fixture(scope="class")
+    def run(self, tmp_path_factory):
+        """One real seeded chaos run shared by the assertions below."""
+        sched = chaos_schedule.generate(
+            ScheduleConfig(
+                seed=0,
+                tenants=8,
+                warm_batches=2,
+                churn_batches=2,
+                drain_batches=3,
+                hang_seconds=0.5,
+                absent_after_seconds=0.15,
+                idle_gap_seconds=0.01,
+            )
+        )
+        config = ReplayConfig(
+            fuse=1,  # per-batch dispatch: no scan-bucket compiles in the suite
+            scrape_interval_seconds=0.03,
+            sync_timeout_seconds=0.02,
+            flight_dump_dir=str(tmp_path_factory.mktemp("chaos_dumps")),
+        )
+        result = replay(sched, config)
+        return sched, result, chaos_slo.judge(result)
+
+    def test_acceptance_run_completes_and_passes(self, run):
+        sched, result, report = run
+        assert result["schedule"]["tenants"] == 8
+        assert result["batches_fed"] == len(sched.batches())
+        assert report["passed"], chaos_slo.format_report(report)
+
+    def test_every_injected_fault_has_measured_fire_and_resolve(self, run):
+        _, result, report = run
+        assert {f["fault"] for f in result["faults"]} == {"poison", "hang"}
+        for fault in ("poison", "hang"):
+            ttf = report["configs"][f"chaos_time_to_fire_{fault}"]["value"]
+            ttr = report["configs"][f"chaos_time_to_resolve_{fault}"]["value"]
+            assert ttf >= 0.0 and ttr >= 0.0
+
+    def test_scrape_latency_measured_per_route_both_sides(self, run):
+        _, result, _ = run
+        for route in ("/metrics", "/alerts", "/tenants"):
+            driver = result["scrapes"]["driver"][route]
+            server = result["scrapes"]["server"][route]
+            assert driver["count"] > 0 and driver["errors"] == 0
+            assert server["count"] > 0
+            # the server saw (essentially) every request the driver sent —
+            # the driver is its only client on this ephemeral port. Minus one
+            # because the duration observation lands in the handler's finally
+            # AFTER the response bytes, so the very last scrape can be read
+            # client-side before its histogram write.
+            assert server["count"] >= driver["count"] - 1
+
+    def test_poisoned_guarded_batch_is_quarantined_and_named(self, run):
+        sched, result, _ = run
+        expected = {
+            (tenant, idx)
+            for tenant, indices in sched.poisoned().items()
+            if tenant != sched.victim
+            for idx in indices
+        }
+        named = {
+            (dump["tenant"], idx)
+            for dump in result["flight"]["dumps"]
+            for idx in dump["poisoned_batches"]
+        }
+        assert expected and expected <= named
+        assert result["robust"]["quarantined"]  # the guard counted it too
+
+    def test_hung_host_degraded_sync_and_operator_visibility(self, run):
+        sched, result, _ = run
+        assert result["robust"]["sync_degraded"] == [sched.hung]
+        # mid-run /healthz scrapes saw the process degraded while it burned
+        assert result["scrapes"]["degraded_healthz_seen"] > 0
+
+    def test_compiled_variants_counted_under_churn(self, run):
+        _, result, _ = run
+        assert result["cost"]["compiled_variants"] > 0
+
+    def test_tenant_sessions_registered(self, run):
+        sched, result, _ = run
+        rows = {row["tenant"] for row in result["tenants"]["tenants"]}
+        assert set(sched.tenants) <= rows
+
+    def test_driver_quantiles_are_nearest_rank(self):
+        from torchmetrics_tpu.chaos.replay import _Scraper
+
+        scraper = _Scraper("http://unused", ("/x",), 1.0)
+        scraper.latencies["/x"] = [0.01, 0.02]
+        summary = scraper.summary()["/x"]
+        # p50 of two samples is the FIRST order statistic, not the max
+        assert summary["p50_seconds"] == 0.01
+        assert summary["p99_seconds"] == 0.02
+
+    def test_default_dump_dir_is_cleaned_up(self):
+        import glob
+        import os
+        import tempfile
+
+        pattern = os.path.join(tempfile.gettempdir(), "tm_tpu_chaos_*")
+        before = set(glob.glob(pattern))
+        sched = chaos_schedule.generate(
+            ScheduleConfig(seed=1, tenants=3, warm_batches=1, churn_batches=1,
+                           drain_batches=2, hang_seconds=0.2,
+                           absent_after_seconds=0.05, idle_gap_seconds=0.005)
+        )
+        result = replay(sched, ReplayConfig(fuse=1, scrape_interval_seconds=0.05,
+                                            sync_timeout_seconds=0.01))
+        assert result["flight"]["dump_dir"] is None  # consumed and removed
+        # the dump metas survived the cleanup
+        assert all("poisoned_batches" in d for d in result["flight"]["dumps"])
+        assert set(glob.glob(pattern)) == before  # nothing leaked on disk

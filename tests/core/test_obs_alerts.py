@@ -466,6 +466,101 @@ class TestStateMachine:
 # --------------------------------------------------------------------- egress
 
 
+class TestFireResolveTimes:
+    """time_to_fire / time_to_resolve derived from the bounded history
+    (the chaos bench's fault-response SLOs), against the injectable clock."""
+
+    def test_dwell_rule_measures_pending_to_firing_delta(self):
+        now = [0.0]
+        engine, log, _ = _engine(
+            AlertRule(name="nf", kind="non_finite", metric="M", for_seconds=10.0),
+            clock=lambda: now[0],
+        )
+        log.record("M", "0", "value", 1, float("nan"))
+        engine.evaluate()  # -> pending at t=0
+        now[0] = 12.0
+        engine.evaluate()  # -> firing at t=12
+        now[0] = 30.0
+        log.record("M", "0", "value", 2, 0.5)
+        engine.evaluate()  # -> resolved at t=30
+        (episode,) = engine.fire_resolve_times()
+        assert episode["rule"] == "nf"
+        assert episode["breach_at"] == 0.0 and episode["fired_at"] == 12.0
+        assert episode["time_to_fire"] == 12.0
+        assert episode["resolved_at"] == 30.0 and episode["time_to_resolve"] == 18.0
+
+    def test_dwell_less_rule_fires_with_zero_time_to_fire(self):
+        now = [5.0]
+        engine, log, _ = _engine(
+            AlertRule(name="nf", kind="non_finite", metric="M"), clock=lambda: now[0]
+        )
+        log.record("M", "0", "value", 1, float("nan"))
+        engine.evaluate()
+        (episode,) = engine.fire_resolve_times()
+        assert episode["time_to_fire"] == 0.0 and episode["fired_at"] == 5.0
+        assert episode["resolved_at"] is None and episode["time_to_resolve"] is None
+
+    def test_pending_that_clears_produces_no_episode(self):
+        now = [0.0]
+        engine, log, _ = _engine(
+            AlertRule(name="nf", kind="non_finite", metric="M", for_seconds=60.0),
+            clock=lambda: now[0],
+        )
+        log.record("M", "0", "value", 1, float("nan"))
+        engine.evaluate()  # pending
+        log.record("M", "0", "value", 2, 0.5)
+        now[0] = 1.0
+        engine.evaluate()  # back to inactive without firing
+        assert engine.fire_resolve_times() == []
+
+    def test_refire_yields_one_episode_per_fire(self):
+        now = [0.0]
+        engine, log, _ = _engine(
+            AlertRule(name="nf", kind="non_finite", metric="M"), clock=lambda: now[0]
+        )
+        for start, stop in ((1.0, 2.0), (10.0, 14.0)):
+            now[0] = start
+            log.record("M", "0", "value", int(start), float("nan"))
+            engine.evaluate()
+            now[0] = stop
+            log.record("M", "0", "value", int(stop), 0.5)
+            engine.evaluate()
+        first, second = engine.fire_resolve_times()
+        assert (first["fired_at"], first["time_to_resolve"]) == (1.0, 1.0)
+        assert (second["fired_at"], second["time_to_resolve"]) == (10.0, 4.0)
+
+    def test_record_gauges_publishes_latest_episode_deltas(self):
+        now = [0.0]
+        rec = trace.TraceRecorder()
+        engine, log, _ = _engine(
+            AlertRule(name="nf", kind="non_finite", metric="M", for_seconds=2.0),
+            clock=lambda: now[0],
+        )
+        log.record("M", "0", "value", 1, float("nan"))
+        engine.evaluate()
+        now[0] = 3.0
+        engine.evaluate()  # fired: time_to_fire 3.0
+        now[0] = 8.0
+        log.record("M", "0", "value", 2, 0.5)
+        engine.evaluate()  # resolved: time_to_resolve 5.0
+        engine.record_gauges(recorder=rec)
+        snap = rec.snapshot()
+        gauges = {
+            (g["name"], g["labels"].get("alertname")): g["value"] for g in snap["gauges"]
+        }
+        assert gauges[("alerts.time_to_fire_seconds", "nf")] == 3.0
+        assert gauges[("alerts.time_to_resolve_seconds", "nf")] == 5.0
+
+    def test_tenant_label_rides_episodes(self):
+        engine, log, _ = _engine(
+            AlertRule(name="nf", kind="non_finite", metric="M", tenant="acme")
+        )
+        log.record("M", "0", "value", 1, float("nan"), tenant="acme")
+        engine.evaluate()
+        (episode,) = engine.fire_resolve_times()
+        assert episode["tenant"] == "acme"
+
+
 class TestEgress:
     def test_alerts_series_and_totals_with_resolve_edge(self):
         rec = trace.TraceRecorder()

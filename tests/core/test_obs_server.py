@@ -295,6 +295,74 @@ class TestHealthDegradation:
         finally:
             srv.stop()
 
+    @staticmethod
+    def _wait_stats(srv, route, count, timeout=5.0):
+        """request_stats once the route's histogram reaches ``count``.
+
+        The duration observation lands in the handler's *finally*, after the
+        response bytes — a client can read the response a beat before the
+        histogram write, so assertions poll instead of racing.
+        """
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        while True:
+            stats = srv.request_stats()
+            if stats.get(route, {}).get("count", 0) >= count or _time.monotonic() > deadline:
+                return stats
+            _time.sleep(0.005)
+
+    def test_request_duration_histogram_without_tracing(self):
+        """Self-instrumentation is unconditional: scrape latency must be
+        measurable from the obs plane itself even with tracing off."""
+        own = trace.TraceRecorder()
+        srv = obs_server.IntrospectionServer(port=0, recorder=own).start()
+        try:
+            assert not trace.ENABLED
+            _get(srv.url + "/healthz")
+            _get(srv.url + "/healthz")
+            _get(srv.url + "/readyz")
+            stats = self._wait_stats(srv, "/readyz", 1)
+            stats = self._wait_stats(srv, "/healthz", 2)
+            assert stats["/healthz"]["count"] == 2
+            assert stats["/readyz"]["count"] == 1
+            assert stats["/healthz"]["errors"] == 0
+            # snapshot bucket shape: [[upper_bound, count], ...], judged via
+            # export.histogram_quantile by the chaos SLO judge
+            from torchmetrics_tpu.obs import export
+
+            assert export.histogram_quantile(stats["/healthz"]["buckets"], 0.95) is not None
+            # the unconditional counters land too
+            assert own.counter_value("server.requests", route="/healthz") == 2
+        finally:
+            srv.stop()
+
+    def test_request_histogram_exposed_on_own_metrics_page(self, server):
+        _get(server.url + "/healthz")
+        status, body = _get(server.url + "/metrics")
+        assert status == 200
+        assert "# TYPE tm_tpu_server_request_seconds histogram" in body
+        assert 'tm_tpu_server_request_seconds_bucket{le="+Inf",route="/healthz"}' in body
+        assert "self-instrumented scrape latency" in body
+
+    def test_bad_request_records_duration_without_error_counter(self, server):
+        with pytest.raises(urllib.error.HTTPError):
+            _get(server.url + "/memory?top=frogs")
+        stats = self._wait_stats(server, "/memory", 1)
+        assert stats["/memory"]["count"] == 1
+        # a 400 is a served response, not a handler bug: no error counter
+        assert stats["/memory"]["errors"] == 0
+
+    def test_unknown_routes_collapse_to_one_series(self, server):
+        """Unconditional request telemetry must not let a URL-walking prober
+        mint a fresh series per path — unknown routes share one bucket."""
+        for path in ("/frogs", "/toads", "/newts"):
+            with pytest.raises(urllib.error.HTTPError):
+                _get(server.url + path)
+        stats = self._wait_stats(server, "<unknown>", 3)
+        assert stats["<unknown>"]["count"] == 3
+        assert not any(route in stats for route in ("/frogs", "/toads", "/newts"))
+
     def test_recovery_after_reset(self, server):
         metric = MeanSquaredError(error_policy="quarantine")
         server.register(metric)

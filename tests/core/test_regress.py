@@ -136,6 +136,120 @@ class TestCheckRegressions:
         assert regress.check_regressions(mangled_current, history) == []
 
 
+class TestSLOKind:
+    """The chaos bench's `slo` record kind: judged, not just recorded."""
+
+    def _slo_run(self, value, name="chaos_time_to_fire_hang", unit="s", passed=True, **cfg_extra):
+        cfg = {"value": value, "unit": unit, "kind": "slo", "threshold": 5.0, **cfg_extra}
+        return regress.run_record(
+            {
+                "hardware": "cpu-fallback",
+                "configs": {name: cfg},
+                "slo": {"passed": passed, "n_slos": 13, "failed": [] if passed else [name]},
+            }
+        )
+
+    def test_run_record_keeps_kind_threshold_and_slo_summary(self):
+        record = self._slo_run(0.3, passed=False)
+        cfg = record["configs"]["chaos_time_to_fire_hang"]
+        assert cfg["kind"] == "slo" and cfg["threshold"] == 5.0
+        assert record["slo"] == {
+            "passed": False,
+            "n_slos": 13,
+            "failed": ["chaos_time_to_fire_hang"],
+        }
+
+    def test_slo_latency_units_judged_like_timing_configs(self):
+        history = [self._slo_run(0.3), self._slo_run(0.35)]
+        bad = self._slo_run(1.2)  # 4x the best: outside the 1.5x base tolerance
+        (row,) = [r for r in regress.check_regressions(bad, history) if r["config"].startswith("chaos_")]
+        assert row["regressed"]
+        good = self._slo_run(0.33)
+        (row,) = regress.check_regressions(good, history)
+        assert not row["regressed"]
+
+    def test_updates_per_sec_is_higher_is_better(self):
+        history = [_run(25.0, unit="updates/sec", name="chaos_update_throughput")]
+        slow = _run(5.0, unit="updates/sec", name="chaos_update_throughput")
+        (row,) = regress.check_regressions(slow, history)
+        assert row["regressed"]
+
+    def test_variants_is_lower_is_better(self):
+        history = [_run(30.0, unit="variants", name="chaos_compiled_variants")]
+        churny = _run(300.0, unit="variants", name="chaos_compiled_variants")
+        (row,) = regress.check_regressions(churny, history)
+        assert row["regressed"]
+
+    def test_slo_pass_is_strict_zero_tolerance(self):
+        history = [
+            _run(1.0, unit="slo_pass", name="chaos_slo_pass"),
+            _run(1.0, unit="slo_pass", name="chaos_slo_pass"),
+        ]
+        fail = _run(0.0, unit="slo_pass", name="chaos_slo_pass")
+        (row,) = regress.check_regressions(fail, history)
+        assert row["regressed"] and row["baseline"] == 1.0 and row["ratio"] is None
+        ok = _run(1.0, unit="slo_pass", name="chaos_slo_pass")
+        (row,) = regress.check_regressions(ok, history)
+        assert not row["regressed"]
+
+    def test_slo_pass_zero_value_is_still_judged(self):
+        # the generic path skips value<=0 configs; the strict path must not —
+        # a failing SLO run is exactly the value the gate exists to catch
+        history = [_run(1.0, unit="slo_pass", name="chaos_slo_pass")]
+        fail = _run(0.0, unit="slo_pass", name="chaos_slo_pass")
+        (row,) = regress.check_regressions(fail, history)
+        assert row["regressed"]
+
+    def test_slo_pass_without_passing_history_stays_quiet(self):
+        history = [_run(0.0, unit="slo_pass", name="chaos_slo_pass")]
+        fail = _run(0.0, unit="slo_pass", name="chaos_slo_pass")
+        (row,) = regress.check_regressions(fail, history)
+        assert not row["regressed"]
+        no_history = regress.check_regressions(fail, [])
+        assert no_history[0]["baseline"] is None and not no_history[0]["regressed"]
+
+    def test_traced_slo_runs_still_exempt(self):
+        history = [self._slo_run(0.3)]
+        traced = dict(self._slo_run(9.9), traced=True)
+        assert regress.check_regressions(traced, history) == []
+
+    def test_format_table_renders_strict_rows(self):
+        history = [_run(1.0, unit="slo_pass", name="chaos_slo_pass")]
+        fail = _run(0.0, unit="slo_pass", name="chaos_slo_pass")
+        rows = regress.check_regressions(fail, history)
+        text = regress.format_table(rows, hardware="cpu-fallback")
+        assert "REGRESSED" in text and "strict" in text
+
+    def test_spread_floor_caps_throughput_gating_at_the_budget(self):
+        # chaos throughput records {"min": <SLO floor>} as its spread: a
+        # runner-speed dip that stays above the absolute budget must not flag,
+        # while collapsing below the budget still does
+        spread = {"min": 5.0, "max": 24.0, "reps": 1}
+        history = [
+            _run(24.0, unit="updates/sec", name="chaos_update_throughput", spread=spread)
+        ]
+        dip = _run(8.0, unit="updates/sec", name="chaos_update_throughput", spread=spread)
+        (row,) = regress.check_regressions(dip, history)
+        assert not row["regressed"]
+        collapse = _run(3.0, unit="updates/sec", name="chaos_update_throughput")
+        (row,) = regress.check_regressions(collapse, history)
+        assert row["regressed"]
+
+    def test_bucket_spread_absorbs_adjacent_quantization_hop(self):
+        # the scrape-latency configs record their histogram bucket (+1 bucket
+        # of slack) as spread: a 10x one-bucket hop must NOT flag, two must
+        spread = {"min": 1000.0, "max": 100000.0, "reps": 1}
+        history = [
+            _run(5500.0, unit="us", name="chaos_scrape_p99_alerts", spread=spread)
+        ]
+        hop = _run(55000.0, unit="us", name="chaos_scrape_p99_alerts", spread=spread)
+        (row,) = regress.check_regressions(hop, history)
+        assert not row["regressed"]
+        jump = _run(550000.0, unit="us", name="chaos_scrape_p99_alerts")
+        (row,) = regress.check_regressions(jump, history)
+        assert row["regressed"]
+
+
 class TestHistoryFile:
     def test_append_and_load_round_trip(self, tmp_path):
         path = str(tmp_path / "hist.jsonl")

@@ -1,0 +1,49 @@
+"""Traffic-replay chaos bench: production as a measured, SLO-judged scenario.
+
+Three parts, composed by ``bench.py --chaos`` and usable standalone:
+
+- :mod:`~torchmetrics_tpu.chaos.schedule` — a seeded, deterministic traffic
+  schedule (many tenants, mixed shapes, bursts, poisoned batches, a hung
+  host) with a schema-versioned JSONL record/load format.
+- :mod:`~torchmetrics_tpu.chaos.replay` — the driver: the schedule through
+  per-tenant :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline`
+  sessions while a background thread scrapes the live obs server.
+- :mod:`~torchmetrics_tpu.chaos.slo` — the declarative SLO spec + judge:
+  throughput, p95/p99 scrape latency, time-to-fire/time-to-resolve for the
+  injected faults, compiled-variant churn, flight-dump correctness — emitted
+  as bench configs so the regression sentinel gates them like perf numbers.
+
+    from torchmetrics_tpu import chaos
+
+    sched = chaos.generate(chaos.ScheduleConfig(seed=0, tenants=8))
+    report = chaos.judge(chaos.replay(sched))
+    print(chaos.format_report(report))
+"""
+
+from torchmetrics_tpu.chaos.schedule import (
+    SCHEDULE_SCHEMA,
+    ScheduleConfig,
+    ScheduleError,
+    TrafficSchedule,
+    generate,
+    load,
+    loads,
+)
+from torchmetrics_tpu.chaos.replay import ReplayConfig, ReplayError, replay
+from torchmetrics_tpu.chaos.slo import SLOSpec, format_report, judge
+
+__all__ = [
+    "SCHEDULE_SCHEMA",
+    "ReplayConfig",
+    "ReplayError",
+    "SLOSpec",
+    "ScheduleConfig",
+    "ScheduleError",
+    "TrafficSchedule",
+    "format_report",
+    "generate",
+    "judge",
+    "load",
+    "loads",
+    "replay",
+]

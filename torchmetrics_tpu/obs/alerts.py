@@ -30,7 +30,12 @@ lines; :func:`AlertEngine.write_history` dumps the full ring atomically via
 ``utils/fileio``), in the trace event log, and — via
 :meth:`~AlertEngine.record_gauges` — as Prometheus ``ALERTS``-style series
 (``tm_tpu_alerts{alertname,alertstate,...} 1``) plus ``alerts.firing`` /
-``alerts.pending`` totals.
+``alerts.pending`` totals. :meth:`~AlertEngine.fire_resolve_times` derives
+per-episode ``time_to_fire`` (pending→firing) and ``time_to_resolve``
+(firing→resolved) wall deltas from that same bounded history —
+``record_gauges`` publishes the latest episode per (rule, series) as
+``alerts.time_to_fire_seconds`` / ``alerts.time_to_resolve_seconds``, and the
+chaos bench judges its injected faults from exactly these episodes.
 
 A process-global engine (:func:`install` / :func:`get_engine`) is what the
 introspection server's ``GET /alerts`` + degraded-``/healthz`` and the
@@ -618,6 +623,56 @@ class AlertEngine:
         with self._lock:
             return [dict(record) for record in self._history]
 
+    def fire_resolve_times(self) -> List[Dict[str, Any]]:
+        """Fire/resolve episodes derived from the bounded transition history.
+
+        One row per *fire* of a ``(rule, series)`` pair, oldest first::
+
+            {"rule", "series", "tenant", "severity",
+             "breach_at",            # when the breach entered the machine
+             "fired_at", "time_to_fire",      # fired_at - breach_at (0 when
+                                              #  the rule has no pending dwell)
+             "resolved_at", "time_to_resolve"}  # None while still firing
+
+        ``time_to_fire`` is the pending→firing wall delta (the dwell the
+        operator actually waited); ``time_to_resolve`` the firing→resolved
+        delta. A pending episode that cleared without firing produces no row.
+        This is the read behind the chaos bench's time-to-fire /
+        time-to-resolve SLOs and the ``alerts.time_to_*_seconds`` gauges —
+        derived purely from history, so it is as bounded as the history ring.
+        """
+        episodes: List[Dict[str, Any]] = []
+        pending_at: Dict[Tuple[str, str], float] = {}
+        firing: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for record in self.history():
+            key = (record["rule"], record["series"])
+            to = record["to"]
+            if to == STATE_PENDING:
+                pending_at[key] = record["at"]
+            elif to == STATE_FIRING:
+                breach_at = pending_at.pop(key, record["at"])
+                episode = {
+                    "rule": record["rule"],
+                    "series": record["series"],
+                    "tenant": record.get("tenant"),
+                    "severity": record.get("severity"),
+                    "breach_at": breach_at,
+                    "fired_at": record["at"],
+                    "time_to_fire": record["at"] - breach_at,
+                    "resolved_at": None,
+                    "time_to_resolve": None,
+                }
+                episodes.append(episode)
+                firing[key] = episode
+            elif to == STATE_RESOLVED:
+                episode = firing.pop(key, None)
+                if episode is not None:
+                    episode["resolved_at"] = record["at"]
+                    episode["time_to_resolve"] = record["at"] - episode["fired_at"]
+            elif to == STATE_INACTIVE:
+                pending_at.pop(key, None)  # a dwell that never fired
+        return episodes
+
     def report(self) -> Dict[str, Any]:
         """The ``GET /alerts`` payload."""
         with self._lock:
@@ -689,6 +744,31 @@ class AlertEngine:
             self._gauge_keys = live
         rec.set_gauge("alerts.firing", float(n_firing), tenant=None)
         rec.set_gauge("alerts.pending", float(n_pending), tenant=None)
+        # operational-latency gauges: the LATEST episode's pending→firing and
+        # firing→resolved wall deltas per (rule, series) — what a dashboard
+        # plots as "how fast do our watchdogs react". Bounded by the same
+        # cardinality as the ALERTS series above.
+        latest: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for episode in self.fire_resolve_times():
+            latest[(episode["rule"], episode["series"])] = episode
+        for episode in latest.values():
+            labels = {"alertname": episode["rule"], "series": episode["series"]}
+            if episode.get("tenant"):
+                labels["tenant"] = episode["tenant"]
+            rec.set_gauge(
+                "alerts.time_to_fire_seconds",
+                float(episode["time_to_fire"]),
+                **{"tenant": None, **labels},
+            )
+            # the pair always describes ONE episode: a refire that has not
+            # resolved yet must not leave the PREVIOUS episode's resolve
+            # delta standing next to the new fire delta (zero = "current
+            # episode unresolved", the ALERTS zero-on-clear convention)
+            rec.set_gauge(
+                "alerts.time_to_resolve_seconds",
+                float(episode["time_to_resolve"]) if episode["time_to_resolve"] is not None else 0.0,
+                **{"tenant": None, **labels},
+            )
         return {"firing": n_firing, "pending": n_pending}
 
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 import json
 import math
 import sys
-from typing import Any, Dict, IO, Iterable, List, Optional, Union
+from typing import Any, Dict, IO, Iterable, List, Optional, Tuple, Union
 
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.utils.fileio import atomic_write_text
@@ -25,6 +25,7 @@ __all__ = [
     "filter_tenant",
     "histogram_quantile",
     "prometheus_text",
+    "quantile_bucket",
     "summary",
     "write_jsonl",
 ]
@@ -273,6 +274,8 @@ _GAUGE_HELP = {
     "alerts": "ALERTS-style series: 1 while the named alert is pending/firing, 0 on resolve",
     "alerts.firing": "Alerts currently in the firing state",
     "alerts.pending": "Alerts currently dwelling in the pending state (for_seconds not yet met)",
+    "alerts.time_to_fire_seconds": "Latest episode's pending-to-firing wall delta for this (rule, series)",
+    "alerts.time_to_resolve_seconds": "Latest episode's firing-to-resolved wall delta for this (rule, series)",
     # tenant/session attribution families (obs/scope.py): bounded-cardinality
     # per-tenant liveness, with the overflow bucket loud by design
     "tenant.updates": "Metric updates billed to this tenant (ambient scope or captured attribution)",
@@ -290,6 +293,20 @@ def _gauge_help(name: str) -> str:
     if specific is not None:
         return f"{specific} (torchmetrics_tpu.obs)"
     return f"Last recorded value of `{name}` (torchmetrics_tpu.obs)"
+
+
+# specific HELP text for histogram families; the default wording below covers
+# span-derived duration histograms
+_HIST_HELP = {
+    "server.request": "Obs-server HTTP request duration by route — the self-instrumented scrape latency",
+}
+
+
+def _hist_help(name: str) -> str:
+    specific = _HIST_HELP.get(name)
+    if specific is not None:
+        return f"{specific} (torchmetrics_tpu.obs)"
+    return f"Duration distribution of `{name}` in seconds (torchmetrics_tpu.obs)"
 
 
 def prometheus_text(
@@ -330,7 +347,7 @@ def prometheus_text(
         by_name.setdefault(hist["name"], []).append(hist)
     for name in sorted(by_name):
         prom = _prom_name(name) + "_seconds"
-        _prom_header(out, prom, "histogram", f"Duration distribution of `{name}` in seconds (torchmetrics_tpu.obs)")
+        _prom_header(out, prom, "histogram", _hist_help(name))
         for hist in by_name[name]:
             cumulative = 0
             for bound, count in hist["buckets"]:
@@ -377,18 +394,16 @@ def prometheus_text(
 # ------------------------------------------------------------------- quantiles
 
 
-def histogram_quantile(buckets: List[List[float]], q: float) -> Optional[float]:
-    """Estimate the ``q``-quantile of a bucketed duration histogram (seconds).
+def quantile_bucket(buckets: List[List[float]], q: float) -> Optional[Tuple[float, float]]:
+    """``(lower, upper)`` bounds of the bucket holding the ``q``-quantile.
 
-    ``buckets`` is the snapshot shape — ``[[upper_bound, count], ...]`` with
-    *non-cumulative* per-bucket counts, bounds ascending and ending ``+Inf``.
-    Estimation is **bucket-midpoint interpolation**: the quantile lands in the
-    first bucket whose cumulative count reaches ``q * total`` and is reported
-    as that bucket's midpoint (``(lower + upper) / 2``); the open-ended
-    ``+Inf`` bucket reports its lower bound (the only defensible point).
-    With log-scale buckets this is a coarse-but-honest estimate — the error is
-    bounded by the bucket width, which the summary tables document.
-    Returns ``None`` for an empty histogram.
+    The single implementation of the cumulative bucket-selection walk —
+    :func:`histogram_quantile` derives its midpoint estimate from this, and
+    consumers that need the estimate's error bar (the chaos bench's
+    scrape-latency spreads) read the same bucket, so the two can never
+    disagree about which bucket the quantile landed in. The open-ended
+    ``+Inf`` bucket reports ``(lower, lower)``. Returns ``None`` for an
+    empty histogram.
     """
     if not 0.0 < q <= 1.0:
         raise ValueError(f"Expected quantile in (0, 1], got {q}")
@@ -402,11 +417,32 @@ def histogram_quantile(buckets: List[List[float]], q: float) -> Optional[float]:
         cumulative += count
         if cumulative >= target and count:
             if math.isinf(bound):
-                return lower
-            return (lower + bound) / 2.0
+                return (lower, lower)
+            return (lower, bound)
         if not math.isinf(bound):
             lower = bound
-    return lower  # pragma: no cover - cumulative always reaches target above
+    return (lower, lower)  # pragma: no cover - cumulative always reaches target above
+
+
+def histogram_quantile(buckets: List[List[float]], q: float) -> Optional[float]:
+    """Estimate the ``q``-quantile of a bucketed duration histogram (seconds).
+
+    ``buckets`` is the snapshot shape — ``[[upper_bound, count], ...]`` with
+    *non-cumulative* per-bucket counts, bounds ascending and ending ``+Inf``.
+    Estimation is **bucket-midpoint interpolation**: the quantile lands in the
+    first bucket whose cumulative count reaches ``q * total``
+    (:func:`quantile_bucket`) and is reported as that bucket's midpoint
+    (``(lower + upper) / 2``); the open-ended ``+Inf`` bucket reports its
+    lower bound (the only defensible point). With log-scale buckets this is a
+    coarse-but-honest estimate — the error is bounded by the bucket width,
+    which the summary tables document. Returns ``None`` for an empty
+    histogram.
+    """
+    bucket = quantile_bucket(buckets, q)
+    if bucket is None:
+        return None
+    lower, upper = bucket
+    return (lower + upper) / 2.0
 
 
 def _quantile_cols(hist: Dict[str, Any]) -> str:

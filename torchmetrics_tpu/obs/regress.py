@@ -20,7 +20,12 @@ tolerances:
   with its min/max over interleaved reps) are additionally allowed anything
   under ``max(recorded spread maxima) * (1 + headroom)``;
 - runs are only compared against history from the **same hardware tag**
-  (a cpu-fallback round must not be judged against TPU numbers).
+  (a cpu-fallback round must not be judged against TPU numbers);
+- chaos-bench **SLO configs** (``kind: "slo"``, from ``bench.py --chaos``) are
+  judged, not just recorded: their latency/throughput numbers ride the same
+  unit-direction tolerances as timing configs, and the boolean ``slo_pass``
+  config is **strict** — once history shows a pass on this hardware, a later
+  fail regresses with zero tolerance. ``traced`` runs stay exempt either way.
 
 CLI (``python -m torchmetrics_tpu.obs.regress``) exit codes:
 
@@ -79,8 +84,14 @@ def _resolve_default_history() -> str:
 
 # direction by unit: lower-is-better costs vs higher-is-better throughputs;
 # configs with unknown units are not judged (omitted from the table entirely)
-_LOWER_UNITS = {"us/step", "us", "ms/epoch", "ms", "s", "% of step time"}
-_HIGHER_UNITS = {"samples/sec", "imgs/sec", "items/sec", "steps/sec"}
+_LOWER_UNITS = {"us/step", "us", "ms/epoch", "ms", "s", "% of step time", "variants"}
+_HIGHER_UNITS = {"samples/sec", "imgs/sec", "items/sec", "steps/sec", "updates/sec"}
+
+# strict pass/fail units (the chaos bench's `slo_pass` config): judged with
+# ZERO tolerance — once history shows a pass (1.0), any later fail (0.0) on
+# the same hardware regresses, noise headroom notwithstanding. A boolean has
+# no noise to be aware of.
+_STRICT_UNITS = {"slo_pass"}
 
 _REL_TOL = 0.5  # a config must cost >1.5x its best history to flag (pre-noise)
 _NOISE_HEADROOM = 0.1  # margin multiplied onto the observed historical spread
@@ -124,6 +135,14 @@ def run_record(
         if not isinstance(value, (int, float)) or isinstance(value, bool):
             continue
         entry: Dict[str, Any] = {"value": float(value), "unit": cfg.get("unit")}
+        if cfg.get("kind") == "slo":
+            # the chaos bench's SLO configs: `kind` marks them and the
+            # absolute judged threshold rides along, so history shows WHAT the
+            # number was promised against, not just what it was
+            entry["kind"] = "slo"
+            threshold = cfg.get("threshold")
+            if isinstance(threshold, (int, float)) and not isinstance(threshold, bool):
+                entry["threshold"] = float(threshold)
         spread = cfg.get("spread")
         if isinstance(spread, dict):
             clean = {
@@ -166,6 +185,17 @@ def run_record(
         # predicted-vs-measured story accumulates across rounds, never judged
         # by check_regressions — same passthrough contract as memory/engine
         record["cost"] = cost
+    slo = result.get("slo")
+    if isinstance(slo, dict):
+        # chaos-bench SLO verdict. Unlike memory/engine/cost this is NOT a
+        # passthrough-only section: the judged numbers live in `configs` (slo
+        # kind, judged via their units incl. the strict `slo_pass`), and this
+        # compact summary records which SLOs failed for the history reader.
+        record["slo"] = {
+            "passed": bool(slo.get("passed")),
+            "n_slos": int(slo.get("n_slos", 0) or 0),
+            "failed": [str(name) for name in (slo.get("failed") or [])],
+        }
     return record
 
 
@@ -229,6 +259,19 @@ def _spread_max(entries: List[Dict[str, Any]]) -> Optional[float]:
     return max(values) if values else None
 
 
+def _spread_min(entries: List[Dict[str, Any]]) -> Optional[float]:
+    """The lowest recorded spread minimum — the higher-is-better mirror of
+    :func:`_spread_max`: a throughput config that recorded its own observed
+    (or budgeted) floor is allowed anything above it."""
+    values = [
+        entry["spread"]["min"]
+        for entry in entries
+        if isinstance(entry.get("spread"), dict)
+        and isinstance(entry["spread"].get("min"), (int, float))
+    ]
+    return min(values) if values else None
+
+
 def check_regressions(
     current: Dict[str, Any],
     history: List[Dict[str, Any]],
@@ -257,13 +300,42 @@ def check_regressions(
         unit = cfg.get("unit")
         direction = _direction(unit)
         value = cfg.get("value")
-        if direction is None or not isinstance(value, (int, float)):
+        if (direction is None and unit not in _STRICT_UNITS) or not isinstance(value, (int, float)):
             continue
         entries = [
             run["configs"][name]
             for run in baseline_runs
             if isinstance(run.get("configs", {}).get(name), dict)
         ]
+        if unit in _STRICT_UNITS:
+            # boolean pass/fail: zero tolerance against the best history value
+            # (once this hardware has passed, failing again is a regression —
+            # the noise machinery below has nothing to widen)
+            strict_values = [
+                e["value"]
+                for e in entries
+                if isinstance(e.get("value"), (int, float)) and not isinstance(e["value"], bool)
+            ]
+            row = {
+                "config": name,
+                "unit": unit,
+                "value": float(value),
+                "n_history": len(strict_values),
+            }
+            if not strict_values:
+                row.update({"baseline": None, "allowed": None, "ratio": None, "regressed": False})
+            else:
+                best = max(strict_values)
+                row.update(
+                    {
+                        "baseline": round(best, 4),
+                        "allowed": round(best, 4),
+                        "ratio": None,
+                        "regressed": bool(value < best),
+                    }
+                )
+            rows.append(row)
+            continue
         values = [
             e["value"] for e in entries if isinstance(e.get("value"), (int, float)) and e["value"] > 0
         ]
@@ -292,6 +364,9 @@ def check_regressions(
             noise_ratio = best / worst if worst > 0 else 1.0
             allowed_ratio = max(1.0 + rel_tol, noise_ratio * (1.0 + noise_headroom))
             allowed = best / allowed_ratio
+            spread_floor = _spread_min(entries)
+            if spread_floor is not None and spread_floor > 0:
+                allowed = min(allowed, spread_floor * (1.0 - noise_headroom))
             ratio = best / value
             regressed = value < allowed
         row.update(
@@ -319,9 +394,12 @@ def format_table(rows: List[Dict[str, Any]], hardware: Optional[str] = None) -> 
             detail = f"value={row['value']:g} {row['unit']}"
         else:
             verdict = "REGRESSED" if row["regressed"] else "ok"
+            # strict (pass/fail) rows carry no ratio — there is no "how much
+            # worse" for a boolean, only pass or fail against the baseline
+            ratio = "strict" if row["ratio"] is None else f"{row['ratio']:g}x"
             detail = (
                 f"value={row['value']:g} best={row['baseline']:g} allowed={row['allowed']:g}"
-                f" ratio={row['ratio']:g}x (n={row['n_history']}) {row['unit']}"
+                f" ratio={ratio} (n={row['n_history']}) {row['unit']}"
             )
         lines.append(f"  {row['config']:<{width}}  {verdict:<10}  {detail}")
     n_bad = sum(1 for r in rows if r.get("regressed"))

@@ -37,6 +37,18 @@ dependencies, localhost by default:
   (404 on a tenant the registry has never seen), and a degraded ``/healthz``
   names the offending tenant(s) under ``tenants_degraded``.
 
+Self-instrumentation: every request lands in the server's **own** recorder —
+a ``server.request`` duration histogram per route (exported as
+``tm_tpu_server_request_seconds{route}``) plus ``server.requests`` /
+``server.errors`` counters — so scrape latency is measurable *from the obs
+plane itself* (``/metrics`` reports the cost of serving ``/metrics``), not
+only by an external prober. These land unconditionally (running the server is
+the opt-in, like the explicit memory-accounting calls); only the per-request
+trace *events* stay behind the ``trace.ENABLED`` gate.
+:meth:`IntrospectionServer.request_stats` returns the per-route histograms in
+the snapshot bucket shape :func:`~torchmetrics_tpu.obs.export.histogram_quantile`
+consumes — the chaos bench's scrape-latency SLOs read exactly that.
+
 Lifecycle contract: :func:`start` is idempotent (a second call returns the
 running server), :meth:`IntrospectionServer.stop` is idempotent and leaves no
 thread behind, and a process that never starts the server pays nothing — no
@@ -56,6 +68,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
@@ -145,7 +158,13 @@ class _Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         route = parsed.path.rstrip("/") or "/"
         query = parse_qs(parsed.query)
-        owner._rec_inc("server.requests", route=route)
+        # telemetry label: unknown paths collapse to ONE bucket — request
+        # recording is unconditional now, and a prober walking random URLs
+        # must not mint a fresh series per path (the recorder's series cap
+        # would fill with garbage and then refuse legitimate new series)
+        route_label = route if (route == "/" or route in ROUTES) else "<unknown>"
+        owner._rec_inc("server.requests", route=route_label)
+        start = time.perf_counter()
         try:
             tenant = query.get("tenant", [None])[0]
             if tenant is not None and route in _TENANT_ROUTES:
@@ -203,11 +222,16 @@ class _Handler(BaseHTTPRequestHandler):
         except BrokenPipeError:  # client went away mid-response: not our problem
             pass
         except Exception as err:  # never kill the serving thread on a handler bug
-            owner._rec_inc("server.errors", route=route)
+            owner._rec_inc("server.errors", route=route_label)
             try:
                 self._send_json({"error": f"{type(err).__name__}: {err}"}, status=500)
             except Exception:
                 pass
+        finally:
+            # scrape-latency self-instrumentation: the duration of serving
+            # this request, whatever happened to it, into the per-route
+            # server.request histogram (module docstring)
+            owner._observe_request(route_label, time.perf_counter() - start)
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -254,11 +278,18 @@ class IntrospectionServer:
 
     # server telemetry goes to THIS server's recorder (not the process-global
     # one — a custom-recorder server's request counters must show up in its
-    # own /metrics and /snapshot, not pollute an unrelated session), with the
-    # same trace.ENABLED gate as every other instrumented site
+    # own /metrics and /snapshot, not pollute an unrelated session).
+    # Counters and the request-duration histogram record UNconditionally:
+    # running the server is the opt-in, and scrape latency must be measurable
+    # from the obs plane itself. Only the verbose per-request trace events
+    # keep the trace.ENABLED gate.
     def _rec_inc(self, name: str, **labels: Any) -> None:
-        if trace.ENABLED:
-            self.recorder.inc(name, **labels)
+        # tenant=None: a scrape served from inside someone's tenant scope must
+        # not have the server's own telemetry billed to that tenant
+        self.recorder.inc(name, tenant=None, **labels)
+
+    def _observe_request(self, route: str, seconds: float) -> None:
+        self.recorder.observe_duration("server.request", seconds, tenant=None, route=route)
 
     def _rec_event(self, name: str, **attrs: Any) -> None:
         if trace.ENABLED:
@@ -338,6 +369,26 @@ class IntrospectionServer:
     def metrics(self) -> List[Any]:
         with self._metrics_lock:
             return list(self._metrics)
+
+    def request_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-route self-instrumented request-duration histograms.
+
+        ``{route: {"count", "errors", "sum_seconds", "buckets"}}`` where
+        ``buckets`` is the snapshot shape (``[[upper_bound, count], ...]``,
+        non-cumulative) that
+        :func:`~torchmetrics_tpu.obs.export.histogram_quantile` consumes —
+        the read behind the chaos bench's p95/p99 scrape-latency SLOs.
+        """
+        out: Dict[str, Dict[str, Any]] = {}
+        for row in self.recorder.histograms(name="server.request"):
+            route = row["labels"].get("route", "?")
+            out[route] = {
+                "count": row["count"],
+                "errors": int(self.recorder.counter_value("server.errors", route=route)),
+                "sum_seconds": round(row["sum"], 6),
+                "buckets": row["buckets"],
+            }
+        return out
 
     # -------------------------------------------------------------------- alerts
 

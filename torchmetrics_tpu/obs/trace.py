@@ -345,6 +345,20 @@ class TraceRecorder:
                 for (name, labels), hist in self._hists.items()
             ]
 
+    def histograms(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        """Labeled histogram snapshots, optionally filtered to one family.
+
+        The public read behind per-family consumers (the obs server's
+        ``request_stats``) — ``snapshot()`` would copy the whole event ring
+        for nothing.
+        """
+        with self._lock:
+            return [
+                {"name": hist_name, "labels": dict(labels), **hist.snapshot()}
+                for (hist_name, labels), hist in self._hists.items()
+                if name is None or hist_name == name
+            ]
+
     def series_counts_by_label(
         self, label: str, exclude_name_prefix: Optional[str] = None
     ) -> Dict[str, int]:
