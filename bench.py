@@ -280,6 +280,117 @@ def bench_acc_engine(preds, target, fuse: int):
     return elapsed / STEPS * 1e6, stats
 
 
+def bench_acc_mux(preds, target, n_tenants: int):
+    """Multiplexer configs: N tenant sessions through ONE cross-tenant fused
+    dispatch stream vs N per-tenant pipeline sessions (the PR-8 serving shape).
+
+    Both sides drive the same sliced accuracy batches (256 rows — the tenant
+    axis, not the per-tenant batch, is the load), both are warmed outside the
+    timed region (AOT for the mux, a discarded warm round for the pipelines),
+    and both close over the same total tenant-update count. Returns
+    ``(mux_us_per_update, stats)`` where ``stats`` carries the per-tenant
+    baseline timing, the speedup, and — the structural claim — each side's
+    fresh-compiled-variant count from the cost ledger: the baseline compiles
+    O(tenants) programs (every instance its own jit cache), the mux
+    O(width-buckets).
+    """
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.engine import (
+        MetricPipeline,
+        MuxConfig,
+        PipelineConfig,
+        TenantMultiplexer,
+    )
+    from torchmetrics_tpu.obs import cost as _cost_mod
+
+    rows = 256  # per-tenant batch rows: small on purpose (the tenant axis is the load)
+    n_distinct = 8
+    batches = [(preds[i][:rows], target[i][:rows]) for i in range(n_distinct)]
+    jax.block_until_ready(batches)
+    rounds = max(2, 256 // n_tenants)
+    total = rounds * n_tenants
+    make = lambda: MulticlassAccuracy(  # noqa: E731 - bench-local factory
+        num_classes=NUM_CLASSES, average="micro", validate_args=False
+    )
+    ledger = _cost_mod.get_ledger()
+
+    # ---- fused multiplexer: one dispatch folds up to n_tenants rows
+    mux_mark = ledger.mark()
+    mux = TenantMultiplexer(make, MuxConfig(max_width=n_tenants))
+    tenants = [f"mux{n_tenants}-{i:03d}" for i in range(n_tenants)]
+    for t in tenants:
+        mux.adopt(t)
+    mux.warmup(*batches[0])
+    for t in tenants:  # warm round: remaining dispatch paths execute once
+        mux.feed(t, *batches[0])
+    mux.flush()
+    for t in tenants:
+        jax.block_until_ready(mux.compute(t))
+        mux.metric(t).reset()
+    before = mux.report().asdict()
+    start = time.perf_counter()
+    for r in range(rounds):
+        for j, t in enumerate(tenants):
+            mux.feed(t, *batches[(r + j) % n_distinct])
+    mux.flush()
+    # drain EVERY tenant's async state before stopping the clock — blocking
+    # on one tenant would leave in-flight work outside the timed region
+    jax.block_until_ready([mux.metric(t)._state_values for t in tenants])
+    mux_elapsed = time.perf_counter() - start
+    after = mux.close().asdict()
+    mux_variants = ledger.since(mux_mark)["variants_compiled"]
+    mux_us = mux_elapsed / total * 1e6
+
+    # ---- baseline: one pipeline session per tenant (fuse=1: the serving
+    # shape before cross-tenant batching — per-tenant dispatch streams)
+    base_mark = ledger.mark()
+    pipes = {
+        t: MetricPipeline(
+            make(), PipelineConfig(fuse=1, max_in_flight=2, prefetch=0, tenant=f"pipe-{t}")
+        )
+        for t in tenants
+    }
+    for t, pipe in pipes.items():  # warm round (each instance compiles its own program)
+        pipe.feed(*batches[0])
+        jax.block_until_ready(pipe.compute())
+        pipe.metric.reset()
+    start = time.perf_counter()
+    for r in range(rounds):
+        for j, (t, pipe) in enumerate(pipes.items()):
+            pipe.feed(*batches[(r + j) % n_distinct])
+    for pipe in pipes.values():
+        pipe.flush()
+    # symmetric drain: all N independent pipelines' async dispatches must
+    # finish inside the timed region, exactly as on the mux side
+    jax.block_until_ready([pipe.metric._state_values for pipe in pipes.values()])
+    base_elapsed = time.perf_counter() - start
+    for pipe in pipes.values():
+        pipe.close()
+    base_variants = ledger.since(base_mark)["variants_compiled"]
+    base_us = base_elapsed / total * 1e6
+
+    timed = {
+        key: after[key] - before[key]
+        for key in after
+        if isinstance(after[key], int) and isinstance(before.get(key), int)
+        and key not in ("max_width", "last_width")
+    }
+    stats = {
+        "tenants": n_tenants,
+        "rows_per_batch": rows,
+        "updates_timed": total,
+        "mux_us_per_update": round(mux_us, 3),
+        "per_tenant_pipelines_us_per_update": round(base_us, 3),
+        "speedup_vs_per_tenant": round(base_us / mux_us, 3) if mux_us > 0 else None,
+        "timed_run": timed,
+        "compiled_variants": {"mux": mux_variants, "per_tenant_pipelines": base_variants},
+        "cache": mux.cache_info(),
+    }
+    return mux_us, stats
+
+
 def bench_acc_scan(preds, target) -> float:
     """Config #2: whole epoch folded through ``lax.scan`` in ONE XLA program."""
     import jax
@@ -891,6 +1002,12 @@ def _chaos_main(argv) -> None:
     parser.add_argument("--chaos-tenants", type=int, default=8)
     parser.add_argument("--chaos-seed", type=int, default=0)
     parser.add_argument(
+        "--chaos-scenario", choices=("default", "high_tenant"), default="default",
+        help="high_tenant: >=64 tenants with shared signatures and bursty arrivals,"
+             " replayed through the cross-tenant multiplexer and judged against the"
+             " high-tenant SLO spec (configs prefixed chaos_ht_*)",
+    )
+    parser.add_argument(
         "--chaos-schedule", default=None,
         help="replay a recorded schedule JSONL instead of generating one",
     )
@@ -923,8 +1040,13 @@ def _chaos_main(argv) -> None:
     from torchmetrics_tpu import chaos
     from torchmetrics_tpu.utils.fileio import atomic_write_text
 
+    high_tenant = args.chaos_scenario == "high_tenant"
     if args.chaos_schedule:
         sched = chaos.load(args.chaos_schedule)
+    elif high_tenant:
+        sched = chaos.generate(
+            chaos.high_tenant_config(seed=args.chaos_seed, tenants=max(64, args.chaos_tenants))
+        )
     else:
         sched = chaos.generate(
             chaos.ScheduleConfig(seed=args.chaos_seed, tenants=args.chaos_tenants)
@@ -932,13 +1054,22 @@ def _chaos_main(argv) -> None:
     if args.chaos_save_schedule:
         sched.save(args.chaos_save_schedule)
 
-    result = chaos.replay(sched)
-    report = chaos.judge(result)
+    if high_tenant:
+        # the multiplexed scenario: guarded/hung tenants share ONE cross-tenant
+        # fused dispatch stream; distinct config prefix so the sentinel never
+        # baselines this workload against the default scenario's
+        result = chaos.replay(
+            sched, chaos.ReplayConfig(multiplex=True, mux_max_width=len(sched.tenants))
+        )
+        report = chaos.judge(result, chaos.high_tenant_slo_spec(), prefix="chaos_ht")
+    else:
+        result = chaos.replay(sched)
+        report = chaos.judge(result)
     sys.stderr.write(chaos.format_report(report))
 
     line = {
         "metric": (
-            f"chaos replay bench ({len(sched.tenants)} tenants,"
+            f"chaos replay bench ({args.chaos_scenario} scenario, {len(sched.tenants)} tenants,"
             f" {result['batches_fed']} batches, seed {sched.config.seed})"
         ),
         "value": 1.0 if report["passed"] else 0.0,
@@ -960,6 +1091,9 @@ def _chaos_main(argv) -> None:
             "faults": result["faults"],
             "robust": result["robust"],
             "cost": result["cost"],
+            "scenario": args.chaos_scenario,
+            # cross-tenant fused dispatch accounting (None when unmultiplexed)
+            "mux": result["mux"],
         },
     }
     print(json.dumps(line, sort_keys=True, default=str))
@@ -1244,6 +1378,19 @@ def _engine_configs(obs_by_config: dict, preds, target) -> dict:
     return out
 
 
+def _mux_configs(obs_by_config: dict, preds, target) -> dict:
+    """Both multiplexer configs as flat keys + a `mux_stats` side channel."""
+    out: dict = {}
+    stats: dict = {}
+    for name, n_tenants in (("multiplexed_8tenants", 8), ("multiplexed_64tenants", 64)):
+        res = _safe_obs(obs_by_config, name, bench_acc_mux, preds, target, n_tenants)
+        if res is not None:
+            out[name], stats[name] = res
+    if stats:
+        out["mux_stats"] = stats
+    return out
+
+
 def _run_ours(hardware: str) -> dict:
     """Measure our configs in THIS process (backend already chosen)."""
     preds, target = _stage_data()
@@ -1252,6 +1399,7 @@ def _run_ours(hardware: str) -> dict:
         "stateful": _safe_obs(obs_by_config, "stateful", bench_acc_stateful, preds, target),
         "scan": _safe_obs(obs_by_config, "scan", bench_acc_scan, preds, target),
         **_engine_configs(obs_by_config, preds, target),
+        **_mux_configs(obs_by_config, preds, target),
         **(_safe(bench_sync_overhead_stats) or {}),
         "curve": _safe_obs(obs_by_config, "curve", bench_pr_curve),
         "inception": _safe_obs(obs_by_config, "inception", bench_inception, hardware),
@@ -1307,9 +1455,10 @@ def _worker_main(mode: str) -> None:
             "rouge": _safe_obs(obs_by_config, "rouge", bench_rouge),
             "ref_rouge": _safe(ref_rouge),
         })
-        # engine configs carry a non-numeric stats dict, so they stay outside
+        # engine/mux configs carry a non-numeric stats dict, so they stay outside
         # the min-merge (their timings are single-round like the model configs)
         out.update(_engine_configs(obs_by_config, preds, target))
+        out.update(_mux_configs(obs_by_config, preds, target))
         out["obs_demo"] = _obs_demo()
         if obs_by_config:
             out["obs_configs"] = obs_by_config
@@ -1466,6 +1615,12 @@ def main(check_regressions: bool = False) -> None:
             return None
         return round(max(0.0, (with_sync - without_sync) / with_sync * 100.0), 2)
 
+    def _mux_baseline(ours_dict, name):
+        # the multiplexer configs' baseline is measured in the same run: the
+        # identical traffic through per-tenant pipeline sessions
+        stats = (ours_dict.get("mux_stats") or {}).get(name) or {}
+        return stats.get("per_tenant_pipelines_us_per_update")
+
     configs = {
         "acc_update_stateful": {
             "value": ours_stateful, "unit": "us/step", "baseline": ref_stateful,
@@ -1487,6 +1642,27 @@ def main(check_regressions: bool = False) -> None:
             "note": "config #1 loop through the streaming engine, fuse=8: 8 batches per"
                     " lax.scan dispatch after AOT warmup; dispatch/warmup/compile-cache"
                     " stats ride in the top-level `engine` key (recorded, never judged)",
+        },
+        "acc_update_multiplexed_8tenants": {
+            "value": ours.get("multiplexed_8tenants"), "unit": "us/step",
+            "baseline": _mux_baseline(ours, "multiplexed_8tenants"),
+            "vs_baseline": ratio(
+                _mux_baseline(ours, "multiplexed_8tenants"), ours.get("multiplexed_8tenants")
+            ),
+            "note": "8 tenant sessions through ONE cross-tenant fused vmap dispatch"
+                    " (256-row accuracy batches, AOT-warmed); baseline = the same"
+                    " traffic through 8 per-tenant pipeline sessions; variant counts"
+                    " ride in the top-level `mux` key (recorded, never judged)",
+        },
+        "acc_update_multiplexed_64tenants": {
+            "value": ours.get("multiplexed_64tenants"), "unit": "us/step",
+            "baseline": _mux_baseline(ours, "multiplexed_64tenants"),
+            "vs_baseline": ratio(
+                _mux_baseline(ours, "multiplexed_64tenants"), ours.get("multiplexed_64tenants")
+            ),
+            "note": "64 tenant sessions through ONE cross-tenant fused vmap dispatch;"
+                    " the compiled-program collapse (O(buckets) vs O(tenants)) is the"
+                    " structural claim — see the `mux` key's compiled_variants",
         },
         "collection_acc_f1_auroc_mesh_sync": {
             "value": ours_collection, "unit": "us/step", "baseline": ref_col,
@@ -1566,6 +1742,9 @@ def main(check_regressions: bool = False) -> None:
         # sizes, AOT-warmup compile totals, persistent-compile-cache hits):
         # recorded in the JSON line and the history record, never judged
         "engine": ours.get("engine_stats"),
+        # cross-tenant multiplexer accounting (timings, per-side compiled
+        # variants, speedup vs per-tenant pipelines) — recorded, never judged
+        "mux": ours.get("mux_stats"),
         # peak host RSS (+ device HBM peak when the backend reports it), max
         # across this process and the workers; recorded in the history line,
         # never judged by the regression gate

@@ -459,3 +459,130 @@ class TestReplayEndToEnd:
         # the dump metas survived the cleanup
         assert all("poisoned_batches" in d for d in result["flight"]["dumps"])
         assert set(glob.glob(pattern)) == before  # nothing leaked on disk
+
+
+# ------------------------------------------- high-tenant preset + multiplexing
+
+
+class TestHighTenantPreset:
+    def test_preset_is_deterministic_and_loads(self):
+        a = chaos_schedule.generate(chaos_schedule.high_tenant_config(seed=3))
+        b = chaos_schedule.generate(chaos_schedule.high_tenant_config(seed=3))
+        assert a.to_jsonl() == b.to_jsonl()
+        assert len(a.tenants) == 64
+        reloaded = chaos_schedule.loads(a.to_jsonl())
+        assert reloaded.roles == a.roles
+        # the fault surfaces are unchanged: one victim, one hung, rest guarded
+        assert len(reloaded.guarded) == 62
+
+    def test_preset_shares_signatures_and_bursts(self):
+        config = chaos_schedule.high_tenant_config(seed=0)
+        assert config.burst >= 8  # bursty arrivals
+        assert len(config.batch_sizes) >= 2  # signature churn stays in play
+        sched = chaos_schedule.generate(config)
+        sizes = {ev["size"] for ev in sched.batches()}
+        assert sizes == set(config.batch_sizes)  # shared across the population
+
+    def test_preset_rejects_small_tenant_counts(self):
+        with pytest.raises(ValueError, match="tenants"):
+            chaos_schedule.high_tenant_config(tenants=8)
+
+    def test_judge_prefix_names_distinct_configs(self):
+        report = chaos_slo.judge(_fake_result(), prefix="chaos_ht")
+        assert "chaos_ht_slo_pass" in report["configs"]
+        assert "chaos_ht_update_throughput" in report["configs"]
+        assert not any(name.startswith("chaos_u") for name in report["configs"])
+
+    def test_mux_engaged_slo(self):
+        spec = chaos_slo.SLOSpec(require_multiplexed=True)
+        good = _fake_result(
+            mux={"report": {"fused_updates": 80, "dispatches": 10, "max_width": 8}}
+        )
+        report = chaos_slo.judge(good, spec)
+        assert "mux_engaged" not in report["failed"]
+        bad = _fake_result(mux=None)
+        report = chaos_slo.judge(bad, spec)
+        assert "mux_engaged" in report["failed"]
+
+    def test_quarantine_attribution_slo(self):
+        spec = chaos_slo.SLOSpec(
+            require_poisoned_named=False, require_quarantine_attributed=True
+        )
+        good = _fake_result(robust={"quarantined": {"tenant-04": 1}, "sync_degraded": []})
+        assert "quarantine_attributed" not in chaos_slo.judge(good, spec)["failed"]
+        missed = _fake_result(robust={"quarantined": {}, "sync_degraded": []})
+        assert "quarantine_attributed" in chaos_slo.judge(missed, spec)["failed"]
+        # cohort bleed: a tenant nobody poisoned showing quarantines FAILS
+        bled = _fake_result(
+            robust={"quarantined": {"tenant-04": 1, "tenant-02": 1}, "sync_degraded": []}
+        )
+        assert "quarantine_attributed" in chaos_slo.judge(bled, spec)["failed"]
+
+    def test_high_tenant_spec_shape(self):
+        spec = chaos_slo.high_tenant_slo_spec()
+        assert spec.require_multiplexed and spec.require_quarantine_attributed
+        assert not spec.require_poisoned_named  # the mux has no flight recorder
+        assert spec.max_compiled_variants < 160  # tighter than the default
+
+
+class TestMultiplexedReplay:
+    @pytest.fixture(scope="class")
+    def run(self):
+        """One real multiplexed chaos run (8 tenants to stay CI-sized; the
+        64-tenant scenario is the bench.py --chaos-scenario high_tenant job)."""
+        sched = chaos_schedule.generate(
+            ScheduleConfig(
+                seed=0,
+                tenants=8,
+                warm_batches=2,
+                churn_batches=2,
+                drain_batches=3,
+                hang_seconds=0.5,
+                absent_after_seconds=0.15,
+                idle_gap_seconds=0.01,
+            )
+        )
+        config = ReplayConfig(
+            multiplex=True,
+            mux_max_width=8,
+            scrape_interval_seconds=0.03,
+            sync_timeout_seconds=0.02,
+        )
+        result = replay(sched, config)
+        spec = chaos_slo.SLOSpec(
+            require_poisoned_named=False,
+            require_multiplexed=True,
+            require_quarantine_attributed=True,
+        )
+        return sched, result, chaos_slo.judge(result, spec, prefix="chaos_mx")
+
+    def test_multiplexed_run_passes_all_slos(self, run):
+        _, _, report = run
+        assert report["passed"], chaos_slo.format_report(report)
+
+    def test_mux_actually_fused_across_tenants(self, run):
+        _, result, _ = run
+        mux = result["mux"]
+        assert mux is not None and mux["tenants"] == 7  # victim stays a pipeline
+        assert mux["report"]["fused_updates"] > mux["report"]["dispatches"] > 0
+        assert mux["report"]["max_width"] > 1  # real cross-tenant grouping
+
+    def test_poison_isolated_to_owning_tenant_without_dumps(self, run):
+        sched, result, _ = run
+        poisoned_guarded = [
+            tenant for tenant in sched.poisoned() if tenant != sched.victim
+        ]
+        assert result["robust"]["quarantined"] == {tenant: 1 for tenant in poisoned_guarded}
+
+    def test_fault_watchdogs_fire_and_resolve_through_the_mux(self, run):
+        _, _, report = run
+        for fault in ("poison", "hang"):
+            assert report["configs"][f"chaos_mx_time_to_fire_{fault}"]["value"] >= 0.0
+            assert report["configs"][f"chaos_mx_time_to_resolve_{fault}"]["value"] >= 0.0
+
+    def test_fewer_variants_than_tenant_scaling(self, run):
+        sched, result, _ = run
+        # the structural claim at suite scale: compiled variants stay well
+        # under one-per-(tenant × signature)
+        n_sigs = len(sched.config.batch_sizes)
+        assert result["cost"]["compiled_variants"] < len(sched.tenants) * n_sigs

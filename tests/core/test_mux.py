@@ -1,0 +1,792 @@
+"""Cross-tenant fused dispatch + admission suite (marker: ``engine``).
+
+Covers ``torchmetrics_tpu.engine.mux`` and the admission plane in
+``torchmetrics_tpu.obs.scope``: multiplexed updates bit-identical to
+per-tenant eager across metric families (incl. MaskedBuffer state and a
+collection with compute groups), tenant-width bucket padding with masked
+rows, poisoned-batch isolation to exactly the owning tenant, the
+compiled-variant bound (O(width-buckets × signatures), not O(tenants ×
+signatures)) asserted via ``StaticLeafJit.cache_info()`` AND the cost-ledger
+delta, admission shed/defer decisions with quota gauges and the
+``tenant.quota_exceeded`` alert signal, the ``/tenants`` quota columns, AOT
+warmup, and the disabled-path overhead smoke (multiplexer imported but
+unused).
+
+Everything is CPU-deterministic and fast: tiny batches, no sleeps, no network.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.engine import (
+    MetricPipeline,
+    MuxConfig,
+    PipelineConfig,
+    TenantMultiplexer,
+    pow2_buckets,
+)
+from torchmetrics_tpu.obs import cost as obs_cost
+from torchmetrics_tpu.obs import scope as obs_scope
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.regression import MeanSquaredError
+
+pytestmark = pytest.mark.engine
+
+
+@pytest.fixture(autouse=True)
+def _clean_scope():
+    """Tenancy and admission are process-global: every test starts and ends
+    on the pristine disabled path (the obs suites' reset discipline)."""
+    obs_scope.reset()
+    yield
+    obs_scope.reset()
+
+
+def _class_batches(n, batch=16, classes=5, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _value_batches(n, size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.rand(size).astype(np.float32)),) for _ in range(n)]
+
+
+def _pair_batches(n, size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(size).astype(np.float32)),
+            jnp.asarray(rng.rand(size).astype(np.float32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _nan_pair(size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        jnp.asarray(np.full(size, np.nan, np.float32)),
+        jnp.asarray(rng.rand(size).astype(np.float32)),
+    )
+
+
+def _assert_states_identical(reference: Metric, driven: Metric):
+    for key in reference._defaults:
+        a, b = reference._state_values[key], driven._state_values[key]
+        if isinstance(a, list):
+            assert len(a) == len(b)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        elif hasattr(a, "data") and hasattr(a, "count"):  # MaskedBuffer
+            np.testing.assert_array_equal(np.asarray(a.data), np.asarray(b.data))
+            np.testing.assert_array_equal(np.asarray(a.count), np.asarray(b.count))
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+TENANTS = ("acme", "bravo", "carol", "delta", "echo")
+
+
+def _drive(maker, per_tenant_batches, max_width=8):
+    """References updated eagerly per tenant vs the same traffic multiplexed."""
+    refs = {t: maker() for t in per_tenant_batches}
+    mux = TenantMultiplexer(maker, MuxConfig(max_width=max_width))
+    for t in per_tenant_batches:
+        mux.adopt(t)
+    rounds = max(len(b) for b in per_tenant_batches.values())
+    for rnd in range(rounds):
+        for t, batches in per_tenant_batches.items():
+            if rnd < len(batches):
+                refs[t].update(*batches[rnd])
+                mux.feed(t, *batches[rnd])
+    mux.close()
+    return refs, mux
+
+
+# ------------------------------------------------------------------ bit identity
+
+
+class TestMultiplexedBitIdentical:
+    @pytest.mark.parametrize(
+        "maker, batch_fn",
+        [
+            (
+                lambda: MulticlassAccuracy(num_classes=5, validate_args=False),
+                lambda seed: _class_batches(3, seed=seed),
+            ),
+            (lambda: MeanSquaredError(), lambda seed: _pair_batches(3, seed=seed)),
+            (
+                lambda: MeanMetric(nan_strategy="ignore"),
+                lambda seed: _value_batches(3, seed=seed),
+            ),
+            (
+                lambda: CatMetric(capacity=64, nan_strategy=0.0),  # MaskedBuffer state
+                lambda seed: _value_batches(3, seed=seed),
+            ),
+        ],
+        ids=["accuracy", "mse", "mean", "cat_masked_buffer"],
+    )
+    def test_multiplexed_equals_per_tenant_eager(self, maker, batch_fn):
+        data = {t: batch_fn(seed) for seed, t in enumerate(TENANTS)}
+        refs, mux = _drive(maker, data)
+        for t in TENANTS:
+            _assert_states_identical(refs[t], mux.metric(t))
+            np.testing.assert_array_equal(
+                np.asarray(refs[t].compute()), np.asarray(mux.compute(t))
+            )
+            assert mux.metric(t)._update_count == refs[t]._update_count == 3
+        report = mux.report()
+        assert report.fused_updates == 3 * len(TENANTS)
+        assert report.dispatches < report.fused_updates  # fusion actually fused
+
+    def test_collection_with_compute_groups_identical_and_aliased(self):
+        def coll():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=5, validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=5, validate_args=False),
+                    "auroc": MulticlassAUROC(num_classes=5, thresholds=10, validate_args=False),
+                }
+            )
+
+        data = {t: _class_batches(2, seed=seed + 20) for seed, t in enumerate(TENANTS)}
+        refs, mux = _drive(coll, data)
+        for t in TENANTS:
+            ref_res, mux_res = refs[t].compute(), mux.compute(t)
+            assert sorted(ref_res) == sorted(mux_res)
+            for key in ref_res:
+                np.testing.assert_array_equal(np.asarray(ref_res[key]), np.asarray(mux_res[key]))
+            # the acc/f1 compute group: members alias the leader's state arrays
+            # after mux commits, exactly like update()
+            driven = mux.metric(t)
+            groups = [g for g in driven.compute_groups.values() if len(g) > 1]
+            assert groups, "expected acc/f1 to share a compute group"
+            leader, member = groups[0][0], groups[0][1]
+            for state in driven[leader]._defaults:
+                assert driven[member]._state_values[state] is driven[leader]._state_values[state]
+
+    def test_ragged_list_state_degrades_to_eager_and_matches(self):
+        data = {t: _value_batches(2, seed=seed + 40) for seed, t in enumerate(TENANTS[:3])}
+        refs, mux = _drive(lambda: CatMetric(), data)
+        for t in data:
+            _assert_states_identical(refs[t], mux.metric(t))
+        report = mux.report()
+        assert report.eager_updates == 6
+        assert report.dispatches == 0 and report.fused_updates == 0
+
+    def test_partial_group_pads_to_width_bucket_with_masked_rows(self):
+        # 3 tenants pad up to the width-4 bucket; the repeated pad row must not
+        # leak into any state — including a MaskedBuffer append
+        data = {t: _value_batches(2, seed=seed + 60) for seed, t in enumerate(TENANTS[:3])}
+        refs, mux = _drive(lambda: CatMetric(capacity=32, nan_strategy=0.0), data, max_width=4)
+        report = mux.report()
+        assert report.padded_rows > 0
+        for t in data:
+            assert int(refs[t].value.count) == int(mux.metric(t).value.count)
+            np.testing.assert_array_equal(
+                np.asarray(refs[t].compute()), np.asarray(mux.compute(t))
+            )
+
+    def test_per_tenant_stream_order_preserved_on_refeed(self):
+        # a tenant feeding twice before its group dispatches forces an order
+        # flush: its first batch lands before its second, always
+        mux = TenantMultiplexer(
+            lambda: MeanMetric(nan_strategy="ignore"), MuxConfig(max_width=8)
+        )
+        ref = MeanMetric(nan_strategy="ignore")
+        batches = _value_batches(4, seed=80)
+        for args in batches:
+            ref.update(*args)
+            mux.feed("solo", *args)
+        mux.close()
+        assert mux.report().order_flushes == 3
+        np.testing.assert_array_equal(np.asarray(ref.compute()), np.asarray(mux.compute("solo")))
+
+    def test_signature_change_opens_separate_group(self):
+        small = _class_batches(1, batch=8, seed=81)[0]
+        large = _class_batches(1, batch=24, seed=82)[0]
+        make = lambda: MulticlassAccuracy(num_classes=5, validate_args=False)  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=8))
+        refs = {}
+        for i, t in enumerate(TENANTS[:4]):
+            mux.adopt(t)
+            refs[t] = make()
+            args = small if i % 2 else large
+            refs[t].update(*args)
+            mux.feed(t, *args)
+        mux.close()
+        for t in TENANTS[:4]:
+            np.testing.assert_array_equal(np.asarray(refs[t].compute()), np.asarray(mux.compute(t)))
+        assert mux.report().dispatches == 2  # one per signature group
+
+
+# -------------------------------------------------------------- fault isolation
+
+
+class TestPoisonedIsolation:
+    def test_poisoned_batch_quarantined_at_owning_tenant_only(self):
+        make = lambda: MeanSquaredError(error_policy="quarantine")  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=4))
+        refs = {t: make() for t in TENANTS[:3]}
+        for t in TENANTS[:3]:
+            mux.adopt(t)
+        clean = {t: _pair_batches(2, seed=seed + 90) for seed, t in enumerate(TENANTS[:3])}
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for t in TENANTS[:3]:
+                refs[t].update(*clean[t][0])
+                mux.feed(t, *clean[t][0])
+            for t in TENANTS[:3]:
+                args = _nan_pair(seed=99) if t == "bravo" else clean[t][1]
+                refs[t].update(*args)
+                mux.feed(t, *args)
+            mux.close()
+        for t in TENANTS[:3]:
+            expected = 1 if t == "bravo" else 0
+            assert mux.metric(t).updates_quarantined == expected, t
+            assert refs[t].updates_quarantined == expected
+            np.testing.assert_array_equal(np.asarray(refs[t].compute()), np.asarray(mux.compute(t)))
+        report = mux.report()
+        assert report.replayed_updates == 1  # only the poisoned tenant replayed
+        assert report.fused_updates == 5  # its cohort still fused
+
+    def test_unguarded_tenant_keeps_its_nan(self):
+        # no policy: the NaN flows into exactly that tenant's state, fused
+        make = lambda: MeanSquaredError()  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=4))
+        refs = {t: make() for t in TENANTS[:2]}
+        for t in TENANTS[:2]:
+            mux.adopt(t)
+        clean = _pair_batches(1, seed=110)[0]
+        refs["acme"].update(*_nan_pair(seed=111))
+        refs["bravo"].update(*clean)
+        mux.feed("acme", *_nan_pair(seed=111))
+        mux.feed("bravo", *clean)
+        mux.close()
+        assert mux.report().replayed_updates == 0
+        assert np.isnan(np.asarray(mux.compute("acme")))
+        np.testing.assert_array_equal(
+            np.asarray(refs["bravo"].compute()), np.asarray(mux.compute("bravo"))
+        )
+
+    def test_raise_policy_propagates_from_owning_tenant(self):
+        make = lambda: MeanSquaredError(error_policy="raise")  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=4))
+        for t in TENANTS[:2]:
+            mux.adopt(t)
+        mux.feed("acme", *_pair_batches(1, seed=120)[0])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(Exception, match="non-finite"):
+                mux.feed("bravo", *_nan_pair(seed=121))
+                mux.flush()
+
+    def test_raise_policy_tenant_never_costs_the_cohort(self):
+        # the clean cohort's batches land BEFORE the poisoned tenant's raise
+        # propagates — one tenant's raise policy must not drop its neighbors'
+        # work from the group
+        make = lambda: MeanSquaredError(error_policy="raise")  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=4))
+        refs = {}
+        clean = {}
+        for i, t in enumerate(TENANTS[:3]):
+            mux.adopt(t)
+            refs[t] = make()
+            clean[t] = _pair_batches(1, seed=125 + i)[0]
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for t in ("acme", "carol"):
+                refs[t].update(*clean[t])
+                mux.feed(t, *clean[t])
+            with pytest.raises(Exception, match="non-finite"):
+                mux.feed("bravo", *_nan_pair(seed=128))
+                mux.flush()
+        for t in ("acme", "carol"):
+            assert mux.metric(t)._update_count == 1, t
+            np.testing.assert_array_equal(np.asarray(refs[t].compute()), np.asarray(mux.compute(t)))
+        assert mux.metric("bravo")._update_count == 0
+
+    def test_past_cap_tenants_collapse_onto_overflow_session_and_keep_serving(self):
+        # the registry cap's documented attribution-loss semantic: past-cap
+        # names share the __overflow__ session instead of crashing the stream
+        obs_scope.configure(max_tenants=2)
+        make = lambda: MeanMetric(nan_strategy="ignore")  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=4))
+        batches = _value_batches(4, seed=129)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i, t in enumerate(("in-cap-a", "in-cap-b", "over-cap-c", "over-cap-d")):
+                mux.feed(t, *batches[i])  # auto-adopts; c and d collapse
+            mux.close()
+        assert set(mux.tenants()) == {"in-cap-a", "in-cap-b", obs_scope.OVERFLOW_TENANT}
+        # the collapsed names share one session: both batches landed there
+        assert mux.metric("over-cap-c") is mux.metric("over-cap-d")
+        assert mux.metric(obs_scope.OVERFLOW_TENANT)._update_count == 2
+        ref = make()
+        ref.update(*batches[2])
+        ref.update(*batches[3])
+        np.testing.assert_array_equal(
+            np.asarray(ref.compute()), np.asarray(mux.compute("over-cap-c"))
+        )
+
+
+# ------------------------------------------------- compiled-variant bound / AOT
+
+
+class TestVariantBound:
+    def test_variants_scale_with_buckets_not_tenants(self):
+        n_tenants = 24
+        make = lambda: MulticlassAccuracy(  # noqa: E731
+            num_classes=4, average="micro", validate_args=False
+        )
+        mark = obs_cost.get_ledger().mark()
+        mux = TenantMultiplexer(make, MuxConfig(max_width=n_tenants))
+        tenants = [f"vt-{i:02d}" for i in range(n_tenants)]
+        for t in tenants:
+            mux.adopt(t)
+        sizes = (12, 20)  # two signatures shared by every tenant
+        rng = np.random.RandomState(7)
+        for rnd in range(2):
+            for i, t in enumerate(tenants):
+                size = sizes[(rnd + i) % 2]
+                mux.feed(
+                    t,
+                    jnp.asarray(rng.rand(size, 4).astype(np.float32)),
+                    jnp.asarray(rng.randint(0, 4, size)),
+                )
+        mux.close()
+        info = mux.cache_info()
+        bound = len(mux.config.buckets()) * len(sizes)
+        assert info["compiled_variants"] <= bound < n_tenants * len(sizes)
+        # the ledger agrees: fused mux compiles stay under the bucket bound
+        mux_entries = [
+            e for e in obs_cost.get_ledger().entries() if e.seq >= mark and "mux_update" in e.fn
+        ]
+        assert 0 < len(mux_entries) <= bound
+
+    def test_warmup_precompiles_every_width_bucket(self):
+        make = lambda: MulticlassAccuracy(num_classes=4, validate_args=False)  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=8))
+        for t in TENANTS[:5]:
+            mux.adopt(t)
+        batches = _class_batches(1, classes=4, seed=130)[0]
+        manifest = mux.warmup(*batches)
+        mux_entries = [e for e in manifest["entries"] if e["kind"] == "mux"]
+        assert [e["width"] for e in mux_entries] == [1, 2, 4, 8]
+        assert manifest["fresh_compiles"] > 0
+        data = {t: _class_batches(2, classes=4, seed=131 + i) for i, t in enumerate(TENANTS[:5])}
+        with trace.observe() as rec:
+            for rnd in range(2):
+                for t in TENANTS[:5]:
+                    mux.feed(t, *data[t][rnd])
+            mux.close()
+        assert rec.counter_value("jit.cache_miss") == 0  # zero compiles in the loop
+        assert [e for e in rec.events() if e["name"] == "jit.compile"] == []
+
+    def test_pow2_buckets_ladder(self):
+        assert pow2_buckets(1) == (1,)
+        assert pow2_buckets(8) == (1, 2, 4, 8)
+        assert pow2_buckets(6) == (1, 2, 4, 6)
+        assert pow2_buckets(64) == (1, 2, 4, 8, 16, 32, 64)
+        with pytest.raises(ValueError):
+            pow2_buckets(0)
+        assert MuxConfig(max_width=64).buckets() == pow2_buckets(64)
+
+
+# ------------------------------------------------------------------- admission
+
+
+def _quota_controller(clock):
+    controller = obs_scope.AdmissionController(clock=clock)
+    controller.set_quota(
+        "noisy",
+        obs_scope.TenantQuota(updates_per_window=2, window_seconds=100.0, over_quota="shed"),
+    )
+    controller.set_quota(
+        "slow",
+        obs_scope.TenantQuota(updates_per_window=1, window_seconds=100.0, over_quota="defer"),
+    )
+    return controller
+
+
+class TestAdmission:
+    def test_quota_validation(self):
+        with pytest.raises(ValueError, match="over_quota"):
+            obs_scope.TenantQuota(over_quota="drop")
+        with pytest.raises(ValueError, match="window_seconds"):
+            obs_scope.TenantQuota(window_seconds=0)
+        with pytest.raises(ValueError, match="updates_per_window"):
+            obs_scope.TenantQuota(updates_per_window=-1)
+
+    def test_shed_and_defer_paths_through_mux(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        make = lambda: MulticlassAccuracy(num_classes=4, validate_args=False)  # noqa: E731
+        mux = TenantMultiplexer(
+            make, MuxConfig(max_width=4, admission=controller)
+        )
+        for t in ("noisy", "slow", "calm"):
+            mux.adopt(t)
+        batches = _class_batches(4, classes=4, seed=140)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for rnd in range(4):
+                for t in ("noisy", "slow", "calm"):
+                    mux.feed(t, *batches[rnd])
+            report_mid = mux.report()
+            mux.close()
+        report = mux.report()
+        # noisy (shed): 2 admitted then 2 dropped — dropped stay dropped
+        assert report.shed_batches == 2
+        assert mux.metric("noisy")._update_count == 2
+        # slow (defer): 1 admitted, 3 deprioritized, all landed by close()
+        assert report.deferred_batches == 3
+        assert report.deferred_replayed == 3
+        assert mux.metric("slow")._update_count == 4
+        # calm: untouched
+        assert mux.metric("calm")._update_count == 4
+        assert report_mid.deferred_batches == 3
+        assert controller.shed_count("noisy") == 2
+        assert controller.deferred_count("slow") == 3
+
+    def test_defer_backlog_drains_when_window_rolls(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        make = lambda: MeanMetric(nan_strategy="ignore")  # noqa: E731
+        mux = TenantMultiplexer(make, MuxConfig(max_width=2, admission=controller))
+        mux.adopt("slow")
+        batches = _value_batches(3, seed=150)
+        mux.feed("slow", *batches[0])  # admitted (window burn -> 1/1)
+        mux.feed("slow", *batches[1])  # deferred
+        assert mux.report().deferred_batches == 1
+        clock[0] = 200.0  # the window rolls: burn resets
+        mux.feed("slow", *batches[2])  # backlog drains first, then this batch
+        mux.close()
+        assert mux.report().deferred_replayed == 1
+        assert mux.metric("slow")._update_count == 3
+        # stream order held: the reference sees the batches in feed order
+        ref = MeanMetric()
+        for args in batches:
+            ref.update(*args)
+        np.testing.assert_array_equal(np.asarray(ref.compute()), np.asarray(mux.compute("slow")))
+
+    def test_pipeline_tenant_session_sheds_and_defers(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        obs_scope.install_admission(controller)
+        data = _pair_batches(4, seed=160)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            noisy = MetricPipeline(
+                MeanSquaredError(), PipelineConfig(fuse=2, tenant="noisy")
+            )
+            for args in data:
+                noisy.feed(*args)
+            noisy_report = noisy.close()
+            slow = MetricPipeline(
+                MeanSquaredError(), PipelineConfig(fuse=2, tenant="slow")
+            )
+            for args in data:
+                slow.feed(*args)
+            slow_report = slow.close()
+        assert noisy_report.shed_batches == 2
+        assert noisy.metric._update_count == 2
+        assert slow_report.deferred_batches == 3
+        assert slow_report.deferred_replayed == 3  # drained at close
+        assert slow.metric._update_count == 4
+        # untenanted pipelines never consult admission
+        free = MetricPipeline(MeanSquaredError(), PipelineConfig(fuse=2))
+        for args in data:
+            free.feed(*args)
+        assert free.close().shed_batches == 0
+
+    def test_quota_exceeded_gauge_feeds_threshold_alert_rule(self):
+        from torchmetrics_tpu.obs import alerts as obs_alerts
+
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        rec = trace.TraceRecorder()
+        engine = obs_alerts.AlertEngine(
+            rules=[
+                obs_alerts.AlertRule(
+                    name="quota_pressure",
+                    kind="threshold",
+                    series="tenant.quota_exceeded",
+                    above=0.5,
+                    tenant="noisy",
+                )
+            ],
+            recorder=rec,
+        )
+        with obs_scope.scope("noisy"):
+            pass
+        controller.charge("noisy", updates=2)
+        assert controller.admit("noisy", recorder=rec) == obs_scope.SHED
+        engine.evaluate()
+        firing = engine.firing()
+        assert [alert["rule"] for alert in firing] == ["quota_pressure"]
+        assert firing[0]["tenant"] == "noisy"
+
+    def test_burn_and_status_rows(self):
+        clock = [0.0]
+        controller = obs_scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "acct",
+            obs_scope.TenantQuota(
+                flops_per_window=100.0, bytes_per_window=1000.0, window_seconds=50.0
+            ),
+        )
+        controller.charge("acct", updates=3, flops=50.0, bytes_accessed=100.0)
+        row = controller.status()["acct"]
+        assert row["burn_ratio"] == 0.5  # flops dominate: 50/100
+        assert not row["exceeded"]
+        controller.charge("acct", flops=60.0)
+        assert controller.status()["acct"]["exceeded"]
+        assert controller.admit("acct") == obs_scope.SHED
+        clock[0] = 60.0  # window rolls
+        assert controller.admit("acct") == obs_scope.ADMIT
+        assert controller.status()["acct"]["burn_ratio"] == 0.0
+
+    def test_tenants_route_gains_quota_columns(self):
+        from torchmetrics_tpu.obs.server import IntrospectionServer
+
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        obs_scope.install_admission(controller)
+        with obs_scope.scope("noisy"):
+            pass
+        with obs_scope.scope("free-rider"):
+            pass
+        controller.charge("noisy", updates=5)
+        controller.admit("noisy")
+        server = IntrospectionServer(port=0)
+        page = server.tenants_report()
+        assert page["admission"]["enabled"] is True
+        rows = {row["tenant"]: row for row in page["tenants"]}
+        quota = rows["noisy"]["quota"]
+        assert quota["exceeded"] is True
+        assert quota["over_quota_policy"] == "shed"
+        assert quota["used"]["updates"] == 5.0
+        assert quota["limits"] == {"updates": 2.0}
+        # an unmetered tenant renders quota: None, not a zero budget
+        assert rows["free-rider"]["quota"] is None
+        # a quota configured for a tenant the registry never saw still renders
+        assert rows["slow"]["quota"]["deferred"] == 0
+        assert rows["slow"].get("registered") is False
+
+    def test_defer_backlog_is_bounded_and_degrades_to_shed(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        make = lambda: MeanMetric(nan_strategy="ignore")  # noqa: E731
+        mux = TenantMultiplexer(
+            make, MuxConfig(max_width=2, admission=controller, max_deferred=2)
+        )
+        mux.adopt("slow")
+        batches = _value_batches(5, seed=155)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for args in batches:
+                mux.feed("slow", *args)
+            report_mid = mux.report()
+            mux.close()
+        # 1 admitted, 2 deferred (cap), 2 degraded to shed past the cap
+        assert report_mid.deferred_batches == 2
+        assert report_mid.shed_batches == 2
+        assert mux.metric("slow")._update_count == 3  # admitted + drained backlog
+        # the controller's books agree: the degrades were reclassified, so
+        # tenant.quota_shed tells the operator data was actually lost
+        assert controller.shed_count("slow") == 2
+        assert controller.deferred_count("slow") == 2
+
+    def test_pipeline_defer_backlog_is_bounded_too(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        obs_scope.install_admission(controller)
+        data = _pair_batches(5, seed=156)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pipe = MetricPipeline(
+                MeanSquaredError(), PipelineConfig(fuse=2, tenant="slow", max_deferred=2)
+            )
+            for args in data:
+                pipe.feed(*args)
+            report = pipe.close()
+        assert report.deferred_batches == 2 and report.shed_batches == 2
+        assert pipe.metric._update_count == 3
+        assert controller.shed_count("slow") == 2
+        assert controller.deferred_count("slow") == 2
+
+    def test_adopt_rejects_same_class_different_config(self):
+        mux = TenantMultiplexer(
+            lambda: MulticlassAccuracy(num_classes=5, validate_args=False),
+            MuxConfig(max_width=2),
+        )
+        mux.adopt("a")
+        # same class, same state shapes — but the fused program would bake in
+        # the template's ignore_index, so this must be rejected loudly
+        with pytest.raises(ValueError, match="ignore_index"):
+            mux.adopt(
+                "b", MulticlassAccuracy(num_classes=5, ignore_index=0, validate_args=False)
+            )
+        # differing error policies ARE allowed: robust policy is per-tenant
+        mux.adopt(
+            "c", MulticlassAccuracy(num_classes=5, validate_args=False, error_policy="quarantine")
+        )
+
+    def test_adopt_rejects_differing_array_config(self):
+        # array-valued configuration (a curve metric's thresholds buffer) is
+        # configuration too: different binning must not share a fused program
+        make = lambda: MulticlassAUROC(  # noqa: E731
+            num_classes=5, thresholds=10, validate_args=False
+        )
+        mux = TenantMultiplexer(make, MuxConfig(max_width=2))
+        mux.adopt("a")
+        with pytest.raises(ValueError, match="configuration differs"):
+            mux.adopt("b", MulticlassAUROC(num_classes=5, thresholds=20, validate_args=False))
+
+    def test_width_buckets_above_max_width_rejected(self):
+        with pytest.raises(ValueError, match="exceeds `max_width`"):
+            MuxConfig(max_width=64, width_buckets=(128,))
+
+    def test_no_admission_installed_admits_everything(self):
+        mux = TenantMultiplexer(lambda: MeanMetric(), MuxConfig(max_width=2))
+        mux.adopt("anyone")
+        for args in _value_batches(3, seed=170):
+            mux.feed("anyone", *args)
+        mux.close()
+        report = mux.report()
+        assert report.shed_batches == 0 and report.deferred_batches == 0
+        assert mux.metric("anyone")._update_count == 3
+
+
+# ------------------------------------------------------------ telemetry / scope
+
+
+class TestTelemetryAndScope:
+    def test_mux_counters_and_gauges_recorded(self):
+        data = {t: _class_batches(2, seed=180 + i) for i, t in enumerate(TENANTS[:3])}
+        with trace.observe() as rec:
+            _drive(
+                lambda: MulticlassAccuracy(num_classes=5, validate_args=False),
+                data,
+                max_width=4,
+            )
+        assert rec.counter_value("engine.mux_dispatches") >= 1
+        assert rec.counter_value("engine.mux_fused_updates") == 6
+        gauges = {g["name"] for g in rec.snapshot()["gauges"]}
+        assert {"engine.mux_width", "engine.mux_open_groups"} <= gauges
+        spans = [
+            e
+            for e in rec.events()
+            if e["kind"] == "span" and e["name"] == "engine.dispatch"
+        ]
+        assert spans and all(s["attrs"]["path"] == "mux" for s in spans)
+
+    def test_tenant_sessions_registered_and_attributed(self):
+        data = {t: _value_batches(1, seed=190 + i) for i, t in enumerate(TENANTS[:2])}
+        refs, mux = _drive(lambda: MeanMetric(nan_strategy="ignore"), data, max_width=2)
+        registry = obs_scope.get_registry()
+        rows = {row["tenant"]: row for row in registry.rows()}
+        for t in TENANTS[:2]:
+            assert rows[t]["updates"] == 1  # billed via _engine_commit_state
+            assert rows[t]["active_pipelines"] == 0  # close() ended the session
+            assert mux.metric(t)._obs_tenant == t
+
+    def test_adopt_rejects_duplicates_and_mismatched_targets(self):
+        mux = TenantMultiplexer(lambda: MeanMetric(nan_strategy="ignore"), MuxConfig(max_width=2))
+        mux.adopt("a")
+        with pytest.raises(ValueError, match="already multiplexed"):
+            mux.adopt("a")
+        with pytest.raises(ValueError, match="mismatched state structures"):
+            mux.adopt("b", MeanSquaredError())
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError, match="max_width"):
+            MuxConfig(max_width=0)
+        with pytest.raises(ValueError, match="alert_every"):
+            MuxConfig(alert_every=0)
+        with pytest.raises(ValueError, match="width_buckets"):
+            MuxConfig(width_buckets=(0, 2))
+        with pytest.raises(ValueError):
+            TenantMultiplexer()  # neither factory nor metrics
+
+    def test_alert_seam_samples_committed_tenants(self):
+        from torchmetrics_tpu.obs import alerts as obs_alerts
+        from torchmetrics_tpu.obs import values as obs_values
+
+        log = obs_values.ValueLog()
+        engine = obs_alerts.AlertEngine(
+            rules=[
+                obs_alerts.AlertRule(
+                    name="mux_nf", kind="non_finite", metric="MeanSquaredError", tenant="acme"
+                )
+            ],
+            value_log=log,
+        )
+        mux = TenantMultiplexer(
+            lambda: MeanSquaredError(), MuxConfig(max_width=2, alert_engine=engine)
+        )
+        for t in TENANTS[:2]:
+            mux.adopt(t)
+        mux.feed("acme", *_nan_pair(seed=200))  # unguarded: NaN reaches state
+        mux.feed("bravo", *_pair_batches(1, seed=201)[0])
+        mux.close()
+        firing = engine.firing()
+        assert [alert["rule"] for alert in firing] == ["mux_nf"]
+        assert firing[0]["tenant"] == "acme"
+
+
+# ------------------------------------------------------------- disabled overhead
+
+
+class TestDisabledOverhead:
+    def test_mux_imported_but_unused_keeps_dispatch_within_noise(self):
+        """Extends the engine disabled-path smoke: with the multiplexer and
+        admission modules imported but unused, plain metric dispatch stays
+        within noise of the seed-equivalent inner body (same 2x shared-host
+        bound as tests/core/test_observability.py)."""
+        import torchmetrics_tpu.engine.mux  # noqa: F401  (imported-but-unused is the point)
+        from torchmetrics_tpu.utils.checks import measure_runtime
+
+        assert not trace.is_enabled()
+        assert obs_scope.get_admission() is None
+        # the ring keeps data from earlier scoped observes (by design); the
+        # smoke asserts this test's dispatches add NOTHING to it
+        events_before = list(trace.get_recorder().events())
+        m = MeanSquaredError()
+        x, y = jnp.ones(64), jnp.zeros(64)
+        m.update(x, y)
+
+        def instrumented():
+            for _ in range(200):
+                m._dispatch_update(x, y)
+
+        def seed_equivalent():
+            for _ in range(200):
+                m._dispatch_update_inner(x, y)
+
+        t_inner = measure_runtime(seed_equivalent, reps=5, warmup=1)
+        t_instr = measure_runtime(instrumented, reps=5, warmup=1)
+        assert t_instr < t_inner * 2.0 + 0.05, (
+            f"mux-imported dispatch {t_instr:.4f}s vs seed-equivalent {t_inner:.4f}s"
+        )
+        assert trace.get_recorder().events() == events_before

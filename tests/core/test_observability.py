@@ -827,6 +827,57 @@ class TestPrometheusExpositionAudit:
         finally:
             obs_scope.reset()
 
+    def test_quota_and_mux_families_survive_strict_parse(self):
+        """The tenant.quota_* admission families and the engine.mux_* gauge
+        families: HELP on every family, gauges never `_total`, tenant label
+        round-trips, and tenant.quota_exceeded carries the 0/1 signal shape
+        the threshold alert rules consume."""
+        from torchmetrics_tpu.obs import scope as obs_scope
+
+        obs_scope.reset()
+        try:
+            rec = trace.TraceRecorder()
+            controller = obs_scope.AdmissionController(clock=lambda: 0.0)
+            controller.set_quota(
+                "noisy",
+                obs_scope.TenantQuota(updates_per_window=1, window_seconds=60, over_quota="shed"),
+            )
+            obs_scope.install_admission(controller)
+            with obs_scope.scope("noisy"):
+                pass  # register the tenant
+            controller.charge("noisy", updates=2, flops=100.0, bytes_accessed=50.0)
+            assert controller.admit("noisy", recorder=rec) == obs_scope.SHED
+            # the multiplexer's gauge families as engine/mux.py records them
+            rec.set_gauge("engine.mux_width", 7, mux="Mux[MulticlassAccuracy]")
+            rec.set_gauge("engine.mux_open_groups", 1, mux="Mux[MulticlassAccuracy]")
+            obs_scope.record_gauges(recorder=rec)  # includes admission gauges
+            families, samples = _parse_exposition(export.prometheus_text(recorder=rec))
+            by_name = {}
+            for name, labels, value in samples:
+                by_name.setdefault(name, []).append((labels, value))
+            for family in (
+                "tm_tpu_tenant_quota_exceeded",
+                "tm_tpu_tenant_quota_burn_ratio",
+                "tm_tpu_tenant_quota_shed",
+                "tm_tpu_tenant_quota_deferred",
+                "tm_tpu_tenant_quota_window_updates",
+                "tm_tpu_tenant_quota_window_flops",
+                "tm_tpu_tenant_quota_window_bytes",
+                "tm_tpu_tenant_quota_window_compile_seconds",
+                "tm_tpu_engine_mux_width",
+                "tm_tpu_engine_mux_open_groups",
+            ):
+                assert families[family]["type"] == "gauge", family
+                assert families[family]["help"], family
+                assert not family.endswith("_total")
+                assert family in by_name, family
+            labels, value = by_name["tm_tpu_tenant_quota_exceeded"][0]
+            assert labels["tenant"] == "noisy" and value == "1"
+            assert by_name["tm_tpu_tenant_quota_shed"][0][1] == "1"
+            assert float(by_name["tm_tpu_tenant_quota_burn_ratio"][0][1]) >= 1.0
+        finally:
+            obs_scope.reset()
+
     def test_tenant_scoped_page_drops_other_tenants(self):
         from torchmetrics_tpu.obs import scope as obs_scope
 

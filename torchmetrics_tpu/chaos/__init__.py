@@ -7,7 +7,9 @@ Three parts, composed by ``bench.py --chaos`` and usable standalone:
   host) with a schema-versioned JSONL record/load format.
 - :mod:`~torchmetrics_tpu.chaos.replay` — the driver: the schedule through
   per-tenant :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline`
-  sessions while a background thread scrapes the live obs server.
+  sessions — or, with ``ReplayConfig.multiplex``, through ONE cross-tenant
+  :class:`~torchmetrics_tpu.engine.mux.TenantMultiplexer` — while a
+  background thread scrapes the live obs server.
 - :mod:`~torchmetrics_tpu.chaos.slo` — the declarative SLO spec + judge:
   throughput, p95/p99 scrape latency, time-to-fire/time-to-resolve for the
   injected faults, compiled-variant churn, flight-dump correctness — emitted
@@ -26,11 +28,12 @@ from torchmetrics_tpu.chaos.schedule import (
     ScheduleError,
     TrafficSchedule,
     generate,
+    high_tenant_config,
     load,
     loads,
 )
 from torchmetrics_tpu.chaos.replay import ReplayConfig, ReplayError, replay
-from torchmetrics_tpu.chaos.slo import SLOSpec, format_report, judge
+from torchmetrics_tpu.chaos.slo import SLOSpec, format_report, high_tenant_slo_spec, judge
 
 __all__ = [
     "SCHEDULE_SCHEMA",
@@ -42,6 +45,8 @@ __all__ = [
     "TrafficSchedule",
     "format_report",
     "generate",
+    "high_tenant_config",
+    "high_tenant_slo_spec",
     "judge",
     "load",
     "loads",

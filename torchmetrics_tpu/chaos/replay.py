@@ -63,6 +63,14 @@ class ReplayConfig:
     Args:
         fuse: micro-batch fusion depth of every tenant pipeline (``1`` keeps
             the per-batch path — faster to warm up, no scan variants).
+        multiplex: drive the guarded/hung tenants through ONE
+            :class:`~torchmetrics_tpu.engine.mux.TenantMultiplexer` (cross-
+            tenant fused dispatch, shared compiled programs) instead of one
+            pipeline per tenant. The victim keeps its own unguarded pipeline
+            — it runs a different metric class and the value-watchdog path is
+            its whole point. This is the before/after lever for the
+            compiled-variant-collapse SLO.
+        mux_max_width: the multiplexer's top tenant-width bucket.
         scrape_interval_seconds: pause between scrape sweeps of the routes.
         scrape_routes: routes the background thread hits each sweep.
         sync_timeout_seconds: the sync guard's per-attempt timeout for the
@@ -74,6 +82,8 @@ class ReplayConfig:
     """
 
     fuse: int = 2
+    multiplex: bool = False
+    mux_max_width: int = 64
     scrape_interval_seconds: float = 0.05
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants", "/healthz")
     sync_timeout_seconds: float = 0.05
@@ -84,6 +94,8 @@ class ReplayConfig:
     def __post_init__(self) -> None:
         if self.fuse < 1:
             raise ValueError(f"Expected `fuse` >= 1, got {self.fuse}")
+        if self.mux_max_width < 1:
+            raise ValueError(f"Expected `mux_max_width` >= 1, got {self.mux_max_width}")
         if self.scrape_interval_seconds <= 0:
             raise ValueError(
                 f"Expected positive `scrape_interval_seconds`, got {self.scrape_interval_seconds}"
@@ -162,29 +174,55 @@ class _Scraper(threading.Thread):
 
 
 def _build_tenants(schedule: TrafficSchedule, config: ReplayConfig, engine: AlertEngine, dump_dir: str):
-    """(metrics, pipelines) keyed by tenant, per the schedule's roles."""
+    """(metrics, pipelines, mux) keyed by tenant, per the schedule's roles.
+
+    Per-tenant pipeline sessions by default; with ``config.multiplex`` every
+    guarded/hung tenant instead rides ONE cross-tenant multiplexer (shared
+    fused programs, per-tenant state and robust isolation) and only the
+    victim keeps a pipeline of its own.
+    """
     from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.engine.mux import MuxConfig, TenantMultiplexer
     from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig
     from torchmetrics_tpu.regression import MeanSquaredError
 
+    def guarded_metric(tenant: str) -> Any:
+        return MulticlassAccuracy(
+            num_classes=schedule.config.num_classes,
+            average="micro",
+            validate_args=False,
+            error_policy="quarantine",
+            # the hung tenant's collective runs under the injected fault;
+            # a 2-host world is claimed so Metric.sync enters the guard
+            distributed_available_fn=(lambda: True) if tenant == schedule.hung else None,
+        )
+
     metrics: Dict[str, Any] = {}
     pipelines: Dict[str, Any] = {}
+    mux: Optional[TenantMultiplexer] = None
+    if config.multiplex:
+        mux = TenantMultiplexer(
+            config=MuxConfig(
+                max_width=config.mux_max_width, alert_engine=engine, alert_every=1
+            ),
+            metrics={
+                tenant: guarded_metric(tenant)
+                for tenant in schedule.tenants
+                if schedule.roles[tenant] != ROLE_VICTIM
+            },
+        )
+        for tenant in mux.tenants():
+            metrics[tenant] = mux.metric(tenant)
     for tenant in schedule.tenants:
         role = schedule.roles[tenant]
+        if role != ROLE_VICTIM and mux is not None:
+            continue  # multiplexed tenants built above
         if role == ROLE_VICTIM:
             # deliberately unguarded: the NaN must REACH the value timeline so
             # the non-finite watchdog (not an input guard) is what catches it
             metric = MeanSquaredError()
         else:
-            metric = MulticlassAccuracy(
-                num_classes=schedule.config.num_classes,
-                average="micro",
-                validate_args=False,
-                error_policy="quarantine",
-                # the hung tenant's collective runs under the injected fault;
-                # a 2-host world is claimed so Metric.sync enters the guard
-                distributed_available_fn=(lambda: True) if tenant == schedule.hung else None,
-            )
+            metric = guarded_metric(tenant)
         metrics[tenant] = metric
         pipelines[tenant] = MetricPipeline(
             metric,
@@ -199,7 +237,7 @@ def _build_tenants(schedule: TrafficSchedule, config: ReplayConfig, engine: Aler
                 flight_dump_dir=dump_dir,
             ),
         )
-    return metrics, pipelines
+    return metrics, pipelines, mux
 
 
 def _read_dump(path: str) -> Optional[Dict[str, Any]]:
@@ -263,9 +301,21 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         ],
         history=config.alert_history,
     )
-    metrics, pipelines = _build_tenants(schedule, config, engine, dump_dir)
+    metrics, pipelines, mux = _build_tenants(schedule, config, engine, dump_dir)
     victim, hung = schedule.victim, schedule.hung
     n_classes = schedule.config.num_classes
+
+    def feed_tenant(tenant: str, preds: Any, target: Any) -> None:
+        if mux is not None and tenant not in pipelines:
+            mux.feed(tenant, preds, target)
+        else:
+            pipelines[tenant].feed(preds, target)
+
+    def flush_tenant(tenant: str) -> None:
+        if mux is not None and tenant not in pipelines:
+            mux.flush()
+        else:
+            pipelines[tenant].flush()
 
     def make_batch(tenant: str, size: int, poison: bool) -> Tuple[Any, Any]:
         if schedule.roles[tenant] == ROLE_VICTIM:
@@ -312,7 +362,7 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                                 }
                             )
                         preds, target = make_batch(tenant, ev["size"], bool(ev.get("poison")))
-                        pipelines[tenant].feed(preds, target)
+                        feed_tenant(tenant, preds, target)
                         batches_fed += 1
                     elif kind == "sleep":
                         sleep_seconds += ev["seconds"]
@@ -333,7 +383,7 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                         # absence that began during an earlier idle gap must
                         # not be credited to this hang window (time-to-fire
                         # would otherwise measure the schedule, not the alert)
-                        pipelines[ev["tenant"]].flush()
+                        flush_tenant(ev["tenant"])
                         _values.sample_local(metrics[ev["tenant"]], log=engine._log())
                         engine.evaluate()
                         faults_injected.append(
@@ -366,7 +416,7 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                                 fault["ended_at"] = time.time()
                     elif kind == "repair":
                         fault_tenant = ev["tenant"]
-                        pipelines[fault_tenant].flush()
+                        flush_tenant(fault_tenant)
                         metrics[fault_tenant].reset()
                         for fault in faults_injected:
                             if fault["tenant"] == fault_tenant and fault["fault"] == "poison":
@@ -375,6 +425,8 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                         raise ReplayError(f"unknown schedule event kind {kind!r}")
                 for pipe in pipelines.values():
                     pipe.close()
+                if mux is not None:
+                    mux.close()
                 closed = True
                 engine.evaluate()
             elapsed = time.perf_counter() - perf_start
@@ -393,6 +445,11 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             for pipe in pipelines.values():
                 try:
                     pipe.close()
+                except Exception:
+                    pass
+            if mux is not None:
+                try:
+                    mux.close()
                 except Exception:
                     pass
 
@@ -449,6 +506,19 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         },
         # dump metas were read above; an auto-created dir is gone by now
         "flight": {"dump_dir": None if own_dump_dir else dump_dir, "dumps": dumps},
+        # cross-tenant fused dispatch accounting (None when unmultiplexed):
+        # the SLO judge's mux-engagement check and the before/after evidence
+        # next to the compiled-variant delta above
+        "mux": (
+            {
+                "max_width": config.mux_max_width,
+                "tenants": len(mux.tenants()),
+                "report": mux.report().asdict(),
+                "cache": mux.cache_info(),
+            }
+            if mux is not None
+            else None
+        ),
         "robust": {"sync_degraded": sync_degraded, "quarantined": quarantined},
         "health": health,
         "tenants": tenants_page,

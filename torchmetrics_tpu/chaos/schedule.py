@@ -59,6 +59,7 @@ __all__ = [
     "ScheduleError",
     "TrafficSchedule",
     "generate",
+    "high_tenant_config",
     "load",
     "loads",
 ]
@@ -304,6 +305,46 @@ def load(path: str) -> TrafficSchedule:
     except OSError as err:
         raise ScheduleError(f"cannot read schedule {path}: {err}") from None
     return loads(text, source=path)
+
+
+def high_tenant_config(seed: int = 0, tenants: int = 64) -> ScheduleConfig:
+    """The high-tenant-count chaos preset: the multiplexer's stress workload.
+
+    ≥64 tenant sessions sharing two batch-size signatures (shared signatures
+    are what cross-tenant fusion batches on; two sizes keep signature churn in
+    play), bursty arrivals (long back-to-back runs, short idle gaps) and a
+    compressed warm/churn/drain cycle so the scenario stays CI-sized while the
+    tenant axis — not the per-tenant stream length — carries the load. The
+    fault surfaces (one victim, one hung tenant, one poisoned guarded tenant)
+    are unchanged from the default scenario, so the same SLO fire/resolve
+    machinery judges it.
+
+    This is the workload behind ``bench.py --chaos --chaos-scenario
+    high_tenant``: unmultiplexed it compiles O(tenants × signatures) variants
+    (every tenant's metric instance owns its own jit cache); through
+    :class:`~torchmetrics_tpu.engine.mux.TenantMultiplexer` the same traffic
+    compiles O(width-buckets × signatures) — ``chaos_ht_compiled_variants``
+    is that collapse, measured.
+    """
+    if tenants < 64:
+        raise ValueError(
+            f"Expected `tenants` >= 64 for the high-tenant preset, got {tenants}"
+            " (the point is the tenant axis)"
+        )
+    return ScheduleConfig(
+        seed=seed,
+        tenants=tenants,
+        warm_batches=2,
+        churn_batches=2,
+        drain_batches=2,
+        batch_sizes=(16, 24),
+        num_classes=4,
+        poisoned_guarded=1,
+        hang_seconds=0.8,
+        absent_after_seconds=0.25,
+        idle_gap_seconds=0.005,
+        burst=16,
+    )
 
 
 # ------------------------------------------------------------------ generation

@@ -44,7 +44,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.obs.export import histogram_quantile, quantile_bucket
 
-__all__ = ["SLOSpec", "format_report", "judge"]
+__all__ = ["SLOSpec", "format_report", "high_tenant_slo_spec", "judge"]
 
 
 @dataclass
@@ -64,11 +64,41 @@ class SLOSpec:
     max_time_to_resolve_seconds: Optional[float] = 15.0
     max_compiled_variants: Optional[int] = 160
     require_poisoned_named: bool = True
+    # cross-tenant fused dispatch promises (the multiplexed scenarios):
+    # the run must actually have fused across tenants, and every guarded
+    # tenant's poisoned batch must be quarantined by exactly its own session
+    # (isolation without the pipeline flight recorder's dump evidence)
+    require_multiplexed: bool = False
+    require_quarantine_attributed: bool = False
     # routes whose scrape latency is judged (the driver may scrape more)
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants")
 
     def asdict(self) -> Dict[str, Any]:
         return asdict(self)
+
+
+def high_tenant_slo_spec() -> SLOSpec:
+    """The SLO spec of the high-tenant multiplexed scenario
+    (:func:`~torchmetrics_tpu.chaos.schedule.high_tenant_config` replayed with
+    ``ReplayConfig.multiplex=True``).
+
+    The compiled-variant budget is the headline: 64 tenants sharing two batch
+    signatures must compile O(width-buckets × signatures) programs — the
+    fused-program ladder (7 buckets × 2 signatures), the per-tenant replay /
+    victim / hung-path programs and warmup leave comfortable slack under 60,
+    where the unmultiplexed same-schedule run compiles ~4–5× more (every
+    tenant's own jit cache pays every signature). Poisoned-batch evidence is
+    quarantine *attribution* instead of flight-dump naming — the multiplexer
+    has no flight recorder; isolation is proven by exactly the owning tenant's
+    robust counters moving.
+    """
+    return SLOSpec(
+        min_updates_per_second=5.0,
+        max_compiled_variants=60,
+        require_poisoned_named=False,
+        require_multiplexed=True,
+        require_quarantine_attributed=True,
+    )
 
 
 def _slug(route: str) -> str:
@@ -188,11 +218,17 @@ def _fault_episode(
     return None, False
 
 
-def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, Any]:
+def judge(
+    result: Dict[str, Any], spec: Optional[SLOSpec] = None, prefix: str = "chaos"
+) -> Dict[str, Any]:
     """Judge one replay result against ``spec``; returns the SLO report.
 
     Report shape: ``{"passed", "n_slos", "failed": [names], "slos": [rows],
-    "spec": {...}, "configs": {bench-config-shaped numbers}}``.
+    "spec": {...}, "configs": {bench-config-shaped numbers}}``. ``prefix``
+    names the emitted bench configs (default ``chaos_*``) — distinct scenarios
+    MUST use distinct prefixes (e.g. ``chaos_ht`` for the high-tenant
+    scenario), or the regression sentinel would baseline one scenario's
+    numbers against another's workload.
     """
     spec = spec or SLOSpec()
     rows: List[Dict[str, Any]] = []
@@ -234,7 +270,7 @@ def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, A
     # load — runner-speed-dominated, so (like the time_to_* configs) the
     # recorded spread floor makes the ABSOLUTE SLO budget the sentinel's cap
     config(
-        "chaos_update_throughput",
+        f"{prefix}_update_throughput",
         throughput,
         "updates/sec",
         spec.min_updates_per_second,
@@ -269,7 +305,7 @@ def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, A
             if estimate is not None:
                 bucket = _quantile_bucket_bounds(result, route, q)
                 config(
-                    f"chaos_scrape_{label}_{_slug(route)}",
+                    f"{prefix}_scrape_{label}_{_slug(route)}",
                     estimate * 1e6,
                     "us",
                     bound * 1e6 if bound is not None else None,
@@ -345,7 +381,7 @@ def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, A
         # within budget any value is noise; beyond it the SLO row itself
         # fails and the strict slo_pass config regresses.
         config(
-            f"chaos_time_to_fire_{name}",
+            f"{prefix}_time_to_fire_{name}",
             ttf,
             "s",
             spec.max_time_to_fire_seconds,
@@ -376,7 +412,7 @@ def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, A
                 + ("this fault's injection" if already_firing else "firing"),
             )
             config(
-                f"chaos_time_to_resolve_{name}",
+                f"{prefix}_time_to_resolve_{name}",
                 ttr,
                 "s",
                 spec.max_time_to_resolve_seconds,
@@ -397,7 +433,7 @@ def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, A
         detail=f"{(result.get('cost') or {}).get('compile_seconds', 0)}s total compile"
         " wall across the run's fresh XLA executables",
     )
-    config("chaos_compiled_variants", variants, "variants", spec.max_compiled_variants)
+    config(f"{prefix}_compiled_variants", variants, "variants", spec.max_compiled_variants)
 
     # ------------------------------------------------- flight-dump correctness
     expected = {
@@ -429,9 +465,51 @@ def judge(result: Dict[str, Any], spec: Optional[SLOSpec] = None) -> Dict[str, A
             ),
         )
 
+    # -------------------------------------------- cross-tenant fused dispatch
+    if spec.require_multiplexed:
+        mux = result.get("mux") or {}
+        mux_report = mux.get("report") or {}
+        fused = mux_report.get("fused_updates") or 0
+        dispatches = mux_report.get("dispatches") or 0
+        engaged = bool(fused) and bool(dispatches) and fused > dispatches
+        _row(
+            rows,
+            "mux_engaged",
+            float(engaged),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"{fused} tenant-updates fused into {dispatches} dispatch(es),"
+                f" peak width {mux_report.get('max_width')}"
+                if mux
+                else "replay result carries no multiplexer accounting"
+            ),
+        )
+    if spec.require_quarantine_attributed:
+        # isolation without dump evidence: exactly the tenants the schedule
+        # poisoned (victim aside) show quarantines — no cohort bleed, no miss
+        expected_tenants = sorted({tenant for tenant, _ in expected})
+        quarantined = (result.get("robust") or {}).get("quarantined") or {}
+        missed = [t for t in expected_tenants if not quarantined.get(t)]
+        bled = sorted(set(quarantined) - set(expected_tenants))
+        _row(
+            rows,
+            "quarantine_attributed",
+            float(not missed and not bled),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"quarantines on exactly {expected_tenants}"
+                if not missed and not bled
+                else f"missed poisoned tenants {missed}; cohort bleed onto {bled}"
+            ),
+        )
+
     failed = [row["slo"] for row in rows if not row["passed"]]
     passed = not failed
-    config("chaos_slo_pass", 1.0 if passed else 0.0, "slo_pass", 1.0)
+    config(f"{prefix}_slo_pass", 1.0 if passed else 0.0, "slo_pass", 1.0)
     return {
         "passed": passed,
         "n_slos": len(rows),

@@ -10,6 +10,13 @@ The execution layer between user batch streams and the ``Metric`` /
   to a small set of buckets with masked tails so the compiled-variant count
   stays bounded. Robust error policies still apply per fused chunk, with
   degrade-to-per-batch replay isolating poisoned batches.
+- :class:`~torchmetrics_tpu.engine.mux.TenantMultiplexer` — **cross-tenant
+  fused dispatch**: same-signature updates from *different* tenants stacked on
+  a leading tenant axis and folded into per-tenant state with one ``vmap``
+  dispatch, tenant-width power-of-two buckets keeping the compiled-program
+  count ``O(buckets × signatures)`` instead of ``O(tenants × signatures)``,
+  per-tenant robust isolation, and cost-aware admission
+  (:class:`~torchmetrics_tpu.obs.scope.AdmissionController`) on top.
 - :mod:`~torchmetrics_tpu.engine.warmup` — AOT precompilation of every
   (metric, shape-bucket, static-config) variant before the loop, JAX
   **persistent compilation cache** wiring (``TM_TPU_COMPILE_CACHE``), and the
@@ -25,6 +32,7 @@ Quick start::
     value = metric.compute()
 """
 
+from torchmetrics_tpu.engine.mux import MuxConfig, MuxReport, TenantMultiplexer
 from torchmetrics_tpu.engine.pipeline import (
     FLIGHT_DIR_ENV,
     MetricPipeline,
@@ -38,6 +46,7 @@ from torchmetrics_tpu.engine.warmup import (
     configured_cache_dir,
     load_manifest,
     persistent_cache_stats,
+    pow2_buckets,
     save_manifest,
 )
 
@@ -45,12 +54,16 @@ __all__ = [
     "CACHE_ENV_VAR",
     "FLIGHT_DIR_ENV",
     "MetricPipeline",
+    "MuxConfig",
+    "MuxReport",
     "PipelineConfig",
     "PipelineReport",
+    "TenantMultiplexer",
     "build_manifest",
     "configure_compile_cache",
     "configured_cache_dir",
     "load_manifest",
     "persistent_cache_stats",
+    "pow2_buckets",
     "save_manifest",
 ]

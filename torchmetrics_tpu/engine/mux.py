@@ -1,0 +1,998 @@
+"""Cross-tenant fused dispatch: many tenants' updates in ONE compiled program.
+
+PR 8 made a pipeline a tenant session; this module makes tenants share
+*executables*. A serving process with 10k tenant sessions still issues 10k
+independent dispatch streams, and — worse — compiles O(tenants × signatures)
+program variants, because every tenant's metric instance owns its own jit
+cache. That is exactly the compiled-program-count blowup the pjit/TPU-scaling
+playbook avoids by batching work into a small set of shape-bucketed programs.
+:class:`TenantMultiplexer` is that batching layer for metric serving:
+
+- **One dispatch, many tenants** — same-signature update batches from
+  *different* tenants are stacked on a leading tenant axis together with their
+  per-tenant states, and folded with ONE ``jax.vmap`` of the existing
+  ``pure_update`` transition (collections: per compute-group leader, exactly
+  like the streaming pipeline's fused scan). Results are bit-identical to
+  per-tenant eager updates — vmap batches the same program, it does not change
+  it.
+- **Tenant-width buckets** — a group of N tenants is padded up to the next
+  power-of-two width with a masked tail (padded rows pass their state through
+  unchanged), reusing the engine's shape-bucket discipline so the compiled
+  program count stays **O(width-buckets × signatures)**, independent of the
+  tenant population. :meth:`warmup` AOT-precompiles every (width-bucket,
+  signature) variant, persistent-compile-cache included.
+- **Per-tenant fault isolation** — the PR-5 robust seam survives the fusion:
+  a group is screened once for non-finite inputs; a poisoned row degrades
+  exactly *its* tenant's batch to that tenant's own guarded ``update``
+  (skip/quarantine/raise per its policy) while the rest of the cohort still
+  lands fused. A tenant never pays for its neighbor's garbage.
+- **Cost-aware admission** — with an
+  :class:`~torchmetrics_tpu.obs.scope.AdmissionController` configured (or
+  installed process-wide), every fed batch is admitted, shed or deferred
+  against the tenant's quota, and executed work is billed back priced by the
+  cost ledger's per-dispatch estimates (flops/bytes per fused row, compile
+  seconds split across the group that forced them). Over-quota pressure
+  surfaces as ``tenant.quota_*`` gauges and the ``tenant.quota_exceeded``
+  alert signal.
+
+Per-tenant stream order is preserved: a tenant feeding a second batch (or a
+new signature) before its pending group dispatched flushes that group first.
+Cross-tenant order inside one group is irrelevant by construction — rows fold
+independent states.
+
+Telemetry (``torchmetrics_tpu.obs``, off by default): ``engine.mux_*``
+counters/gauges (dispatches, fused/eager/replayed updates, padded rows, shed
+and deferred admission decisions, last/peak dispatch width), ``engine.dispatch``
+spans with ``path="mux"``. :meth:`report` returns the same accounting as plain
+ints.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields, replace
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import torchmetrics_tpu.obs.cost as _cost
+import torchmetrics_tpu.obs.scope as _scope
+import torchmetrics_tpu.obs.trace as _trace
+import torchmetrics_tpu.obs.values as _values
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.jit import (
+    StaticLeafJit,
+    _ArraySlot,
+    _aval_signature,
+    jit_with_static_leaves,
+    partition_static_leaves,
+)
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.engine import warmup as _warmup
+from torchmetrics_tpu.robust.policy import effective_policy, nonfinite_step_indices
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["MuxConfig", "MuxReport", "TenantMultiplexer"]
+
+
+@dataclass
+class MuxConfig:
+    """Tuning knobs for :class:`TenantMultiplexer`.
+
+    Args:
+        max_width: max tenants fused into one dispatch (the top width bucket).
+        width_buckets: explicit tenant-width buckets (ascending). Default:
+            powers of two up to ``max_width`` — a partial group pads up to the
+            next bucket with a masked tail, so compiled-variant count stays
+            ``O(log max_width)`` per signature.
+        admission: an :class:`~torchmetrics_tpu.obs.scope.AdmissionController`
+            consulted per fed batch. ``None`` falls back to the process-wide
+            controller (:func:`~torchmetrics_tpu.obs.scope.get_admission`),
+            which may also be ``None`` — everything admitted.
+        alert_engine: an :class:`~torchmetrics_tpu.obs.alerts.AlertEngine`
+            evaluated per committed group — each committed tenant's values are
+            sampled sync-free under its own session, so per-tenant watchdogs
+            see mid-stream state exactly as with per-tenant pipelines.
+        alert_every: evaluate the alert engine every Nth committed group
+            (``close()`` always runs a final evaluation).
+        max_deferred: per-tenant cap on the deprioritized backlog — deferred
+            batches hold real device arrays, so a tenant parked over quota
+            for hours must not grow memory without bound. Past the cap,
+            further defer decisions degrade to shed (counted, loud once).
+        device: target device for stacked batches (``None``: default device).
+    """
+
+    max_width: int = 64
+    width_buckets: Optional[Tuple[int, ...]] = None
+    admission: Any = None
+    alert_engine: Any = None
+    alert_every: int = 1
+    max_deferred: int = 1024
+    device: Any = None
+
+    def __post_init__(self) -> None:
+        if self.max_width < 1:
+            raise ValueError(f"Expected `max_width` >= 1, got {self.max_width}")
+        if self.alert_every < 1:
+            raise ValueError(f"Expected `alert_every` >= 1, got {self.alert_every}")
+        if self.max_deferred < 1:
+            raise ValueError(f"Expected `max_deferred` >= 1, got {self.max_deferred}")
+        if self.width_buckets is not None:
+            buckets = tuple(sorted(set(int(b) for b in self.width_buckets)))
+            if not buckets or buckets[0] < 1:
+                raise ValueError(f"Expected positive `width_buckets`, got {self.width_buckets}")
+            if buckets[-1] > self.max_width:
+                raise ValueError(
+                    f"`width_buckets` top bucket {buckets[-1]} exceeds `max_width`"
+                    f" {self.max_width} — every full group would pad (and bill) phantom"
+                    " rows past the dispatch cap"
+                )
+            if buckets[-1] < self.max_width:
+                buckets = buckets + (self.max_width,)
+            self.width_buckets = buckets
+
+    def buckets(self) -> Tuple[int, ...]:
+        if self.width_buckets is not None:
+            return self.width_buckets
+        return _warmup.pow2_buckets(self.max_width)
+
+
+@dataclass
+class MuxReport:
+    """Plain-int accounting of one multiplexer's work (no obs tracing needed)."""
+
+    batches: int = 0  # batches ingested (admitted + deferred-replayed)
+    fused_updates: int = 0  # tenant-updates landed via a fused vmap dispatch
+    eager_updates: int = 0  # tenant-updates driven through per-tenant `update`
+    replayed_updates: int = 0  # guarded per-tenant replays of poisoned rows
+    dispatches: int = 0  # fused vmap dispatches issued
+    eager_dispatches: int = 0  # per-tenant update dispatches (incl. replays)
+    shed_batches: int = 0  # admission decisions: dropped over-quota batches
+    deferred_batches: int = 0  # admission decisions: deprioritized batches
+    deferred_replayed: int = 0  # deferred batches later ingested
+    padded_rows: int = 0  # masked tenant rows added by width-bucket padding
+    order_flushes: int = 0  # groups dispatched early to keep a tenant's order
+    max_width: int = 0
+    last_width: int = 0
+
+    def host_dispatches(self) -> int:
+        return self.dispatches + self.eager_dispatches
+
+    def dispatches_per_update(self) -> Optional[float]:
+        """Host dispatches per landed tenant-update (< 1.0 once fusion engages)."""
+        landed = self.fused_updates + self.eager_updates + self.replayed_updates
+        if not landed:
+            return None
+        return self.host_dispatches() / landed
+
+    def asdict(self) -> Dict[str, Any]:
+        out = {f.name: getattr(self, f.name) for f in fields(self)}
+        out["host_dispatches"] = self.host_dispatches()
+        out["dispatches_per_update"] = self.dispatches_per_update()
+        return out
+
+
+# runtime state that legitimately differs between healthy same-config
+# instances; everything else public+hashable is configuration the fused
+# program bakes in (error_policy is per-tenant by design: it guards the
+# eager/replay path, never the pure transition)
+_RUNTIME_ATTRS = frozenset(
+    {
+        "updates_ok",
+        "updates_skipped",
+        "updates_quarantined",
+        "quarantine_dropped",
+        "last_update_ok",
+        "sync_degraded",
+        "error_policy",
+    }
+)
+
+
+def _config_fingerprint(target: Any) -> Any:
+    """Hashable-config snapshot of a metric (or collection, per member).
+
+    The fused program traces the TEMPLATE instance's ``pure_update``, so every
+    adopted target must agree on the configuration that transition bakes in —
+    a same-class tenant with a different ``ignore_index`` would otherwise
+    compute silently with the template's. Public scalar/tuple attributes are
+    the configuration surface; runtime counters and per-tenant robust policy
+    are excluded.
+    """
+
+    def one(m: Any) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in vars(m).items():
+            if k.startswith("_") or k in _RUNTIME_ATTRS:
+                continue
+            if isinstance(v, (bool, int, float, str, bytes, tuple, frozenset, type(None))):
+                out[k] = v
+            elif hasattr(v, "dtype") and hasattr(v, "shape"):
+                # array-valued configuration (e.g. curve metrics' `thresholds`
+                # buffer) is configuration too — two tenants binning on
+                # different thresholds must not share a fused program
+                arr = np.asarray(v)
+                if arr.size <= 65536:
+                    out[k] = (str(arr.dtype), arr.shape, arr.tobytes())
+        return out
+
+    modules = getattr(target, "_modules", None)
+    if isinstance(modules, dict):
+        return {name: (type(m).__name__, one(m)) for name, m in modules.items()}
+    return one(target)
+
+
+class _MuxGroup:
+    """One open fusion group: same-signature rows from distinct tenants."""
+
+    __slots__ = ("sig", "treedef", "template", "tenants", "traced", "originals")
+
+    def __init__(self, sig: tuple, treedef: Any, template: tuple) -> None:
+        self.sig = sig
+        self.treedef = treedef
+        self.template = template
+        self.tenants: List[str] = []
+        self.traced: List[list] = []  # per row: traced leaves, template order
+        self.originals: List[Tuple[tuple, dict]] = []
+
+    def __len__(self) -> int:
+        return len(self.tenants)
+
+
+class TenantMultiplexer:
+    """Fold same-signature updates from many tenants with one ``vmap`` dispatch.
+
+    Usage::
+
+        mux = TenantMultiplexer(lambda: MulticlassAccuracy(num_classes=4),
+                                MuxConfig(max_width=64))
+        for tenant in tenants:
+            mux.adopt(tenant)
+        mux.warmup(example_preds, example_target)    # AOT every width bucket
+        for tenant, batch in traffic:
+            mux.feed(tenant, *batch)                 # fuses across tenants
+        mux.close()
+        value = mux.compute("acme-prod")
+
+    Every tenant owns a *separate* metric instance (``factory()``) — state is
+    never shared; only the compiled programs are. Targets with ragged list
+    states (or ``jit_update=False``) degrade to per-tenant eager updates
+    automatically, exactly like the streaming pipeline.
+    """
+
+    def __init__(
+        self,
+        factory: Optional[Callable[[], Union[Metric, MetricCollection]]] = None,
+        config: Optional[MuxConfig] = None,
+        metrics: Optional[Dict[str, Union[Metric, MetricCollection]]] = None,
+        **overrides: Any,
+    ) -> None:
+        if config is None:
+            config = MuxConfig(**overrides)
+        elif overrides:
+            config = replace(config, **overrides)
+        if factory is None and not metrics:
+            raise ValueError(
+                "TenantMultiplexer needs a metric factory or an initial `metrics` dict"
+            )
+        self.config = config
+        self._factory = factory
+        self._metrics: Dict[str, Union[Metric, MetricCollection]] = {}
+        # raw tenant name -> effective label (identity for in-cap tenants;
+        # past-cap names collapse onto the shared OVERFLOW_TENANT session)
+        self._aliases: Dict[str, str] = {}
+        self._template: Optional[Union[Metric, MetricCollection]] = None
+        self._is_collection = False
+        self._fused_leaders: List[Optional[str]] = []
+        self._eager_leaders: List[str] = []
+        self._fusable = False
+        self._label = "TenantMultiplexer"
+        self._buckets = config.buckets()
+        self._groups: Dict[tuple, _MuxGroup] = {}
+        self._pending: Dict[str, tuple] = {}  # tenant -> sig of its open row
+        self._fused_fns: Dict[tuple, StaticLeafJit] = {}
+        self._deferred: Dict[str, List[Tuple[tuple, dict]]] = {}
+        self._report = MuxReport()
+        self._warmup_manifest: Optional[Dict[str, Any]] = None
+        self._alert_commits = 0
+        self._alert_warned = False
+        self._shed_warned: set = set()
+        # per-width-bucket (flops, bytes) per dispatch — a width-1 program
+        # costs ~1/64th of a width-64 one, so billing must price the bucket
+        # that actually executed, not a cross-width mean
+        self._width_prices: Dict[int, Tuple[Optional[float], Optional[float]]] = {}
+        self._closed = False
+        for tenant, metric in (metrics or {}).items():
+            self.adopt(tenant, metric)
+        # persistent compile cache wiring is part of engine startup (no-op
+        # unless TM_TPU_COMPILE_CACHE or an earlier explicit call set a dir)
+        _warmup.configure_compile_cache()
+
+    # ------------------------------------------------------------------ membership
+
+    def adopt(
+        self, tenant: str, metric: Optional[Union[Metric, MetricCollection]] = None
+    ) -> Union[Metric, MetricCollection]:
+        """Register ``tenant`` with its own metric instance (created via the
+        factory when not given); returns the instance.
+
+        The tenant is registered with the scope registry as a live session
+        (``active_pipelines``), and the metric adopts the tenant label so its
+        eager paths (direct compute, robust counters, memory gauges) stay
+        attributed. The first adopted target fixes the template: every later
+        target must be the same class (same state structure — the fused
+        program folds all of them).
+
+        Past the registry cap, new tenant names collapse onto the shared
+        :data:`~torchmetrics_tpu.obs.scope.OVERFLOW_TENANT` session — the
+        registry's documented attribution-loss semantic: their traffic keeps
+        flowing (through one shared metric instance), it just stops being
+        individually attributable.
+        """
+        raw = _scope.validate_tenant(tenant)
+        if raw in self._aliases:
+            raise ValueError(f"Tenant {raw!r} is already multiplexed")
+        effective = _scope.adopt(raw)
+        if effective in self._metrics:
+            if effective == raw:
+                raise ValueError(f"Tenant {raw!r} is already multiplexed")
+            # past-cap collapse: the raw name joins the overflow session
+            self._aliases[raw] = effective
+            return self._metrics[effective]
+        if metric is None:
+            if self._factory is None:
+                raise ValueError(f"No factory to build a metric for tenant {tenant!r}")
+            with _scope.session(effective):
+                metric = self._factory()
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise ValueError(
+                f"TenantMultiplexer drives Metric or MetricCollection targets,"
+                f" got {type(metric).__name__}"
+            )
+        if self._template is None:
+            self._template = metric
+            self._is_collection = isinstance(metric, MetricCollection)
+            self._label = f"Mux[{type(metric).__name__}]"
+            if self._is_collection:
+                self._fused_leaders, self._eager_leaders = metric._engine_fusable_leaders()
+            else:
+                self._fused_leaders, self._eager_leaders = [], []
+                if metric._engine_fusable():
+                    self._fused_leaders = [None]  # sentinel: the metric itself fuses
+            self._fusable = bool(self._fused_leaders)
+        elif type(metric) is not type(self._template):
+            raise ValueError(
+                f"Tenant {tenant!r} brings a {type(metric).__name__} but this multiplexer"
+                f" fuses {type(self._template).__name__} targets — one compiled program"
+                " cannot fold mismatched state structures"
+            )
+        else:
+            # same class is not enough: the fused program runs the TEMPLATE's
+            # pure_update, so configuration (thresholds, ignore_index, top_k,
+            # averaging, ...) must match or this tenant would silently compute
+            # with the template's settings
+            ours, theirs = _config_fingerprint(self._template), _config_fingerprint(metric)
+            if ours != theirs:
+                if isinstance(ours, dict) and isinstance(theirs, dict):
+                    differing = sorted(
+                        k for k in set(ours) | set(theirs) if ours.get(k) != theirs.get(k)
+                    )
+                else:  # pragma: no cover - both sides are dicts by construction
+                    differing = ["<configuration>"]
+                raise ValueError(
+                    f"Tenant {tenant!r} brings a {type(metric).__name__} whose"
+                    f" configuration differs from the template's on {differing} —"
+                    " the fused program bakes in ONE configuration; use a separate"
+                    " multiplexer (or per-tenant pipelines) for divergent configs"
+                )
+        if getattr(metric, "_obs_tenant", None) is None:
+            metric._obs_tenant = effective
+        if self._is_collection:
+            for m in metric._modules.values():
+                if getattr(m, "_obs_tenant", None) is None:
+                    m._obs_tenant = effective
+        self._metrics[effective] = metric
+        self._aliases[raw] = effective
+        _scope.get_registry().pipeline_started(effective)
+        return metric
+
+    def _effective(self, tenant: str) -> str:
+        """The session label a raw tenant name maps to (adopting on demand)."""
+        effective = self._aliases.get(tenant)
+        if effective is None:
+            self.adopt(tenant)
+            effective = self._aliases[tenant]
+        return effective
+
+    def tenants(self) -> List[str]:
+        return list(self._metrics)
+
+    def metric(self, tenant: str) -> Union[Metric, MetricCollection]:
+        return self._metrics[self._aliases.get(tenant, tenant)]
+
+    def report(self) -> MuxReport:
+        """Copy of the accounting so far (safe to keep across further feeds)."""
+        return replace(self._report)
+
+    @property
+    def warmup_manifest(self) -> Optional[Dict[str, Any]]:
+        return self._warmup_manifest
+
+    def cache_info(self) -> Dict[str, Any]:
+        """Summed fused-program cache accounting across signature families."""
+        infos = [fn.cache_info() for fn in self._fused_fns.values()]
+        return {
+            "families": len(infos),
+            "static_variants": sum(i["static_variants"] for i in infos),
+            "compiled_variants": sum(i["compiled_variants"] for i in infos),
+            "hits": sum(i["hits"] for i in infos),
+            "misses": sum(i["misses"] for i in infos),
+        }
+
+    # ---------------------------------------------------------------------- feeding
+
+    def feed(self, tenant: str, *args: Any, **kwargs: Any) -> None:
+        """Ingest one update batch for ``tenant`` (admission applies first)."""
+        # everything downstream keys on the EFFECTIVE label, so past-cap
+        # tenants (collapsed onto the overflow session) keep being served
+        tenant = self._effective(tenant)
+        controller = self._admission()
+        if controller is not None:
+            decision = controller.admit(tenant)
+            if decision == _scope.DEFER:
+                backlog = self._deferred.setdefault(tenant, [])
+                if len(backlog) >= self.config.max_deferred:
+                    # a full backlog holds real device arrays: degrade to
+                    # shed instead of growing memory without bound — and tell
+                    # the controller, whose admit() counted this as deferred
+                    controller.note_degraded_shed(tenant)
+                    decision = _scope.SHED
+                else:
+                    backlog.append((args, kwargs))
+                    self._report.deferred_batches += 1
+                    if _trace.ENABLED:
+                        _trace.inc("engine.mux_deferred", mux=self._label, tenant=tenant)
+                    return
+            if decision == _scope.SHED:
+                self._report.shed_batches += 1
+                if tenant not in self._shed_warned:
+                    self._shed_warned.add(tenant)
+                    rank_zero_warn(
+                        f"Tenant {tenant!r} is over quota: its update batches are being"
+                        " SHED (dropped, counted in tenant.quota_shed). This warning"
+                        " fires once per tenant; the burn state is on GET /tenants.",
+                        RuntimeWarning,
+                    )
+                if _trace.ENABLED:
+                    _trace.inc("engine.mux_shed", mux=self._label, tenant=tenant)
+                return
+            # back under quota: the tenant's deferred backlog drains first so
+            # its stream order is preserved
+            backlog = self._deferred.pop(tenant, None)
+            if backlog:
+                for b_args, b_kwargs in backlog:
+                    self._report.deferred_replayed += 1
+                    controller.charge(tenant, updates=1)
+                    self._ingest(tenant, b_args, b_kwargs)
+            controller.charge(tenant, updates=1)
+        self._ingest(tenant, args, kwargs)
+
+    def _admission(self) -> Optional[Any]:
+        return self.config.admission if self.config.admission is not None else _scope.get_admission()
+
+    def _ingest(self, tenant: str, args: tuple, kwargs: dict) -> None:
+        self._report.batches += 1
+        if _trace.ENABLED:
+            _trace.inc("engine.mux_batches", mux=self._label)
+        if not self._fusable:
+            self._drive_eager(tenant, args, kwargs)
+            return
+        if self._eager_leaders:
+            # unfusable group leaders advance per batch, in stream order
+            self._drive_eager_leaders(tenant, args, kwargs)
+        args, kwargs = self._device_put(args, kwargs)
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template, unhashable = partition_static_leaves(leaves)
+        if unhashable is not None:
+            # unhashable statics cannot key a group signature: keep this
+            # tenant's order (dispatch its pending group) and go eager
+            self._flush_pending(tenant)
+            self._drive_fused_leaders_eagerly(tenant, args, kwargs)
+            return
+        sig = (treedef, tuple(template), _aval_signature(traced))
+        pending = self._pending.get(tenant)
+        if pending is not None:
+            # the tenant already has an undispatched row: its earlier batch
+            # must land before this one, whatever group it sits in
+            self._report.order_flushes += 1
+            if _trace.ENABLED:
+                _trace.inc("engine.mux_order_flush", mux=self._label)
+            self._dispatch_sig(pending)
+        group = self._groups.get(sig)
+        if group is None:
+            group = self._groups[sig] = _MuxGroup(sig, treedef, tuple(template))
+        group.tenants.append(tenant)
+        group.traced.append(traced)
+        group.originals.append((args, kwargs))
+        self._pending[tenant] = sig
+        if _trace.ENABLED:
+            _trace.set_gauge("engine.mux_open_groups", len(self._groups), mux=self._label)
+        if len(group) >= self.config.max_width:
+            self._dispatch_sig(sig)
+
+    def _device_put(self, args: tuple, kwargs: dict) -> Tuple[tuple, dict]:
+        if self.config.device is None:
+            return args, kwargs
+
+        def _put(x: Any) -> Any:
+            if isinstance(x, (jax.Array, np.ndarray)):
+                return jax.device_put(x, self.config.device)
+            return x
+
+        return jax.tree_util.tree_map(_put, (args, kwargs))
+
+    def _flush_pending(self, tenant: str) -> None:
+        sig = self._pending.get(tenant)
+        if sig is not None:
+            self._dispatch_sig(sig)
+
+    def flush(self) -> None:
+        """Dispatch every open group (insertion order, padded to its bucket)."""
+        for sig in list(self._groups):
+            self._dispatch_sig(sig)
+
+    def flush_deferred(self) -> None:
+        """Drain every tenant's deprioritized backlog (admission decisions
+        bypassed — the work executes regardless — but executed updates are
+        still billed, same as an in-stream drain)."""
+        controller = self._admission()
+        deferred, self._deferred = self._deferred, {}
+        for tenant, backlog in deferred.items():
+            for args, kwargs in backlog:
+                self._report.deferred_replayed += 1
+                if controller is not None:
+                    controller.charge(tenant, updates=1)
+                self._ingest(tenant, args, kwargs)
+        self.flush()
+
+    def close(self) -> MuxReport:
+        """Flush open groups AND the deferred backlog; end the tenant sessions."""
+        try:
+            self.flush()
+            self.flush_deferred()
+            self._evaluate_alerts([], force=True)
+        finally:
+            if not self._closed:
+                self._closed = True
+                registry = _scope.get_registry()
+                for tenant in self._metrics:
+                    registry.pipeline_finished(tenant)
+        return self.report()
+
+    def __enter__(self) -> "TenantMultiplexer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+    def compute(self, tenant: str) -> Any:
+        """Flush the tenant's pending work, then compute its metric."""
+        tenant = self._aliases.get(tenant, tenant)
+        self._flush_pending(tenant)
+        with _scope.session(tenant):
+            return self._metrics[tenant].compute()
+
+    # ---------------------------------------------------------------------- warmup
+
+    def warmup(
+        self, *args: Any, manifest_path: Optional[str] = None, **kwargs: Any
+    ) -> Dict[str, Any]:
+        """AOT-precompile every (tenant-width-bucket, signature) fused variant
+        for one example batch (concrete arrays or ``jax.ShapeDtypeStruct``
+        specs), plus the template's per-batch path (the replay fallback).
+
+        Per-tenant replay programs for *other* tenants' instances are not
+        pre-walked — they compile on first fault, and with the persistent
+        compilation cache wired those compiles are disk reads of the
+        template's program. Returns (and stores) the warmup manifest.
+        """
+        if self._template is None:
+            raise RuntimeError("TenantMultiplexer.warmup needs at least one adopted tenant")
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        traced, template, unhashable = partition_static_leaves(leaves)
+        if unhashable is not None:
+            raise TypeError(
+                f"TenantMultiplexer.warmup received an unhashable static argument of type"
+                f" {type(unhashable).__name__}; such batches dispatch per-tenant eagerly"
+                " and cannot be precompiled."
+            )
+        traced_specs = []
+        for leaf in traced:
+            if isinstance(leaf, jax.ShapeDtypeStruct):
+                traced_specs.append(leaf)
+            else:
+                dtype = leaf.dtype if hasattr(leaf, "dtype") else np.asarray(leaf).dtype
+                traced_specs.append(jax.ShapeDtypeStruct(np.shape(leaf), dtype))
+        shapes = [list(map(int, s.shape)) for s in traced_specs]
+        entries: List[Dict[str, Any]] = []
+        if self._fusable:
+            fused = self._get_fused_fn(treedef, tuple(template))
+            state = self._template_state()
+            abstract_state = jax.tree_util.tree_map(
+                lambda leaf: jax.ShapeDtypeStruct(
+                    np.shape(leaf), getattr(leaf, "dtype", np.asarray(leaf).dtype)
+                ),
+                state,
+            )
+            for width in self._buckets:
+                states = tuple(abstract_state for _ in range(width))
+                rows = tuple(tuple(traced_specs) for _ in range(width))
+                valid = jax.ShapeDtypeStruct((width,), np.bool_)
+                info = fused.warmup(states, rows, valid)
+                if info.get("flops") is not None or info.get("bytes_accessed") is not None:
+                    self._width_prices[width] = (info.get("flops"), info.get("bytes_accessed"))
+                entries.append({**info, "kind": "mux", "width": width, "shapes": shapes})
+        # the template's per-batch path: the replay/eager fallback program
+        it = iter(traced_specs)
+        abstract_full = [next(it) if isinstance(t, _ArraySlot) else t for t in template]
+        a_args, a_kwargs = jax.tree_util.tree_unflatten(treedef, abstract_full)
+        for m in self._per_batch_metrics(self._template):
+            if not m._jit_enabled():
+                continue
+            if m._jitted_update is None:
+                m._jitted_update = jit_with_static_leaves(m.pure_update)
+            filtered = m._filter_kwargs(**a_kwargs) if self._is_collection else a_kwargs
+            info = m._jitted_update.warmup(dict(m._state_values), *a_args, **filtered)
+            entries.append({**info, "kind": "per_batch", "width": None, "shapes": shapes})
+        manifest = _warmup.build_manifest(entries, cache_dir=_warmup.configured_cache_dir())
+        self._warmup_manifest = manifest
+        if _trace.ENABLED:
+            _trace.event(
+                "engine.mux_warmup",
+                mux=self._label,
+                variants=manifest["variants"],
+                fresh=manifest["fresh_compiles"],
+                seconds=manifest["total_compile_seconds"],
+            )
+        if manifest_path is not None:
+            _warmup.save_manifest(manifest, manifest_path)
+        return manifest
+
+    # ------------------------------------------------------------------ fused path
+
+    def _per_batch_metrics(self, target: Union[Metric, MetricCollection]) -> List[Metric]:
+        """The metrics the per-tenant eager/replay path drives directly."""
+        if not self._is_collection:
+            return [target]
+        return [target._modules[name] for name in self._fused_leaders if name is not None]
+
+    def _template_state(self) -> Any:
+        return self._fused_state(self._template)
+
+    def _fused_state(self, target: Union[Metric, MetricCollection]) -> Any:
+        if not self._is_collection:
+            return dict(target._state_values)
+        return {name: dict(target._modules[name]._state_values) for name in self._fused_leaders}
+
+    def _get_fused_fn(self, treedef: Any, template: tuple) -> StaticLeafJit:
+        key = (treedef, template)
+        fused = self._fused_fns.get(key)
+        if fused is not None:
+            return fused
+        target = self._template
+        if self._is_collection:
+            leaders = [(name, target._modules[name]) for name in self._fused_leaders]
+        else:
+            leaders = None
+
+        def mux_update(states, rows, valid):
+            # states: tuple of per-tenant state pytrees; rows: tuple of
+            # per-row traced-leaf tuples. Stacking AND unstacking happen
+            # INSIDE the compiled program — the host issues exactly one
+            # dispatch per group instead of O(width × leaves) stack/slice ops
+            # (on a CPU host those small ops dominate; on a TPU they would
+            # serialize the dispatch stream this layer exists to collapse).
+            stacked_state = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+            stacked = tuple(
+                jnp.stack([row[i] for row in rows]) for i in range(len(rows[0]))
+            )
+
+            def one(st, row_leaves, ok):
+                it = iter(row_leaves)
+                full = [next(it) if isinstance(t, _ArraySlot) else t for t in template]
+                a, kw = jax.tree_util.tree_unflatten(treedef, full)
+                if leaders is None:
+                    new = target.pure_update(st, *a, **kw)
+                else:
+                    new = {
+                        name: m.pure_update(st[name], *a, **m._filter_kwargs(**kw))
+                        for name, m in leaders
+                    }
+                # masked tail: padded tenant rows pass their state through
+                # unchanged, so a partial group padded up to its width bucket
+                # stays bit-identical to the unpadded per-tenant run
+                return jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new, st)
+
+            out = jax.vmap(one)(stacked_state, stacked, valid)
+            return tuple(
+                jax.tree_util.tree_map(lambda leaf: leaf[i], out) for i in range(len(states))
+            )
+
+        mux_update.__name__ = "mux_update"
+        mux_update.__qualname__ = f"{self._label}.mux_update"
+        fused = jit_with_static_leaves(mux_update)
+        self._fused_fns[key] = fused
+        return fused
+
+    def _width_bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _row_policy(self, tenant: str):
+        """The error policy guarding this tenant's row (any fused metric's,
+        else the global default) — mirrors the pipeline's chunk policy."""
+        for m in self._per_batch_metrics(self._metrics[tenant]):
+            policy = effective_policy(m.error_policy)
+            if policy is not None:
+                return policy
+        return None
+
+    def _dispatch_sig(self, sig: tuple) -> None:
+        group = self._groups.pop(sig, None)
+        if group is None or not len(group):
+            return
+        for tenant in group.tenants:
+            self._pending.pop(tenant, None)
+        rows = list(zip(group.tenants, group.traced, group.originals))
+        # one non-finite screen per GROUP (vs one host sync per tenant batch on
+        # the guarded eager path); only guarded tenants' rows are screened —
+        # an unguarded tenant's NaN must flow into ITS state like always
+        guarded = {i for i, (tenant, _, _) in enumerate(rows) if self._row_policy(tenant) is not None}
+        if guarded:
+            # host-side probe: the screen reads host values anyway (one sync
+            # per group by design), so stack with numpy instead of burning a
+            # device op per leaf; scalar leaves stack to shape (n,) and are
+            # screened like any other, matching the pipeline's chunk screen
+            stacked_probe = [
+                np.stack([np.asarray(row[1][i]) for row in rows])
+                for i in range(len(rows[0][1]))
+            ]
+            bad = [i for i in nonfinite_step_indices(stacked_probe) if i in guarded]
+            if bad:
+                if _trace.ENABLED:
+                    _trace.event(
+                        "engine.mux_degraded",
+                        mux=self._label,
+                        reason="nonfinite",
+                        tenants=",".join(rows[i][0] for i in bad),
+                        width=len(rows),
+                    )
+                # the clean cohort lands FIRST (cross-tenant order inside a
+                # group is free — rows fold independent states), then exactly
+                # the poisoned tenants' batches replay through their OWN
+                # guarded updates. Each replay is individually guarded: one
+                # tenant's raise policy propagates AFTER every other tenant's
+                # work — poisoned or clean — has landed, so a neighbor's
+                # garbage never costs anyone else a batch.
+                clean = [row for i, row in enumerate(rows) if i not in set(bad)]
+                if clean:
+                    self._dispatch_rows(group, clean)
+                self._replay_rows([rows[i] for i in bad])
+                return
+        self._dispatch_rows(group, rows)
+
+    def _replay_rows(self, rows: List[tuple]) -> None:
+        """Guarded per-tenant replays; the first raising tenant's error
+        propagates only after every row has been given its replay."""
+        errors: List[BaseException] = []
+        replayed: List[str] = []
+        for tenant, _, (r_args, r_kwargs) in rows:
+            try:
+                self._replay_row(tenant, r_args, r_kwargs)
+            except BaseException as err:  # raise-policy tenants re-raise below
+                errors.append(err)
+            replayed.append(tenant)
+        self._evaluate_alerts(replayed)
+        if errors:
+            raise errors[0]
+
+    def _dispatch_rows(self, group: _MuxGroup, rows: List[tuple]) -> None:
+        n = len(rows)
+        width = self._width_bucket(n)
+        pad = width - n
+        padded = rows + [rows[-1]] * pad  # repeat-last padding, masked out
+        traced_rows = tuple(tuple(row[1]) for row in padded)
+        valid = np.arange(width) < n
+        states = [self._fused_state(self._metrics[row[0]]) for row in rows]
+        states += [states[-1]] * pad
+        fused = self._get_fused_fn(group.treedef, group.template)
+        controller = self._admission()
+        ledger_mark = _cost.get_ledger().mark() if controller is not None else None
+        try:
+            if _trace.ENABLED:
+                with _trace.span(
+                    "engine.dispatch", pipeline=self._label, path="mux", width=n
+                ):
+                    new_states = fused(tuple(states), traced_rows, valid)
+            else:
+                new_states = fused(tuple(states), traced_rows, valid)
+        except Exception as err:
+            # state was never committed; every row replays through its own
+            # (guarded or not) per-tenant update, isolating real failures —
+            # one tenant's raising replay never robs the others of theirs
+            if _trace.ENABLED:
+                _trace.event(
+                    "engine.mux_degraded",
+                    mux=self._label,
+                    reason=type(err).__name__,
+                    width=n,
+                )
+            self._replay_rows(rows)
+            return
+        committed: List[str] = []
+        for i, (tenant, _, _) in enumerate(rows):
+            # new_states[i] is the tenant's state pytree, already split by the
+            # compiled program — no per-leaf host slicing here
+            with _scope.session(tenant):
+                self._commit(self._metrics[tenant], new_states[i])
+            committed.append(tenant)
+        self._report.dispatches += 1
+        self._report.fused_updates += n
+        self._report.padded_rows += pad
+        self._report.max_width = max(self._report.max_width, n)
+        self._report.last_width = n
+        if _trace.ENABLED:
+            _trace.inc("engine.mux_dispatches", mux=self._label)
+            _trace.inc("engine.mux_fused_updates", n, mux=self._label)
+            if pad:
+                _trace.inc("engine.mux_padded_rows", pad, mux=self._label)
+            _trace.set_gauge("engine.mux_width", n, mux=self._label)
+            _trace.set_gauge("engine.mux_open_groups", len(self._groups), mux=self._label)
+        if controller is not None:
+            self._charge_rows(controller, committed, width, ledger_mark)
+        self._evaluate_alerts(committed)
+
+    def _commit(self, target: Union[Metric, MetricCollection], state: Any) -> None:
+        if self._is_collection:
+            target._engine_commit({name: state[name] for name in self._fused_leaders}, 1)
+        else:
+            target._engine_commit_state(state, 1)
+        for m in self._per_batch_metrics(target):
+            m._check_buffer_overflow()
+
+    def _charge_rows(
+        self, controller: Any, tenants: List[str], width: int, ledger_mark: Optional[int]
+    ) -> None:
+        """Bill the dispatch back per tenant: the executed width bucket's
+        per-dispatch estimate split across its rows (each row is one tenant's
+        share), plus fresh compile seconds split across the rows that forced
+        them (shared executables, shared bill)."""
+        try:
+            ledger = _cost.get_ledger()
+            compile_delta = ledger.since(ledger_mark) if ledger_mark is not None else {}
+            fresh = compile_delta.get("variants_compiled", 0)
+            if fresh and width not in self._width_prices:
+                # the first dispatch at this width compiled exactly this
+                # width's program: the delta's estimate IS its price (a
+                # genuine 0.0 is a valid price — it must not read as missing,
+                # or this width would pay the fallback scan forever)
+                self._width_prices[width] = (
+                    compile_delta.get("estimated_flops"),
+                    compile_delta.get("estimated_bytes"),
+                )
+            if width in self._width_prices:
+                flops, bytes_accessed = self._width_prices[width]
+            else:
+                # unwarmed width on a cached program (e.g. persistent compile
+                # cache hit): fall back to the cross-width ledger mean —
+                # approximate, but only until this width is priced
+                price = ledger.fn_estimate(f"{self._label}.mux_update")
+                flops = price.get("flops_per_dispatch")
+                bytes_accessed = price.get("bytes_per_dispatch")
+            per_row_flops = (flops or 0.0) / max(1, width)
+            per_row_bytes = (bytes_accessed or 0.0) / max(1, width)
+            compile_share = (
+                float(compile_delta.get("compile_seconds", 0.0)) / len(tenants) if tenants else 0.0
+            )
+            for tenant in tenants:
+                controller.charge(
+                    tenant,
+                    flops=per_row_flops,
+                    bytes_accessed=per_row_bytes,
+                    compile_seconds=compile_share,
+                )
+        except Exception:  # pricing must never cost correctness
+            pass
+
+    # ------------------------------------------------------------- per-tenant paths
+
+    def _drive_eager(self, tenant: str, args: tuple, kwargs: dict) -> None:
+        """Whole-target per-tenant update (target unfusable)."""
+        target = self._metrics[tenant]
+        with _scope.session(tenant):
+            if _trace.ENABLED:
+                with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
+                    target.update(*args, **kwargs)
+            else:
+                target.update(*args, **kwargs)
+        self._report.eager_updates += 1
+        self._report.eager_dispatches += 1
+        if _trace.ENABLED:
+            _trace.inc("engine.mux_eager_updates", mux=self._label)
+        self._evaluate_alerts([tenant])
+
+    def _drive_eager_leaders(self, tenant: str, args: tuple, kwargs: dict) -> None:
+        target = self._metrics[tenant]
+        with _scope.session(tenant):
+            for name in self._eager_leaders:
+                m = target._modules[name]
+                m.update(*args, **m._filter_kwargs(**kwargs))
+        self._report.eager_dispatches += len(self._eager_leaders)
+
+    def _drive_fused_leaders_eagerly(self, tenant: str, args: tuple, kwargs: dict) -> None:
+        """Per-tenant fallback for a batch that cannot join a group."""
+        target = self._metrics[tenant]
+        with _scope.session(tenant):
+            for m in self._per_batch_metrics(target):
+                filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
+                m.update(*args, **filtered)
+            if self._is_collection:
+                target._sync_group_states()
+        self._report.eager_updates += 1
+        self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
+        self._evaluate_alerts([tenant])
+
+    def _replay_row(self, tenant: str, args: tuple, kwargs: dict) -> None:
+        """Guarded per-tenant replay of a poisoned/failed row: the tenant's own
+        error policy decides (skip/quarantine/raise) — its cohort never sees it."""
+        target = self._metrics[tenant]
+        with _scope.session(tenant):
+            if _trace.ENABLED:
+                with _trace.span("engine.dispatch", pipeline=self._label, path="replay"):
+                    self._replay_updates(target, args, kwargs)
+            else:
+                self._replay_updates(target, args, kwargs)
+        self._report.replayed_updates += 1
+        self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
+        if _trace.ENABLED:
+            _trace.inc("engine.mux_replayed_updates", mux=self._label, tenant=tenant)
+
+    def _replay_updates(self, target: Any, args: tuple, kwargs: dict) -> None:
+        for m in self._per_batch_metrics(target):
+            filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
+            m.update(*args, **filtered)
+        if self._is_collection:
+            target._sync_group_states()
+
+    # ------------------------------------------------------------------ alert seam
+
+    def _evaluate_alerts(self, tenants: Iterable[str], force: bool = False) -> None:
+        """Per-committed-group value-health evaluation (``config.alert_engine``):
+        sample each committed tenant's values sync-free under its session, then
+        run the rules. A broken engine warns once and the stream keeps flowing."""
+        engine = self.config.alert_engine
+        if engine is None:
+            return
+        self._alert_commits += 1
+        if not force and self._alert_commits % self.config.alert_every:
+            return
+        try:
+            log_hook = getattr(engine, "_log", None)
+            log = log_hook() if callable(log_hook) else None
+            for tenant in tenants:
+                with _scope.session(tenant):
+                    _values.sample_local(self._metrics[tenant], log=log)
+            engine.evaluate()
+        except Exception as err:
+            if not self._alert_warned:
+                self._alert_warned = True
+                rank_zero_warn(
+                    f"Alert evaluation failed on the {self._label} multiplexer"
+                    f" ({type(err).__name__}: {err}). The stream keeps flowing; further"
+                    " failures are silent (this warning fires once) and value watchdogs"
+                    " may be stale.",
+                    RuntimeWarning,
+                )

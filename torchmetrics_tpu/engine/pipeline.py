@@ -151,6 +151,16 @@ class PipelineConfig:
             (default) disables the seam entirely.
         alert_every: evaluate the alert engine every Nth committed chunk
             (``close()`` always runs a final evaluation).
+        admission: an :class:`~torchmetrics_tpu.obs.scope.AdmissionController`
+            consulted per ingested batch when the pipeline is a **tenant
+            session**: over-quota batches are shed (dropped, counted) or
+            deferred (held, drained at ``close()`` or when the tenant falls
+            back under quota), per the tenant's quota policy. ``None`` falls
+            back to the process-wide controller
+            (:func:`~torchmetrics_tpu.obs.scope.get_admission`); untenanted
+            pipelines never consult admission.
+        max_deferred: cap on the deprioritized backlog (deferred batches hold
+            real device arrays); past it, defer decisions degrade to shed.
     """
 
     fuse: int = 8
@@ -164,6 +174,8 @@ class PipelineConfig:
     tenant: Optional[str] = None
     alert_engine: Any = None
     alert_every: int = 1
+    admission: Any = None
+    max_deferred: int = 1024
 
     def __post_init__(self) -> None:
         if self.tenant is not None:
@@ -180,6 +192,8 @@ class PipelineConfig:
             raise ValueError(f"Expected `flight_max_dumps` >= 0, got {self.flight_max_dumps}")
         if self.alert_every < 1:
             raise ValueError(f"Expected `alert_every` >= 1, got {self.alert_every}")
+        if self.max_deferred < 1:
+            raise ValueError(f"Expected `max_deferred` >= 1, got {self.max_deferred}")
         if self.fuse_buckets is not None:
             buckets = tuple(sorted(set(int(b) for b in self.fuse_buckets)))
             if not buckets or buckets[0] < 1:
@@ -191,12 +205,7 @@ class PipelineConfig:
     def buckets(self) -> Tuple[int, ...]:
         if self.fuse_buckets is not None:
             return self.fuse_buckets
-        out, b = [], 1
-        while b < self.fuse:
-            out.append(b)
-            b *= 2
-        out.append(self.fuse)
-        return tuple(out)
+        return _warmup.pow2_buckets(self.fuse)
 
 
 @dataclass
@@ -218,6 +227,9 @@ class PipelineReport:
     prefetch_misses: int = 0
     inflight_waits: int = 0
     flight_dumps: int = 0  # flight-recorder fault dumps written
+    shed_batches: int = 0  # admission: over-quota batches dropped (tenant sessions)
+    deferred_batches: int = 0  # admission: batches deprioritized (held)
+    deferred_replayed: int = 0  # deferred batches later ingested
 
     def host_dispatches(self) -> int:
         """Total host dispatches that advanced metric state."""
@@ -428,6 +440,8 @@ class MetricPipeline:
         self._alert_engine = config.alert_engine
         self._alert_commits = 0
         self._alert_warned = False
+        self._deferred: List[Tuple[tuple, dict]] = []  # admission-deprioritized batches
+        self._shed_warned = False
         self._tenant: Optional[str] = None
         self._tenant_closed = False
         if config.tenant is not None:
@@ -542,9 +556,18 @@ class MetricPipeline:
             self._check_buffer_overflow()
 
     def close(self) -> PipelineReport:
-        """Flush, drain the in-flight window, and return the final report."""
+        """Flush (deferred backlog included), drain the in-flight window, and
+        return the final report."""
         try:
             with self._tenant_ctx():
+                # admission-deprioritized batches land now, after in-quota
+                # traffic — deprioritized, never silently lost
+                if self._tenant is not None:
+                    self._drain_deferred(
+                        self.config.admission
+                        if self.config.admission is not None
+                        else _scope.get_admission()
+                    )
                 self.flush()
                 while self._inflight:
                     jax.block_until_ready(self._inflight.popleft())
@@ -668,7 +691,65 @@ class MetricPipeline:
 
         return jax.tree_util.tree_map(_put, (args, kwargs))
 
-    def _ingest(self, args: tuple, kwargs: dict, stages: Optional[Dict[str, float]] = None) -> None:
+    def _drain_deferred(self, controller: Any) -> None:
+        """Re-ingest the deprioritized backlog in order (admission decisions
+        bypassed — the work executes regardless — but executed updates are
+        still billed). Shared by the back-under-quota path and close()."""
+        while self._deferred:
+            args, kwargs = self._deferred.pop(0)
+            self._report.deferred_replayed += 1
+            if controller is not None:
+                controller.charge(self._tenant, updates=1)
+            self._ingest(args, kwargs, bypass_admission=True)
+
+    def _ingest(
+        self,
+        args: tuple,
+        kwargs: dict,
+        stages: Optional[Dict[str, float]] = None,
+        bypass_admission: bool = False,
+    ) -> None:
+        if self._tenant is not None and not bypass_admission:
+            # cost-aware admission (obs/scope.py): only tenant SESSIONS are
+            # metered — an untenanted pipeline never consults the controller,
+            # so the default path stays one branch
+            controller = (
+                self.config.admission
+                if self.config.admission is not None
+                else _scope.get_admission()
+            )
+            if controller is not None:
+                decision = controller.admit(self._tenant)
+                if decision == _scope.DEFER and len(self._deferred) >= self.config.max_deferred:
+                    # a full backlog holds real device arrays: degrade to
+                    # shed instead of growing memory without bound — and tell
+                    # the controller, whose admit() counted this as deferred
+                    controller.note_degraded_shed(self._tenant)
+                    decision = _scope.SHED
+                if decision == _scope.SHED:
+                    self._report.shed_batches += 1
+                    if not self._shed_warned:
+                        self._shed_warned = True
+                        rank_zero_warn(
+                            f"Tenant {self._tenant!r} is over quota: this pipeline's"
+                            " batches are being SHED (dropped, counted in"
+                            " tenant.quota_shed). This warning fires once per pipeline;"
+                            " the burn state is on GET /tenants.",
+                            RuntimeWarning,
+                        )
+                    if _trace.ENABLED:
+                        _trace.inc("engine.shed_batches", pipeline=self._label)
+                    return
+                if decision == _scope.DEFER:
+                    self._deferred.append((args, kwargs))
+                    self._report.deferred_batches += 1
+                    if _trace.ENABLED:
+                        _trace.inc("engine.deferred_batches", pipeline=self._label)
+                    return
+                # back under quota: the deferred backlog drains first so the
+                # tenant's stream order is preserved
+                self._drain_deferred(controller)
+                controller.charge(self._tenant, updates=1)
         if _faults.update_faults_active():
             # injected faults apply ONCE per ingested batch, at the pipeline
             # seam; downstream metric.update calls are told not to re-apply

@@ -19,9 +19,13 @@ it:
 
 The actual precompiles are driven by :meth:`MetricPipeline.warmup
 <torchmetrics_tpu.engine.pipeline.MetricPipeline.warmup>` (which lowers every
-fused shape-bucket variant plus the per-batch replay path through
-:meth:`StaticLeafJit.warmup <torchmetrics_tpu.core.jit.StaticLeafJit.warmup>`),
-using the helpers here for cache wiring and manifest assembly.
+fused shape-bucket variant plus the per-batch replay path) and
+:meth:`TenantMultiplexer.warmup
+<torchmetrics_tpu.engine.mux.TenantMultiplexer.warmup>` (every tenant-width
+bucket of the cross-tenant fused program, manifest entries ``kind: "mux"``),
+both through :meth:`StaticLeafJit.warmup
+<torchmetrics_tpu.core.jit.StaticLeafJit.warmup>`, using the helpers here for
+cache wiring, the shared :func:`pow2_buckets` ladder, and manifest assembly.
 """
 
 from __future__ import annotations
@@ -44,8 +48,28 @@ __all__ = [
     "configured_cache_dir",
     "load_manifest",
     "persistent_cache_stats",
+    "pow2_buckets",
     "save_manifest",
 ]
+
+
+def pow2_buckets(cap: int) -> tuple:
+    """The engine's shared bucket ladder: powers of two up to (and including)
+    ``cap``, with ``cap`` itself always the top bucket.
+
+    One discipline, two axes: the streaming pipeline buckets fused *chunk
+    lengths* and the tenant multiplexer buckets fused *tenant widths* with the
+    same ladder, so both keep their compiled-variant count ``O(log cap)`` per
+    signature instead of one program per observed size.
+    """
+    if cap < 1:
+        raise ValueError(f"Expected `cap` >= 1, got {cap}")
+    out, b = [], 1
+    while b < cap:
+        out.append(b)
+        b *= 2
+    out.append(int(cap))
+    return tuple(out)
 
 CACHE_ENV_VAR = "TM_TPU_COMPILE_CACHE"
 MANIFEST_SCHEMA = 1

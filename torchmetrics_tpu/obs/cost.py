@@ -483,6 +483,37 @@ class CostLedger:
                 row["bytes_per_dispatch"] = None
         return rollup
 
+    def fn_estimate(self, fn: str) -> Dict[str, Optional[float]]:
+        """Per-dispatch cost estimate for one wrapped-function label.
+
+        The admission plane's pricing read
+        (:class:`~torchmetrics_tpu.obs.scope.AdmissionController`): the mean
+        per-dispatch flops / bytes-accessed across the ledger entries whose
+        ``fn`` matches (``None`` when the backend reported no analysis), plus
+        the summed compile seconds those variants cost. Matching is exact on
+        the ``fn`` label — the multiplexer's fused programs all share one
+        label, so one read prices a whole dispatch family.
+        """
+        flops: List[float] = []
+        bytes_accessed: List[float] = []
+        compile_seconds = 0.0
+        variants = 0
+        for entry in self.entries():
+            if entry.fn != fn:
+                continue
+            variants += 1
+            compile_seconds += entry.compile_seconds or 0.0
+            if entry.flops is not None:
+                flops.append(entry.flops)
+            if entry.bytes_accessed is not None:
+                bytes_accessed.append(entry.bytes_accessed)
+        return {
+            "variants": variants,
+            "compile_seconds": round(compile_seconds, 6),
+            "flops_per_dispatch": sum(flops) / len(flops) if flops else None,
+            "bytes_per_dispatch": sum(bytes_accessed) / len(bytes_accessed) if bytes_accessed else None,
+        }
+
     def top(self, sort: str = "flops", top_k: int = 20) -> List[Dict[str, Any]]:
         """Top-K variant rows by ``sort`` (see :data:`SORT_KEYS`), largest first."""
         attr = SORT_KEYS.get(sort)

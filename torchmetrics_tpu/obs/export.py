@@ -285,6 +285,21 @@ _GAUGE_HELP = {
     "tenant.last_activity_age_seconds": "Wall-clock seconds since this tenant's last recorded activity",
     "tenant.registered": "Tenants currently in the bounded tenant registry (cap: max_tenants)",
     "tenant.overflow_collapsed": "Distinct past-cap tenant names collapsed into the __overflow__ bucket",
+    # cost-aware admission families (obs/scope.py AdmissionController): quota
+    # pressure per tenant, with tenant.quota_exceeded the AlertRule-compatible
+    # 0/1 signal (threshold series rules turn it into a firing alert)
+    "tenant.quota_exceeded": "1 while the tenant's current window burn is at/over a quota limit, 0 otherwise",
+    "tenant.quota_burn_ratio": "Max used/limit ratio across the tenant's metered quota dimensions this window",
+    "tenant.quota_shed": "Lifetime update batches dropped for this tenant by over-quota shed decisions",
+    "tenant.quota_deferred": "Lifetime update batches deprioritized for this tenant by over-quota defer decisions",
+    "tenant.quota_window_updates": "Update batches admitted for this tenant in the current quota window",
+    "tenant.quota_window_flops": "Estimated flops billed to this tenant in the current quota window (cost-ledger priced)",
+    "tenant.quota_window_bytes": "Estimated bytes-accessed billed to this tenant in the current quota window",
+    "tenant.quota_window_compile_seconds": "XLA compile wall-seconds billed to this tenant in the current quota window",
+    # cross-tenant multiplexer families (engine/mux.py): one fused vmap
+    # dispatch folds many tenants' same-signature updates
+    "engine.mux_width": "Tenant count of the multiplexer's last fused dispatch (pre-padding)",
+    "engine.mux_open_groups": "Same-signature tenant groups currently accumulating in the multiplexer",
 }
 
 

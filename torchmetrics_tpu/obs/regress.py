@@ -178,6 +178,11 @@ def run_record(
         # trajectory accumulates across rounds, never judged by
         # check_regressions — exactly the `memory` passthrough pattern
         record["engine"] = engine
+    mux = result.get("mux")
+    if isinstance(mux, dict):
+        # cross-tenant multiplexer stats (per-side compiled variants, speedup
+        # vs per-tenant pipelines, dispatch widths): same passthrough contract
+        record["mux"] = mux
     cost = result.get("cost")
     if isinstance(cost, dict):
         # XLA cost-ledger summary (per-config variants compiled + estimated

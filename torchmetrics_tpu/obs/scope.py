@@ -26,6 +26,21 @@ acme-prod is quarantining batches". This module is that attribution plane:
   tracks pick them up with no further wiring; ``GET /tenants``
   (:mod:`~torchmetrics_tpu.obs.server`) serves the registry table live.
 
+- :class:`TenantQuota` / :class:`AdmissionController` — the **cost-aware
+  admission plane** on top of the attribution: per-tenant budgets
+  (updates / estimated flops / estimated bytes / compile-seconds per rolling
+  window, priced by the :mod:`~torchmetrics_tpu.obs.cost` ledger's
+  per-dispatch estimates) with an over-quota policy of ``"shed"`` (drop,
+  counted, loud once) or ``"defer"`` (deprioritize: hold until the window
+  rolls or the stream closes). The serving layers — tenant
+  :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline` sessions and the
+  cross-tenant :class:`~torchmetrics_tpu.engine.mux.TenantMultiplexer` —
+  consult :func:`get_admission` per fed batch; decisions surface as
+  ``tenant.quota_*`` gauges (``tenant.quota_exceeded`` is deliberately
+  :class:`~torchmetrics_tpu.obs.alerts.AlertRule`-compatible: a ``threshold``
+  series rule over it turns quota pressure into a firing alert) and as
+  quota/burn columns on ``GET /tenants``.
+
 The disabled path is one branch: :data:`ENABLED` stays ``False`` until the
 first tenant is registered (a scope entered, a metric adopted, a pipeline
 configured), and every hook in the hot paths guards on it — a process that
@@ -40,17 +55,25 @@ import time
 import warnings
 from contextlib import contextmanager
 from contextvars import ContextVar
-from typing import Any, Dict, Iterator, List, Optional
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 __all__ = [
+    "ADMIT",
     "DEFAULT_MAX_TENANTS",
+    "DEFER",
     "ENABLED",
     "OVERFLOW_TENANT",
+    "SHED",
+    "AdmissionController",
+    "TenantQuota",
     "TenantRegistry",
     "adopt",
     "configure",
     "current_tenant",
+    "get_admission",
     "get_registry",
+    "install_admission",
     "note_compute",
     "note_update",
     "record_gauges",
@@ -270,9 +293,10 @@ def reset() -> None:
     so suites that exercise tenancy call this to leave the next suite the
     pristine one-branch disabled path.
     """
-    global ENABLED
+    global ENABLED, _ADMISSION
     _REGISTRY.clear()
     _REGISTRY.max_tenants = DEFAULT_MAX_TENANTS
+    _ADMISSION = None
     ENABLED = False
 
 
@@ -374,6 +398,301 @@ def tag(labels: Dict[str, Any]) -> Dict[str, Any]:
     return labels
 
 
+# --------------------------------------------------------------------- admission
+
+# admission decisions (AdmissionController.admit return values)
+ADMIT = "admit"
+SHED = "shed"
+DEFER = "defer"
+
+
+@dataclass
+class TenantQuota:
+    """One tenant's budget per rolling window — the promises admission enforces.
+
+    All limits are optional (``None`` = unmetered on that dimension); a quota
+    with no limits admits everything but still tracks burn. ``flops`` and
+    ``bytes`` are *estimated* costs — the cost ledger's per-dispatch XLA
+    ``cost_analysis`` numbers, dispatch-weighted — so enforcement is
+    prediction-priced, not profiler-priced (the honest option on a host where
+    per-tenant wall time cannot be isolated from shared dispatches).
+
+    Args:
+        updates_per_window: admitted update batches per window.
+        flops_per_window: estimated flops per window.
+        bytes_per_window: estimated bytes-accessed per window.
+        compile_seconds_per_window: XLA compile wall-seconds billed to the
+            tenant per window (fresh variants its traffic forced).
+        window_seconds: rolling-window length; burn resets when it elapses.
+        over_quota: ``"shed"`` drops over-quota batches (counted, loud once
+            per tenant — the warn_skip pattern); ``"defer"`` deprioritizes
+            them (held until the window rolls under quota or the stream
+            closes).
+    """
+
+    updates_per_window: Optional[float] = None
+    flops_per_window: Optional[float] = None
+    bytes_per_window: Optional[float] = None
+    compile_seconds_per_window: Optional[float] = None
+    window_seconds: float = 60.0
+    over_quota: str = SHED
+
+    # burn-dimension name -> the quota field bounding it
+    _DIMENSIONS = (
+        ("updates", "updates_per_window"),
+        ("flops", "flops_per_window"),
+        ("bytes", "bytes_per_window"),
+        ("compile_seconds", "compile_seconds_per_window"),
+    )
+
+    def __post_init__(self) -> None:
+        if self.over_quota not in (SHED, DEFER):
+            raise ValueError(
+                f"Expected `over_quota` of {SHED!r} or {DEFER!r}, got {self.over_quota!r}"
+            )
+        if self.window_seconds <= 0:
+            raise ValueError(f"Expected positive `window_seconds`, got {self.window_seconds}")
+        for _, field in self._DIMENSIONS:
+            limit = getattr(self, field)
+            if limit is not None and limit <= 0:
+                raise ValueError(f"Expected positive `{field}` (or None), got {limit}")
+
+    def limits(self) -> Dict[str, float]:
+        """The metered dimensions only: ``{dimension: limit}``."""
+        out = {}
+        for dim, field in self._DIMENSIONS:
+            limit = getattr(self, field)
+            if limit is not None:
+                out[dim] = float(limit)
+        return out
+
+
+class AdmissionController:
+    """Per-tenant quota enforcement over rolling burn windows (thread-safe).
+
+    The control loop the serving layers consult per fed batch:
+    :meth:`admit` answers ``"admit"`` / ``"shed"`` / ``"defer"`` from the
+    tenant's current window burn vs its quota, and :meth:`charge` is how the
+    dispatch layers bill work back (updates always; estimated flops/bytes and
+    compile seconds when the cost ledger priced the executed variant). Burn
+    state is bounded by the tenant registry's own cap discipline: windows
+    exist only for tenants with a quota (explicit or default) that have seen
+    traffic.
+
+    ``tenant.quota_exceeded`` flips are written to the recorder at decision
+    time (not only at scrape time) so a ``threshold`` series
+    :class:`~torchmetrics_tpu.obs.alerts.AlertRule` watching it fires
+    mid-stream.
+    """
+
+    def __init__(
+        self,
+        default_quota: Optional[TenantQuota] = None,
+        clock: Any = time.monotonic,
+    ) -> None:
+        self._lock = threading.Lock()
+        self.default_quota = default_quota
+        self._clock = clock
+        self._quotas: Dict[str, TenantQuota] = {}
+        self._windows: Dict[str, Dict[str, float]] = {}
+        self._shed: Dict[str, int] = {}
+        self._deferred: Dict[str, int] = {}
+        self._exceeded: Dict[str, bool] = {}  # last reported state per tenant
+
+    def set_quota(self, tenant: str, quota: TenantQuota) -> "AdmissionController":
+        validate_tenant(tenant)
+        with self._lock:
+            self._quotas[tenant] = quota
+        return self
+
+    def quota_for(self, tenant: str) -> Optional[TenantQuota]:
+        with self._lock:
+            return self._quotas.get(tenant, self.default_quota)
+
+    def _window(self, tenant: str, quota: TenantQuota) -> Dict[str, float]:
+        """The tenant's live burn window (lock held); rolls when elapsed."""
+        now = self._clock()
+        window = self._windows.get(tenant)
+        if window is None or now - window["start"] >= quota.window_seconds:
+            window = {"start": now, "updates": 0.0, "flops": 0.0, "bytes": 0.0, "compile_seconds": 0.0}
+            self._windows[tenant] = window
+        return window
+
+    @staticmethod
+    def _burn(window: Dict[str, float], quota: TenantQuota) -> Dict[str, Any]:
+        limits = quota.limits()
+        ratios = {dim: window[dim] / limit for dim, limit in limits.items()}
+        burn_ratio = max(ratios.values()) if ratios else 0.0
+        return {
+            "used": {dim: window[dim] for dim, _ in TenantQuota._DIMENSIONS},
+            "limits": limits,
+            "burn_ratio": burn_ratio,
+            "exceeded": burn_ratio >= 1.0,
+        }
+
+    def charge(
+        self,
+        tenant: str,
+        updates: float = 0.0,
+        flops: float = 0.0,
+        bytes_accessed: float = 0.0,
+        compile_seconds: float = 0.0,
+    ) -> None:
+        """Bill executed work to the tenant's current window (unmetered
+        tenants — no quota anywhere — are not tracked at all)."""
+        quota = self.quota_for(tenant)
+        if quota is None:
+            return
+        with self._lock:
+            window = self._window(tenant, quota)
+            window["updates"] += updates
+            window["flops"] += flops
+            window["bytes"] += bytes_accessed
+            window["compile_seconds"] += compile_seconds
+
+    def admit(self, tenant: str, recorder: Optional[Any] = None) -> str:
+        """The per-batch decision: :data:`ADMIT`, :data:`SHED` or :data:`DEFER`.
+
+        Over-quota is *current window burn already at/over a limit* — the
+        batch that would cross the line is still admitted (its charge tips
+        the window), so enforcement never needs to predict a batch's cost
+        before running it.
+        """
+        quota = self.quota_for(tenant)
+        if quota is None:
+            return ADMIT
+        with self._lock:
+            window = self._window(tenant, quota)
+            exceeded = self._burn(window, quota)["exceeded"]
+            if exceeded:
+                decision = quota.over_quota
+                if decision == SHED:
+                    self._shed[tenant] = self._shed.get(tenant, 0) + 1
+                else:
+                    self._deferred[tenant] = self._deferred.get(tenant, 0) + 1
+            else:
+                decision = ADMIT
+            flipped = self._exceeded.get(tenant) != exceeded
+            self._exceeded[tenant] = exceeded
+        if flipped:
+            # the AlertRule-compatible signal, written on the EDGE (a
+            # threshold series rule sees pressure start and end mid-stream,
+            # without waiting for a scrape); tenant=... is explicit so an
+            # ambient scope can never mis-attribute the flip
+            import torchmetrics_tpu.obs.trace as trace  # lazy: scope stays cycle-free
+
+            rec = recorder if recorder is not None else trace.get_recorder()
+            rec.set_gauge("tenant.quota_exceeded", 1.0 if exceeded else 0.0, tenant=tenant)
+            if trace.ENABLED:
+                trace.event(
+                    "tenant.quota_" + ("exceeded" if exceeded else "recovered"),
+                    tenant=tenant,
+                    decision=decision,
+                )
+        return decision
+
+    def note_degraded_shed(self, tenant: str, recorder: Optional[Any] = None) -> None:
+        """Reclassify one DEFER decision as SHED (full-backlog degrade).
+
+        :meth:`admit` already counted the batch as deferred when it answered
+        ``"defer"``; a caller whose backlog is full drops the batch instead —
+        this keeps the controller's (and so ``tenant.quota_shed`` /
+        ``/tenants``) accounting truthful about the loss.
+        """
+        with self._lock:
+            if self._deferred.get(tenant, 0) > 0:
+                self._deferred[tenant] -= 1
+            self._shed[tenant] = self._shed.get(tenant, 0) + 1
+
+    # -------------------------------------------------------------- inspection
+
+    def shed_count(self, tenant: str) -> int:
+        with self._lock:
+            return self._shed.get(tenant, 0)
+
+    def deferred_count(self, tenant: str) -> int:
+        with self._lock:
+            return self._deferred.get(tenant, 0)
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        """Per-tenant quota/burn rows — the ``GET /tenants`` join.
+
+        Covers every tenant with an explicit quota or live burn window:
+        current-window used/limits/burn_ratio, the exceeded flag, the
+        over-quota policy, and lifetime shed/deferred totals. **Read-only**:
+        scrapes never create or roll windows — a tenant whose window has
+        elapsed (or that never saw traffic) reports zero burn without
+        mutating enforcement state.
+        """
+        empty = {"start": 0.0, "updates": 0.0, "flops": 0.0, "bytes": 0.0, "compile_seconds": 0.0}
+        with self._lock:
+            now = self._clock()
+            tenants = set(self._quotas) | set(self._windows) | set(self._shed) | set(self._deferred)
+            rows: Dict[str, Dict[str, Any]] = {}
+            for tenant in tenants:
+                quota = self._quotas.get(tenant, self.default_quota)
+                if quota is None:
+                    continue
+                window = self._windows.get(tenant)
+                if window is None or now - window["start"] >= quota.window_seconds:
+                    window, age = empty, 0.0  # elapsed/absent: zero burn, no write
+                else:
+                    age = max(0.0, now - window["start"])
+                rows[tenant] = {
+                    "tenant": tenant,
+                    "window_seconds": quota.window_seconds,
+                    "window_age_seconds": age,
+                    "over_quota_policy": quota.over_quota,
+                    "shed": self._shed.get(tenant, 0),
+                    "deferred": self._deferred.get(tenant, 0),
+                    **self._burn(window, quota),
+                }
+        return rows
+
+    def record_gauges(self, recorder: Optional[Any] = None) -> int:
+        """Write ``tenant.quota_*`` gauges into the recorder; returns row count.
+
+        Families (all labeled ``{tenant}``): ``tenant.quota_exceeded`` (the
+        alert-compatible 0/1 signal), ``tenant.quota_burn_ratio`` (max
+        used/limit across metered dimensions), ``tenant.quota_shed`` /
+        ``tenant.quota_deferred`` (lifetime decisions), and per-dimension
+        ``tenant.quota_window_*`` burn.
+        """
+        import torchmetrics_tpu.obs.trace as trace  # lazy: scope stays cycle-free
+
+        rec = recorder if recorder is not None else trace.get_recorder()
+        rows = self.status()
+        for tenant, row in rows.items():
+            labels = {"tenant": tenant}
+            rec.set_gauge("tenant.quota_exceeded", 1.0 if row["exceeded"] else 0.0, **labels)
+            rec.set_gauge("tenant.quota_burn_ratio", float(row["burn_ratio"]), **labels)
+            rec.set_gauge("tenant.quota_shed", float(row["shed"]), **labels)
+            rec.set_gauge("tenant.quota_deferred", float(row["deferred"]), **labels)
+            for dim in ("updates", "flops", "bytes", "compile_seconds"):
+                rec.set_gauge(f"tenant.quota_window_{dim}", float(row["used"][dim]), **labels)
+        return len(rows)
+
+
+_ADMISSION: Optional[AdmissionController] = None
+
+
+def install_admission(controller: Optional[AdmissionController]) -> Optional[AdmissionController]:
+    """Install (or clear, with ``None``) the process-wide admission controller.
+
+    The engine layers resolve it per fed batch via :func:`get_admission`, so
+    installing mid-stream starts enforcing on the next batch; ``/tenants``
+    joins its quota/burn rows. Returns the controller for chaining.
+    """
+    global _ADMISSION
+    _ADMISSION = controller
+    return controller
+
+
+def get_admission() -> Optional[AdmissionController]:
+    """The installed admission controller, or ``None`` (everything admitted)."""
+    return _ADMISSION
+
+
 def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
     """Write per-tenant liveness/cardinality gauges into the recorder.
 
@@ -421,4 +740,13 @@ def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
     # splitting the totals into per-tenant variants
     rec.set_gauge("tenant.registered", float(len(rows)), tenant=None)
     rec.set_gauge("tenant.overflow_collapsed", float(_REGISTRY.overflow_names), tenant=None)
-    return {"tenants": len(rows), "overflow_collapsed": _REGISTRY.overflow_names}
+    quota_rows = 0
+    if _ADMISSION is not None:
+        # the admission plane's quota/burn gauges refresh alongside the
+        # registry's: one scrape shows who is active AND who is over budget
+        quota_rows = _ADMISSION.record_gauges(recorder=rec)
+    return {
+        "tenants": len(rows),
+        "overflow_collapsed": _REGISTRY.overflow_names,
+        "quota_rows": quota_rows,
+    }

@@ -31,8 +31,9 @@ dependencies, localhost by default:
   model); firing alerts also flip ``/healthz`` to degraded with the offending
   metric and rule named.
 - ``GET /tenants`` — the tenant registry (:mod:`~torchmetrics_tpu.obs.scope`):
-  per-tenant liveness, series cardinality, state-memory bytes, estimated cost
-  and firing alerts, JSON. ``/metrics``, ``/alerts``, ``/memory`` and
+  per-tenant liveness, series cardinality, state-memory bytes, estimated cost,
+  firing alerts and — with an admission controller installed — quota/burn
+  state (window burn, burn ratio, exceeded flag, shed/deferred totals), JSON. ``/metrics``, ``/alerts``, ``/memory`` and
   ``/snapshot`` additionally accept ``?tenant=<name>`` for a scoped view
   (404 on a tenant the registry has never seen), and a degraded ``/healthz``
   names the offending tenant(s) under ``tenants_degraded``.
@@ -427,8 +428,10 @@ class IntrospectionServer:
 
     def tenants_report(self) -> Dict[str, Any]:
         """The /tenants page: the bounded registry joined with per-tenant
-        series cardinality, state-memory bytes, estimated cost and firing
-        alerts — the table an operator scans to name a noisy tenant."""
+        series cardinality, state-memory bytes, estimated cost, firing alerts
+        and — when an admission controller is installed — quota/burn state,
+        the table an operator scans to name (and now *throttle-check*) a
+        noisy tenant."""
         registry = _scope.get_registry()
         series_counts = self.recorder.series_counts_by_label("tenant", exclude_name_prefix="tenant.")
         engine = self._evaluated_engine("/tenants")
@@ -450,11 +453,19 @@ class IntrospectionServer:
                 continue
             memory_bytes[metric_tenant] = memory_bytes.get(metric_tenant, 0) + int(fp["unique_bytes"])
         cost_rows = _cost.get_ledger().by_tenant()
+        admission = _scope.get_admission()
+        quota_rows: Dict[str, Dict[str, Any]] = {}
+        if admission is not None:
+            try:
+                quota_rows = admission.status()
+            except Exception:  # the quota join must never break the page
+                self._rec_inc("server.errors", route="/tenants(admission)")
         rows: List[Dict[str, Any]] = []
         for row in registry.rows():
             tenant = row["tenant"]
             tenant_firing = [alert for alert in firing if alert.get("tenant") == tenant]
             cost_row = cost_rows.get(tenant, {})
+            quota_row = quota_rows.pop(tenant, None)
             rows.append(
                 {
                     **row,
@@ -470,8 +481,16 @@ class IntrospectionServer:
                     "est_bytes_per_dispatch": cost_row.get("bytes_per_dispatch"),
                     "alerts_firing": len(tenant_firing),
                     "firing_rules": sorted({alert["rule"] for alert in tenant_firing}),
+                    # quota/burn (obs.scope.AdmissionController): null when
+                    # the tenant is unmetered — absence of quota is visible,
+                    # not rendered as a zero budget
+                    "quota": quota_row,
                 }
             )
+        # quotas configured for tenants the registry has not seen yet still
+        # render (an operator pre-provisioning budgets can read them back)
+        for tenant, quota_row in sorted(quota_rows.items()):
+            rows.append({"tenant": tenant, "quota": quota_row, "registered": False})
         return {
             "enabled": _scope.ENABLED,
             "n_tenants": len(rows),
@@ -479,6 +498,10 @@ class IntrospectionServer:
             "overflow": {
                 "collapsed_names": registry.overflow_names,
                 "registrations": registry.overflow_registrations,
+            },
+            "admission": {
+                "enabled": admission is not None,
+                "metered_tenants": sum(1 for row in rows if row.get("quota") is not None),
             },
             "tenants": rows,
         }
