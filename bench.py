@@ -1002,10 +1002,16 @@ def _chaos_main(argv) -> None:
     parser.add_argument("--chaos-tenants", type=int, default=8)
     parser.add_argument("--chaos-seed", type=int, default=0)
     parser.add_argument(
-        "--chaos-scenario", choices=("default", "high_tenant"), default="default",
+        "--chaos-scenario", choices=("default", "high_tenant", "rolling_deploy"),
+        default="default",
         help="high_tenant: >=64 tenants with shared signatures and bursty arrivals,"
              " replayed through the cross-tenant multiplexer and judged against the"
-             " high-tenant SLO spec (configs prefixed chaos_ht_*)",
+             " high-tenant SLO spec (configs prefixed chaos_ht_*)."
+             " rolling_deploy: one 'host' is killed mid-traffic and its tenant"
+             " sessions migrate to the survivor via the live-session"
+             " checkpoint/restore protocol, judged against the rolling-deploy SLO"
+             " spec incl. bit-identity vs unmigrated controls (configs prefixed"
+             " chaos_rd_*)",
     )
     parser.add_argument(
         "--chaos-schedule", default=None,
@@ -1062,6 +1068,12 @@ def _chaos_main(argv) -> None:
             sched, chaos.ReplayConfig(multiplex=True, mux_max_width=len(sched.tenants))
         )
         report = chaos.judge(result, chaos.high_tenant_slo_spec(), prefix="chaos_ht")
+    elif args.chaos_scenario == "rolling_deploy":
+        # the live-migration scenario: host B is killed mid-traffic, its tenant
+        # sessions drain→checkpoint→restore→replay-tail onto the survivor with
+        # shadow controls proving bit-identity; own prefix, own baselines
+        result = chaos.replay(sched, chaos.ReplayConfig(rolling_deploy=True))
+        report = chaos.judge(result, chaos.rolling_deploy_slo_spec(), prefix="chaos_rd")
     else:
         result = chaos.replay(sched)
         report = chaos.judge(result)
@@ -1094,6 +1106,8 @@ def _chaos_main(argv) -> None:
             "scenario": args.chaos_scenario,
             # cross-tenant fused dispatch accounting (None when unmultiplexed)
             "mux": result["mux"],
+            # live-migration accounting (None unless rolling_deploy)
+            "migration": result.get("migration"),
         },
     }
     print(json.dumps(line, sort_keys=True, default=str))

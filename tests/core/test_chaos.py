@@ -521,7 +521,9 @@ class TestHighTenantPreset:
     def test_high_tenant_spec_shape(self):
         spec = chaos_slo.high_tenant_slo_spec()
         assert spec.require_multiplexed and spec.require_quarantine_attributed
-        assert not spec.require_poisoned_named  # the mux has no flight recorder
+        # the mux flight recorder landed: poisoned batches must be NAMED in
+        # dumps again, same standard as per-tenant pipelines
+        assert spec.require_poisoned_named
         assert spec.max_compiled_variants < 160  # tighter than the default
 
 
@@ -550,7 +552,7 @@ class TestMultiplexedReplay:
         )
         result = replay(sched, config)
         spec = chaos_slo.SLOSpec(
-            require_poisoned_named=False,
+            require_poisoned_named=True,  # the mux flight recorder names batches now
             require_multiplexed=True,
             require_quarantine_attributed=True,
         )
@@ -567,12 +569,26 @@ class TestMultiplexedReplay:
         assert mux["report"]["fused_updates"] > mux["report"]["dispatches"] > 0
         assert mux["report"]["max_width"] > 1  # real cross-tenant grouping
 
-    def test_poison_isolated_to_owning_tenant_without_dumps(self, run):
+    def test_poison_isolated_to_owning_tenant_and_named_in_mux_dump(self, run):
         sched, result, _ = run
         poisoned_guarded = [
             tenant for tenant in sched.poisoned() if tenant != sched.victim
         ]
         assert result["robust"]["quarantined"] == {tenant: 1 for tenant in poisoned_guarded}
+        # the mux flight recorder names the poisoned batch with its tenant-local
+        # index — dump-evidence parity with the per-tenant pipeline recorder
+        named = {
+            (dump["tenant"], idx)
+            for dump in result["flight"]["dumps"]
+            for idx in dump["poisoned_batches"]
+        }
+        expected = {
+            (tenant, idx)
+            for tenant, indices in sched.poisoned().items()
+            if tenant != sched.victim
+            for idx in indices
+        }
+        assert expected and expected <= named
 
     def test_fault_watchdogs_fire_and_resolve_through_the_mux(self, run):
         _, _, report = run
@@ -586,3 +602,149 @@ class TestMultiplexedReplay:
         # under one-per-(tenant × signature)
         n_sigs = len(sched.config.batch_sizes)
         assert result["cost"]["compiled_variants"] < len(sched.tenants) * n_sigs
+
+
+# ------------------------------------------------------ rolling-deploy scenario
+
+
+class TestRollingDeployJudge:
+    """The migration SLO rows over fabricated results (fast, no replay)."""
+
+    def _mig_result(self, **overrides):
+        migration = {
+            "tenants": ["tenant-02", "tenant-03"],
+            "migration_seconds": 1.2,
+            "healthz_named_migrating": True,
+            "controls": {
+                "tenant-02": {"restored": 0.5, "control": 0.5, "bit_identical": True},
+                "tenant-03": {"restored": 0.25, "control": 0.25, "bit_identical": True},
+            },
+            "zero_loss": True,
+        }
+        migration.update(overrides)
+        return _fake_result(migration=migration)
+
+    def _spec(self):
+        return chaos_slo.rolling_deploy_slo_spec()
+
+    def test_spec_shape(self):
+        spec = self._spec()
+        assert spec.require_migration_zero_loss and spec.require_migration_visible
+        assert spec.max_migration_seconds is not None
+        assert spec.require_poisoned_named  # ordinary chaos SLOs keep holding
+
+    def test_passing_migration(self):
+        report = chaos_slo.judge(self._mig_result(), self._spec(), prefix="chaos_rd")
+        assert report["passed"], chaos_slo.format_report(report)
+        assert report["configs"]["chaos_rd_slo_pass"]["value"] == 1.0
+        assert report["configs"]["chaos_rd_migrated_tenants"]["value"] == 2.0
+        assert report["configs"]["chaos_rd_migration_seconds"]["value"] == pytest.approx(1.2)
+
+    def test_diverged_control_fails_zero_loss(self):
+        result = self._mig_result(
+            controls={
+                "tenant-02": {"restored": 0.5, "control": 0.5, "bit_identical": True},
+                "tenant-03": {"restored": 0.25, "control": 0.3, "bit_identical": False},
+            }
+        )
+        report = chaos_slo.judge(result, self._spec(), prefix="chaos_rd")
+        assert "migration_zero_loss" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "migration_zero_loss")
+        assert "tenant-03" in row["detail"]
+
+    def test_no_migration_at_all_fails(self):
+        report = chaos_slo.judge(
+            self._mig_result(tenants=[], controls={}), self._spec(), prefix="chaos_rd"
+        )
+        assert "migration_zero_loss" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "migration_zero_loss")
+        assert "never happened" in row["detail"]
+
+    def test_invisible_migration_fails(self):
+        report = chaos_slo.judge(
+            self._mig_result(healthz_named_migrating=False), self._spec(), prefix="chaos_rd"
+        )
+        assert "migration_visible_degraded" in report["failed"]
+
+    def test_slow_migration_fails_budget(self):
+        result = self._mig_result(migration_seconds=99.0)
+        report = chaos_slo.judge(result, self._spec(), prefix="chaos_rd")
+        assert "migration_seconds" in report["failed"]
+
+    def test_default_spec_ignores_migration_section(self):
+        # the default scenario's judge must not grow migration rows
+        report = chaos_slo.judge(self._mig_result())
+        assert not any(r["slo"].startswith("migration") for r in report["slos"])
+
+    def test_rolling_deploy_config_validation(self):
+        with pytest.raises(ValueError, match="rolling_deploy"):
+            ReplayConfig(rolling_deploy=True, multiplex=True)
+        with pytest.raises(ValueError, match="migrate_fraction"):
+            ReplayConfig(rolling_deploy=True, migrate_fraction=0.0)
+
+
+class TestRollingDeployEndToEnd:
+    @pytest.fixture(scope="class")
+    def run(self):
+        """One real rolling deploy: host B killed mid-traffic, its tenant
+        sessions migrated live to the survivor, chaos continuing throughout."""
+        sched = chaos_schedule.generate(
+            ScheduleConfig(
+                seed=0,
+                tenants=8,
+                warm_batches=2,
+                churn_batches=2,
+                drain_batches=3,
+                hang_seconds=0.5,
+                absent_after_seconds=0.15,
+                idle_gap_seconds=0.01,
+            )
+        )
+        config = ReplayConfig(
+            rolling_deploy=True,
+            fuse=2,
+            scrape_interval_seconds=0.03,
+            sync_timeout_seconds=0.02,
+        )
+        result = replay(sched, config)
+        report = chaos_slo.judge(
+            result, chaos_slo.rolling_deploy_slo_spec(), prefix="chaos_rd"
+        )
+        return sched, result, report
+
+    def test_rolling_deploy_passes_all_slos(self, run):
+        _, _, report = run
+        assert report["passed"], chaos_slo.format_report(report)
+
+    def test_migrated_sessions_bit_identical_to_controls(self, run):
+        _, result, _ = run
+        migration = result["migration"]
+        assert migration["zero_loss"] is True
+        assert len(migration["tenants"]) >= 1
+        for tenant, row in migration["controls"].items():
+            assert row["bit_identical"], (tenant, row)
+
+    def test_fault_surfaces_survive_the_deploy(self, run):
+        sched, result, report = run
+        # the victim/hung/poisoned tenants stayed on host A: their watchdogs
+        # fired AND resolved through the migration window
+        for fault in ("poison", "hang"):
+            assert report["configs"][f"chaos_rd_time_to_fire_{fault}"]["value"] >= 0.0
+            assert report["configs"][f"chaos_rd_time_to_resolve_{fault}"]["value"] >= 0.0
+        assert set(migrated := result["migration"]["tenants"]).isdisjoint(
+            {sched.victim, sched.hung}
+        ), migrated
+
+    def test_healthz_named_migrating_tenant_mid_flight(self, run):
+        _, result, _ = run
+        assert result["migration"]["healthz_named_migrating"] is True
+
+    def test_migrated_tenants_keep_serving_after_restore(self, run):
+        sched, result, _ = run
+        # every migrated tenant's pipeline report covers its FULL schedule
+        # traffic: pre-migration batches (restored accounting) + post-restore
+        per_tenant = {
+            ev["tenant"]: ev["index"] + 1 for ev in sched.batches()
+        }  # last index + 1 = total batches
+        for tenant in result["migration"]["tenants"]:
+            assert result["pipelines"][tenant]["batches"] == per_tenant[tenant]

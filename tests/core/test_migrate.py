@@ -1,0 +1,629 @@
+"""Live-session checkpoint/restore battery (marker: ``engine``).
+
+Covers ``torchmetrics_tpu.engine.migrate``: the drain→checkpoint→restore→
+replay-tail protocol's zero-loss promise (restored sessions compute
+BIT-identical to unmigrated controls, across metric families and
+collections), loud rejection of corrupt/truncated/schema-mismatched bundles
+without poisoning the restoring process, round-trip of the non-pipeline
+session state (alert state machines with dwell clocks, value timelines with
+step anchors, ``sync_degraded``, the flight ring, the report, the registry
+row), the admission-deferred replay tail, and the degraded-not-dead
+``/healthz`` view of a migration in flight.
+
+Everything is CPU-deterministic and fast: tiny batches, no sleeps beyond an
+injectable clock, no network beyond the loopback introspection server.
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.engine import (
+    MetricPipeline,
+    PipelineConfig,
+    SessionBundleError,
+    checkpoint_session,
+    restore_session,
+    verify_bundle,
+)
+from torchmetrics_tpu.engine import migrate as migrate_mod
+from torchmetrics_tpu.obs import scope as obs_scope
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.obs import values as obs_values
+from torchmetrics_tpu.obs.alerts import AlertEngine, AlertRule
+from torchmetrics_tpu.obs.values import ValueLog
+from torchmetrics_tpu.regression import MeanSquaredError
+
+pytestmark = pytest.mark.engine
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_values.disable()
+    obs_values.get_log().clear()
+    obs_scope.reset()
+    yield
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_values.disable()
+    obs_values.get_log().clear()
+    obs_scope.reset()
+
+
+def _class_batches(n, batch=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(batch, classes).astype(np.float32)),
+            jnp.asarray(rng.randint(0, classes, batch)),
+        )
+        for _ in range(n)
+    ]
+
+
+def _mean_batches(n, size=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.rand(size).astype(np.float32)),) for _ in range(n)]
+
+
+def _bits(value):
+    arr = np.asarray(value)
+    return (str(arr.dtype), arr.tobytes())
+
+
+def _tree_bits(value):
+    if isinstance(value, dict):
+        return {k: _tree_bits(v) for k, v in value.items()}
+    return _bits(value)
+
+
+# ---------------------------------------------------------------- zero loss
+
+
+class TestZeroLossRoundTrip:
+    @pytest.mark.parametrize(
+        "factory,batches",
+        [
+            (
+                lambda: MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                _class_batches(10),
+            ),
+            (lambda: MeanMetric(), _mean_batches(10)),
+        ],
+        ids=["accuracy", "mean"],
+    )
+    def test_restored_session_is_bit_identical_to_unmigrated_control(
+        self, tmp_path, factory, batches
+    ):
+        control = factory()
+        cpipe = MetricPipeline(control, PipelineConfig(fuse=4, tenant="ctl"))
+        for b in batches:
+            cpipe.feed(*b)
+        cpipe.close()
+
+        origin = factory()
+        pipe = MetricPipeline(origin, PipelineConfig(fuse=4, tenant="mig"))
+        for b in batches[:6]:
+            pipe.feed(*b)
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+
+        restored = factory()
+        pipe2, manifest = restore_session(restored, str(tmp_path / "bundle"))
+        assert manifest["cursor"]["batches_ingested"] == 6
+        for b in batches[6:]:
+            pipe2.feed(*b)
+        pipe2.close()
+        assert _bits(restored.compute()) == _bits(control.compute())
+
+    def test_collection_round_trip_bit_identical(self, tmp_path):
+        batches = _class_batches(9, seed=3)
+
+        def factory():
+            return MetricCollection(
+                {
+                    "acc": MulticlassAccuracy(num_classes=4, average="micro", validate_args=False),
+                    "f1": MulticlassF1Score(num_classes=4, average="macro", validate_args=False),
+                }
+            )
+
+        control = factory()
+        cpipe = MetricPipeline(control, PipelineConfig(fuse=4))
+        for b in batches:
+            cpipe.feed(*b)
+        cpipe.close()
+
+        origin = factory()
+        pipe = MetricPipeline(origin, PipelineConfig(fuse=4))
+        for b in batches[:5]:
+            pipe.feed(*b)
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+
+        restored = factory()
+        pipe2, manifest = restore_session(restored, str(tmp_path / "bundle"))
+        assert sorted(manifest["members"]) == ["acc", "f1"]
+        for b in batches[5:]:
+            pipe2.feed(*b)
+        pipe2.close()
+        assert _tree_bits(restored.compute()) == _tree_bits(control.compute())
+
+    def test_checkpoint_drains_open_chunk_and_counts_cursor(self, tmp_path):
+        batches = _class_batches(5)
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=8))
+        for b in batches:
+            pipe.feed(*b)  # 5 < fuse: the chunk is still open
+        manifest = checkpoint_session(pipe, str(tmp_path / "bundle"))
+        # drain dispatched the open chunk: state holds all 5, tail is empty
+        assert manifest["cursor"]["batches_ingested"] == 5
+        assert manifest["cursor"]["tail_batches"] == 0
+        assert metric.update_count == 5
+        pipe.close()
+
+    def test_caller_buffered_tail_rides_the_bundle(self, tmp_path):
+        batches = _class_batches(8, seed=1)
+        control = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        for b in batches:
+            control.update(*b)
+
+        origin = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(origin, PipelineConfig(fuse=4))
+        for b in batches[:6]:
+            pipe.feed(*b)
+        # the router buffered two arrivals while the drain was in flight
+        manifest = checkpoint_session(pipe, str(tmp_path / "bundle"), tail=batches[6:])
+        assert manifest["cursor"]["tail_batches"] == 2
+        pipe.close()
+
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        pipe2.close()
+        assert _bits(restored.compute()) == _bits(control.compute())
+
+    def test_tail_replay_bills_and_balances_deferred_accounting(self, tmp_path):
+        clock = [0.0]
+        origin_controller = obs_scope.AdmissionController(clock=lambda: clock[0])
+        origin_controller.set_quota(
+            "bill-t",
+            obs_scope.TenantQuota(
+                updates_per_window=2, window_seconds=60.0, over_quota=obs_scope.DEFER
+            ),
+        )
+        batches = _class_batches(5, seed=11)
+        origin = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            origin, PipelineConfig(fuse=2, tenant="bill-t", admission=origin_controller)
+        )
+        for b in batches:
+            pipe.feed(*b)
+        origin_report = pipe.report()
+        assert origin_report.deferred_batches == 3
+        manifest = checkpoint_session(pipe, str(tmp_path / "bundle"))
+        assert manifest["cursor"]["deferred_tail"] == 3
+        pipe.close()
+
+        # the restoring host has its own (generous) controller: the replayed
+        # tail burns quota WHERE IT RUNS, and the deferred ledger balances
+        restore_controller = obs_scope.AdmissionController(clock=lambda: clock[0])
+        restore_controller.set_quota(
+            "bill-t",
+            obs_scope.TenantQuota(updates_per_window=100, window_seconds=60.0),
+        )
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(
+            restored, str(tmp_path / "bundle"), admission=restore_controller
+        )
+        report = pipe2.report()
+        assert report.deferred_replayed == report.deferred_batches == 3
+        assert restore_controller.status()["bill-t"]["used"]["updates"] == 3.0
+        pipe2.flush()  # the tail re-enters the fusion plane; flush folds the open chunk
+        assert restored.update_count == 5
+        pipe2.close()
+
+    def test_deferred_backlog_is_the_replay_tail(self, tmp_path):
+        clock = [0.0]
+        controller = obs_scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "deferred-t",
+            obs_scope.TenantQuota(
+                updates_per_window=3, window_seconds=60.0, over_quota=obs_scope.DEFER
+            ),
+        )
+        batches = _class_batches(6, seed=2)
+        control = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        for b in batches:
+            control.update(*b)
+
+        origin = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            origin, PipelineConfig(fuse=2, tenant="deferred-t", admission=controller)
+        )
+        for b in batches:
+            pipe.feed(*b)
+        report = pipe.report()
+        assert report.deferred_batches > 0  # some batches are parked over-quota
+        manifest = checkpoint_session(pipe, str(tmp_path / "bundle"))
+        assert manifest["cursor"]["tail_batches"] == report.deferred_batches
+        pipe.close()
+
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        # the restoring host has no admission controller: the tail replays
+        # unconditionally (it was admitted before the checkpoint)
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        pipe2.close()
+        assert _bits(restored.compute()) == _bits(control.compute())
+
+
+# ------------------------------------------------------------ loud rejection
+
+
+class TestBundleRejection:
+    def _bundle(self, tmp_path, n_fed=4):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="rej"))
+        for b in _class_batches(n_fed):
+            pipe.feed(*b)
+        path = str(tmp_path / "bundle")
+        checkpoint_session(pipe, path)
+        pipe.close()
+        return path
+
+    def _fresh(self):
+        return MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+
+    def test_missing_bundle_rejected(self, tmp_path):
+        with pytest.raises(SessionBundleError, match="No session bundle"):
+            verify_bundle(str(tmp_path / "nope"))
+
+    def test_flipped_byte_in_state_rejected_without_poisoning_target(self, tmp_path):
+        path = self._bundle(tmp_path)
+        with open(os.path.join(path, "state.npz"), "r+b") as fh:
+            fh.seek(12)
+            byte = fh.read(1)
+            fh.seek(12)
+            fh.write(bytes([byte[0] ^ 0xFF]))
+        target = self._fresh()
+        with pytest.raises(SessionBundleError, match="integrity check"):
+            restore_session(target, path)
+        # the restoring process is untouched: no state landed, no session opened
+        assert target.update_count == 0
+        assert len(obs_scope.get_registry()) == 1  # only the checkpoint's tenant
+
+    def test_truncated_manifest_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        manifest_path = os.path.join(path, "MANIFEST.json")
+        text = open(manifest_path).read()
+        with open(manifest_path, "w") as fh:
+            fh.write(text[: len(text) // 2])
+        with pytest.raises(SessionBundleError, match="integrity check"):
+            restore_session(self._fresh(), path)
+
+    def test_missing_integrity_record_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        os.remove(os.path.join(path, "INTEGRITY.json"))
+        with pytest.raises(SessionBundleError, match="no INTEGRITY.json"):
+            verify_bundle(path)
+
+    def test_schema_mismatch_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        manifest_path = os.path.join(path, "MANIFEST.json")
+        manifest = json.load(open(manifest_path))
+        manifest["schema_version"] = migrate_mod.SESSION_SCHEMA + 1
+        with open(manifest_path, "w") as fh:
+            json.dump(manifest, fh)
+        # keep the digest honest so ONLY the schema gate fires
+        from torchmetrics_tpu.utils.checkpoint import file_tree_digest
+
+        digest = file_tree_digest(path, exclude=("INTEGRITY.json",))
+        with open(os.path.join(path, "INTEGRITY.json"), "w") as fh:
+            json.dump({"version": 1, "sha256": digest}, fh)
+        with pytest.raises(SessionBundleError, match="schema"):
+            verify_bundle(path)
+
+    def test_wrong_metric_class_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        with pytest.raises(SessionBundleError, match="MulticlassAccuracy"):
+            restore_session(MeanSquaredError(), path)
+
+    def test_extra_file_smuggled_into_bundle_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        with open(os.path.join(path, "extra.bin"), "wb") as fh:
+            fh.write(b"\x00")
+        with pytest.raises(SessionBundleError, match="integrity check"):
+            verify_bundle(path)
+
+    def test_checkpoint_overwrites_atomically(self, tmp_path):
+        path = self._bundle(tmp_path, n_fed=4)
+        # a second checkpoint to the SAME path swaps in whole
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="rej2"))
+        for b in _class_batches(2, seed=9):
+            pipe.feed(*b)
+        checkpoint_session(pipe, path)
+        pipe.close()
+        manifest = verify_bundle(path)
+        assert manifest["tenant"] == "rej2"
+        assert manifest["cursor"]["batches_ingested"] == 2
+        # no stray .tmp/.old siblings masquerade next to the bundle
+        siblings = [p for p in os.listdir(tmp_path) if p != "bundle"]
+        assert siblings == []
+
+
+# ------------------------------------- non-pipeline session state round-trip
+
+
+class TestSessionStateRoundTrip:
+    def test_alert_state_machines_resume_with_dwell_clocks(self, tmp_path):
+        clock = [1000.0]
+        log = ValueLog()
+        engine = AlertEngine(
+            rules=[
+                AlertRule(name="nan-watch", kind="non_finite", metric="MeanMetric"),
+                AlertRule(
+                    name="slow-burn",
+                    kind="threshold",
+                    series="engine.batches",
+                    above=0.5,
+                    for_seconds=30.0,
+                ),
+            ],
+            value_log=log,
+            clock=lambda: clock[0],
+        )
+        # machine 1 FIRING: a NaN value landed
+        log.record("MeanMetric", "0", "value", 3, float("nan"))
+        # machine 2 PENDING mid-dwell: the threshold breached at t=1000
+        trace.get_recorder().inc("engine.batches", 2.0)
+        engine.evaluate()
+        assert {a["state"] for a in engine.active()} == {"firing", "pending"}
+
+        metric = MeanMetric()
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="alerts-t", alert_engine=engine))
+        for b in _mean_batches(3):
+            pipe.feed(*b)
+        checkpoint_session(pipe, str(tmp_path / "bundle"), value_log=log)
+        pipe.close()
+
+        # "another host": a fresh engine with the same injectable clock
+        clock2 = [clock[0] + 10.0]  # 10s of the 30s dwell elapsed in transit
+        log2 = ValueLog()
+        engine2 = AlertEngine(value_log=log2, clock=lambda: clock2[0])
+        restored = MeanMetric()
+        pipe2, _ = restore_session(
+            restored, str(tmp_path / "bundle"), alert_engine=engine2, value_log=log2
+        )
+        # rules came across, live machines resumed in their exact states
+        assert {r.name for r in engine2.rules()} >= {"nan-watch", "slow-burn"}
+        states = {a["rule"]: a for a in engine2.active()}
+        assert states["nan-watch"]["state"] == "firing"
+        assert states["slow-burn"]["state"] == "pending"
+        assert states["slow-burn"]["since"] == 1000.0  # the ORIGIN's breach stamp
+        # the dwell continues, not restarts: 21 more seconds completes the 30
+        trace.get_recorder().inc("engine.batches", 2.0)
+        clock2[0] = 1000.0 + 31.0
+        transitions = engine2.evaluate()
+        fired = [t for t in transitions if t["rule"] == "slow-burn" and t["to"] == "firing"]
+        assert fired, transitions
+        pipe2.close()
+
+    def test_history_restore_merges_by_timestamp_not_append_order(self):
+        # an engine that already holds transitions NEWER than the snapshot's
+        # (shared engine; origin records aged out of its own ring) must merge
+        # by wall stamp — an old resolve appended at the tail would pair with
+        # the newer fire into a negative time_to_resolve episode
+        engine = AlertEngine()
+        engine._history.append(
+            {"rule": "r", "series": "s", "from": "inactive", "to": "firing", "at": 200.0}
+        )
+        snapshot = {
+            "rules": [],
+            "alerts": [],
+            "history": [
+                {"rule": "r", "series": "s", "from": "inactive", "to": "firing", "at": 50.0},
+                {"rule": "r", "series": "s", "from": "firing", "to": "resolved", "at": 60.0},
+            ],
+        }
+        engine.restore_state(snapshot)
+        assert [r["at"] for r in engine.history()] == [50.0, 60.0, 200.0]
+        episodes = engine.fire_resolve_times()
+        for episode in episodes:
+            if episode["time_to_resolve"] is not None:
+                assert episode["time_to_resolve"] >= 0.0
+        # the old episode resolved; the newer fire is still open
+        assert episodes[0]["time_to_resolve"] == pytest.approx(10.0)
+        assert episodes[1]["resolved_at"] is None
+
+    def test_value_timelines_keep_step_anchors(self, tmp_path):
+        log = ValueLog()
+        engine = AlertEngine(value_log=log)
+        metric = MeanMetric()
+        pipe = MetricPipeline(
+            metric, PipelineConfig(fuse=2, tenant="values-t", alert_engine=engine, alert_every=1)
+        )
+        for b in _mean_batches(5):
+            pipe.feed(*b)
+        pipe.flush()
+        origin_series = [row for row in log.series() if row["tenant"] == "values-t"]
+        assert origin_series and origin_series[0]["points"]
+        checkpoint_session(pipe, str(tmp_path / "bundle"), value_log=log)
+        pipe.close()
+
+        log2 = ValueLog()
+        restored = MeanMetric()
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"), value_log=log2)
+        restored_series = [row for row in log2.series() if row["tenant"] == "values-t"]
+        assert restored_series
+        by_leaf = {row["leaf"]: row["points"] for row in restored_series}
+        for row in origin_series:
+            # every point survives with its (step, wall, value) anchor intact
+            assert [tuple(p) for p in by_leaf[row["leaf"]]] == [tuple(p) for p in row["points"]]
+        pipe2.close()
+
+    def test_sync_degraded_survives_save_restore(self, tmp_path):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="deg-t"))
+        for b in _class_batches(3):
+            pipe.feed(*b)
+        metric.sync_degraded = True  # a degraded collective happened mid-epoch
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, manifest = restore_session(restored, str(tmp_path / "bundle"))
+        assert restored.sync_degraded is True
+        assert manifest["robust"][""]["sync_degraded"] is True
+        pipe2.close()
+
+    def test_robust_counters_ride_the_bundle(self, tmp_path):
+        metric = MulticlassAccuracy(
+            num_classes=4, average="micro", validate_args=False, error_policy="quarantine"
+        )
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="rob-t", flight_records=16))
+        batches = _class_batches(4)
+        poisoned = (jnp.asarray(np.full((16, 4), np.nan, np.float32)), batches[0][1])
+        with pytest.warns(RuntimeWarning):
+            for b in batches[:2] + [poisoned] + batches[2:]:
+                pipe.feed(*b)
+        pipe.flush()
+        assert metric.updates_quarantined == 1
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+
+        restored = MulticlassAccuracy(
+            num_classes=4, average="micro", validate_args=False, error_policy="quarantine"
+        )
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        assert restored.updates_quarantined == 1
+        assert restored.updates_ok == 4
+        pipe2.close()
+
+    def test_flight_ring_and_report_continue(self, tmp_path):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="fl-t", flight_records=8))
+        for b in _class_batches(5):
+            pipe.feed(*b)
+        pipe.flush()
+        origin_records = pipe.flight_records()
+        origin_report = pipe.report()
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        ring = pipe2.flight_records()
+        assert [r["batch_index"] for r in ring] == [r["batch_index"] for r in origin_records]
+        report = pipe2.report()
+        assert report.batches == origin_report.batches
+        assert report.dispatches == origin_report.dispatches
+        # new traffic continues the session's ordinals, not the process's
+        pipe2.feed(*_class_batches(1, seed=7)[0])
+        assert pipe2.report().batches == origin_report.batches + 1
+        assert pipe2.flight_records()[-1]["batch_index"] == origin_report.batches
+        pipe2.close()
+
+    def test_registry_row_merges_on_restore(self, tmp_path):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="reg-t"))
+        for b in _class_batches(4):
+            pipe.feed(*b)
+        pipe.flush()
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+        origin_row = next(
+            row for row in obs_scope.get_registry().rows() if row["tenant"] == "reg-t"
+        )
+        assert origin_row["updates"] == 4
+
+        obs_scope.reset()  # "another host": a pristine registry
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        row = next(row for row in obs_scope.get_registry().rows() if row["tenant"] == "reg-t")
+        # lifetime counts carried across the migration; the session is live
+        assert row["updates"] >= 4
+        assert row["active_pipelines"] == 1
+        assert row["first_seen_unix"] <= origin_row["first_seen_unix"]
+        pipe2.close()
+
+
+# -------------------------------------------------------- operator visibility
+
+
+class TestMigrationVisibility:
+    def test_healthz_names_migrating_tenant_degraded_not_dead(self):
+        from torchmetrics_tpu.obs.server import IntrospectionServer
+
+        server = IntrospectionServer(metrics=[])
+        try:
+            assert server.health()["status"] == "ok"
+            with obs_scope.migration("moving-t", "checkpoint"):
+                health = server.health()
+                assert health["status"] == "degraded"
+                assert health["tenants_migrating"] == {"moving-t": "checkpoint"}
+                assert "moving-t" in health["tenants_degraded"]
+                assert any("migration in flight" in r for r in health["reasons"])
+            assert server.health()["status"] == "ok"
+            assert server.health()["tenants_migrating"] == {}
+        finally:
+            server.stop()
+
+    def test_migration_phases_nest_innermost_wins(self):
+        with obs_scope.migration("t", "rolling_deploy"):
+            with obs_scope.migration("t", "restore"):
+                assert obs_scope.migrating_tenants() == {"t": "restore"}
+            assert obs_scope.migrating_tenants() == {"t": "rolling_deploy"}
+        assert obs_scope.migrating_tenants() == {}
+
+    def test_checkpoint_announces_migration(self, tmp_path, monkeypatch):
+        seen = {}
+        original = obs_scope.migration
+
+        def spy(tenant, phase="migrating"):
+            seen[tenant] = phase
+            return original(tenant, phase)
+
+        monkeypatch.setattr(migrate_mod._scope, "migration", spy)
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, tenant="ann-t"))
+        pipe.feed(*_class_batches(1)[0])
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+        assert seen == {"ann-t": "checkpoint"}
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        assert seen == {"ann-t": "restore"}
+        pipe2.close()
+
+
+# ------------------------------------------------------------- warmup story
+
+
+class TestRestoreWarmup:
+    def test_restored_pipeline_warmup_runs_and_manifests(self, tmp_path):
+        batches = _class_batches(4)
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=4, tenant="wm-t"))
+        pipe.warmup(*batches[0])
+        for b in batches:
+            pipe.feed(*b)
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(restored, str(tmp_path / "bundle"))
+        # the restored session precompiles the same (bucket, signature)
+        # variants; with TM_TPU_COMPILE_CACHE shared (tests/conftest.py wires
+        # a hermetic one) the XLA work is persistent-cache reads — PERF.md
+        # carries the wall-clock methodology, here we assert the seam works
+        manifest = pipe2.warmup(*batches[0])
+        assert manifest["variants"] > 0
+        assert manifest["cache_dir"] is not None
+        pipe2.close()

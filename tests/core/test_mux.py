@@ -755,6 +755,307 @@ class TestTelemetryAndScope:
         assert firing[0]["tenant"] == "acme"
 
 
+# ------------------------------------------------------------- flight recorder
+
+
+class TestMuxFlightRecorder:
+    def _guarded_mux(self, tmp_path, **cfg):
+        make = lambda: MulticlassAccuracy(  # noqa: E731
+            num_classes=4, validate_args=False, error_policy="quarantine"
+        )
+        return TenantMultiplexer(
+            make, MuxConfig(max_width=4, flight_dump_dir=str(tmp_path), **cfg)
+        )
+
+    def test_poisoned_row_dumps_named_tenant_local_batch(self, tmp_path):
+        import json
+
+        mux = self._guarded_mux(tmp_path)
+        batches = _class_batches(3, classes=4, seed=170)
+        poisoned = (
+            jnp.asarray(np.full((16, 4), np.nan, np.float32)),
+            batches[0][1],
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i in range(3):
+                for t in ("t-a", "t-b"):
+                    if t == "t-b" and i == 1:
+                        mux.feed(t, *poisoned)
+                    else:
+                        mux.feed(t, *batches[i])
+            mux.close()
+        assert mux.report().flight_dumps == 1
+        assert len(mux.flight_dumps) == 1
+        with open(mux.flight_dumps[0], encoding="utf-8") as fh:
+            lines = [json.loads(line) for line in fh]
+        meta, records = lines[0], lines[1:]
+        # the dump is attributed to the OWNING tenant with ITS local ordinal
+        assert meta["tenant"] == "t-b"
+        assert meta["poisoned_batches"] == [1]
+        assert meta["reason"] == "group_replay"
+        assert meta["pipeline"] == "TenantMultiplexer"
+        # the ring ships cross-tenant context: t-a's rows ride along
+        assert {r["tenant"] for r in records} == {"t-a", "t-b"}
+        faulted = [r for r in records if r["fault"] == "quarantined"]
+        assert len(faulted) == 1
+        assert faulted[0]["tenant"] == "t-b" and faulted[0]["batch_index"] == 1
+        assert faulted[0]["path"] == "replay"
+        # isolation held: the neighbor lost nothing
+        assert mux.metric("t-a").updates_quarantined == 0
+        assert mux.metric("t-b").updates_quarantined == 1
+
+    def test_two_poisoned_tenants_get_one_dump_each(self, tmp_path):
+        import json
+
+        mux = self._guarded_mux(tmp_path)
+        batches = _class_batches(2, classes=4, seed=171)
+        nan_preds = jnp.asarray(np.full((16, 4), np.nan, np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            mux.feed("t-a", nan_preds, batches[0][1])
+            mux.feed("t-b", nan_preds, batches[0][1])
+            mux.feed("t-c", *batches[0])
+            mux.close()
+        assert mux.report().flight_dumps == 2
+        owners = set()
+        for path in mux.flight_dumps:
+            with open(path, encoding="utf-8") as fh:
+                meta = json.loads(fh.readline())
+            owners.add(meta["tenant"])
+            assert meta["poisoned_batches"] == [0]
+        assert owners == {"t-a", "t-b"}
+
+    def test_fused_rows_carry_group_lineage(self, tmp_path):
+        mux = self._guarded_mux(tmp_path)
+        batches = _class_batches(1, classes=4, seed=172)
+        mux.feed("t-a", *batches[0])
+        mux.feed("t-b", *batches[0])
+        mux.flush()
+        records = mux.flight_records()
+        assert [r["tenant"] for r in records] == ["t-a", "t-b"]
+        assert all(r["path"] == "mux" for r in records)
+        assert all(r["signature"] is not None for r in records)
+        # both rows fused into the same group
+        assert records[0]["chunk_id"] == records[1]["chunk_id"]
+        mux.close()
+
+    def test_ring_is_bounded_and_disableable(self, tmp_path):
+        mux = self._guarded_mux(tmp_path, flight_records=4)
+        batches = _class_batches(1, classes=4, seed=173)
+        for i in range(7):
+            mux.feed(f"t-{i}", *batches[0])
+        mux.flush()
+        records = mux.flight_records()
+        assert len(records) == 4  # drop-oldest past capacity
+        assert [r["tenant"] for r in records] == ["t-3", "t-4", "t-5", "t-6"]
+        mux.close()
+
+        off = TenantMultiplexer(
+            lambda: MulticlassAccuracy(num_classes=4, validate_args=False),
+            MuxConfig(max_width=2, flight_records=0),
+        )
+        off.feed("t-x", *batches[0])
+        off.flush()
+        assert off.flight_records() == [] and off.flight_dumps == []
+        off.close()
+
+    def test_replay_driver_collects_mux_dumps(self):
+        """The chaos replay result's flight section now includes mux dumps —
+        the seam behind flipping require_poisoned_named for the multiplexed
+        scenarios (the full end-to-end lives in test_chaos.py)."""
+        from torchmetrics_tpu.chaos.slo import high_tenant_slo_spec
+
+        spec = high_tenant_slo_spec()
+        assert spec.require_poisoned_named is True  # the gap this PR closes
+        assert spec.require_quarantine_attributed is True
+
+
+# ------------------------------------------------------- time-based readmission
+
+
+class TestTimeBasedReadmission:
+    def test_would_admit_is_read_only(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        assert controller.would_admit("slow") is True
+        controller.charge("slow", updates=1)  # burn hits the 1/window limit
+        assert controller.would_admit("slow") is False
+        # probing created no decisions and rolled no windows
+        assert controller.deferred_count("slow") == 0
+        assert controller.shed_count("slow") == 0
+        clock[0] = 200.0  # window elapsed
+        assert controller.would_admit("slow") is True
+        # ...and the probe did NOT create a fresh window
+        assert controller.status()["slow"]["window_age_seconds"] == 0.0
+        # unmetered tenants always pass
+        assert controller.would_admit("unknown") is True
+
+    def test_idle_deferred_tenant_drains_on_other_tenants_traffic(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        make = lambda: MeanMetric(nan_strategy="ignore")  # noqa: E731
+        mux = TenantMultiplexer(
+            make,
+            MuxConfig(max_width=2, admission=controller, readmit_check_seconds=0.0),
+        )
+        mux.adopt("slow")
+        mux.adopt("calm")
+        batches = _value_batches(3, seed=180)
+        mux.feed("slow", *batches[0])  # admitted (burn -> 1/1)
+        mux.feed("slow", *batches[1])  # deferred; "slow" then goes IDLE forever
+        assert mux.report().deferred_batches == 1
+        clock[0] = 200.0  # the quota window rolls while slow is idle
+        mux.feed("calm", *batches[2])  # someone ELSE's traffic...
+        # ...drained the idle tenant's backlog (no slow feed, no close needed)
+        assert mux.report().deferred_replayed == 1
+        mux.flush()
+        assert mux.metric("slow")._update_count == 2
+        mux.close()
+
+    def test_mux_poll_admission_drains_without_any_traffic(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        mux = TenantMultiplexer(
+            lambda: MeanMetric(nan_strategy="ignore"),
+            MuxConfig(max_width=2, admission=controller),
+        )
+        mux.adopt("slow")
+        batches = _value_batches(2, seed=181)
+        mux.feed("slow", *batches[0])
+        mux.feed("slow", *batches[1])  # deferred
+        assert mux.poll_admission() == 0  # still over quota: nothing drains
+        clock[0] = 200.0
+        assert mux.poll_admission() == 1  # the external ticker's hook
+        mux.flush()
+        assert mux.metric("slow")._update_count == 2
+        mux.close()
+
+    def test_readmit_interval_gates_the_per_feed_sweep(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        mux = TenantMultiplexer(
+            lambda: MeanMetric(nan_strategy="ignore"),
+            # a huge interval: per-feed sweeps are gated off; only the
+            # forced paths (flush/poll/close) may drain
+            MuxConfig(max_width=2, admission=controller, readmit_check_seconds=1e6),
+        )
+        mux.adopt("slow")
+        mux.adopt("calm")
+        batches = _value_batches(3, seed=182)
+        mux.feed("slow", *batches[0])
+        mux.feed("slow", *batches[1])  # deferred
+        clock[0] = 200.0
+        mux.feed("calm", *batches[2])  # sweep suppressed by the interval gate
+        assert mux.report().deferred_replayed == 0
+        assert mux.poll_admission() == 1  # force path still works
+        mux.close()
+
+    def test_pipeline_flush_readmits_idle_backlog(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        data = _pair_batches(3, seed=183)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pipe = MetricPipeline(
+                MeanSquaredError(),
+                PipelineConfig(fuse=2, tenant="slow", admission=controller),
+            )
+            pipe.feed(*data[0])  # admitted
+            pipe.feed(*data[1])  # deferred
+            pipe.feed(*data[2])  # deferred
+        assert pipe.report().deferred_batches == 2
+        pipe.flush()  # still over quota: backlog stays parked
+        assert pipe.report().deferred_replayed == 0
+        clock[0] = 200.0  # window rolls while the tenant is idle
+        pipe.flush()  # wall-clock re-admission drains it
+        report = pipe.report()
+        assert report.deferred_replayed == 2
+        assert pipe.metric._update_count == 3
+        pipe.close()
+
+    def test_pipeline_poll_admission_is_the_external_hook(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        data = _pair_batches(2, seed=184)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pipe = MetricPipeline(
+                MeanSquaredError(),
+                PipelineConfig(fuse=2, tenant="slow", admission=controller),
+            )
+            pipe.feed(*data[0])
+            pipe.feed(*data[1])  # deferred
+        assert pipe.poll_admission() == 0
+        clock[0] = 200.0
+        assert pipe.poll_admission() == 1
+        assert pipe.metric._update_count == 2
+        pipe.close()
+
+    def test_probe_less_controller_stays_conservative(self):
+        """A duck-typed controller without `would_admit` (the pre-probe
+        protocol) must not have its quota bypassed by flush/poll — the
+        backlog keeps waiting for close(), on the pipeline AND the mux."""
+
+        class LegacyController:
+            def __init__(self):
+                self.charged = 0
+
+            def admit(self, tenant):
+                return obs_scope.DEFER
+
+            def charge(self, tenant, **kw):
+                self.charged += 1
+
+        controller = LegacyController()
+        data = _pair_batches(2, seed=186)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            pipe = MetricPipeline(
+                MeanSquaredError(),
+                PipelineConfig(fuse=2, tenant="legacy", admission=controller),
+            )
+            pipe.feed(*data[0])
+            pipe.feed(*data[1])
+            assert pipe.report().deferred_batches == 2
+            pipe.flush()
+            assert pipe.poll_admission() == 0
+            assert pipe.report().deferred_replayed == 0  # quota NOT bypassed
+            pipe.close()  # close still drains — deprioritized, never lost
+        assert pipe.report().deferred_replayed == 2
+
+        mux = TenantMultiplexer(
+            lambda: MeanMetric(nan_strategy="ignore"),
+            MuxConfig(max_width=2, admission=LegacyController()),
+        )
+        mux.adopt("legacy")
+        batches = _value_batches(2, seed=187)
+        mux.feed("legacy", *batches[0])
+        mux.feed("legacy", *batches[1])
+        mux.flush()
+        assert mux.poll_admission() == 0
+        assert mux.report().deferred_replayed == 0
+        mux.close()
+        assert mux.report().deferred_replayed == 2
+
+    def test_readmitted_batches_are_billed(self):
+        clock = [0.0]
+        controller = _quota_controller(lambda: clock[0])
+        mux = TenantMultiplexer(
+            lambda: MeanMetric(nan_strategy="ignore"),
+            MuxConfig(max_width=2, admission=controller, readmit_check_seconds=0.0),
+        )
+        mux.adopt("slow")
+        batches = _value_batches(2, seed=185)
+        mux.feed("slow", *batches[0])
+        mux.feed("slow", *batches[1])  # deferred
+        clock[0] = 200.0
+        mux.poll_admission()
+        # the drained batch burned the fresh window (billed, not free)
+        assert controller.status()["slow"]["used"]["updates"] == 1.0
+        mux.close()
+
+
 # ------------------------------------------------------------- disabled overhead
 
 

@@ -81,4 +81,5 @@ def test_two_process_aggregate_battery(tmp_path):
         "degraded_keeps_partial_alert_state": True,
         "tenant_rows_merge_fleet_wide": True,
         "degraded_keeps_tenant_attribution": True,
+        "session_migrates_across_hosts_bit_identical": True,
     }

@@ -207,6 +207,79 @@ def main() -> None:
     alerts.uninstall()
     scope.reset()
 
+    # -- 9. live-session migration handoff across REAL hosts -------------------
+    # (the rolling-deploy primitive, 2-process-validated: rank 1 drains and
+    # checkpoints a live tenant pipeline session to shared disk and "dies";
+    # rank 0 restores the bundle mid-stream, feeds the remaining traffic, and
+    # its compute() is BIT-identical to rank 1's unmigrated control. The fleet
+    # aggregate then attributes the tenant on both hosts — the session moved,
+    # it did not vanish.)
+    import numpy as np
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.engine import MetricPipeline, PipelineConfig
+    from torchmetrics_tpu.engine import migrate as engine_migrate
+
+    shared = os.path.dirname(os.path.abspath(out_path))
+    bundle = os.path.join(shared, "mig_bundle")
+    expected_path = os.path.join(shared, "mig_expected.json")
+    mig_rng = np.random.RandomState(42)
+    mig_batches = [
+        (
+            jnp.asarray(mig_rng.rand(16, 4).astype(np.float32)),
+            jnp.asarray(mig_rng.randint(0, 4, 16)),
+        )
+        for _ in range(10)
+    ]
+
+    def mig_metric():
+        # sync_on_compute off: compute() must not enter a collective only one
+        # rank is running (the migration halves are deliberately asymmetric)
+        return MulticlassAccuracy(
+            num_classes=4, average="micro", validate_args=False, sync_on_compute=False
+        )
+
+    if pid == 1:
+        control = mig_metric()
+        for p_, t_ in mig_batches:
+            control.update(p_, t_)
+        expected = np.asarray(control.compute())
+        origin = mig_metric()
+        pipe = MetricPipeline(origin, PipelineConfig(fuse=4, tenant="t-mig"))
+        for p_, t_ in mig_batches[:6]:
+            pipe.feed(p_, t_)
+        engine_migrate.checkpoint_session(pipe, bundle)
+        pipe.close()
+        tmp = expected_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"dtype": str(expected.dtype), "hex": expected.tobytes().hex()}, fh)
+        os.replace(tmp, expected_path)
+    # collective barrier: the bundle + oracle are fully on shared disk before
+    # the surviving host reads them
+    aggregate()
+    if pid == 0:
+        manifest = engine_migrate.verify_bundle(bundle)
+        assert manifest["tenant"] == "t-mig"
+        assert manifest["cursor"]["batches_ingested"] == 6
+        restored = mig_metric()
+        pipe2, _ = engine_migrate.restore_session(restored, bundle)
+        for p_, t_ in mig_batches[6:]:
+            pipe2.feed(p_, t_)
+        pipe2.close()
+        got = np.asarray(restored.compute())
+        with open(expected_path) as fh:
+            oracle = json.load(fh)
+        assert str(got.dtype) == oracle["dtype"]
+        assert got.tobytes().hex() == oracle["hex"], (got.tolist(), oracle)
+    fleet = aggregate()
+    mig_rows = {row["tenant"]: row for row in fleet["tenants"]}
+    # the migrated session is attributed on BOTH hosts fleet-wide: it served
+    # on host 1, then continued (restored) on host 0
+    assert mig_rows["t-mig"]["hosts"] == [0, 1], mig_rows
+    results["session_migrates_across_hosts_bit_identical"] = True
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

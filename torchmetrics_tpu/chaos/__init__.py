@@ -33,7 +33,13 @@ from torchmetrics_tpu.chaos.schedule import (
     loads,
 )
 from torchmetrics_tpu.chaos.replay import ReplayConfig, ReplayError, replay
-from torchmetrics_tpu.chaos.slo import SLOSpec, format_report, high_tenant_slo_spec, judge
+from torchmetrics_tpu.chaos.slo import (
+    SLOSpec,
+    format_report,
+    high_tenant_slo_spec,
+    judge,
+    rolling_deploy_slo_spec,
+)
 
 __all__ = [
     "SCHEDULE_SCHEMA",
@@ -51,4 +57,5 @@ __all__ = [
     "load",
     "loads",
     "replay",
+    "rolling_deploy_slo_spec",
 ]

@@ -44,7 +44,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.obs.export import histogram_quantile, quantile_bucket
 
-__all__ = ["SLOSpec", "format_report", "high_tenant_slo_spec", "judge"]
+__all__ = [
+    "SLOSpec",
+    "format_report",
+    "high_tenant_slo_spec",
+    "judge",
+    "rolling_deploy_slo_spec",
+]
 
 
 @dataclass
@@ -67,9 +73,16 @@ class SLOSpec:
     # cross-tenant fused dispatch promises (the multiplexed scenarios):
     # the run must actually have fused across tenants, and every guarded
     # tenant's poisoned batch must be quarantined by exactly its own session
-    # (isolation without the pipeline flight recorder's dump evidence)
     require_multiplexed: bool = False
     require_quarantine_attributed: bool = False
+    # live-session migration promises (the rolling-deploy scenario): every
+    # migrated tenant's restored session must compute BIT-IDENTICAL to its
+    # unmigrated shadow control, the handoff must be operator-visible
+    # (/healthz degraded with the migrating tenant NAMED while in flight),
+    # and the whole host handoff must land inside the wall budget
+    require_migration_zero_loss: bool = False
+    require_migration_visible: bool = False
+    max_migration_seconds: Optional[float] = None
     # routes whose scrape latency is judged (the driver may scrape more)
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants")
 
@@ -88,16 +101,42 @@ def high_tenant_slo_spec() -> SLOSpec:
     victim / hung-path programs and warmup leave comfortable slack under 60,
     where the unmultiplexed same-schedule run compiles ~4–5× more (every
     tenant's own jit cache pays every signature). Poisoned-batch evidence is
-    quarantine *attribution* instead of flight-dump naming — the multiplexer
-    has no flight recorder; isolation is proven by exactly the owning tenant's
-    robust counters moving.
+    held to BOTH standards: quarantine attribution (exactly the owning
+    tenant's robust counters move) AND flight-dump naming — the multiplexer
+    now carries the per-row lineage ring + dump-on-fault, so a poisoned
+    tenant row produces a named-batch JSONL dump exactly like a per-tenant
+    pipeline's.
     """
     return SLOSpec(
         min_updates_per_second=5.0,
         max_compiled_variants=60,
-        require_poisoned_named=False,
+        require_poisoned_named=True,
         require_multiplexed=True,
         require_quarantine_attributed=True,
+    )
+
+
+def rolling_deploy_slo_spec() -> SLOSpec:
+    """The SLO spec of the rolling-deploy scenario
+    (``ReplayConfig.rolling_deploy=True``): one "host" is killed mid-traffic
+    and its tenant sessions migrate to the survivor via the live-session
+    drain→checkpoint→restore→replay-tail protocol
+    (:mod:`torchmetrics_tpu.engine.migrate`).
+
+    The promises: every migrated session's final ``compute()`` is
+    bit-identical to an unmigrated shadow control fed the same stream
+    (zero loss), the handoff window is degraded-but-visible (``/healthz``
+    names the migrating tenant mid-flight), the whole host handoff lands
+    inside a generous wall budget, and the ordinary fault SLOs (poison
+    fire/resolve, hang fire/resolve, named dumps) keep holding through the
+    deploy — chaos does not pause for the migration.
+    """
+    return SLOSpec(
+        min_updates_per_second=5.0,
+        require_poisoned_named=True,
+        require_migration_zero_loss=True,
+        require_migration_visible=True,
+        max_migration_seconds=30.0,
     )
 
 
@@ -505,6 +544,71 @@ def judge(
                 if not missed and not bled
                 else f"missed poisoned tenants {missed}; cohort bleed onto {bled}"
             ),
+        )
+
+    # --------------------------------------------- live-session migration
+    migration = result.get("migration") or {}
+    if spec.require_migration_zero_loss:
+        migrated = migration.get("tenants") or []
+        controls = migration.get("controls") or {}
+        identical = [t for t in migrated if (controls.get(t) or {}).get("bit_identical")]
+        divergent = sorted(set(migrated) - set(identical))
+        ok = bool(migrated) and not divergent
+        _row(
+            rows,
+            "migration_zero_loss",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"all {len(migrated)} migrated session(s) computed bit-identical to"
+                " their unmigrated controls"
+                if ok
+                else (
+                    f"migrated sessions diverged from their controls: {divergent}"
+                    if migrated
+                    else "no tenants were migrated (the rolling deploy never happened)"
+                )
+            ),
+        )
+        config(f"{prefix}_migrated_tenants", float(len(migrated)), "tenants", None)
+    if spec.require_migration_visible:
+        named = migration.get("healthz_named_migrating")
+        _row(
+            rows,
+            "migration_visible_degraded",
+            float(bool(named)),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                "mid-migration /healthz was degraded with the migrating tenant named"
+                if named
+                else "no mid-migration /healthz observation named the migrating tenant"
+            ),
+        )
+    if spec.max_migration_seconds is not None:
+        seconds = migration.get("migration_seconds")
+        _row(
+            rows,
+            "migration_seconds",
+            seconds,
+            spec.max_migration_seconds,
+            "s",
+            "max",
+            detail=f"{len(migration.get('tenants') or [])} session(s)"
+            " drained, checkpointed, restored and tail-replayed",
+        )
+        # handoff wall time is dominated by bundle I/O + restore compiles on
+        # the runner: like the time_to_* configs, the recorded spread makes
+        # the ABSOLUTE SLO budget the sentinel's cap
+        config(
+            f"{prefix}_migration_seconds",
+            seconds,
+            "s",
+            spec.max_migration_seconds,
+            spread={"min": 0.0, "max": spec.max_migration_seconds, "reps": 1},
         )
 
     failed = [row["slo"] for row in rows if not row["passed"]]

@@ -21,6 +21,11 @@ The execution layer between user batch streams and the ``Metric`` /
   (metric, shape-bucket, static-config) variant before the loop, JAX
   **persistent compilation cache** wiring (``TM_TPU_COMPILE_CACHE``), and the
   warmup manifest recording what startup compiled.
+- :mod:`~torchmetrics_tpu.engine.migrate` — **live-session checkpoint/restore**:
+  a running pipeline session (state + replay tail + flight ring + report +
+  registry row + value timelines + alert machines) as an atomic,
+  integrity-checked bundle; drain→checkpoint→restore→replay-tail with
+  bit-identical restores and degraded-not-dead ``/healthz`` while in flight.
 
 Quick start::
 
@@ -32,6 +37,13 @@ Quick start::
     value = metric.compute()
 """
 
+from torchmetrics_tpu.engine.migrate import (
+    SESSION_SCHEMA,
+    SessionBundleError,
+    checkpoint_session,
+    restore_session,
+    verify_bundle,
+)
 from torchmetrics_tpu.engine.mux import MuxConfig, MuxReport, TenantMultiplexer
 from torchmetrics_tpu.engine.pipeline import (
     FLIGHT_DIR_ENV,
@@ -53,17 +65,22 @@ from torchmetrics_tpu.engine.warmup import (
 __all__ = [
     "CACHE_ENV_VAR",
     "FLIGHT_DIR_ENV",
+    "SESSION_SCHEMA",
     "MetricPipeline",
     "MuxConfig",
     "MuxReport",
     "PipelineConfig",
     "PipelineReport",
+    "SessionBundleError",
     "TenantMultiplexer",
     "build_manifest",
+    "checkpoint_session",
     "configure_compile_cache",
     "configured_cache_dir",
     "load_manifest",
     "persistent_cache_stats",
     "pow2_buckets",
+    "restore_session",
     "save_manifest",
+    "verify_bundle",
 ]

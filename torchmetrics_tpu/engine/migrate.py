@@ -1,0 +1,565 @@
+"""Live-session checkpoint/restore: a running pipeline as a migratable object.
+
+PR 1's atomic SHA-256 metric checkpoints froze *state*; PR 8's tenant sessions
+made a :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline` a live,
+attributed serving object. This module composes them: a **session bundle**
+captures everything a running session *is* — not just its metric state — and
+restores it on another host with nothing lost:
+
+- **metric state**, mid-stream, via the existing ``__robust__``-aware
+  ``state_dict`` machinery (update counts, quarantine counters and
+  ``sync_degraded`` ride along), written as a plain ``state.npz`` payload +
+  JSON skeleton — deliberately **not** orbax: orbax's multihost save barrier
+  would deadlock exactly the asymmetric one-host-checkpoints-while-the-other-
+  serves handoff this module exists for;
+- the **replay tail**: the fusion/prefetch plane is drained to a cursor
+  (:meth:`MetricPipeline.drain` dispatches the open chunk and blocks the
+  in-flight window, so state is exactly the fold of every dispatched batch)
+  and the batches *behind* the cursor — the admission-deferred backlog plus
+  any caller-buffered arrivals — are persisted verbatim and re-fed after
+  restore;
+- the **flight-recorder ring** (a restored session's first fault dump still
+  carries pre-migration lineage), the **pipeline report** (accounting keeps
+  counting, not restarting), the **tenant registry row** (lifetime
+  updates/computes merge onto the restoring host), the session's **value
+  timelines** (step anchors intact) and its **alert state machines**
+  (``pending``/``firing`` resume with their dwell clocks).
+
+Durability is the hardened PR-1 writer: the whole bundle is materialized under
+a temp directory, digested file-by-file into ``INTEGRITY.json``, and swapped
+into place with the displace-then-rename loop
+(:func:`~torchmetrics_tpu.utils.checkpoint.atomic_install_dir`) — preemption
+mid-checkpoint leaves the old bundle or the new one, never a hybrid. Restores
+verify the digest and the schema-versioned manifest **before touching the
+target**: a truncated, tampered or schema-mismatched bundle raises
+:class:`SessionBundleError` loudly and the restoring process is untouched.
+
+The protocol is **drain → checkpoint → restore → replay-tail**, and it is
+degraded-not-dead while in flight: both halves run under
+:func:`torchmetrics_tpu.obs.scope.migration`, so ``/healthz`` answers
+``degraded`` with the migrating tenant *named* (``tenants_migrating``) for the
+handoff window. With the persistent compile cache wired
+(``TM_TPU_COMPILE_CACHE`` shared between hosts), the restored session's warmup
+is disk reads — the restart cost a rolling deploy pays is the bundle I/O, not
+recompilation.
+
+Zero-loss contract (asserted by the test suite and the rolling-deploy chaos
+scenario): a session checkpointed mid-stream, restored elsewhere, tail
+replayed, then fed the remainder of the stream computes values **bit-identical**
+to an unmigrated control.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import uuid
+from dataclasses import replace
+from typing import Any, Dict, Iterable, List, Optional, Tuple, Union
+
+import numpy as np
+
+import torchmetrics_tpu.obs.scope as _scope
+import torchmetrics_tpu.obs.trace as _trace
+import torchmetrics_tpu.obs.values as _values
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.core.metric import Metric
+from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig, _normalize_batch
+from torchmetrics_tpu.utils import checkpoint as _checkpoint
+from torchmetrics_tpu.utils.checkpoint import CheckpointIntegrityError
+
+__all__ = [
+    "SESSION_SCHEMA",
+    "SessionBundleError",
+    "checkpoint_session",
+    "restore_session",
+    "verify_bundle",
+]
+
+# wire-format version of a session bundle; bump on any structural change —
+# restores REJECT other versions (a silently reinterpreted session would
+# break the bit-identity promise without saying so)
+SESSION_SCHEMA = 1
+_BUNDLE_KIND = "tm_tpu_session"
+
+_MANIFEST_NAME = "MANIFEST.json"
+_INTEGRITY_NAME = "INTEGRITY.json"
+_STATE_NAME = "state.npz"
+_TAIL_NAME = "tail.npz"
+
+# PipelineConfig knobs that serialize into the manifest (everything except
+# live objects: device handles, alert engines, admission controllers — those
+# are the restoring host's to supply)
+_CONFIG_FIELDS = (
+    "fuse",
+    "max_in_flight",
+    "prefetch",
+    "fuse_buckets",
+    "flight_records",
+    "flight_max_dumps",
+    "alert_every",
+    "max_deferred",
+    "tenant",
+)
+
+
+class SessionBundleError(CheckpointIntegrityError):
+    """The session bundle on disk cannot be trusted (truncated, tampered,
+    half-written, or written by an incompatible schema)."""
+
+
+# ------------------------------------------------------------------ internals
+
+
+def _encode_tree(tree: Any) -> Tuple[Any, Dict[str, np.ndarray]]:
+    """Split a host-state pytree (nested dicts, numpy leaves) into a JSON
+    skeleton + an npz array payload.
+
+    Leaves become ``{"__leaf__": "s<N>"}`` placeholders; the skeleton keeps
+    empty containers (unlike orbax, which drops them — and unlike orbax, the
+    writer involves no multihost barrier, so one host can checkpoint while
+    its peers keep serving).
+    """
+    arrays: Dict[str, np.ndarray] = {}
+    counter = [0]
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, dict):
+            return {key: walk(value) for key, value in node.items()}
+        key = f"s{counter[0]}"
+        counter[0] += 1
+        arrays[key] = np.asarray(node)
+        return {"__leaf__": key}
+
+    return walk(tree), arrays
+
+
+def _decode_tree(skeleton: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    def walk(node: Any) -> Any:
+        if (
+            isinstance(node, dict)
+            and set(node) == {"__leaf__"}
+            and isinstance(node["__leaf__"], str)
+        ):
+            return arrays[node["__leaf__"]]
+        return {key: walk(value) for key, value in node.items()}
+
+    return walk(skeleton)
+
+
+def _driven_metrics(target: Union[Metric, MetricCollection]) -> List[Tuple[str, Metric]]:
+    """(label, metric) pairs the session drives — collections flatten by name."""
+    if isinstance(target, MetricCollection):
+        return list(target._modules.items())
+    return [("", target)]
+
+
+def _serialize_tail(
+    tail: List[Tuple[tuple, dict]]
+) -> Tuple[List[Dict[str, Any]], Dict[str, np.ndarray]]:
+    """Split tail batches into a JSON structure + an array payload (npz keys)."""
+    structure: List[Dict[str, Any]] = []
+    arrays: Dict[str, np.ndarray] = {}
+    for bi, (args, kwargs) in enumerate(tail):
+        a_desc: List[Dict[str, Any]] = []
+        for ai, leaf in enumerate(args):
+            if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                key = f"b{bi}_a{ai}"
+                arrays[key] = np.asarray(leaf)
+                a_desc.append({"array": key})
+            else:
+                a_desc.append({"value": leaf})
+        k_desc: Dict[str, Dict[str, Any]] = {}
+        for name, leaf in kwargs.items():
+            if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+                key = f"b{bi}_k_{name}"
+                arrays[key] = np.asarray(leaf)
+                k_desc[name] = {"array": key}
+            else:
+                k_desc[name] = {"value": leaf}
+        structure.append({"args": a_desc, "kwargs": k_desc})
+    return structure, arrays
+
+
+def _deserialize_tail(
+    structure: List[Dict[str, Any]], arrays: Dict[str, np.ndarray]
+) -> List[Tuple[tuple, dict]]:
+    import jax.numpy as jnp
+
+    def leaf(desc: Dict[str, Any]) -> Any:
+        if "array" in desc:
+            return jnp.asarray(arrays[desc["array"]])
+        return desc.get("value")
+
+    batches: List[Tuple[tuple, dict]] = []
+    for entry in structure or []:
+        args = tuple(leaf(d) for d in entry.get("args") or [])
+        kwargs = {name: leaf(d) for name, d in (entry.get("kwargs") or {}).items()}
+        batches.append((args, kwargs))
+    return batches
+
+
+def _session_values(
+    log: Any, tenant: Optional[str], inst_pairs: set
+) -> List[Dict[str, Any]]:
+    """The value-timeline series belonging to this session: its tenant's
+    series plus the driven metric instances' untenanted ones."""
+    rows = []
+    for row in log.series():
+        owns = (tenant is not None and row.get("tenant") == tenant) or (
+            (row.get("metric"), row.get("inst")) in inst_pairs
+        )
+        if owns:
+            rows.append(row)
+    return rows
+
+
+def _resolve_value_log(value_log: Any, alert_engine: Any) -> Any:
+    """The value log a session actually used: explicit > engine's > global."""
+    if value_log is not None:
+        return value_log
+    log_hook = getattr(alert_engine, "_log", None)
+    if callable(log_hook):
+        return log_hook()
+    return _values.get_log()
+
+
+# ---------------------------------------------------------------- checkpoint
+
+
+def checkpoint_session(
+    pipe: MetricPipeline,
+    path: str,
+    tail: Iterable[Any] = (),
+    alert_engine: Any = None,
+    value_log: Any = None,
+) -> Dict[str, Any]:
+    """Atomically checkpoint a *live* session to a bundle at ``path``.
+
+    Drains the pipeline first (open chunk dispatched, in-flight window blocked
+    — the **cursor**: metric state is now exactly the fold of every dispatched
+    batch), then persists the full session: metric state (orbax pytree, the
+    ``__robust__``-aware ``state_dict``), the replay tail (the drained
+    admission-deferred backlog plus any ``tail`` batches the caller buffered
+    while draining — each item a positional tuple, a kwargs dict, or a single
+    array), the flight-recorder ring, the pipeline report, the tenant registry
+    row, the session's value timelines, and the alert engine's live state
+    machines + history.
+
+    ``alert_engine`` defaults to the pipeline's configured engine, else the
+    process-global one; ``value_log`` to the engine's log, else the global.
+    Runs under ``scope.migration(tenant, "checkpoint")`` so ``/healthz`` names
+    the tenant while the drain+write is in flight. Returns the manifest.
+    """
+    target = pipe.metric
+    tenant = pipe.config.tenant
+    engine = alert_engine if alert_engine is not None else pipe.config.alert_engine
+    if engine is None:
+        import torchmetrics_tpu.obs.alerts as _alerts
+
+        engine = _alerts.get_engine()
+    log = _resolve_value_log(value_log, engine)
+
+    ctx = _scope.migration(tenant, "checkpoint") if tenant is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        drained = pipe.drain()
+        tail_batches = list(drained) + [_normalize_batch(b) for b in tail]
+        report = pipe.report()
+        members = _driven_metrics(target)
+        robust = {
+            label: {"sync_degraded": bool(getattr(m, "sync_degraded", False))}
+            for label, m in members
+        }
+        cursor = {
+            "batches_ingested": report.batches,
+            "tail_batches": len(tail_batches),
+            # the first this-many tail batches are the origin's admission-
+            # deferred backlog (drain() hands it back first): the restore
+            # counts them toward deferred_replayed so the accounting balances
+            "deferred_tail": len(drained),
+            "update_counts": {label: int(m.update_count) for label, m in members},
+        }
+        inst_pairs = {
+            (type(m).__name__, str(getattr(m, "_obs_instance", "0"))) for _, m in members
+        }
+        registry_row = None
+        if tenant is not None:
+            effective = pipe._tenant
+            for row in _scope.get_registry().rows():
+                if row["tenant"] == effective:
+                    registry_row = row
+                    break
+        tail_structure, tail_arrays = _serialize_tail(tail_batches)
+        state_skeleton, state_arrays = _encode_tree(_checkpoint._tree_of(target))
+        config_fields = {name: getattr(pipe.config, name) for name in _CONFIG_FIELDS}
+        if config_fields["fuse_buckets"] is not None:
+            config_fields["fuse_buckets"] = list(config_fields["fuse_buckets"])
+        manifest = {
+            "kind": _BUNDLE_KIND,
+            "schema_version": SESSION_SCHEMA,
+            "tenant": tenant,
+            "metric_class": type(target).__name__,
+            "collection": isinstance(target, MetricCollection),
+            "members": [label for label, _ in members if label],
+            "config": config_fields,
+            "cursor": cursor,
+            "state_skeleton": state_skeleton,
+            "tail": tail_structure,
+            "report": {k: v for k, v in report.asdict().items()},
+            "robust": robust,
+            "flight": pipe.flight_snapshot(),
+            "values": _session_values(log, pipe._tenant, inst_pairs),
+            "alerts": engine.export_state() if engine is not None else None,
+            "registry": registry_row,
+            "ts_unix": time.time(),
+        }
+        try:
+            manifest_text = json.dumps(manifest, sort_keys=True, indent=2)
+        except TypeError as err:
+            raise TypeError(
+                "Session state carries a non-JSON-serializable leaf (a tail batch's"
+                f" static argument, most likely): {err}. Only plain scalars/strings"
+                " may ride the tail outside arrays."
+            ) from err
+
+        path = os.path.abspath(path)
+        tag = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+        tmp = f"{path}.tmp.{tag}"
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            np.savez(os.path.join(tmp, _STATE_NAME), **state_arrays)
+            if tail_arrays:
+                np.savez(os.path.join(tmp, _TAIL_NAME), **tail_arrays)
+            with open(os.path.join(tmp, _MANIFEST_NAME), "w", encoding="utf-8") as fh:
+                fh.write(manifest_text)
+            digest = _checkpoint.file_tree_digest(tmp, exclude=(_INTEGRITY_NAME,))
+            with open(os.path.join(tmp, _INTEGRITY_NAME), "w", encoding="utf-8") as fh:
+                json.dump({"version": 1, "schema": SESSION_SCHEMA, "sha256": digest}, fh)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        _checkpoint.atomic_install_dir(tmp, path, tag)
+        if _trace.ENABLED:
+            _trace.event(
+                "engine.session_checkpoint",
+                pipeline=type(target).__name__,
+                tenant=tenant,
+                batches=report.batches,
+                tail=len(tail_batches),
+                path=path,
+            )
+        return manifest
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+
+
+# ------------------------------------------------------------------- restore
+
+
+def verify_bundle(path: str) -> Dict[str, Any]:
+    """Verify a session bundle's integrity + schema; returns its manifest.
+
+    Loud by design: a missing bundle, a missing/unreadable integrity record, a
+    file-tree digest mismatch (truncation, tampering, a half-copied rsync), an
+    unreadable manifest, or a schema/kind mismatch each raise
+    :class:`SessionBundleError` **before any state is touched** — restoring
+    from a bad bundle must never poison the restoring process.
+    """
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise SessionBundleError(f"No session bundle at {path}")
+    integrity_path = os.path.join(path, _INTEGRITY_NAME)
+    if not os.path.isfile(integrity_path):
+        raise SessionBundleError(
+            f"Session bundle at {path} has no {_INTEGRITY_NAME} — bundles are always"
+            " written with an integrity record, so this is a partial copy or a"
+            " directory that is not a session bundle; refusing to restore from it."
+        )
+    try:
+        with open(integrity_path, encoding="utf-8") as fh:
+            recorded = json.load(fh)
+    except (OSError, ValueError) as err:
+        raise SessionBundleError(
+            f"Session bundle at {path} has an unreadable {_INTEGRITY_NAME} ({err}) —"
+            " the record itself is truncated or tampered; restore from another bundle."
+        ) from err
+    digest = _checkpoint.file_tree_digest(path, exclude=(_INTEGRITY_NAME,))
+    if digest != recorded.get("sha256"):
+        raise SessionBundleError(
+            f"Session bundle at {path} failed its integrity check (recorded"
+            f" {str(recorded.get('sha256'))[:12]}…, recomputed {digest[:12]}…) —"
+            " the bundle was corrupted after the checkpoint; restore from another one."
+        )
+    try:
+        with open(os.path.join(path, _MANIFEST_NAME), encoding="utf-8") as fh:
+            manifest = json.load(fh)
+    except (OSError, ValueError) as err:
+        raise SessionBundleError(
+            f"Session bundle at {path} has an unreadable {_MANIFEST_NAME} ({err})"
+        ) from err
+    if not isinstance(manifest, dict) or manifest.get("kind") != _BUNDLE_KIND:
+        raise SessionBundleError(
+            f"Directory at {path} verifies but is not a session bundle"
+            f" (kind={manifest.get('kind') if isinstance(manifest, dict) else None!r})"
+        )
+    if manifest.get("schema_version") != SESSION_SCHEMA:
+        raise SessionBundleError(
+            f"Session bundle at {path} carries schema"
+            f" {manifest.get('schema_version')!r} but this build speaks"
+            f" {SESSION_SCHEMA} — re-checkpoint with a matching build (a silently"
+            " reinterpreted session would break the zero-loss contract)."
+        )
+    return manifest
+
+
+def restore_session(
+    metric: Union[Metric, MetricCollection],
+    path: str,
+    config: Optional[PipelineConfig] = None,
+    alert_engine: Any = None,
+    value_log: Any = None,
+    replay: bool = True,
+    restore_registry: bool = True,
+    **overrides: Any,
+) -> Tuple[MetricPipeline, Dict[str, Any]]:
+    """Restore a checkpointed session onto ``metric`` (freshly constructed with
+    the same spec — the ``load_checkpoint`` contract); returns ``(pipeline,
+    manifest)``.
+
+    The second half of drain→checkpoint→restore→replay-tail: the bundle is
+    verified (:func:`verify_bundle`, loud), metric state is restored (update
+    counts, robust counters and ``sync_degraded`` included), a new
+    :class:`MetricPipeline` is built from the bundled config (``config=`` or
+    keyword ``overrides`` adjust host-local knobs: ``flight_dump_dir``,
+    ``device``, ...; ``alert_engine`` attaches the restoring host's engine and
+    receives the bundled alert machines with dwell clocks intact), the flight
+    ring / report / value timelines / registry row are re-installed, and the
+    replay tail is re-fed in order (admission bypassed — it was admitted
+    before the checkpoint). With ``TM_TPU_COMPILE_CACHE`` shared between
+    hosts, the restored pipeline's :meth:`~MetricPipeline.warmup` is
+    persistent-cache reads, so warmup after a restore is ~free.
+
+    Runs under ``scope.migration(tenant, "restore")`` — ``/healthz`` stays
+    degraded-not-dead with the tenant named until the tail has replayed.
+    """
+    manifest = verify_bundle(path)
+    path = os.path.abspath(path)
+
+    if type(metric).__name__ != manifest.get("metric_class"):
+        raise SessionBundleError(
+            f"Session bundle at {path} was checkpointed from a"
+            f" {manifest.get('metric_class')!r} but the restore target is a"
+            f" {type(metric).__name__!r} — the target must be constructed with the"
+            " checkpointed session's spec."
+        )
+    is_collection = isinstance(metric, MetricCollection)
+    if bool(manifest.get("collection")) != is_collection:
+        raise SessionBundleError(
+            f"Session bundle at {path} and the restore target disagree on being a"
+            " MetricCollection."
+        )
+    members = _driven_metrics(metric)
+    if is_collection:
+        want = set(manifest.get("members") or [])
+        have = {label for label, _ in members}
+        if want != have:
+            raise SessionBundleError(
+                f"Session bundle at {path} names members {sorted(want)} but the"
+                f" restore target holds {sorted(have)} — same-spec restore only."
+            )
+
+    try:
+        with np.load(os.path.join(path, _STATE_NAME)) as payload:
+            state_arrays = {key: payload[key] for key in payload.files}
+        tree = _decode_tree(manifest.get("state_skeleton") or {}, state_arrays)
+    except SessionBundleError:
+        raise
+    except Exception as err:
+        raise SessionBundleError(
+            f"Session bundle at {path} verifies but its state tree is unreadable:"
+            f" {err}"
+        ) from err
+
+    tenant = manifest.get("tenant")
+    ctx = _scope.migration(tenant, "restore") if tenant is not None else None
+    if ctx is not None:
+        ctx.__enter__()
+    try:
+        if is_collection:
+            for label, m in members:
+                _checkpoint._restore_states(m, tree[label])
+        else:
+            _checkpoint._restore_states(metric, tree)
+        robust = manifest.get("robust") or {}
+        for label, m in members:
+            flags = robust.get(label) or {}
+            if flags.get("sync_degraded"):
+                m.sync_degraded = True
+
+        if config is None:
+            cfg_kwargs = dict(manifest.get("config") or {})
+            if cfg_kwargs.get("fuse_buckets") is not None:
+                cfg_kwargs["fuse_buckets"] = tuple(cfg_kwargs["fuse_buckets"])
+            cfg_kwargs.update(overrides)
+            if alert_engine is not None:
+                cfg_kwargs["alert_engine"] = alert_engine
+            config = PipelineConfig(**cfg_kwargs)
+        else:
+            if config.tenant is None and tenant is not None:
+                overrides = {"tenant": tenant, **overrides}
+            if alert_engine is not None:
+                overrides = {**overrides, "alert_engine": alert_engine}
+            if overrides:
+                config = replace(config, **overrides)
+
+        pipe = MetricPipeline(metric, config)
+        pipe._restore_report(manifest.get("report") or {})
+        pipe._restore_flight(manifest.get("flight") or {})
+
+        engine = config.alert_engine
+        if engine is None:
+            import torchmetrics_tpu.obs.alerts as _alerts
+
+            engine = _alerts.get_engine()
+        if engine is not None and manifest.get("alerts"):
+            engine.restore_state(manifest["alerts"])
+        log = _resolve_value_log(value_log, engine)
+        log.restore_series(manifest.get("values") or [])
+
+        row = manifest.get("registry")
+        if restore_registry and row and pipe._tenant is not None:
+            _scope.get_registry().restore_row(
+                pipe._tenant,
+                updates=row.get("updates", 0),
+                computes=row.get("computes", 0),
+                first_seen_unix=row.get("first_seen_unix"),
+            )
+
+        if replay:
+            arrays: Dict[str, np.ndarray] = {}
+            tail_path = os.path.join(path, _TAIL_NAME)
+            if os.path.isfile(tail_path):
+                with np.load(tail_path) as payload:
+                    arrays = {key: payload[key] for key in payload.files}
+            batches = _deserialize_tail(manifest.get("tail") or [], arrays)
+            pipe.replay_tail(
+                batches, deferred=int((manifest.get("cursor") or {}).get("deferred_tail", 0) or 0)
+            )
+        if _trace.ENABLED:
+            _trace.event(
+                "engine.session_restore",
+                pipeline=type(metric).__name__,
+                tenant=tenant,
+                batches=(manifest.get("cursor") or {}).get("batches_ingested", 0),
+                tail=(manifest.get("cursor") or {}).get("tail_batches", 0),
+                path=path,
+            )
+        return pipe, manifest
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)

@@ -35,6 +35,15 @@ playbook avoids by batching work into a small set of shape-bucketed programs.
   surfaces as ``tenant.quota_*`` gauges and the ``tenant.quota_exceeded``
   alert signal.
 
+- **Flight recorder** — the per-row lineage ring + dump-on-fault of
+  :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline`, ported to the
+  cross-tenant plane: every fed row keeps (tenant, tenant-local batch index,
+  signature, group id, dispatch path) in a bounded ring, and a poisoned row
+  produces a named-batch JSONL dump attributed to exactly its owning tenant
+  (one dump per faulted tenant, full cross-tenant ring as context) — parity
+  with the per-tenant pipeline's evidence, so the chaos SLO judge reads both
+  alike. ``MuxConfig.flight_records=0`` disables it.
+
 Per-tenant stream order is preserved: a tenant feeding a second batch (or a
 new signature) before its pending group dispatched flushes that group first.
 Cross-tenant order inside one group is irrelevant by construction — rows fold
@@ -49,6 +58,10 @@ ints.
 
 from __future__ import annotations
 
+import itertools
+import os
+import tempfile
+import time
 from dataclasses import dataclass, fields, replace
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
@@ -67,9 +80,11 @@ from torchmetrics_tpu.core.jit import (
     _aval_signature,
     jit_with_static_leaves,
     partition_static_leaves,
+    signature_str,
 )
 from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.engine import warmup as _warmup
+from torchmetrics_tpu.engine.pipeline import FLIGHT_DIR_ENV, _FlightRecorder
 from torchmetrics_tpu.robust.policy import effective_policy, nonfinite_step_indices
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -100,6 +115,22 @@ class MuxConfig:
             batches hold real device arrays, so a tenant parked over quota
             for hours must not grow memory without bound. Past the cap,
             further defer decisions degrade to shed (counted, loud once).
+        readmit_check_seconds: how often the multiplexer's per-feed sweep
+            probes deferred tenants' quotas (read-only
+            :meth:`~torchmetrics_tpu.obs.scope.AdmissionController.would_admit`)
+            for wall-clock re-admission — an idle-but-deferred tenant drains
+            on any *other* tenant's traffic once its window rolls, instead of
+            starving until its own next feed or ``close()``.
+        flight_records: flight-recorder ring capacity — the last this-many
+            fed rows keep their lineage (tenant, tenant-local batch index,
+            signature, group membership, dispatch path) for a dump-on-fault,
+            exactly the :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline`
+            recorder ported to the cross-tenant plane. ``0`` disables it.
+        flight_dump_dir: where fault dumps land. ``None``: the
+            ``TM_TPU_FLIGHT_DIR`` environment variable, else
+            ``<tempdir>/tm_tpu_flight``.
+        flight_max_dumps: hard cap on dump files one multiplexer writes
+            (suppressed dumps are counted).
         device: target device for stacked batches (``None``: default device).
     """
 
@@ -109,6 +140,10 @@ class MuxConfig:
     alert_engine: Any = None
     alert_every: int = 1
     max_deferred: int = 1024
+    readmit_check_seconds: float = 0.25
+    flight_records: int = 64
+    flight_dump_dir: Optional[str] = None
+    flight_max_dumps: int = 16
     device: Any = None
 
     def __post_init__(self) -> None:
@@ -118,6 +153,14 @@ class MuxConfig:
             raise ValueError(f"Expected `alert_every` >= 1, got {self.alert_every}")
         if self.max_deferred < 1:
             raise ValueError(f"Expected `max_deferred` >= 1, got {self.max_deferred}")
+        if self.readmit_check_seconds < 0:
+            raise ValueError(
+                f"Expected `readmit_check_seconds` >= 0, got {self.readmit_check_seconds}"
+            )
+        if self.flight_records < 0:
+            raise ValueError(f"Expected `flight_records` >= 0, got {self.flight_records}")
+        if self.flight_max_dumps < 0:
+            raise ValueError(f"Expected `flight_max_dumps` >= 0, got {self.flight_max_dumps}")
         if self.width_buckets is not None:
             buckets = tuple(sorted(set(int(b) for b in self.width_buckets)))
             if not buckets or buckets[0] < 1:
@@ -153,6 +196,7 @@ class MuxReport:
     deferred_replayed: int = 0  # deferred batches later ingested
     padded_rows: int = 0  # masked tenant rows added by width-bucket padding
     order_flushes: int = 0  # groups dispatched early to keep a tenant's order
+    flight_dumps: int = 0  # flight-recorder fault dumps written
     max_width: int = 0
     last_width: int = 0
 
@@ -226,7 +270,7 @@ def _config_fingerprint(target: Any) -> Any:
 class _MuxGroup:
     """One open fusion group: same-signature rows from distinct tenants."""
 
-    __slots__ = ("sig", "treedef", "template", "tenants", "traced", "originals")
+    __slots__ = ("sig", "treedef", "template", "tenants", "traced", "originals", "records")
 
     def __init__(self, sig: tuple, treedef: Any, template: tuple) -> None:
         self.sig = sig
@@ -235,6 +279,7 @@ class _MuxGroup:
         self.tenants: List[str] = []
         self.traced: List[list] = []  # per row: traced leaves, template order
         self.originals: List[Tuple[tuple, dict]] = []
+        self.records: List[Optional[dict]] = []  # per row: flight record (or None)
 
     def __len__(self) -> int:
         return len(self.tenants)
@@ -260,6 +305,8 @@ class TenantMultiplexer:
     states (or ``jit_update=False``) degrade to per-tenant eager updates
     automatically, exactly like the streaming pipeline.
     """
+
+    _instance_seq = itertools.count()
 
     def __init__(
         self,
@@ -298,6 +345,27 @@ class TenantMultiplexer:
         self._alert_commits = 0
         self._alert_warned = False
         self._shed_warned: set = set()
+        # per-tenant ingest ordinals: flight records and dump attribution name
+        # TENANT-LOCAL batch indices (the schedule/SLO ground-truth shape)
+        self._tenant_batch_index: Dict[str, int] = {}
+        self._group_seq = 0
+        self._last_readmit_check = 0.0
+        self._instance = str(next(TenantMultiplexer._instance_seq))
+        if config.flight_records > 0:
+            dump_dir = (
+                config.flight_dump_dir
+                or os.environ.get(FLIGHT_DIR_ENV)
+                or os.path.join(tempfile.gettempdir(), "tm_tpu_flight")
+            )
+            self._flight: Optional[_FlightRecorder] = _FlightRecorder(
+                "TenantMultiplexer",
+                self._instance,
+                config.flight_records,
+                dump_dir,
+                config.flight_max_dumps,
+            )
+        else:
+            self._flight = None
         # per-width-bucket (flops, bytes) per dispatch — a width-1 program
         # costs ~1/64th of a width-64 one, so billing must price the bucket
         # that actually executed, not a cross-width mean
@@ -430,6 +498,15 @@ class TenantMultiplexer:
             "misses": sum(i["misses"] for i in infos),
         }
 
+    def flight_records(self) -> List[dict]:
+        """Copies of the flight-recorder ring (empty when ``flight_records=0``)."""
+        return self._flight.records() if self._flight is not None else []
+
+    @property
+    def flight_dumps(self) -> List[str]:
+        """Paths of the fault dumps this multiplexer has written."""
+        return list(self._flight.dump_paths) if self._flight is not None else []
+
     # ---------------------------------------------------------------------- feeding
 
     def feed(self, tenant: str, *args: Any, **kwargs: Any) -> None:
@@ -437,6 +514,13 @@ class TenantMultiplexer:
         # everything downstream keys on the EFFECTIVE label, so past-cap
         # tenants (collapsed onto the overflow session) keep being served
         tenant = self._effective(tenant)
+        # wall-clock re-admission sweep: OTHER tenants' deferred backlogs whose
+        # quota windows have rolled drain on this feed (interval-gated), so an
+        # idle-but-deferred tenant rides any live traffic instead of starving.
+        # The fed tenant itself is excluded — its own backlog drains through
+        # the admit() path below, keeping the drain-then-admit order (and the
+        # admit-the-crossing-batch semantic) exactly as before.
+        self._maybe_readmit_deferred(exclude=tenant)
         controller = self._admission()
         if controller is not None:
             decision = controller.admit(tenant)
@@ -483,10 +567,23 @@ class TenantMultiplexer:
 
     def _ingest(self, tenant: str, args: tuple, kwargs: dict) -> None:
         self._report.batches += 1
+        # tenant-local ordinal: the index a dump names is the tenant's own
+        # batch count, matching the per-tenant pipeline (and the chaos
+        # schedule's poisoned-batch ground truth), not the shared mux stream
+        batch_index = self._tenant_batch_index.get(tenant, 0)
+        self._tenant_batch_index[tenant] = batch_index + 1
+        record = None
+        if self._flight is not None:
+            record = self._flight.open_record(batch_index)
+            record["tenant"] = tenant
         if _trace.ENABLED:
             _trace.inc("engine.mux_batches", mux=self._label)
+            if record is not None:
+                _trace.set_gauge(
+                    "flight.records", len(self._flight), pipeline=self._label, inst=self._instance
+                )
         if not self._fusable:
-            self._drive_eager(tenant, args, kwargs)
+            self._drive_eager(tenant, args, kwargs, record)
             return
         if self._eager_leaders:
             # unfusable group leaders advance per batch, in stream order
@@ -498,9 +595,11 @@ class TenantMultiplexer:
             # unhashable statics cannot key a group signature: keep this
             # tenant's order (dispatch its pending group) and go eager
             self._flush_pending(tenant)
-            self._drive_fused_leaders_eagerly(tenant, args, kwargs)
+            self._drive_fused_leaders_eagerly(tenant, args, kwargs, record)
             return
         sig = (treedef, tuple(template), _aval_signature(traced))
+        if record is not None:
+            record["signature"] = signature_str(sig[2])
         pending = self._pending.get(tenant)
         if pending is not None:
             # the tenant already has an undispatched row: its earlier batch
@@ -515,6 +614,7 @@ class TenantMultiplexer:
         group.tenants.append(tenant)
         group.traced.append(traced)
         group.originals.append((args, kwargs))
+        group.records.append(record)
         self._pending[tenant] = sig
         if _trace.ENABLED:
             _trace.set_gauge("engine.mux_open_groups", len(self._groups), mux=self._label)
@@ -538,9 +638,71 @@ class TenantMultiplexer:
             self._dispatch_sig(sig)
 
     def flush(self) -> None:
-        """Dispatch every open group (insertion order, padded to its bucket)."""
+        """Dispatch every open group (insertion order, padded to its bucket).
+
+        Also runs the wall-clock re-admission sweep (time gate bypassed):
+        deferred tenants back under quota drain here too.
+        """
+        self._maybe_readmit_deferred(force=True)
         for sig in list(self._groups):
             self._dispatch_sig(sig)
+
+    def poll_admission(self) -> int:
+        """Wall-clock re-admission sweep over every deferred tenant's backlog.
+
+        An external ticker's hook (the pipeline's
+        :meth:`~torchmetrics_tpu.engine.pipeline.MetricPipeline.poll_admission`
+        analog): each deferred tenant is probed read-only
+        (:meth:`~torchmetrics_tpu.obs.scope.AdmissionController.would_admit`)
+        and, when back under quota, its backlog drains in order (billed).
+        Returns the number of batches drained.
+        """
+        return self._maybe_readmit_deferred(force=True)
+
+    def _maybe_readmit_deferred(self, force: bool = False, exclude: Optional[str] = None) -> int:
+        """Drain deferred backlogs whose tenants are back under quota.
+
+        Interval-gated by ``readmit_check_seconds`` unless ``force`` — the
+        per-feed sweep must stay O(1) on the no-deferred hot path and cheap
+        even with parked tenants. ``exclude`` skips one tenant (the per-feed
+        sweep's caller, whose own backlog the admit() path drains).
+        """
+        if not self._deferred:
+            return 0
+        controller = self._admission()
+        if controller is None:
+            # the controller was uninstalled mid-stream: nothing meters these
+            # tenants anymore, so their backlogs drain unconditionally
+            deferred, self._deferred = self._deferred, {}
+            drained = 0
+            for tenant, backlog in deferred.items():
+                for args, kwargs in backlog:
+                    self._report.deferred_replayed += 1
+                    self._ingest(tenant, args, kwargs)
+                    drained += 1
+            return drained
+        probe = getattr(controller, "would_admit", None)
+        if not callable(probe):
+            return 0
+        now = time.monotonic()
+        if not force and now - self._last_readmit_check < self.config.readmit_check_seconds:
+            return 0
+        self._last_readmit_check = now
+        drained = 0
+        for tenant in list(self._deferred):
+            if tenant == exclude or not probe(tenant):
+                continue
+            backlog = self._deferred.pop(tenant, None) or []
+            for args, kwargs in backlog:
+                self._report.deferred_replayed += 1
+                controller.charge(tenant, updates=1)
+                self._ingest(tenant, args, kwargs)
+                drained += 1
+            if _trace.ENABLED and backlog:
+                _trace.event(
+                    "engine.mux_readmitted", mux=self._label, tenant=tenant, batches=len(backlog)
+                )
+        return drained
 
     def flush_deferred(self) -> None:
         """Drain every tenant's deprioritized backlog (admission decisions
@@ -746,11 +908,11 @@ class TenantMultiplexer:
             return
         for tenant in group.tenants:
             self._pending.pop(tenant, None)
-        rows = list(zip(group.tenants, group.traced, group.originals))
+        rows = list(zip(group.tenants, group.traced, group.originals, group.records))
         # one non-finite screen per GROUP (vs one host sync per tenant batch on
         # the guarded eager path); only guarded tenants' rows are screened —
         # an unguarded tenant's NaN must flow into ITS state like always
-        guarded = {i for i, (tenant, _, _) in enumerate(rows) if self._row_policy(tenant) is not None}
+        guarded = {i for i, row in enumerate(rows) if self._row_policy(row[0]) is not None}
         if guarded:
             # host-side probe: the screen reads host values anyway (one sync
             # per group by design), so stack with numpy instead of burning a
@@ -780,21 +942,92 @@ class TenantMultiplexer:
                 clean = [row for i, row in enumerate(rows) if i not in set(bad)]
                 if clean:
                     self._dispatch_rows(group, clean)
-                self._replay_rows([rows[i] for i in bad])
+                self._replay_rows([rows[i] for i in bad], reason="group_replay")
                 return
         self._dispatch_rows(group, rows)
 
-    def _replay_rows(self, rows: List[tuple]) -> None:
+    def _tenant_robust_counts(self, tenant: str) -> Tuple[int, int]:
+        """(quarantined, skipped) totals of one tenant's metrics — diffed
+        around a replay/eager update to attribute the fault to its batch."""
+        target = self._metrics[tenant]
+        metrics = (
+            list(target._modules.values()) if self._is_collection else [target]
+        )
+        quarantined = skipped = 0
+        for m in metrics:
+            quarantined += int(getattr(m, "updates_quarantined", 0) or 0)
+            skipped += int(getattr(m, "updates_skipped", 0) or 0)
+        return quarantined, skipped
+
+    def _dump_flight(self, reason: str, tenant: str, poisoned: List[int]) -> Optional[str]:
+        """One fault dump naming ONE tenant's poisoned tenant-local batches.
+
+        The mux ring is shared (the dump ships the full cross-tenant lineage
+        as context), but attribution is per tenant: a group where two tenants'
+        rows went bad produces two dumps, each naming exactly its owner's
+        batches — the same (tenant, batch-index) evidence shape the per-tenant
+        pipeline recorder produces, so the chaos SLO judge reads both alike.
+        """
+        if self._flight is None:
+            return None
+        config = {
+            "max_width": self.config.max_width,
+            "buckets": list(self._buckets),
+            "tenants": len(self._metrics),
+        }
+        path = self._flight.dump(reason, poisoned, config, tenant=tenant)
+        if path is not None:
+            self._report.flight_dumps += 1
+            if _trace.ENABLED:
+                _trace.inc("flight.dumps", pipeline=self._label)
+                _trace.event(
+                    "engine.mux_flight_dump",
+                    mux=self._label,
+                    tenant=tenant,
+                    reason=reason,
+                    path=path,
+                    poisoned=",".join(map(str, sorted(set(poisoned)))),
+                )
+        return path
+
+    def _replay_rows(self, rows: List[tuple], reason: str = "group_replay") -> None:
         """Guarded per-tenant replays; the first raising tenant's error
-        propagates only after every row has been given its replay."""
+        propagates only after every row has been given its replay.
+
+        Fault attribution mirrors the pipeline's: each replay is bracketed by
+        the owning tenant's robust counters, the row's flight record is
+        stamped, and every faulted tenant gets a dump naming exactly its
+        tenant-local batch indices — written BEFORE a raise-policy error
+        propagates, so the evidence always lands.
+        """
         errors: List[BaseException] = []
         replayed: List[str] = []
-        for tenant, _, (r_args, r_kwargs) in rows:
+        poisoned_by_tenant: Dict[str, List[int]] = {}
+        for row in rows:
+            tenant, _, (r_args, r_kwargs) = row[0], row[1], row[2]
+            record = row[3] if len(row) > 3 else None
+            before = self._tenant_robust_counts(tenant)
             try:
                 self._replay_row(tenant, r_args, r_kwargs)
             except BaseException as err:  # raise-policy tenants re-raise below
                 errors.append(err)
+                if record is not None:
+                    record["path"] = "replay"
+                    record["fault"] = "raised"
+                    poisoned_by_tenant.setdefault(tenant, []).append(record["batch_index"])
+            else:
+                if record is not None:
+                    record["path"] = "replay"
+                    quarantined, skipped = self._tenant_robust_counts(tenant)
+                    if quarantined > before[0]:
+                        record["fault"] = "quarantined"
+                    elif skipped > before[1]:
+                        record["fault"] = "skipped"
+                    if record["fault"] is not None:
+                        poisoned_by_tenant.setdefault(tenant, []).append(record["batch_index"])
             replayed.append(tenant)
+        for tenant, poisoned in poisoned_by_tenant.items():
+            self._dump_flight(reason, tenant, poisoned)
         self._evaluate_alerts(replayed)
         if errors:
             raise errors[0]
@@ -811,6 +1044,8 @@ class TenantMultiplexer:
         fused = self._get_fused_fn(group.treedef, group.template)
         controller = self._admission()
         ledger_mark = _cost.get_ledger().mark() if controller is not None else None
+        gid = self._group_seq
+        self._group_seq += 1
         try:
             if _trace.ENABLED:
                 with _trace.span(
@@ -830,15 +1065,20 @@ class TenantMultiplexer:
                     reason=type(err).__name__,
                     width=n,
                 )
-            self._replay_rows(rows)
+            self._replay_rows(rows, reason="group_replay")
             return
         committed: List[str] = []
-        for i, (tenant, _, _) in enumerate(rows):
+        for i, row in enumerate(rows):
+            tenant = row[0]
             # new_states[i] is the tenant's state pytree, already split by the
             # compiled program — no per-leaf host slicing here
             with _scope.session(tenant):
                 self._commit(self._metrics[tenant], new_states[i])
             committed.append(tenant)
+            record = row[3] if len(row) > 3 else None
+            if record is not None:
+                record["chunk_id"] = gid
+                record["path"] = "mux"
         self._report.dispatches += 1
         self._report.fused_updates += n
         self._report.padded_rows += pad
@@ -909,9 +1149,27 @@ class TenantMultiplexer:
 
     # ------------------------------------------------------------- per-tenant paths
 
-    def _drive_eager(self, tenant: str, args: tuple, kwargs: dict) -> None:
+    def _mark_eager_fault(
+        self, tenant: str, record: Optional[dict], before: Tuple[int, int]
+    ) -> None:
+        """Stamp an eager-path record with its fault; quarantines dump directly
+        (no replay step exists to do it — the pipeline's eager-path rule)."""
+        if record is None:
+            return
+        record["path"] = "eager"
+        quarantined, skipped = self._tenant_robust_counts(tenant)
+        if quarantined > before[0]:
+            record["fault"] = "quarantined"
+            self._dump_flight("quarantine", tenant, [record["batch_index"]])
+        elif skipped > before[1]:
+            record["fault"] = "skipped"
+
+    def _drive_eager(
+        self, tenant: str, args: tuple, kwargs: dict, record: Optional[dict] = None
+    ) -> None:
         """Whole-target per-tenant update (target unfusable)."""
         target = self._metrics[tenant]
+        before = self._tenant_robust_counts(tenant) if record is not None else (0, 0)
         with _scope.session(tenant):
             if _trace.ENABLED:
                 with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
@@ -922,6 +1180,7 @@ class TenantMultiplexer:
         self._report.eager_dispatches += 1
         if _trace.ENABLED:
             _trace.inc("engine.mux_eager_updates", mux=self._label)
+        self._mark_eager_fault(tenant, record, before)
         self._evaluate_alerts([tenant])
 
     def _drive_eager_leaders(self, tenant: str, args: tuple, kwargs: dict) -> None:
@@ -932,9 +1191,12 @@ class TenantMultiplexer:
                 m.update(*args, **m._filter_kwargs(**kwargs))
         self._report.eager_dispatches += len(self._eager_leaders)
 
-    def _drive_fused_leaders_eagerly(self, tenant: str, args: tuple, kwargs: dict) -> None:
+    def _drive_fused_leaders_eagerly(
+        self, tenant: str, args: tuple, kwargs: dict, record: Optional[dict] = None
+    ) -> None:
         """Per-tenant fallback for a batch that cannot join a group."""
         target = self._metrics[tenant]
+        before = self._tenant_robust_counts(tenant) if record is not None else (0, 0)
         with _scope.session(tenant):
             for m in self._per_batch_metrics(target):
                 filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
@@ -943,6 +1205,7 @@ class TenantMultiplexer:
                 target._sync_group_states()
         self._report.eager_updates += 1
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
+        self._mark_eager_fault(tenant, record, before)
         self._evaluate_alerts([tenant])
 
     def _replay_row(self, tenant: str, args: tuple, kwargs: dict) -> None:

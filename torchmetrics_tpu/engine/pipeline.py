@@ -322,12 +322,33 @@ class _FlightRecorder:
         """Copies of the live ring, oldest first (safe to mutate/serialize)."""
         return [{**r, "stages": dict(r["stages"])} for r in self._ring]
 
-    def dump(self, reason: str, poisoned: List[int], config: Dict[str, Any]) -> Optional[str]:
+    def restore_records(self, records: List[dict]) -> None:
+        """Refill the ring from serialized records (oldest first, bounded).
+
+        The migration path: a restored session's first fault dump should still
+        carry the pre-migration batch lineage as context, not start from an
+        empty ring.
+        """
+        for record in records or []:
+            restored = {**record, "stages": dict(record.get("stages") or {})}
+            self._ring.append(restored)
+
+    def dump(
+        self,
+        reason: str,
+        poisoned: List[int],
+        config: Dict[str, Any],
+        tenant: Optional[str] = None,
+    ) -> Optional[str]:
         """Write the ring as JSONL (meta line first, then batches oldest-first).
 
         Atomic via :func:`~torchmetrics_tpu.utils.fileio.atomic_write_text` — a
         crash mid-dump never leaves a truncated file masquerading as evidence.
         Returns the path, or ``None`` when suppressed (cap) or unwritable.
+        ``tenant`` overrides the recorder-level tenant on the meta line — the
+        multiplexer's ring is shared across tenants, but each fault dump names
+        the ONE tenant whose batches it attributes (``poisoned`` indices are
+        that tenant's tenant-local ordinals).
         """
         if len(self.dump_paths) >= self.max_dumps:
             self.dumps_suppressed += 1
@@ -339,7 +360,7 @@ class _FlightRecorder:
             "schema": FLIGHT_SCHEMA,
             "pipeline": self.pipeline,
             "inst": self.inst,
-            "tenant": self.tenant,
+            "tenant": tenant if tenant is not None else self.tenant,
             "reason": reason,
             "poisoned_batches": sorted(set(poisoned)),
             "records": len(self._ring),
@@ -492,6 +513,38 @@ class MetricPipeline:
         """Paths of the fault dumps this pipeline has written."""
         return list(self._flight.dump_paths) if self._flight is not None else []
 
+    def flight_snapshot(self) -> Dict[str, Any]:
+        """Serializable flight-recorder state (the session-bundle seam)."""
+        if self._flight is None:
+            return {"records": [], "dumps_written": 0, "dumps_suppressed": 0}
+        return {
+            "records": self._flight.records(),
+            "dumps_written": len(self._flight.dump_paths),
+            "dumps_suppressed": self._flight.dumps_suppressed,
+        }
+
+    def _restore_flight(self, snapshot: Dict[str, Any]) -> None:
+        """Refill the flight ring from a session bundle (restore path).
+
+        Dump *files* stay on the origin host — only the ring (the lineage
+        context a future fault dump ships) and the suppressed count migrate;
+        the written-dump total lives on in the restored report.
+        """
+        if self._flight is None or not snapshot:
+            return
+        self._flight.restore_records(snapshot.get("records") or [])
+        self._flight.dumps_suppressed += int(snapshot.get("dumps_suppressed", 0) or 0)
+
+    def _restore_report(self, totals: Dict[str, Any]) -> None:
+        """Adopt a checkpointed session's accounting (restore path): the
+        restored pipeline keeps counting from the origin host's totals."""
+        for f in fields(PipelineReport):
+            if f.name in totals:
+                setattr(self._report, f.name, int(totals[f.name]))
+        # the ingest ordinal continues too, so flight-record batch indices
+        # stay the session's (not the process's) ordinals
+        self._ingested = max(self._ingested, int(totals.get("batches", 0) or 0))
+
     def feed(self, *args: Any, **kwargs: Any) -> None:
         """Ingest one batch (positional/keyword update arguments)."""
         with self._tenant_ctx():
@@ -549,11 +602,109 @@ class MetricPipeline:
         return self.report()
 
     def flush(self) -> None:
-        """Dispatch the open partial chunk (padded up to its bucket)."""
+        """Dispatch the open partial chunk (padded up to its bucket).
+
+        Also runs the wall-clock re-admission check: a deferred backlog whose
+        tenant has fallen back under quota drains here too, so an
+        idle-but-deferred tenant is not starved until ``close()``.
+        """
         with self._tenant_ctx():
+            self._maybe_readmit()
             if self._chunk is not None and len(self._chunk):
                 self._dispatch_chunk()
             self._check_buffer_overflow()
+
+    def poll_admission(self) -> int:
+        """Wall-clock re-admission check for the deferred backlog.
+
+        A tenant whose batches were deferred drains them on its next feed once
+        the quota window rolls — but an *idle* tenant never feeds again, so its
+        backlog used to wait for ``close()``. An external ticker (or any
+        housekeeping loop) calls this instead: when the admission controller's
+        read-only probe (:meth:`~torchmetrics_tpu.obs.scope.AdmissionController.would_admit`)
+        says the tenant is back under quota, the backlog drains in order (and
+        is billed). Returns the number of batches drained.
+        """
+        with self._tenant_ctx():
+            return self._maybe_readmit()
+
+    def _maybe_readmit(self) -> int:
+        """Drain the deferred backlog if the tenant is back under quota."""
+        if self._tenant is None or not self._deferred:
+            return 0
+        controller = (
+            self.config.admission if self.config.admission is not None else _scope.get_admission()
+        )
+        if controller is None:
+            # the controller was uninstalled mid-stream: nothing meters this
+            # tenant anymore, so the backlog drains unconditionally
+            n = len(self._deferred)
+            self._drain_deferred(None)
+            return n
+        probe = getattr(controller, "would_admit", None)
+        if not callable(probe):
+            # a controller without the read-only probe cannot be asked safely:
+            # stay conservative (the backlog still drains at close(), exactly
+            # the pre-probe behavior) rather than bypassing a live quota
+            return 0
+        if not probe(self._tenant):
+            return 0
+        n = len(self._deferred)
+        self._drain_deferred(controller)
+        return n
+
+    def drain(self) -> List[Tuple[tuple, dict]]:
+        """Quiesce the pipeline for a checkpoint; returns the **replay tail**.
+
+        The first step of the drain→checkpoint→restore→replay-tail migration
+        protocol (:mod:`torchmetrics_tpu.engine.migrate`): the open fusion
+        chunk is dispatched, the in-flight async window is blocked to
+        completion — after which the metric state is exactly the fold of every
+        dispatched batch — and the admission-deferred backlog (batches
+        ingested but never folded) is handed back, cleared, as the tail to
+        persist and replay after restore. The session stays open (``close()``
+        still owes the registry its ``pipeline_finished``).
+        """
+        with self._tenant_ctx():
+            if self._chunk is not None and len(self._chunk):
+                self._dispatch_chunk()
+            while self._inflight:
+                jax.block_until_ready(self._inflight.popleft())
+            if _trace.ENABLED:
+                _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
+            tail, self._deferred = self._deferred, []
+            return tail
+
+    def replay_tail(self, batches: Iterable[Tuple[tuple, dict]], deferred: int = 0) -> int:
+        """Re-ingest checkpointed tail batches on the restored host, in order.
+
+        Admission *decisions* are bypassed — these batches were accepted by
+        the origin host before the checkpoint; replaying them is completing
+        accepted work, not new traffic — but the executed updates ARE billed
+        to the restoring host's controller (deferred batches are never charged
+        at defer time; the work burns quota where it actually runs, exactly
+        like :meth:`_drain_deferred`). The first ``deferred`` batches are the
+        origin's admission-deferred backlog and count toward
+        ``deferred_replayed`` so the restored report's deferred accounting
+        balances. Returns the number of batches replayed.
+        """
+        controller = None
+        if self._tenant is not None:
+            controller = (
+                self.config.admission
+                if self.config.admission is not None
+                else _scope.get_admission()
+            )
+        n = 0
+        with self._tenant_ctx():
+            for args, kwargs in batches:
+                if n < deferred:
+                    self._report.deferred_replayed += 1
+                if controller is not None:
+                    controller.charge(self._tenant, updates=1)
+                self._ingest(tuple(args), dict(kwargs), bypass_admission=True)
+                n += 1
+        return n
 
     def close(self) -> PipelineReport:
         """Flush (deferred backlog included), drain the in-flight window, and

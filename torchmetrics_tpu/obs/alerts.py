@@ -673,6 +673,85 @@ class AlertEngine:
                 pending_at.pop(key, None)  # a dwell that never fired
         return episodes
 
+    def export_state(self) -> Dict[str, Any]:
+        """Serializable snapshot of the engine: rules, live state machines,
+        transition history.
+
+        The session-bundle seam (:mod:`torchmetrics_tpu.engine.migrate`): a
+        live session's alert machines — a ``pending`` alert mid-dwell, a
+        ``firing`` one awaiting its resolve — are part of what a rolling
+        deploy must not lose. Plain data only (rules via ``asdict``), suitable
+        for JSON.
+        """
+        with self._lock:
+            return {
+                "rules": [asdict(rule) for rule in self._rules],
+                "alerts": [dict(alert) for alert in self._alerts.values()],
+                "history": [dict(record) for record in self._history],
+                "evaluations": self.evaluations,
+            }
+
+    def restore_state(self, state: Dict[str, Any], rules: bool = True) -> int:
+        """Re-install live alert machines exported by :meth:`export_state`.
+
+        Restored ``pending``/``firing`` alerts resume **with their dwell
+        clocks intact**: ``since``/``fired_at`` carry the origin host's wall
+        stamps, so a pending alert fires after its *remaining* ``for_seconds``
+        dwell (not a fresh one) and a firing alert's eventual
+        ``time_to_resolve`` spans the migration. History extends the bounded
+        ring oldest-first — transitions the engine *already holds* (a restore
+        back into the origin process, or two sessions sharing one engine) are
+        skipped by exact match, so :meth:`fire_resolve_times` never derives
+        phantom episodes from duplicated records. With ``rules`` (default),
+        rules from the snapshot that this engine does not already have (by
+        name) are re-added — a fresh engine on the restoring host picks up
+        the session's watchdogs wholesale. Returns the number of live
+        machines restored.
+        """
+        restored = 0
+        with self._lock:
+            if rules:
+                have = {rule.name for rule in self._rules}
+                for spec in state.get("rules") or []:
+                    if spec.get("name") not in have:
+                        self._rules.append(AlertRule(**spec))
+            for alert in state.get("alerts") or []:
+                rule_name, series = alert.get("rule"), alert.get("series")
+                if not rule_name or not series:
+                    continue
+                self._alerts[(rule_name, series)] = dict(alert)
+                restored += 1
+            seen = {
+                (r.get("rule"), r.get("series"), r.get("from"), r.get("to"), r.get("at"))
+                for r in self._history
+            }
+            fresh = []
+            for record in state.get("history") or []:
+                key = (
+                    record.get("rule"),
+                    record.get("series"),
+                    record.get("from"),
+                    record.get("to"),
+                    record.get("at"),
+                )
+                if key in seen:
+                    continue
+                seen.add(key)
+                fresh.append(dict(record))
+            if fresh:
+                # merge by wall stamp, NOT by appending at the tail: the
+                # engine may already hold transitions newer than the
+                # snapshot's (shared engine, origin records aged out of its
+                # ring), and fire_resolve_times derives episodes from ring
+                # ORDER — an old resolve appended after a newer fire would
+                # pair into an episode with a negative time_to_resolve
+                merged = sorted(
+                    list(self._history) + fresh, key=lambda r: float(r.get("at") or 0.0)
+                )
+                self._history.clear()
+                self._history.extend(merged)  # bounded deque keeps the newest
+        return restored
+
     def report(self) -> Dict[str, Any]:
         """The ``GET /alerts`` payload."""
         with self._lock:

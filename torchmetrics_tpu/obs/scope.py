@@ -74,6 +74,8 @@ __all__ = [
     "get_admission",
     "get_registry",
     "install_admission",
+    "migrating_tenants",
+    "migration",
     "note_compute",
     "note_update",
     "record_gauges",
@@ -269,6 +271,37 @@ class TenantRegistry:
             "tenants": self.rows(),
         }
 
+    def restore_row(
+        self,
+        tenant: str,
+        updates: int = 0,
+        computes: int = 0,
+        first_seen_unix: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Merge a migrated session's lifetime activity into the tenant's row.
+
+        The live-session migration path (:mod:`torchmetrics_tpu.engine.migrate`):
+        a session restored on this host carries its origin host's update/compute
+        totals, and the registry must keep counting from there — a tenant that
+        served a million updates before the rolling deploy did not become a
+        newborn by moving. The earliest first-seen stamp wins; the restore
+        itself counts as activity (``last_seen`` moves). Returns a copy of the
+        merged row.
+        """
+        with self._lock:
+            self._step += 1
+            now = time.time()
+            row = self._rows.get(tenant)
+            if row is None:
+                row = self._rows[tenant] = self._new_row(tenant, now)
+            row["updates"] += int(updates)
+            row["computes"] += int(computes)
+            if first_seen_unix is not None:
+                row["first_seen_unix"] = min(row["first_seen_unix"], float(first_seen_unix))
+            row["last_seen_unix"] = now
+            row["last_step"] = self._step
+            return dict(row)
+
 
 _REGISTRY = TenantRegistry()
 
@@ -297,6 +330,8 @@ def reset() -> None:
     _REGISTRY.clear()
     _REGISTRY.max_tenants = DEFAULT_MAX_TENANTS
     _ADMISSION = None
+    with _MIGRATION_LOCK:
+        _MIGRATIONS.clear()
     ENABLED = False
 
 
@@ -396,6 +431,49 @@ def tag(labels: Dict[str, Any]) -> Dict[str, Any]:
     if tenant is not None and "tenant" not in labels:
         labels["tenant"] = tenant
     return labels
+
+
+# --------------------------------------------------------------------- migration
+
+# tenants with a live-session migration in flight: tenant -> phase stack
+# (nested phases — drain inside a rolling-deploy window — innermost wins).
+# Lives here (pure stdlib, next to the liveness registry) so /healthz can name
+# the migrating tenant without the obs server importing the engine layer.
+_MIGRATIONS: Dict[str, List[str]] = {}
+_MIGRATION_LOCK = threading.Lock()
+
+
+@contextmanager
+def migration(tenant: str, phase: str = "migrating") -> Iterator[str]:
+    """Mark ``tenant``'s live session as mid-migration for the block's duration.
+
+    The degraded-not-dead seam of :mod:`torchmetrics_tpu.engine.migrate`:
+    while any phase is active, ``/healthz`` answers ``degraded`` with the
+    migrating tenant *named* (``tenants_migrating``) — a host handing a
+    session off is still serving, but an operator watching the fleet must see
+    WHO is in flight, not a silently shrinking tenant list. Nesting stacks
+    (the innermost phase is the reported one); the entry is removed when the
+    outermost block exits, crash or not.
+    """
+    validate_tenant(tenant)
+    phase = str(phase)
+    with _MIGRATION_LOCK:
+        _MIGRATIONS.setdefault(tenant, []).append(phase)
+    try:
+        yield phase
+    finally:
+        with _MIGRATION_LOCK:
+            stack = _MIGRATIONS.get(tenant)
+            if stack:
+                stack.pop()
+                if not stack:
+                    _MIGRATIONS.pop(tenant, None)
+
+
+def migrating_tenants() -> Dict[str, str]:
+    """Tenants with a migration in flight: ``{tenant: current phase}``."""
+    with _MIGRATION_LOCK:
+        return {tenant: stack[-1] for tenant, stack in _MIGRATIONS.items() if stack}
 
 
 # --------------------------------------------------------------------- admission
@@ -590,6 +668,28 @@ class AdmissionController:
                     decision=decision,
                 )
         return decision
+
+    def would_admit(self, tenant: str) -> bool:
+        """Read-only probe: would :meth:`admit` answer :data:`ADMIT` right now?
+
+        The wall-clock re-admission check for deferred backlogs: a tenant
+        parked over quota drains its deprioritized batches only when *someone*
+        asks again, and an idle tenant never does — the serving layers
+        (pipeline ``flush``/``poll_admission``, the multiplexer's per-feed
+        sweep) probe this instead. **No state mutates**: no decision counters,
+        no ``quota_exceeded`` edge writes, and an elapsed/absent window is not
+        created or rolled — an answer of ``True`` simply means the next real
+        ``admit()`` would let the backlog through.
+        """
+        quota = self.quota_for(tenant)
+        if quota is None:
+            return True
+        with self._lock:
+            now = self._clock()
+            window = self._windows.get(tenant)
+            if window is None or now - window["start"] >= quota.window_seconds:
+                return True  # elapsed/absent window: a fresh window has zero burn
+            return not self._burn(window, quota)["exceeded"]
 
     def note_degraded_shed(self, tenant: str, recorder: Optional[Any] = None) -> None:
         """Reclassify one DEFER decision as SHED (full-backlog degrade).

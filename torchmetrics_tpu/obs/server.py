@@ -632,6 +632,16 @@ class IntrospectionServer:
                 + (f" [tenant {tenant}]" if tenant else "")
                 + f": {alert['detail']}"
             )
+        # live-session migrations in flight (engine/migrate.py, announced via
+        # scope.migration): degraded-not-dead with the MIGRATING tenant named —
+        # a rolling deploy's handoff window is an expected, visible state, not
+        # a silent gap in the tenant list
+        migrating = _scope.migrating_tenants()
+        for tenant, phase in sorted(migrating.items()):
+            tenants_degraded.add(tenant)
+            reasons.append(
+                f"live-session migration in flight for tenant {tenant!r} (phase: {phase})"
+            )
         status = "degraded" if reasons else "ok"
         return {
             "status": status,
@@ -643,6 +653,8 @@ class IntrospectionServer:
             # the offending tenant(s), named: a degraded serving process must
             # say WHO is sick, not just that someone is
             "tenants_degraded": sorted(tenants_degraded),
+            # migration handoffs in flight: {tenant: phase}
+            "tenants_migrating": migrating,
             "n_metrics": len(self.metrics()),
             "trace_enabled": trace.is_enabled(),
         }

@@ -192,6 +192,51 @@ class ValueLog:
                 for row in self._series.values()
             ]
 
+    def restore_series(self, rows: Any) -> int:
+        """Re-install serialized series rows (the :meth:`series` shape).
+
+        The live-session migration seam (:mod:`torchmetrics_tpu.engine.migrate`):
+        a restored session's value timelines keep their original ``(step, wall,
+        value)`` anchors — the watchdogs' frozen/jump windows and the step axis
+        of every point survive the host move instead of restarting at zero.
+        Appends in order (an existing series extends; the ring bound still
+        drops oldest) and respects the series cap exactly like live recording.
+        Points a series *already holds* are skipped by exact ``(step, wall)``
+        match — restoring a session back into its origin log (or two restores
+        of the same bundle) must not double the timeline and fool the frozen/
+        jump windows. Returns the number of points restored.
+        """
+        restored = 0
+        for row in rows or []:
+            bounds = row.get("bounds")
+            key = (
+                str(row["metric"]),
+                str(row.get("inst", "0")),
+                str(row.get("leaf", ROOT_LEAF)),
+                str(row.get("tenant")) if row.get("tenant") else "",
+            )
+            with self._lock:
+                existing = self._series.get(key)
+                seen = (
+                    {(p[0], p[1]) for p in existing["points"]} if existing is not None else set()
+                )
+            for point in row.get("points") or []:
+                step, wall, value = point[0], point[1], point[2]
+                if (int(step), float(wall)) in seen:
+                    continue
+                if self.record(
+                    row["metric"],
+                    row.get("inst", "0"),
+                    row.get("leaf", ROOT_LEAF),
+                    step,
+                    value,
+                    bounds=tuple(bounds) if bounds is not None else None,
+                    wall=wall,
+                    tenant=row.get("tenant") or None,
+                ):
+                    restored += 1
+        return restored
+
     def latest(
         self,
         metric: str,

@@ -32,7 +32,13 @@ import numpy as np
 
 from torchmetrics_tpu.core.metric import _ROBUST_STATE_KEY, Metric
 
-__all__ = ["CheckpointIntegrityError", "load_checkpoint", "save_checkpoint"]
+__all__ = [
+    "CheckpointIntegrityError",
+    "atomic_install_dir",
+    "file_tree_digest",
+    "load_checkpoint",
+    "save_checkpoint",
+]
 
 _DATA_SUBDIR = "data"
 _INTEGRITY_NAME = "INTEGRITY.json"
@@ -128,33 +134,17 @@ def _tree_digest(tree: Any) -> str:
     return digest.hexdigest()
 
 
-def save_checkpoint(target: Union[Metric, Any], path: str) -> str:
-    """Write ``target``'s full state (mid-epoch included) to ``path`` via orbax.
+def atomic_install_dir(tmp: str, path: str, tag: str) -> str:
+    """Swap a fully-materialized temp directory into place at ``path``.
 
-    ``target`` is a :class:`Metric` or a ``MetricCollection``. Returns the absolute
-    checkpoint path. Overwrites an existing checkpoint at the same path — atomically:
-    the new checkpoint is fully materialized (tree + integrity record) under a temp
-    directory first, then swapped in with renames, so preemption mid-save leaves
-    either the old checkpoint or the new one, never a hybrid.
+    The hardened half of the temp-dir+rename writer, shared by metric
+    checkpoints and live-session bundles (:mod:`torchmetrics_tpu.engine.migrate`):
+    a displace-then-rename loop (a concurrent saver can install a new dir at
+    ``path`` between our displace and rename — displace again and retry rather
+    than stranding the fully-written tmp), then a sweep of stale ``.old.*`` /
+    ``.tmp.*`` siblings old enough that no live save owns them. ``tmp`` must be
+    fully written (integrity record included) before this is called.
     """
-    ocp = _require_orbax()
-
-    path = os.path.abspath(path)
-    tree = _tree_of(target)
-    # tag beyond the pid: containerized pod hosts commonly share pid 1, and two
-    # hosts saving to the same shared-storage path must never collide on tmp
-    tag = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
-    tmp = f"{path}.tmp.{tag}"
-    try:
-        ocp.PyTreeCheckpointer().save(os.path.join(tmp, _DATA_SUBDIR), tree, force=True)
-        with open(os.path.join(tmp, _INTEGRITY_NAME), "w") as fh:
-            json.dump({"version": 1, "sha256": _tree_digest(tree)}, fh)
-    except BaseException:
-        shutil.rmtree(tmp, ignore_errors=True)
-        raise
-    # swap with a displace-then-rename loop: a concurrent saver can install a
-    # new dir at `path` between our displace and rename (ENOTEMPTY) — displace
-    # again and retry rather than stranding the fully-written tmp
     displaced = []
     for attempt in range(3):
         old = f"{path}.old.{tag}.{attempt}"
@@ -183,6 +173,61 @@ def save_checkpoint(target: Union[Metric, Any], path: str) -> str:
         except OSError:
             pass  # vanished under us (another sweeper won the race)
     return path
+
+
+def file_tree_digest(root: str, exclude: tuple = ()) -> str:
+    """Deterministic SHA-256 over every file under ``root`` (relpath + bytes).
+
+    The integrity digest for directory bundles whose contents are opaque files
+    (the session-bundle layout) rather than a restorable pytree: files are
+    walked in sorted relative-path order and hashed as (path, content), so a
+    truncated, tampered, renamed or missing file flips the digest. ``exclude``
+    names relative paths to skip — the integrity record itself.
+    """
+    digest = hashlib.sha256()
+    excluded = {str(e).replace(os.sep, "/") for e in exclude}
+    entries = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames.sort()
+        for fname in filenames:
+            full = os.path.join(dirpath, fname)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            if rel in excluded:
+                continue
+            entries.append((rel, full))
+    for rel, full in sorted(entries):
+        digest.update(rel.encode())
+        with open(full, "rb") as fh:
+            for chunk in iter(lambda: fh.read(1 << 20), b""):
+                digest.update(chunk)
+    return digest.hexdigest()
+
+
+def save_checkpoint(target: Union[Metric, Any], path: str) -> str:
+    """Write ``target``'s full state (mid-epoch included) to ``path`` via orbax.
+
+    ``target`` is a :class:`Metric` or a ``MetricCollection``. Returns the absolute
+    checkpoint path. Overwrites an existing checkpoint at the same path — atomically:
+    the new checkpoint is fully materialized (tree + integrity record) under a temp
+    directory first, then swapped in with renames, so preemption mid-save leaves
+    either the old checkpoint or the new one, never a hybrid.
+    """
+    ocp = _require_orbax()
+
+    path = os.path.abspath(path)
+    tree = _tree_of(target)
+    # tag beyond the pid: containerized pod hosts commonly share pid 1, and two
+    # hosts saving to the same shared-storage path must never collide on tmp
+    tag = f"{os.getpid()}.{uuid.uuid4().hex[:8]}"
+    tmp = f"{path}.tmp.{tag}"
+    try:
+        ocp.PyTreeCheckpointer().save(os.path.join(tmp, _DATA_SUBDIR), tree, force=True)
+        with open(os.path.join(tmp, _INTEGRITY_NAME), "w") as fh:
+            json.dump({"version": 1, "sha256": _tree_digest(tree)}, fh)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return atomic_install_dir(tmp, path, tag)
 
 
 def _recover_displaced(path: str) -> Optional[str]:
