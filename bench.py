@@ -982,6 +982,72 @@ def ref_pr_curve() -> float:
 # ------------------------------------------------------------------------ chaos
 
 
+def _checkpoint_overhead_probe(batches: int = 64, cadence: int = 4) -> dict:
+    """Checkpoint-cadence overhead: the same stream with the policy on vs off.
+
+    A small fused pipeline folds ``batches`` identical batches twice — once
+    plain, once with a ``CheckpointPolicy(every_batches=cadence)`` writing
+    delta bundles to a tempdir — and the per-batch wall ratio is recorded.
+    Rides the bench line's top-level ``checkpoint`` key through
+    ``obs.regress.run_record`` recorded-but-never-judged (the ``memory``
+    passthrough pattern), so the cadence tax accumulates as a trend without
+    gating anything; PERF.md carries the methodology.
+    """
+    import shutil
+    import tempfile
+    import time as _time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.engine import CheckpointPolicy, MetricPipeline, PipelineConfig
+
+    rng = np.random.RandomState(0)
+    data = [
+        (
+            jnp.asarray(rng.rand(32, 4).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 4, 32)),
+        )
+        for _ in range(batches)
+    ]
+
+    def run(policy):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2, checkpoint=policy))
+        pipe.warmup(*data[0])
+        start = _time.perf_counter()
+        for b in data:
+            pipe.feed(*b)
+        pipe.flush()
+        import jax
+
+        jax.block_until_ready(metric._state_values)
+        return _time.perf_counter() - start, pipe._checkpointer
+
+    off_seconds, _ = run(None)
+    ckpt_dir = tempfile.mkdtemp(prefix="tm_tpu_ckpt_probe_")
+    try:
+        on_seconds, checkpointer = run(
+            CheckpointPolicy(directory=ckpt_dir, every_batches=cadence, full_every=4, keep=4)
+        )
+        stats = checkpointer.stats
+        out = {
+            "batches": batches,
+            "cadence_batches": cadence,
+            "off_us_per_batch": round(off_seconds / batches * 1e6, 3),
+            "on_us_per_batch": round(on_seconds / batches * 1e6, 3),
+            "overhead_ratio": round(on_seconds / off_seconds, 4) if off_seconds > 0 else None,
+            "bundles_full": stats["full"]["count"],
+            "bundles_delta": stats["delta"]["count"],
+            "bundle_bytes_full": stats["full"]["bytes"],
+            "bundle_bytes_delta": stats["delta"]["bytes"],
+        }
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    return out
+
+
 def _chaos_main(argv) -> None:
     """``python bench.py --chaos``: the traffic-replay chaos bench.
 
@@ -1002,7 +1068,8 @@ def _chaos_main(argv) -> None:
     parser.add_argument("--chaos-tenants", type=int, default=8)
     parser.add_argument("--chaos-seed", type=int, default=0)
     parser.add_argument(
-        "--chaos-scenario", choices=("default", "high_tenant", "rolling_deploy"),
+        "--chaos-scenario",
+        choices=("default", "high_tenant", "rolling_deploy", "host_crash"),
         default="default",
         help="high_tenant: >=64 tenants with shared signatures and bursty arrivals,"
              " replayed through the cross-tenant multiplexer and judged against the"
@@ -1011,7 +1078,14 @@ def _chaos_main(argv) -> None:
              " sessions migrate to the survivor via the live-session"
              " checkpoint/restore protocol, judged against the rolling-deploy SLO"
              " spec incl. bit-identity vs unmigrated controls (configs prefixed"
-             " chaos_rd_*)",
+             " chaos_rd_*)."
+             " host_crash: one 'host' dies UNPLANNED (SIGKILL semantics, no drain)"
+             " mid-traffic; its sessions ran continuous periodic delta bundles"
+             " (engine/migrate.py CheckpointPolicy) and are recovered from the"
+             " newest intact bundle with the replay gap re-fed from the"
+             " deterministic schedule, judged against the host-crash SLO spec"
+             " incl. gap<=cadence, bit-identity vs unkilled controls and"
+             " delta-vs-full bundle bytes (configs prefixed chaos_hc_*)",
     )
     parser.add_argument(
         "--chaos-schedule", default=None,
@@ -1074,6 +1148,12 @@ def _chaos_main(argv) -> None:
         # shadow controls proving bit-identity; own prefix, own baselines
         result = chaos.replay(sched, chaos.ReplayConfig(rolling_deploy=True))
         report = chaos.judge(result, chaos.rolling_deploy_slo_spec(), prefix="chaos_rd")
+    elif args.chaos_scenario == "host_crash":
+        # the crash-consistency scenario: host B dies with SIGKILL semantics
+        # (no drain, no final checkpoint); recovery restores from the last
+        # continuous periodic bundle and re-feeds the bounded replay gap
+        result = chaos.replay(sched, chaos.ReplayConfig(host_crash=True))
+        report = chaos.judge(result, chaos.host_crash_slo_spec(), prefix="chaos_hc")
     else:
         result = chaos.replay(sched)
         report = chaos.judge(result)
@@ -1108,8 +1188,16 @@ def _chaos_main(argv) -> None:
             "mux": result["mux"],
             # live-migration accounting (None unless rolling_deploy)
             "migration": result.get("migration"),
+            # crash-recovery accounting (None unless host_crash)
+            "crash": result.get("crash"),
         },
     }
+    if args.chaos_scenario == "host_crash":
+        # the cadence-overhead probe rides the host-crash runs: checkpointing
+        # on vs off on an identical stream, recorded-never-judged
+        probe = _safe(_checkpoint_overhead_probe)
+        if probe is not None:
+            line["checkpoint"] = probe
     print(json.dumps(line, sort_keys=True, default=str))
     if args.chaos_report:
         atomic_write_text(

@@ -748,3 +748,206 @@ class TestRollingDeployEndToEnd:
         }  # last index + 1 = total batches
         for tenant in result["migration"]["tenants"]:
             assert result["pipelines"][tenant]["batches"] == per_tenant[tenant]
+
+
+# --------------------------------------------------------- host-crash scenario
+
+
+class TestHostCrashJudge:
+    """The crash-consistency SLO rows over fabricated results (fast, no replay)."""
+
+    def _crash_result(self, **overrides):
+        crash = {
+            "tenants": ["tenant-02", "tenant-03"],
+            "cadence_batches": 4,
+            "recovery_seconds": 0.2,
+            "replay_gap_batches": 2,
+            "sessions": {
+                "tenant-02": {"fed_at_crash": 6, "restored_cursor": 4,
+                              "replay_gap_batches": 2, "bundle": "bundle-000000"},
+                "tenant-03": {"fed_at_crash": 6, "restored_cursor": 4,
+                              "replay_gap_batches": 2, "bundle": "bundle-000001"},
+            },
+            "torn_bundle_skipped": True,
+            "controls": {
+                "tenant-02": {"dtype": "float32", "items": 256, "bit_identical": True},
+                "tenant-03": {"dtype": "float32", "items": 232, "bit_identical": True},
+            },
+            "zero_loss": True,
+            "checkpoints": {
+                "full_bundles": 4, "delta_bundles": 5,
+                "full_bytes_mean": 150000.0, "delta_bytes_mean": 20000.0,
+                "delta_full_ratio": 20000.0 / 150000.0,
+            },
+        }
+        crash.update(overrides)
+        return _fake_result(crash=crash)
+
+    def _spec(self):
+        return chaos_slo.host_crash_slo_spec(cadence_batches=4)
+
+    def test_spec_shape(self):
+        spec = self._spec()
+        assert spec.max_replay_gap_batches == 4
+        assert spec.require_crash_zero_loss
+        assert spec.max_recovery_seconds is not None
+        assert spec.max_delta_full_ratio is not None
+        assert spec.require_poisoned_named  # ordinary chaos SLOs keep holding
+
+    def test_passing_crash(self):
+        report = chaos_slo.judge(self._crash_result(), self._spec(), prefix="chaos_hc")
+        assert report["passed"], chaos_slo.format_report(report)
+        assert report["configs"]["chaos_hc_slo_pass"]["value"] == 1.0
+        assert report["configs"]["chaos_hc_replay_gap_batches"]["value"] == 2.0
+        assert report["configs"]["chaos_hc_crashed_tenants"]["value"] == 2.0
+        assert report["configs"]["chaos_hc_delta_bundle_bytes_ratio"]["value"] == pytest.approx(
+            20000.0 / 150000.0, abs=1e-6
+        )
+
+    def test_gap_beyond_cadence_fails(self):
+        report = chaos_slo.judge(
+            self._crash_result(replay_gap_batches=7), self._spec(), prefix="chaos_hc"
+        )
+        assert "replay_gap_batches" in report["failed"]
+
+    def test_diverged_control_fails_zero_loss(self):
+        result = self._crash_result(
+            controls={
+                "tenant-02": {"dtype": "float32", "items": 256, "bit_identical": True},
+                "tenant-03": {"dtype": "float32", "items": 200, "bit_identical": False},
+            }
+        )
+        report = chaos_slo.judge(result, self._spec(), prefix="chaos_hc")
+        assert "crash_zero_loss" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "crash_zero_loss")
+        assert "tenant-03" in row["detail"]
+
+    def test_torn_bundle_chosen_fails_zero_loss(self):
+        report = chaos_slo.judge(
+            self._crash_result(torn_bundle_skipped=False), self._spec(), prefix="chaos_hc"
+        )
+        assert "crash_zero_loss" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "crash_zero_loss")
+        assert "torn" in row["detail"]
+
+    def test_no_crash_at_all_fails(self):
+        # a result with NO crash section at all: nothing was measured
+        report = chaos_slo.judge(_fake_result(), self._spec(), prefix="chaos_hc")
+        assert "crash_zero_loss" in report["failed"]
+        assert "replay_gap_batches" in report["failed"]  # no gap measured either
+        # crashed-but-empty (the deploy never selected anyone) also fails
+        report = chaos_slo.judge(
+            self._crash_result(tenants=[], controls={}), self._spec(), prefix="chaos_hc"
+        )
+        assert "crash_zero_loss" in report["failed"]
+
+    def test_delta_not_smaller_fails(self):
+        result = self._crash_result(
+            checkpoints={"full_bundles": 2, "delta_bundles": 2,
+                         "full_bytes_mean": 100.0, "delta_bytes_mean": 95.0,
+                         "delta_full_ratio": 0.95}
+        )
+        report = chaos_slo.judge(result, self._spec(), prefix="chaos_hc")
+        assert "delta_bundle_bytes_ratio" in report["failed"]
+
+    def test_slow_recovery_fails_budget(self):
+        report = chaos_slo.judge(
+            self._crash_result(recovery_seconds=99.0), self._spec(), prefix="chaos_hc"
+        )
+        assert "recovery_seconds" in report["failed"]
+
+    def test_default_spec_ignores_crash_section(self):
+        # the default scenario's judge must not grow crash rows
+        report = chaos_slo.judge(self._crash_result())
+        crash_rows = ("replay_gap_batches", "crash_zero_loss", "recovery_seconds",
+                      "delta_bundle_bytes_ratio")
+        assert not any(r["slo"] in crash_rows for r in report["slos"])
+
+    def test_host_crash_config_validation(self):
+        with pytest.raises(ValueError, match="host_crash"):
+            ReplayConfig(host_crash=True, multiplex=True)
+        with pytest.raises(ValueError, match="host_crash"):
+            ReplayConfig(host_crash=True, rolling_deploy=True)
+        with pytest.raises(ValueError, match="checkpoint_every_batches"):
+            ReplayConfig(host_crash=True, checkpoint_every_batches=0)
+
+
+class TestHostCrashEndToEnd:
+    @pytest.fixture(scope="class")
+    def run(self):
+        """One real host crash: host B SIGKILL'd mid-traffic (no drain, no
+        final checkpoint), recovered from its continuous periodic bundles,
+        chaos continuing throughout."""
+        sched = chaos_schedule.generate(
+            ScheduleConfig(
+                seed=0,
+                tenants=8,
+                warm_batches=2,
+                churn_batches=2,
+                drain_batches=3,
+                hang_seconds=0.5,
+                absent_after_seconds=0.15,
+                idle_gap_seconds=0.01,
+            )
+        )
+        result = replay(sched, ReplayConfig(host_crash=True, checkpoint_every_batches=4))
+        report = chaos_slo.judge(
+            result, chaos_slo.host_crash_slo_spec(cadence_batches=4), prefix="chaos_hc"
+        )
+        return sched, result, report
+
+    def test_host_crash_passes_all_slos(self, run):
+        _, _, report = run
+        assert report["passed"], chaos_slo.format_report(report)
+
+    def test_recovered_sessions_bit_identical_to_controls(self, run):
+        _, result, _ = run
+        crash = result["crash"]
+        assert crash["zero_loss"] is True
+        assert len(crash["tenants"]) >= 1
+        for tenant, row in crash["controls"].items():
+            assert row["bit_identical"], (tenant, row)
+
+    def test_replay_gap_bounded_by_cadence(self, run):
+        _, result, _ = run
+        crash = result["crash"]
+        assert crash["replay_gap_batches"] <= crash["cadence_batches"]
+        for tenant, session in crash["sessions"].items():
+            assert 0 <= session["replay_gap_batches"] <= crash["cadence_batches"], (
+                tenant,
+                session,
+            )
+            # the restore point really is BEHIND the crash (unplanned death:
+            # the open chunk was lost, not drained)
+            assert session["restored_cursor"] <= session["fed_at_crash"]
+
+    def test_torn_midwrite_bundle_was_skipped(self, run):
+        _, result, _ = run
+        assert result["crash"]["torn_bundle_skipped"] is True
+        for session in result["crash"]["sessions"].values():
+            assert session["bundle"] != "bundle-999999"
+
+    def test_delta_bundles_measurably_smaller_than_full(self, run):
+        _, result, _ = run
+        checkpoints = result["crash"]["checkpoints"]
+        assert checkpoints["full_bundles"] >= 1 and checkpoints["delta_bundles"] >= 1
+        assert checkpoints["delta_full_ratio"] < 0.8, checkpoints
+
+    def test_fault_surfaces_survive_the_crash(self, run):
+        sched, result, report = run
+        # the victim/hung/poisoned tenants stayed on host A: their watchdogs
+        # fired AND resolved through the crash + recovery window
+        for fault in ("poison", "hang"):
+            assert report["configs"][f"chaos_hc_time_to_fire_{fault}"]["value"] >= 0.0
+            assert report["configs"][f"chaos_hc_time_to_resolve_{fault}"]["value"] >= 0.0
+        assert set(crashed := result["crash"]["tenants"]).isdisjoint(
+            {sched.victim, sched.hung}
+        ), crashed
+
+    def test_recovered_tenants_keep_serving_after_restore(self, run):
+        sched, result, _ = run
+        # every crashed tenant's recovered pipeline covers its FULL schedule
+        # traffic: restored cursor + gap re-feed + post-crash stream
+        per_tenant = {ev["tenant"]: ev["index"] + 1 for ev in sched.batches()}
+        for tenant in result["crash"]["tenants"]:
+            assert result["pipelines"][tenant]["batches"] == per_tenant[tenant]

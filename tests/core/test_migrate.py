@@ -22,14 +22,21 @@ import numpy as np
 import pytest
 
 from torchmetrics_tpu import MetricCollection
-from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric
 from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
 from torchmetrics_tpu.engine import (
+    CheckpointPolicy,
     MetricPipeline,
+    MuxConfig,
     PipelineConfig,
     SessionBundleError,
+    TenantMultiplexer,
     checkpoint_session,
+    checkpoint_staleness_rule,
+    compact_chain,
+    latest_valid_bundle,
     restore_session,
+    sweep_bundles,
     verify_bundle,
 )
 from torchmetrics_tpu.engine import migrate as migrate_mod
@@ -627,3 +634,628 @@ class TestRestoreWarmup:
         assert manifest["variants"] > 0
         assert manifest["cache_dir"] is not None
         pipe2.close()
+
+
+# ------------------------------------------------------- path-traversal guard
+
+
+class TestPathTraversal:
+    def _bundle(self, tmp_path):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2))
+        for b in _class_batches(2):
+            pipe.feed(*b)
+        path = str(tmp_path / "bundle")
+        checkpoint_session(pipe, path)
+        pipe.close()
+        return path
+
+    def test_symlinked_file_in_bundle_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        outside = tmp_path / "outside.txt"
+        outside.write_text("secret")
+        os.symlink(str(outside), os.path.join(path, "evil"))
+        with pytest.raises(SessionBundleError, match="symlink"):
+            verify_bundle(path)
+        # the restore path hits the same wall before touching the target
+        target = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        with pytest.raises(SessionBundleError, match="symlink"):
+            restore_session(target, path)
+        assert target.update_count == 0
+
+    def test_symlinked_directory_in_bundle_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        outside_dir = tmp_path / "outside_dir"
+        outside_dir.mkdir()
+        (outside_dir / "x.bin").write_bytes(b"\x00")
+        os.symlink(str(outside_dir), os.path.join(path, "evil_dir"))
+        with pytest.raises(SessionBundleError, match="symlink"):
+            verify_bundle(path)
+
+    def test_file_tree_digest_guard_is_at_the_utils_layer(self, tmp_path):
+        # the guard lives in utils/checkpoint.file_tree_digest, so EVERY
+        # consumer (metric checkpoints included) refuses escaping trees
+        from torchmetrics_tpu.utils.checkpoint import (
+            CheckpointIntegrityError,
+            file_tree_digest,
+        )
+
+        root = tmp_path / "tree"
+        root.mkdir()
+        (root / "ok.bin").write_bytes(b"\x01")
+        os.symlink(str(tmp_path / "elsewhere"), str(root / "link"))
+        with pytest.raises(CheckpointIntegrityError, match="symlink"):
+            file_tree_digest(str(root))
+
+    def test_chain_base_name_with_separators_rejected(self, tmp_path):
+        path = self._bundle(tmp_path)
+        manifest_path = os.path.join(path, "MANIFEST.json")
+        manifest = json.load(open(manifest_path))
+        manifest["base"] = {"name": "../../etc", "bundle_id": "x"}
+        with open(manifest_path, "w") as fh:
+            json.dump(manifest, fh)
+        from torchmetrics_tpu.utils.checkpoint import file_tree_digest
+
+        digest = file_tree_digest(path, exclude=("INTEGRITY.json",))
+        with open(os.path.join(path, "INTEGRITY.json"), "w") as fh:
+            json.dump({"version": 1, "sha256": digest}, fh)
+        with pytest.raises(SessionBundleError, match="base"):
+            verify_bundle(path)
+
+
+# ----------------------------------------------------------------- delta chains
+
+
+def _cat_factory():
+    # a large MaskedBuffer state: appends only touch a few delta segments
+    return CatMetric(capacity=1 << 14, nan_strategy="disable")
+
+
+def _cat_batches(n, size=32, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.rand(size).astype(np.float32)),) for _ in range(n)]
+
+
+def _build_chain(tmp_path, n_batches=9, every=2, full_every=8):
+    """A pipeline + continuous policy producing full→delta→delta… bundles."""
+    directory = str(tmp_path / "stream")
+    metric = _cat_factory()
+    pipe = MetricPipeline(
+        metric,
+        PipelineConfig(
+            fuse=2,
+            tenant="chain-t",
+            checkpoint=CheckpointPolicy(
+                directory=directory,
+                every_batches=every,
+                full_every=full_every,
+                keep=64,
+                segment_bytes=4096,
+            ),
+        ),
+    )
+    batches = _cat_batches(n_batches)
+    for b in batches:
+        pipe.feed(*b)
+    pipe.flush()
+    bundles = sorted(
+        name for name in os.listdir(directory) if name.startswith("bundle-")
+    )
+    return directory, bundles, batches, pipe
+
+
+class TestDeltaChains:
+    def test_deltas_are_written_and_measurably_smaller(self, tmp_path):
+        directory, bundles, _, pipe = _build_chain(tmp_path)
+        stats = pipe._checkpointer.stats
+        assert stats["full"]["count"] >= 1 and stats["delta"]["count"] >= 2
+        full_mean = stats["full"]["bytes"] / stats["full"]["count"]
+        delta_mean = stats["delta"]["bytes"] / stats["delta"]["count"]
+        assert delta_mean < 0.5 * full_mean, (full_mean, delta_mean)
+        # linkage on disk: the first bundle is full, the rest name their base
+        manifests = [
+            json.load(open(os.path.join(directory, name, "MANIFEST.json")))
+            for name in bundles
+        ]
+        assert manifests[0]["base"] is None
+        for prev, manifest in zip(manifests, manifests[1:]):
+            assert manifest["base"]["bundle_id"] == prev["bundle_id"]
+            # the delta wrote a strict subset of the entry set
+            assert set(manifest["written"]) < set(manifest["entries"])
+        pipe.close()
+
+    def test_restore_from_every_chain_prefix(self, tmp_path):
+        directory, bundles, batches, pipe = _build_chain(tmp_path)
+        pipe.close()
+        for name in bundles:
+            target = _cat_factory()
+            restored_pipe, manifest = restore_session(
+                target, os.path.join(directory, name)
+            )
+            restored_pipe.close()
+            cursor = manifest["cursor"]["batches_ingested"]
+            control = _cat_factory()
+            for b in batches[:cursor]:
+                control.update(*b)
+            assert _bits(target.compute()) == _bits(control.compute()), name
+
+    def test_tamper_any_file_in_any_link_rejects_the_top(self, tmp_path):
+        import shutil
+
+        directory, bundles, _, pipe = _build_chain(tmp_path)
+        pipe.close()
+        top = os.path.join(directory, bundles[-1])
+        assert len(bundles) >= 3
+        cases = []
+        for name in bundles:
+            link = os.path.join(directory, name)
+            for fname in sorted(os.listdir(link)):
+                cases.append((name, fname))
+        assert cases
+        for name, fname in cases:
+            copy_root = str(tmp_path / f"copy_{name}_{fname}")
+            shutil.copytree(directory, copy_root)
+            victim = os.path.join(copy_root, name, fname)
+            with open(victim, "r+b") as fh:
+                fh.seek(max(0, os.path.getsize(victim) // 2))
+                byte = fh.read(1) or b"\x00"
+                fh.seek(max(0, os.path.getsize(victim) // 2))
+                fh.write(bytes([byte[0] ^ 0xFF]))
+            with pytest.raises(SessionBundleError):
+                verify_bundle(os.path.join(copy_root, bundles[-1]))
+
+    def test_substituted_base_rejected_by_bundle_id(self, tmp_path):
+        directory, bundles, _, pipe = _build_chain(tmp_path)
+        pipe.close()
+        base_name = bundles[0]
+        # a VALID bundle (fresh checkpoint) replaces the base: digests check
+        # out per link, but it is not the bundle the delta was written against
+        metric = _cat_factory()
+        imposter = MetricPipeline(metric, PipelineConfig(fuse=2))
+        for b in _cat_batches(2, seed=9):
+            imposter.feed(*b)
+        checkpoint_session(imposter, os.path.join(directory, base_name))
+        imposter.close()
+        with pytest.raises(SessionBundleError, match="bundle_id"):
+            verify_bundle(os.path.join(directory, bundles[-1]))
+
+    def test_compaction_bit_equivalent_to_the_chain(self, tmp_path):
+        directory, bundles, _, pipe = _build_chain(tmp_path)
+        pipe.close()
+        top = os.path.join(directory, bundles[-1])
+        out = str(tmp_path / "compacted")
+        manifest = compact_chain(top, out)
+        assert manifest["base"] is None
+        assert sorted(manifest["written"]) == sorted(manifest["entries"])
+        assert manifest["compacted_from"] == verify_bundle(top)["bundle_id"]
+        a, b = _cat_factory(), _cat_factory()
+        pa, _ = restore_session(a, top)
+        pb, _ = restore_session(b, out)
+        pa.close(), pb.close()
+        assert _bits(a.compute()) == _bits(b.compute())
+        # the compacted bundle stands alone: the chain can vanish
+        import shutil
+
+        for name in bundles:
+            shutil.rmtree(os.path.join(directory, name))
+        c = _cat_factory()
+        pc, _ = restore_session(c, out)
+        pc.close()
+        assert _bits(c.compute()) == _bits(a.compute())
+
+    def test_retention_sweep_never_deletes_a_live_chain_link(self, tmp_path):
+        directory, bundles, _, pipe = _build_chain(tmp_path)
+        pipe.close()
+        # keep=1 keeps the newest bundle — which is a delta, so its WHOLE
+        # chain back to the full root must survive the sweep
+        removed = sweep_bundles(directory, keep=1)
+        assert removed == []  # every bundle is a link of the newest chain
+        top = os.path.join(directory, bundles[-1])
+        verify_bundle(top)  # still restores end to end
+        # a later FULL bundle makes the old chain sweepable
+        target = _cat_factory()
+        new_pipe, _ = restore_session(target, top)
+        new_pipe.feed(*_cat_batches(1, seed=5)[0])
+        new_full = checkpoint_session(new_pipe, os.path.join(directory, "bundle-100000"))
+        new_pipe.close()
+        assert new_full["base"] is None
+        removed = sweep_bundles(directory, keep=1)
+        assert removed  # the superseded chain went away
+        assert os.path.isdir(os.path.join(directory, "bundle-100000"))
+        verify_bundle(os.path.join(directory, "bundle-100000"))
+
+
+# --------------------------------------------------------- continuous cadence
+
+
+class TestContinuousPolicy:
+    def test_policy_validation(self):
+        with pytest.raises(ValueError, match="cadence"):
+            CheckpointPolicy(directory="/tmp/x", every_batches=0, every_seconds=0)
+        with pytest.raises(ValueError, match="full_every"):
+            CheckpointPolicy(directory="/tmp/x", every_batches=1, full_every=0)
+        with pytest.raises(ValueError, match="keep"):
+            CheckpointPolicy(directory="/tmp/x", every_batches=1, keep=0)
+        with pytest.raises(ValueError, match="stale_after_seconds"):
+            CheckpointPolicy(directory="/tmp/x", every_batches=1, stale_after_seconds=0)
+
+    def test_batch_cadence_writes_at_commit_boundaries_without_drain(self, tmp_path):
+        directory = str(tmp_path / "stream")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=2,
+                checkpoint=CheckpointPolicy(directory=directory, every_batches=2, keep=64),
+            ),
+        )
+        batches = _class_batches(5)
+        for b in batches:
+            pipe.feed(*b)
+        # 5 fed, fuse=2: commits at 2 and 4 → two bundles; batch 5 sits in the
+        # OPEN chunk — no drain happened, the session is still live
+        bundles = sorted(n for n in os.listdir(directory) if n.startswith("bundle-"))
+        assert len(bundles) == 2
+        manifest = verify_bundle(os.path.join(directory, bundles[-1]))
+        assert manifest["cursor"]["batches_ingested"] == 4
+        assert metric.update_count == 4  # open chunk NOT dispatched by the write
+        pipe.close()  # close flushes + writes the final complete bundle
+        latest = latest_valid_bundle(directory)
+        assert verify_bundle(latest)["cursor"]["batches_ingested"] == 5
+
+    def test_time_cadence(self, tmp_path):
+        import time as _time
+
+        directory = str(tmp_path / "stream")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=1,
+                checkpoint=CheckpointPolicy(
+                    directory=directory, every_seconds=0.05, keep=64
+                ),
+            ),
+        )
+        pipe.feed(*_class_batches(1)[0])
+        # not due yet: the interval has not elapsed since the session started
+        n_first = len(os.listdir(directory)) if os.path.isdir(directory) else 0
+        _time.sleep(0.08)
+        pipe.feed(*_class_batches(1, seed=1)[0])
+        assert len(os.listdir(directory)) > n_first
+        pipe.close()
+
+    def test_checkpoint_now_forces_a_bundle(self, tmp_path):
+        directory = str(tmp_path / "stream")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=4,
+                checkpoint=CheckpointPolicy(directory=directory, every_batches=1000),
+            ),
+        )
+        pipe.feed(*_class_batches(1)[0])
+        assert pipe.checkpoint_now() is not None
+        assert latest_valid_bundle(directory) is not None
+        pipe.close()
+
+    def test_unwritable_directory_warns_once_and_stream_flows(self, tmp_path):
+        blocker = tmp_path / "blocked"
+        blocker.write_text("a file where the directory should be")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=1,
+                checkpoint=CheckpointPolicy(directory=str(blocker), every_batches=1),
+            ),
+        )
+        with pytest.warns(RuntimeWarning, match="Continuous checkpoint"):
+            pipe.feed(*_class_batches(1)[0])
+        # further feeds keep flowing, silently counted
+        for b in _class_batches(3, seed=2):
+            pipe.feed(*b)
+        assert pipe._checkpointer.failures >= 2
+        assert metric.update_count == 4
+        pipe.close()
+
+    def test_checkpoint_gauges_and_tenants_join(self, tmp_path):
+        from torchmetrics_tpu.obs.server import IntrospectionServer
+
+        directory = str(tmp_path / "stream")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=1,
+                tenant="gauge-t",
+                checkpoint=CheckpointPolicy(
+                    directory=directory, every_batches=1, stale_after_seconds=3600.0
+                ),
+            ),
+        )
+        for b in _class_batches(3):
+            pipe.feed(*b)
+        info = obs_scope.record_gauges()
+        assert info["checkpoint_rows"] == 1
+        names = {g["name"] for g in trace.get_recorder().snapshot()["gauges"]}
+        assert "checkpoint.last_success_age_seconds" in names
+        assert "checkpoint.bundle_bytes" in names
+        server = IntrospectionServer(metrics=[metric])
+        try:
+            row = next(
+                r for r in server.tenants_report()["tenants"] if r["tenant"] == "gauge-t"
+            )
+            assert row["checkpoint"] is not None
+            assert row["checkpoint"]["bundles"]["full"] >= 1
+            assert row["checkpoint"]["stale"] is False
+            assert server.health()["status"] == "ok"  # fresh within budget
+        finally:
+            server.stop()
+        pipe.close()
+
+    def test_clean_close_ends_the_freshness_promise(self, tmp_path):
+        import time as _time
+
+        from torchmetrics_tpu.obs.server import IntrospectionServer
+
+        directory = str(tmp_path / "stream")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=1,
+                tenant="closed-t",
+                checkpoint=CheckpointPolicy(
+                    directory=directory, every_batches=1, stale_after_seconds=0.02
+                ),
+            ),
+        )
+        pipe.feed(*_class_batches(1)[0])
+        pipe.close()
+        _time.sleep(0.05)  # well past the budget — but the session is CLOSED
+        assert obs_scope.checkpoint_overdue() == {}
+        server = IntrospectionServer(metrics=[])
+        try:
+            health = server.health()
+            assert health["status"] == "ok", health["reasons"]
+            assert health["checkpoints_stale"] == {}
+        finally:
+            server.stop()
+        # the closed row stops exporting the live age gauge too, so a
+        # checkpoint_stale threshold rule cannot strand itself firing
+        obs_scope.record_gauges()
+        gauges = {
+            (g["name"], g["labels"].get("tenant"))
+            for g in trace.get_recorder().snapshot()["gauges"]
+        }
+        assert ("checkpoint.last_success_age_seconds", "closed-t") not in gauges
+        # the bundle accounting survives (it describes work that happened)
+        assert obs_scope.checkpoint_status()["closed-t"]["bundles"]["full"] >= 1
+        # a restored session reopens the promise on its next bundle
+        restored = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe2, _ = restore_session(
+            restored,
+            latest_valid_bundle(directory),
+            checkpoint=CheckpointPolicy(
+                directory=directory, every_batches=1, stale_after_seconds=3600.0
+            ),
+        )
+        pipe2.feed(*_class_batches(1, seed=4)[0])
+        assert obs_scope.checkpoint_status()["closed-t"]["closed"] is False
+        pipe2.close()
+
+    def test_clean_close_skips_a_duplicate_final_bundle(self, tmp_path):
+        directory = str(tmp_path / "stream")
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(
+            metric,
+            PipelineConfig(
+                fuse=2,
+                checkpoint=CheckpointPolicy(directory=directory, every_batches=2, keep=64),
+            ),
+        )
+        for b in _class_batches(4):
+            pipe.feed(*b)  # commits at 2 and 4; the cadence wrote at both
+        n_before = len(os.listdir(directory))
+        pipe.close()  # everything already covered: no byte-identical duplicate
+        assert len(os.listdir(directory)) == n_before
+
+    def test_staleness_flips_healthz_and_alert_rule(self):
+        import time as _time
+
+        from torchmetrics_tpu.obs.alerts import AlertEngine
+        from torchmetrics_tpu.obs.server import IntrospectionServer
+
+        obs_scope.adopt("stale-t")
+        obs_scope.note_checkpoint(
+            "stale-t", path="/x", nbytes=10, kind="full", seconds=0.01,
+            stale_after_seconds=0.02,
+        )
+        _time.sleep(0.05)
+        server = IntrospectionServer(metrics=[])
+        try:
+            health = server.health()
+            assert health["status"] == "degraded"
+            assert "stale-t" in health["tenants_degraded"]
+            assert "stale-t" in health["checkpoints_stale"]
+            assert any("checkpoint stale" in r for r in health["reasons"])
+        finally:
+            server.stop()
+        engine = AlertEngine(rules=[checkpoint_staleness_rule(0.02, tenant="stale-*")])
+        obs_scope.record_gauges()  # refresh the age gauge (the scrape path)
+        engine.evaluate()
+        firing = engine.firing()
+        assert firing and firing[0]["rule"] == "checkpoint_stale"
+        assert firing[0]["tenant"] == "stale-t"
+
+
+# -------------------------------------------------------- mux slice extraction
+
+
+class TestMuxSliceExtraction:
+    def _factory(self):
+        return MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+
+    def test_slice_restores_into_pipeline_bit_identical(self, tmp_path):
+        mux = TenantMultiplexer(self._factory, MuxConfig(max_width=8))
+        history = {t: [] for t in ("a", "b", "c")}
+        for t in history:
+            mux.adopt(t)
+        for i in range(6):
+            for t in history:
+                b = _class_batches(1, seed=100 * i + ord(t[0]))[0]
+                history[t].append(b)
+                mux.feed(t, *b)
+        mux.flush()
+        manifest = checkpoint_session(mux, str(tmp_path / "slice"), tenant="b")
+        assert manifest["tenant"] == "b" and manifest["mux_slice"] is True
+        assert manifest["cursor"]["batches_ingested"] == 6
+        mux.close()
+
+        restored = self._factory()
+        pipe, _ = restore_session(restored, str(tmp_path / "slice"))
+        # the whole fed stream is already folded; the session just continues
+        pipe.feed(*_class_batches(1, seed=77)[0])
+        pipe.close()
+        control = self._factory()
+        for b in history["b"]:
+            control.update(*b)
+        control.update(*_class_batches(1, seed=77)[0])
+        assert _bits(restored.compute()) == _bits(control.compute())
+
+    def test_slice_carries_pending_row_via_flush_and_deferred_tail(self, tmp_path):
+        clock = [0.0]
+        controller = obs_scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "b",
+            obs_scope.TenantQuota(
+                updates_per_window=2, window_seconds=60.0, over_quota=obs_scope.DEFER
+            ),
+        )
+        mux = TenantMultiplexer(
+            self._factory, MuxConfig(max_width=8, admission=controller)
+        )
+        for t in ("a", "b"):
+            mux.adopt(t)
+        batches = _class_batches(4, seed=3)
+        for b in batches:
+            mux.feed("b", *b)
+        # 2 admitted (one possibly pending in an open group), 2 deferred
+        manifest = checkpoint_session(mux, str(tmp_path / "slice"), tenant="b")
+        assert manifest["cursor"]["batches_ingested"] == 2  # pending row flushed
+        assert manifest["cursor"]["tail_batches"] == 2  # the deferred backlog
+        mux.close()
+        restored = self._factory()
+        pipe, _ = restore_session(restored, str(tmp_path / "slice"))
+        pipe.flush()
+        pipe.close()
+        control = self._factory()
+        for b in batches:
+            control.update(*b)
+        assert _bits(restored.compute()) == _bits(control.compute())
+
+    def test_mux_checkpoint_session_requires_tenant(self, tmp_path):
+        mux = TenantMultiplexer(self._factory, MuxConfig(max_width=4))
+        mux.adopt("a")
+        with pytest.raises(ValueError, match="tenant"):
+            checkpoint_session(mux, str(tmp_path / "slice"))
+        with pytest.raises(ValueError, match="not multiplexed"):
+            checkpoint_session(mux, str(tmp_path / "slice"), tenant="nope")
+        mux.close()
+
+    def test_mux_continuous_policy_writes_per_tenant_streams(self, tmp_path):
+        directory = str(tmp_path / "mux_stream")
+        mux = TenantMultiplexer(
+            self._factory,
+            MuxConfig(
+                max_width=8,
+                checkpoint=CheckpointPolicy(directory=directory, every_batches=4, keep=8),
+            ),
+        )
+        history = {t: [] for t in ("x", "y")}
+        for t in history:
+            mux.adopt(t)
+        for i in range(6):
+            for t in history:
+                b = _class_batches(1, seed=10 * i + ord(t[0]))[0]
+                history[t].append(b)
+                mux.feed(t, *b)
+        mux.flush()
+        for t in history:
+            latest = latest_valid_bundle(os.path.join(directory, t))
+            assert latest is not None
+            manifest = verify_bundle(latest)
+            assert manifest["tenant"] == t
+        mux.close()
+        # an abandoned mux (crash) is recoverable per tenant from its stream
+        restored = self._factory()
+        latest = latest_valid_bundle(os.path.join(directory, "x"))
+        pipe, manifest = restore_session(restored, latest)
+        cursor = manifest["cursor"]["batches_ingested"]
+        for b in history["x"][cursor:]:
+            pipe.feed(*b)
+        pipe.close()
+        control = self._factory()
+        for b in history["x"]:
+            control.update(*b)
+        assert _bits(restored.compute()) == _bits(control.compute())
+
+
+# --------------------------------------------------------------- operator CLI
+
+
+class TestOperatorCLI:
+    def _bundle(self, tmp_path):
+        metric = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+        pipe = MetricPipeline(metric, PipelineConfig(fuse=2))
+        for b in _class_batches(3):
+            pipe.feed(*b)
+        path = str(tmp_path / "bundle")
+        checkpoint_session(pipe, path)
+        pipe.close()
+        return path
+
+    def test_verify_intact_exits_0(self, tmp_path, capsys):
+        path = self._bundle(tmp_path)
+        assert migrate_mod.main(["verify", path]) == 0
+        out = capsys.readouterr().out
+        assert "OK" in out and "chain depth 1" in out
+
+    def test_verify_corrupt_exits_1(self, tmp_path, capsys):
+        path = self._bundle(tmp_path)
+        with open(os.path.join(path, "state.npz"), "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"\xff")
+        assert migrate_mod.main(["verify", path]) == 1
+        assert "CORRUPT" in capsys.readouterr().err
+
+    def test_verify_chain_aware_exits_1_on_tampered_base(self, tmp_path, capsys):
+        directory, bundles, _, pipe = _build_chain(tmp_path)
+        pipe.close()
+        base = os.path.join(directory, bundles[0], "state.npz")
+        with open(base, "r+b") as fh:
+            fh.seek(10)
+            fh.write(b"\xff")
+        # the TOP bundle's own files are intact; only the chain walk can tell
+        assert migrate_mod.main(["verify", os.path.join(directory, bundles[-1])]) == 1
+        assert "CORRUPT" in capsys.readouterr().err
+
+    def test_verify_missing_exits_2(self, tmp_path, capsys):
+        assert migrate_mod.main(["verify", str(tmp_path / "nope")]) == 2
+        assert "cannot run" in capsys.readouterr().err
+
+    def test_module_entrypoint_runs(self, tmp_path):
+        import subprocess
+        import sys
+
+        path = self._bundle(tmp_path)
+        proc = subprocess.run(
+            [sys.executable, "-m", "torchmetrics_tpu.engine.migrate", "verify", path],
+            capture_output=True,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "OK" in proc.stdout

@@ -452,3 +452,40 @@ class TestBenchWiring:
         with pytest.raises(SystemExit) as err:
             bench._record_history(result, check=True)
         assert err.value.code == 2
+
+
+class TestCheckpointPassthrough:
+    def test_checkpoint_overhead_rides_along_recorded_never_judged(self):
+        from torchmetrics_tpu.obs import regress
+
+        result = {
+            "hardware": "cpu-fallback",
+            "configs": {"a": {"value": 10.0, "unit": "us/step"}},
+            "checkpoint": {
+                "batches": 64,
+                "cadence_batches": 4,
+                "off_us_per_batch": 1200.0,
+                "on_us_per_batch": 2500.0,
+                "overhead_ratio": 2.08,
+                "bundles_full": 4,
+                "bundles_delta": 12,
+            },
+        }
+        record = regress.run_record(result)
+        assert record["checkpoint"]["overhead_ratio"] == 2.08
+        # carried through, but the gate only walks `configs` — a 100x
+        # overhead jump must not flag anything (the memory contract)
+        history = [
+            regress.run_record({**result, "checkpoint": {"overhead_ratio": 0.01}})
+        ]
+        rows = regress.check_regressions(record, history)
+        assert [row["config"] for row in rows] == ["a"]
+        assert not any(row["regressed"] for row in rows)
+
+    def test_absent_checkpoint_key_stays_absent(self):
+        from torchmetrics_tpu.obs import regress
+
+        record = regress.run_record(
+            {"hardware": "x", "configs": {"a": {"value": 1.0, "unit": "us/step"}}}
+        )
+        assert "checkpoint" not in record

@@ -82,4 +82,5 @@ def test_two_process_aggregate_battery(tmp_path):
         "tenant_rows_merge_fleet_wide": True,
         "degraded_keeps_tenant_attribution": True,
         "session_migrates_across_hosts_bit_identical": True,
+        "worker_killed_without_drain_recovers": True,
     }

@@ -280,6 +280,90 @@ def main() -> None:
     results["session_migrates_across_hosts_bit_identical"] = True
     scope.reset()
 
+    # -- 10. worker killed WITHOUT drain: crash-consistent recovery ------------
+    # (the host-crash primitive, 2-process-validated: rank 1 runs a live
+    # tenant pipeline with a continuous CheckpointPolicy writing periodic
+    # delta bundles to shared disk, then "dies" with kill -9 semantics — NO
+    # drain, NO close, NO final checkpoint, the session object is simply
+    # abandoned mid-stream with a batch in the open fusion chunk. Rank 0
+    # scans the shared bundle directory, restores from the last periodic
+    # bundle, re-feeds the bounded replay gap from the deterministic stream,
+    # finishes the traffic, and its compute() is BIT-identical to rank 1's
+    # unkilled control. The fleet aggregate attributes the recovered tenant
+    # on both hosts.)
+    from torchmetrics_tpu.engine.migrate import (
+        CheckpointPolicy,
+        latest_valid_bundle,
+        restore_session,
+        verify_bundle,
+    )
+
+    crash_dir = os.path.join(shared, "crash_stream")
+    crash_expected = os.path.join(shared, "crash_expected.json")
+    crash_rng = np.random.RandomState(7)
+    crash_batches = [
+        (
+            jnp.asarray(crash_rng.rand(16, 4).astype(np.float32)),
+            jnp.asarray(crash_rng.randint(0, 4, 16)),
+        )
+        for _ in range(10)
+    ]
+
+    if pid == 1:
+        control = mig_metric()
+        for p_, t_ in crash_batches:
+            control.update(p_, t_)
+        expected = np.asarray(control.compute())
+        doomed = mig_metric()
+        pipe = MetricPipeline(
+            doomed,
+            PipelineConfig(
+                fuse=2,
+                tenant="t-crash",
+                checkpoint=CheckpointPolicy(
+                    directory=crash_dir, every_batches=2, full_every=4, keep=8
+                ),
+            ),
+        )
+        for p_, t_ in crash_batches[:7]:
+            pipe.feed(p_, t_)
+        # kill -9: 7 fed, 6 committed+checkpointed, 1 lost in the open chunk —
+        # deliberately NO drain/close/checkpoint_now; the object is abandoned
+        del pipe
+        tmp = crash_expected + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"dtype": str(expected.dtype), "hex": expected.tobytes().hex()}, fh)
+        os.replace(tmp, crash_expected)
+    # collective barrier: the bundle stream + oracle are on shared disk before
+    # the survivor scans them
+    aggregate()
+    if pid == 0:
+        bundle = latest_valid_bundle(crash_dir)
+        assert bundle is not None, os.listdir(crash_dir)
+        manifest = verify_bundle(bundle)
+        assert manifest["tenant"] == "t-crash"
+        cursor = manifest["cursor"]["batches_ingested"]
+        assert cursor == 6, manifest["cursor"]  # the last periodic bundle
+        survivor = mig_metric()
+        pipe2, _ = restore_session(survivor, bundle)
+        # the replay gap (batch 7, lost in the dead host's open chunk) plus
+        # the rest of the stream, re-fed from the deterministic source
+        for p_, t_ in crash_batches[cursor:]:
+            pipe2.feed(p_, t_)
+        pipe2.close()
+        got = np.asarray(survivor.compute())
+        with open(crash_expected) as fh:
+            oracle = json.load(fh)
+        assert str(got.dtype) == oracle["dtype"]
+        assert got.tobytes().hex() == oracle["hex"], (got.tolist(), oracle)
+    fleet = aggregate()
+    crash_rows = {row["tenant"]: row for row in fleet["tenants"]}
+    # the recovered tenant is attributed on BOTH hosts: it served on host 1,
+    # crashed, and finished (restored) on host 0
+    assert crash_rows["t-crash"]["hosts"] == [0, 1], crash_rows
+    results["worker_killed_without_drain_recovers"] = True
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

@@ -37,6 +37,7 @@ from torchmetrics_tpu.chaos.slo import (
     SLOSpec,
     format_report,
     high_tenant_slo_spec,
+    host_crash_slo_spec,
     judge,
     rolling_deploy_slo_spec,
 )
@@ -53,6 +54,7 @@ __all__ = [
     "generate",
     "high_tenant_config",
     "high_tenant_slo_spec",
+    "host_crash_slo_spec",
     "judge",
     "load",
     "loads",

@@ -21,11 +21,19 @@ The execution layer between user batch streams and the ``Metric`` /
   (metric, shape-bucket, static-config) variant before the loop, JAX
   **persistent compilation cache** wiring (``TM_TPU_COMPILE_CACHE``), and the
   warmup manifest recording what startup compiled.
-- :mod:`~torchmetrics_tpu.engine.migrate` — **live-session checkpoint/restore**:
-  a running pipeline session (state + replay tail + flight ring + report +
-  registry row + value timelines + alert machines) as an atomic,
-  integrity-checked bundle; drain→checkpoint→restore→replay-tail with
-  bit-identical restores and degraded-not-dead ``/healthz`` while in flight.
+- :mod:`~torchmetrics_tpu.engine.migrate` — **live-session checkpoint/restore
+  and continuous crash-consistent checkpointing**: a running pipeline session
+  (state + replay tail + flight ring + report + registry row + value
+  timelines + alert machines) as an atomic, integrity-checked bundle;
+  drain→checkpoint→restore→replay-tail with bit-identical restores and
+  degraded-not-dead ``/healthz`` while in flight. A
+  :class:`~torchmetrics_tpu.engine.migrate.CheckpointPolicy` on
+  ``PipelineConfig.checkpoint`` / ``MuxConfig.checkpoint`` writes periodic
+  **delta bundles** at chunk-commit boundaries (no drain) with chain-aware
+  verification, compaction and retention; after an unplanned death,
+  :func:`~torchmetrics_tpu.engine.migrate.latest_valid_bundle` +
+  :func:`~torchmetrics_tpu.engine.migrate.restore_session` recover the
+  session with a replay gap bounded by the cadence.
 
 Quick start::
 
@@ -39,9 +47,14 @@ Quick start::
 
 from torchmetrics_tpu.engine.migrate import (
     SESSION_SCHEMA,
+    CheckpointPolicy,
     SessionBundleError,
     checkpoint_session,
+    checkpoint_staleness_rule,
+    compact_chain,
+    latest_valid_bundle,
     restore_session,
+    sweep_bundles,
     verify_bundle,
 )
 from torchmetrics_tpu.engine.mux import MuxConfig, MuxReport, TenantMultiplexer
@@ -66,6 +79,7 @@ __all__ = [
     "CACHE_ENV_VAR",
     "FLIGHT_DIR_ENV",
     "SESSION_SCHEMA",
+    "CheckpointPolicy",
     "MetricPipeline",
     "MuxConfig",
     "MuxReport",
@@ -75,12 +89,16 @@ __all__ = [
     "TenantMultiplexer",
     "build_manifest",
     "checkpoint_session",
+    "checkpoint_staleness_rule",
+    "compact_chain",
     "configure_compile_cache",
     "configured_cache_dir",
+    "latest_valid_bundle",
     "load_manifest",
     "persistent_cache_stats",
     "pow2_buckets",
     "restore_session",
     "save_manifest",
+    "sweep_bundles",
     "verify_bundle",
 ]

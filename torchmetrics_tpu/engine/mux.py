@@ -132,6 +132,14 @@ class MuxConfig:
         flight_max_dumps: hard cap on dump files one multiplexer writes
             (suppressed dumps are counted).
         device: target device for stacked batches (``None``: default device).
+        checkpoint: a :class:`~torchmetrics_tpu.engine.migrate.CheckpointPolicy`
+            — continuous checkpointing for the multiplexed plane. On cadence
+            (counted over the mux's committed batches / wall clock, checked at
+            group-commit boundaries) every adopted tenant's **slice** is
+            written as its own pipeline-restorable bundle stream under
+            ``<directory>/<tenant>/`` (delta-encoded, compacted, swept — the
+            :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline` policy
+            semantics per tenant). ``None`` (default) disables.
     """
 
     max_width: int = 64
@@ -145,6 +153,7 @@ class MuxConfig:
     flight_dump_dir: Optional[str] = None
     flight_max_dumps: int = 16
     device: Any = None
+    checkpoint: Any = None
 
     def __post_init__(self) -> None:
         if self.max_width < 1:
@@ -348,6 +357,10 @@ class TenantMultiplexer:
         # per-tenant ingest ordinals: flight records and dump attribution name
         # TENANT-LOCAL batch indices (the schedule/SLO ground-truth shape)
         self._tenant_batch_index: Dict[str, int] = {}
+        # per-tenant PROCESSED counts (fused commits + eager + replays): the
+        # slice-checkpoint cursor — never counts a row still pending in an
+        # open group, so every slice bundle is commit-consistent
+        self._tenant_folded: Dict[str, int] = {}
         self._group_seq = 0
         self._last_readmit_check = 0.0
         self._instance = str(next(TenantMultiplexer._instance_seq))
@@ -371,6 +384,12 @@ class TenantMultiplexer:
         # that actually executed, not a cross-width mean
         self._width_prices: Dict[int, Tuple[Optional[float], Optional[float]]] = {}
         self._closed = False
+        # continuous checkpointing (engine/migrate.py): one bundle stream per
+        # adopted tenant under <policy.directory>/<tenant>, gated by ONE
+        # mux-level cadence so a trigger snapshots the whole cohort
+        self._checkpointers: Dict[str, Any] = {}
+        self._ckpt_last_batches = 0
+        self._ckpt_last_time = time.monotonic()
         for tenant, metric in (metrics or {}).items():
             self.adopt(tenant, metric)
         # persistent compile cache wiring is part of engine startup (no-op
@@ -463,7 +482,66 @@ class TenantMultiplexer:
         self._metrics[effective] = metric
         self._aliases[raw] = effective
         _scope.get_registry().pipeline_started(effective)
+        if self.config.checkpoint is not None and effective not in self._checkpointers:
+            from dataclasses import replace as _dc_replace
+
+            from torchmetrics_tpu.engine.migrate import ContinuousCheckpointer
+
+            policy = _dc_replace(
+                self.config.checkpoint,
+                directory=os.path.join(self.config.checkpoint.directory, effective),
+            )
+            self._checkpointers[effective] = ContinuousCheckpointer(
+                policy, tenant=effective, label=self._label
+            )
         return metric
+
+    def _maybe_checkpoint(self, force: bool = False, skip_covered: bool = False) -> int:
+        """Group-commit-boundary hook: when the mux-level cadence is due, every
+        tenant's slice is written (its own delta stream). Returns bundles
+        written. Open (undispatched) rows are excluded per tenant, so each
+        slice is commit-consistent without flushing anyone's pending group.
+
+        On cadence an idle tenant still gets a (near-empty) delta — the bundle
+        is its freshness heartbeat; ``skip_covered`` (the close path) skips
+        slices the last bundle already covers, since the freshness contract
+        ends with the session anyway."""
+        if not self._checkpointers:
+            return 0
+        policy = self.config.checkpoint
+        committed = (
+            self._report.fused_updates
+            + self._report.eager_updates
+            + self._report.replayed_updates
+        )
+        if not force:
+            due_batches = (
+                policy.every_batches
+                and committed - self._ckpt_last_batches >= policy.every_batches
+            )
+            due_time = (
+                policy.every_seconds
+                and time.monotonic() - self._ckpt_last_time >= policy.every_seconds
+            )
+            if not due_batches and not due_time:
+                return 0
+        self._ckpt_last_batches = committed
+        self._ckpt_last_time = time.monotonic()
+        written = 0
+        for tenant, checkpointer in self._checkpointers.items():
+            if (
+                checkpointer.maybe_mux_slice(
+                    self, tenant, force=True, skip_if_covered=skip_covered
+                )
+                is not None
+            ):
+                written += 1
+        return written
+
+    def checkpoint_now(self) -> int:
+        """Force one slice bundle per tenant (cadence bypassed); returns the
+        number written (0 without a configured ``CheckpointPolicy``)."""
+        return self._maybe_checkpoint(force=True)
 
     def _effective(self, tenant: str) -> str:
         """The session label a raw tenant name maps to (adopting on demand)."""
@@ -723,6 +801,11 @@ class TenantMultiplexer:
         try:
             self.flush()
             self.flush_deferred()
+            # the slice streams end complete: a clean close leaves per-tenant
+            # restore points covering every batch the mux ever folded (slices
+            # the cadence already covered skip the duplicate write)
+            if self._checkpointers and self._report.batches:
+                self._maybe_checkpoint(force=True, skip_covered=True)
             self._evaluate_alerts([], force=True)
         finally:
             if not self._closed:
@@ -730,6 +813,10 @@ class TenantMultiplexer:
                 registry = _scope.get_registry()
                 for tenant in self._metrics:
                     registry.pipeline_finished(tenant)
+                for tenant in self._checkpointers:
+                    # the freshness promise ends with the sessions (see the
+                    # pipeline close path)
+                    _scope.note_checkpoint_closed(tenant)
         return self.report()
 
     def __enter__(self) -> "TenantMultiplexer":
@@ -1028,6 +1115,7 @@ class TenantMultiplexer:
             replayed.append(tenant)
         for tenant, poisoned in poisoned_by_tenant.items():
             self._dump_flight(reason, tenant, poisoned)
+        self._maybe_checkpoint()
         self._evaluate_alerts(replayed)
         if errors:
             raise errors[0]
@@ -1074,6 +1162,7 @@ class TenantMultiplexer:
             # compiled program — no per-leaf host slicing here
             with _scope.session(tenant):
                 self._commit(self._metrics[tenant], new_states[i])
+            self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
             committed.append(tenant)
             record = row[3] if len(row) > 3 else None
             if record is not None:
@@ -1093,6 +1182,7 @@ class TenantMultiplexer:
             _trace.set_gauge("engine.mux_open_groups", len(self._groups), mux=self._label)
         if controller is not None:
             self._charge_rows(controller, committed, width, ledger_mark)
+        self._maybe_checkpoint()
         self._evaluate_alerts(committed)
 
     def _commit(self, target: Union[Metric, MetricCollection], state: Any) -> None:
@@ -1176,11 +1266,13 @@ class TenantMultiplexer:
                     target.update(*args, **kwargs)
             else:
                 target.update(*args, **kwargs)
+        self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.eager_updates += 1
         self._report.eager_dispatches += 1
         if _trace.ENABLED:
             _trace.inc("engine.mux_eager_updates", mux=self._label)
         self._mark_eager_fault(tenant, record, before)
+        self._maybe_checkpoint()
         self._evaluate_alerts([tenant])
 
     def _drive_eager_leaders(self, tenant: str, args: tuple, kwargs: dict) -> None:
@@ -1203,9 +1295,11 @@ class TenantMultiplexer:
                 m.update(*args, **filtered)
             if self._is_collection:
                 target._sync_group_states()
+        self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.eager_updates += 1
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
         self._mark_eager_fault(tenant, record, before)
+        self._maybe_checkpoint()
         self._evaluate_alerts([tenant])
 
     def _replay_row(self, tenant: str, args: tuple, kwargs: dict) -> None:
@@ -1218,6 +1312,7 @@ class TenantMultiplexer:
                     self._replay_updates(target, args, kwargs)
             else:
                 self._replay_updates(target, args, kwargs)
+        self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.replayed_updates += 1
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
         if _trace.ENABLED:

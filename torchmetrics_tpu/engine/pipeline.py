@@ -161,6 +161,14 @@ class PipelineConfig:
             pipelines never consult admission.
         max_deferred: cap on the deprioritized backlog (deferred batches hold
             real device arrays); past it, defer decisions degrade to shed.
+        checkpoint: a :class:`~torchmetrics_tpu.engine.migrate.CheckpointPolicy`
+            — the **continuous checkpointing** seam. Bundles are written every
+            N batches / T seconds at chunk-commit boundaries (no drain, no
+            stall; the committed state is chunk-consistent by construction),
+            delta-encoded against their predecessor, compacted every
+            ``full_every``-th write, retention-swept, and scanned back with
+            :func:`~torchmetrics_tpu.engine.migrate.latest_valid_bundle` after
+            an unplanned death. ``None`` (default) disables — zero overhead.
     """
 
     fuse: int = 8
@@ -176,6 +184,7 @@ class PipelineConfig:
     alert_every: int = 1
     admission: Any = None
     max_deferred: int = 1024
+    checkpoint: Any = None
 
     def __post_init__(self) -> None:
         if self.tenant is not None:
@@ -479,9 +488,30 @@ class MetricPipeline:
                     m._obs_tenant = self._tenant
             if self._flight is not None:
                 self._flight.tenant = self._tenant
+        self._checkpointer = None
+        if config.checkpoint is not None:
+            # lazy import: migrate.py imports this module at load time
+            from torchmetrics_tpu.engine.migrate import ContinuousCheckpointer
+
+            self._checkpointer = ContinuousCheckpointer(
+                config.checkpoint, tenant=self._tenant, label=self._label
+            )
         # wiring the persistent compile cache is part of engine startup: no-op
         # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
         _warmup.configure_compile_cache()
+
+    def _maybe_checkpoint(self, force: bool = False) -> Optional[str]:
+        """Continuous-checkpoint hook, called at chunk-commit boundaries only —
+        so every periodic bundle is chunk-consistent without a drain."""
+        if self._checkpointer is None:
+            return None
+        return self._checkpointer.maybe_pipeline(self, force=force)
+
+    def checkpoint_now(self) -> Optional[str]:
+        """Force one continuous-checkpoint bundle (cadence bypassed); returns
+        its path, or ``None`` without a configured ``CheckpointPolicy``."""
+        with self._tenant_ctx():
+            return self._maybe_checkpoint(force=True)
 
     def _tenant_ctx(self):
         """The session scope every public entry point runs under (no-op when
@@ -724,6 +754,14 @@ class MetricPipeline:
                     jax.block_until_ready(self._inflight.popleft())
                 if _trace.ENABLED:
                     _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
+                # the bundle stream ends complete: a clean close leaves a
+                # restore point covering every batch the session ever folded
+                # (skipped when the cadence already covered the final commit —
+                # no byte-identical duplicate bundle on shutdown)
+                if self._checkpointer is not None and self._report.batches:
+                    self._checkpointer.maybe_pipeline(
+                        self, force=True, skip_if_covered=True
+                    )
                 self._evaluate_alerts(force=True)
         finally:
             # the session ends exactly once, however many times close() runs —
@@ -732,6 +770,11 @@ class MetricPipeline:
             if self._tenant is not None and not self._tenant_closed:
                 self._tenant_closed = True
                 _scope.get_registry().pipeline_finished(self._tenant)
+                if self._checkpointer is not None:
+                    # the freshness promise ends WITH the session: a closed
+                    # session must not age into /healthz staleness or a
+                    # firing checkpoint_stale alert
+                    _scope.note_checkpoint_closed(self._tenant)
         return self.report()
 
     def compute(self) -> Any:
@@ -1108,6 +1151,7 @@ class MetricPipeline:
             record["stages"]["dispatch"] = round(dispatch_seconds, 6)
             record["stages"]["commit"] = round(commit_seconds, 6)
             record["stages"]["blocked_on_inflight"] = round(waited, 6)
+        self._maybe_checkpoint()
         self._evaluate_alerts()
 
     def _commit(self, new_state: Any, n: int) -> None:
@@ -1212,6 +1256,7 @@ class MetricPipeline:
                 # the per-batch path has no replay step: the quarantine itself
                 # is the fault event, so it dumps the lineage directly
                 self._dump_flight("quarantine", [record["batch_index"]])
+        self._maybe_checkpoint()
         self._evaluate_alerts()
 
     def _drive_eager_leaders(self, args: tuple, kwargs: dict) -> None:
@@ -1253,6 +1298,7 @@ class MetricPipeline:
             record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
             if self._mark_fault(record, before) == "quarantined":
                 self._dump_flight("quarantine", [record["batch_index"]])
+        self._maybe_checkpoint()
         self._evaluate_alerts()
 
     def _replay_chunk(self, chunk: _Chunk, cid: int) -> None:
@@ -1316,6 +1362,7 @@ class MetricPipeline:
         for record in chunk.records:
             record["stages"]["blocked_on_inflight"] = round(waited, 6)
         self._dump_flight("chunk_replay", poisoned)
+        self._maybe_checkpoint()
         self._evaluate_alerts()
 
     # ------------------------------------------------------------ alerting seam

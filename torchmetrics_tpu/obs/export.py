@@ -300,6 +300,13 @@ _GAUGE_HELP = {
     # dispatch folds many tenants' same-signature updates
     "engine.mux_width": "Tenant count of the multiplexer's last fused dispatch (pre-padding)",
     "engine.mux_open_groups": "Same-signature tenant groups currently accumulating in the multiplexer",
+    # continuous-checkpointing families (engine/migrate.py CheckpointPolicy):
+    # crash-recovery liveness per tenant session, refreshed per scrape
+    "checkpoint.last_success_age_seconds": "Wall-clock seconds since the tenant session's last successful periodic bundle",
+    "checkpoint.write_seconds": "Wall seconds the last continuous-checkpoint bundle write took",
+    "checkpoint.bundle_bytes": "Mean bundle bytes per checkpoint kind (full vs delta) for this tenant session",
+    "checkpoint.bundles": "Continuous-checkpoint bundles written per kind (full vs delta)",
+    "checkpoint.failures": "Continuous-checkpoint writes that failed (stream kept flowing; staleness grows)",
 }
 
 

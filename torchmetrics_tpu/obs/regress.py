@@ -183,6 +183,14 @@ def run_record(
         # cross-tenant multiplexer stats (per-side compiled variants, speedup
         # vs per-tenant pipelines, dispatch widths): same passthrough contract
         record["mux"] = mux
+    checkpoint = result.get("checkpoint")
+    if isinstance(checkpoint, dict):
+        # continuous-checkpointing cadence overhead (bench.py probe: the same
+        # stream with the CheckpointPolicy on vs off, plus full/delta bundle
+        # byte totals): recorded so the cadence tax accumulates as a trend
+        # across rounds, never judged by check_regressions — exactly the
+        # `memory` passthrough pattern
+        record["checkpoint"] = checkpoint
     cost = result.get("cost")
     if isinstance(cost, dict):
         # XLA cost-ledger summary (per-config variants compiled + estimated

@@ -69,6 +69,8 @@ __all__ = [
     "TenantQuota",
     "TenantRegistry",
     "adopt",
+    "checkpoint_overdue",
+    "checkpoint_status",
     "configure",
     "current_tenant",
     "get_admission",
@@ -76,6 +78,9 @@ __all__ = [
     "install_admission",
     "migrating_tenants",
     "migration",
+    "note_checkpoint",
+    "note_checkpoint_closed",
+    "note_checkpoint_failure",
     "note_compute",
     "note_update",
     "record_gauges",
@@ -332,6 +337,8 @@ def reset() -> None:
     _ADMISSION = None
     with _MIGRATION_LOCK:
         _MIGRATIONS.clear()
+    with _CHECKPOINT_LOCK:
+        _CHECKPOINTS.clear()
     ENABLED = False
 
 
@@ -474,6 +481,119 @@ def migrating_tenants() -> Dict[str, str]:
     """Tenants with a migration in flight: ``{tenant: current phase}``."""
     with _MIGRATION_LOCK:
         return {tenant: stack[-1] for tenant, stack in _MIGRATIONS.items() if stack}
+
+
+# ------------------------------------------------------------------ checkpoints
+
+# per-tenant continuous-checkpoint liveness (engine/migrate.py's
+# ContinuousCheckpointer reports here): last success, full-vs-delta bundle
+# accounting, and the optional staleness budget /healthz judges. Lives here —
+# pure stdlib, next to the liveness registry — so the obs server can surface
+# checkpoint freshness without importing the engine layer, and so the record
+# survives the session object whose crash it exists to describe.
+_CHECKPOINTS: Dict[str, Dict[str, Any]] = {}
+_CHECKPOINT_LOCK = threading.Lock()
+
+
+def note_checkpoint(
+    tenant: str,
+    path: str,
+    nbytes: int,
+    kind: str,
+    seconds: float,
+    stale_after_seconds: Optional[float] = None,
+) -> None:
+    """Record one successful continuous-checkpoint bundle for ``tenant``.
+
+    ``kind`` is ``"full"`` or ``"delta"``; ``stale_after_seconds`` (when the
+    session's policy declares one) is the budget :func:`checkpoint_overdue`
+    and ``/healthz`` judge the last-success age against.
+    """
+    validate_tenant(tenant)
+    now = time.time()
+    with _CHECKPOINT_LOCK:
+        row = _CHECKPOINTS.setdefault(
+            tenant,
+            {
+                "tenant": tenant,
+                "bundles": {"full": 0, "delta": 0},
+                "bytes": {"full": 0, "delta": 0},
+                "failures": 0,
+            },
+        )
+        row["last_unix"] = now
+        row["last_path"] = str(path)
+        row["last_kind"] = str(kind)
+        row["last_bytes"] = int(nbytes)
+        row["last_write_seconds"] = float(seconds)
+        row["closed"] = False  # a fresh bundle reopens a closed session's row
+        if kind in row["bundles"]:
+            row["bundles"][kind] += 1
+            row["bytes"][kind] += int(nbytes)
+        if stale_after_seconds is not None:
+            row["stale_after_seconds"] = float(stale_after_seconds)
+
+
+def note_checkpoint_failure(tenant: str) -> None:
+    """Count one failed continuous-checkpoint write for ``tenant``."""
+    with _CHECKPOINT_LOCK:
+        row = _CHECKPOINTS.get(tenant)
+        if row is None:
+            row = _CHECKPOINTS[tenant] = {
+                "tenant": tenant,
+                "bundles": {"full": 0, "delta": 0},
+                "bytes": {"full": 0, "delta": 0},
+                "failures": 0,
+            }
+        row["failures"] += 1
+
+
+def note_checkpoint_closed(tenant: str) -> None:
+    """Mark ``tenant``'s checkpointed session as cleanly closed.
+
+    A closed session has no freshness promise: its age must stop being judged
+    (``/healthz`` staleness) and stop being exported as the live
+    ``checkpoint.last_success_age_seconds`` gauge — otherwise every cleanly
+    shut-down session would flip the fleet degraded ``stale_after_seconds``
+    later and strand a staleness alert firing forever. The bundle accounting
+    (counts, bytes, failures) stays — it describes work that happened. A later
+    :func:`note_checkpoint` (the session restarted or was restored) reopens
+    the row.
+    """
+    with _CHECKPOINT_LOCK:
+        row = _CHECKPOINTS.get(tenant)
+        if row is not None:
+            row["closed"] = True
+
+
+def checkpoint_status() -> Dict[str, Dict[str, Any]]:
+    """Per-tenant checkpoint liveness rows (deep-copied; the /tenants join)."""
+    with _CHECKPOINT_LOCK:
+        return {
+            tenant: {**row, "bundles": dict(row["bundles"]), "bytes": dict(row["bytes"])}
+            for tenant, row in _CHECKPOINTS.items()
+        }
+
+
+def checkpoint_overdue(now: Optional[float] = None) -> Dict[str, Dict[str, float]]:
+    """Tenants whose last successful bundle is older than their declared budget.
+
+    ``{tenant: {"age": seconds_since_success, "budget": stale_after_seconds}}``
+    — only tenants whose policy declared ``stale_after_seconds`` are judged;
+    the rest checkpoint on a best-effort cadence without a health contract.
+    """
+    now = time.time() if now is None else now
+    overdue: Dict[str, Dict[str, float]] = {}
+    with _CHECKPOINT_LOCK:
+        for tenant, row in _CHECKPOINTS.items():
+            budget = row.get("stale_after_seconds")
+            last = row.get("last_unix")
+            if budget is None or last is None or row.get("closed"):
+                continue  # a cleanly closed session promises no freshness
+            age = now - float(last)
+            if age > float(budget):
+                overdue[tenant] = {"age": age, "budget": float(budget)}
+    return overdue
 
 
 # --------------------------------------------------------------------- admission
@@ -845,8 +965,39 @@ def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
         # the admission plane's quota/burn gauges refresh alongside the
         # registry's: one scrape shows who is active AND who is over budget
         quota_rows = _ADMISSION.record_gauges(recorder=rec)
+    # continuous-checkpoint liveness (engine/migrate.py): the last-success age
+    # refreshes per scrape, so checkpoint_staleness_rule's threshold series and
+    # the /healthz staleness reason read a live number, not the write-time one
+    checkpoint_rows = checkpoint_status()
+    for tenant, row in checkpoint_rows.items():
+        labels = {"tenant": tenant}
+        last = row.get("last_unix")
+        if last is not None and not row.get("closed"):
+            # the age gauge is a LIVE-session signal only: a cleanly closed
+            # session must not age into a firing staleness alert
+            rec.set_gauge(
+                "checkpoint.last_success_age_seconds",
+                max(0.0, now - float(last)),
+                **labels,
+            )
+        if row.get("last_write_seconds") is not None:
+            rec.set_gauge(
+                "checkpoint.write_seconds", float(row["last_write_seconds"]), **labels
+            )
+        rec.set_gauge("checkpoint.failures", float(row.get("failures", 0)), **labels)
+        for kind in ("full", "delta"):
+            count = row["bundles"].get(kind, 0)
+            rec.set_gauge("checkpoint.bundles", float(count), kind=kind, **labels)
+            if count:
+                rec.set_gauge(
+                    "checkpoint.bundle_bytes",
+                    float(row["bytes"].get(kind, 0)) / count,
+                    kind=kind,
+                    **labels,
+                )
     return {
         "tenants": len(rows),
         "overflow_collapsed": _REGISTRY.overflow_names,
         "quota_rows": quota_rows,
+        "checkpoint_rows": len(checkpoint_rows),
     }

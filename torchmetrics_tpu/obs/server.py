@@ -426,6 +426,31 @@ class IntrospectionServer:
             report["tenant_filter"] = tenant
         return report
 
+    @staticmethod
+    def _checkpoint_row(row: Optional[Dict[str, Any]], now: float) -> Optional[Dict[str, Any]]:
+        """One tenant's /tenants checkpoint column: liveness + full-vs-delta."""
+        if row is None:
+            return None
+        last = row.get("last_unix")
+        budget = row.get("stale_after_seconds")
+        age = max(0.0, now - float(last)) if last is not None else None
+        closed = bool(row.get("closed"))
+        return {
+            "last_success_age_seconds": age,
+            "last_kind": row.get("last_kind"),
+            "last_bytes": row.get("last_bytes"),
+            "last_write_seconds": row.get("last_write_seconds"),
+            "bundles": row.get("bundles"),
+            "bytes": row.get("bytes"),
+            "failures": row.get("failures", 0),
+            "stale_after_seconds": budget,
+            "closed": closed,
+            # a cleanly closed session has no freshness promise to break
+            "stale": bool(
+                not closed and budget is not None and age is not None and age > budget
+            ),
+        }
+
     def tenants_report(self) -> Dict[str, Any]:
         """The /tenants page: the bounded registry joined with per-tenant
         series cardinality, state-memory bytes, estimated cost, firing alerts
@@ -460,6 +485,8 @@ class IntrospectionServer:
                 quota_rows = admission.status()
             except Exception:  # the quota join must never break the page
                 self._rec_inc("server.errors", route="/tenants(admission)")
+        checkpoint_rows = _scope.checkpoint_status()
+        now = time.time()
         rows: List[Dict[str, Any]] = []
         for row in registry.rows():
             tenant = row["tenant"]
@@ -485,6 +512,9 @@ class IntrospectionServer:
                     # the tenant is unmetered — absence of quota is visible,
                     # not rendered as a zero budget
                     "quota": quota_row,
+                    # continuous-checkpoint liveness (engine/migrate.py): null
+                    # when the tenant's session runs no CheckpointPolicy
+                    "checkpoint": self._checkpoint_row(checkpoint_rows.pop(tenant, None), now),
                 }
             )
         # quotas configured for tenants the registry has not seen yet still
@@ -642,6 +672,17 @@ class IntrospectionServer:
             reasons.append(
                 f"live-session migration in flight for tenant {tenant!r} (phase: {phase})"
             )
+        # continuous-checkpoint staleness (engine/migrate.py CheckpointPolicy):
+        # a tenant session whose policy declares stale_after_seconds and whose
+        # last successful bundle is older than it has lost its crash-recovery
+        # guarantee — degraded, tenant named, budget and age in the reason
+        checkpoints_stale = _scope.checkpoint_overdue()
+        for tenant, row in sorted(checkpoints_stale.items()):
+            tenants_degraded.add(tenant)
+            reasons.append(
+                f"continuous checkpoint stale for tenant {tenant!r}:"
+                f" {row['age']:.1f}s since last bundle (budget {row['budget']:.1f}s)"
+            )
         status = "degraded" if reasons else "ok"
         return {
             "status": status,
@@ -655,6 +696,8 @@ class IntrospectionServer:
             "tenants_degraded": sorted(tenants_degraded),
             # migration handoffs in flight: {tenant: phase}
             "tenants_migrating": migrating,
+            # tenants past their declared checkpoint-staleness budget
+            "checkpoints_stale": checkpoints_stale,
             "n_metrics": len(self.metrics()),
             "trace_enabled": trace.is_enabled(),
         }

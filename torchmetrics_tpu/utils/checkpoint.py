@@ -183,17 +183,47 @@ def file_tree_digest(root: str, exclude: tuple = ()) -> str:
     walked in sorted relative-path order and hashed as (path, content), so a
     truncated, tampered, renamed or missing file flips the digest. ``exclude``
     names relative paths to skip — the integrity record itself.
+
+    Path-traversal hardening: a bundle is a closed set of regular files its
+    writer materialized under one root, so any entry that could make a reader
+    touch bytes *outside* that root — a symlink (file or directory, wherever it
+    points) or a relative path escaping the root — raises
+    :class:`CheckpointIntegrityError` instead of being silently followed. A
+    crafted bundle must fail loudly at verification, before any restore reads
+    through it.
     """
     digest = hashlib.sha256()
     excluded = {str(e).replace(os.sep, "/") for e in exclude}
+    real_root = os.path.realpath(root)
     entries = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames.sort()
+        for dname in dirnames:
+            if os.path.islink(os.path.join(dirpath, dname)):
+                rel = os.path.relpath(os.path.join(dirpath, dname), root).replace(os.sep, "/")
+                raise CheckpointIntegrityError(
+                    f"Bundle at {root} contains a symlinked directory {rel!r} — bundles"
+                    " hold only regular files; a link could point a restore outside the"
+                    " bundle root, so this tree is rejected."
+                )
         for fname in filenames:
             full = os.path.join(dirpath, fname)
             rel = os.path.relpath(full, root).replace(os.sep, "/")
             if rel in excluded:
                 continue
+            if os.path.islink(full):
+                raise CheckpointIntegrityError(
+                    f"Bundle at {root} contains a symlink {rel!r} — bundles hold only"
+                    " regular files; a link could point a restore outside the bundle"
+                    " root, so this tree is rejected."
+                )
+            if rel.startswith("..") or not os.path.realpath(full).startswith(
+                real_root + os.sep
+            ):
+                raise CheckpointIntegrityError(
+                    f"Bundle at {root} contains an entry {rel!r} that escapes the"
+                    " bundle root — rejected."
+                )
             entries.append((rel, full))
     for rel, full in sorted(entries):
         digest.update(rel.encode())
