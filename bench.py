@@ -1099,6 +1099,11 @@ def _chaos_main(argv) -> None:
         "--chaos-report", default=None,
         help="write the full SLO report JSON here (atomic write; the CI artifact)",
     )
+    parser.add_argument(
+        "--chaos-trace", default=None,
+        help="write one stitched GET /trace/<id> JSON (an injected-NaN batch's full"
+             " lineage story) here — the batch-lineage CI artifact",
+    )
     args = parser.parse_args(argv)
 
     # fast backend choice (the chaos loop runs in THIS process): honor an
@@ -1190,8 +1195,15 @@ def _chaos_main(argv) -> None:
             "migration": result.get("migration"),
             # crash-recovery accounting (None unless host_crash)
             "crash": result.get("crash"),
+            # batch-lineage causality rows (trace id → dump/alert links)
+            "lineage_poisoned": (result.get("lineage") or {}).get("poisoned"),
         },
     }
+    if result.get("lineage"):
+        # trace-index cardinality rides the history recorded-never-judged
+        # (the `memory` passthrough pattern): size/minted/evicted trends
+        # accumulate across rounds without gating anything
+        line["lineage"] = {"index": result["lineage"]["index"]}
     if args.chaos_scenario == "host_crash":
         # the cadence-overhead probe rides the host-crash runs: checkpointing
         # on vs off on an identical stream, recorded-never-judged
@@ -1203,6 +1215,19 @@ def _chaos_main(argv) -> None:
         atomic_write_text(
             args.chaos_report,
             json.dumps({"report": report, "result": result}, sort_keys=True, default=str, indent=2),
+        )
+    if args.chaos_trace:
+        # the stitched GET /trace/<id> of one injected-NaN batch the replay
+        # fetched over HTTP mid-run — proof the lookup plane answers end to end
+        sample = (result.get("lineage") or {}).get("sample_trace")
+        atomic_write_text(
+            args.chaos_trace,
+            json.dumps(
+                sample if sample is not None else {"error": "no sample trace captured"},
+                sort_keys=True,
+                default=str,
+                indent=2,
+            ),
         )
     _record_history(line, check=args.check_regressions)
     if not report["passed"]:

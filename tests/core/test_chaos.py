@@ -223,8 +223,23 @@ def _fake_result(**overrides):
         "cost": {"compiled_variants": 20, "compile_seconds": 1.5},
         "flight": {"dumps": [
             {"path": "x", "tenant": "tenant-04", "reason": "chunk_replay",
-             "poisoned_batches": [5]},
+             "poisoned_batches": [5],
+             "poisoned_trace_ids": ["tenant-04-ep0-5"]},
         ]},
+        # batch-lineage causality rows (the fault_causality SLO's input): one
+        # per injected NaN batch, both linked end to end
+        "lineage": {
+            "enabled": True,
+            "index": {"size": 100, "max_traces": 4096, "minted": 100, "evicted": 0},
+            "poisoned": [
+                {"tenant": "tenant-00", "index": 3, "trace_id": "tenant-00-ep0-3",
+                 "found": True, "outcome": "ok", "dump_named": False,
+                 "alert_linked": True, "linked": True},
+                {"tenant": "tenant-04", "index": 5, "trace_id": "tenant-04-ep0-5",
+                 "found": True, "outcome": "quarantined", "dump_named": True,
+                 "alert_linked": False, "linked": True},
+            ],
+        },
     }
     result.update(overrides)
     return result

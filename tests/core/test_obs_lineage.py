@@ -1,0 +1,715 @@
+"""Distributed batch-lineage battery: trace ids end to end.
+
+Covers the lineage tentpole — ``obs/lineage.py`` (deterministic minting, the
+bounded trace-id index, the contextvar) and every identity-destroying seam the
+id must survive: admission defer → re-admission, fusion chunking, poisoned-row
+replay, the cross-tenant multiplexer, cooperative migration
+(``checkpoint_session`` → ``restore_session`` → tail replay) and crash-recovery
+gap re-feed. Plus the egress planes: bounded per-bucket histogram exemplars,
+OpenMetrics-vs-classic content negotiation (the classic page stays
+exemplar-free and byte-compatible), ``GET /trace/<id>`` with 404-on-evicted
+semantics, ``GET /traces?outliers=K`` seeded from the exemplars, Perfetto flow
+events, the ``fault_causality`` SLO judge, and the disabled-path one-branch
+overhead smoke. CPU-only, deterministic, no sleeps.
+"""
+
+import json
+import urllib.error
+import urllib.request
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.engine.migrate import (
+    CheckpointPolicy,
+    checkpoint_session,
+    latest_valid_bundle,
+    restore_session,
+)
+from torchmetrics_tpu.engine.mux import MuxConfig, TenantMultiplexer
+from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig
+from torchmetrics_tpu.obs import alerts, export, lineage, perfetto, scope, trace, values
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.regression import MeanSquaredError
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    scope.reset()
+    lineage.reset()
+    values.disable()
+    values.get_log().clear()
+    alerts.uninstall()
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_server.stop()
+    yield
+    obs_server.stop()
+    alerts.uninstall()
+    values.disable()
+    values.get_log().clear()
+    trace.disable()
+    trace.get_recorder().clear()
+    lineage.reset()
+    scope.reset()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode("utf-8")
+
+
+def _acc(**kwargs):
+    return MulticlassAccuracy(num_classes=4, average="micro", validate_args=False, **kwargs)
+
+
+def _class_batches(n, seed=0, size=8):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(size, 4).astype(np.float32)),
+            jnp.asarray(rng.randint(0, 4, size)),
+        )
+        for _ in range(n)
+    ]
+
+
+# ------------------------------------------------------------------ minting
+
+
+class TestMinting:
+    def test_mint_is_deterministic_and_ordinal_readable(self):
+        tid = lineage.mint("acme", "ep01", 7)
+        assert tid == lineage.mint("acme", "ep01", 7)
+        assert lineage.ordinal_of(tid) == 7
+        assert lineage.ordinal_of("garbage") == -1
+        # untenanted sessions mint under a reserved (`__`-prefixed) label, so
+        # a real tenant literally named "local" can never collide with them
+        assert lineage.mint(None, "ep01", 0).startswith(lineage.LOCAL_TENANT + "-")
+        assert lineage.LOCAL_TENANT.startswith("__")
+
+    def test_pipeline_ids_are_tenant_epoch_ordinal(self):
+        lineage.enable()
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=2, tenant="t-mint"))
+        batches = _class_batches(3)
+        for b in batches:
+            pipe.feed(*b)
+        pipe.close()
+        ids = lineage.trace_ids(tenant="t-mint")
+        assert ids == [pipe.trace_id_for(i) for i in range(3)]
+        assert all(tid.startswith(f"t-mint-{pipe.lineage_epoch}-") for tid in ids)
+
+    def test_disabled_path_mints_nothing(self):
+        assert not lineage.ENABLED
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=2))
+        for b in _class_batches(3):
+            pipe.feed(*b)
+        pipe.close()
+        assert len(lineage.get_index()) == 0
+        assert lineage.get_index().stats()["minted"] == 0
+        # flight records carry a null trace id, not a minted one
+        assert all(r["trace_id"] is None for r in pipe.flight_records())
+
+
+# ------------------------------------------------------------ seam survival
+
+
+class TestSeamSurvival:
+    def test_fused_chunk_members_share_chunk_id_and_keep_ids(self):
+        lineage.enable()
+        trace.enable()
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=4, tenant="t-fuse"))
+        for b in _class_batches(4):
+            pipe.feed(*b)
+        pipe.close()
+        records = [lineage.lookup(pipe.trace_id_for(i)) for i in range(4)]
+        assert all(r is not None for r in records)
+        assert {r["path"] for r in records} == {"fused"}
+        assert {r["outcome"] for r in records} == {"ok"}
+        assert len({r["chunk_id"] for r in records}) == 1
+        assert all(r["signature"] for r in records)
+        # the dispatch span carries the chunk's ids (correlatable, never labels)
+        spans = [
+            ev
+            for ev in trace.get_recorder().events()
+            if ev["kind"] == "span" and ev["name"] == "engine.dispatch"
+        ]
+        assert spans and spans[-1]["attrs"]["trace_id"] == pipe.trace_id_for(0)
+        assert pipe.trace_id_for(3) in spans[-1]["attrs"]["trace_ids"].split(",")
+
+    def test_poisoned_replay_quarantine_named_by_trace_id(self):
+        lineage.enable()
+        pipe = MetricPipeline(
+            _acc(error_policy="quarantine"), PipelineConfig(fuse=4, tenant="t-poison")
+        )
+        batches = _class_batches(4)
+        poisoned_preds = np.full((8, 4), np.nan, dtype=np.float32)
+        batches[2] = (jnp.asarray(poisoned_preds), batches[2][1])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for b in batches:
+                pipe.feed(*b)
+            pipe.close()
+        bad = lineage.lookup(pipe.trace_id_for(2))
+        assert bad["path"] == "replay" and bad["outcome"] == "quarantined"
+        assert bad["dump"] is not None
+        # the dump meta names the id alongside the ordinal
+        with open(bad["dump"], encoding="utf-8") as fh:
+            meta = json.loads(fh.readline())
+        assert meta["poisoned_trace_ids"] == [pipe.trace_id_for(2)]
+        assert meta["poisoned_batches"] == [2]
+        # clean chunk-mates replayed to "ok", ids intact
+        assert lineage.lookup(pipe.trace_id_for(3))["outcome"] == "ok"
+
+    def test_defer_readmission_keeps_identity(self):
+        lineage.enable()
+        clock = [0.0]
+        controller = scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "t-defer",
+            scope.TenantQuota(updates_per_window=2, window_seconds=60.0, over_quota=scope.DEFER),
+        )
+        pipe = MetricPipeline(
+            _acc(), PipelineConfig(fuse=2, tenant="t-defer", admission=controller)
+        )
+        for b in _class_batches(4):
+            pipe.feed(*b)
+        deferred_id = pipe.trace_id_for(3)
+        assert lineage.lookup(deferred_id)["outcome"] == "deferred"
+        clock[0] += 120.0  # window rolls; close() drains the backlog
+        pipe.close()
+        record = lineage.lookup(deferred_id)
+        assert record["outcome"] == "ok"
+        assert record["ordinal"] == 3  # identity assigned at FIRST arrival
+
+    def test_migration_preserves_epoch_and_tail_ids(self, tmp_path):
+        lineage.enable()
+        clock = [0.0]
+        controller = scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "t-mig",
+            scope.TenantQuota(updates_per_window=3, window_seconds=60.0, over_quota=scope.DEFER),
+        )
+        batches = _class_batches(5, seed=3)
+        pipe = MetricPipeline(
+            _acc(), PipelineConfig(fuse=2, tenant="t-mig", admission=controller)
+        )
+        for b in batches:
+            pipe.feed(*b)
+        ids = [pipe.trace_id_for(i) for i in range(5)]
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+        # a "fresh host": empty index, new process-local state
+        lineage.get_index().clear()
+        pipe2, manifest = restore_session(_acc(), str(tmp_path / "bundle"))
+        assert pipe2.lineage_epoch == pipe.lineage_epoch
+        # the deferred tail replayed under its bundle-persisted ids
+        tail_ids = [e["trace_id"] for e in manifest["tail"]]
+        assert tail_ids and set(tail_ids) <= set(ids)
+        for tid in tail_ids:
+            assert lineage.lookup(tid) is not None
+        # fresh post-restore arrivals never collide with pre-migration ids
+        pipe2.feed(*batches[0])
+        fresh = pipe2.trace_id_for(5)
+        assert fresh not in ids and lineage.lookup(fresh) is not None
+        pipe2.close()
+
+    def test_crash_refeed_remints_the_lost_batches_ids(self, tmp_path):
+        lineage.enable()
+        batches = _class_batches(7, seed=5)
+        pipe = MetricPipeline(
+            _acc(),
+            PipelineConfig(
+                fuse=2,
+                tenant="t-crash",
+                checkpoint=CheckpointPolicy(directory=str(tmp_path / "stream"), every_batches=2),
+            ),
+        )
+        for b in batches:
+            pipe.feed(*b)
+        original_ids = [pipe.trace_id_for(i) for i in range(7)]
+        del pipe  # SIGKILL semantics: no drain, no close, open chunk lost
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            bundle = latest_valid_bundle(str(tmp_path / "stream"))
+        assert bundle is not None
+        lineage.get_index().clear()  # the recovering host saw nothing
+        pipe2, manifest = restore_session(_acc(), bundle)
+        cursor = manifest["cursor"]["batches_ingested"]
+        for b in batches[cursor:]:
+            pipe2.feed(*b)
+        pipe2.close()
+        # the re-fed gap batches carry EXACTLY the ids the dead host minted
+        assert lineage.trace_ids(tenant="t-crash") == original_ids[cursor:]
+
+    def test_continuous_capture_with_detours_never_reissues_ids(self, tmp_path):
+        """The review-found collision: a continuous (no-drain) bundle used to
+        persist the PROCESSED count as the lineage seq even when deferred
+        batches had consumed arrival ordinals — a restored session would
+        re-mint ids that already name OTHER batches. With detours the capture
+        now hands over the arrival counter: collision-safety over gap-id
+        stability."""
+        lineage.enable()
+        clock = [0.0]
+        controller = scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "t-col",
+            scope.TenantQuota(updates_per_window=2, window_seconds=60.0, over_quota=scope.DEFER),
+        )
+        pipe = MetricPipeline(
+            _acc(),
+            PipelineConfig(
+                fuse=2,
+                tenant="t-col",
+                admission=controller,
+                checkpoint=CheckpointPolicy(directory=str(tmp_path / "s"), every_batches=2),
+            ),
+        )
+        for b in _class_batches(4):
+            pipe.feed(*b)  # arrivals 0..3; 2 processed, 2 deferred
+        issued = {pipe.trace_id_for(i) for i in range(4)}
+        pipe.checkpoint_now()
+        del pipe  # crash: abandoned with a deferred backlog
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            bundle = latest_valid_bundle(str(tmp_path / "s"))
+        pipe2, manifest = restore_session(_acc(), bundle)
+        assert manifest["cursor"]["lineage"]["seq"] == 4  # arrivals, not processed
+        pipe2.feed(*_class_batches(1, seed=99)[0])
+        fresh = pipe2.trace_id_for(4)
+        assert fresh not in issued  # a fresh batch can never wear an old id
+        pipe2.close()
+
+    def test_mux_defer_keeps_identity_and_arrival_stamp(self):
+        """Mux identity is assigned at FIRST arrival (pre-admission), exactly
+        like the pipeline: a deferred row is visible as `deferred` for its
+        whole deferral and keeps its id (and ingest stamp) through
+        re-admission."""
+        lineage.enable()
+        clock = [0.0]
+        controller = scope.AdmissionController(clock=lambda: clock[0])
+        controller.set_quota(
+            "m-d",
+            scope.TenantQuota(updates_per_window=1, window_seconds=60.0, over_quota=scope.DEFER),
+        )
+        mux = TenantMultiplexer(
+            lambda: _acc(), MuxConfig(max_width=4, admission=controller)
+        )
+        batches = _class_batches(3, seed=4)
+        for b in batches:
+            mux.feed("m-d", *b)
+        deferred_id = mux.trace_id_for("m-d", 2)
+        record = lineage.lookup(deferred_id)
+        assert record is not None and record["outcome"] == "deferred"
+        stamp = record["ingest_unix"]
+        clock[0] += 120.0
+        mux.close()  # the backlog drains
+        record = lineage.lookup(deferred_id)
+        assert record["outcome"] == "ok"
+        assert record["ordinal"] == 2 and record["ingest_unix"] == stamp
+
+    def test_mux_rows_get_tenant_local_ids(self):
+        lineage.enable()
+        mux = TenantMultiplexer(
+            lambda: _acc(error_policy="quarantine"), MuxConfig(max_width=4)
+        )
+        rng = np.random.RandomState(0)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for tenant in ("m-a", "m-b", "m-c"):
+                preds = rng.rand(8, 4).astype(np.float32)
+                if tenant == "m-b":
+                    preds = np.full_like(preds, np.nan)
+                mux.feed(tenant, jnp.asarray(preds), jnp.asarray(rng.randint(0, 4, 8)))
+            mux.close()
+        ok = lineage.lookup(mux.trace_id_for("m-a", 0))
+        assert ok["path"] == "mux" and ok["outcome"] == "ok"
+        bad = lineage.lookup(mux.trace_id_for("m-b", 0))
+        assert bad["outcome"] == "quarantined" and bad["dump"] is not None
+        with open(bad["dump"], encoding="utf-8") as fh:
+            meta = json.loads(fh.readline())
+        assert meta["tenant"] == "m-b"
+        assert meta["poisoned_trace_ids"] == [mux.trace_id_for("m-b", 0)]
+
+
+# ----------------------------------------------- correlation across restore
+
+
+class TestSpanRecordCorrelation:
+    def test_post_restore_chunk_ids_continue_and_trace_id_is_canonical(self, tmp_path):
+        """The pre-fix bug: a restored session's dispatch spans restarted
+        ``chunk_id`` at 0 while the restored flight ring still held records
+        with the origin's low chunk ids — ordinal equality matched the WRONG
+        record. Now ``chunk_seq`` continues across the restore AND every
+        record/span carries the trace id as the canonical key."""
+        lineage.enable()
+        trace.enable()
+        batches = _class_batches(6, seed=9)
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=2, tenant="t-corr"))
+        for b in batches[:4]:
+            pipe.feed(*b)
+        origin_chunks = {r["chunk_id"] for r in pipe.flight_records()}
+        assert origin_chunks == {0, 1}
+        checkpoint_session(pipe, str(tmp_path / "bundle"))
+        pipe.close()
+        pipe2, _ = restore_session(_acc(), str(tmp_path / "bundle"))
+        for b in batches[4:]:
+            pipe2.feed(*b)
+        pipe2.flush()
+        records = pipe2.flight_records()
+        new_records = [r for r in records if r["trace_id"] not in {
+            pipe.trace_id_for(i) for i in range(4)
+        }]
+        # post-restore chunk ids continue past the origin's, never collide
+        assert new_records and all(r["chunk_id"] not in origin_chunks for r in new_records)
+        # and the trace id correlates record ↔ span exactly (chunk leads ride
+        # `trace_id`, every member the `trace_ids` attr)
+        span_ids = set()
+        for ev in trace.get_recorder().events():
+            if ev["kind"] == "span" and ev["name"] == "engine.dispatch":
+                attrs = ev["attrs"]
+                if attrs.get("trace_id"):
+                    span_ids.add(attrs["trace_id"])
+                span_ids.update(str(attrs.get("trace_ids") or "").split(","))
+        for r in new_records:
+            assert r["trace_id"] in span_ids
+        pipe2.close()
+
+
+# -------------------------------------------------------------- exemplars
+
+
+class TestExemplars:
+    def test_per_bucket_ring_is_bounded(self):
+        lineage.enable()
+        trace.enable()
+        for i in range(20):
+            with lineage.trace(lineage.mint("t", "ep", i)):
+                trace.observe_duration("d", 0.002, op="x")
+        hist = [h for h in trace.get_recorder().snapshot()["histograms"] if h["name"] == "d"][0]
+        rows = hist["exemplars"]["4"]  # the 1e-2 bucket
+        from torchmetrics_tpu.obs.trace import _Histogram
+
+        assert len(rows) == _Histogram.EXEMPLAR_K
+        # last-K wins: the freshest ids survive
+        assert rows[-1][0] == lineage.mint("t", "ep", 19)
+
+    def test_exemplars_never_mint_series_and_need_lineage(self):
+        trace.enable()
+        trace.observe_duration("d", 0.002, op="x")  # lineage off: no exemplar
+        hist = [h for h in trace.get_recorder().snapshot()["histograms"] if h["name"] == "d"][0]
+        assert "exemplars" not in hist
+        lineage.enable()
+        with lineage.trace("t-ep-0"):
+            trace.observe_duration("d", 0.003, op="x")
+        snap = trace.get_recorder().snapshot()
+        hists = [h for h in snap["histograms"] if h["name"] == "d"]
+        assert len(hists) == 1  # same series: the exemplar attached, no new labelset
+        assert hists[0]["exemplars"]
+
+    def test_span_trace_id_attrs_are_excluded_from_histogram_labels(self):
+        trace.enable()
+        lineage.enable()
+        with trace.span("engine.dispatch", pipeline="X", trace_id="a-b-0", trace_ids="a-b-0"):
+            pass
+        hist = [
+            h for h in trace.get_recorder().snapshot()["histograms"]
+            if h["name"] == "engine.dispatch"
+        ][0]
+        assert "trace_id" not in hist["labels"] and "trace_ids" not in hist["labels"]
+
+
+# ------------------------------------------------------ exposition flavors
+
+
+class TestContentNegotiation:
+    def _seed(self):
+        lineage.enable()
+        trace.enable()
+        trace.inc("c", reason="x")
+        with lineage.trace(lineage.mint("t", "ep", 0)):
+            trace.observe_duration("d", 0.002, op="x")
+
+    def test_classic_page_stays_exemplar_free_and_byte_compatible(self):
+        self._seed()
+        with_exemplars = export.prometheus_text()
+        assert "# {" not in with_exemplars
+        assert "# EOF" not in with_exemplars
+        # byte-compatibility: the classic render of the same data with the
+        # exemplars stripped is IDENTICAL — lineage never changes the page
+        rec = trace.get_recorder()
+        for (_name, _labels), hist in rec._hists.items():
+            hist.exemplars = None
+        assert export.prometheus_text() == with_exemplars
+
+    def test_openmetrics_page_carries_exemplars_and_eof(self):
+        self._seed()
+        text = export.openmetrics_text()
+        assert text.rstrip().endswith("# EOF")
+        exemplar_lines = [line for line in text.splitlines() if "# {" in line]
+        assert exemplar_lines
+        # OpenMetrics exemplar grammar: bucket line, then `# {trace_id="..."}`
+        # then value and timestamp
+        import re
+
+        grammar = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*_bucket\{[^}]*le=\"[^\"]+\"[^}]*\} \d+"
+            r" # \{trace_id=\"[^\"]+\"\} [0-9.eE+-]+ [0-9.]+$"
+        )
+        for line in exemplar_lines:
+            assert grammar.match(line), line
+        # counter families: header names drop _total, samples keep it
+        assert "# TYPE tm_tpu_c counter" in text
+        assert "tm_tpu_c_total{" in text
+
+    def test_server_negotiates_on_accept_header(self):
+        self._seed()
+        server = obs_server.IntrospectionServer(port=0).start()
+        try:
+            status, classic = _get(server.url + "/metrics")
+            assert status == 200 and "# {" not in classic
+            request = urllib.request.Request(
+                server.url + "/metrics",
+                headers={"Accept": "application/openmetrics-text"},
+            )
+            with urllib.request.urlopen(request, timeout=10) as resp:
+                body = resp.read().decode("utf-8")
+                assert resp.headers["Content-Type"].startswith("application/openmetrics-text")
+            assert "# {" in body and body.rstrip().endswith("# EOF")
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------ lookup plane
+
+
+class TestTraceLookup:
+    def _run_poisoned_pipeline(self):
+        lineage.enable()
+        trace.enable()
+        values.enable()
+        engine = alerts.configure(
+            alerts.AlertRule(name="nan-watch", kind="non_finite", metric="MeanSquaredError")
+        )
+        mse = MeanSquaredError()
+        pipe = MetricPipeline(
+            mse, PipelineConfig(fuse=1, tenant="t-look", alert_engine=engine)
+        )
+        pipe.feed(jnp.asarray([1.0, 0.5]), jnp.zeros(2))
+        pipe.feed(jnp.asarray([1.0, float("nan")]), jnp.zeros(2))
+        pipe.close()
+        return pipe, mse
+
+    def test_trace_route_returns_the_full_story(self):
+        pipe, mse = self._run_poisoned_pipeline()
+        bad = pipe.trace_id_for(1)
+        server = obs_server.IntrospectionServer([mse], port=0).start()
+        try:
+            status, body = _get(server.url + "/trace/" + bad)
+            payload = json.loads(body)
+            assert status == 200 and payload["found"]
+            assert payload["record"]["tenant"] == "t-look"
+            assert payload["record"]["ordinal"] == 1
+            assert payload["spans"]  # the ingest/dispatch spans reference it
+            # the value watchdog its commit fired is linked
+            assert any(row["rule"] == "nan-watch" for row in payload["alerts"])
+        finally:
+            server.stop()
+
+    def test_trace_404_and_eviction_semantics(self):
+        lineage.enable(max_traces=4)
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=2, tenant="t-evict"))
+        for b in _class_batches(8):
+            pipe.feed(*b)
+        pipe.close()
+        assert lineage.get_index().stats()["evicted"] == 4
+        server = obs_server.IntrospectionServer(port=0).start()
+        try:
+            # never-minted id: 404 with index stats
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + "/trace/not-a-real-id")
+            assert err.value.code == 404
+            payload = json.load(err.value)
+            assert payload["found"] is False and payload["lineage"]["evicted"] == 4
+            # an EVICTED id 404s the same way — the index is bounded, loudly
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + "/trace/" + pipe.trace_id_for(0))
+            assert err.value.code == 404
+            # live ids still answer
+            status, _body = _get(server.url + "/trace/" + pipe.trace_id_for(7))
+            assert status == 200
+        finally:
+            server.stop()
+
+    def test_traces_listing_and_outliers(self):
+        lineage.enable()
+        trace.enable()
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=2, tenant="t-list"))
+        for b in _class_batches(4):
+            pipe.feed(*b)
+        pipe.close()
+        server = obs_server.IntrospectionServer(port=0).start()
+        try:
+            status, body = _get(server.url + "/traces?tenant=t-list")
+            payload = json.loads(body)
+            assert status == 200 and payload["enabled"]
+            assert payload["trace_ids"] == [pipe.trace_id_for(i) for i in range(4)]
+            status, body = _get(server.url + "/traces?outliers=2")
+            payload = json.loads(body)
+            assert status == 200 and len(payload["outliers"]) <= 2
+            assert payload["outliers"], "exemplars should seed the outlier list"
+            # each outlier row resolves at /trace/<id>
+            status, _ = _get(server.url + "/trace/" + payload["outliers"][0]["trace_id"])
+            assert status == 200
+            # ids are deduped: one row per trace id
+            ids = [row["trace_id"] for row in payload["outliers"]]
+            assert len(ids) == len(set(ids))
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + "/traces?outliers=0")
+            assert err.value.code == 400
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(server.url + "/traces?tenant=unknown-t")
+            assert err.value.code == 404
+        finally:
+            server.stop()
+
+    def test_covering_checkpoint_joined(self, tmp_path):
+        lineage.enable()
+        pipe = MetricPipeline(
+            _acc(),
+            PipelineConfig(
+                fuse=2,
+                tenant="t-cover",
+                checkpoint=CheckpointPolicy(directory=str(tmp_path / "s"), every_batches=2),
+            ),
+        )
+        for b in _class_batches(4):
+            pipe.feed(*b)
+        pipe.close()
+        server = obs_server.IntrospectionServer(port=0).start()
+        try:
+            status, body = _get(server.url + "/trace/" + pipe.trace_id_for(0))
+            payload = json.loads(body)
+            assert status == 200
+            assert payload["checkpoint"] is not None
+            assert payload["checkpoint"]["covered_batches"] >= 1
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------------ perfetto flows
+
+
+class TestPerfettoFlows:
+    def test_one_batch_binds_into_one_flow_chain(self):
+        lineage.enable()
+        trace.enable()
+        pipe = MetricPipeline(_acc(), PipelineConfig(fuse=2, tenant="t-flow"))
+        for b in _class_batches(2):
+            pipe.feed(*b)
+        pipe.close()
+        doc = perfetto.chrome_trace()
+        flows = [e for e in doc["traceEvents"] if e.get("cat") == "lineage"]
+        assert flows and doc["otherData"]["n_flows"] >= 1
+        lead = pipe.trace_id_for(0)
+        chain = sorted(
+            (e for e in flows if e["id"] == lead), key=lambda e: e["ts"]
+        )
+        # ingest span starts the flow, the dispatch span ends it
+        assert len(chain) >= 2
+        assert chain[0]["ph"] == "s" and chain[-1]["ph"] == "f"
+        json.dumps(doc)  # valid plain JSON
+
+
+# ---------------------------------------------------------- causality judge
+
+
+class TestFaultCausalityJudge:
+    def _result(self, **lineage_overrides):
+        from tests.core.test_chaos import _fake_result
+
+        result = _fake_result()
+        result["lineage"].update(lineage_overrides)
+        return result
+
+    def test_missing_lineage_section_fails_the_slo(self):
+        from torchmetrics_tpu.chaos import slo as chaos_slo
+
+        result = self._result()
+        result.pop("lineage")
+        report = chaos_slo.judge(result)
+        row = [r for r in report["slos"] if r["slo"] == "fault_causality"][0]
+        assert not row["passed"] and "no batch-lineage" in row["detail"]
+
+    def test_unlinked_poisoned_batch_fails_with_names(self):
+        from torchmetrics_tpu.chaos import slo as chaos_slo
+
+        result = self._result()
+        result["lineage"]["poisoned"][1]["linked"] = False
+        report = chaos_slo.judge(result)
+        row = [r for r in report["slos"] if r["slo"] == "fault_causality"][0]
+        assert not row["passed"] and "tenant-04[5]" in row["detail"]
+
+    def test_unmeasured_poisoned_batch_fails(self):
+        from torchmetrics_tpu.chaos import slo as chaos_slo
+
+        result = self._result(poisoned=[])
+        report = chaos_slo.judge(result)
+        row = [r for r in report["slos"] if r["slo"] == "fault_causality"][0]
+        assert not row["passed"] and "unmeasured" in row["detail"]
+
+    def test_spec_can_disable(self):
+        from torchmetrics_tpu.chaos import slo as chaos_slo
+
+        result = self._result()
+        result.pop("lineage")
+        report = chaos_slo.judge(
+            result, chaos_slo.SLOSpec(require_fault_causality=False)
+        )
+        assert not [r for r in report["slos"] if r["slo"] == "fault_causality"]
+
+
+# ------------------------------------------------------ disabled-path smoke
+
+
+class TestDisabledOverhead:
+    def test_lineage_disabled_ingest_within_noise(self):
+        """With lineage imported-but-disabled, the pipeline ingest path pays
+        one module-flag branch: feeding must stay within noise of a pipeline
+        run before lineage ever existed (generous 2x bound, shared host)."""
+        from torchmetrics_tpu.utils.checks import measure_runtime
+
+        assert not lineage.ENABLED and not trace.is_enabled()
+        batches = _class_batches(32)
+
+        def run():
+            pipe = MetricPipeline(_acc(), PipelineConfig(fuse=4, flight_records=0))
+            for b in batches:
+                pipe.feed(*b)
+            pipe.close()
+
+        run()  # compile outside the timed region
+        baseline = measure_runtime(run, reps=3, warmup=1)
+        enabled_cost = None
+        try:
+            lineage.enable()
+            run()
+            enabled_cost = measure_runtime(run, reps=3, warmup=1)
+        finally:
+            lineage.reset()
+        disabled = measure_runtime(run, reps=3, warmup=1)
+        assert disabled < baseline * 2.0 + 0.05, (disabled, baseline)
+        # and the disabled runs minted nothing
+        assert len(lineage.get_index()) == 0
+        assert enabled_cost is not None  # the enabled path at least ran
+
+    def test_recorder_observe_disabled_lineage_is_one_branch(self):
+        trace.enable()
+        trace.observe_duration("d", 0.001)
+        hist = [h for h in trace.get_recorder().snapshot()["histograms"] if h["name"] == "d"][0]
+        assert "exemplars" not in hist

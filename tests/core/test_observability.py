@@ -919,6 +919,77 @@ class TestPrometheusExpositionAudit:
         ]
         assert flight and flight[0][0]["pipeline"] == "MeanSquaredError"
 
+    def _lineage_page(self, openmetrics: bool):
+        from torchmetrics_tpu.obs import lineage as obs_lineage
+
+        try:
+            obs_lineage.enable()
+            with trace.observe():
+                _seed_recorder_deterministically()
+                with obs_lineage.trace(obs_lineage.mint("t", "ep", 0)):
+                    trace.observe_duration("engine.dispatch", 2e-3, pipeline="X")
+                obs_lineage.record_gauges()
+                if openmetrics:
+                    return export.openmetrics_text()
+                return export.prometheus_text()
+        finally:
+            obs_lineage.reset()
+
+    def test_classic_exposition_stays_exemplar_free_and_strict(self):
+        # batch lineage recorded exemplars, but the CLASSIC page must not
+        # change a byte of grammar: strict parse passes, no exemplar syntax,
+        # no trace_id label anywhere, and the lineage.* gauge families carry
+        # HELP like everything else
+        page = self._lineage_page(openmetrics=False)
+        assert "# {" not in page and "# EOF" not in page
+        families, samples = _parse_exposition(page)
+        for family in ("tm_tpu_lineage_traces", "tm_tpu_lineage_evicted", "tm_tpu_lineage_minted"):
+            assert families[family]["type"] == "gauge" and families[family]["help"]
+        for _name, labels, _value in samples:
+            assert "trace_id" not in labels
+
+    def test_openmetrics_exposition_validates_exemplar_grammar(self):
+        # the OpenMetrics flavor: exemplars ride bucket lines in
+        # `# {trace_id="..."} value timestamp` syntax, the page ends `# EOF`,
+        # and stripping the exemplar suffixes yields a page the strict classic
+        # parser accepts MODULO counter headers (OpenMetrics names counter
+        # families without the _total suffix) — exemplars never mint labelsets
+        page = self._lineage_page(openmetrics=True)
+        lines = page.splitlines()
+        assert lines[-1] == "# EOF"
+        exemplar_re = re.compile(
+            r"^([a-zA-Z_:][a-zA-Z0-9_:]*_bucket\{[^}]*\} \d+)"
+            r" # \{trace_id=\"[^\"]+\"\} [0-9.eE+-]+ [0-9.]+$"
+        )
+        exemplar_lines = [line for line in lines if " # {" in line]
+        assert exemplar_lines, "seeded dispatch histogram must carry an exemplar"
+        stripped = []
+        for line in lines[:-1]:
+            match = exemplar_re.match(line)
+            if match:
+                stripped.append(match.group(1))
+            else:
+                assert " # {" not in line, f"malformed exemplar line: {line}"
+                stripped.append(line)
+        # counter TYPE/HELP headers name the family without _total
+        assert any(line.startswith("# TYPE tm_tpu_") and " counter" in line for line in stripped)
+        for line in stripped:
+            if line.startswith("# TYPE ") and line.endswith(" counter"):
+                assert not line.split()[2].endswith("_total"), line
+        # exemplar-stripped samples parse under the strict sample grammar
+        for line in stripped:
+            if line and not line.startswith("#"):
+                assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+        # and the exemplar'd series existed on the classic page too: the same
+        # (name, labels) set, no exemplar-only labelsets
+        classic_samples = {
+            (name, tuple(sorted(labels.items())))
+            for name, labels, _ in _parse_exposition(self._lineage_page(openmetrics=False))[1]
+        }
+        for line in exemplar_lines:
+            name = line.split("{", 1)[0]
+            assert any(sample_name == name for sample_name, _ in classic_samples), name
+
 
 # ---------------------------------------------------- warning-drop visibility
 

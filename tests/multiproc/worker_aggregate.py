@@ -364,6 +364,44 @@ def main() -> None:
     results["worker_killed_without_drain_recovers"] = True
     scope.reset()
 
+    # -- 11. cross-host batch-lineage flow stitching ---------------------------
+    # (a batch dispatched on rank 1 under lineage must render as ONE flow
+    # chain on rank 0's aggregated Perfetto export: the flow id is the batch's
+    # trace id — global across hosts — while the anchoring spans sit on rank
+    # 1's pid. Rank 0 learns the id from the shipped span attrs, exactly the
+    # cross-host join the trace ids exist to make mechanical.)
+    from torchmetrics_tpu.obs import lineage
+
+    trace.enable()
+    lineage.enable()
+    if pid == 1:
+        lin_pipe = MetricPipeline(mig_metric(), PipelineConfig(fuse=2, tenant="t-lin"))
+        for p_, t_ in mig_batches[:2]:
+            lin_pipe.feed(p_, t_)
+        lin_pipe.close()
+    fleet = aggregate(include_events=True)
+    assert fleet["aggregate_degraded"] is False
+    doc = perfetto.chrome_trace(fleet)
+    flows = [e for e in doc["traceEvents"] if e.get("cat") == "lineage"]
+    assert flows, "rank 1's dispatched batches must contribute flow events"
+    assert {e["pid"] for e in flows} == {1}  # the batches ran on rank 1
+    # rank 0 reads the trace id off the aggregated span attrs and finds its
+    # whole chain (start → finish) stitched under that one flow id
+    span_ids = set()
+    for snap in fleet["host_snapshots"]:
+        for ev in snap.get("events", ()):
+            attrs = ev.get("attrs") or {}
+            if ev.get("kind") == "span" and attrs.get("trace_id"):
+                span_ids.add(attrs["trace_id"])
+    assert span_ids, "aggregated spans must carry the trace ids"
+    stitched = [fid for fid in span_ids if len([e for e in flows if e["id"] == fid]) >= 2]
+    assert stitched, (span_ids, [e["id"] for e in flows])
+    chain = sorted((e for e in flows if e["id"] == stitched[0]), key=lambda e: e["ts"])
+    assert chain[0]["ph"] == "s" and chain[-1]["ph"] == "f"
+    results["lineage_flow_stitched_across_hosts"] = True
+    lineage.reset()
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

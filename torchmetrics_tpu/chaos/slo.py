@@ -25,6 +25,12 @@ measurements; an :class:`SLOSpec` says which of them the serving stack
 - **flight-dump correctness** — every poisoned batch the schedule injected
   into a guarded tenant must be *named* (tenant + tenant-local batch index)
   in some flight-recorder dump.
+- **fault causality** — every injected NaN batch's **trace id**
+  (:mod:`~torchmetrics_tpu.obs.lineage`) must resolve end-to-end: the lineage
+  record exists, a guarded tenant's poison shows a quarantine outcome AND a
+  flight dump naming its id, and the victim's poison links to the value
+  watchdog that fired on its commit — injection → evidence → alert as one
+  joined record, not three greps.
 
 :func:`judge` returns a plain report: per-SLO rows (value, threshold, pass,
 detail), an overall verdict, and a ``configs`` dict shaped exactly like
@@ -71,6 +77,11 @@ class SLOSpec:
     max_time_to_resolve_seconds: Optional[float] = 15.0
     max_compiled_variants: Optional[int] = 160
     require_poisoned_named: bool = True
+    # end-to-end batch-lineage causality (obs/lineage.py): every injected NaN
+    # batch's trace id must link schedule injection → quarantine/flight dump
+    # (guarded tenants) or → value-watchdog firing (the victim) — the
+    # grep-and-guess eliminator, judged as one strict boolean
+    require_fault_causality: bool = True
     # cross-tenant fused dispatch promises (the multiplexed scenarios):
     # the run must actually have fused across tenants, and every guarded
     # tenant's poisoned batch must be quarantined by exactly its own session
@@ -546,6 +557,35 @@ def judge(
                 else f"poisoned batches never named in any dump: {missing}"
             ),
         )
+
+    # ------------------------------------------------ batch-lineage causality
+    if spec.require_fault_causality:
+        lineage = result.get("lineage") or {}
+        causality_rows = lineage.get("poisoned") or []
+        all_poisoned = {
+            (tenant, index)
+            for tenant, indices in ((result.get("schedule") or {}).get("poisoned") or {}).items()
+            for index in indices
+        }
+        covered_rows = {(row.get("tenant"), row.get("index")) for row in causality_rows}
+        unlinked = sorted(
+            f"{row.get('tenant')}[{row.get('index')}]"
+            for row in causality_rows
+            if not row.get("linked")
+        )
+        unmeasured = sorted(all_poisoned - covered_rows)
+        if not lineage.get("enabled"):
+            value: Optional[float] = None
+            detail = "replay result carries no batch-lineage section"
+        else:
+            value = float(not unlinked and not unmeasured)
+            detail = (
+                f"all {len(causality_rows)} injected NaN batch(es) resolve end-to-end:"
+                " trace id → quarantine/flight dump (guarded) or alert firing (victim)"
+                if value
+                else f"unlinked poisoned batches: {unlinked}; unmeasured: {unmeasured}"
+            )
+        _row(rows, "fault_causality", value, 1.0, "bool", "min", detail=detail)
 
     # -------------------------------------------- cross-tenant fused dispatch
     if spec.require_multiplexed:

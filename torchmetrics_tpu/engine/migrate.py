@@ -108,6 +108,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 import numpy as np
 
+import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
 import torchmetrics_tpu.obs.values as _values
@@ -318,12 +319,20 @@ def _driven_metrics(target: Union[Metric, MetricCollection]) -> List[Tuple[str, 
 
 
 def _serialize_tail(
-    tail: List[Tuple[tuple, dict]]
+    tail: List[tuple]
 ) -> Tuple[List[Dict[str, Any]], Dict[str, np.ndarray]]:
-    """Split tail batches into a JSON structure + an array payload (npz keys)."""
+    """Split tail batches into a JSON structure + an array payload (npz keys).
+
+    Items are ``(args, kwargs)`` or ``(args, kwargs, trace_id)`` — the batch's
+    lineage id (:mod:`torchmetrics_tpu.obs.lineage`) persists verbatim so the
+    restoring host's ``replay_tail`` re-feeds it under the identity it was
+    originally fed with.
+    """
     structure: List[Dict[str, Any]] = []
     arrays: Dict[str, np.ndarray] = {}
-    for bi, (args, kwargs) in enumerate(tail):
+    for bi, item in enumerate(tail):
+        args, kwargs = item[0], item[1]
+        trace_id = item[2] if len(item) > 2 else None
         a_desc: List[Dict[str, Any]] = []
         for ai, leaf in enumerate(args):
             if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
@@ -340,13 +349,16 @@ def _serialize_tail(
                 k_desc[name] = {"array": key}
             else:
                 k_desc[name] = {"value": leaf}
-        structure.append({"args": a_desc, "kwargs": k_desc})
+        entry: Dict[str, Any] = {"args": a_desc, "kwargs": k_desc}
+        if trace_id is not None:
+            entry["trace_id"] = str(trace_id)
+        structure.append(entry)
     return structure, arrays
 
 
 def _deserialize_tail(
     structure: List[Dict[str, Any]], arrays: Dict[str, np.ndarray]
-) -> List[Tuple[tuple, dict]]:
+) -> List[tuple]:
     import jax.numpy as jnp
 
     def leaf(desc: Dict[str, Any]) -> Any:
@@ -354,11 +366,11 @@ def _deserialize_tail(
             return jnp.asarray(arrays[desc["array"]])
         return desc.get("value")
 
-    batches: List[Tuple[tuple, dict]] = []
+    batches: List[tuple] = []
     for entry in structure or []:
         args = tuple(leaf(d) for d in entry.get("args") or [])
         kwargs = {name: leaf(d) for name, d in (entry.get("kwargs") or {}).items()}
-        batches.append((args, kwargs))
+        batches.append((args, kwargs, entry.get("trace_id")))
     return batches
 
 
@@ -532,7 +544,7 @@ def _capture_pipeline(
         tail_batches = list(drained) + [_normalize_batch(b) for b in tail]
         deferred_tail = len(drained)
     else:
-        tail_batches = [(tuple(a), dict(k)) for a, k in pipe._deferred]
+        tail_batches = [(tuple(a), dict(k), t) for a, k, t in pipe._deferred]
         tail_batches += [_normalize_batch(b) for b in tail]
         deferred_tail = len(tail_batches)
     report = pipe.report()
@@ -558,6 +570,29 @@ def _capture_pipeline(
         # so the accounting balances
         "deferred_tail": deferred_tail,
         "update_counts": {label: int(m.update_count) for label, m in members},
+        # the fusion-chunk ordinal continues across a restore so post-restore
+        # dispatch spans can never collide with restored flight records'
+        # chunk ids (the trace id stays the canonical correlation key)
+        "chunk_seq": int(pipe._chunk_seq),
+        # batch-lineage identity (obs/lineage.py): restored mints continue the
+        # origin's id space. A drained (cooperative) capture hands over the
+        # arrival counter verbatim — the tail already carries its pre-minted
+        # ids, and fresh batches must not collide with them. A continuous
+        # (no-drain) capture hands over the PROCESSED count instead — the open
+        # chunk's batches are the crash replay gap, and re-feeding them must
+        # re-mint exactly the ordinals they originally carried — but ONLY on a
+        # detour-free stream: once any batch was shed or deferred, arrival
+        # ordinals and the processed count no longer line up, and a
+        # processed-count seq would re-issue ids that already name OTHER
+        # batches. Such sessions hand over the arrival counter instead:
+        # collision-safety is the invariant, gap-id stability the
+        # clean-stream optimization.
+        "lineage": {
+            "epoch": pipe._lineage_epoch,
+            "seq": int(pipe._lineage_seq)
+            if (drain or report.shed_batches or report.deferred_batches)
+            else committed,
+        },
     }
     inst_pairs = {
         (type(m).__name__, str(getattr(m, "_obs_instance", "0"))) for _, m in members
@@ -625,7 +660,7 @@ def _capture_mux_slice(
         backlog = mux._deferred.pop(effective, None) or []
     else:
         backlog = list(mux._deferred.get(effective) or [])
-    tail_batches = [(tuple(a), dict(k)) for a, k in backlog]
+    tail_batches = [(tuple(a), dict(k), t) for a, k, t in backlog]
     # the PROCESSED count (fused commits + eager + replays) — a row pending in
     # an open group is deliberately not claimed (commit-consistency)
     committed = int(mux._tenant_folded.get(effective, 0))
@@ -639,6 +674,21 @@ def _capture_mux_slice(
         "tail_batches": len(tail_batches),
         "deferred_tail": len(tail_batches),
         "update_counts": {label: int(m.update_count) for label, m in members},
+        # lineage identity: the restored pipeline session keeps minting in the
+        # mux's id space for this tenant. The tenant-local ARRIVAL counter
+        # carries over on the cooperative (flushed) path and whenever THIS
+        # tenant ever shed or deferred — arrival and processed ordinals no
+        # longer line up then, and a processed-count seq would re-issue ids
+        # that already name other rows. Only a detour-free continuous capture
+        # hands over the processed count, so a crash gap re-feed re-mints the
+        # lost pending row's exact id (the pipeline capture's rule, mirrored
+        # per tenant).
+        "lineage": {
+            "epoch": mux._lineage_epoch,
+            "seq": int(mux._tenant_arrivals.get(effective, 0))
+            if (flush_pending or mux._tenant_detours.get(effective, 0))
+            else committed,
+        },
     }
     inst_pairs = {
         (type(m).__name__, str(getattr(m, "_obs_instance", "0"))) for _, m in members
@@ -1163,8 +1213,14 @@ class ContinuousCheckpointer:
         self,
         capture: Callable[[str, Optional[Tuple[str, str, Dict[str, str]]], int], Dict[str, Any]],
         committed_batches: int,
+        coverage_exact: bool = True,
     ) -> Optional[str]:
-        """Write one bundle via ``capture(path, delta_base, segment_bytes)``."""
+        """Write one bundle via ``capture(path, delta_base, segment_bytes)``.
+
+        ``coverage_exact`` says whether ``committed_batches`` also bounds the
+        session's ARRIVAL ordinals (a detour-free stream) — only then is the
+        bundle noted into the lineage index's /trace covering-checkpoint join.
+        """
         policy = self.policy
         if not self._seq_seeded:
             # a restored session continuing an existing directory (crash
@@ -1224,6 +1280,14 @@ class ContinuousCheckpointer:
                 seconds=seconds,
                 stale_after_seconds=policy.stale_after_seconds,
             )
+        # batch lineage: this bundle covers the session's first
+        # `committed_batches` processed batches — GET /trace/<id> joins a
+        # batch against the newest bundle whose cursor is past its ordinal.
+        # Only noted on detour-free streams (see note_checkpoint): once a
+        # batch was shed/deferred, arrival ordinals and the processed count
+        # no longer line up and the join would name the wrong bundle.
+        if coverage_exact:
+            _lineage.note_checkpoint(self.tenant, path, committed_batches)
         if _trace.ENABLED:
             _trace.inc("checkpoint.bundles", pipeline=self.label, kind=kind)
             _trace.set_gauge("checkpoint.bundle_bytes", float(nbytes), pipeline=self.label, kind=kind)
@@ -1263,7 +1327,11 @@ class ContinuousCheckpointer:
                 pipe, path, drain=False, delta_base=delta_base, segment_bytes=segment_bytes
             )
 
-        return self.write(capture, committed)
+        return self.write(
+            capture,
+            committed,
+            coverage_exact=not (report.shed_batches or report.deferred_batches),
+        )
 
     def maybe_mux_slice(
         self,
@@ -1291,7 +1359,11 @@ class ContinuousCheckpointer:
                 segment_bytes=segment_bytes,
             )
 
-        return self.write(capture, committed)
+        return self.write(
+            capture,
+            committed,
+            coverage_exact=not mux._tenant_detours.get(effective, 0),
+        )
 
 
 def checkpoint_staleness_rule(
@@ -1433,6 +1505,7 @@ def restore_session(
         pipe = MetricPipeline(metric, config)
         pipe._restore_report(manifest.get("report") or {})
         pipe._restore_flight(manifest.get("flight") or {})
+        pipe._restore_lineage(manifest.get("cursor") or {})
 
         engine = config.alert_engine
         if engine is None:

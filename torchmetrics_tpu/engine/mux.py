@@ -70,6 +70,7 @@ import jax.numpy as jnp
 import numpy as np
 
 import torchmetrics_tpu.obs.cost as _cost
+import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
 import torchmetrics_tpu.obs.values as _values
@@ -279,7 +280,16 @@ def _config_fingerprint(target: Any) -> Any:
 class _MuxGroup:
     """One open fusion group: same-signature rows from distinct tenants."""
 
-    __slots__ = ("sig", "treedef", "template", "tenants", "traced", "originals", "records")
+    __slots__ = (
+        "sig",
+        "treedef",
+        "template",
+        "tenants",
+        "traced",
+        "originals",
+        "records",
+        "trace_ids",
+    )
 
     def __init__(self, sig: tuple, treedef: Any, template: tuple) -> None:
         self.sig = sig
@@ -289,6 +299,7 @@ class _MuxGroup:
         self.traced: List[list] = []  # per row: traced leaves, template order
         self.originals: List[Tuple[tuple, dict]] = []
         self.records: List[Optional[dict]] = []  # per row: flight record (or None)
+        self.trace_ids: List[Optional[str]] = []  # per row: lineage id (None when off)
 
     def __len__(self) -> int:
         return len(self.tenants)
@@ -348,7 +359,9 @@ class TenantMultiplexer:
         self._groups: Dict[tuple, _MuxGroup] = {}
         self._pending: Dict[str, tuple] = {}  # tenant -> sig of its open row
         self._fused_fns: Dict[tuple, StaticLeafJit] = {}
-        self._deferred: Dict[str, List[Tuple[tuple, dict]]] = {}
+        # per-tenant deprioritized backlogs as (args, kwargs, trace_id): the
+        # id was minted at first arrival, so defer → re-admission keeps it
+        self._deferred: Dict[str, List[Tuple[tuple, dict, Optional[str]]]] = {}
         self._report = MuxReport()
         self._warmup_manifest: Optional[Dict[str, Any]] = None
         self._alert_commits = 0
@@ -357,12 +370,27 @@ class TenantMultiplexer:
         # per-tenant ingest ordinals: flight records and dump attribution name
         # TENANT-LOCAL batch indices (the schedule/SLO ground-truth shape)
         self._tenant_batch_index: Dict[str, int] = {}
+        # per-tenant ARRIVAL ordinals (lineage ids only): assigned at feed,
+        # before admission, so shed/deferred rows keep identity — a separate
+        # counter so flight-record numbering stays identical whether or not
+        # lineage is enabled (the pipeline's two-ordinal-space model)
+        self._tenant_arrivals: Dict[str, int] = {}
+        # per-tenant shed+defer counts: once a tenant detoured, its arrival
+        # and processed ordinals no longer line up — slice captures and the
+        # covering-checkpoint join consult this (per tenant, not mux-global)
+        self._tenant_detours: Dict[str, int] = {}
         # per-tenant PROCESSED counts (fused commits + eager + replays): the
         # slice-checkpoint cursor — never counts a row still pending in an
         # open group, so every slice bundle is commit-consistent
         self._tenant_folded: Dict[str, int] = {}
         self._group_seq = 0
         self._last_readmit_check = 0.0
+        # batch lineage (obs/lineage.py): one epoch per multiplexer; trace ids
+        # are minted per ROW at ingestion from the tenant-local batch ordinal,
+        # so a dump's (tenant, batch-index) evidence and the id name the same
+        # batch. Persisted into tenant-slice bundles so a restored pipeline
+        # session keeps the mux's id space.
+        self._lineage_epoch = _lineage.new_epoch()
         self._instance = str(next(TenantMultiplexer._instance_seq))
         if config.flight_records > 0:
             dump_dir = (
@@ -585,13 +613,43 @@ class TenantMultiplexer:
         """Paths of the fault dumps this multiplexer has written."""
         return list(self._flight.dump_paths) if self._flight is not None else []
 
+    @property
+    def lineage_epoch(self) -> str:
+        """The epoch this multiplexer's trace ids are minted under."""
+        return self._lineage_epoch
+
+    def trace_id_for(self, tenant: str, ordinal: int) -> str:
+        """The (deterministic) trace id of ``tenant``'s ``ordinal``-th FED
+        row — tenant-local arrival ordinals (identity is assigned before
+        admission, so the driver's fed-event index is the right key)."""
+        return _lineage.mint(
+            self._aliases.get(tenant, tenant), self._lineage_epoch, ordinal
+        )
+
     # ---------------------------------------------------------------------- feeding
+
+    def _next_ordinal(self, tenant: str) -> int:
+        """The tenant-local batch ordinal (flight records AND lineage ids)."""
+        ordinal = self._tenant_batch_index.get(tenant, 0)
+        self._tenant_batch_index[tenant] = ordinal + 1
+        return ordinal
 
     def feed(self, tenant: str, *args: Any, **kwargs: Any) -> None:
         """Ingest one update batch for ``tenant`` (admission applies first)."""
         # everything downstream keys on the EFFECTIVE label, so past-cap
         # tenants (collapsed onto the overflow session) keep being served
         tenant = self._effective(tenant)
+        trace_id = None
+        if _lineage.ENABLED:
+            # identity is assigned at FIRST arrival — before the admission
+            # decision — so a deferred row re-admitted later keeps the id (and
+            # the ingest stamp) it arrived with, exactly like the pipeline.
+            # Minted from the tenant-local ARRIVAL ordinal (its own counter,
+            # so flight-record ingest numbering is unchanged by this flag).
+            ordinal = self._tenant_arrivals.get(tenant, 0)
+            self._tenant_arrivals[tenant] = ordinal + 1
+            trace_id = _lineage.mint(tenant, self._lineage_epoch, ordinal)
+            _lineage.get_index().open(trace_id, tenant, ordinal)
         # wall-clock re-admission sweep: OTHER tenants' deferred backlogs whose
         # quota windows have rolled drain on this feed (interval-gated), so an
         # idle-but-deferred tenant rides any live traffic instead of starving.
@@ -611,13 +669,19 @@ class TenantMultiplexer:
                     controller.note_degraded_shed(tenant)
                     decision = _scope.SHED
                 else:
-                    backlog.append((args, kwargs))
+                    backlog.append((args, kwargs, trace_id))
                     self._report.deferred_batches += 1
+                    self._tenant_detours[tenant] = self._tenant_detours.get(tenant, 0) + 1
+                    if trace_id is not None:
+                        _lineage.get_index().update(trace_id, outcome="deferred")
                     if _trace.ENABLED:
                         _trace.inc("engine.mux_deferred", mux=self._label, tenant=tenant)
                     return
             if decision == _scope.SHED:
                 self._report.shed_batches += 1
+                self._tenant_detours[tenant] = self._tenant_detours.get(tenant, 0) + 1
+                if trace_id is not None:
+                    _lineage.get_index().update(trace_id, outcome="shed")
                 if tenant not in self._shed_warned:
                     self._shed_warned.add(tenant)
                     rank_zero_warn(
@@ -633,26 +697,34 @@ class TenantMultiplexer:
             # its stream order is preserved
             backlog = self._deferred.pop(tenant, None)
             if backlog:
-                for b_args, b_kwargs in backlog:
+                for b_args, b_kwargs, b_trace_id in backlog:
                     self._report.deferred_replayed += 1
                     controller.charge(tenant, updates=1)
-                    self._ingest(tenant, b_args, b_kwargs)
+                    self._ingest(tenant, b_args, b_kwargs, trace_id=b_trace_id)
             controller.charge(tenant, updates=1)
-        self._ingest(tenant, args, kwargs)
+        self._ingest(tenant, args, kwargs, trace_id=trace_id)
 
     def _admission(self) -> Optional[Any]:
         return self.config.admission if self.config.admission is not None else _scope.get_admission()
 
-    def _ingest(self, tenant: str, args: tuple, kwargs: dict) -> None:
+    def _ingest(
+        self, tenant: str, args: tuple, kwargs: dict, trace_id: Optional[str] = None
+    ) -> None:
         self._report.batches += 1
-        # tenant-local ordinal: the index a dump names is the tenant's own
-        # batch count, matching the per-tenant pipeline (and the chaos
-        # schedule's poisoned-batch ground truth), not the shared mux stream
-        batch_index = self._tenant_batch_index.get(tenant, 0)
-        self._tenant_batch_index[tenant] = batch_index + 1
+        # tenant-local INGEST ordinal: the index a dump names is the tenant's
+        # own ingested-batch count, matching the per-tenant pipeline (and the
+        # chaos schedule's poisoned-batch ground truth), not the shared mux
+        # stream — and deliberately NOT the lineage arrival ordinal, so the
+        # numbering is identical whether or not lineage is enabled (records
+        # carry the trace id as the cross-space join when it is)
+        batch_index = self._next_ordinal(tenant)
+        if trace_id is not None and _lineage.ENABLED:
+            # idempotent re-open: live records keep their arrival stamps, a
+            # restored-host tail replay recreates the record
+            _lineage.get_index().open(trace_id, tenant, _lineage.ordinal_of(trace_id))
         record = None
         if self._flight is not None:
-            record = self._flight.open_record(batch_index)
+            record = self._flight.open_record(batch_index, trace_id=trace_id)
             record["tenant"] = tenant
         if _trace.ENABLED:
             _trace.inc("engine.mux_batches", mux=self._label)
@@ -661,7 +733,7 @@ class TenantMultiplexer:
                     "flight.records", len(self._flight), pipeline=self._label, inst=self._instance
                 )
         if not self._fusable:
-            self._drive_eager(tenant, args, kwargs, record)
+            self._drive_eager(tenant, args, kwargs, record, trace_id)
             return
         if self._eager_leaders:
             # unfusable group leaders advance per batch, in stream order
@@ -673,11 +745,15 @@ class TenantMultiplexer:
             # unhashable statics cannot key a group signature: keep this
             # tenant's order (dispatch its pending group) and go eager
             self._flush_pending(tenant)
-            self._drive_fused_leaders_eagerly(tenant, args, kwargs, record)
+            self._drive_fused_leaders_eagerly(tenant, args, kwargs, record, trace_id)
             return
         sig = (treedef, tuple(template), _aval_signature(traced))
-        if record is not None:
-            record["signature"] = signature_str(sig[2])
+        if record is not None or trace_id is not None:
+            sig_str = signature_str(sig[2])
+            if record is not None:
+                record["signature"] = sig_str
+            if trace_id is not None:
+                _lineage.get_index().update(trace_id, signature=sig_str)
         pending = self._pending.get(tenant)
         if pending is not None:
             # the tenant already has an undispatched row: its earlier batch
@@ -693,6 +769,7 @@ class TenantMultiplexer:
         group.traced.append(traced)
         group.originals.append((args, kwargs))
         group.records.append(record)
+        group.trace_ids.append(trace_id)
         self._pending[tenant] = sig
         if _trace.ENABLED:
             _trace.set_gauge("engine.mux_open_groups", len(self._groups), mux=self._label)
@@ -754,9 +831,9 @@ class TenantMultiplexer:
             deferred, self._deferred = self._deferred, {}
             drained = 0
             for tenant, backlog in deferred.items():
-                for args, kwargs in backlog:
+                for args, kwargs, trace_id in backlog:
                     self._report.deferred_replayed += 1
-                    self._ingest(tenant, args, kwargs)
+                    self._ingest(tenant, args, kwargs, trace_id=trace_id)
                     drained += 1
             return drained
         probe = getattr(controller, "would_admit", None)
@@ -771,10 +848,10 @@ class TenantMultiplexer:
             if tenant == exclude or not probe(tenant):
                 continue
             backlog = self._deferred.pop(tenant, None) or []
-            for args, kwargs in backlog:
+            for args, kwargs, trace_id in backlog:
                 self._report.deferred_replayed += 1
                 controller.charge(tenant, updates=1)
-                self._ingest(tenant, args, kwargs)
+                self._ingest(tenant, args, kwargs, trace_id=trace_id)
                 drained += 1
             if _trace.ENABLED and backlog:
                 _trace.event(
@@ -789,11 +866,11 @@ class TenantMultiplexer:
         controller = self._admission()
         deferred, self._deferred = self._deferred, {}
         for tenant, backlog in deferred.items():
-            for args, kwargs in backlog:
+            for args, kwargs, trace_id in backlog:
                 self._report.deferred_replayed += 1
                 if controller is not None:
                     controller.charge(tenant, updates=1)
-                self._ingest(tenant, args, kwargs)
+                self._ingest(tenant, args, kwargs, trace_id=trace_id)
         self.flush()
 
     def close(self) -> MuxReport:
@@ -995,7 +1072,9 @@ class TenantMultiplexer:
             return
         for tenant in group.tenants:
             self._pending.pop(tenant, None)
-        rows = list(zip(group.tenants, group.traced, group.originals, group.records))
+        rows = list(
+            zip(group.tenants, group.traced, group.originals, group.records, group.trace_ids)
+        )
         # one non-finite screen per GROUP (vs one host sync per tenant batch on
         # the guarded eager path); only guarded tenants' rows are screened —
         # an unguarded tenant's NaN must flow into ITS state like always
@@ -1046,7 +1125,13 @@ class TenantMultiplexer:
             skipped += int(getattr(m, "updates_skipped", 0) or 0)
         return quarantined, skipped
 
-    def _dump_flight(self, reason: str, tenant: str, poisoned: List[int]) -> Optional[str]:
+    def _dump_flight(
+        self,
+        reason: str,
+        tenant: str,
+        poisoned: List[int],
+        trace_ids: Optional[List[str]] = None,
+    ) -> Optional[str]:
         """One fault dump naming ONE tenant's poisoned tenant-local batches.
 
         The mux ring is shared (the dump ships the full cross-tenant lineage
@@ -1062,9 +1147,12 @@ class TenantMultiplexer:
             "buckets": list(self._buckets),
             "tenants": len(self._metrics),
         }
-        path = self._flight.dump(reason, poisoned, config, tenant=tenant)
+        path = self._flight.dump(
+            reason, poisoned, config, tenant=tenant, poisoned_trace_ids=trace_ids
+        )
         if path is not None:
             self._report.flight_dumps += 1
+            _lineage.note_dump(trace_ids or [], path)
             if _trace.ENABLED:
                 _trace.inc("flight.dumps", pipeline=self._label)
                 _trace.event(
@@ -1074,6 +1162,7 @@ class TenantMultiplexer:
                     reason=reason,
                     path=path,
                     poisoned=",".join(map(str, sorted(set(poisoned)))),
+                    trace_ids=",".join(sorted(set(trace_ids or []))),
                 )
         return path
 
@@ -1089,34 +1178,56 @@ class TenantMultiplexer:
         """
         errors: List[BaseException] = []
         replayed: List[str] = []
+        replayed_ids: List[str] = []
         poisoned_by_tenant: Dict[str, List[int]] = {}
+        poisoned_ids_by_tenant: Dict[str, List[str]] = {}
         for row in rows:
             tenant, _, (r_args, r_kwargs) = row[0], row[1], row[2]
             record = row[3] if len(row) > 3 else None
+            tid = row[4] if len(row) > 4 else None
+            if tid is not None:
+                replayed_ids.append(tid)
             before = self._tenant_robust_counts(tenant)
             try:
-                self._replay_row(tenant, r_args, r_kwargs)
+                with _lineage.trace(tid):
+                    self._replay_row(tenant, r_args, r_kwargs)
             except BaseException as err:  # raise-policy tenants re-raise below
                 errors.append(err)
                 if record is not None:
                     record["path"] = "replay"
                     record["fault"] = "raised"
                     poisoned_by_tenant.setdefault(tenant, []).append(record["batch_index"])
+                if tid is not None:
+                    _lineage.get_index().update(tid, path="replay", outcome="raised")
+                    poisoned_ids_by_tenant.setdefault(tenant, []).append(tid)
             else:
+                fault = None
+                quarantined, skipped = self._tenant_robust_counts(tenant)
+                if quarantined > before[0]:
+                    fault = "quarantined"
+                elif skipped > before[1]:
+                    fault = "skipped"
                 if record is not None:
                     record["path"] = "replay"
-                    quarantined, skipped = self._tenant_robust_counts(tenant)
-                    if quarantined > before[0]:
-                        record["fault"] = "quarantined"
-                    elif skipped > before[1]:
-                        record["fault"] = "skipped"
-                    if record["fault"] is not None:
+                    record["fault"] = fault
+                    if fault is not None:
                         poisoned_by_tenant.setdefault(tenant, []).append(record["batch_index"])
+                if tid is not None:
+                    _lineage.get_index().update(
+                        tid, path="replay", outcome=fault if fault is not None else "ok"
+                    )
+                    if fault is not None:
+                        poisoned_ids_by_tenant.setdefault(tenant, []).append(tid)
             replayed.append(tenant)
-        for tenant, poisoned in poisoned_by_tenant.items():
-            self._dump_flight(reason, tenant, poisoned)
+        for tenant in set(poisoned_by_tenant) | set(poisoned_ids_by_tenant):
+            self._dump_flight(
+                reason,
+                tenant,
+                poisoned_by_tenant.get(tenant, []),
+                trace_ids=poisoned_ids_by_tenant.get(tenant),
+            )
         self._maybe_checkpoint()
-        self._evaluate_alerts(replayed)
+        self._evaluate_alerts(replayed, trace_ids=replayed_ids)
         if errors:
             raise errors[0]
 
@@ -1134,12 +1245,23 @@ class TenantMultiplexer:
         ledger_mark = _cost.get_ledger().mark() if controller is not None else None
         gid = self._group_seq
         self._group_seq += 1
+        row_ids = [row[4] for row in rows if len(row) > 4 and row[4] is not None]
         try:
             if _trace.ENABLED:
-                with _trace.span(
-                    "engine.dispatch", pipeline=self._label, path="mux", width=n
-                ):
-                    new_states = fused(tuple(states), traced_rows, valid)
+                span_attrs: Dict[str, Any] = {
+                    "pipeline": self._label,
+                    "path": "mux",
+                    "width": n,
+                }
+                if row_ids:
+                    # trace_id/trace_ids are excluded from histogram labels by
+                    # the recorder; the ambient lineage context makes the
+                    # dispatch histogram's exemplar reference the lead row
+                    span_attrs["trace_id"] = row_ids[0]
+                    span_attrs["trace_ids"] = ",".join(row_ids)
+                with _lineage.trace(row_ids[0] if row_ids else None):
+                    with _trace.span("engine.dispatch", **span_attrs):
+                        new_states = fused(tuple(states), traced_rows, valid)
             else:
                 new_states = fused(tuple(states), traced_rows, valid)
         except Exception as err:
@@ -1168,6 +1290,9 @@ class TenantMultiplexer:
             if record is not None:
                 record["chunk_id"] = gid
                 record["path"] = "mux"
+            tid = row[4] if len(row) > 4 else None
+            if tid is not None:
+                _lineage.get_index().update(tid, chunk_id=gid, path="mux", outcome="ok")
         self._report.dispatches += 1
         self._report.fused_updates += n
         self._report.padded_rows += pad
@@ -1183,7 +1308,7 @@ class TenantMultiplexer:
         if controller is not None:
             self._charge_rows(controller, committed, width, ledger_mark)
         self._maybe_checkpoint()
-        self._evaluate_alerts(committed)
+        self._evaluate_alerts(committed, trace_ids=row_ids)
 
     def _commit(self, target: Union[Metric, MetricCollection], state: Any) -> None:
         if self._is_collection:
@@ -1240,40 +1365,69 @@ class TenantMultiplexer:
     # ------------------------------------------------------------- per-tenant paths
 
     def _mark_eager_fault(
-        self, tenant: str, record: Optional[dict], before: Tuple[int, int]
+        self,
+        tenant: str,
+        record: Optional[dict],
+        before: Tuple[int, int],
+        trace_id: Optional[str] = None,
     ) -> None:
         """Stamp an eager-path record with its fault; quarantines dump directly
         (no replay step exists to do it — the pipeline's eager-path rule)."""
-        if record is None:
+        if record is None and trace_id is None:
             return
-        record["path"] = "eager"
         quarantined, skipped = self._tenant_robust_counts(tenant)
+        fault = None
         if quarantined > before[0]:
-            record["fault"] = "quarantined"
-            self._dump_flight("quarantine", tenant, [record["batch_index"]])
+            fault = "quarantined"
         elif skipped > before[1]:
-            record["fault"] = "skipped"
+            fault = "skipped"
+        if record is not None:
+            record["path"] = "eager"
+            record["fault"] = fault
+        if trace_id is not None:
+            _lineage.get_index().update(
+                trace_id, path="eager", outcome=fault if fault is not None else "ok"
+            )
+        if fault == "quarantined":
+            self._dump_flight(
+                "quarantine",
+                tenant,
+                [record["batch_index"]] if record is not None else [],
+                trace_ids=[trace_id] if trace_id is not None else None,
+            )
 
     def _drive_eager(
-        self, tenant: str, args: tuple, kwargs: dict, record: Optional[dict] = None
+        self,
+        tenant: str,
+        args: tuple,
+        kwargs: dict,
+        record: Optional[dict] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Whole-target per-tenant update (target unfusable)."""
         target = self._metrics[tenant]
-        before = self._tenant_robust_counts(tenant) if record is not None else (0, 0)
+        attributed = record is not None or trace_id is not None
+        before = self._tenant_robust_counts(tenant) if attributed else (0, 0)
         with _scope.session(tenant):
-            if _trace.ENABLED:
-                with _trace.span("engine.dispatch", pipeline=self._label, path="eager"):
+            with _lineage.trace(trace_id):
+                if _trace.ENABLED:
+                    span_attrs: Dict[str, Any] = {"pipeline": self._label, "path": "eager"}
+                    if trace_id is not None:
+                        span_attrs["trace_id"] = trace_id
+                    with _trace.span("engine.dispatch", **span_attrs):
+                        target.update(*args, **kwargs)
+                else:
                     target.update(*args, **kwargs)
-            else:
-                target.update(*args, **kwargs)
         self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.eager_updates += 1
         self._report.eager_dispatches += 1
         if _trace.ENABLED:
             _trace.inc("engine.mux_eager_updates", mux=self._label)
-        self._mark_eager_fault(tenant, record, before)
+        self._mark_eager_fault(tenant, record, before, trace_id)
         self._maybe_checkpoint()
-        self._evaluate_alerts([tenant])
+        self._evaluate_alerts(
+            [tenant], trace_ids=[trace_id] if trace_id is not None else ()
+        )
 
     def _drive_eager_leaders(self, tenant: str, args: tuple, kwargs: dict) -> None:
         target = self._metrics[tenant]
@@ -1284,23 +1438,32 @@ class TenantMultiplexer:
         self._report.eager_dispatches += len(self._eager_leaders)
 
     def _drive_fused_leaders_eagerly(
-        self, tenant: str, args: tuple, kwargs: dict, record: Optional[dict] = None
+        self,
+        tenant: str,
+        args: tuple,
+        kwargs: dict,
+        record: Optional[dict] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Per-tenant fallback for a batch that cannot join a group."""
         target = self._metrics[tenant]
-        before = self._tenant_robust_counts(tenant) if record is not None else (0, 0)
+        attributed = record is not None or trace_id is not None
+        before = self._tenant_robust_counts(tenant) if attributed else (0, 0)
         with _scope.session(tenant):
-            for m in self._per_batch_metrics(target):
-                filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
-                m.update(*args, **filtered)
-            if self._is_collection:
-                target._sync_group_states()
+            with _lineage.trace(trace_id):
+                for m in self._per_batch_metrics(target):
+                    filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
+                    m.update(*args, **filtered)
+                if self._is_collection:
+                    target._sync_group_states()
         self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.eager_updates += 1
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
-        self._mark_eager_fault(tenant, record, before)
+        self._mark_eager_fault(tenant, record, before, trace_id)
         self._maybe_checkpoint()
-        self._evaluate_alerts([tenant])
+        self._evaluate_alerts(
+            [tenant], trace_ids=[trace_id] if trace_id is not None else ()
+        )
 
     def _replay_row(self, tenant: str, args: tuple, kwargs: dict) -> None:
         """Guarded per-tenant replay of a poisoned/failed row: the tenant's own
@@ -1308,7 +1471,11 @@ class TenantMultiplexer:
         target = self._metrics[tenant]
         with _scope.session(tenant):
             if _trace.ENABLED:
-                with _trace.span("engine.dispatch", pipeline=self._label, path="replay"):
+                span_attrs: Dict[str, Any] = {"pipeline": self._label, "path": "replay"}
+                trace_id = _lineage.current_trace()  # set by _replay_rows
+                if trace_id is not None:
+                    span_attrs["trace_id"] = trace_id
+                with _trace.span("engine.dispatch", **span_attrs):
                     self._replay_updates(target, args, kwargs)
             else:
                 self._replay_updates(target, args, kwargs)
@@ -1327,7 +1494,9 @@ class TenantMultiplexer:
 
     # ------------------------------------------------------------------ alert seam
 
-    def _evaluate_alerts(self, tenants: Iterable[str], force: bool = False) -> None:
+    def _evaluate_alerts(
+        self, tenants: Iterable[str], force: bool = False, trace_ids: Iterable[str] = ()
+    ) -> None:
         """Per-committed-group value-health evaluation (``config.alert_engine``):
         sample each committed tenant's values sync-free under its session, then
         run the rules. A broken engine warns once and the stream keeps flowing."""
@@ -1343,7 +1512,18 @@ class TenantMultiplexer:
             for tenant in tenants:
                 with _scope.session(tenant):
                     _values.sample_local(self._metrics[tenant], log=log)
-            engine.evaluate()
+            transitions = engine.evaluate()
+            fired_rules = sorted(
+                {
+                    t["rule"]
+                    for t in transitions
+                    if t["to"] == "firing" and t.get("source") == "values"
+                }
+            )
+            if fired_rules:
+                # link newly-fired value watchdogs back to the rows whose
+                # commit triggered this evaluation (the lineage alert join)
+                _lineage.note_alert(list(trace_ids), fired_rules)
         except Exception as err:
             if not self._alert_warned:
                 self._alert_warned = True

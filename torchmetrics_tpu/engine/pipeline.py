@@ -80,6 +80,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
 import torchmetrics_tpu.obs.values as _values
@@ -269,7 +270,16 @@ def _normalize_batch(batch: Any) -> Tuple[tuple, dict]:
 class _Chunk:
     """One open fusion chunk: same-signature batches awaiting a fused dispatch."""
 
-    __slots__ = ("sig", "treedef", "template", "traced", "originals", "records", "first_index")
+    __slots__ = (
+        "sig",
+        "treedef",
+        "template",
+        "traced",
+        "originals",
+        "records",
+        "trace_ids",
+        "first_index",
+    )
 
     def __init__(self, sig: tuple, treedef: Any, template: tuple, first_index: int) -> None:
         self.sig = sig
@@ -278,6 +288,7 @@ class _Chunk:
         self.traced: List[list] = []  # per batch: traced leaves, template order
         self.originals: List[Tuple[tuple, dict]] = []  # per batch: (args, kwargs)
         self.records: List[dict] = []  # per batch: flight-recorder record (flight on only)
+        self.trace_ids: List[Optional[str]] = []  # per batch: lineage id (None when disabled)
         self.first_index = first_index  # ingest ordinal of the chunk's first batch
 
     def __len__(self) -> int:
@@ -313,9 +324,18 @@ class _FlightRecorder:
     def __len__(self) -> int:
         return len(self._ring)
 
-    def open_record(self, batch_index: int, stages: Optional[Dict[str, float]] = None) -> dict:
+    def open_record(
+        self,
+        batch_index: int,
+        stages: Optional[Dict[str, float]] = None,
+        trace_id: Optional[str] = None,
+    ) -> dict:
         record = {
             "batch_index": batch_index,
+            # the canonical correlation key (obs/lineage.py): batch_index and
+            # chunk_id ordinals restart per process across a restore, the
+            # trace id does not — dump readers should join on it when present
+            "trace_id": trace_id,
             "chunk_id": None,
             "signature": None,
             "path": None,
@@ -348,6 +368,7 @@ class _FlightRecorder:
         poisoned: List[int],
         config: Dict[str, Any],
         tenant: Optional[str] = None,
+        poisoned_trace_ids: Optional[List[str]] = None,
     ) -> Optional[str]:
         """Write the ring as JSONL (meta line first, then batches oldest-first).
 
@@ -372,6 +393,9 @@ class _FlightRecorder:
             "tenant": tenant if tenant is not None else self.tenant,
             "reason": reason,
             "poisoned_batches": sorted(set(poisoned)),
+            # the cross-restore-stable naming of the same batches (may be
+            # empty: lineage off, or a fault with no batch to name)
+            "poisoned_trace_ids": sorted(set(poisoned_trace_ids or [])),
             "records": len(self._ring),
             "ts_unix": time.time(),
             "config": config,
@@ -450,6 +474,13 @@ class MetricPipeline:
         self._inflight: deque = deque()
         self._ingested = 0
         self._chunk_seq = 0
+        # batch lineage (obs/lineage.py): the session epoch + arrival counter
+        # minting one stable trace id per fed batch. Both are persisted in
+        # session bundles and restored, so the same logical batch keeps its id
+        # across migration and crash-recovery re-feeds; with lineage disabled
+        # the counter never moves (one branch per ingest).
+        self._lineage_epoch = _lineage.new_epoch()
+        self._lineage_seq = 0
         self._report = PipelineReport()
         self._warmup_manifest: Optional[Dict[str, Any]] = None
         if config.flight_records > 0:
@@ -470,7 +501,9 @@ class MetricPipeline:
         self._alert_engine = config.alert_engine
         self._alert_commits = 0
         self._alert_warned = False
-        self._deferred: List[Tuple[tuple, dict]] = []  # admission-deprioritized batches
+        # admission-deprioritized batches as (args, kwargs, trace_id) — the id
+        # was minted at first arrival, so defer → re-admission keeps identity
+        self._deferred: List[Tuple[tuple, dict, Optional[str]]] = []
         self._shed_warned = False
         self._tenant: Optional[str] = None
         self._tenant_closed = False
@@ -534,6 +567,17 @@ class MetricPipeline:
     def warmup_manifest(self) -> Optional[Dict[str, Any]]:
         return self._warmup_manifest
 
+    @property
+    def lineage_epoch(self) -> str:
+        """The session epoch trace ids are minted under (bundle-persisted)."""
+        return self._lineage_epoch
+
+    def trace_id_for(self, ordinal: int) -> str:
+        """The (deterministic) trace id of this session's ``ordinal``-th fed
+        batch — the ``GET /trace/<id>`` key a driver can compute without
+        having observed the ingest."""
+        return _lineage.mint(self._tenant, self._lineage_epoch, ordinal)
+
     def flight_records(self) -> List[dict]:
         """Copies of the flight-recorder ring (empty when ``flight_records=0``)."""
         return self._flight.records() if self._flight is not None else []
@@ -574,6 +618,25 @@ class MetricPipeline:
         # the ingest ordinal continues too, so flight-record batch indices
         # stay the session's (not the process's) ordinals
         self._ingested = max(self._ingested, int(totals.get("batches", 0) or 0))
+
+    def _restore_lineage(self, cursor: Dict[str, Any]) -> None:
+        """Adopt the bundled session's lineage identity + chunk ordinal.
+
+        The epoch + arrival counter make post-restore mints continue the
+        origin session's id space (a crash-recovery gap re-feed reproduces
+        the lost batches' exact ids); ``chunk_seq`` continues too, so a
+        post-restore dispatch span's ``chunk_id`` can never collide with a
+        restored flight record's — the ordinal half of the span↔record
+        correlation fix (the trace id is the canonical key either way).
+        """
+        lineage_row = cursor.get("lineage") or {}
+        if lineage_row.get("epoch"):
+            self._lineage_epoch = str(lineage_row["epoch"])
+            self._lineage_seq = max(
+                self._lineage_seq, int(lineage_row.get("seq", 0) or 0)
+            )
+        if cursor.get("chunk_seq") is not None:
+            self._chunk_seq = max(self._chunk_seq, int(cursor["chunk_seq"]))
 
     def feed(self, *args: Any, **kwargs: Any) -> None:
         """Ingest one batch (positional/keyword update arguments)."""
@@ -683,7 +746,7 @@ class MetricPipeline:
         self._drain_deferred(controller)
         return n
 
-    def drain(self) -> List[Tuple[tuple, dict]]:
+    def drain(self) -> List[Tuple[tuple, dict, Optional[str]]]:
         """Quiesce the pipeline for a checkpoint; returns the **replay tail**.
 
         The first step of the drain→checkpoint→restore→replay-tail migration
@@ -692,8 +755,11 @@ class MetricPipeline:
         completion — after which the metric state is exactly the fold of every
         dispatched batch — and the admission-deferred backlog (batches
         ingested but never folded) is handed back, cleared, as the tail to
-        persist and replay after restore. The session stays open (``close()``
-        still owes the registry its ``pipeline_finished``).
+        persist and replay after restore. Each tail item is ``(args, kwargs,
+        trace_id)`` — the third element is the batch's lineage id
+        (:mod:`~torchmetrics_tpu.obs.lineage`; ``None`` with lineage off),
+        exactly what :meth:`replay_tail` re-ingests. The session stays open
+        (``close()`` still owes the registry its ``pipeline_finished``).
         """
         with self._tenant_ctx():
             if self._chunk is not None and len(self._chunk):
@@ -705,8 +771,14 @@ class MetricPipeline:
             tail, self._deferred = self._deferred, []
             return tail
 
-    def replay_tail(self, batches: Iterable[Tuple[tuple, dict]], deferred: int = 0) -> int:
+    def replay_tail(self, batches: Iterable[tuple], deferred: int = 0) -> int:
         """Re-ingest checkpointed tail batches on the restored host, in order.
+
+        Each item is ``(args, kwargs)`` or ``(args, kwargs, trace_id)`` — the
+        third element is the batch's bundle-persisted lineage id, re-adopted
+        so the replayed batch keeps the identity it was fed under on the
+        origin host (``GET /trace/<id>`` keeps resolving across the
+        migration).
 
         Admission *decisions* are bypassed — these batches were accepted by
         the origin host before the checkpoint; replaying them is completing
@@ -727,12 +799,16 @@ class MetricPipeline:
             )
         n = 0
         with self._tenant_ctx():
-            for args, kwargs in batches:
+            for item in batches:
+                args, kwargs = item[0], item[1]
+                trace_id = item[2] if len(item) > 2 else None
                 if n < deferred:
                     self._report.deferred_replayed += 1
                 if controller is not None:
                     controller.charge(self._tenant, updates=1)
-                self._ingest(tuple(args), dict(kwargs), bypass_admission=True)
+                self._ingest(
+                    tuple(args), dict(kwargs), bypass_admission=True, trace_id=trace_id
+                )
                 n += 1
         return n
 
@@ -890,11 +966,11 @@ class MetricPipeline:
         bypassed — the work executes regardless — but executed updates are
         still billed). Shared by the back-under-quota path and close()."""
         while self._deferred:
-            args, kwargs = self._deferred.pop(0)
+            args, kwargs, trace_id = self._deferred.pop(0)
             self._report.deferred_replayed += 1
             if controller is not None:
                 controller.charge(self._tenant, updates=1)
-            self._ingest(args, kwargs, bypass_admission=True)
+            self._ingest(args, kwargs, bypass_admission=True, trace_id=trace_id)
 
     def _ingest(
         self,
@@ -902,7 +978,23 @@ class MetricPipeline:
         kwargs: dict,
         stages: Optional[Dict[str, float]] = None,
         bypass_admission: bool = False,
+        trace_id: Optional[str] = None,
     ) -> None:
+        if _lineage.ENABLED and trace_id is None:
+            # identity is assigned at FIRST arrival — before the admission
+            # decision — so a deferred batch re-admitted later (or persisted
+            # as a migration tail) keeps the id it arrived with
+            ordinal = self._lineage_seq
+            self._lineage_seq += 1
+            trace_id = self.trace_id_for(ordinal)
+            _lineage.get_index().open(trace_id, self._tenant, ordinal)
+        elif trace_id is not None and _lineage.ENABLED:
+            # a pre-minted id (deferred re-admission, tail replay, crash gap
+            # re-feed): idempotent re-open — a record already live keeps its
+            # original stamps, a restored-host replay recreates it
+            _lineage.get_index().open(
+                trace_id, self._tenant, _lineage.ordinal_of(trace_id)
+            )
         if self._tenant is not None and not bypass_admission:
             # cost-aware admission (obs/scope.py): only tenant SESSIONS are
             # metered — an untenanted pipeline never consults the controller,
@@ -922,6 +1014,8 @@ class MetricPipeline:
                     decision = _scope.SHED
                 if decision == _scope.SHED:
                     self._report.shed_batches += 1
+                    if trace_id is not None:
+                        _lineage.get_index().update(trace_id, outcome="shed")
                     if not self._shed_warned:
                         self._shed_warned = True
                         rank_zero_warn(
@@ -935,8 +1029,10 @@ class MetricPipeline:
                         _trace.inc("engine.shed_batches", pipeline=self._label)
                     return
                 if decision == _scope.DEFER:
-                    self._deferred.append((args, kwargs))
+                    self._deferred.append((args, kwargs, trace_id))
                     self._report.deferred_batches += 1
+                    if trace_id is not None:
+                        _lineage.get_index().update(trace_id, outcome="deferred")
                     if _trace.ENABLED:
                         _trace.inc("engine.deferred_batches", pipeline=self._label)
                     return
@@ -953,7 +1049,19 @@ class MetricPipeline:
         self._report.batches += 1
         record = None
         if self._flight is not None:
-            record = self._flight.open_record(batch_index, stages)
+            record = self._flight.open_record(batch_index, stages, trace_id=trace_id)
+        if trace_id is not None and _trace.ENABLED:
+            # the lineage flow's first anchor: a (near-zero) ingest span
+            # carrying the trace id plus the prefetch/device_put stage
+            # timings, so Perfetto draws prefetch → dispatch as one arrow
+            # chain per batch (numeric attrs never become histogram labels)
+            ingest_attrs: Dict[str, Any] = {"pipeline": self._label, "trace_id": trace_id}
+            if stages:
+                ingest_attrs.update(
+                    {k: v for k, v in stages.items() if v is not None}
+                )
+            with _trace.span("engine.ingest", **ingest_attrs):
+                pass
         if _trace.ENABLED:
             _trace.inc("engine.batches", pipeline=self._label)
             if record is not None:
@@ -961,7 +1069,7 @@ class MetricPipeline:
                     "flight.records", len(self._flight), pipeline=self._label, inst=self._instance
                 )
         if not self._fusable:
-            self._drive_per_batch(args, kwargs, record)
+            self._drive_per_batch(args, kwargs, record, trace_id)
             return
         if self._eager_leaders:
             # unfusable group leaders advance per batch, in stream order
@@ -973,11 +1081,15 @@ class MetricPipeline:
             # through to the per-batch path for this batch
             if self._chunk is not None and len(self._chunk):
                 self._dispatch_chunk()
-            self._drive_fused_leaders_eagerly(args, kwargs, record)
+            self._drive_fused_leaders_eagerly(args, kwargs, record, trace_id)
             return
         sig = (treedef, tuple(template), _aval_signature(traced))
-        if record is not None:
-            record["signature"] = signature_str(sig[2])
+        if record is not None or trace_id is not None:
+            sig_str = signature_str(sig[2])
+            if record is not None:
+                record["signature"] = sig_str
+            if trace_id is not None:
+                _lineage.get_index().update(trace_id, signature=sig_str)
         if self._chunk is not None and self._chunk.sig != sig:
             self._report.shape_flushes += 1
             if _trace.ENABLED:
@@ -987,6 +1099,7 @@ class MetricPipeline:
             self._chunk = _Chunk(sig, treedef, tuple(template), batch_index)
         self._chunk.traced.append(traced)
         self._chunk.originals.append((args, kwargs))
+        self._chunk.trace_ids.append(trace_id)
         if record is not None:
             self._chunk.records.append(record)
         if _trace.ENABLED:
@@ -1094,19 +1207,28 @@ class MetricPipeline:
         state = self._current_fused_state()
         timed = bool(chunk.records)
         start = time.perf_counter() if timed else 0.0
+        chunk_ids = [t for t in chunk.trace_ids if t is not None]
         try:
             if _trace.ENABLED:
                 # batch_index/chunk_id are numeric attrs: they land on the span
                 # (correlatable with flight-recorder records and Perfetto) but
-                # never become histogram labels, so cardinality stays bounded
-                with _trace.span(
-                    "engine.dispatch",
-                    pipeline=self._label,
-                    path="fused",
-                    chunk_id=cid,
-                    batch_index=chunk.first_index,
-                ):
-                    new_state = fused(state, stacked, valid)
+                # never become histogram labels, so cardinality stays bounded.
+                # trace_id/trace_ids are string attrs EXCLUDED from labels by
+                # the recorder (unbounded ids must never mint series); the
+                # ambient lineage context makes the dispatch histogram's
+                # exemplar reference the chunk's lead batch
+                span_attrs: Dict[str, Any] = {
+                    "pipeline": self._label,
+                    "path": "fused",
+                    "chunk_id": cid,
+                    "batch_index": chunk.first_index,
+                }
+                if chunk_ids:
+                    span_attrs["trace_id"] = chunk_ids[0]
+                    span_attrs["trace_ids"] = ",".join(chunk_ids)
+                with _lineage.trace(chunk_ids[0] if chunk_ids else None):
+                    with _trace.span("engine.dispatch", **span_attrs):
+                        new_state = fused(state, stacked, valid)
             else:
                 new_state = fused(state, stacked, valid)
         except Exception as err:
@@ -1151,8 +1273,12 @@ class MetricPipeline:
             record["stages"]["dispatch"] = round(dispatch_seconds, 6)
             record["stages"]["commit"] = round(commit_seconds, 6)
             record["stages"]["blocked_on_inflight"] = round(waited, 6)
+        if chunk_ids:
+            index = _lineage.get_index()
+            for tid in chunk_ids:
+                index.update(tid, chunk_id=cid, path="fused", outcome="ok")
         self._maybe_checkpoint()
-        self._evaluate_alerts()
+        self._evaluate_alerts(trace_ids=chunk_ids)
 
     def _commit(self, new_state: Any, n: int) -> None:
         if self._is_collection:
@@ -1196,18 +1322,31 @@ class MetricPipeline:
             skipped += int(getattr(m, "updates_skipped", 0) or 0)
         return quarantined, skipped
 
-    def _mark_fault(self, record: Optional[dict], before: Tuple[int, int]) -> Optional[str]:
-        """Stamp a flight record with the fault its update triggered, if any."""
-        if record is None:
+    def _mark_fault(
+        self,
+        record: Optional[dict],
+        before: Tuple[int, int],
+        trace_id: Optional[str] = None,
+    ) -> Optional[str]:
+        """Stamp a flight record (and the lineage record) with the fault its
+        update triggered, if any."""
+        if record is None and trace_id is None:
             return None
         quarantined, skipped = self._robust_counts()
+        fault: Optional[str] = None
         if quarantined > before[0]:
-            record["fault"] = "quarantined"
+            fault = "quarantined"
         elif skipped > before[1]:
-            record["fault"] = "skipped"
-        return record["fault"]
+            fault = "skipped"
+        if record is not None:
+            record["fault"] = fault
+        if trace_id is not None and fault is not None:
+            _lineage.get_index().update(trace_id, outcome=fault)
+        return fault
 
-    def _dump_flight(self, reason: str, poisoned: List[int]) -> Optional[str]:
+    def _dump_flight(
+        self, reason: str, poisoned: List[int], trace_ids: Optional[List[str]] = None
+    ) -> Optional[str]:
         """Dump the flight ring on a fault; telemetry rides along when tracing."""
         if self._flight is None:
             return None
@@ -1218,9 +1357,10 @@ class MetricPipeline:
             "buckets": list(self._buckets),
             "tenant": self._tenant,
         }
-        path = self._flight.dump(reason, poisoned, config)
+        path = self._flight.dump(reason, poisoned, config, poisoned_trace_ids=trace_ids)
         if path is not None:
             self._report.flight_dumps += 1
+            _lineage.note_dump(trace_ids or [], path)
             if _trace.ENABLED:
                 _trace.inc("flight.dumps", pipeline=self._label)
                 _trace.event(
@@ -1229,35 +1369,56 @@ class MetricPipeline:
                     reason=reason,
                     path=path,
                     poisoned=",".join(map(str, sorted(set(poisoned)))),
+                    trace_ids=",".join(sorted(set(trace_ids or []))),
                 )
         return path
 
-    def _drive_per_batch(self, args: tuple, kwargs: dict, record: Optional[dict] = None) -> None:
+    def _drive_per_batch(
+        self,
+        args: tuple,
+        kwargs: dict,
+        record: Optional[dict] = None,
+        trace_id: Optional[str] = None,
+    ) -> None:
         """Whole-target per-batch update (fusion off or target unfusable)."""
-        before = self._robust_counts() if record is not None else (0, 0)
+        attributed = record is not None or trace_id is not None
+        before = self._robust_counts() if attributed else (0, 0)
         start = time.perf_counter() if record is not None else 0.0
-        if _trace.ENABLED:
-            with _trace.span(
-                "engine.dispatch", pipeline=self._label, path="eager", batch_index=self._ingested - 1
-            ):
+        with _lineage.trace(trace_id):
+            if _trace.ENABLED:
+                span_attrs: Dict[str, Any] = {
+                    "pipeline": self._label,
+                    "path": "eager",
+                    "batch_index": self._ingested - 1,
+                }
+                if trace_id is not None:
+                    span_attrs["trace_id"] = trace_id
+                with _trace.span("engine.dispatch", **span_attrs):
+                    self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
+            else:
                 self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
-        else:
-            self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
         self._report.eager_batches += 1
         self._report.eager_dispatches += 1
         if _trace.ENABLED:
             _trace.inc("engine.eager_batches", pipeline=self._label)
         waited = self._ticket(self._current_any_state())
-        if record is not None:
-            record["path"] = "eager"
-            record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
-            record["stages"]["blocked_on_inflight"] = round(waited, 6)
-            if self._mark_fault(record, before) == "quarantined":
+        if attributed:
+            if trace_id is not None:
+                _lineage.get_index().update(trace_id, path="eager", outcome="ok")
+            if record is not None:
+                record["path"] = "eager"
+                record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
+                record["stages"]["blocked_on_inflight"] = round(waited, 6)
+            if self._mark_fault(record, before, trace_id) == "quarantined":
                 # the per-batch path has no replay step: the quarantine itself
                 # is the fault event, so it dumps the lineage directly
-                self._dump_flight("quarantine", [record["batch_index"]])
+                self._dump_flight(
+                    "quarantine",
+                    [record["batch_index"]] if record is not None else [],
+                    trace_ids=[trace_id] if trace_id is not None else None,
+                )
         self._maybe_checkpoint()
-        self._evaluate_alerts()
+        self._evaluate_alerts(trace_ids=[trace_id] if trace_id is not None else ())
 
     def _drive_eager_leaders(self, args: tuple, kwargs: dict) -> None:
         def _run() -> None:
@@ -1269,7 +1430,11 @@ class MetricPipeline:
         self._report.eager_dispatches += len(self._eager_leaders)
 
     def _drive_fused_leaders_eagerly(
-        self, args: tuple, kwargs: dict, record: Optional[dict] = None
+        self,
+        args: tuple,
+        kwargs: dict,
+        record: Optional[dict] = None,
+        trace_id: Optional[str] = None,
     ) -> None:
         """Per-batch fallback for a batch that cannot join a chunk."""
 
@@ -1278,28 +1443,42 @@ class MetricPipeline:
                 filtered = m._filter_kwargs(**kwargs) if self._is_collection else kwargs
                 m.update(*args, **filtered)
 
-        before = self._robust_counts() if record is not None else (0, 0)
+        attributed = record is not None or trace_id is not None
+        before = self._robust_counts() if attributed else (0, 0)
         start = time.perf_counter() if record is not None else 0.0
-        if _trace.ENABLED:
-            with _trace.span(
-                "engine.dispatch", pipeline=self._label, path="eager", batch_index=self._ingested - 1
-            ):
+        with _lineage.trace(trace_id):
+            if _trace.ENABLED:
+                span_attrs: Dict[str, Any] = {
+                    "pipeline": self._label,
+                    "path": "eager",
+                    "batch_index": self._ingested - 1,
+                }
+                if trace_id is not None:
+                    span_attrs["trace_id"] = trace_id
+                with _trace.span("engine.dispatch", **span_attrs):
+                    self._suppressing_refault(_run)
+            else:
                 self._suppressing_refault(_run)
-        else:
-            self._suppressing_refault(_run)
         if self._is_collection:
             self._target._sync_group_states()
         self._report.eager_batches += 1
         # one host dispatch per driven metric (multi-group collections issue
         # several updates per batch), matching _drive_eager_leaders' accounting
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
-        if record is not None:
-            record["path"] = "eager"
-            record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
-            if self._mark_fault(record, before) == "quarantined":
-                self._dump_flight("quarantine", [record["batch_index"]])
+        if attributed:
+            if trace_id is not None:
+                _lineage.get_index().update(trace_id, path="eager", outcome="ok")
+            if record is not None:
+                record["path"] = "eager"
+                record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
+            if self._mark_fault(record, before, trace_id) == "quarantined":
+                self._dump_flight(
+                    "quarantine",
+                    [record["batch_index"]] if record is not None else [],
+                    trace_ids=[trace_id] if trace_id is not None else None,
+                )
         self._maybe_checkpoint()
-        self._evaluate_alerts()
+        self._evaluate_alerts(trace_ids=[trace_id] if trace_id is not None else ())
 
     def _replay_chunk(self, chunk: _Chunk, cid: int) -> None:
         """Per-batch replay of a degraded chunk: the metrics' own guarded updates
@@ -1314,9 +1493,12 @@ class MetricPipeline:
         if _trace.ENABLED:
             _trace.inc("engine.chunks_replayed", pipeline=self._label)
         poisoned: List[int] = []
+        poisoned_ids: List[str] = []
         for step, (args, kwargs) in enumerate(chunk.originals):
             record = chunk.records[step] if step < len(chunk.records) else None
-            before = self._robust_counts() if record is not None else (0, 0)
+            tid = chunk.trace_ids[step] if step < len(chunk.trace_ids) else None
+            attributed = record is not None or tid is not None
+            before = self._robust_counts() if attributed else (0, 0)
             start = time.perf_counter() if record is not None else 0.0
 
             def _run(args=args, kwargs=kwargs) -> None:
@@ -1325,49 +1507,66 @@ class MetricPipeline:
                     m.update(*args, **filtered)
 
             try:
-                if _trace.ENABLED:
-                    with _trace.span(
-                        "engine.dispatch",
-                        pipeline=self._label,
-                        path="replay",
-                        chunk_id=cid,
-                        batch_index=chunk.first_index + step,
-                    ):
+                with _lineage.trace(tid):
+                    if _trace.ENABLED:
+                        span_attrs: Dict[str, Any] = {
+                            "pipeline": self._label,
+                            "path": "replay",
+                            "chunk_id": cid,
+                            "batch_index": chunk.first_index + step,
+                        }
+                        if tid is not None:
+                            span_attrs["trace_id"] = tid
+                        with _trace.span("engine.dispatch", **span_attrs):
+                            self._suppressing_refault(_run)
+                    else:
                         self._suppressing_refault(_run)
-                else:
-                    self._suppressing_refault(_run)
             except BaseException:
                 # raise policy (or an unguarded failure): the faulting batch is
                 # named and the lineage dumped BEFORE the exception propagates
+                if tid is not None:
+                    poisoned_ids.append(tid)
+                    _lineage.get_index().update(
+                        tid, chunk_id=cid, path="replay", outcome="raised"
+                    )
                 if record is not None:
                     record["chunk_id"] = cid
                     record["path"] = "replay"
                     record["fault"] = "raised"
                     poisoned.append(record["batch_index"])
-                    self._dump_flight("chunk_replay", poisoned)
+                if record is not None or tid is not None:
+                    self._dump_flight("chunk_replay", poisoned, trace_ids=poisoned_ids)
                 raise
             self._report.replayed_batches += 1
             self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
             if _trace.ENABLED:
                 _trace.inc("engine.replayed_batches", pipeline=self._label)
-            if record is not None:
-                record["chunk_id"] = cid
-                record["path"] = "replay"
-                record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
-                if self._mark_fault(record, before) is not None:
-                    poisoned.append(record["batch_index"])
+            if attributed:
+                if tid is not None:
+                    _lineage.get_index().update(
+                        tid, chunk_id=cid, path="replay", outcome="ok"
+                    )
+                if record is not None:
+                    record["chunk_id"] = cid
+                    record["path"] = "replay"
+                    record["stages"]["dispatch"] = round(time.perf_counter() - start, 6)
+                if self._mark_fault(record, before, tid) is not None:
+                    if record is not None:
+                        poisoned.append(record["batch_index"])
+                    if tid is not None:
+                        poisoned_ids.append(tid)
         if self._is_collection:
             self._target._sync_group_states()
         waited = self._ticket(self._current_any_state())
         for record in chunk.records:
             record["stages"]["blocked_on_inflight"] = round(waited, 6)
-        self._dump_flight("chunk_replay", poisoned)
+        self._dump_flight("chunk_replay", poisoned, trace_ids=poisoned_ids)
         self._maybe_checkpoint()
-        self._evaluate_alerts()
+        self._evaluate_alerts(trace_ids=[t for t in chunk.trace_ids if t is not None])
 
     # ------------------------------------------------------------ alerting seam
 
-    def _evaluate_alerts(self, force: bool = False) -> None:
+    def _evaluate_alerts(self, force: bool = False, trace_ids: Iterable[str] = ()) -> None:
         """Per-committed-chunk value-health evaluation (``config.alert_engine``).
 
         Samples the target's values sync-free (``pure_update`` streams must not
@@ -1409,6 +1608,11 @@ class MetricPipeline:
         if not fired:
             return
         rules = sorted({t["rule"] for t in fired})
+        # the commit that triggered this evaluation links the fired rules to
+        # the batches it folded: for an unguarded NaN (the victim-tenant
+        # scenario) this is exactly "injection → value watchdog firing" on the
+        # poisoned batch's own lineage record
+        _lineage.note_alert(list(trace_ids), rules)
         if _trace.ENABLED:
             _trace.inc("engine.value_alerts", len(fired), pipeline=self._label)
             _trace.event(

@@ -49,6 +49,12 @@ recorder:
   out-of-declared-bounds, frozen, jump/z-score, absence, threshold) with a
   pending→firing→resolved state machine, JSONL transition sink, Prometheus
   ``ALERTS``-style series and fleet-wide cross-host merge.
+- :mod:`~torchmetrics_tpu.obs.lineage` — distributed batch lineage: a stable
+  ``trace_id`` per fed batch (tenant + session epoch + ingest ordinal,
+  contextvar-propagated) surviving admission defer, fusion chunking,
+  poisoned-row replay, the multiplexer, migration tails and crash-recovery
+  re-feeds; a bounded trace-id index behind ``GET /trace/<id>``, histogram
+  exemplars, and Perfetto flow events.
 - :mod:`~torchmetrics_tpu.obs.scope` — tenant/session attribution: a
   contextvar-based ``scope(tenant=...)`` context manager stamping every
   recorder write, value point, alert and cost entry with a bounded-cardinality
@@ -79,6 +85,7 @@ from torchmetrics_tpu.obs import (
     alerts,
     cost,
     export,
+    lineage,
     memory,
     perfetto,
     profile,
@@ -135,6 +142,7 @@ __all__ = [
     "host_snapshot",
     "inc",
     "is_enabled",
+    "lineage",
     "memory",
     "merge_snapshots",
     "observe",

@@ -20,10 +20,13 @@ import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.utils.fileio import atomic_write_text
 
 __all__ = [
+    "OPENMETRICS_CONTENT_TYPE",
+    "PROMETHEUS_CONTENT_TYPE",
     "build_info",
     "collect",
     "filter_tenant",
     "histogram_quantile",
+    "openmetrics_text",
     "prometheus_text",
     "quantile_bucket",
     "summary",
@@ -32,6 +35,13 @@ __all__ = [
 
 # every exported series is namespaced; dots in internal names become underscores
 _PROM_PREFIX = "tm_tpu_"
+
+# the two negotiated exposition flavors the obs server serves on /metrics:
+# classic text (the default — strict 0.0.4, byte-stable, exemplar-free) and
+# OpenMetrics (opt-in via the Accept header — same series, plus histogram
+# EXEMPLARS in `# {trace_id="..."}` syntax and a terminating `# EOF`)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 _ROBUST_COUNTERS = ("updates_ok", "updates_skipped", "updates_quarantined", "quarantine_dropped")
 _ROBUST_FLAGS = ("sync_degraded", "last_update_ok")
@@ -307,6 +317,11 @@ _GAUGE_HELP = {
     "checkpoint.bundle_bytes": "Mean bundle bytes per checkpoint kind (full vs delta) for this tenant session",
     "checkpoint.bundles": "Continuous-checkpoint bundles written per kind (full vs delta)",
     "checkpoint.failures": "Continuous-checkpoint writes that failed (stream kept flowing; staleness grows)",
+    # batch-lineage index families (obs/lineage.py): the bounded trace-id
+    # index's cardinality, measured — eviction is visible, never silent
+    "lineage.traces": "Live per-batch lineage records in the bounded trace-id index",
+    "lineage.evicted": "Lineage records evicted from the bounded trace-id index (oldest-first)",
+    "lineage.minted": "Trace ids minted by this process since the index was last reset",
 }
 
 
@@ -331,27 +346,32 @@ def _hist_help(name: str) -> str:
     return f"Duration distribution of `{name}` in seconds (torchmetrics_tpu.obs)"
 
 
-def prometheus_text(
-    metrics: Iterable[Any] = (),
-    recorder: Optional[trace.TraceRecorder] = None,
-    tenant: Optional[str] = None,
-) -> str:
-    """Prometheus text exposition (0.0.4) of counters, gauges, histograms and
-    the per-metric robust counters. Every family gets a ``# HELP`` + ``# TYPE``
-    header; histograms emit cumulative ``_bucket`` lines whose ``le`` labels
-    end in ``+Inf`` plus ``_sum``/``_count``. ``tenant`` scopes the page to one
-    tenant's series (``/metrics?tenant=``); meta families (build info, dropped
-    events) stay on the scoped page.
+def _render_exposition(snap: Dict[str, Any], openmetrics: bool) -> str:
+    """One exposition walk, two flavors.
+
+    ``openmetrics=False`` renders exactly the classic 0.0.4 page (byte-stable:
+    the strict-parser goldens lock it) — histogram exemplars that may exist in
+    the snapshot are **dropped**, because the classic text format has no
+    exemplar syntax and a classic scraper must keep parsing unchanged.
+    ``openmetrics=True`` renders the OpenMetrics flavor: counter family
+    headers drop the ``_total`` suffix (samples keep it), histogram bucket
+    lines carry their bucket's freshest exemplar as
+    ``# {trace_id="..."} <value> <timestamp>``, and the page ends ``# EOF``.
+    Exemplars reference already-existing series only — they can never mint a
+    new label set.
     """
-    snap = collect(metrics, recorder, tenant=tenant)
     out: List[str] = []
+
+    def header(sample_name: str, family_name: str, kind: str, help_text: str) -> None:
+        name = family_name if openmetrics else sample_name
+        _prom_header(out, name, kind, help_text)
 
     by_name: Dict[str, List[Dict[str, Any]]] = {}
     for counter in snap["counters"]:
         by_name.setdefault(counter["name"], []).append(counter)
     for name in sorted(by_name):
         prom = _prom_name(name) + "_total"
-        _prom_header(out, prom, "counter", f"Cumulative count of `{name}` events (torchmetrics_tpu.obs)")
+        header(prom, _prom_name(name), "counter", f"Cumulative count of `{name}` events (torchmetrics_tpu.obs)")
         for counter in by_name[name]:
             out.append(f"{prom}{_prom_labels(counter['labels'])} {_prom_value(counter['value'])}")
 
@@ -360,7 +380,7 @@ def prometheus_text(
         by_name.setdefault(gauge["name"], []).append(gauge)
     for name in sorted(by_name):
         prom = _prom_name(name)
-        _prom_header(out, prom, "gauge", _gauge_help(name))
+        header(prom, prom, "gauge", _gauge_help(name))
         for gauge in by_name[name]:
             out.append(f"{prom}{_prom_labels(gauge['labels'])} {_prom_value(gauge['value'])}")
 
@@ -369,14 +389,24 @@ def prometheus_text(
         by_name.setdefault(hist["name"], []).append(hist)
     for name in sorted(by_name):
         prom = _prom_name(name) + "_seconds"
-        _prom_header(out, prom, "histogram", _hist_help(name))
+        header(prom, prom, "histogram", _hist_help(name))
         for hist in by_name[name]:
+            exemplars = hist.get("exemplars") or {}
             cumulative = 0
-            for bound, count in hist["buckets"]:
+            for index, (bound, count) in enumerate(hist["buckets"]):
                 cumulative += count
                 le = "+Inf" if math.isinf(bound) else f"{bound:g}"
                 labels = _prom_labels({**hist["labels"], "le": le})
-                out.append(f"{prom}_bucket{labels} {cumulative}")
+                line = f"{prom}_bucket{labels} {cumulative}"
+                if openmetrics:
+                    rows = exemplars.get(str(index)) or exemplars.get(index)
+                    if rows:
+                        trace_id, value, wall = rows[-1]  # freshest exemplar wins
+                        line += (
+                            f' # {{trace_id="{_prom_escape(trace_id)}"}}'
+                            f" {float(value):.9g} {float(wall):.3f}"
+                        )
+                out.append(line)
             out.append(f"{prom}_sum{_prom_labels(hist['labels'])} {_prom_value(hist['sum'])}")
             out.append(f"{prom}_count{_prom_labels(hist['labels'])} {hist['count']}")
 
@@ -390,27 +420,76 @@ def prometheus_text(
 
         for name in _ROBUST_COUNTERS:
             prom = _prom_name("robust." + name) + "_total"
-            _prom_header(out, prom, "counter", f"Per-metric robustness counter `{name}` (torchmetrics_tpu.robust)")
+            header(
+                prom,
+                _prom_name("robust." + name),
+                "counter",
+                f"Per-metric robustness counter `{name}` (torchmetrics_tpu.robust)",
+            )
             for row in snap["robust"]:
                 out.append(f"{prom}{_prom_labels(_robust_labels(row))} {row[name]}")
         for name in _ROBUST_FLAGS:
             prom = _prom_name("robust." + name)
-            _prom_header(out, prom, "gauge", f"Per-metric robustness flag `{name}` (torchmetrics_tpu.robust)")
+            header(prom, prom, "gauge", f"Per-metric robustness flag `{name}` (torchmetrics_tpu.robust)")
             for row in snap["robust"]:
                 out.append(f"{prom}{_prom_labels(_robust_labels(row))} {int(row[name])}")
 
     prom = _prom_name("dropped_events") + "_total"
-    _prom_header(out, prom, "counter", "Events evicted from the telemetry ring buffer (torchmetrics_tpu.obs)")
+    header(
+        prom,
+        _prom_name("dropped_events"),
+        "counter",
+        "Events evicted from the telemetry ring buffer (torchmetrics_tpu.obs)",
+    )
     out.append(f"{prom} {snap['dropped_events']}")
 
     # node-exporter-style identity gauge: constant 1, labels carry the build
     prom = _prom_name("build_info")
-    _prom_header(
-        out, prom, "gauge",
+    header(
+        prom, prom, "gauge",
         "Build identity of this process: package/jax versions, backend, process index (torchmetrics_tpu.obs)",
     )
     out.append(f"{prom}{_prom_labels(snap['build_info'])} 1")
+    if openmetrics:
+        out.append("# EOF")
     return "\n".join(out) + "\n"
+
+
+def prometheus_text(
+    metrics: Iterable[Any] = (),
+    recorder: Optional[trace.TraceRecorder] = None,
+    tenant: Optional[str] = None,
+) -> str:
+    """Prometheus text exposition (0.0.4) of counters, gauges, histograms and
+    the per-metric robust counters. Every family gets a ``# HELP`` + ``# TYPE``
+    header; histograms emit cumulative ``_bucket`` lines whose ``le`` labels
+    end in ``+Inf`` plus ``_sum``/``_count``. ``tenant`` scopes the page to one
+    tenant's series (``/metrics?tenant=``); meta families (build info, dropped
+    events) stay on the scoped page. Deliberately **exemplar-free**: batch
+    lineage never changes a byte of the classic page
+    (:func:`openmetrics_text` is the exemplar-carrying flavor).
+    """
+    snap = collect(metrics, recorder, tenant=tenant)
+    return _render_exposition(snap, openmetrics=False)
+
+
+def openmetrics_text(
+    metrics: Iterable[Any] = (),
+    recorder: Optional[trace.TraceRecorder] = None,
+    tenant: Optional[str] = None,
+) -> str:
+    """OpenMetrics exposition: the classic series plus histogram exemplars.
+
+    Served by the obs server when a scraper's ``Accept`` header asks for
+    ``application/openmetrics-text`` (:data:`OPENMETRICS_CONTENT_TYPE`).
+    Histogram ``_bucket`` lines carry their bucket's freshest
+    ``(trace_id, value, wall)`` exemplar (:mod:`~torchmetrics_tpu.obs.lineage`)
+    in OpenMetrics exemplar syntax, so a dashboard can jump from a p99 latency
+    bucket straight to ``GET /trace/<id>``; the page terminates with
+    ``# EOF``.
+    """
+    snap = collect(metrics, recorder, tenant=tenant)
+    return _render_exposition(snap, openmetrics=True)
 
 
 # ------------------------------------------------------------------- quantiles

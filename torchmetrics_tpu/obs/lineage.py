@@ -1,0 +1,360 @@
+"""Distributed batch lineage: one stable ``trace_id`` per fed batch.
+
+The obs plane can say *that* p99 dispatch latency spiked, *that* a tenant's
+alert fired, and *that* a flight dump named a poisoned batch — but nothing
+connects those facts, because a batch has no identity that survives the
+engine's seams: admission defer/re-admission, fusion chunking, poisoned-row
+replay, the multiplexer's restack, ``replay_tail()`` after a migration, and
+the crash-recovery gap re-feed all re-derive ordinals per process. This
+module is the join key:
+
+- :func:`mint` — a **stable, deterministic** trace id per fed batch:
+  ``<tenant>-<session epoch>-<ingest ordinal>``. The epoch is minted once per
+  session and *persisted in session bundles*
+  (:mod:`torchmetrics_tpu.engine.migrate`), and the ordinal is the session's
+  arrival counter (restored across migration/crash recovery), so the same
+  logical batch carries the same id on whichever host finally folds it.
+- :class:`LineageIndex` — a **bounded**, thread-safe, process-wide index of
+  per-batch lineage records (tenant, ordinal, ingest stamp, signature, chunk
+  membership, dispatch path, fault outcome, the flight dump that named it,
+  the alert rules its commit triggered, the checkpoint bundle that covers
+  it). Drop-oldest past ``max_traces`` with an ``evicted`` counter — the
+  recorder's ring-buffer discipline; ``GET /trace/<id>`` 404s on an evicted
+  id and says the index is bounded.
+- :func:`trace` — a contextvar (the :mod:`~torchmetrics_tpu.obs.scope`
+  pattern: thread/task-correct, one branch when never used) carrying the
+  *current* batch's id through a dispatch, so duration histograms can attach
+  **exemplars** (:class:`~torchmetrics_tpu.obs.trace._Histogram`) and spans
+  can carry ``trace_id`` attrs (excluded from histogram labels — ids are
+  event-only, unbounded-cardinality data and must never mint series).
+
+The disabled path is one branch: :data:`ENABLED` stays ``False`` until
+:func:`enable` is called, every engine hook guards on it, and importing this
+module is pure stdlib (the ``trace``/``scope`` contract). Egress:
+``/trace/<id>`` and ``/traces`` (:mod:`~torchmetrics_tpu.obs.server`),
+OpenMetrics exemplars (:mod:`~torchmetrics_tpu.obs.export`), and Perfetto
+flow events binding one batch's spans into an arrow chain
+(:mod:`~torchmetrics_tpu.obs.perfetto`), across hosts when
+:mod:`~torchmetrics_tpu.obs.aggregate` stitches snapshots.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from contextlib import contextmanager
+from contextvars import ContextVar
+from typing import Any, Dict, Iterator, List, Optional
+
+__all__ = [
+    "DEFAULT_MAX_TRACES",
+    "ENABLED",
+    "LOCAL_TENANT",
+    "LineageIndex",
+    "current_trace",
+    "disable",
+    "enable",
+    "get_index",
+    "is_enabled",
+    "lookup",
+    "mint",
+    "new_epoch",
+    "ordinal_of",
+    "note_alert",
+    "note_checkpoint",
+    "note_dump",
+    "record_gauges",
+    "reset",
+    "trace",
+    "trace_ids",
+]
+
+# THE in-use flag. False until enable(); every engine hook guards with
+# ``if lineage.ENABLED:`` so the never-enabled runtime pays one module
+# attribute load and one branch per batch.
+ENABLED = False
+
+DEFAULT_MAX_TRACES = 4096
+
+# the current batch's trace id (set around a dispatch/replay so histogram
+# exemplars and nested metric spans can reference it)
+_TRACE: ContextVar[Optional[str]] = ContextVar("tm_tpu_trace_id", default=None)
+
+# the label untenanted sessions mint under: a ``__``-prefixed name, which
+# scope.validate_tenant reserves — so it can never collide with a real tenant
+LOCAL_TENANT = "__local__"
+
+
+def new_epoch() -> str:
+    """A fresh session epoch (random, unique per session *start*).
+
+    Sessions persist their epoch in checkpoint bundles and restores re-adopt
+    it, so a batch re-fed after a migration or crash carries the id it was
+    originally minted with — that persistence, not this function, is what
+    makes ids stable across hosts.
+    """
+    return uuid.uuid4().hex[:12]
+
+
+def mint(tenant: Optional[str], epoch: str, ordinal: int) -> str:
+    """The stable id of one fed batch: tenant + session epoch + ingest ordinal.
+
+    Deterministic given its three parts — re-minting the same (tenant, epoch,
+    ordinal) yields the same id, which is exactly how a crash-recovery gap
+    re-feed reproduces the lost batches' identities. The id is opaque to
+    consumers (:func:`ordinal_of` is the one sanctioned read-back, used when a
+    persisted id is re-fed on a host that never saw the original ingest).
+    """
+    return f"{tenant if tenant is not None else LOCAL_TENANT}-{epoch}-{int(ordinal)}"
+
+
+def ordinal_of(trace_id: str) -> int:
+    """The ingest ordinal a minted id carries (``-1`` on a foreign id)."""
+    try:
+        return int(trace_id.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return -1
+
+
+class LineageIndex:
+    """Bounded, thread-safe map of ``trace_id`` → per-batch lineage record.
+
+    One record per minted id, drop-oldest past ``max_traces`` (``evicted``
+    counts the loss — ``GET /trace/<id>`` surfaces it on a 404). Records are
+    plain dicts, safe to serialize.
+    """
+
+    def __init__(self, max_traces: int = DEFAULT_MAX_TRACES) -> None:
+        if max_traces < 1:
+            raise ValueError(f"Expected `max_traces` >= 1, got {max_traces}")
+        self._lock = threading.Lock()
+        self.max_traces = int(max_traces)
+        self.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+            self.evicted = 0
+            self.minted = 0
+            # per-tenant covering-checkpoint watermark: (bundle path, the
+            # processed-batch count the bundle covers) — the /trace join
+            self._checkpoints: Dict[str, Dict[str, Any]] = {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def open(
+        self,
+        trace_id: str,
+        tenant: Optional[str],
+        ordinal: int,
+        **fields: Any,
+    ) -> Dict[str, Any]:
+        """Register one batch's record (idempotent: a re-fed batch whose id is
+        already live — tail replay on the same process — updates in place)."""
+        with self._lock:
+            record = self._records.get(trace_id)
+            if record is None:
+                record = {
+                    "trace_id": trace_id,
+                    "tenant": tenant,
+                    "ordinal": int(ordinal),
+                    "ingest_unix": time.time(),
+                    "signature": None,
+                    "chunk_id": None,
+                    "path": None,
+                    "outcome": None,
+                    "dump": None,
+                    "alerts": [],
+                }
+                self._records[trace_id] = record
+                self.minted += 1
+                while len(self._records) > self.max_traces:
+                    self._records.popitem(last=False)
+                    self.evicted += 1
+            record.update(fields)
+            return record
+
+    def update(self, trace_id: str, **fields: Any) -> None:
+        """Amend a live record (no-op on an evicted/unknown id)."""
+        with self._lock:
+            record = self._records.get(trace_id)
+            if record is not None:
+                record.update(fields)
+
+    def note_dump(self, ids: List[str], path: Optional[str]) -> None:
+        """Attach the flight dump that named these batches to their records."""
+        if path is None:
+            return
+        with self._lock:
+            for trace_id in ids:
+                record = self._records.get(trace_id)
+                if record is not None:
+                    record["dump"] = path
+
+    def note_alert(self, ids: List[str], rules: List[str]) -> None:
+        """Attach newly-fired alert rules to the batches whose commit
+        triggered the evaluation (the victim-NaN → value-watchdog link)."""
+        with self._lock:
+            for trace_id in ids:
+                record = self._records.get(trace_id)
+                if record is not None:
+                    for rule in rules:
+                        if rule not in record["alerts"]:
+                            record["alerts"].append(rule)
+
+    def note_checkpoint(self, tenant: Optional[str], path: str, covered_batches: int) -> None:
+        """Record the newest bundle covering ``tenant``'s first
+        ``covered_batches`` processed batches (the /trace checkpoint join).
+
+        Callers must only note coverage on a **detour-free** stream (no sheds,
+        no defers): the join compares a batch's ARRIVAL ordinal against this
+        processed-batch watermark, and the two spaces only line up when every
+        arrival was processed in order. The continuous checkpointer enforces
+        this — a detoured session's batches simply report no covering bundle
+        (honest absence beats a wrong join).
+        """
+        key = tenant if tenant is not None else LOCAL_TENANT
+        with self._lock:
+            self._checkpoints[key] = {
+                "path": str(path),
+                "covered_batches": int(covered_batches),
+                "ts_unix": time.time(),
+            }
+
+    def covering_checkpoint(self, record: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """The bundle covering this batch, if one has been written past it."""
+        key = record.get("tenant") or LOCAL_TENANT
+        with self._lock:
+            row = self._checkpoints.get(key)
+            if row is None or record.get("ordinal", 0) >= row["covered_batches"]:
+                return None
+            return dict(row)
+
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            record = self._records.get(trace_id)
+            return dict(record) if record is not None else None
+
+    def ids(self, tenant: Optional[str] = None) -> List[str]:
+        """Live trace ids, oldest first (optionally one tenant's)."""
+        with self._lock:
+            if tenant is None:
+                return list(self._records)
+            return [
+                trace_id
+                for trace_id, record in self._records.items()
+                if record.get("tenant") == tenant
+            ]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "size": len(self._records),
+                "max_traces": self.max_traces,
+                "minted": self.minted,
+                "evicted": self.evicted,
+            }
+
+
+_INDEX = LineageIndex()
+
+
+def get_index() -> LineageIndex:
+    return _INDEX
+
+
+def is_enabled() -> bool:
+    return ENABLED
+
+
+def enable(max_traces: Optional[int] = None, reset: bool = True) -> LineageIndex:
+    """Turn batch lineage on; ``reset`` (default) clears the index."""
+    global ENABLED
+    if max_traces is not None:
+        if max_traces < 1:
+            raise ValueError(f"Expected `max_traces` >= 1, got {max_traces}")
+        _INDEX.max_traces = int(max_traces)
+    if reset:
+        _INDEX.clear()
+    ENABLED = True
+    return _INDEX
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def reset() -> None:
+    """Back to the pristine one-branch disabled path (test hygiene)."""
+    global ENABLED
+    ENABLED = False
+    _INDEX.clear()
+    _INDEX.max_traces = DEFAULT_MAX_TRACES
+
+
+def current_trace() -> Optional[str]:
+    """The ambient batch's trace id, or ``None`` outside any dispatch."""
+    return _TRACE.get()
+
+
+@contextmanager
+def trace(trace_id: Optional[str]) -> Iterator[Optional[str]]:
+    """Set the ambient trace id for the block (exemplars + span references).
+
+    ``None`` is accepted and is a no-op context, so call sites need no branch
+    of their own beyond the ``lineage.ENABLED`` guard.
+    """
+    if trace_id is None:
+        yield None
+        return
+    token = _TRACE.set(trace_id)
+    try:
+        yield trace_id
+    finally:
+        _TRACE.reset(token)
+
+
+def lookup(trace_id: str) -> Optional[Dict[str, Any]]:
+    """One batch's lineage record (a copy), or ``None``."""
+    return _INDEX.get(trace_id)
+
+
+def trace_ids(tenant: Optional[str] = None) -> List[str]:
+    return _INDEX.ids(tenant)
+
+
+def note_dump(ids: List[str], path: Optional[str]) -> None:
+    if ENABLED:
+        _INDEX.note_dump(ids, path)
+
+
+def note_alert(ids: List[str], rules: List[str]) -> None:
+    if ENABLED:
+        _INDEX.note_alert(ids, rules)
+
+
+def note_checkpoint(tenant: Optional[str], path: str, covered_batches: int) -> None:
+    if ENABLED:
+        _INDEX.note_checkpoint(tenant, path, covered_batches)
+
+
+def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
+    """Write ``lineage.*`` index-cardinality gauges into the recorder.
+
+    The bounded-index promise, measured: ``lineage.traces`` (live records),
+    ``lineage.evicted`` and ``lineage.minted`` (lifetime). Unlabeled totals —
+    an ambient tenant scope must not split them (the ``tenant=None`` opt-out).
+    """
+    import torchmetrics_tpu.obs.trace as _trace  # lazy: lineage stays cycle-free
+
+    rec = recorder if recorder is not None else _trace.get_recorder()
+    stats = _INDEX.stats()
+    rec.set_gauge("lineage.traces", float(stats["size"]), tenant=None)
+    rec.set_gauge("lineage.evicted", float(stats["evicted"]), tenant=None)
+    rec.set_gauge("lineage.minted", float(stats["minted"]), tenant=None)
+    return stats

@@ -12,6 +12,12 @@ so host spans load *next to* device traces:
   spans (``engine.*`` with a ``pipeline`` label) instead get their own named
   track per pipeline, so multiple streams' dispatch cadences read side by side;
 - instant events and warnings → ``"i"`` events;
+- batch lineage (:mod:`~torchmetrics_tpu.obs.lineage`) → **flow events**
+  (``"s"``/``"t"``/``"f"``, id = the batch's trace id): every span carrying a
+  ``trace_id``/``trace_ids`` attr anchors the batch's flow, so one batch's
+  ingest → dispatch → replay spans render as a visible arrow chain — across
+  hosts when an aggregate is exported, because flow ids are global while each
+  host keeps its own pid;
 - counters and gauges → ``"C"`` counter tracks;
 - **one pid per host**: a single-host export uses the local process index; a
   multi-host aggregate (``obs.aggregate.aggregate(include_events=True)``)
@@ -73,6 +79,13 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
     anchor0 = min(anchors) if anchors else 0.0
 
     events: List[Dict[str, Any]] = []
+    # batch-lineage flow points: every span referencing a trace id (the
+    # `trace_id`/`trace_ids` attrs obs/lineage.py threads through the engine)
+    # contributes one point; after all hosts are rendered, each trace id's
+    # points become a Chrome flow chain (s → t → f) binding that ONE batch's
+    # spans into a visible arrow — across hosts, because flow ids are global
+    # while pids are per host
+    flow_points: Dict[str, List[Dict[str, Any]]] = {}
     for snap in sorted(snaps, key=lambda s: s.get("host", {}).get("process_index", 0)):
         meta = snap.get("host", {})
         pid = int(meta.get("process_index", 0))
@@ -146,6 +159,15 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
             }
             if record["kind"] == "span":
                 events.append({**base, "ph": "X", "cat": "span", "dur": _us(record["dur"])})
+                attrs = record.get("attrs") or {}
+                ids = [attrs["trace_id"]] if attrs.get("trace_id") else []
+                for extra in str(attrs.get("trace_ids") or "").split(","):
+                    if extra and extra not in ids:
+                        ids.append(extra)
+                for trace_id in ids:
+                    flow_points.setdefault(trace_id, []).append(
+                        {"pid": base["pid"], "tid": base["tid"], "ts": base["ts"]}
+                    )
             elif record["kind"] == "warning":
                 events.append({**base, "ph": "i", "cat": "warning", "s": "p"})
             else:
@@ -182,6 +204,32 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
                 }
             )
 
+    # one flow chain per trace id with at least two anchoring spans: the
+    # first point starts the flow ("s"), intermediates step it ("t"), the
+    # last ends it ("f") — Perfetto draws the arrow chain through every
+    # anchored slice, stitching one batch's ingest → dispatch → replay story
+    # across threads AND hosts (flow ids are the trace ids themselves)
+    n_flows = 0
+    for trace_id, points in sorted(flow_points.items()):
+        if len(points) < 2:
+            continue
+        n_flows += 1
+        points.sort(key=lambda p: p["ts"])
+        for index, point in enumerate(points):
+            ph = "s" if index == 0 else ("f" if index == len(points) - 1 else "t")
+            flow = {
+                "ph": ph,
+                "cat": "lineage",
+                "name": "batch",
+                "id": trace_id,
+                "pid": point["pid"],
+                "tid": point["tid"],
+                "ts": point["ts"],
+            }
+            if ph == "f":
+                flow["bp"] = "e"  # bind the terminator to the enclosing slice
+            events.append(flow)
+
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
@@ -189,6 +237,7 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
             "generator": "torchmetrics_tpu.obs.perfetto",
             "schema_version": trace.SCHEMA_VERSION,
             "n_hosts": len(snaps),
+            "n_flows": n_flows,
         },
     }
 

@@ -198,6 +198,12 @@ def run_record(
         # predicted-vs-measured story accumulates across rounds, never judged
         # by check_regressions — same passthrough contract as memory/engine
         record["cost"] = cost
+    lineage = result.get("lineage")
+    if isinstance(lineage, dict):
+        # batch-lineage trace-index cardinality (size/minted/evicted): the
+        # bounded-index promise trends across rounds, recorded-never-judged —
+        # same passthrough contract as memory/engine/cost
+        record["lineage"] = lineage
     slo = result.get("slo")
     if isinstance(slo, dict):
         # chaos-bench SLO verdict. Unlike memory/engine/cost this is NOT a

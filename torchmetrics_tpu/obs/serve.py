@@ -60,9 +60,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         "--demo",
         action="store_true",
         help=(
-            "run two named tenants (tenant-a healthy, tenant-b fed one NaN batch) with"
-            " values+alerts enabled, so /tenants, ?tenant= filters and a firing"
-            " non_finite alert are demonstrable out of the box"
+            "run two named tenants (tenant-a healthy, tenant-b fed one NaN batch"
+            " through a lineage-enabled pipeline) with values+alerts enabled, so"
+            " /tenants, ?tenant= filters, a firing non_finite alert AND a"
+            " curl-able GET /trace/<id> lineage story are demonstrable out of"
+            " the box"
         ),
     )
     args = parser.parse_args(argv)
@@ -71,30 +73,43 @@ def main(argv: Optional[List[str]] = None) -> int:
         _trace.enable(reset=False)
 
     metrics = []
+    demo_trace_id = None
     if args.demo:
         try:
             import jax.numpy as jnp
 
             from torchmetrics_tpu.aggregation import MeanMetric
+            from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig
             from torchmetrics_tpu.obs import alerts as _alerts
+            from torchmetrics_tpu.obs import lineage as _lineage
             from torchmetrics_tpu.obs import scope as _scope
             from torchmetrics_tpu.obs import values as _values
             from torchmetrics_tpu.regression import MeanSquaredError
 
             _values.enable()
-            _alerts.configure(
+            _lineage.enable()
+            engine = _alerts.configure(
                 _alerts.AlertRule(name="non_finite", kind="non_finite", metric="*")
             )
             with _scope.scope("tenant-a"):
                 healthy = MeanMetric()
                 healthy.update(jnp.arange(8.0))
                 healthy.compute()
+            # tenant-b is a lineage-enabled pipeline SESSION: one clean batch,
+            # then one injected NaN. The NaN reaches the unguarded MSE state,
+            # the non_finite watchdog fires on the pipeline's commit, and the
+            # poisoned batch's trace id resolves at GET /trace/<id> with the
+            # alert linked — the whole lineage story, curl-able below.
+            poisoned = MeanSquaredError()
+            pipe = MetricPipeline(
+                poisoned,
+                PipelineConfig(fuse=1, tenant="tenant-b", alert_engine=engine),
+            )
+            pipe.feed(jnp.asarray([1.0, 0.5]), jnp.zeros(2))
+            pipe.feed(jnp.asarray([1.0, float("nan")]), jnp.zeros(2))
+            demo_trace_id = pipe.trace_id_for(1)  # the injected-NaN batch
+            pipe.close()
             with _scope.scope("tenant-b"):
-                # one injected NaN: tenant-b's MSE goes non-finite, the
-                # non_finite watchdog fires on the next /alerts or /healthz
-                # scrape, and /healthz names tenant-b as the offender
-                poisoned = MeanSquaredError()
-                poisoned.update(jnp.asarray([1.0, float("nan")]), jnp.zeros(2))
                 poisoned.compute()
             metrics.extend([healthy, poisoned])
         except Exception as err:  # demo is a convenience, never a hard failure
@@ -114,6 +129,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             f" {server.url}/alerts?tenant=tenant-b (non_finite fires there)",
             flush=True,
         )
+        if demo_trace_id is not None:
+            # the injected-NaN batch's full lineage story, ready to run: the
+            # record, its spans, the alert firing it triggered, 404-on-evicted
+            print(
+                f"batch lineage: curl -s {server.url}/trace/{demo_trace_id}"
+                " | python -m json.tool",
+                flush=True,
+            )
     try:
         if args.duration is not None:
             deadline = time.monotonic() + args.duration

@@ -30,6 +30,14 @@ dependencies, localhost by default:
   transition history, JSON. Scraping evaluates the rules (the Prometheus
   model); firing alerts also flip ``/healthz`` to degraded with the offending
   metric and rule named.
+- ``GET /trace/<id>`` — one batch's full lineage story
+  (:mod:`~torchmetrics_tpu.obs.lineage`): ingest stamp, signature, fusion
+  chunk, dispatch path, fault outcome, the spans/events referencing the id,
+  the flight dump that named it, the covering checkpoint bundle, and the
+  alert firings it triggered. 404 (with the bounded index's eviction stats)
+  on an unknown/evicted id.
+- ``GET /traces`` — the live trace-id index (``?tenant=`` filter;
+  ``?outliers=K`` seeds the K slowest batches from the histogram exemplars).
 - ``GET /tenants`` — the tenant registry (:mod:`~torchmetrics_tpu.obs.scope`):
   per-tenant liveness, series cardinality, state-memory bytes, estimated cost,
   firing alerts and — with an admission controller installed — quota/burn
@@ -74,6 +82,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import aggregate as _aggregate
@@ -97,10 +106,21 @@ __all__ = [
 ENV_PORT = "TM_TPU_OBS_PORT"
 DEFAULT_PORT = 9464  # the conventional OpenMetrics/collector exporter port
 
-ROUTES = ("/metrics", "/healthz", "/readyz", "/snapshot", "/memory", "/costs", "/alerts", "/tenants")
+ROUTES = (
+    "/metrics",
+    "/healthz",
+    "/readyz",
+    "/snapshot",
+    "/memory",
+    "/costs",
+    "/alerts",
+    "/tenants",
+    "/traces",
+    "/trace/<id>",
+)
 
 # routes that accept a ``?tenant=`` scoped view (unknown tenants 404)
-_TENANT_ROUTES = ("/metrics", "/alerts", "/memory", "/snapshot")
+_TENANT_ROUTES = ("/metrics", "/alerts", "/memory", "/snapshot", "/traces")
 
 
 def _parse_top(query: Dict[str, list], default: int = 20) -> int:
@@ -162,8 +182,13 @@ class _Handler(BaseHTTPRequestHandler):
         # telemetry label: unknown paths collapse to ONE bucket — request
         # recording is unconditional now, and a prober walking random URLs
         # must not mint a fresh series per path (the recorder's series cap
-        # would fill with garbage and then refuse legitimate new series)
-        route_label = route if (route == "/" or route in ROUTES) else "<unknown>"
+        # would fill with garbage and then refuse legitimate new series).
+        # /trace/<id> lookups likewise collapse to one "/trace" bucket: the id
+        # segment is unbounded-cardinality data, never a label
+        if route.startswith("/trace/"):
+            route_label = "/trace"
+        else:
+            route_label = route if (route == "/" or route in ROUTES) else "<unknown>"
         owner._rec_inc("server.requests", route=route_label)
         start = time.perf_counter()
         try:
@@ -181,8 +206,18 @@ class _Handler(BaseHTTPRequestHandler):
                     )
                     return
             if route == "/metrics":
-                self._send(200, owner.render_metrics(tenant=tenant).encode("utf-8"),
-                           "text/plain; version=0.0.4; charset=utf-8")
+                # content negotiation: the classic 0.0.4 page is the default
+                # (byte-stable, exemplar-free — a strict classic parser keeps
+                # passing); a scraper whose Accept header asks for OpenMetrics
+                # gets the exemplar-carrying flavor instead
+                openmetrics = "application/openmetrics-text" in self.headers.get("Accept", "")
+                body = owner.render_metrics(tenant=tenant, openmetrics=openmetrics)
+                content_type = (
+                    _export.OPENMETRICS_CONTENT_TYPE
+                    if openmetrics
+                    else _export.PROMETHEUS_CONTENT_TYPE
+                )
+                self._send(200, body.encode("utf-8"), content_type)
             elif route == "/healthz":
                 self._send_json(owner.health())
             elif route == "/readyz":
@@ -216,6 +251,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(owner.alerts_report(tenant=tenant))
             elif route == "/tenants":
                 self._send_json(owner.tenants_report())
+            elif route.startswith("/trace/"):
+                trace_id = parsed.path[len("/trace/") :].strip("/")
+                payload = owner.trace_report(trace_id)
+                self._send_json(payload, status=200 if payload.get("found") else 404)
+            elif route == "/traces":
+                try:
+                    outliers = query.get("outliers", [None])[0]
+                    outliers_k = int(outliers) if outliers is not None else None
+                    if outliers_k is not None and outliers_k <= 0:
+                        raise ValueError(f"outliers must be a positive integer, got {outliers_k}")
+                except ValueError as err:
+                    self._send_json({"error": str(err)}, status=400)
+                    return
+                self._send_json(owner.traces_report(tenant=tenant, outliers=outliers_k))
             elif route == "/":
                 self._send_json({"routes": list(ROUTES), "service": "torchmetrics_tpu.obs"})
             else:
@@ -536,9 +585,117 @@ class IntrospectionServer:
             "tenants": rows,
         }
 
+    # -------------------------------------------------------------------- lineage
+
+    def trace_report(self, trace_id: str) -> Dict[str, Any]:
+        """The ``GET /trace/<id>`` page: one batch's full story.
+
+        Joins the lineage index record (tenant, ingest ordinal + stamp,
+        signature, fusion chunk, dispatch path, fault outcome) with the spans
+        and events referencing the id in this recorder's ring, the flight dump
+        that named it, the newest checkpoint bundle covering it, and the alert
+        firings its commit triggered (explicitly linked rules plus any firing
+        transition of its tenant at/after its ingest stamp). ``found: False``
+        (the 404 shape) carries the bounded index's stats so an evicted id
+        reads as "the index is bounded and has evicted N records", not as a
+        silent miss.
+        """
+        record = _lineage.lookup(trace_id)
+        if record is None:
+            return {
+                "trace_id": trace_id,
+                "found": False,
+                "error": f"unknown trace id {trace_id!r} (evicted, or never minted here)",
+                "lineage": _lineage.get_index().stats(),
+            }
+        spans: List[Dict[str, Any]] = []
+        events: List[Dict[str, Any]] = []
+        for ev in self.recorder.events():
+            attrs = ev.get("attrs") or {}
+            referenced = attrs.get("trace_id") == trace_id or trace_id in str(
+                attrs.get("trace_ids") or ""
+            ).split(",")
+            if not referenced:
+                continue
+            (spans if ev.get("kind") == "span" else events).append(ev)
+        alerts: List[Dict[str, Any]] = []
+        engine = self.alert_engine()
+        if engine is not None:
+            try:
+                ingest = float(record.get("ingest_unix") or 0.0)
+                for row in engine.history():
+                    if row.get("to") != "firing":
+                        continue
+                    linked = row.get("rule") in (record.get("alerts") or []) or (
+                        record.get("tenant") is not None
+                        and row.get("tenant") == record.get("tenant")
+                        # the matching slack the SLO judge uses: the watchdog
+                        # can catch the batch within the same commit instant
+                        and float(row.get("at") or 0.0) >= ingest - 0.005
+                    )
+                    if linked:
+                        alerts.append(row)
+            except Exception:  # the alert join must never break the page
+                self._rec_inc("server.errors", route="/trace(alerts)")
+        return {
+            "trace_id": trace_id,
+            "found": True,
+            "record": record,
+            "spans": spans,
+            "events": events,
+            "flight_dump": record.get("dump"),
+            "checkpoint": _lineage.get_index().covering_checkpoint(record),
+            "alerts": alerts,
+        }
+
+    def traces_report(
+        self, tenant: Optional[str] = None, outliers: Optional[int] = None
+    ) -> Dict[str, Any]:
+        """The ``GET /traces`` page: the live trace-id index.
+
+        ``?tenant=`` filters to one tenant's batches; ``?outliers=K`` seeds
+        the listing from the histogram **exemplars** instead — the K slowest
+        exemplar'd observations across every duration histogram, each carrying
+        the trace id to feed straight into ``GET /trace/<id>``.
+        """
+        index = _lineage.get_index()
+        payload: Dict[str, Any] = {
+            "enabled": _lineage.ENABLED,
+            **index.stats(),
+        }
+        if tenant is not None:
+            payload["tenant_filter"] = tenant
+        if outliers is not None:
+            # one row per trace id (its slowest exemplar'd observation): the
+            # same batch anchors exemplars in several histograms (ingest,
+            # dispatch, nested metric spans) and must not fill the top-K with
+            # itself
+            best: Dict[str, Dict[str, Any]] = {}
+            for hist in self.recorder.histograms():
+                for bucket_rows in (hist.get("exemplars") or {}).values():
+                    for trace_id, value, wall in bucket_rows:
+                        if tenant is not None:
+                            record = index.get(trace_id)
+                            if record is None or record.get("tenant") != tenant:
+                                continue
+                        seen = best.get(trace_id)
+                        if seen is None or value > seen["seconds"]:
+                            best[trace_id] = {
+                                "trace_id": trace_id,
+                                "seconds": value,
+                                "wall_unix": wall,
+                                "histogram": hist["name"],
+                                "labels": hist["labels"],
+                            }
+            rows = sorted(best.values(), key=lambda row: -row["seconds"])
+            payload["outliers"] = rows[:outliers]
+        else:
+            payload["trace_ids"] = index.ids(tenant)
+        return payload
+
     # ------------------------------------------------------------------- payloads
 
-    def render_metrics(self, tenant: Optional[str] = None) -> str:
+    def render_metrics(self, tenant: Optional[str] = None, openmetrics: bool = False) -> str:
         """The /metrics page: refresh memory gauges, then Prometheus text.
 
         Memory gauges are recorded against the *registered* objects (a
@@ -564,6 +721,12 @@ class IntrospectionServer:
                 _scope.record_gauges(recorder=self.recorder)
             except Exception:
                 self._rec_inc("server.errors", route="/metrics(tenants)")
+        if _lineage.ENABLED:
+            try:
+                # trace-index cardinality gauges (lineage.* families)
+                _lineage.record_gauges(recorder=self.recorder)
+            except Exception:
+                self._rec_inc("server.errors", route="/metrics(lineage)")
         engine = self._evaluated_engine("/metrics")
         if engine is not None:
             try:
@@ -573,7 +736,8 @@ class IntrospectionServer:
             except Exception:
                 self._rec_inc("server.errors", route="/metrics(alerts)")
         robust_leaves = [metric for _, metric in self._flat_metrics()]
-        return _export.prometheus_text(metrics=robust_leaves, recorder=self.recorder, tenant=tenant)
+        render = _export.openmetrics_text if openmetrics else _export.prometheus_text
+        return render(metrics=robust_leaves, recorder=self.recorder, tenant=tenant)
 
     def _flat_metrics(self) -> List[Tuple[str, Any]]:
         """Registered metrics recursively flattened into (path, metric) pairs.

@@ -41,6 +41,12 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+# batch lineage (pure stdlib): with lineage enabled, the ambient batch trace
+# id (obs/lineage.py contextvar) rides duration observations as bounded
+# per-bucket histogram EXEMPLARS — never as labels, so an unbounded id stream
+# can never mint series; never-enabled cost is one branch per observation
+import torchmetrics_tpu.obs.lineage as _lineage
+
 # tenant/session attribution (pure stdlib, no package-internal imports): every
 # recorder write passes its labels through scope.tag so an ambient
 # `scope(tenant=...)` context stamps counters/gauges/histograms/spans/events
@@ -119,32 +125,60 @@ def _labels_key(labels: Dict[str, Any]) -> LabelsKey:
 
 
 class _Histogram:
-    """Fixed log-scale duration histogram (seconds), Prometheus-compatible."""
+    """Fixed log-scale duration histogram (seconds), Prometheus-compatible.
+
+    With batch lineage enabled (:mod:`~torchmetrics_tpu.obs.lineage`) each
+    bucket additionally keeps the last :data:`EXEMPLAR_K` ``(trace_id, value,
+    wall)`` **exemplars** — the OpenMetrics join from a latency bucket back to
+    the concrete batch that landed in it. Exemplars are bounded per bucket,
+    attach only to already-existing series (they can never mint a new label
+    set), and cost nothing while lineage is off (the dict stays ``None``).
+    """
 
     # non-cumulative per-bucket upper bounds; export computes cumulative counts
     BOUNDS: Tuple[float, ...] = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, float("inf"))
 
-    __slots__ = ("counts", "sum", "count")
+    # exemplars kept per bucket (last-K wins: the freshest evidence is the
+    # most actionable, and K bounds the memory per series)
+    EXEMPLAR_K: int = 2
+
+    __slots__ = ("counts", "sum", "count", "exemplars")
 
     def __init__(self) -> None:
         self.counts = [0] * len(self.BOUNDS)
         self.sum = 0.0
         self.count = 0
+        self.exemplars: Optional[Dict[int, deque]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         for i, bound in enumerate(self.BOUNDS):
             if value <= bound:
                 self.counts[i] += 1
+                if trace_id is not None:
+                    if self.exemplars is None:
+                        self.exemplars = {}
+                    ring = self.exemplars.get(i)
+                    if ring is None:
+                        ring = self.exemplars[i] = deque(maxlen=self.EXEMPLAR_K)
+                    ring.append((trace_id, value, time.time()))
                 break
         self.sum += value
         self.count += 1
 
     def snapshot(self) -> Dict[str, Any]:
-        return {
+        snap = {
             "buckets": [[bound, count] for bound, count in zip(self.BOUNDS, self.counts)],
             "sum": self.sum,
             "count": self.count,
         }
+        if self.exemplars:
+            # additive key (absent without lineage): bucket index -> rows, so
+            # pre-lineage consumers of the snapshot shape keep parsing
+            snap["exemplars"] = {
+                str(i): [[tid, val, wall] for tid, val, wall in ring]
+                for i, ring in sorted(self.exemplars.items())
+            }
+        return snap
 
 
 class TraceRecorder:
@@ -237,14 +271,24 @@ class TraceRecorder:
                     "attrs": attrs,
                 }
             )
-            labels = {k: v for k, v in attrs.items() if isinstance(v, str)}
+            # trace ids are event-only data: an unbounded id stream must never
+            # become a histogram label (series explosion) — they ride the span
+            # attrs for /trace and Perfetto flows, and the histogram as a
+            # bounded exemplar instead
+            labels = {
+                k: v
+                for k, v in attrs.items()
+                if isinstance(v, str) and not k.startswith("trace_id")
+            }
             key = (name, _labels_key(labels))
             if not self._series_slot(self._hists, key):
                 return
             hist = self._hists.get(key)
             if hist is None:
                 hist = self._hists[key] = _Histogram()
-            hist.observe(duration)
+            hist.observe(
+                duration, _lineage.current_trace() if _lineage.ENABLED else None
+            )
 
     def inc(self, name: str, value: float = 1.0, **labels: Any) -> None:
         key = (name, _labels_key(_scope.tag(labels)))
@@ -266,7 +310,9 @@ class TraceRecorder:
             hist = self._hists.get(key)
             if hist is None:
                 hist = self._hists[key] = _Histogram()
-            hist.observe(seconds)
+            hist.observe(
+                seconds, _lineage.current_trace() if _lineage.ENABLED else None
+            )
 
     # dedup tracks at most this many distinct warning messages: warnings with
     # per-occurrence dynamic text (embedded errors, attempt counts) would
