@@ -1069,7 +1069,7 @@ def _chaos_main(argv) -> None:
     parser.add_argument("--chaos-seed", type=int, default=0)
     parser.add_argument(
         "--chaos-scenario",
-        choices=("default", "high_tenant", "rolling_deploy", "host_crash"),
+        choices=("default", "high_tenant", "rolling_deploy", "host_crash", "hung_host"),
         default="default",
         help="high_tenant: >=64 tenants with shared signatures and bursty arrivals,"
              " replayed through the cross-tenant multiplexer and judged against the"
@@ -1085,7 +1085,14 @@ def _chaos_main(argv) -> None:
              " newest intact bundle with the replay gap re-fed from the"
              " deterministic schedule, judged against the host-crash SLO spec"
              " incl. gap<=cadence, bit-identity vs unkilled controls and"
-             " delta-vs-full bundle bytes (configs prefixed chaos_hc_*)",
+             " delta-vs-full bundle bytes (configs prefixed chaos_hc_*)."
+             " hung_host: one 'host' WEDGES mid-traffic (alive but silent: no"
+             " drain, no close, no lease release); the scrape-driven lease"
+             " watchdog fences its tenant sessions and fails them over to the"
+             " survivor under a new epoch, judged against the hung-host SLO"
+             " spec incl. time-to-detect/time-to-failover budgets, zombie"
+             " bundle-write rejection and bit-identity vs never-hung controls"
+             " (configs prefixed chaos_hh_*)",
     )
     parser.add_argument(
         "--chaos-schedule", default=None,
@@ -1159,6 +1166,14 @@ def _chaos_main(argv) -> None:
         # continuous periodic bundle and re-feeds the bounded replay gap
         result = chaos.replay(sched, chaos.ReplayConfig(host_crash=True))
         report = chaos.judge(result, chaos.host_crash_slo_spec(), prefix="chaos_hc")
+    elif args.chaos_scenario == "hung_host":
+        # the fencing scenario: host B wedges (hung, not dead) mid-traffic;
+        # the lease watchdog — ticked by the /metrics scrape loop — detects
+        # the stale lease, fences the zombie epoch and restores the tenants
+        # elsewhere under a new epoch; the zombie's late bundle write must
+        # land fenced-out and be discarded by the next recovery scan
+        result = chaos.replay(sched, chaos.ReplayConfig(hung_host=True))
+        report = chaos.judge(result, chaos.hung_host_slo_spec(), prefix="chaos_hh")
     else:
         result = chaos.replay(sched)
         report = chaos.judge(result)
@@ -1195,6 +1210,8 @@ def _chaos_main(argv) -> None:
             "migration": result.get("migration"),
             # crash-recovery accounting (None unless host_crash)
             "crash": result.get("crash"),
+            # hung-host fencing accounting (None unless hung_host)
+            "fence": result.get("fence"),
             # batch-lineage causality rows (trace id → dump/alert links)
             "lineage_poisoned": (result.get("lineage") or {}).get("poisoned"),
         },

@@ -966,3 +966,234 @@ class TestHostCrashEndToEnd:
         per_tenant = {ev["tenant"]: ev["index"] + 1 for ev in sched.batches()}
         for tenant in result["crash"]["tenants"]:
             assert result["pipelines"][tenant]["batches"] == per_tenant[tenant]
+
+
+class TestHungHostJudge:
+    """The fencing SLO rows over fabricated results (fast, no replay)."""
+
+    def _fence_result(self, **overrides):
+        fence = {
+            "tenants": ["tenant-02", "tenant-03"],
+            "lease_seconds": 0.25,
+            "time_to_detect_seconds": 0.3,
+            "time_to_failover_seconds": 0.05,
+            "sessions": {
+                "tenant-02": {"fed_at_wedge": 6, "restored_cursor": 4,
+                              "refed_batches": 4, "fenced_epoch": "aaa",
+                              "new_epoch": "bbb", "bundle": "bundle-000000",
+                              "detect_seconds": 0.3, "failover_seconds": 0.05},
+                "tenant-03": {"fed_at_wedge": 6, "restored_cursor": 4,
+                              "refed_batches": 4, "fenced_epoch": "ccc",
+                              "new_epoch": "ddd", "bundle": "bundle-000000",
+                              "detect_seconds": 0.2, "failover_seconds": 0.04},
+            },
+            "zombie": {"tenant": "tenant-02", "bundle": "bundle-000001",
+                       "landed": True, "rejected_count": 1,
+                       "selected": "bundle-000000", "discarded": True},
+            "controls": {
+                "tenant-02": {"dtype": "float32", "items": 256, "bit_identical": True},
+                "tenant-03": {"dtype": "float32", "items": 232, "bit_identical": True},
+            },
+            "zero_double_count": True,
+            "healthz_named_fenced": True,
+            "leases_page_fences": 2,
+        }
+        fence.update(overrides)
+        return _fake_result(fence=fence)
+
+    def _spec(self):
+        return chaos_slo.hung_host_slo_spec()
+
+    def test_spec_shape(self):
+        spec = self._spec()
+        assert spec.max_time_to_detect_seconds is not None
+        assert spec.max_time_to_failover_seconds is not None
+        assert spec.require_zombie_writes_rejected
+        assert spec.require_fence_zero_double_count
+        assert spec.require_fence_visible
+        assert spec.require_poisoned_named  # ordinary chaos SLOs keep holding
+
+    def test_passing_fence(self):
+        report = chaos_slo.judge(self._fence_result(), self._spec(), prefix="chaos_hh")
+        assert report["passed"], chaos_slo.format_report(report)
+        assert report["configs"]["chaos_hh_slo_pass"]["value"] == 1.0
+        assert report["configs"]["chaos_hh_time_to_detect_seconds"]["value"] == 0.3
+        assert report["configs"]["chaos_hh_time_to_failover_seconds"]["value"] == 0.05
+        assert report["configs"]["chaos_hh_failed_over_tenants"]["value"] == 2.0
+        # wall budgets are scheduler-jitter-dominated: the recorded spreads
+        # make the ABSOLUTE budget the regression sentinel's cap
+        spread = report["configs"]["chaos_hh_time_to_detect_seconds"]["spread"]
+        assert spread["max"] == self._spec().max_time_to_detect_seconds
+
+    def test_slow_detection_fails_budget(self):
+        report = chaos_slo.judge(
+            self._fence_result(time_to_detect_seconds=99.0), self._spec(), prefix="chaos_hh"
+        )
+        assert "time_to_detect_seconds" in report["failed"]
+
+    def test_slow_failover_fails_budget(self):
+        report = chaos_slo.judge(
+            self._fence_result(time_to_failover_seconds=99.0), self._spec(), prefix="chaos_hh"
+        )
+        assert "time_to_failover_seconds" in report["failed"]
+
+    def test_zombie_bundle_selected_fails(self):
+        # the zombie's post-fence write got chosen as a restore point
+        report = chaos_slo.judge(
+            self._fence_result(
+                zombie={"tenant": "tenant-02", "bundle": "bundle-000001",
+                        "landed": True, "rejected_count": 0,
+                        "selected": "bundle-000001", "discarded": False}
+            ),
+            self._spec(),
+            prefix="chaos_hh",
+        )
+        assert "zombie_writes_rejected" in report["failed"]
+
+    def test_zombie_write_never_landed_fails(self):
+        # the fence must reject writes AFTER they land, not block the landing:
+        # a zombie that could not even write proves nothing about rejection
+        report = chaos_slo.judge(
+            self._fence_result(
+                zombie={"tenant": "tenant-02", "bundle": None, "landed": False,
+                        "rejected_count": 0, "selected": "bundle-000000",
+                        "discarded": False}
+            ),
+            self._spec(),
+            prefix="chaos_hh",
+        )
+        assert "zombie_writes_rejected" in report["failed"]
+
+    def test_diverged_control_fails_double_count(self):
+        result = self._fence_result(
+            controls={
+                "tenant-02": {"dtype": "float32", "items": 256, "bit_identical": True},
+                "tenant-03": {"dtype": "float32", "items": 200, "bit_identical": False},
+            },
+            zero_double_count=False,
+        )
+        report = chaos_slo.judge(result, self._spec(), prefix="chaos_hh")
+        assert "fence_zero_double_count" in report["failed"]
+        row = next(r for r in report["slos"] if r["slo"] == "fence_zero_double_count")
+        assert "tenant-03" in row["detail"]
+
+    def test_no_fence_at_all_fails(self):
+        report = chaos_slo.judge(_fake_result(), self._spec(), prefix="chaos_hh")
+        assert "fence_zero_double_count" in report["failed"]
+        assert "time_to_detect_seconds" in report["failed"]
+        assert "zombie_writes_rejected" in report["failed"]
+        assert "fence_visible_degraded" in report["failed"]
+
+    def test_invisible_fence_fails(self):
+        report = chaos_slo.judge(
+            self._fence_result(healthz_named_fenced=False), self._spec(), prefix="chaos_hh"
+        )
+        assert "fence_visible_degraded" in report["failed"]
+        report = chaos_slo.judge(
+            self._fence_result(leases_page_fences=0), self._spec(), prefix="chaos_hh"
+        )
+        assert "fence_visible_degraded" in report["failed"]
+
+    def test_default_spec_ignores_fence_section(self):
+        report = chaos_slo.judge(self._fence_result())
+        fence_rows = ("time_to_detect_seconds", "time_to_failover_seconds",
+                      "zombie_writes_rejected", "fence_zero_double_count",
+                      "fence_visible_degraded")
+        assert not any(r["slo"] in fence_rows for r in report["slos"])
+
+    def test_hung_host_config_validation(self):
+        with pytest.raises(ValueError, match="hung_host"):
+            ReplayConfig(hung_host=True, multiplex=True)
+        with pytest.raises(ValueError, match="hung_host"):
+            ReplayConfig(hung_host=True, rolling_deploy=True)
+        with pytest.raises(ValueError, match="hung_host"):
+            ReplayConfig(hung_host=True, host_crash=True)
+        with pytest.raises(ValueError, match="lease_seconds"):
+            ReplayConfig(hung_host=True, lease_seconds=0.0)
+
+
+class TestHungHostEndToEnd:
+    @pytest.fixture(scope="class")
+    def run(self):
+        """One real hung host: host B wedges mid-traffic (alive but silent —
+        no drain, no close, no lease release); the scrape-driven watchdog
+        fences its epoch and fails its tenants over, chaos continuing
+        throughout; the zombie then writes a post-fence bundle that must be
+        rejected by the next recovery scan."""
+        sched = chaos_schedule.generate(
+            ScheduleConfig(
+                seed=0,
+                tenants=8,
+                warm_batches=2,
+                churn_batches=2,
+                drain_batches=3,
+                hang_seconds=0.5,
+                absent_after_seconds=0.15,
+                idle_gap_seconds=0.01,
+            )
+        )
+        result = replay(sched, ReplayConfig(hung_host=True))
+        report = chaos_slo.judge(result, chaos_slo.hung_host_slo_spec(), prefix="chaos_hh")
+        return sched, result, report
+
+    def test_hung_host_passes_all_slos(self, run):
+        _, _, report = run
+        assert report["passed"], chaos_slo.format_report(report)
+
+    def test_failed_over_sessions_bit_identical_to_controls(self, run):
+        _, result, _ = run
+        fence = result["fence"]
+        assert fence["zero_double_count"] is True
+        assert len(fence["tenants"]) >= 1
+        for tenant, row in fence["controls"].items():
+            assert row["bit_identical"], (tenant, row)
+
+    def test_failover_under_new_epoch(self, run):
+        _, result, _ = run
+        for tenant, session in result["fence"]["sessions"].items():
+            assert session["new_epoch"] != session["fenced_epoch"], (tenant, session)
+            # the restore point really is BEHIND the wedge (the zombie's open
+            # chunk was never drained) and the gap was re-fed
+            assert session["restored_cursor"] <= session["fed_at_wedge"]
+            assert session["refed_batches"] >= 1
+
+    def test_zombie_bundle_landed_then_rejected(self, run):
+        _, result, _ = run
+        zombie = result["fence"]["zombie"]
+        # the write LANDS (fencing rejects at recovery, it does not block
+        # the filesystem) — and the next scan counts it out, never selects it
+        assert zombie["landed"], zombie
+        assert zombie["rejected_count"] >= 1, zombie
+        assert zombie["selected"] != zombie["bundle"], zombie
+        assert zombie["discarded"], zombie
+
+    def test_detection_is_lease_bounded(self, run):
+        _, result, _ = run
+        fence = result["fence"]
+        # detection cannot beat the lease TTL (the lease was valid until
+        # then) and must not blow the generous scrape-cadence budget
+        assert fence["time_to_detect_seconds"] >= fence["lease_seconds"] * 0.5
+        assert fence["time_to_detect_seconds"] <= 15.0
+
+    def test_fence_visible_on_obs_routes(self, run):
+        _, result, _ = run
+        fence = result["fence"]
+        assert fence["healthz_named_fenced"] is True
+        assert fence["leases_page_fences"] >= len(fence["tenants"])
+
+    def test_fault_surfaces_survive_the_fence(self, run):
+        sched, result, report = run
+        for fault in ("poison", "hang"):
+            assert report["configs"][f"chaos_hh_time_to_fire_{fault}"]["value"] >= 0.0
+            assert report["configs"][f"chaos_hh_time_to_resolve_{fault}"]["value"] >= 0.0
+        assert set(fenced := result["fence"]["tenants"]).isdisjoint(
+            {sched.victim, sched.hung}
+        ), fenced
+
+    def test_failed_over_tenants_keep_serving(self, run):
+        sched, result, _ = run
+        # every fenced tenant's successor pipeline covers its FULL schedule
+        # traffic: restored cursor + gap re-feed + post-wedge stream
+        per_tenant = {ev["tenant"]: ev["index"] + 1 for ev in sched.batches()}
+        for tenant in result["fence"]["tenants"]:
+            assert result["pipelines"][tenant]["batches"] == per_tenant[tenant]

@@ -1,0 +1,849 @@
+"""Hung-host fencing battery (marker: ``engine``).
+
+Covers the lease/fence/failover plane end to end, single-process:
+
+- **leases** (``robust/fence.py`` + ``engine/pipeline.py``): minted per
+  session epoch, renewed on feed (throttled) and force-renewed on every
+  bundle write, released on close — and visible in the scope registry the
+  whole time.
+- **the fence ledger** (``engine/migrate.py``): ``FENCED.json`` written
+  atomically next to the bundles, idempotent per epoch, snapshotting the
+  bundle names present at fence time (``known``) — those stay restorable,
+  anything the zombie writes later is rejected by every recovery-path
+  verify, counted, and never selected.
+- **failover** (:func:`torchmetrics_tpu.robust.fence.failover` + the
+  :class:`~torchmetrics_tpu.robust.fence.Watchdog`): fence FIRST, then
+  select, then restore under a FRESH epoch; detection = expired lease
+  (+ optionally stale bundle stream), never a fenced or released one.
+- **schema back-compat** (the SESSION_SCHEMA 2→3 bump): unleased schema-2
+  bundles restore cleanly with a lease minted on restore; a tampered lease
+  block fails ``verify_bundle``.
+- **satellites**: the ``TM_TPU_SYNC_TIMEOUT``/``TM_TPU_SYNC_RETRIES``
+  environment defaults (explicit config wins, bad values warn once), the
+  tenant label on the guard's degradation counters (two tenants, one hung),
+  the ``checkpoint.torn_bundles`` gauge, a strict Prometheus parse of every
+  new family, and the ``/leases`` + ``/healthz`` + ``/trace`` surfaces.
+
+CPU-only and fast: sub-second lease TTLs with injected clocks wherever the
+API takes ``now=``; real sleeps only where lease expiry itself is the thing
+under test (tens of milliseconds).
+"""
+
+import json
+import os
+import re
+import time
+import urllib.request
+import warnings
+from unittest import mock
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import CatMetric, MeanMetric
+from torchmetrics_tpu.engine import (
+    CheckpointPolicy,
+    MetricPipeline,
+    PipelineConfig,
+    latest_valid_bundle,
+    restore_session,
+    verify_bundle,
+)
+from torchmetrics_tpu.engine import migrate as migrate_mod
+from torchmetrics_tpu.engine.migrate import FencedBundleError, SessionBundleError
+from torchmetrics_tpu.obs import export as obs_export
+from torchmetrics_tpu.obs import lineage as obs_lineage
+from torchmetrics_tpu.obs import scope as obs_scope
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.obs import trace
+from torchmetrics_tpu.obs import values as obs_values
+from torchmetrics_tpu.robust import degraded, faults
+from torchmetrics_tpu.robust import fence as fence_mod
+from torchmetrics_tpu.robust.degraded import sync_guard
+
+pytestmark = pytest.mark.engine
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_values.disable()
+    obs_values.get_log().clear()
+    obs_scope.reset()
+    fence_mod.install_watchdog(None)
+    yield
+    fence_mod.install_watchdog(None)
+    obs_server.stop()
+    trace.disable()
+    trace.get_recorder().clear()
+    obs_values.disable()
+    obs_values.get_log().clear()
+    obs_scope.reset()
+
+
+def _feed(pipe, n, seed=0, size=6):
+    rng = np.random.RandomState(seed)
+    for _ in range(n):
+        pipe.feed(jnp.asarray(rng.rand(size).astype(np.float32)))
+
+
+def _cat_session(tmp_path, tenant, every_batches=1, lease_seconds=30.0):
+    policy = CheckpointPolicy(
+        directory=os.path.join(str(tmp_path), tenant),
+        every_batches=every_batches,
+        full_every=4,
+        keep=16,
+        segment_bytes=4096,
+    )
+    return MetricPipeline(
+        CatMetric(capacity=1 << 12, nan_strategy="disable"),
+        PipelineConfig(
+            fuse=1, tenant=tenant, checkpoint=policy, lease_seconds=lease_seconds
+        ),
+    )
+
+
+# -------------------------------------------------------------------- leases
+
+
+class TestLeaseLifecycle:
+    def test_mint_registers_with_scope(self):
+        lease = fence_mod.mint_lease("t-a", epoch="ep1", ttl_seconds=30.0, now=1000.0)
+        assert lease["epoch"] == "ep1"
+        assert lease["expires_unix"] == 1030.0
+        row = obs_scope.lease_status()["t-a"]
+        assert row["holder"] == lease["holder"]
+        assert row["epoch"] == "ep1"
+        assert not row.get("released")
+
+    def test_mint_rejects_nonpositive_ttl(self):
+        with pytest.raises(ValueError, match="ttl_seconds"):
+            fence_mod.mint_lease("t-a", epoch="ep1", ttl_seconds=0.0)
+
+    def test_renew_extends_expiry(self):
+        lease = fence_mod.mint_lease("t-a", epoch="ep1", ttl_seconds=30.0, now=1000.0)
+        fence_mod.renew_lease(lease, "t-a", now=1020.0)
+        assert lease["expires_unix"] == 1050.0
+        assert obs_scope.lease_status()["t-a"]["expires_unix"] == 1050.0
+
+    def test_expiry_with_grace(self):
+        lease = fence_mod.mint_lease("t-a", epoch="ep1", ttl_seconds=10.0, now=1000.0)
+        assert not fence_mod.lease_expired(lease, now=1009.0)
+        assert fence_mod.lease_expired(lease, now=1011.0)
+        assert not fence_mod.lease_expired(lease, now=1011.0, grace=5.0)
+        assert fence_mod.lease_expired(lease, now=1016.0, grace=5.0)
+        assert not fence_mod.lease_expired(None, now=1e12)
+
+    def test_stale_leases_skip_released_and_fenced(self):
+        fence_mod.mint_lease("t-exp", epoch="ep1", ttl_seconds=0.001, now=1000.0)
+        fence_mod.mint_lease("t-rel", epoch="ep2", ttl_seconds=0.001, now=1000.0)
+        fence_mod.mint_lease("t-fen", epoch="ep3", ttl_seconds=0.001, now=1000.0)
+        fence_mod.mint_lease("t-live", epoch="ep4", ttl_seconds=1e6, now=1000.0)
+        obs_scope.note_lease_released("t-rel")
+        obs_scope.note_fence("ep3", tenant="t-fen")
+        stale = fence_mod.stale_leases(now=2000.0)
+        assert set(stale) == {"t-exp"}
+
+    def test_pipeline_mints_and_releases(self, tmp_path):
+        pipe = _cat_session(tmp_path, "lease-t")
+        row = obs_scope.lease_status()["lease-t"]
+        assert row["epoch"] == pipe.lineage_epoch
+        assert not fence_mod.lease_expired(row, now=time.time())
+        pipe.close()
+        assert obs_scope.lease_status()["lease-t"].get("released")
+        # a cleanly released lease is NOT a hung host
+        assert "lease-t" not in fence_mod.stale_leases(now=time.time() + 1e6)
+
+    def test_bundle_write_is_a_lease_renewal(self, tmp_path):
+        pipe = _cat_session(tmp_path, "renew-t", lease_seconds=30.0)
+        before = obs_scope.lease_status()["renew-t"]["renewed_unix"]
+        time.sleep(0.02)
+        _feed(pipe, 1)
+        path = pipe.checkpoint_now()
+        try:
+            after = obs_scope.lease_status()["renew-t"]["renewed_unix"]
+            assert after > before  # forced, not TTL/4-throttled
+            manifest = verify_bundle(path)
+            stamp = manifest["lease"]
+            assert stamp["epoch"] == pipe.lineage_epoch
+            assert stamp["holder"] == fence_mod.holder_id()
+            assert stamp["renewed_unix"] == pytest.approx(after)
+        finally:
+            pipe.close()
+
+    def test_scan_bundle_lease_reads_newest_stamp(self, tmp_path):
+        pipe = _cat_session(tmp_path, "scan-t")
+        _feed(pipe, 2)
+        pipe.checkpoint_now()
+        directory = pipe.config.checkpoint.directory
+        pipe.close()
+        lease = fence_mod.scan_bundle_lease(directory)
+        assert lease is not None and lease["epoch"] == pipe.lineage_epoch
+        assert fence_mod.scan_bundle_lease(str(tmp_path / "nowhere")) is None
+
+
+class TestEpochOf:
+    def test_round_trip(self):
+        assert obs_lineage.epoch_of("tenant-03-abc123-17") == "abc123"
+        assert obs_lineage.epoch_of("__local__-deadbeef-0") == "deadbeef"
+
+    def test_tenant_names_with_dashes(self):
+        # rsplit: only the LAST two dashes delimit epoch and ordinal
+        assert obs_lineage.epoch_of("team-a-shard-9-ep42-3") == "ep42"
+
+    def test_malformed_ids(self):
+        assert obs_lineage.epoch_of("no-ordinal-here") is None
+        assert obs_lineage.epoch_of("short-1") is None
+        assert obs_lineage.epoch_of("t--3") is None  # empty epoch
+        assert obs_lineage.epoch_of("") is None
+
+
+# -------------------------------------------------------------- fence ledger
+
+
+class TestFenceLedger:
+    def test_fence_epoch_writes_durable_record(self, tmp_path):
+        directory = str(tmp_path / "bundles")
+        os.makedirs(os.path.join(directory, "bundle-000000"))
+        record = fence_mod_record = migrate_mod.fence_epoch(
+            directory, "ep-z", tenant="t-a", holder="host-b", by="host-a", target="host-a"
+        )
+        assert record["known"] == ["bundle-000000"]
+        with open(os.path.join(directory, "FENCED.json"), encoding="utf-8") as fh:
+            payload = json.load(fh)
+        assert payload["version"] == 1
+        assert payload["fences"]["ep-z"]["holder"] == "host-b"
+        assert payload["fences"]["ep-z"] == fence_mod_record
+        # mirrored into the scope registry for /healthz and /trace
+        assert obs_scope.is_fenced("ep-z")
+        assert obs_scope.fence_status()["ep-z"]["target"] == "host-a"
+
+    def test_fence_epoch_idempotent_first_known_wins(self, tmp_path):
+        directory = str(tmp_path / "bundles")
+        os.makedirs(os.path.join(directory, "bundle-000000"))
+        first = migrate_mod.fence_epoch(directory, "ep-z", tenant="t-a")
+        os.makedirs(os.path.join(directory, "bundle-000001"))
+        again = migrate_mod.fence_epoch(directory, "ep-z", tenant="t-a")
+        assert again["known"] == first["known"] == ["bundle-000000"]
+
+    def test_known_snapshot_skips_temp_dirs(self, tmp_path):
+        directory = str(tmp_path / "bundles")
+        os.makedirs(os.path.join(directory, "bundle-000000"))
+        os.makedirs(os.path.join(directory, "bundle-000001.tmp.123.abc"))
+        record = migrate_mod.fence_epoch(directory, "ep-z")
+        assert record["known"] == ["bundle-000000"]
+
+    def test_missing_or_corrupt_ledger_reads_empty(self, tmp_path):
+        directory = str(tmp_path / "bundles")
+        assert migrate_mod.fenced_epochs(directory) == {}
+        os.makedirs(directory)
+        with open(os.path.join(directory, "FENCED.json"), "w", encoding="utf-8") as fh:
+            fh.write("{not json")
+        # fencing must never make an intact, unfenced stream unrestorable
+        assert migrate_mod.fenced_epochs(directory) == {}
+
+
+class TestZombieRejection:
+    def _fenced_stream(self, tmp_path):
+        """One session: pre-fence bundle, fence, then a post-fence zombie write."""
+        pipe = _cat_session(tmp_path, "zomb-t")
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pre = pipe.checkpoint_now()
+        migrate_mod.fence_epoch(
+            directory, pipe.lineage_epoch, tenant="zomb-t", holder="host-b", by="host-a"
+        )
+        _feed(pipe, 1, seed=1)
+        post = pipe.checkpoint_now()  # the zombie write: it LANDS
+        return pipe, directory, pre, post
+
+    def test_post_fence_write_lands_but_fails_verify(self, tmp_path):
+        pipe, _, pre, post = self._fenced_stream(tmp_path)
+        try:
+            assert post is not None and os.path.isdir(post)
+            with pytest.raises(FencedBundleError, match="zombie"):
+                verify_bundle(post)
+            # the pre-fence bundle (in `known`) stays restorable
+            assert verify_bundle(pre)["lease"]["epoch"] == pipe.lineage_epoch
+            # the writer's own view skips the fence check: landing is allowed
+            assert verify_bundle(post, check_fence=False)["kind"] == migrate_mod._BUNDLE_KIND
+        finally:
+            pipe.close()
+
+    def test_recovery_scan_counts_and_never_selects(self, tmp_path):
+        pipe, directory, pre, post = self._fenced_stream(tmp_path)
+        try:
+            before = obs_scope.fenced_rejected_count()
+            selected = latest_valid_bundle(directory)
+            assert selected == pre  # newest VALID, not newest
+            assert os.path.basename(selected) != os.path.basename(post)
+            # counted at least once (chain verification may reject it again)
+            assert obs_scope.fenced_rejected_count() >= before + 1
+        finally:
+            pipe.close()
+
+    def test_fresh_epoch_restore_is_not_fenced(self, tmp_path):
+        pipe, directory, pre, _ = self._fenced_stream(tmp_path)
+        pipe.close()
+        new_pipe, manifest = restore_session(
+            CatMetric(capacity=1 << 12, nan_strategy="disable"),
+            pre,
+            fresh_epoch=True,
+            checkpoint=CheckpointPolicy(
+                directory=directory, every_batches=1, segment_bytes=4096
+            ),
+        )
+        try:
+            assert new_pipe.lineage_epoch != pipe.lineage_epoch
+            _feed(new_pipe, 1, seed=2)
+            successor = new_pipe.checkpoint_now()
+            # the successor's bundles verify even though its directory carries
+            # a fence ledger: only the FENCED epoch is dead
+            assert verify_bundle(successor)["lease"]["epoch"] == new_pipe.lineage_epoch
+            assert latest_valid_bundle(directory) == successor
+        finally:
+            new_pipe.close()
+
+
+# ------------------------------------------------------------------ failover
+
+
+class TestFailover:
+    def test_failover_fences_then_restores_fresh_epoch(self, tmp_path):
+        pipe = _cat_session(tmp_path, "fo-t")
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 3)
+        pipe.checkpoint_now()
+        old_epoch = pipe.lineage_epoch
+        new_pipe, report = fence_mod.failover(
+            CatMetric(capacity=1 << 12, nan_strategy="disable"),
+            directory,
+            tenant="fo-t",
+            checkpoint=CheckpointPolicy(
+                directory=directory, every_batches=1, segment_bytes=4096
+            ),
+        )
+        try:
+            assert report["fenced_epoch"] == old_epoch
+            assert report["new_epoch"] == new_pipe.lineage_epoch != old_epoch
+            assert report["restored_cursor"] == 3
+            assert report["failover_seconds"] >= 0.0
+            assert os.path.basename(report["bundle"]) in report["known_bundles"]
+            assert obs_scope.is_fenced(old_epoch)
+            # the new session computes what the old one had checkpointed
+            assert int(np.asarray(new_pipe.metric.compute()).size) == 18
+        finally:
+            new_pipe.close()
+            pipe.close()
+
+    def test_failover_without_any_lease_refuses(self, tmp_path):
+        directory = str(tmp_path / "empty")
+        os.makedirs(directory)
+        with pytest.raises(RuntimeError, match="nothing to fence"):
+            fence_mod.failover(MeanMetric(), directory, tenant="ghost")
+
+    def test_failover_with_no_restorable_bundle_refuses(self, tmp_path):
+        directory = str(tmp_path / "bundles")
+        os.makedirs(directory)
+        fence_mod.mint_lease("gone-t", epoch="ep-gone", ttl_seconds=30.0)
+        with pytest.raises(RuntimeError, match="no[\\s\\S]*valid pre-fence bundle"):
+            fence_mod.failover(MeanMetric(), directory, tenant="gone-t")
+
+    def test_zombie_renewal_cannot_clobber_successor_lease(self):
+        # the zombie's checkpoint_now() force-renews its lease; once its epoch
+        # is fenced and the successor holds the row under a NEW epoch, that
+        # renewal must be dropped on the floor
+        zombie = fence_mod.mint_lease("clob-t", epoch="ep-old", ttl_seconds=30.0)
+        obs_scope.note_fence("ep-old", tenant="clob-t")
+        fence_mod.mint_lease("clob-t", epoch="ep-new", ttl_seconds=30.0)
+        fence_mod.renew_lease(zombie, "clob-t", now=time.time() + 999.0)
+        row = obs_scope.lease_status()["clob-t"]
+        assert row["epoch"] == "ep-new"
+
+
+class TestWatchdog:
+    def _watched(self, tmp_path, tenant, ttl=30.0, config=None, on_failover=None):
+        pipe = _cat_session(tmp_path, tenant, lease_seconds=ttl)
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pipe.checkpoint_now()
+        dog = fence_mod.Watchdog(on_failover=on_failover)
+        dog.watch(
+            tenant,
+            directory,
+            lambda: CatMetric(capacity=1 << 12, nan_strategy="disable"),
+            config
+            or fence_mod.WatchdogConfig(
+                restore_overrides={
+                    "checkpoint": CheckpointPolicy(
+                        directory=directory, every_batches=1, segment_bytes=4096
+                    )
+                }
+            ),
+        )
+        return pipe, directory, dog
+
+    def test_detects_expired_lease_and_fails_over(self, tmp_path):
+        swaps = []
+        pipe, _, dog = self._watched(
+            tmp_path, "wd-t", on_failover=lambda p, r: swaps.append((p, r))
+        )
+        assert dog.tick(now=time.time()) == []  # lease still live: no action
+        produced = dog.tick(now=time.time() + 999.0)
+        assert len(produced) == 1 and len(swaps) == 1
+        report = produced[0]
+        assert report["tenant"] == "wd-t"
+        assert report["fenced_epoch"] == pipe.lineage_epoch
+        assert report["detected_unix"] > 0
+        # the fenced tenant is unwatched: no repeat failover next tick
+        assert dog.tick(now=time.time() + 9999.0) == []
+        swaps[0][0].close()
+        pipe.close()
+
+    def test_released_lease_never_fails_over(self, tmp_path):
+        pipe, directory, dog = self._watched(tmp_path, "wd-rel")
+        pipe.close()  # clean shutdown releases the lease
+        assert dog.tick(now=time.time() + 999.0) == []
+        assert not obs_scope.is_fenced(pipe.lineage_epoch)
+
+    def test_fenced_epoch_never_fails_over_again(self, tmp_path):
+        pipe, _, dog = self._watched(tmp_path, "wd-fen")
+        obs_scope.note_fence(pipe.lineage_epoch, tenant="wd-fen")
+        assert dog.tick(now=time.time() + 999.0) == []
+        pipe.close()
+
+    def test_require_checkpoint_stale_holds_while_bundles_fresh(self, tmp_path):
+        pipe, directory, dog = self._watched(
+            tmp_path,
+            "wd-fresh",
+            ttl=30.0,
+            config=fence_mod.WatchdogConfig(require_checkpoint_stale=True),
+        )
+        # simulate LOST RENEWALS on a demonstrably alive host: the registry
+        # row reads expired, but the bundle just written carries a fresh
+        # renewal stamp — the freshness check must hold the failover off
+        now = time.time()
+        obs_scope.note_lease(
+            "wd-fresh",
+            holder=fence_mod.holder_id(),
+            epoch=pipe.lineage_epoch,
+            ttl_seconds=30.0,
+            expires_unix=now - 1.0,
+            renewed_unix=now - 31.0,
+        )
+        assert dog.tick(now=now) == []
+        assert not obs_scope.is_fenced(pipe.lineage_epoch)
+        pipe.close()
+
+    def test_failover_error_does_not_kill_the_tick(self, tmp_path):
+        dog = fence_mod.Watchdog()
+        fence_mod.mint_lease("wd-err", epoch="ep-err", ttl_seconds=0.001)
+        dog.watch("wd-err", str(tmp_path / "void"), MeanMetric)
+        with pytest.warns(RuntimeWarning, match="failover.*failed|failed"):
+            assert dog.tick(now=time.time() + 999.0) == []
+        # still watched: the next tick retries rather than silently dropping
+        assert "wd-err" in dog._watches
+
+    def test_install_watchdog_ticked_by_metrics_scrape(self, tmp_path):
+        swaps = []
+        pipe, _, dog = self._watched(
+            tmp_path, "wd-scrape", ttl=0.05, on_failover=lambda p, r: swaps.append(p)
+        )
+        fence_mod.install_watchdog(dog)
+        time.sleep(0.12)  # let the lease expire for real
+        srv = obs_server.IntrospectionServer(port=0).start()
+        try:
+            with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as resp:
+                assert resp.status == 200
+            assert len(swaps) == 1  # the scrape drove the failover
+            assert obs_scope.is_fenced(pipe.lineage_epoch)
+        finally:
+            srv.stop()
+            for p in swaps:
+                p.close()
+            pipe.close()
+
+
+# ------------------------------------------------- schema back-compat (sat 4)
+
+
+def _rewrite_manifest(bundle_path, mutate, reseal=True):
+    """Edit a bundle's manifest in place; optionally recompute the digest so
+    the bundle still passes its integrity check (a schema-2 impostor), or
+    leave the stale digest behind (a tamper)."""
+    from torchmetrics_tpu.utils import checkpoint as ckpt_mod
+
+    manifest_file = os.path.join(bundle_path, "MANIFEST.json")
+    with open(manifest_file, encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    mutate(manifest)
+    with open(manifest_file, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh, sort_keys=True, indent=2)
+    if reseal:
+        digest = ckpt_mod.file_tree_digest(bundle_path, exclude=("INTEGRITY.json",))
+        with open(os.path.join(bundle_path, "INTEGRITY.json"), "w", encoding="utf-8") as fh:
+            json.dump({"version": 1, "schema": 2, "sha256": digest}, fh)
+    return manifest
+
+
+class TestSchemaBackCompat:
+    def _schema2_bundle(self, tmp_path, tenant="compat-t"):
+        pipe = _cat_session(tmp_path, tenant)
+        _feed(pipe, 3)
+        path = pipe.checkpoint_now()
+        directory = pipe.config.checkpoint.directory
+        pipe.close()
+
+        def strip_lease(manifest):
+            manifest["schema_version"] = 2
+            manifest.pop("lease", None)
+
+        _rewrite_manifest(path, strip_lease)
+        return pipe, directory, path
+
+    def test_unleased_schema2_bundle_restores_with_lease_minted(self, tmp_path):
+        pipe, directory, path = self._schema2_bundle(tmp_path)
+        manifest = verify_bundle(path)
+        assert manifest["schema_version"] == 2 and "lease" not in manifest
+        obs_scope.reset()  # a genuinely fresh process restoring an old bundle
+        new_pipe, _ = restore_session(
+            CatMetric(capacity=1 << 12, nan_strategy="disable"), path
+        )
+        try:
+            assert int(np.asarray(new_pipe.metric.compute()).size) == 18
+            # the restored session minted a lease for itself: old bundles do
+            # not opt a session out of the fencing plane
+            row = obs_scope.lease_status()["compat-t"]
+            assert row["epoch"] == new_pipe.lineage_epoch
+            assert not fence_mod.lease_expired(row, now=time.time())
+        finally:
+            new_pipe.close()
+
+    def test_schema2_bundle_is_fenceable_via_lineage_epoch(self, tmp_path):
+        # pre-lease sessions must still be fenceable: the epoch falls back to
+        # the lineage cursor's stamp
+        pipe, directory, path = self._schema2_bundle(tmp_path)
+        manifest = verify_bundle(path)
+        epoch = migrate_mod._bundle_epoch(manifest)
+        assert epoch == pipe.lineage_epoch
+        migrate_mod.fence_epoch(directory, epoch, tenant="compat-t")
+        assert verify_bundle(path)["schema_version"] == 2  # in `known`: restorable
+
+    def test_tampered_lease_block_fails_verify(self, tmp_path):
+        pipe = _cat_session(tmp_path, "tamper-t")
+        _feed(pipe, 2)
+        path = pipe.checkpoint_now()
+        pipe.close()
+
+        def forge_lease(manifest):
+            manifest["lease"]["epoch"] = "forged-epoch"
+            manifest["lease"]["holder"] = "evil-host"
+
+        # the digest is NOT recomputed: this is what tampering looks like
+        _rewrite_manifest(path, forge_lease, reseal=False)
+        with pytest.raises(SessionBundleError, match="integrity"):
+            verify_bundle(path)
+        # and the recovery scan skips it (counted as torn/corrupt), falling
+        # back to the newest INTACT bundle instead
+        before = obs_scope.torn_bundle_count()
+        selected = latest_valid_bundle(os.path.dirname(path))
+        assert selected != path
+        assert obs_scope.torn_bundle_count() >= before + 1
+
+    def test_unknown_schema_still_refused(self, tmp_path):
+        pipe = _cat_session(tmp_path, "schema-t")
+        _feed(pipe, 1)
+        path = pipe.checkpoint_now()
+        pipe.close()
+        _rewrite_manifest(path, lambda m: m.update(schema_version=99))
+        with pytest.raises(SessionBundleError, match="schema"):
+            verify_bundle(path)
+
+
+# ------------------------------------------- gauges + Prometheus page (sat 2)
+
+
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.+)$")
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|histogram|summary|untyped)$"
+)
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:e-?[0-9]+)?|\+Inf|-Inf|NaN))$"
+)
+
+
+def _parse_exposition(text):
+    families, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            match = _HELP_RE.match(line)
+            assert match, f"malformed HELP line: {line!r}"
+            families.setdefault(match.group(1), {})["help"] = match.group(2)
+            continue
+        if line.startswith("# TYPE "):
+            match = _TYPE_RE.match(line)
+            assert match, f"malformed TYPE line: {line!r}"
+            families.setdefault(match.group(1), {})["type"] = match.group(2)
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line!r}"
+        match = _SAMPLE_RE.match(line)
+        assert match, f"malformed sample line: {line!r}"
+        name, label_body, value = match.groups()
+        labels = dict(
+            re.findall(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"', label_body or "")
+        )
+        samples.append((name, labels, value))
+    return families, samples
+
+
+class TestFenceGauges:
+    def test_torn_bundle_skips_feed_the_gauge(self, tmp_path):
+        pipe = _cat_session(tmp_path, "torn-t")
+        _feed(pipe, 2)
+        good = pipe.checkpoint_now()
+        directory = pipe.config.checkpoint.directory
+        pipe.close()
+        # a torn mid-write copy: manifest corrupted after the digest sealed
+        torn = os.path.join(directory, "bundle-999999")
+        import shutil
+
+        shutil.copytree(good, torn)
+        with open(os.path.join(torn, "MANIFEST.json"), "a", encoding="utf-8") as fh:
+            fh.write("GARBAGE")
+        before = obs_scope.torn_bundle_count()
+        assert latest_valid_bundle(directory) == good
+        assert obs_scope.torn_bundle_count() == before + 1
+        with trace.observe():
+            obs_scope.record_gauges()
+            page = obs_export.prometheus_text()
+        assert "tm_tpu_checkpoint_torn_bundles" in page
+
+    def test_new_families_survive_strict_parse_with_help(self, tmp_path):
+        pipe = _cat_session(tmp_path, "prom-t", lease_seconds=0.01)
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 1)
+        pipe.checkpoint_now()
+        with trace.observe():
+            time.sleep(0.03)  # the lease expires → lease.expired goes nonzero
+            migrate_mod.fence_epoch(directory, pipe.lineage_epoch, tenant="prom-t")
+            obs_scope.note_fenced_bundle_rejected()
+            trace.inc("fence.failovers", tenant="prom-t")
+            trace.inc("lease.renewals")
+            obs_scope.record_gauges()
+            page = obs_export.prometheus_text()
+        pipe.close()
+        families, samples = _parse_exposition(page)
+        sample_names = {name for name, _, _ in samples}
+        for family in (
+            "tm_tpu_lease_seconds_to_expiry",
+            "tm_tpu_lease_active",
+            "tm_tpu_lease_expired",
+            "tm_tpu_fence_fenced_epochs",
+            "tm_tpu_fence_bundles_rejected",
+        ):
+            assert families[family].get("type") == "gauge", family
+            assert families[family].get("help"), f"{family} missing HELP"
+            assert family in sample_names, f"{family} emitted no sample"
+        for family in ("tm_tpu_fence_failovers_total", "tm_tpu_lease_renewals_total"):
+            assert families[family].get("type") == "counter", family
+            assert families[family].get("help"), f"{family} missing HELP"
+        # the per-tenant expiry gauge carries its tenant label and the
+        # expired lease reads NEGATIVE (time PAST expiry, the alertable shape)
+        expiry = [
+            (labels, float(value))
+            for name, labels, value in samples
+            if name == "tm_tpu_lease_seconds_to_expiry"
+        ]
+        assert any(labels.get("tenant") == "prom-t" and value < 0 for labels, value in expiry)
+
+
+# ------------------------------------------ sync-guard env + tenant (sat 1+3)
+
+
+class TestSyncGuardEnvConfig:
+    @pytest.fixture(autouse=True)
+    def _guard_state(self):
+        previous = dict(degraded._CONFIG)
+        degraded._ENV_WARNED.clear()
+        yield
+        degraded._CONFIG.update(previous)
+        degraded._ENV_WARNED.clear()
+
+    def test_env_defaults_apply_when_unconfigured(self):
+        degraded._CONFIG.update({"timeout": None, "retries": 1, "explicit": False})
+        with mock.patch.dict(
+            os.environ, {"TM_TPU_SYNC_TIMEOUT": "12.5", "TM_TPU_SYNC_RETRIES": "3"}
+        ):
+            assert degraded._resolved_config() == (12.5, 3)
+
+    def test_explicit_config_beats_env(self):
+        with mock.patch.dict(
+            os.environ, {"TM_TPU_SYNC_TIMEOUT": "12.5", "TM_TPU_SYNC_RETRIES": "3"}
+        ):
+            with sync_guard(timeout=0.5, retries=0):
+                assert degraded._resolved_config() == (0.5, 0)
+            # the scoped guard restores: the env defaults are live again
+            degraded._CONFIG["explicit"] = False
+            assert degraded._resolved_config() == (12.5, 3)
+
+    def test_bad_value_warns_once_then_falls_back(self):
+        degraded._CONFIG.update({"timeout": None, "retries": 1, "explicit": False})
+        with mock.patch.dict(os.environ, {"TM_TPU_SYNC_TIMEOUT": "soon"}):
+            with pytest.warns(RuntimeWarning, match="TM_TPU_SYNC_TIMEOUT"):
+                assert degraded._resolved_config() == (None, 1)
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")  # second resolve must stay silent
+                assert degraded._resolved_config() == (None, 1)
+
+    def test_nonpositive_timeout_and_negative_retries_rejected(self):
+        degraded._CONFIG.update({"timeout": None, "retries": 1, "explicit": False})
+        with mock.patch.dict(
+            os.environ, {"TM_TPU_SYNC_TIMEOUT": "-5", "TM_TPU_SYNC_RETRIES": "-1"}
+        ):
+            with pytest.warns(RuntimeWarning):
+                assert degraded._resolved_config() == (None, 1)
+
+    def test_empty_env_is_not_an_error(self):
+        degraded._CONFIG.update({"timeout": None, "retries": 1, "explicit": False})
+        with mock.patch.dict(
+            os.environ, {"TM_TPU_SYNC_TIMEOUT": "", "TM_TPU_SYNC_RETRIES": "  "}
+        ):
+            with warnings.catch_warnings():
+                warnings.simplefilter("error")
+                assert degraded._resolved_config() == (None, 1)
+
+
+class TestDegradedSyncTenantAttribution:
+    def test_two_tenants_one_hung_counters_carry_the_tenant(self):
+        """Satellite 3's regression shape: two tenants sync, one host hangs —
+        the guard's timeout counter must name the hung tenant, and the healthy
+        tenant's series must stay clean."""
+        from contextlib import nullcontext
+
+        from jax.experimental import multihost_utils
+
+        from torchmetrics_tpu.parallel import sync as sync_mod
+
+        results = {}
+        with trace.observe():
+            for tenant, hang in (("healthy-t", False), ("hung-t", True)):
+                metric = MeanMetric()
+                with obs_scope.scope(tenant):
+                    metric.update(jnp.ones(3))
+                    # single-process "world": the gather is an identity with a
+                    # leading world axis, so the healthy sync passes through
+                    with mock.patch.object(sync_mod, "distributed_available", lambda: True), \
+                         mock.patch.object(metric, "distributed_available_fn", lambda: True), \
+                         mock.patch.object(
+                             multihost_utils, "process_allgather",
+                             lambda x, tiled=False: np.asarray(x)[None, ...],
+                         ), \
+                         (faults.inject_collective_fault(mode="hang", times=99)
+                          if hang else nullcontext()):
+                        with sync_guard(timeout=0.05, retries=0):
+                            metric.sync()
+                results[tenant] = metric.sync_degraded
+            counters = trace.get_recorder()._counters
+        assert results["hung-t"] is True
+        timeout_keys = [key for key in counters if key[0] == "sync.collective_timeout"]
+        assert timeout_keys, "the guard never counted the timeout"
+        assert all("hung-t" in str(labels) for _, labels in timeout_keys), timeout_keys
+        assert not any("healthy-t" in str(labels) for _, labels in timeout_keys)
+        degraded_keys = [key for key in counters if key[0] == "sync.degraded"]
+        assert all("hung-t" in str(labels) for _, labels in degraded_keys)
+
+
+# ----------------------------------------------------------------- obs routes
+
+
+class TestObsRoutes:
+    def _get_json(self, url):
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+
+    def test_leases_page_lists_row_and_fences(self, tmp_path):
+        pipe = _cat_session(tmp_path, "route-t", lease_seconds=30.0)
+        srv = obs_server.IntrospectionServer(port=0).start()
+        try:
+            status, page = self._get_json(srv.url + "/leases")
+            assert status == 200 and page["enabled"]
+            row = next(r for r in page["leases"] if r["tenant"] == "route-t")
+            assert row["epoch"] == pipe.lineage_epoch
+            assert row["seconds_to_expiry"] > 0
+            assert row["fenced"] is False
+            assert page["fences"] == {} and page["stale"] == {}
+            # /leases is discoverable from the route index
+            status, index = self._get_json(srv.url + "/")
+            assert "/leases" in index["routes"]
+        finally:
+            srv.stop()
+            pipe.close()
+
+    def test_expired_lease_degrades_healthz_then_fence_names_target(self, tmp_path):
+        pipe = _cat_session(tmp_path, "hz-t", lease_seconds=0.01)
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pipe.checkpoint_now()
+        srv = obs_server.IntrospectionServer(port=0).start()
+        try:
+            time.sleep(0.03)
+            status, health = self._get_json(srv.url + "/healthz")
+            assert health["status"] == "degraded"
+            assert "hz-t" in health["leases_stale"]
+            assert any("hung host suspected" in r for r in health["reasons"])
+            # now the failover lands: the reason flips from suspicion to fact
+            new_pipe, report = fence_mod.failover(
+                CatMetric(capacity=1 << 12, nan_strategy="disable"),
+                directory,
+                tenant="hz-t",
+                checkpoint=CheckpointPolicy(
+                    directory=directory, every_batches=1, segment_bytes=4096
+                ),
+            )
+            try:
+                status, health = self._get_json(srv.url + "/healthz")
+                assert health["status"] == "degraded"
+                assert "hz-t" in health["tenants_fenced"]
+                fenced_reasons = [r for r in health["reasons"] if "fenced" in r]
+                assert fenced_reasons and report["target"] in fenced_reasons[0]
+                status, page = self._get_json(srv.url + "/leases")
+                assert report["fenced_epoch"] in page["fences"]
+            finally:
+                new_pipe.close()
+        finally:
+            srv.stop()
+            pipe.close()
+
+    def test_trace_lookup_attributes_post_fence_updates(self, tmp_path):
+        from torchmetrics_tpu.obs import lineage
+
+        with trace.observe():
+            lineage.enable()
+            try:
+                pipe = _cat_session(tmp_path, "tr-t")
+                _feed(pipe, 1)
+                epoch = pipe.lineage_epoch
+                obs_scope.note_fence(
+                    epoch, tenant="tr-t", holder="host-b", target="host-a",
+                    fenced_unix=0.0,  # fenced "before" the feed: it reads post-fence
+                )
+                _feed(pipe, 1, seed=1)
+                trace_id = f"tr-t-{epoch}-1"
+                srv = obs_server.IntrospectionServer(port=0).start()
+                try:
+                    status, page = self._get_json(srv.url + "/trace/" + trace_id)
+                    assert status == 200
+                    assert page["fence"] is not None
+                    assert page["fence"]["post_fence"] is True
+                    assert page["fence"]["target"] == "host-a"
+                finally:
+                    srv.stop()
+                    pipe.close()
+            finally:
+                lineage.disable()

@@ -84,4 +84,5 @@ def test_two_process_aggregate_battery(tmp_path):
         "session_migrates_across_hosts_bit_identical": True,
         "worker_killed_without_drain_recovers": True,
         "lineage_flow_stitched_across_hosts": True,
+        "hung_host_fenced_and_failed_over": True,
     }

@@ -402,11 +402,172 @@ def main() -> None:
     lineage.reset()
     scope.reset()
 
+    # -- 12. hung host fenced + failed over across REAL processes --------------
+    # (the fencing primitive, 2-process-validated: rank 1 runs a live leased
+    # tenant pipeline writing periodic bundles to shared disk, then HANGS
+    # mid-stream — alive but silent: no drain, no close, no lease release,
+    # the object deliberately kept reachable so it can still write later.
+    # Rank 0 observes the lease expire through the newest bundle's stamp,
+    # fences the epoch durably (FENCED.json) and fails the tenant over under
+    # a NEW epoch, finishing the traffic BIT-identical to rank 1's unhung
+    # control. Rank 1's zombie then wakes up and writes a LATE bundle — the
+    # write lands on disk, and rank 0's next recovery scan rejects it
+    # (counted, never selected) instead of restoring from it.)
+    from torchmetrics_tpu.robust import fence as robust_fence
+
+    trace.enable()
+    fence_dir = os.path.join(shared, "fence_stream")
+    fence_target_dir = os.path.join(shared, "fence_target_stream")
+    fence_oracle = os.path.join(shared, "fence_expected.json")
+    fence_report_path = os.path.join(shared, "fence_report.json")
+    fence_rng = np.random.RandomState(11)
+    fence_batches = [
+        (
+            jnp.asarray(fence_rng.rand(16, 4).astype(np.float32)),
+            jnp.asarray(fence_rng.randint(0, 4, 16)),
+        )
+        for _ in range(10)
+    ]
+    fence_ttl = 0.6
+
+    zombie_pipe = None
+    if pid == 1:
+        control = mig_metric()
+        for p_, t_ in fence_batches:
+            control.update(p_, t_)
+        expected = np.asarray(control.compute())
+        zombie_pipe = MetricPipeline(
+            mig_metric(),
+            PipelineConfig(
+                fuse=2,
+                tenant="t-fence",
+                lease_seconds=fence_ttl,
+                checkpoint=CheckpointPolicy(
+                    directory=fence_dir, every_batches=2, full_every=4, keep=8
+                ),
+            ),
+        )
+        for p_, t_ in fence_batches[:7]:
+            zombie_pipe.feed(p_, t_)
+        # ... and now the host WEDGES: 7 fed, 6 committed+checkpointed, the
+        # lease never renewed again — deliberately NO close/release, and the
+        # object stays alive so the zombie can write again below
+        tmp = fence_oracle + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(
+                {
+                    "dtype": str(expected.dtype),
+                    "hex": expected.tobytes().hex(),
+                    "epoch": zombie_pipe.lineage_epoch,
+                },
+                fh,
+            )
+        os.replace(tmp, fence_oracle)
+    # collective barrier: the bundle stream + oracle are on shared disk
+    aggregate()
+    if pid == 0:
+        import time as time_mod
+
+        with open(fence_oracle) as fh:
+            oracle = json.load(fh)
+        # wait out the lease: the hang is only PROVEN once the newest bundle's
+        # stamp has expired unrenewed
+        deadline = time_mod.time() + 30.0
+        while time_mod.time() < deadline:
+            stamp = robust_fence.scan_bundle_lease(fence_dir)
+            assert stamp is not None, os.listdir(fence_dir)
+            if robust_fence.lease_expired(stamp, now=time_mod.time()):
+                break
+            time_mod.sleep(0.05)
+        else:
+            raise AssertionError(f"lease never expired: {stamp}")
+        assert stamp["epoch"] == oracle["epoch"]
+        # fence + restore HERE under a fresh epoch; the successor writes its
+        # own bundle stream (the failover target's disk, not the zombie's)
+        pipe2, report = robust_fence.failover(
+            mig_metric(),
+            fence_dir,
+            tenant="t-fence",
+            checkpoint=CheckpointPolicy(
+                directory=fence_target_dir, every_batches=2, full_every=4, keep=8
+            ),
+        )
+        assert report["fenced_epoch"] == oracle["epoch"]
+        assert report["new_epoch"] != report["fenced_epoch"]
+        cursor = report["restored_cursor"]
+        assert cursor == 6, report  # the last periodic bundle, not the open chunk
+        for p_, t_ in fence_batches[cursor:]:
+            pipe2.feed(p_, t_)
+        survivor_metric = pipe2.metric
+        pipe2.close()
+        got = np.asarray(survivor_metric.compute())
+        assert str(got.dtype) == oracle["dtype"]
+        assert got.tobytes().hex() == oracle["hex"], (got.tolist(), oracle)
+        tmp = fence_report_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"fenced_epoch": report["fenced_epoch"]}, fh)
+        os.replace(tmp, fence_report_path)
+    # collective barrier: the fence record + failover are durable before the
+    # zombie wakes up
+    aggregate()
+    zombie_bundle_name = None
+    if pid == 1:
+        # the zombie wakes: its late write LANDS (fencing rejects at recovery
+        # scan time, it does not — cannot — block a live host's filesystem)
+        zombie_pipe.feed(*fence_batches[7])
+        late = zombie_pipe.checkpoint_now()
+        assert late is not None and os.path.isdir(late), late
+        zombie_bundle_name = os.path.basename(late)
+        tmp = os.path.join(shared, "fence_zombie.json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump({"bundle": zombie_bundle_name}, fh)
+        os.replace(tmp, os.path.join(shared, "fence_zombie.json"))
+    # collective barrier: the zombie's late bundle is on shared disk
+    aggregate()
+    if pid == 0:
+        import torchmetrics_tpu.obs.scope as scope_mod
+
+        with open(os.path.join(shared, "fence_zombie.json")) as fh:
+            zombie_bundle_name = json.load(fh)["bundle"]
+        before = scope_mod.fenced_rejected_count()
+        selected = latest_valid_bundle(fence_dir)
+        # the recovery scan REJECTED the zombie's late bundle — counted, and
+        # the selection fell back to a pre-fence bundle
+        assert selected is not None
+        assert os.path.basename(selected) != zombie_bundle_name, selected
+        assert scope_mod.fenced_rejected_count() >= before + 1
+        with pytest_like_raises(engine_migrate.FencedBundleError):
+            verify_bundle(os.path.join(fence_dir, zombie_bundle_name))
+    fleet = aggregate()
+    fence_rows = {row["tenant"]: row for row in fleet["tenants"]}
+    # the fenced tenant is attributed on BOTH hosts: it served on host 1,
+    # hung, and finished (failed over) on host 0
+    assert fence_rows["t-fence"]["hosts"] == [0, 1], fence_rows
+    results["hung_host_fenced_and_failed_over"] = True
+    if pid == 1 and zombie_pipe is not None:
+        zombie_pipe.close()
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:
             json.dump(results, fh)
     print(f"WORKER {pid} OK", flush=True)
+
+
+class pytest_like_raises:
+    """A tiny stdlib stand-in for pytest.raises (this worker runs bare)."""
+
+    def __init__(self, exc_type):
+        self.exc_type = exc_type
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            raise AssertionError(f"expected {self.exc_type.__name__} was not raised")
+        return issubclass(exc_type, self.exc_type)
 
 
 if __name__ == "__main__":

@@ -38,6 +38,7 @@ from torchmetrics_tpu.chaos.slo import (
     format_report,
     high_tenant_slo_spec,
     host_crash_slo_spec,
+    hung_host_slo_spec,
     judge,
     rolling_deploy_slo_spec,
 )
@@ -55,6 +56,7 @@ __all__ = [
     "high_tenant_config",
     "high_tenant_slo_spec",
     "host_crash_slo_spec",
+    "hung_host_slo_spec",
     "judge",
     "load",
     "loads",

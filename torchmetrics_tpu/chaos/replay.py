@@ -107,6 +107,24 @@ class ReplayConfig:
             judges).
         checkpoint_dir: where the bundle streams land (default: a fresh
             tempdir per replay, removed on return).
+        hung_host: simulate a host that **hangs** mid-traffic — the fencing
+            twin of ``host_crash``. Host B's tenants run continuous-checkpoint
+            pipelines holding a short renewable **lease**
+            (:mod:`torchmetrics_tpu.robust.fence`); at the schedule midpoint
+            host B wedges: no drain, no close, no lease release — its
+            sessions simply stop renewing while their objects stay live (the
+            defining difference from a crash: a zombie can still *write*).
+            The scrape-driven :class:`~torchmetrics_tpu.robust.fence.Watchdog`
+            detects the stale leases, fences the zombie epochs and restores
+            each tenant elsewhere under a fresh epoch; the driver re-feeds the
+            gap plus the wedge-period traffic from the retained stream. The
+            zombie then attempts a late bundle write, which must land
+            fenced-out: the next recovery scan rejects it (counted) and never
+            selects it. Shadow controls prove end-of-run bit-identity — zero
+            double-counting between zombie and successor. Incompatible with
+            ``multiplex``, ``rolling_deploy`` and ``host_crash``.
+        lease_seconds: the hung-host tenants' lease TTL (short, so detection
+            fits a CI run; production leases are tens of seconds).
         scrape_interval_seconds: pause between scrape sweeps of the routes.
         scrape_routes: routes the background thread hits each sweep.
         sync_timeout_seconds: the sync guard's per-attempt timeout for the
@@ -125,6 +143,8 @@ class ReplayConfig:
     host_crash: bool = False
     checkpoint_every_batches: int = 4
     checkpoint_dir: Optional[str] = None
+    hung_host: bool = False
+    lease_seconds: float = 0.25
     scrape_interval_seconds: float = 0.05
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants", "/healthz")
     sync_timeout_seconds: float = 0.05
@@ -148,18 +168,26 @@ class ReplayConfig:
                 " checkpointing; it cannot be combined with `multiplex` or"
                 " `rolling_deploy`"
             )
+        if self.hung_host and (self.multiplex or self.rolling_deploy or self.host_crash):
+            raise ValueError(
+                "`hung_host` drives per-tenant leased pipeline sessions with"
+                " continuous checkpointing; it cannot be combined with"
+                " `multiplex`, `rolling_deploy` or `host_crash`"
+            )
+        if self.lease_seconds <= 0:
+            raise ValueError(f"Expected positive `lease_seconds`, got {self.lease_seconds}")
         if self.checkpoint_every_batches < 1:
             raise ValueError(
                 f"Expected `checkpoint_every_batches` >= 1, got {self.checkpoint_every_batches}"
             )
-        if self.host_crash and self.fuse > self.checkpoint_every_batches:
+        if (self.host_crash or self.hung_host) and self.fuse > self.checkpoint_every_batches:
             # the replay gap's worst case is cadence + fuse - 2 (commits land
             # on a fuse-spaced grid); a fusion depth beyond the cadence makes
             # the open chunk, not the cadence, the dominant loss window —
             # reject the misconfiguration instead of judging a vacuous bound
             # (host_crash_slo_spec(cadence, fuse=...) carries the exact bound)
             raise ValueError(
-                f"`host_crash` bounds the replay gap by the checkpoint cadence"
+                f"`host_crash`/`hung_host` bound the replay gap by the checkpoint cadence"
                 f" ({self.checkpoint_every_batches}) plus the open fusion chunk;"
                 f" `fuse` ({self.fuse}) > the cadence would make the chunk the"
                 " dominant loss window — deepen the cadence or shrink the fusion"
@@ -268,6 +296,7 @@ def _build_tenants(
     dump_dir: str,
     crash_tenants: Tuple[str, ...] = (),
     ckpt_dir: Optional[str] = None,
+    lease_seconds: Optional[float] = None,
 ):
     """(metrics, pipelines, mux, guarded_metric, crash_metric) keyed by tenant.
 
@@ -346,6 +375,10 @@ def _build_tenants(
         else:
             metric = guarded_metric(tenant)
         metrics[tenant] = metric
+        pipe_kwargs: Dict[str, Any] = {}
+        if lease_seconds is not None and tenant in crash_tenants:
+            # hung-host tenants lease short so stale-lease detection fits CI
+            pipe_kwargs["lease_seconds"] = lease_seconds
         pipelines[tenant] = MetricPipeline(
             metric,
             PipelineConfig(
@@ -358,6 +391,7 @@ def _build_tenants(
                 flight_records=32,
                 flight_dump_dir=dump_dir,
                 checkpoint=checkpoint,
+                **pipe_kwargs,
             ),
         )
     return metrics, pipelines, mux, guarded_metric, crash_metric
@@ -435,6 +469,20 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                 f" poisoned={sorted(schedule.poisoned())})"
             )
         ckpt_dir = config.checkpoint_dir or tempfile.mkdtemp(prefix="tm_tpu_ckpt_")
+    # hung host: "host B" gets the clean guarded tenants on leased continuous-
+    # checkpoint pipelines (the same large-state CatMetric build as host_crash
+    # — they ride the crash-tenant build path below); their fed batches are
+    # retained so the post-failover gap + wedge-period traffic can be re-fed
+    fence_tenants: List[str] = []
+    if config.hung_host:
+        fence_tenants = _eligible_clean_guarded(schedule, config.migrate_fraction)
+        if not fence_tenants:
+            raise ReplayError(
+                "hung_host needs at least one clean guarded tenant to wedge;"
+                f" the schedule offers none (guarded={schedule.guarded},"
+                f" poisoned={sorted(schedule.poisoned())})"
+            )
+        ckpt_dir = config.checkpoint_dir or tempfile.mkdtemp(prefix="tm_tpu_ckpt_")
 
     engine = AlertEngine(
         rules=[
@@ -449,7 +497,13 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         history=config.alert_history,
     )
     metrics, pipelines, mux, guarded_metric, crash_metric = _build_tenants(
-        schedule, config, engine, dump_dir, crash_tenants=tuple(crash_tenants), ckpt_dir=ckpt_dir
+        schedule,
+        config,
+        engine,
+        dump_dir,
+        crash_tenants=tuple(crash_tenants) or tuple(fence_tenants),
+        ckpt_dir=ckpt_dir,
+        lease_seconds=config.lease_seconds if fence_tenants else None,
     )
     # the checkpoint liveness registry is process-global and tenant names are
     # deterministic: snapshot it NOW so this run's full-vs-delta evidence is a
@@ -482,6 +536,11 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     controls.update({tenant: crash_metric() for tenant in crash_tenants})
     crash_set = set(crash_tenants)
     crash_history: Dict[str, List[tuple]] = {tenant: [] for tenant in crash_tenants}
+    # the hung-host tenants' shadow controls: the no-hang world the failed-over
+    # sessions must match bit-for-bit (zero double-counting, zero loss)
+    controls.update({tenant: crash_metric() for tenant in fence_tenants})
+    fence_set = set(fence_tenants)
+    fence_history: Dict[str, List[tuple]] = {tenant: [] for tenant in fence_tenants}
 
     def feed_tenant(tenant: str, *args: Any) -> None:
         if mux is not None and tenant not in pipelines:
@@ -503,9 +562,9 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             pipelines[tenant].flush()
 
     def make_batch(tenant: str, size: int, poison: bool) -> Tuple[Any, ...]:
-        if tenant in crash_set:
-            # the host-crash tenants drive single-array CatMetric appends;
-            # their streams are clean by selection (no poison reaches them)
+        if tenant in crash_set or tenant in fence_set:
+            # the host-crash/hung-host tenants drive single-array CatMetric
+            # appends; their streams are clean by selection (no poison)
             return (jnp.asarray(rng.rand(size).astype(np.float32)),)
         if schedule.roles[tenant] == ROLE_VICTIM:
             preds = rng.rand(size).astype(np.float32)
@@ -529,6 +588,13 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     bundle_dir = tempfile.mkdtemp(prefix="tm_tpu_migrate_") if migrate_tenants else None
     crash_info: Optional[Dict[str, Any]] = None
     crash_at = len(schedule.events) // 2 if crash_tenants else None
+    fence_info: Optional[Dict[str, Any]] = None
+    wedge_at = len(schedule.events) // 2 if fence_tenants else None
+    # zombie sessions after the wedge (still live objects — a hung host is not
+    # a dead one) and the failovers the scrape-driven watchdog completes
+    # (appended from the scraper thread; list.append is atomic)
+    zombies: Dict[str, Any] = {}
+    failover_swaps: List[Tuple[Any, Dict[str, Any]]] = []
 
     def kill_host_b_sigkill() -> Dict[str, Any]:
         """The unplanned death: host B dies with SIGKILL semantics.
@@ -608,6 +674,167 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             ),
         }
 
+    def wedge_host_b() -> Dict[str, Any]:
+        """The hung host: host B wedges mid-traffic — alive but silent.
+
+        No drain, no close, no lease release: the sessions are popped off the
+        serving set with their objects (and leases) intact, which is exactly
+        what distinguishes a hang from a crash — the zombie can still write.
+        A scrape-driven :class:`~torchmetrics_tpu.robust.fence.Watchdog` is
+        installed watching each wedged tenant's bundle stream; the background
+        scraper's ``/metrics`` pulls drive its ticks, so detection + failover
+        ride the production observation path, not a bespoke timer. The
+        survivor's guarded collective with the hung host is also exercised:
+        under the injected hanging-collective fake it must time out and
+        degrade loudly instead of hanging the run.
+        """
+        from torchmetrics_tpu.engine.migrate import CheckpointPolicy
+        from torchmetrics_tpu.robust import fence as _fence_mod
+
+        wedge_unix = time.time()
+        for tenant in fence_tenants:
+            zombies[tenant] = pipelines.pop(tenant)
+        # the survivor's collective with the hung host: guarded, so it times
+        # out and degrades loudly (sync_degraded) instead of wedging the run
+        probe = metrics[fence_tenants[0]]
+        with mock.patch.object(_sync_mod, "distributed_available", lambda: True):
+            with sync_guard(timeout=config.sync_timeout_seconds, retries=0):
+                with _faults.inject_collective_fault(mode="hang", times=99):
+                    try:
+                        probe.sync()
+                    except Exception:
+                        pass  # raise-path builds still mean "degraded"
+        watchdog = _fence_mod.Watchdog(
+            on_failover=lambda pipe, report: failover_swaps.append((pipe, report))
+        )
+        for tenant in fence_tenants:
+            tenant_dir = os.path.join(ckpt_dir, tenant)
+            watchdog.watch(
+                tenant,
+                tenant_dir,
+                crash_metric,
+                config=_fence_mod.WatchdogConfig(
+                    # both halves of detection: the lease must be past TTL AND
+                    # the bundle stream must be provably stale (a host whose
+                    # renewals are lost but whose bundles still land is slow,
+                    # not hung)
+                    require_checkpoint_stale=True,
+                    restore_overrides={
+                        "alert_engine": engine,
+                        "checkpoint": CheckpointPolicy(
+                            directory=tenant_dir,
+                            every_batches=config.checkpoint_every_batches,
+                            full_every=4,
+                            keep=8,
+                            segment_bytes=4096,
+                        ),
+                    },
+                ),
+            )
+        _fence_mod.install_watchdog(watchdog)
+        return {
+            "tenants": list(fence_tenants),
+            "lease_seconds": config.lease_seconds,
+            "wedge_unix": wedge_unix,
+            "fed_at_wedge": {t: len(fence_history[t]) for t in fence_tenants},
+            "degraded_collective": bool(getattr(probe, "sync_degraded", False)),
+        }
+
+    def finish_failover(base: Dict[str, Any]) -> Dict[str, Any]:
+        """Wait for the scrape-driven failovers, prove zombie rejection,
+        re-feed the gap + wedge-period traffic into the restored sessions."""
+        import torchmetrics_tpu.obs.scope as _scope_mod
+        from torchmetrics_tpu.engine import migrate as _migrate
+
+        deadline = time.monotonic() + 30.0
+        while len(failover_swaps) < len(fence_tenants) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if len(failover_swaps) < len(fence_tenants):
+            raise ReplayError(
+                f"the scrape-driven watchdog failed over {len(failover_swaps)}"
+                f"/{len(fence_tenants)} hung tenant(s) within 30s (lease"
+                f" {config.lease_seconds}s, scrape every"
+                f" {config.scrape_interval_seconds}s)"
+            )
+        reports = {report["tenant"]: report for _, report in failover_swaps}
+        # zombie write-rejection proof, BEFORE the restored sessions write any
+        # bundles of their own: the zombie's late bundle must LAND on disk
+        # (the write path is the zombie's own view — it cannot know it is
+        # fenced) and then be rejected, counted, and never selected by the
+        # next recovery scan
+        zt = fence_tenants[0]
+        rejected_before = _scope_mod.fenced_rejected_count()
+        zombie_bundle = zombies[zt].checkpoint_now()
+        selected = _migrate.latest_valid_bundle(os.path.join(ckpt_dir, zt))
+        rejected_delta = _scope_mod.fenced_rejected_count() - rejected_before
+        zombie_name = os.path.basename(zombie_bundle) if zombie_bundle else None
+        selected_name = os.path.basename(selected) if selected else None
+        zombie_info = {
+            "tenant": zt,
+            "bundle": zombie_name,
+            "landed": bool(zombie_bundle and os.path.isdir(zombie_bundle)),
+            "rejected_count": rejected_delta,
+            "selected": selected_name,
+            "discarded": bool(
+                zombie_name is not None
+                and rejected_delta >= 1
+                and selected_name is not None
+                and selected_name != zombie_name
+            ),
+        }
+        # hand each tenant to its restored session: swap the serving surface,
+        # then close the gap — everything from the restore point's cursor
+        # through the wedge-period backlog, replayed from the retained stream
+        sessions: Dict[str, Any] = {}
+        detect_max = failover_max = 0.0
+        for pipe, report in failover_swaps:
+            tenant = report["tenant"]
+            cursor = int(report.get("restored_cursor") or 0)
+            for args in fence_history[tenant][cursor:]:
+                pipe.feed(*args)
+            server.unregister(metrics[tenant])
+            metrics[tenant] = pipe.metric
+            server.register(pipe.metric)
+            pipelines[tenant] = pipe
+            detect = max(0.0, report["detected_unix"] - base["wedge_unix"])
+            detect_max = max(detect_max, detect)
+            failover_max = max(failover_max, float(report["failover_seconds"]))
+            sessions[tenant] = {
+                "fed_at_wedge": base["fed_at_wedge"][tenant],
+                "restored_cursor": cursor,
+                "refed_batches": len(fence_history[tenant]) - cursor,
+                "fenced_epoch": report["fenced_epoch"],
+                "new_epoch": report["new_epoch"],
+                "bundle": os.path.basename(report["bundle"]),
+                "detect_seconds": round(detect, 6),
+                "failover_seconds": round(float(report["failover_seconds"]), 6),
+            }
+        # operator visibility: /healthz must be degraded with every fenced
+        # tenant NAMED (plus its failover target), and /leases must carry the
+        # fence ledger — probed deterministically, not left to scraper luck
+        healthz_named = False
+        leases_fences = 0
+        try:
+            with urllib.request.urlopen(server.url + "/healthz", timeout=10) as resp:
+                payload = json.loads(resp.read())
+            healthz_named = payload.get("status") == "degraded" and all(
+                tenant in (payload.get("tenants_fenced") or {})
+                for tenant in fence_tenants
+            )
+            with urllib.request.urlopen(server.url + "/leases", timeout=10) as resp:
+                leases_fences = len((json.loads(resp.read()) or {}).get("fences") or {})
+        except Exception:
+            pass  # visibility is judged; a missed probe fails the SLO
+        return {
+            **base,
+            "time_to_detect_seconds": round(detect_max, 6),
+            "time_to_failover_seconds": round(failover_max, 6),
+            "sessions": sessions,
+            "zombie": zombie_info,
+            "healthz_named_fenced": healthz_named,
+            "leases_page_fences": leases_fences,
+        }
+
     def kill_host_b() -> Dict[str, Any]:
         """The rolling deploy: host B dies; its sessions move to the survivor.
 
@@ -677,9 +904,24 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                     if crash_at is not None and ev_index >= crash_at:
                         crash_info = kill_host_b_sigkill()
                         crash_at = None  # one crash per run
+                    if wedge_at is not None and ev_index >= wedge_at:
+                        fence_info = wedge_host_b()
+                        wedge_at = None  # one hang per run
                     kind = ev["kind"]
                     if kind == "batch":
                         tenant = ev["tenant"]
+                        if tenant in fence_set:
+                            # retained for the post-failover re-feed; while
+                            # host B is wedged its traffic cannot land — the
+                            # shadow control (the no-hang world) still folds
+                            # it, and the restored session catches up later
+                            batch_args = make_batch(tenant, ev["size"], False)
+                            fence_history[tenant].append(batch_args)
+                            controls[tenant].update(*batch_args)
+                            if tenant not in zombies:
+                                feed_tenant(tenant, *batch_args)
+                                batches_fed += 1
+                            continue
                         if ev.get("poison") and tenant == victim:
                             faults_injected.append(
                                 {
@@ -778,6 +1020,8 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                                 fault.setdefault("repaired_at", time.time())
                     else:  # pragma: no cover - generate()/loads() only emit known kinds
                         raise ReplayError(f"unknown schedule event kind {kind!r}")
+                if fence_info is not None:
+                    fence_info = finish_failover(fence_info)
                 for pipe in pipelines.values():
                     pipe.close()
                 if mux is not None:
@@ -886,6 +1130,27 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                             delta_mean / full_mean if full_mean and delta_mean is not None else None
                         ),
                     }
+                if fence_info is not None:
+                    # the zero-double-counting verdict: every failed-over
+                    # session's final compute must be BIT-identical to its
+                    # never-hung shadow control — the zombie contributed
+                    # nothing past the fence, the successor missed nothing
+                    fence_rows: Dict[str, Any] = {}
+                    for tenant in fence_tenants:
+                        restored_val = np.asarray(metrics[tenant].compute())
+                        control_val = np.asarray(controls[tenant].compute())
+                        fence_rows[tenant] = {
+                            "dtype": str(restored_val.dtype),
+                            "items": int(restored_val.size),
+                            "bit_identical": bool(
+                                restored_val.dtype == control_val.dtype
+                                and restored_val.tobytes() == control_val.tobytes()
+                            ),
+                        }
+                    fence_info["controls"] = fence_rows
+                    fence_info["zero_double_count"] = all(
+                        row["bit_identical"] for row in fence_rows.values()
+                    )
             elapsed = time.perf_counter() - perf_start
             scraper.stop()
             driver_scrapes = scraper.summary()
@@ -899,9 +1164,22 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         # records for the post-hoc joins below — lookups work either way)
         if not lineage_was_enabled:
             _lineage.disable()
+        if config.hung_host:
+            # the scrape-driven watchdog is process-global: leave none behind
+            from torchmetrics_tpu.robust import fence as _fence_mod
+
+            _fence_mod.install_watchdog(None)
         if scraper is not None:
             scraper.stop()
         server.stop()
+        # the zombies never serve again; closing them releases resources but
+        # NOT the successors' leases (close only releases a lease whose epoch
+        # still owns the scope row — the fenced epochs don't)
+        for zpipe in zombies.values():
+            try:
+                zpipe.close()
+            except Exception:
+                pass
         if not closed:
             for pipe in pipelines.values():
                 try:
@@ -1055,6 +1333,11 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         # wall time, bit-identity verdicts vs unkilled controls, and the
         # full-vs-delta bundle-bytes evidence
         "crash": crash_info,
+        # hung-host fencing accounting (None unless ReplayConfig.hung_host):
+        # wedged tenants, time-to-detect / time-to-failover via the scrape-
+        # driven watchdog, the zombie's rejected late bundle write, operator
+        # visibility probes, and the zero-double-counting verdicts vs controls
+        "fence": fence_info,
         "health": health,
         "tenants": tenants_page,
         "pipelines": reports,

@@ -55,6 +55,7 @@ __all__ = [
     "format_report",
     "high_tenant_slo_spec",
     "host_crash_slo_spec",
+    "hung_host_slo_spec",
     "judge",
     "rolling_deploy_slo_spec",
 ]
@@ -106,6 +107,19 @@ class SLOSpec:
     require_crash_zero_loss: bool = False
     max_recovery_seconds: Optional[float] = None
     max_delta_full_ratio: Optional[float] = None
+    # hung-host fencing promises (the hung-host scenario): the scrape-driven
+    # watchdog must detect the stale lease and complete the failover inside
+    # the wall budgets, the zombie's late bundle write must land fenced-out
+    # (rejected + counted by the next recovery scan, never selected), every
+    # failed-over session must compute bit-identical to a never-hung shadow
+    # control (zero double-counting), and the fence must be operator-visible
+    # (/healthz degraded naming the fenced tenant + target, /leases carrying
+    # the fence ledger)
+    max_time_to_detect_seconds: Optional[float] = None
+    max_time_to_failover_seconds: Optional[float] = None
+    require_zombie_writes_rejected: bool = False
+    require_fence_zero_double_count: bool = False
+    require_fence_visible: bool = False
     # routes whose scrape latency is judged (the driver may scrape more)
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants")
 
@@ -191,6 +205,37 @@ def host_crash_slo_spec(cadence_batches: int = 4, fuse: int = 2) -> SLOSpec:
         require_crash_zero_loss=True,
         max_recovery_seconds=30.0,
         max_delta_full_ratio=0.8,
+    )
+
+
+def hung_host_slo_spec() -> SLOSpec:
+    """The SLO spec of the hung-host scenario (``ReplayConfig.hung_host=True``):
+    one "host" wedges mid-traffic — alive but silent, no drain, no close, no
+    lease release — and its leased tenant sessions are fenced + failed over by
+    the scrape-driven :class:`~torchmetrics_tpu.robust.fence.Watchdog`.
+
+    The promises: the stale lease is **detected** within a budget that covers
+    the lease TTL plus scrape cadence plus scheduler slack; the fence + restore
+    completes inside its own wall budget; the zombie's late bundle write lands
+    fenced-out — rejected and counted by the next recovery scan, never selected
+    as a restore point; every failed-over session's final ``compute()`` is
+    **bit-identical** to a never-hung shadow control fed the same stream (zero
+    double-counting: the zombie contributed nothing past the fence, the
+    successor missed nothing); the fence is operator-visible (``/healthz``
+    degraded with the fenced tenant and failover target named, ``/leases``
+    carrying the fence ledger); and the ordinary fault SLOs keep holding —
+    chaos does not pause for the failover. Detection/failover walls are
+    scheduler-jitter-dominated, so (like ``migration_seconds``) their recorded
+    spreads make the ABSOLUTE budgets the regression sentinel's cap.
+    """
+    return SLOSpec(
+        min_updates_per_second=5.0,
+        require_poisoned_named=True,
+        max_time_to_detect_seconds=15.0,
+        max_time_to_failover_seconds=30.0,
+        require_zombie_writes_rejected=True,
+        require_fence_zero_double_count=True,
+        require_fence_visible=True,
     )
 
 
@@ -797,6 +842,133 @@ def judge(
             "ratio",
             spec.max_delta_full_ratio,
             spread={"min": 0.0, "max": spec.max_delta_full_ratio, "reps": 1},
+        )
+
+    # --------------------------------------------------- hung-host fencing
+    fence = result.get("fence") or {}
+    if spec.max_time_to_detect_seconds is not None:
+        seconds = fence.get("time_to_detect_seconds")
+        _row(
+            rows,
+            "time_to_detect_seconds",
+            seconds,
+            spec.max_time_to_detect_seconds,
+            "s",
+            "max",
+            detail=(
+                f"max wedge-to-detection wall over {len(fence.get('tenants') or [])}"
+                f" fenced session(s); lease TTL {fence.get('lease_seconds')}s,"
+                " detection driven by the /metrics scrape loop"
+                if fence
+                else "replay result carries no fence accounting"
+            ),
+        )
+        # detection lands wherever the next scrape tick falls after the lease
+        # expires: any wall inside the budget is scrape cadence + scheduler
+        # jitter, not a regression — the recorded spread makes the absolute
+        # budget the regression sentinel's cap
+        config(
+            f"{prefix}_time_to_detect_seconds",
+            seconds,
+            "s",
+            spec.max_time_to_detect_seconds,
+            spread={"min": 0.0, "max": spec.max_time_to_detect_seconds, "reps": 1},
+        )
+    if spec.max_time_to_failover_seconds is not None:
+        seconds = fence.get("time_to_failover_seconds")
+        _row(
+            rows,
+            "time_to_failover_seconds",
+            seconds,
+            spec.max_time_to_failover_seconds,
+            "s",
+            "max",
+            detail=f"{len(fence.get('tenants') or [])} session(s) fenced, restored"
+            " elsewhere under a new epoch and gap-re-fed",
+        )
+        config(
+            f"{prefix}_time_to_failover_seconds",
+            seconds,
+            "s",
+            spec.max_time_to_failover_seconds,
+            spread={"min": 0.0, "max": spec.max_time_to_failover_seconds, "reps": 1},
+        )
+    if spec.require_zombie_writes_rejected:
+        zombie = fence.get("zombie") or {}
+        ok = bool(
+            zombie.get("landed")
+            and int(zombie.get("rejected_count") or 0) >= 1
+            and zombie.get("discarded")
+        )
+        _row(
+            rows,
+            "zombie_writes_rejected",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"zombie {zombie.get('tenant')!r} wrote {zombie.get('bundle')!r}"
+                " post-fence; the recovery scan counted it rejected"
+                f" ({zombie.get('rejected_count')}x) and selected"
+                f" {zombie.get('selected')!r} instead"
+                if ok
+                else (
+                    "the zombie's post-fence bundle write was not provably"
+                    f" discarded: {zombie or 'no zombie accounting recorded'}"
+                )
+            ),
+        )
+    if spec.require_fence_zero_double_count:
+        fenced = fence.get("tenants") or []
+        fence_controls = fence.get("controls") or {}
+        identical = [t for t in fenced if (fence_controls.get(t) or {}).get("bit_identical")]
+        divergent = sorted(set(fenced) - set(identical))
+        ok = bool(fenced) and not divergent and bool(fence.get("zero_double_count"))
+        _row(
+            rows,
+            "fence_zero_double_count",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"all {len(fenced)} failed-over session(s) computed bit-identical"
+                " to their never-hung controls (zombie contributed nothing past"
+                " the fence, the successor missed nothing)"
+                if ok
+                else (
+                    f"failed-over sessions diverged from their controls: {divergent}"
+                    if fenced and divergent
+                    else (
+                        "double-count check did not pass"
+                        if fenced
+                        else "no tenants were fenced (the host never hung)"
+                    )
+                )
+            ),
+        )
+        config(f"{prefix}_failed_over_tenants", float(len(fenced)), "tenants", None)
+    if spec.require_fence_visible:
+        ok = bool(fence.get("healthz_named_fenced")) and int(fence.get("leases_page_fences") or 0) >= 1
+        _row(
+            rows,
+            "fence_visible_degraded",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                "/healthz went degraded-not-dead naming the fenced tenant and"
+                f" failover target; /leases carried {fence.get('leases_page_fences')}"
+                " fence ledger entr(ies)"
+                if ok
+                else (
+                    f"fence visibility probes failed: healthz_named_fenced="
+                    f"{fence.get('healthz_named_fenced')!r},"
+                    f" leases_page_fences={fence.get('leases_page_fences')!r}"
+                )
+            ),
         )
 
     failed = [row["slo"] for row in rows if not row["passed"]]

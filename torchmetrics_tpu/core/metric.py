@@ -30,7 +30,7 @@ import functools
 import inspect
 import itertools
 from abc import ABC, abstractmethod
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -918,12 +918,21 @@ class Metric(ABC):
         if not should_sync or not is_dist:
             return
         self._cache = dict(self._state_values)
+        # the sync runs under the metric's tenant session, so every recorder
+        # write below it — including the guard's sync.collective_timeout /
+        # sync.collective_retry counters in robust/degraded.py — picks up the
+        # tenant through scope.tag: a hung tenant's degradation is
+        # attributable on /tenants, not just process-global
+        sync_tenant = (
+            (_scope.current_tenant() or self._obs_tenant) if _scope.ENABLED else None
+        )
         try:
-            if _trace.ENABLED:
-                with _trace.span("metric.sync", metric=type(self).__name__, **self._obs_labels()):
+            with _scope.session(sync_tenant) if sync_tenant is not None else nullcontext():
+                if _trace.ENABLED:
+                    with _trace.span("metric.sync", metric=type(self).__name__, **self._obs_labels()):
+                        self._sync_dist(dist_sync_fn)
+                else:
                     self._sync_dist(dist_sync_fn)
-            else:
-                self._sync_dist(dist_sync_fn)
         except CollectiveError as err:
             # degraded sync: keep local-only state rather than hanging/crashing
             # the job (see torchmetrics_tpu.robust.degraded). Loud by design.

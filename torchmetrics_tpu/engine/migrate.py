@@ -123,10 +123,13 @@ __all__ = [
     "SESSION_SCHEMA",
     "CheckpointPolicy",
     "ContinuousCheckpointer",
+    "FencedBundleError",
     "SessionBundleError",
     "checkpoint_session",
     "checkpoint_staleness_rule",
     "compact_chain",
+    "fence_epoch",
+    "fenced_epochs",
     "latest_valid_bundle",
     "restore_session",
     "sweep_bundles",
@@ -134,16 +137,26 @@ __all__ = [
 ]
 
 # wire-format version of a session bundle; bump on any structural change —
-# restores REJECT other versions (a silently reinterpreted session would
+# restores REJECT unknown versions (a silently reinterpreted session would
 # break the bit-identity promise without saying so). 2: delta bundles
 # (bundle_id / base linkage / per-entry content hashes / segmented leaves).
-SESSION_SCHEMA = 2
+# 3: lease stamp (holder id, session epoch, expiry) in the manifest — the
+# fencing token. Schema-2 bundles stay restorable: every field 3 adds is
+# additive, and a pre-lease session simply mints its lease on restore.
+SESSION_SCHEMA = 3
+_COMPAT_SCHEMAS = (2, 3)
 _BUNDLE_KIND = "tm_tpu_session"
 
 _MANIFEST_NAME = "MANIFEST.json"
 _INTEGRITY_NAME = "INTEGRITY.json"
 _STATE_NAME = "state.npz"
 _TAIL_NAME = "tail.npz"
+# durable fence marker, sibling of the bundle stream: epoch -> fence record
+# ({holder, by, target, fenced_unix, known}). `known` snapshots the bundle
+# names present at fence time — the rejection rule is "fenced epoch AND not
+# in known", so pre-fence bundles stay restorable and the zombie's later
+# writes are dead on arrival, with no cross-host clock comparison anywhere.
+_FENCE_NAME = "FENCED.json"
 
 # leaves larger than this are split into fixed segments, each content-hashed
 # independently — an append-only MaskedBuffer's delta only rewrites the
@@ -163,12 +176,18 @@ _CONFIG_FIELDS = (
     "alert_every",
     "max_deferred",
     "tenant",
+    "lease_seconds",
 )
 
 
 class SessionBundleError(CheckpointIntegrityError):
     """The session bundle on disk cannot be trusted (truncated, tampered,
     half-written, chain-broken, or written by an incompatible schema)."""
+
+
+class FencedBundleError(SessionBundleError):
+    """The bundle was written under a fenced-out session epoch *after* the
+    fence landed — a zombie host's late write. Counted, never restored."""
 
 
 @dataclass
@@ -613,6 +632,10 @@ def _capture_pipeline(
         "values": _session_values(log, pipe._tenant, inst_pairs),
         "alerts": engine.export_state() if engine is not None else None,
         "registry": _registry_row(pipe._tenant),
+        # the lease stamp: holder id, session epoch (the fencing token),
+        # expiry. Every bundle write doubles as a cross-host lease renewal —
+        # the snapshot refreshes the lease before stamping it.
+        "lease": pipe.lease_snapshot(),
     }
     manifest = _write_bundle(
         path, core, _checkpoint._tree_of(target), tail_batches, delta_base, segment_bytes
@@ -707,6 +730,7 @@ def _capture_mux_slice(
         "alert_every": mux.config.alert_every,
         "max_deferred": mux.config.max_deferred,
         "tenant": effective,
+        "lease_seconds": mux.config.lease_seconds,
     }
     core = {
         "tenant": effective,
@@ -724,6 +748,9 @@ def _capture_mux_slice(
         "alerts": engine.export_state() if engine is not None else None,
         "registry": _registry_row(effective),
         "mux_slice": True,
+        # the mux holds ONE lease (one session epoch) covering every tenant;
+        # each slice stamps it, renewed, so any slice write renews cross-host
+        "lease": mux.lease_snapshot(effective),
     }
     manifest = _write_bundle(
         path, core, _checkpoint._tree_of(target), tail_batches, delta_base, segment_bytes
@@ -785,7 +812,9 @@ def checkpoint_session(
     base: Optional[Tuple[str, str, Dict[str, str]]] = None
     if delta_base is not None:
         base_path = os.path.abspath(delta_base)
-        base_manifest = verify_bundle(base_path)
+        # writer's view: a fenced session may keep spooling (its bundles land
+        # and recovery rejects them), so the base verify skips the fence check
+        base_manifest = verify_bundle(base_path, check_fence=False)
         if os.path.dirname(base_path) != os.path.dirname(os.path.abspath(path)):
             raise SessionBundleError(
                 f"Delta base {base_path} must be a sibling of the new bundle"
@@ -837,10 +866,152 @@ def checkpoint_session(
             ctx.__exit__(None, None, None)
 
 
+# ------------------------------------------------------------------ fencing
+
+
+def _fence_path(directory: str) -> str:
+    return os.path.join(os.path.abspath(directory), _FENCE_NAME)
+
+
+def _bundle_epoch(manifest: Dict[str, Any]) -> Optional[str]:
+    """The session epoch a bundle was written under — its fencing token.
+
+    Schema-3 bundles carry it in the lease stamp; schema-2 bundles fall back
+    to the lineage cursor's epoch, so even pre-lease sessions can be fenced.
+    """
+    lease = manifest.get("lease")
+    if isinstance(lease, dict) and lease.get("epoch"):
+        return str(lease["epoch"])
+    lineage = (manifest.get("cursor") or {}).get("lineage") or {}
+    epoch = lineage.get("epoch")
+    return str(epoch) if epoch else None
+
+
+def fenced_epochs(directory: str) -> Dict[str, Dict[str, Any]]:
+    """Read the durable fence records under ``directory``: ``{epoch: record}``.
+
+    Missing or unreadable markers read as "nothing fenced" — fencing must
+    never make an intact, unfenced bundle stream unrestorable. Records found
+    on disk are mirrored into the scope fence registry, so any process that
+    scans the directory can name the fenced tenant on ``/healthz`` and
+    attribute post-fence trace ids.
+    """
+    try:
+        with open(_fence_path(directory), encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    records = payload.get("fences") if isinstance(payload, dict) else None
+    if not isinstance(records, dict):
+        return {}
+    out: Dict[str, Dict[str, Any]] = {}
+    for epoch, record in records.items():
+        if not isinstance(record, dict):
+            continue
+        out[str(epoch)] = record
+        _scope.note_fence(
+            str(epoch),
+            tenant=record.get("tenant"),
+            holder=record.get("holder"),
+            by=record.get("by"),
+            target=record.get("target"),
+            fenced_unix=record.get("fenced_unix"),
+        )
+    return out
+
+
+def fence_epoch(
+    directory: str,
+    epoch: str,
+    *,
+    tenant: Optional[str] = None,
+    holder: Optional[str] = None,
+    by: Optional[str] = None,
+    target: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Durably fence session ``epoch`` out of ``directory``'s bundle stream.
+
+    Writes (atomically) a fence record into ``FENCED.json`` next to the
+    bundles. The record snapshots the bundle names present *now* (``known``):
+    those stay restorable; any bundle the fenced holder writes later carries
+    the fenced epoch but is not in ``known``, so every recovery-path verify
+    rejects it (:class:`FencedBundleError`) — the failover must therefore
+    fence FIRST and only then select its restore bundle. Idempotent per
+    epoch: the first record (and its ``known`` snapshot) wins. Returns the
+    record and mirrors it into the scope fence registry.
+    """
+    from torchmetrics_tpu.utils.fileio import atomic_write_text
+
+    directory = os.path.abspath(directory)
+    existing = fenced_epochs(directory)
+    if str(epoch) in existing:
+        return existing[str(epoch)]
+    known = sorted(
+        name
+        for name in (os.listdir(directory) if os.path.isdir(directory) else ())
+        if os.path.isdir(os.path.join(directory, name))
+        and ".tmp." not in name
+        and ".old." not in name
+    )
+    record = {
+        "epoch": str(epoch),
+        "tenant": tenant,
+        "holder": holder,
+        "by": by,
+        "target": target,
+        "fenced_unix": time.time(),
+        "known": known,
+    }
+    records = {**existing, str(epoch): record}
+    os.makedirs(directory, exist_ok=True)
+    atomic_write_text(
+        _fence_path(directory),
+        json.dumps({"version": 1, "fences": records}, sort_keys=True, indent=2),
+    )
+    _scope.note_fence(
+        str(epoch),
+        tenant=tenant,
+        holder=holder,
+        by=by,
+        target=target,
+        fenced_unix=record["fenced_unix"],
+    )
+    if _trace.ENABLED:
+        _trace.event(
+            "engine.fence",
+            tenant=tenant,
+            epoch=str(epoch),
+            holder=holder,
+            by=by,
+            target=target,
+            known=len(known),
+        )
+    return record
+
+
+def _check_fence(path: str, manifest: Dict[str, Any]) -> None:
+    """Reject ``path`` if it was written under a fenced epoch after the fence."""
+    fences = fenced_epochs(os.path.dirname(os.path.abspath(path)))
+    if not fences:
+        return
+    epoch = _bundle_epoch(manifest)
+    record = fences.get(epoch) if epoch else None
+    if record is None:
+        return
+    if os.path.basename(os.path.abspath(path)) in (record.get("known") or ()):
+        return  # written before the fence: stays restorable
+    raise FencedBundleError(
+        f"Session bundle at {path} was written under fenced-out epoch {epoch}"
+        f" (holder {record.get('holder')!r}, fenced by {record.get('by')!r}) AFTER"
+        " the fence landed — a zombie host's late write; refusing to restore"
+        " from it."
+    )
+
+
 # ------------------------------------------------------------------- verify
 
 
-def _verify_one(path: str) -> Dict[str, Any]:
+def _verify_one(path: str, check_fence: bool = True) -> Dict[str, Any]:
     """Verify ONE bundle directory (digest + schema + kind); returns its manifest."""
     path = os.path.abspath(path)
     if not os.path.isdir(path):
@@ -885,18 +1056,20 @@ def _verify_one(path: str) -> Dict[str, Any]:
             f"Directory at {path} verifies but is not a session bundle"
             f" (kind={manifest.get('kind') if isinstance(manifest, dict) else None!r})"
         )
-    if manifest.get("schema_version") != SESSION_SCHEMA:
+    if manifest.get("schema_version") not in _COMPAT_SCHEMAS:
         raise SessionBundleError(
             f"Session bundle at {path} carries schema"
             f" {manifest.get('schema_version')!r} but this build speaks"
-            f" {SESSION_SCHEMA} — re-checkpoint with a matching build (a silently"
-            " reinterpreted session would break the zero-loss contract)."
+            f" {sorted(_COMPAT_SCHEMAS)} — re-checkpoint with a matching build (a"
+            " silently reinterpreted session would break the zero-loss contract)."
         )
+    if check_fence:
+        _check_fence(path, manifest)
     return manifest
 
 
 def _chain_manifests(
-    path: str, manifest: Dict[str, Any]
+    path: str, manifest: Dict[str, Any], check_fence: bool = True
 ) -> List[Tuple[str, Dict[str, Any]]]:
     """Verify + return the whole delta chain, newest first.
 
@@ -929,7 +1102,7 @@ def _chain_manifests(
             raise SessionBundleError(
                 f"Session bundle chain at {path} is cyclic (revisits {base_path})."
             )
-        base_manifest = _verify_one(base_path)
+        base_manifest = _verify_one(base_path, check_fence=check_fence)
         if base_manifest.get("bundle_id") != base.get("bundle_id"):
             raise SessionBundleError(
                 f"Session bundle at {current_path} was written against base"
@@ -957,7 +1130,7 @@ def _chain_manifests(
     return chain
 
 
-def verify_bundle(path: str, chain: bool = True) -> Dict[str, Any]:
+def verify_bundle(path: str, chain: bool = True, check_fence: bool = True) -> Dict[str, Any]:
     """Verify a session bundle's integrity + schema; returns its manifest.
 
     Loud by design: a missing bundle, a missing/unreadable integrity record, a
@@ -967,11 +1140,16 @@ def verify_bundle(path: str, chain: bool = True) -> Dict[str, Any]:
     touched** — restoring from a bad bundle must never poison the restoring
     process. With ``chain=True`` (the default) a delta bundle's whole base
     chain is walked and verified the same way, including base-id linkage and
-    full entry resolvability.
+    full entry resolvability. With ``check_fence=True`` (the default) a bundle
+    written under a fenced-out session epoch *after* the fence landed raises
+    :class:`FencedBundleError` — recovery paths must never trust a zombie
+    host's late writes. ``check_fence=False`` is the *writer's* view: a fenced
+    session may keep spooling bundles locally (they land, and every recovery
+    scan rejects them), so the fence guards restores, not writes.
     """
-    manifest = _verify_one(path)
+    manifest = _verify_one(path, check_fence=check_fence)
     if chain and manifest.get("base"):
-        _chain_manifests(path, manifest)
+        _chain_manifests(path, manifest, check_fence=check_fence)
     return manifest
 
 
@@ -1028,15 +1206,21 @@ def latest_valid_bundle(directory: str) -> Optional[str]:
     The unplanned-death restore point: a SIGKILL'd host's bundle directory may
     end with a half-written ``.tmp.*`` sibling or a corrupted link — those are
     skipped **loudly** (one ``RuntimeWarning`` naming every skipped entry and
-    why) and the newest intact bundle wins. Bundles are ordered by their
-    manifest ``ts_unix`` (name as tie-break), not directory mtime — a restore
-    must never prefer a stale bundle a copy touched last.
+    why, plus the ``checkpoint.torn_bundles`` gauge counting every torn/corrupt
+    skip) and the newest intact bundle wins. A bundle written under a
+    fenced-out epoch after its fence landed (a zombie host's late write) is
+    likewise never selected — rejected with its own warning and counted into
+    ``fence.bundles_rejected``. Bundles are ordered by their manifest
+    ``ts_unix`` (name as tie-break), not directory mtime — a restore must
+    never prefer a stale bundle a copy touched last.
     """
     directory = os.path.abspath(directory)
     if not os.path.isdir(directory):
         return None
     candidates: List[Tuple[float, str, str]] = []
     skipped: List[Tuple[str, str]] = []
+    torn = 0
+    fenced: List[Tuple[str, str]] = []
     for name in sorted(os.listdir(directory)):
         full = os.path.join(directory, name)
         if not os.path.isdir(full):
@@ -1046,8 +1230,12 @@ def latest_valid_bundle(directory: str) -> Optional[str]:
             continue
         try:
             manifest = verify_bundle(full)
+        except FencedBundleError as err:
+            fenced.append((name, str(err).split("\n")[0][:160]))
+            continue
         except SessionBundleError as err:
             skipped.append((name, str(err).split("\n")[0][:160]))
+            torn += 1
             continue
         candidates.append((float(manifest.get("ts_unix") or 0.0), name, full))
     if skipped:
@@ -1055,6 +1243,17 @@ def latest_valid_bundle(directory: str) -> Optional[str]:
         rank_zero_warn(
             f"Skipped {len(skipped)} invalid or mid-write bundle(s) under"
             f" {directory} while scanning for the latest restore point — {detail}",
+            RuntimeWarning,
+        )
+    if torn:
+        _scope.note_torn_bundles(torn)
+    if fenced:
+        _scope.note_fenced_bundle_rejected(len(fenced))
+        detail = "; ".join(f"{name}: {reason}" for name, reason in fenced)
+        rank_zero_warn(
+            f"Rejected {len(fenced)} post-fence zombie bundle(s) under {directory}"
+            f" — written under a fenced-out epoch after its fence landed; never"
+            f" selected as a restore point — {detail}",
             RuntimeWarning,
         )
     if not candidates:
@@ -1405,6 +1604,7 @@ def restore_session(
     value_log: Any = None,
     replay: bool = True,
     restore_registry: bool = True,
+    fresh_epoch: bool = False,
     **overrides: Any,
 ) -> Tuple[MetricPipeline, Dict[str, Any]]:
     """Restore a checkpointed session onto ``metric`` (freshly constructed with
@@ -1505,7 +1705,13 @@ def restore_session(
         pipe = MetricPipeline(metric, config)
         pipe._restore_report(manifest.get("report") or {})
         pipe._restore_flight(manifest.get("flight") or {})
-        pipe._restore_lineage(manifest.get("cursor") or {})
+        # fresh_epoch=True is the FAILOVER restore: the session continues the
+        # origin's id sequence but under a brand-new epoch — the new fencing
+        # token — so the fenced origin's late writes stay distinguishable
+        # from (and rejectable against) everything this session produces. The
+        # lease is re-minted either way: a schema-2 (pre-lease) bundle simply
+        # gets its first lease here.
+        pipe._restore_lineage(manifest.get("cursor") or {}, fresh_epoch=fresh_epoch)
 
         engine = config.alert_engine
         if engine is None:

@@ -86,6 +86,7 @@ from torchmetrics_tpu.core.jit import (
 from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.engine import warmup as _warmup
 from torchmetrics_tpu.engine.pipeline import FLIGHT_DIR_ENV, _FlightRecorder
+from torchmetrics_tpu.robust import fence as _fence
 from torchmetrics_tpu.robust.policy import effective_policy, nonfinite_step_indices
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
@@ -141,6 +142,12 @@ class MuxConfig:
             ``<directory>/<tenant>/`` (delta-encoded, compacted, swept — the
             :class:`~torchmetrics_tpu.engine.pipeline.MetricPipeline` policy
             semantics per tenant). ``None`` (default) disables.
+        lease_seconds: TTL of the multiplexer's renewable session **lease**
+            (:mod:`torchmetrics_tpu.robust.fence`). The mux holds ONE lease —
+            one session epoch, the fencing token shared by every adopted
+            tenant — renewed on feed/commit (throttled to ~TTL/4), recorded
+            per tenant in the scope lease registry, and stamped into every
+            tenant slice bundle. Default 30 s.
     """
 
     max_width: int = 64
@@ -155,10 +162,13 @@ class MuxConfig:
     flight_max_dumps: int = 16
     device: Any = None
     checkpoint: Any = None
+    lease_seconds: float = 30.0
 
     def __post_init__(self) -> None:
         if self.max_width < 1:
             raise ValueError(f"Expected `max_width` >= 1, got {self.max_width}")
+        if self.lease_seconds <= 0:
+            raise ValueError(f"Expected `lease_seconds` > 0, got {self.lease_seconds}")
         if self.alert_every < 1:
             raise ValueError(f"Expected `alert_every` >= 1, got {self.alert_every}")
         if self.max_deferred < 1:
@@ -418,6 +428,19 @@ class TenantMultiplexer:
         self._checkpointers: Dict[str, Any] = {}
         self._ckpt_last_batches = 0
         self._ckpt_last_time = time.monotonic()
+        # ONE session lease for the whole mux — one epoch, one fencing token
+        # shared by every adopted tenant. No registry row is written here:
+        # adopt()/renewal record it per TENANT, so GET /leases shows each
+        # tenant's row (same holder/epoch/expiry) and no phantom global row
+        _lease_now = time.time()
+        self._lease = {
+            "holder": _fence.holder_id(),
+            "epoch": self._lineage_epoch,
+            "ttl_seconds": float(config.lease_seconds),
+            "expires_unix": _lease_now + float(config.lease_seconds),
+            "renewed_unix": _lease_now,
+        }
+        self._lease_renew_at = _lease_now + config.lease_seconds / 4.0
         for tenant, metric in (metrics or {}).items():
             self.adopt(tenant, metric)
         # persistent compile cache wiring is part of engine startup (no-op
@@ -510,6 +533,10 @@ class TenantMultiplexer:
         self._metrics[effective] = metric
         self._aliases[raw] = effective
         _scope.get_registry().pipeline_started(effective)
+        # every adopted tenant gets its own lease ROW (same holder, same
+        # epoch, same expiry — the mux's one lease) so GET /leases and the
+        # watchdog see each tenant individually
+        self._note_tenant_lease(effective)
         if self.config.checkpoint is not None and effective not in self._checkpointers:
             from dataclasses import replace as _dc_replace
 
@@ -523,6 +550,42 @@ class TenantMultiplexer:
                 policy, tenant=effective, label=self._label
             )
         return metric
+
+    def _note_tenant_lease(self, effective: str) -> None:
+        _scope.note_lease(
+            effective,
+            holder=self._lease["holder"],
+            epoch=self._lease["epoch"],
+            ttl_seconds=self._lease["ttl_seconds"],
+            expires_unix=self._lease["expires_unix"],
+            renewed_unix=self._lease["renewed_unix"],
+        )
+
+    def _renew_lease(self, force: bool = False) -> None:
+        """Renew the mux's one lease (throttled to ~TTL/4) and refresh every
+        adopted tenant's registry row with the new expiry."""
+        now = time.time()
+        if not force and now < self._lease_renew_at:
+            return
+        self._lease["expires_unix"] = now + self._lease["ttl_seconds"]
+        self._lease["renewed_unix"] = now
+        self._lease_renew_at = now + self._lease["ttl_seconds"] / 4.0
+        for effective in self._metrics:
+            self._note_tenant_lease(effective)
+        if _trace.ENABLED:
+            _trace.inc("lease.renewals")
+
+    def lease_snapshot(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The lease stamp a tenant slice bundle carries, freshly renewed —
+        every slice write doubles as a cross-host renewal for the whole mux."""
+        self._renew_lease(force=True)
+        return {
+            "holder": self._lease["holder"],
+            "epoch": self._lease["epoch"],
+            "ttl_seconds": self._lease["ttl_seconds"],
+            "expires_unix": self._lease["expires_unix"],
+            "renewed_unix": self._lease["renewed_unix"],
+        }
 
     def _maybe_checkpoint(self, force: bool = False, skip_covered: bool = False) -> int:
         """Group-commit-boundary hook: when the mux-level cadence is due, every
@@ -639,6 +702,7 @@ class TenantMultiplexer:
         # everything downstream keys on the EFFECTIVE label, so past-cap
         # tenants (collapsed onto the overflow session) keep being served
         tenant = self._effective(tenant)
+        self._renew_lease()  # throttled: live traffic keeps the mux lease warm
         trace_id = None
         if _lineage.ENABLED:
             # identity is assigned at FIRST arrival — before the admission
@@ -894,6 +958,12 @@ class TenantMultiplexer:
                     # the freshness promise ends with the sessions (see the
                     # pipeline close path)
                     _scope.note_checkpoint_closed(tenant)
+                lease_rows = _scope.lease_status()
+                for tenant in self._metrics:
+                    # release only rows this mux's epoch still owns — a
+                    # failed-over tenant's fresh lease must stay live
+                    if lease_rows.get(tenant, {}).get("epoch") == self._lease["epoch"]:
+                        _scope.note_lease_released(tenant)
         return self.report()
 
     def __enter__(self) -> "TenantMultiplexer":

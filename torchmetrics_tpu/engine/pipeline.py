@@ -96,6 +96,7 @@ from torchmetrics_tpu.core.jit import (
 from torchmetrics_tpu.core.metric import Metric
 from torchmetrics_tpu.engine import warmup as _warmup
 from torchmetrics_tpu.robust import faults as _faults
+from torchmetrics_tpu.robust import fence as _fence
 from torchmetrics_tpu.robust.policy import effective_policy, nonfinite_step_indices
 from torchmetrics_tpu.utils.fileio import atomic_write_text
 from torchmetrics_tpu.utils.prints import rank_zero_warn
@@ -170,6 +171,15 @@ class PipelineConfig:
             ``full_every``-th write, retention-swept, and scanned back with
             :func:`~torchmetrics_tpu.engine.migrate.latest_valid_bundle` after
             an unplanned death. ``None`` (default) disables — zero overhead.
+        lease_seconds: TTL of the session's renewable wall-clock **lease**
+            (:mod:`torchmetrics_tpu.robust.fence`). The lease — holder id,
+            session epoch, expiry — is minted per session epoch, renewed on
+            ingest/commit/checkpoint (throttled to ~TTL/4), and stamped into
+            every checkpoint bundle manifest, making the session epoch a
+            fencing token: a watchdog that observes the lease expire without
+            renewal fails the tenant over elsewhere under a fresh epoch and
+            fences this one, after which this session's bundle writes are
+            rejected by every recovery scan. Default 30 s.
     """
 
     fuse: int = 8
@@ -186,12 +196,15 @@ class PipelineConfig:
     admission: Any = None
     max_deferred: int = 1024
     checkpoint: Any = None
+    lease_seconds: float = 30.0
 
     def __post_init__(self) -> None:
         if self.tenant is not None:
             _scope.validate_tenant(self.tenant)
         if self.fuse < 1:
             raise ValueError(f"Expected `fuse` >= 1, got {self.fuse}")
+        if self.lease_seconds <= 0:
+            raise ValueError(f"Expected `lease_seconds` > 0, got {self.lease_seconds}")
         if self.max_in_flight < 1:
             raise ValueError(f"Expected `max_in_flight` >= 1, got {self.max_in_flight}")
         if self.prefetch < 0:
@@ -529,13 +542,42 @@ class MetricPipeline:
             self._checkpointer = ContinuousCheckpointer(
                 config.checkpoint, tenant=self._tenant, label=self._label
             )
+        # the session lease (robust/fence.py): minted per session epoch — the
+        # fencing token — renewed on ingest/commit/checkpoint (throttled to
+        # ~TTL/4) and stamped into every checkpoint bundle. A restore that
+        # adopts a bundled epoch re-mints under it (_restore_lineage).
+        self._lease = _fence.mint_lease(
+            self._tenant, epoch=self._lineage_epoch, ttl_seconds=config.lease_seconds
+        )
+        self._lease_renew_at = time.time() + config.lease_seconds / 4.0
         # wiring the persistent compile cache is part of engine startup: no-op
         # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
         _warmup.configure_compile_cache()
 
+    def _renew_lease(self, force: bool = False) -> None:
+        """Renew the session lease, throttled to ~TTL/4 unless forced."""
+        now = time.time()
+        if not force and now < self._lease_renew_at:
+            return
+        _fence.renew_lease(self._lease, self._tenant, now=now)
+        self._lease_renew_at = now + self._lease["ttl_seconds"] / 4.0
+
+    def lease_snapshot(self) -> Dict[str, Any]:
+        """The lease stamp a checkpoint bundle carries, freshly renewed —
+        every bundle write doubles as a cross-host lease renewal."""
+        self._renew_lease(force=True)
+        return {
+            "holder": self._lease["holder"],
+            "epoch": self._lease["epoch"],
+            "ttl_seconds": self._lease["ttl_seconds"],
+            "expires_unix": self._lease["expires_unix"],
+            "renewed_unix": self._lease["renewed_unix"],
+        }
+
     def _maybe_checkpoint(self, force: bool = False) -> Optional[str]:
         """Continuous-checkpoint hook, called at chunk-commit boundaries only —
         so every periodic bundle is chunk-consistent without a drain."""
+        self._renew_lease()
         if self._checkpointer is None:
             return None
         return self._checkpointer.maybe_pipeline(self, force=force)
@@ -619,7 +661,7 @@ class MetricPipeline:
         # stay the session's (not the process's) ordinals
         self._ingested = max(self._ingested, int(totals.get("batches", 0) or 0))
 
-    def _restore_lineage(self, cursor: Dict[str, Any]) -> None:
+    def _restore_lineage(self, cursor: Dict[str, Any], fresh_epoch: bool = False) -> None:
         """Adopt the bundled session's lineage identity + chunk ordinal.
 
         The epoch + arrival counter make post-restore mints continue the
@@ -628,15 +670,30 @@ class MetricPipeline:
         post-restore dispatch span's ``chunk_id`` can never collide with a
         restored flight record's — the ordinal half of the span↔record
         correlation fix (the trace id is the canonical key either way).
+
+        ``fresh_epoch=True`` is the **failover** variant: the arrival counter
+        still continues, but under a newly minted epoch — the new fencing
+        token — so nothing this session produces can be confused with (or
+        rejected alongside) the fenced origin's writes. Either way the lease
+        is re-minted under the session's final epoch, so the stamp a future
+        bundle carries names the identity it was actually written under.
         """
         lineage_row = cursor.get("lineage") or {}
         if lineage_row.get("epoch"):
-            self._lineage_epoch = str(lineage_row["epoch"])
+            if not fresh_epoch:
+                self._lineage_epoch = str(lineage_row["epoch"])
             self._lineage_seq = max(
                 self._lineage_seq, int(lineage_row.get("seq", 0) or 0)
             )
         if cursor.get("chunk_seq") is not None:
             self._chunk_seq = max(self._chunk_seq, int(cursor["chunk_seq"]))
+        if self._lease["epoch"] != self._lineage_epoch:
+            self._lease = _fence.mint_lease(
+                self._tenant,
+                epoch=self._lineage_epoch,
+                ttl_seconds=self.config.lease_seconds,
+            )
+            self._lease_renew_at = time.time() + self.config.lease_seconds / 4.0
 
     def feed(self, *args: Any, **kwargs: Any) -> None:
         """Ingest one batch (positional/keyword update arguments)."""
@@ -851,6 +908,12 @@ class MetricPipeline:
                     # session must not age into /healthz staleness or a
                     # firing checkpoint_stale alert
                     _scope.note_checkpoint_closed(self._tenant)
+            # a cleanly released lease is not a hung host: it must never age
+            # into the watchdog's stale set and trigger a failover
+            if _scope.lease_status().get(
+                self._tenant if self._tenant is not None else "__local__", {}
+            ).get("epoch") == self._lease["epoch"]:
+                _scope.note_lease_released(self._tenant)
         return self.report()
 
     def compute(self) -> Any:
@@ -980,6 +1043,7 @@ class MetricPipeline:
         bypass_admission: bool = False,
         trace_id: Optional[str] = None,
     ) -> None:
+        self._renew_lease()  # throttled: a live ingest stream keeps the lease warm
         if _lineage.ENABLED and trace_id is None:
             # identity is assigned at FIRST arrival — before the admission
             # decision — so a deferred batch re-admitted later (or persisted

@@ -322,6 +322,14 @@ _GAUGE_HELP = {
     "lineage.traces": "Live per-batch lineage records in the bounded trace-id index",
     "lineage.evicted": "Lineage records evicted from the bounded trace-id index (oldest-first)",
     "lineage.minted": "Trace ids minted by this process since the index was last reset",
+    # hung-host fencing families (robust/fence.py + engine/migrate.py): session
+    # leases, the fence ledger, and what recovery scans reject along the way
+    "lease.seconds_to_expiry": "Seconds until this tenant session's lease expires (negative: expired, holder suspect)",
+    "lease.active": "Unreleased session leases this process currently tracks",
+    "lease.expired": "Leases past expiry that are neither released nor fenced (the watchdog's pending work)",
+    "fence.fenced_epochs": "Session epochs fenced off as zombies (each one is a completed or pending failover)",
+    "fence.bundles_rejected": "Post-fence zombie bundle writes rejected by recovery scans (counted, never restored)",
+    "checkpoint.torn_bundles": "Torn/corrupt checkpoint bundles recovery scans skipped while selecting a restore point",
 }
 
 

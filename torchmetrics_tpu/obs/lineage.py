@@ -56,6 +56,7 @@ __all__ = [
     "current_trace",
     "disable",
     "enable",
+    "epoch_of",
     "get_index",
     "is_enabled",
     "lookup",
@@ -118,6 +119,23 @@ def ordinal_of(trace_id: str) -> int:
         return -1
 
 
+def epoch_of(trace_id: str) -> Optional[str]:
+    """The session epoch a minted id carries (``None`` on a foreign id).
+
+    The epoch doubles as the session's **fencing token** (robust/fence.py):
+    reading it back off a trace id is how ``GET /trace/<id>`` attributes a
+    batch to a since-fenced zombie session.
+    """
+    parts = trace_id.rsplit("-", 2)
+    if len(parts) != 3 or not parts[1]:
+        return None
+    try:
+        int(parts[2])  # a real minted id ends in its ingest ordinal
+    except ValueError:
+        return None
+    return parts[1]
+
+
 class LineageIndex:
     """Bounded, thread-safe map of ``trace_id`` → per-batch lineage record.
 
@@ -162,6 +180,10 @@ class LineageIndex:
                     "trace_id": trace_id,
                     "tenant": tenant,
                     "ordinal": int(ordinal),
+                    # the minting session's epoch — the fencing token; a
+                    # record stamped with a since-fenced epoch is attributable
+                    # as a zombie host's post-fence work
+                    "epoch": epoch_of(trace_id),
                     "ingest_unix": time.time(),
                     "signature": None,
                     "chunk_id": None,

@@ -73,21 +73,33 @@ __all__ = [
     "checkpoint_status",
     "configure",
     "current_tenant",
+    "expired_leases",
+    "fence_status",
+    "fenced_rejected_count",
+    "fenced_tenants",
     "get_admission",
     "get_registry",
     "install_admission",
+    "is_fenced",
+    "lease_status",
     "migrating_tenants",
     "migration",
     "note_checkpoint",
     "note_checkpoint_closed",
     "note_checkpoint_failure",
     "note_compute",
+    "note_fence",
+    "note_fenced_bundle_rejected",
+    "note_lease",
+    "note_lease_released",
+    "note_torn_bundles",
     "note_update",
     "record_gauges",
     "reset",
     "scope",
     "session",
     "tag",
+    "torn_bundle_count",
     "validate_tenant",
 ]
 
@@ -331,7 +343,7 @@ def reset() -> None:
     so suites that exercise tenancy call this to leave the next suite the
     pristine one-branch disabled path.
     """
-    global ENABLED, _ADMISSION
+    global ENABLED, _ADMISSION, _TORN_BUNDLES, _FENCED_REJECTED
     _REGISTRY.clear()
     _REGISTRY.max_tenants = DEFAULT_MAX_TENANTS
     _ADMISSION = None
@@ -339,6 +351,11 @@ def reset() -> None:
         _MIGRATIONS.clear()
     with _CHECKPOINT_LOCK:
         _CHECKPOINTS.clear()
+    with _LEASE_LOCK:
+        _LEASES.clear()
+        _FENCES.clear()
+        _TORN_BUNDLES = 0
+        _FENCED_REJECTED = 0
     ENABLED = False
 
 
@@ -594,6 +611,191 @@ def checkpoint_overdue(now: Optional[float] = None) -> Dict[str, Dict[str, float
             if age > float(budget):
                 overdue[tenant] = {"age": age, "budget": float(budget)}
     return overdue
+
+
+# ------------------------------------------------------------- leases & fencing
+
+# per-tenant session leases (robust/fence.py reports here): holder id, session
+# epoch (the fencing token), expiry/renewal stamps. Lives here — pure stdlib,
+# next to the checkpoint registry — so ``GET /leases`` and the /healthz
+# fenced-tenant naming never import the engine layer, and so the record
+# survives the session object whose hang it exists to describe.
+_LEASES: Dict[str, Dict[str, Any]] = {}
+# fenced session epochs: epoch -> fence record. The process-local mirror of
+# the durable FENCED.json markers engine/migrate.py writes next to bundle
+# streams; GET /trace/<id> joins a trace id's epoch against this to call an
+# update post-fence.
+_FENCES: Dict[str, Dict[str, Any]] = {}
+_LEASE_LOCK = threading.Lock()
+# torn/corrupt bundles skipped by recovery scans, and post-fence zombie
+# bundles rejected by them — running process totals behind the
+# ``checkpoint.torn_bundles`` / ``fence.bundles_rejected`` gauges
+_TORN_BUNDLES = 0
+_FENCED_REJECTED = 0
+
+
+def note_lease(
+    tenant: Optional[str],
+    *,
+    holder: str,
+    epoch: str,
+    ttl_seconds: float,
+    expires_unix: float,
+    renewed_unix: Optional[float] = None,
+) -> None:
+    """Record (or renew) ``tenant``'s session lease.
+
+    ``epoch`` is the session's lineage epoch — THE fencing token: a failover
+    restores under a fresh epoch and fences the old one, after which the
+    zombie holder's bundle writes (still stamped with the fenced epoch) are
+    rejected by recovery scans. Untenanted sessions lease under the reserved
+    ``__local__`` label.
+    """
+    key = tenant if tenant is not None else "__local__"
+    now = time.time()
+    with _LEASE_LOCK:
+        row = _LEASES.setdefault(key, {"tenant": key, "renewals": 0})
+        if str(epoch) in _FENCES and row.get("epoch") not in (None, str(epoch)):
+            # a zombie renewing its FENCED epoch must not clobber the row the
+            # failed-over session holds under the new epoch — the fence is
+            # exactly the promise that the old holder's writes stop counting
+            return
+        if row.get("epoch") == epoch:
+            row["renewals"] += 1
+        else:
+            row["renewals"] = 0
+        row["holder"] = str(holder)
+        row["epoch"] = str(epoch)
+        row["ttl_seconds"] = float(ttl_seconds)
+        row["expires_unix"] = float(expires_unix)
+        row["renewed_unix"] = float(renewed_unix if renewed_unix is not None else now)
+        row["released"] = False
+
+
+def note_lease_released(tenant: Optional[str]) -> None:
+    """Mark ``tenant``'s lease cleanly released (session closed).
+
+    A released lease promises nothing: it must not age into the expired set —
+    a clean shutdown is not a hung host."""
+    key = tenant if tenant is not None else "__local__"
+    with _LEASE_LOCK:
+        row = _LEASES.get(key)
+        if row is not None:
+            row["released"] = True
+
+
+def lease_status() -> Dict[str, Dict[str, Any]]:
+    """Per-tenant lease rows (copied; the ``GET /leases`` payload)."""
+    with _LEASE_LOCK:
+        return {tenant: dict(row) for tenant, row in _LEASES.items()}
+
+
+def expired_leases(
+    now: Optional[float] = None, grace: float = 0.0
+) -> Dict[str, Dict[str, Any]]:
+    """Tenants whose lease expired without a release or an existing fence.
+
+    ``{tenant: {"holder", "epoch", "age": seconds_past_expiry}}`` — the fence
+    watchdog's stale-lease detection input. ``grace`` widens the expiry so one
+    late renewal under scheduler jitter is not a failover."""
+    now = time.time() if now is None else now
+    stale: Dict[str, Dict[str, Any]] = {}
+    with _LEASE_LOCK:
+        for tenant, row in _LEASES.items():
+            expires = row.get("expires_unix")
+            if expires is None or row.get("released"):
+                continue
+            if row.get("epoch") in _FENCES:
+                continue  # already fenced: failover happened, not stale again
+            age = now - float(expires) - float(grace)
+            if age > 0:
+                stale[tenant] = {
+                    "tenant": tenant,
+                    "holder": row.get("holder"),
+                    "epoch": row.get("epoch"),
+                    "age": age,
+                }
+    return stale
+
+
+def note_fence(
+    epoch: str,
+    *,
+    tenant: Optional[str] = None,
+    holder: Optional[str] = None,
+    by: Optional[str] = None,
+    target: Optional[str] = None,
+    fenced_unix: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Record that session ``epoch`` is fenced out.
+
+    ``holder`` is the (presumed-hung) lease holder being fenced, ``by`` who
+    fenced it, ``target`` where the tenant failed over to. Returns the fence
+    record. Idempotent per epoch (the first record wins — a fence is a fact,
+    not a counter)."""
+    with _LEASE_LOCK:
+        record = _FENCES.get(epoch)
+        if record is None:
+            record = _FENCES[epoch] = {
+                "epoch": str(epoch),
+                "tenant": tenant,
+                "holder": holder,
+                "by": by,
+                "target": target,
+                "fenced_unix": float(fenced_unix if fenced_unix is not None else time.time()),
+            }
+        return dict(record)
+
+
+def fence_status() -> Dict[str, Dict[str, Any]]:
+    """Fenced epochs: ``{epoch: fence record}`` (copied)."""
+    with _LEASE_LOCK:
+        return {epoch: dict(record) for epoch, record in _FENCES.items()}
+
+
+def is_fenced(epoch: Optional[str]) -> bool:
+    """Is ``epoch`` a fenced-out session epoch?"""
+    if not epoch:
+        return False
+    with _LEASE_LOCK:
+        return epoch in _FENCES
+
+
+def fenced_tenants() -> Dict[str, Dict[str, Any]]:
+    """Fenced tenants, newest fence per tenant: the /healthz naming input."""
+    out: Dict[str, Dict[str, Any]] = {}
+    with _LEASE_LOCK:
+        for record in sorted(_FENCES.values(), key=lambda r: r["fenced_unix"]):
+            tenant = record.get("tenant")
+            if tenant is not None:
+                out[tenant] = dict(record)
+    return out
+
+
+def note_torn_bundles(n: int) -> None:
+    """Count ``n`` torn/corrupt bundles a recovery scan skipped."""
+    global _TORN_BUNDLES
+    if n > 0:
+        with _LEASE_LOCK:
+            _TORN_BUNDLES += int(n)
+
+
+def torn_bundle_count() -> int:
+    with _LEASE_LOCK:
+        return _TORN_BUNDLES
+
+
+def note_fenced_bundle_rejected(n: int = 1) -> None:
+    """Count ``n`` post-fence zombie bundle(s) a recovery scan rejected."""
+    global _FENCED_REJECTED
+    if n > 0:
+        with _LEASE_LOCK:
+            _FENCED_REJECTED += int(n)
+
+
+def fenced_rejected_count() -> int:
+    with _LEASE_LOCK:
+        return _FENCED_REJECTED
 
 
 # --------------------------------------------------------------------- admission
@@ -995,9 +1197,36 @@ def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
                     kind=kind,
                     **labels,
                 )
+    # lease/fence liveness: per-tenant time-to-expiry (negative = expired, the
+    # watchdog's detection signal made scrapable) plus unlabeled fleet totals
+    lease_rows = lease_status()
+    active = 0
+    expired = 0
+    for tenant, row in lease_rows.items():
+        if row.get("released"):
+            continue
+        expires = row.get("expires_unix")
+        if expires is None:
+            continue
+        remaining = float(expires) - now
+        rec.set_gauge("lease.seconds_to_expiry", remaining, tenant=tenant)
+        if remaining > 0:
+            active += 1
+        else:
+            expired += 1
+    rec.set_gauge("lease.active", float(active), tenant=None)
+    rec.set_gauge("lease.expired", float(expired), tenant=None)
+    fence_rows = fence_status()
+    rec.set_gauge("fence.fenced_epochs", float(len(fence_rows)), tenant=None)
+    rec.set_gauge("fence.bundles_rejected", float(fenced_rejected_count()), tenant=None)
+    # torn/corrupt bundles skipped by recovery scans (satellite: previously
+    # one warning, invisible to scrapes)
+    rec.set_gauge("checkpoint.torn_bundles", float(torn_bundle_count()), tenant=None)
     return {
         "tenants": len(rows),
         "overflow_collapsed": _REGISTRY.overflow_names,
         "quota_rows": quota_rows,
         "checkpoint_rows": len(checkpoint_rows),
+        "lease_rows": len(lease_rows),
+        "fenced_epochs": len(fence_rows),
     }

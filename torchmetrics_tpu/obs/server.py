@@ -115,6 +115,7 @@ ROUTES = (
     "/costs",
     "/alerts",
     "/tenants",
+    "/leases",
     "/traces",
     "/trace/<id>",
 )
@@ -251,6 +252,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(owner.alerts_report(tenant=tenant))
             elif route == "/tenants":
                 self._send_json(owner.tenants_report())
+            elif route == "/leases":
+                self._send_json(owner.leases_report())
             elif route.startswith("/trace/"):
                 trace_id = parsed.path[len("/trace/") :].strip("/")
                 payload = owner.trace_report(trace_id)
@@ -585,6 +588,36 @@ class IntrospectionServer:
             "tenants": rows,
         }
 
+    # ------------------------------------------------------------ leases & fencing
+
+    def leases_report(self) -> Dict[str, Any]:
+        """The ``GET /leases`` page: every session lease plus the fence ledger.
+
+        One row per (tenant, epoch) lease the process knows about — holder id,
+        epoch (the fencing token), expiry, renewal count, seconds left — plus
+        the fenced epochs (who fenced whom, when, and where the tenant went).
+        ``stale`` lists leases past expiry that are neither released nor
+        already fenced: the watchdog's work queue, readable by an operator.
+        """
+        now = time.time()
+        leases = []
+        for key, row in sorted(_scope.lease_status().items()):
+            leases.append(
+                {
+                    "tenant": None if key == "__local__" else key,
+                    **row,
+                    "seconds_to_expiry": float(row.get("expires_unix", 0.0)) - now,
+                    "fenced": _scope.is_fenced(str(row.get("epoch"))),
+                }
+            )
+        return {
+            "enabled": _scope.ENABLED,
+            "now_unix": now,
+            "leases": leases,
+            "stale": _scope.expired_leases(now=now),
+            "fences": _scope.fence_status(),
+        }
+
     # -------------------------------------------------------------------- lineage
 
     def trace_report(self, trace_id: str) -> Dict[str, Any]:
@@ -637,6 +670,20 @@ class IntrospectionServer:
                         alerts.append(row)
             except Exception:  # the alert join must never break the page
                 self._rec_inc("server.errors", route="/trace(alerts)")
+        # fencing attribution: the trace id's embedded session epoch IS the
+        # fencing token, so a batch ingested by a since-fenced zombie session
+        # is attributable right here — the fence record plus whether this
+        # batch's ingest landed after the fence fell
+        fence: Optional[Dict[str, Any]] = None
+        epoch = record.get("epoch") or _lineage.epoch_of(trace_id)
+        if epoch is not None:
+            fence_row = _scope.fence_status().get(str(epoch))
+            if fence_row is not None:
+                ingest = float(record.get("ingest_unix") or 0.0)
+                fence = {
+                    **fence_row,
+                    "post_fence": ingest >= float(fence_row.get("fenced_unix") or 0.0),
+                }
         return {
             "trace_id": trace_id,
             "found": True,
@@ -646,6 +693,7 @@ class IntrospectionServer:
             "flight_dump": record.get("dump"),
             "checkpoint": _lineage.get_index().covering_checkpoint(record),
             "alerts": alerts,
+            "fence": fence,
         }
 
     def traces_report(
@@ -717,10 +765,22 @@ class IntrospectionServer:
             self._rec_inc("server.errors", route="/metrics(cost)")
         if _scope.ENABLED:
             try:
-                # per-tenant liveness/cardinality gauges (tenant.* families)
+                # per-tenant liveness/cardinality gauges (tenant.* families,
+                # plus lease.*/fence.* from the lease+fence registries)
                 _scope.record_gauges(recorder=self.recorder)
             except Exception:
                 self._rec_inc("server.errors", route="/metrics(tenants)")
+        try:
+            # an installed hung-host watchdog (robust/fence.py) rides the
+            # scrape loop: every /metrics pull doubles as a lease sweep, so a
+            # fleet needs no extra timer thread to get automatic failover
+            from torchmetrics_tpu.robust import fence as _fence
+
+            watchdog = _fence.get_watchdog()
+            if watchdog is not None:
+                watchdog.tick()
+        except Exception:  # failover errors must never break the scrape
+            self._rec_inc("server.errors", route="/metrics(watchdog)")
         if _lineage.ENABLED:
             try:
                 # trace-index cardinality gauges (lineage.* families)
@@ -847,6 +907,28 @@ class IntrospectionServer:
                 f"continuous checkpoint stale for tenant {tenant!r}:"
                 f" {row['age']:.1f}s since last bundle (budget {row['budget']:.1f}s)"
             )
+        # hung-host fencing (robust/fence.py): a FENCED tenant is degraded —
+        # not dead — with the zombie holder AND the failover target named;
+        # distinct from "migrating" (planned handoff) and "checkpoint stale"
+        # (no fence yet, recovery guarantee merely at risk)
+        tenants_fenced = _scope.fenced_tenants()
+        for tenant, row in sorted(tenants_fenced.items()):
+            tenants_degraded.add(tenant)
+            target = row.get("target") or "unassigned"
+            reasons.append(
+                f"tenant {tenant!r} fenced: epoch {row.get('epoch')} on"
+                f" {row.get('holder')} is zombie, failed over to {target}"
+            )
+        # leases past expiry that nobody has fenced yet: the watchdog's
+        # pending work, surfaced so a hung host is visible BEFORE failover
+        leases_stale = _scope.expired_leases()
+        for tenant, row in sorted(leases_stale.items()):
+            tenants_degraded.add(tenant)
+            reasons.append(
+                f"session lease expired for tenant {tenant!r}: holder"
+                f" {row.get('holder')} silent for {row.get('age', 0.0):.1f}s"
+                " past expiry (hung host suspected, failover pending)"
+            )
         status = "degraded" if reasons else "ok"
         return {
             "status": status,
@@ -862,6 +944,10 @@ class IntrospectionServer:
             "tenants_migrating": migrating,
             # tenants past their declared checkpoint-staleness budget
             "checkpoints_stale": checkpoints_stale,
+            # fenced tenants ({tenant: fence record}) and expired-but-unfenced
+            # leases: the fencing story in one page
+            "tenants_fenced": tenants_fenced,
+            "leases_stale": leases_stale,
             "n_metrics": len(self.metrics()),
             "trace_enabled": trace.is_enabled(),
         }

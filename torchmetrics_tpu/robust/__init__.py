@@ -23,6 +23,11 @@ touching the happy path:
 - :mod:`~torchmetrics_tpu.robust.faults` — deterministic fault-injection
   context managers (NaN bursts, raising/hanging collectives, truncated
   downloads) used by ``tests/core/test_fault_tolerance.py``.
+- :mod:`~torchmetrics_tpu.robust.fence` — lease-stamped sessions with the
+  session epoch as a fencing token: a :class:`~torchmetrics_tpu.robust.fence.
+  Watchdog` detects a hung host's stale lease, fails its tenants over from
+  the latest valid bundle under a fresh epoch, and the zombie's post-fence
+  bundle writes are provably rejected.
 """
 
 from torchmetrics_tpu.robust.degraded import (
@@ -30,6 +35,19 @@ from torchmetrics_tpu.robust.degraded import (
     CollectiveTimeoutError,
     configure_sync_guard,
     sync_guard,
+)
+from torchmetrics_tpu.robust.fence import (
+    Watchdog,
+    WatchdogConfig,
+    failover,
+    get_watchdog,
+    holder_id,
+    install_watchdog,
+    lease_expired,
+    mint_lease,
+    renew_lease,
+    scan_bundle_lease,
+    stale_leases,
 )
 from torchmetrics_tpu.robust.policy import (
     ErrorPolicy,
@@ -56,13 +74,24 @@ __all__ = [
     "RetryError",
     "RetrySchedule",
     "UpdateGuardError",
+    "Watchdog",
+    "WatchdogConfig",
     "configure_sync_guard",
     "error_policy",
+    "failover",
     "fetch_bytes",
     "fetch_resource",
     "get_error_policy",
+    "get_watchdog",
+    "holder_id",
+    "install_watchdog",
+    "lease_expired",
     "load_with_cache_recovery",
+    "mint_lease",
+    "renew_lease",
     "retry_call",
+    "scan_bundle_lease",
     "set_error_policy",
+    "stale_leases",
     "sync_guard",
 ]

@@ -26,14 +26,17 @@ preemption recovery there belongs to the training loop's checkpoint/restore.
 
 from __future__ import annotations
 
+import os
 import threading
 from contextlib import contextmanager
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import torchmetrics_tpu.obs.trace as _trace
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = [
+    "ENV_SYNC_RETRIES",
+    "ENV_SYNC_TIMEOUT",
     "CollectiveError",
     "CollectiveTimeoutError",
     "configure_sync_guard",
@@ -50,14 +53,75 @@ class CollectiveTimeoutError(CollectiveError):
     """A single guarded collective attempt exceeded its timeout."""
 
 
-# process-global guard config; None timeout = guard disabled (direct calls)
-_CONFIG = {"timeout": None, "retries": 1}
+# process-global guard config; None timeout = guard disabled (direct calls).
+# `explicit` marks a configure_sync_guard()/sync_guard() call: explicit config
+# always beats the TM_TPU_SYNC_* environment defaults below.
+_CONFIG = {"timeout": None, "retries": 1, "explicit": False}
+
+# fleet-deployable guard defaults, consulted only while the guard is NOT
+# explicitly configured: a launcher can arm every host's guard without code
+# changes, and any in-process configure_sync_guard()/sync_guard() still wins
+ENV_SYNC_TIMEOUT = "TM_TPU_SYNC_TIMEOUT"
+ENV_SYNC_RETRIES = "TM_TPU_SYNC_RETRIES"
+# env vars already warned about (bad values warn ONCE per var+value, then
+# fall back to the built-in default — a typo must not spam every collective)
+_ENV_WARNED: set = set()
+
+
+def _env_value(name: str, parse: Callable[[str], Any], describe: str) -> Optional[Any]:
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        return parse(raw.strip())
+    except (TypeError, ValueError):
+        key = (name, raw)
+        if key not in _ENV_WARNED:
+            _ENV_WARNED.add(key)
+            rank_zero_warn(
+                f"Ignoring {name}={raw!r}: expected {describe}. The sync guard"
+                " falls back to its built-in default; this warning fires once"
+                " per value.",
+                RuntimeWarning,
+            )
+        return None
+
+
+def _parse_timeout(raw: str) -> float:
+    value = float(raw)
+    if value <= 0:
+        raise ValueError(raw)
+    return value
+
+
+def _parse_retries(raw: str) -> int:
+    value = int(raw)
+    if value < 0:
+        raise ValueError(raw)
+    return value
+
+
+def _resolved_config() -> Tuple[Optional[float], int]:
+    """The effective (timeout, retries): explicit config wins, else the
+    ``TM_TPU_SYNC_TIMEOUT``/``TM_TPU_SYNC_RETRIES`` environment, else the
+    built-in defaults (guard off, one retry)."""
+    if _CONFIG["explicit"]:
+        return _CONFIG["timeout"], _CONFIG["retries"]
+    timeout = _env_value(ENV_SYNC_TIMEOUT, _parse_timeout, "a positive number of seconds")
+    if timeout is None:
+        timeout = _CONFIG["timeout"]
+    retries = _env_value(ENV_SYNC_RETRIES, _parse_retries, "a non-negative integer")
+    if retries is None:
+        retries = _CONFIG["retries"]
+    return timeout, retries
 
 
 def configure_sync_guard(timeout: Optional[float] = None, retries: int = 1) -> dict:
     """Set the eager-sync guard: per-attempt ``timeout`` seconds and bounded
     ``retries`` after the first attempt. ``timeout=None`` disables the guard.
-    Returns the previous configuration."""
+    Explicit configuration always beats the ``TM_TPU_SYNC_TIMEOUT`` /
+    ``TM_TPU_SYNC_RETRIES`` environment defaults. Returns the previous
+    configuration (restore it to re-enable the environment defaults)."""
     if timeout is not None and timeout <= 0:
         raise ValueError(f"Expected `timeout` to be positive or None, got {timeout}")
     if retries < 0:
@@ -65,6 +129,7 @@ def configure_sync_guard(timeout: Optional[float] = None, retries: int = 1) -> d
     previous = dict(_CONFIG)
     _CONFIG["timeout"] = timeout
     _CONFIG["retries"] = retries
+    _CONFIG["explicit"] = True
     return previous
 
 
@@ -126,11 +191,11 @@ def guarded_collective(fn: Callable[..., Any], *args: Any, description: str = "c
     """
     from torchmetrics_tpu.robust import faults
 
-    timeout = _CONFIG["timeout"]
+    timeout, retries = _resolved_config()
     if timeout is None and not faults.collective_faults_active():
         return fn(*args, **kwargs)
 
-    attempts = 1 + int(_CONFIG["retries"])
+    attempts = 1 + int(retries)
     last_err: Optional[BaseException] = None
     made = 0
     for attempt in range(attempts):

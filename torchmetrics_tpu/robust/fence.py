@@ -1,0 +1,399 @@
+"""Lease-based hung-host fencing and automatic failover.
+
+Whole-host SIGKILL is survivable (``CheckpointPolicy`` + crash recovery), but
+a *wedged-but-alive* host — hung collective, stuck disk, GC death spiral — was
+only observable (checkpoint staleness, absent watchdogs), not survivable. This
+module closes that gap with the classic lease/fencing-token construction:
+
+- **Lease**: every session (:class:`~torchmetrics_tpu.engine.pipeline.
+  MetricPipeline`, :class:`~torchmetrics_tpu.engine.mux.TenantMultiplexer`)
+  holds a renewable wall-clock lease minted per session *epoch* (the lineage
+  epoch from :mod:`~torchmetrics_tpu.obs.lineage`). The lease — holder id,
+  epoch, expiry — is stamped into every checkpoint bundle manifest, so lease
+  renewal is visible cross-host through the bundle stream itself: a host that
+  stops writing bundles stops renewing, observably.
+- **Fencing token**: the session epoch. A failover restores the tenant under a
+  *fresh* epoch and durably fences the old one (``FENCED.json`` next to the
+  bundles, via :func:`~torchmetrics_tpu.engine.migrate.fence_epoch`). The
+  zombie's subsequent bundle writes still carry the fenced epoch and are
+  rejected by ``verify_bundle``/``latest_valid_bundle`` — never selected,
+  loudly counted — and its lineage-stamped updates are attributable as
+  post-fence via ``GET /trace/<id>``.
+- **Watchdog**: :class:`Watchdog` detects a stale lease from absent renewals
+  (in-process: the scope lease registry; cross-host: the lease stamped in the
+  newest bundle) plus checkpoint freshness, then runs :func:`failover`:
+  fence FIRST, then select the restore bundle — the ordering closes the race
+  where the zombie lands one more bundle between selection and fencing.
+
+Drive the watchdog standalone (:meth:`Watchdog.tick` from any loop) or for
+free from the obs server's scrape path (:func:`install_watchdog`; every
+``/metrics`` render ticks it). Pure stdlib at import;
+``engine.migrate`` is imported lazily inside :func:`failover` because the
+engine layer imports :mod:`robust` at module scope.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import torchmetrics_tpu.obs.scope as _scope
+import torchmetrics_tpu.obs.trace as _trace
+from torchmetrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = [
+    "Watchdog",
+    "WatchdogConfig",
+    "failover",
+    "get_watchdog",
+    "holder_id",
+    "install_watchdog",
+    "lease_expired",
+    "mint_lease",
+    "renew_lease",
+    "scan_bundle_lease",
+    "stale_leases",
+]
+
+
+def holder_id() -> str:
+    """This process's lease-holder identity: ``host:pid``."""
+    return f"{socket.gethostname()}:{os.getpid()}"
+
+
+# ------------------------------------------------------------------- leases
+
+
+def mint_lease(
+    tenant: Optional[str],
+    *,
+    epoch: str,
+    ttl_seconds: float,
+    holder: Optional[str] = None,
+    now: Optional[float] = None,
+) -> Dict[str, Any]:
+    """Mint a session lease for ``tenant`` under session ``epoch``.
+
+    Returns the lease record — ``{"holder", "epoch", "ttl_seconds",
+    "expires_unix", "renewed_unix"}`` — and registers it with the scope lease
+    registry so ``GET /leases`` and the in-process watchdog see it.
+    """
+    if ttl_seconds <= 0:
+        raise ValueError(f"Expected `ttl_seconds` to be positive, got {ttl_seconds}")
+    now = time.time() if now is None else now
+    lease = {
+        "holder": holder if holder is not None else holder_id(),
+        "epoch": str(epoch),
+        "ttl_seconds": float(ttl_seconds),
+        "expires_unix": now + float(ttl_seconds),
+        "renewed_unix": now,
+    }
+    _scope.note_lease(
+        tenant,
+        holder=lease["holder"],
+        epoch=lease["epoch"],
+        ttl_seconds=lease["ttl_seconds"],
+        expires_unix=lease["expires_unix"],
+        renewed_unix=now,
+    )
+    return lease
+
+
+def renew_lease(
+    lease: Dict[str, Any], tenant: Optional[str] = None, now: Optional[float] = None
+) -> Dict[str, Any]:
+    """Renew ``lease`` in place (new expiry = now + ttl) and re-register it."""
+    now = time.time() if now is None else now
+    lease["expires_unix"] = now + float(lease["ttl_seconds"])
+    lease["renewed_unix"] = now
+    _scope.note_lease(
+        tenant,
+        holder=lease["holder"],
+        epoch=lease["epoch"],
+        ttl_seconds=lease["ttl_seconds"],
+        expires_unix=lease["expires_unix"],
+        renewed_unix=now,
+    )
+    if _trace.ENABLED:
+        _trace.inc("lease.renewals")
+    return lease
+
+
+def lease_expired(
+    lease: Optional[Dict[str, Any]], now: Optional[float] = None, grace: float = 0.0
+) -> bool:
+    """Is ``lease`` past its expiry (plus ``grace`` seconds of jitter budget)?"""
+    if not lease:
+        return False
+    expires = lease.get("expires_unix")
+    if expires is None:
+        return False
+    now = time.time() if now is None else now
+    return now > float(expires) + float(grace)
+
+
+def stale_leases(now: Optional[float] = None, grace: float = 0.0) -> Dict[str, Dict[str, Any]]:
+    """In-process stale-lease view: unreleased, unfenced, expired past grace."""
+    return _scope.expired_leases(now=now, grace=grace)
+
+
+def scan_bundle_lease(directory: str) -> Optional[Dict[str, Any]]:
+    """Read the lease stamped into the newest bundle under ``directory``.
+
+    The *cross-host* renewal signal: a remote holder renews observably by
+    writing bundles, so the newest manifest's lease block is its last
+    provable renewal. Returns the lease dict (with ``"bundle"`` and
+    ``"tenant"`` added) or ``None`` when no bundle carries one (empty
+    directory, or pre-lease schema-2 bundles only). Torn or unreadable
+    manifests are skipped silently here — recovery scans judge them loudly.
+    """
+    try:
+        names = sorted(os.listdir(directory), reverse=True)
+    except OSError:
+        return None
+    for name in names:
+        full = os.path.join(directory, name)
+        if not os.path.isdir(full) or ".tmp." in name or ".old." in name:
+            continue
+        try:
+            with open(os.path.join(full, "MANIFEST.json"), encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        lease = manifest.get("lease")
+        if isinstance(lease, dict) and lease.get("expires_unix") is not None:
+            return {**lease, "bundle": full, "tenant": manifest.get("tenant")}
+    return None
+
+
+# ----------------------------------------------------------------- failover
+
+
+def failover(
+    metric: Any,
+    directory: str,
+    *,
+    tenant: Optional[str] = None,
+    epoch: Optional[str] = None,
+    holder: Optional[str] = None,
+    by: Optional[str] = None,
+    **restore_overrides: Any,
+) -> Tuple[Any, Dict[str, Any]]:
+    """Fence the stale holder's epoch and restore the tenant here.
+
+    Order matters: the old epoch is fenced (durably, ``FENCED.json`` in
+    ``directory``) *before* the restore bundle is selected, so a zombie bundle
+    landing mid-failover is already fenced-out and never selected. The restore
+    runs under a **fresh** session epoch (``fresh_epoch=True``) — the new
+    fencing token — and the new session mints its own lease.
+
+    ``metric`` is a freshly constructed same-spec metric (the
+    ``restore_session`` contract). ``epoch``/``holder`` default to the lease
+    visible in the scope registry or, cross-host, the newest bundle's stamp.
+    Returns ``(pipeline, report)`` where ``report`` names the fenced epoch,
+    the new epoch, the bundle restored from, and the failover timings.
+    """
+    from torchmetrics_tpu.engine import migrate  # lazy: engine imports robust
+
+    t0 = time.time()
+    if epoch is None or holder is None:
+        row = _scope.lease_status().get(tenant if tenant is not None else "__local__")
+        if row is None or row.get("epoch") is None:
+            row = scan_bundle_lease(directory)
+        if row is not None:
+            epoch = epoch if epoch is not None else row.get("epoch")
+            holder = holder if holder is not None else row.get("holder")
+    if epoch is None:
+        raise RuntimeError(
+            f"Cannot fail over tenant {tenant!r} from {directory}: no lease found in"
+            " the scope registry or any bundle manifest — nothing to fence."
+        )
+    by = by if by is not None else holder_id()
+    # 1) fence FIRST — from here on the zombie's epoch is dead on arrival
+    fence_record = migrate.fence_epoch(
+        directory, epoch, tenant=tenant, holder=holder, by=by, target=by
+    )
+    # 2) only now select the restore bundle: anything the zombie wrote after
+    #    the fence record's snapshot is rejected, not selected
+    bundle = migrate.latest_valid_bundle(directory)
+    if bundle is None:
+        raise RuntimeError(
+            f"Cannot fail over tenant {tenant!r}: fenced epoch {epoch} but found no"
+            f" valid pre-fence bundle under {directory}."
+        )
+    pipe, manifest = migrate.restore_session(
+        metric, bundle, fresh_epoch=True, **restore_overrides
+    )
+    t1 = time.time()
+    if _trace.ENABLED:
+        _trace.inc("fence.failovers", tenant=tenant)
+    rank_zero_warn(
+        f"Fenced session epoch {epoch} (holder {holder!r}) for tenant {tenant!r};"
+        f" restored from {os.path.basename(bundle)} under new epoch"
+        f" {pipe.lineage_epoch} in {t1 - t0:.3f}s.",
+        RuntimeWarning,
+    )
+    report = {
+        "tenant": tenant,
+        "fenced_epoch": str(epoch),
+        "fenced_holder": holder,
+        "by": by,
+        "target": by,
+        "new_epoch": pipe.lineage_epoch,
+        "bundle": bundle,
+        "bundle_ts_unix": manifest.get("ts_unix"),
+        # the restore point's ingest cursor: the supervisor re-feeds its
+        # retained stream from here to close the gap the hang opened
+        "restored_cursor": int(
+            (manifest.get("cursor") or {}).get("batches_ingested", 0) or 0
+        ),
+        "failover_seconds": t1 - t0,
+        "fenced_unix": fence_record.get("fenced_unix", t0),
+        "known_bundles": list(fence_record.get("known", ())),
+    }
+    return pipe, report
+
+
+# ----------------------------------------------------------------- watchdog
+
+
+@dataclass
+class WatchdogConfig:
+    """One watched tenant's detection/failover policy.
+
+    ``grace`` widens lease expiry so one late renewal under scheduler jitter
+    is not a failover. ``require_checkpoint_stale`` additionally demands the
+    newest bundle be older than ``lease ttl + grace`` before fencing — the
+    "checkpoint freshness" half of detection, guarding against a host whose
+    renewals are lost but whose bundle stream is demonstrably alive.
+    """
+
+    grace: float = 0.0
+    require_checkpoint_stale: bool = False
+    restore_overrides: Dict[str, Any] = field(default_factory=dict)
+
+
+class Watchdog:
+    """Detect stale leases and fail their tenants over automatically.
+
+    Register tenants with :meth:`watch`; call :meth:`tick` from any loop —
+    or :func:`install_watchdog` to have the obs server's ``/metrics`` scrape
+    path tick it for free. Each tick checks every watched tenant's lease
+    (in-process registry first, newest-bundle stamp as the cross-host
+    fallback) and, on staleness, fences + restores via :func:`failover`.
+    Completed failovers accumulate on :attr:`failovers` and are handed to
+    ``on_failover`` when given.
+    """
+
+    def __init__(self, on_failover: Optional[Callable[[Any, Dict[str, Any]], None]] = None):
+        self._watches: Dict[str, Dict[str, Any]] = {}
+        self._on_failover = on_failover
+        self.failovers: List[Dict[str, Any]] = []
+
+    def watch(
+        self,
+        tenant: Optional[str],
+        directory: str,
+        metric_factory: Callable[[], Any],
+        config: Optional[WatchdogConfig] = None,
+    ) -> None:
+        """Watch ``tenant``'s bundle ``directory``; ``metric_factory`` builds
+        the fresh same-spec metric a failover restores onto."""
+        key = tenant if tenant is not None else "__local__"
+        self._watches[key] = {
+            "tenant": tenant,
+            "directory": os.path.abspath(directory),
+            "metric_factory": metric_factory,
+            "config": config or WatchdogConfig(),
+        }
+
+    def unwatch(self, tenant: Optional[str]) -> None:
+        self._watches.pop(tenant if tenant is not None else "__local__", None)
+
+    def _stale_lease(
+        self, key: str, watch: Dict[str, Any], now: float
+    ) -> Optional[Dict[str, Any]]:
+        cfg: WatchdogConfig = watch["config"]
+        row = _scope.lease_status().get(key)
+        if row is not None:
+            # the in-process registry is authoritative when it has seen the
+            # tenant at all: a RELEASED lease is a clean shutdown, never a
+            # hung host — falling through to the bundle-stamp fallback here
+            # would fence a session that said goodbye properly
+            if row.get("released"):
+                return None
+            if _scope.is_fenced(row.get("epoch")):
+                return None
+            if not lease_expired(row, now=now, grace=cfg.grace):
+                return None
+            lease = row
+        else:
+            lease = scan_bundle_lease(watch["directory"])
+            if lease is None or _scope.is_fenced(lease.get("epoch")):
+                return None
+            if not lease_expired(lease, now=now, grace=cfg.grace):
+                return None
+        if cfg.require_checkpoint_stale:
+            newest = scan_bundle_lease(watch["directory"])
+            if newest is not None:
+                budget = float(lease.get("ttl_seconds") or 0.0) + cfg.grace
+                if now - float(newest.get("renewed_unix") or 0.0) <= budget:
+                    return None  # bundle stream is provably alive: not hung
+        return dict(lease)
+
+    def tick(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
+        """One detection pass; returns the failover reports it produced."""
+        now = time.time() if now is None else now
+        produced: List[Dict[str, Any]] = []
+        for key, watch in list(self._watches.items()):
+            stale = self._stale_lease(key, watch, now)
+            if stale is None:
+                continue
+            cfg: WatchdogConfig = watch["config"]
+            try:
+                pipe, report = failover(
+                    watch["metric_factory"](),
+                    watch["directory"],
+                    tenant=watch["tenant"],
+                    epoch=stale.get("epoch"),
+                    holder=stale.get("holder"),
+                    **cfg.restore_overrides,
+                )
+            except Exception as err:  # noqa: BLE001 - a watchdog must not die with its patient
+                rank_zero_warn(
+                    f"Watchdog failover for tenant {watch['tenant']!r} failed: {err}",
+                    RuntimeWarning,
+                )
+                continue
+            report = {**report, "detected_unix": now}
+            self.failovers.append(report)
+            produced.append(report)
+            # the restored session owns the tenant now; stop watching the
+            # fenced one (the new session's own lease is watched by whoever
+            # supervises *this* host)
+            self.unwatch(watch["tenant"])
+            if self._on_failover is not None:
+                self._on_failover(pipe, report)
+        return produced
+
+
+# process-global watchdog the obs server's scrape loop drives (render_metrics
+# ticks it right after refreshing the scope gauges)
+_WATCHDOG: Optional[Watchdog] = None
+
+
+def install_watchdog(watchdog: Optional[Watchdog]) -> Optional[Watchdog]:
+    """Install (or with ``None`` remove) the scrape-driven watchdog; returns
+    the previous one."""
+    global _WATCHDOG
+    previous = _WATCHDOG
+    _WATCHDOG = watchdog
+    return previous
+
+
+def get_watchdog() -> Optional[Watchdog]:
+    return _WATCHDOG
