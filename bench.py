@@ -1069,7 +1069,14 @@ def _chaos_main(argv) -> None:
     parser.add_argument("--chaos-seed", type=int, default=0)
     parser.add_argument(
         "--chaos-scenario",
-        choices=("default", "high_tenant", "rolling_deploy", "host_crash", "hung_host"),
+        choices=(
+            "default",
+            "high_tenant",
+            "rolling_deploy",
+            "host_crash",
+            "hung_host",
+            "skewed_load",
+        ),
         default="default",
         help="high_tenant: >=64 tenants with shared signatures and bursty arrivals,"
              " replayed through the cross-tenant multiplexer and judged against the"
@@ -1092,7 +1099,14 @@ def _chaos_main(argv) -> None:
              " survivor under a new epoch, judged against the hung-host SLO"
              " spec incl. time-to-detect/time-to-failover budgets, zombie"
              " bundle-write rejection and bit-identity vs never-hung controls"
-             " (configs prefixed chaos_hh_*)",
+             " (configs prefixed chaos_hh_*)."
+             " skewed_load: a static placement concentrates every tenant but"
+             " one onto one virtual host; the fleet telemetry plane"
+             " (obs/fleet.py — continuous sampling, rate derivation, skew"
+             " signals, GET /fleet) must page on the imbalance within budget"
+             " from fleet samples alone, track a mid-run hot-spot shift, and"
+             " degrade loudly when a gather wedges, judged against the"
+             " skewed-load SLO spec (configs prefixed chaos_sk_*)",
     )
     parser.add_argument(
         "--chaos-schedule", default=None,
@@ -1139,6 +1153,10 @@ def _chaos_main(argv) -> None:
         sched = chaos.generate(
             chaos.high_tenant_config(seed=args.chaos_seed, tenants=max(64, args.chaos_tenants))
         )
+    elif args.chaos_scenario == "skewed_load":
+        sched = chaos.generate(
+            chaos.skewed_load_config(seed=args.chaos_seed, tenants=max(4, args.chaos_tenants))
+        )
     else:
         sched = chaos.generate(
             chaos.ScheduleConfig(seed=args.chaos_seed, tenants=args.chaos_tenants)
@@ -1174,6 +1192,14 @@ def _chaos_main(argv) -> None:
         # land fenced-out and be discarded by the next recovery scan
         result = chaos.replay(sched, chaos.ReplayConfig(hung_host=True))
         report = chaos.judge(result, chaos.hung_host_slo_spec(), prefix="chaos_hh")
+    elif args.chaos_scenario == "skewed_load":
+        # the fleet-telemetry scenario: a static placement makes one virtual
+        # host hot; the installed FleetSampler — ticked by the /metrics scrape
+        # loop — must derive rates + skew from merged host snapshots, page on
+        # sustained imbalance through the standard alert machinery, follow the
+        # mid-run hot-spot shift, and degrade loudly when a gather wedges
+        result = chaos.replay(sched, chaos.ReplayConfig(skewed_load=True))
+        report = chaos.judge(result, chaos.skewed_load_slo_spec(), prefix="chaos_sk")
     else:
         result = chaos.replay(sched)
         report = chaos.judge(result)
@@ -1212,6 +1238,8 @@ def _chaos_main(argv) -> None:
             "crash": result.get("crash"),
             # hung-host fencing accounting (None unless hung_host)
             "fence": result.get("fence"),
+            # fleet-telemetry accounting (None unless skewed_load)
+            "fleet": result.get("fleet"),
             # batch-lineage causality rows (trace id → dump/alert links)
             "lineage_poisoned": (result.get("lineage") or {}).get("poisoned"),
         },

@@ -307,6 +307,86 @@ class TestZombieRejection:
             new_pipe.close()
 
 
+class TestZombieSweep:
+    """Retention's zombie-GC mode: post-fence rejected bundles get collected."""
+
+    def _fenced_stream(self, tmp_path):
+        pipe = _cat_session(tmp_path, "sweep-t")
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pre = pipe.checkpoint_now()
+        migrate_mod.fence_epoch(
+            directory, pipe.lineage_epoch, tenant="sweep-t", holder="host-b", by="host-a"
+        )
+        _feed(pipe, 1, seed=1)
+        post = pipe.checkpoint_now()  # the zombie write: it LANDS on disk
+        return pipe, directory, pre, post
+
+    def test_sweep_gcs_zombie_regardless_of_recency_and_stream_restores(self, tmp_path):
+        pipe, directory, pre, post = self._fenced_stream(tmp_path)
+        try:
+            before = obs_scope.fenced_swept_count()
+            # keep window far larger than the stream: recency alone would keep
+            # the zombie; the GC mode removes it anyway because recovery scans
+            # can never restore it
+            removed = migrate_mod.sweep_bundles(directory, keep=16)
+            removed_names = {os.path.basename(p) for p in removed}
+            assert os.path.basename(post) in removed_names
+            assert not os.path.isdir(post)
+            # the pre-fence bundle (in `known`) is untouched and still selected
+            assert os.path.isdir(pre)
+            assert latest_valid_bundle(directory) == pre
+            # every post-fence bundle is a zombie (the cadence write riding
+            # the feed plus the forced checkpoint_now) and each one counts
+            swept = obs_scope.fenced_swept_count() - before
+            assert swept >= 1
+            assert swept == len(removed)
+            # the count rides the standard gauge surface
+            rec = trace.TraceRecorder()
+            obs_scope.record_gauges(recorder=rec)
+            page = obs_export.prometheus_text(recorder=rec)
+            match = re.search(r"^tm_tpu_fence_bundles_swept (\d+)(?:\.0)?$", page, re.M)
+            assert match is not None and int(match.group(1)) == swept
+            # the fenced-then-swept stream still restores end to end
+            new_pipe, manifest = restore_session(
+                CatMetric(capacity=1 << 12, nan_strategy="disable"),
+                pre,
+                fresh_epoch=True,
+                checkpoint=CheckpointPolicy(
+                    directory=directory, every_batches=1, segment_bytes=4096
+                ),
+            )
+            try:
+                assert manifest["lease"]["epoch"] == pipe.lineage_epoch
+                assert int(np.asarray(new_pipe.metric.compute()).size) == 12
+            finally:
+                new_pipe.close()
+        finally:
+            pipe.close()
+
+    def test_zombie_never_occupies_the_keep_window(self, tmp_path):
+        pipe, directory, pre, post = self._fenced_stream(tmp_path)
+        try:
+            # keep=1 with the zombie newest: the keep window must be filled by
+            # the live stream (pre survives), not by unrestorable garbage
+            migrate_mod.sweep_bundles(directory, keep=1)
+            assert os.path.isdir(pre)
+            assert not os.path.isdir(post)
+        finally:
+            pipe.close()
+
+    def test_gc_fenced_false_preserves_recency_only_sweep(self, tmp_path):
+        pipe, directory, pre, post = self._fenced_stream(tmp_path)
+        try:
+            before = obs_scope.fenced_swept_count()
+            removed = migrate_mod.sweep_bundles(directory, keep=16, gc_fenced=False)
+            assert removed == []
+            assert os.path.isdir(post)
+            assert obs_scope.fenced_swept_count() == before
+        finally:
+            pipe.close()
+
+
 # ------------------------------------------------------------------ failover
 
 

@@ -1,0 +1,301 @@
+"""Fleet telemetry plane battery: sampler, rates, skew, hints, serving.
+
+Deterministic CPU-only unit tests of :mod:`torchmetrics_tpu.obs.fleet` —
+injectable clocks, a fresh recorder per test, tenant load fed through the
+real ``obs.scope`` registry path — plus the ``/fleet`` control-plane read
+API on a live ephemeral-port server. The real two-process collective path
+is covered by ``tests/multiproc/worker_aggregate.py`` (sections 13/14) and
+the chaos ``skewed_load`` scenario; this file pins the derivation math.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from torchmetrics_tpu.obs import fleet
+from torchmetrics_tpu.obs import scope as obs_scope
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.obs import trace
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fleet_clean():
+    obs_scope.reset()
+    previous = fleet.install_sampler(None)
+    yield
+    fleet.install_sampler(previous)
+    obs_scope.reset()
+
+
+def _sampler(placement=None, clock=None, **kwargs):
+    """A sampler on a fresh recorder with an injectable list-backed clock."""
+    clock = clock if clock is not None else [0.0]
+    rec = trace.TraceRecorder()
+    s = fleet.FleetSampler(
+        recorder=rec,
+        placement=placement,
+        clock=lambda: clock[0],
+        wall=lambda: 1.7e9 + clock[0],
+        **kwargs,
+    )
+    return s, clock, rec
+
+
+def _feed(tenant, n=1, computes=0):
+    with obs_scope.scope(tenant):
+        obs_scope.note_update(n=n)
+        for _ in range(computes):
+            obs_scope.note_compute()
+
+
+# ------------------------------------------------------------------ derivation
+
+
+class TestRates:
+    def test_rates_from_consecutive_sample_deltas(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "1"})
+        s.sample()
+        _feed("a", n=30, computes=2)
+        _feed("b", n=10)
+        clock[0] += 2.0
+        s.sample()
+        rates = s.rates()
+        assert rates["window_seconds"] == 2.0
+        assert rates["tenants"]["a"]["updates_per_second"] == 15.0
+        assert rates["tenants"]["a"]["computes_per_second"] == 1.0
+        assert rates["tenants"]["b"]["updates_per_second"] == 5.0
+        assert rates["hosts"]["0"]["updates_per_second"] == 15.0
+        assert rates["hosts"]["1"]["updates_per_second"] == 5.0
+        assert rates["total"]["updates_per_second"] == 20.0
+
+    def test_fewer_than_two_samples_is_empty_not_an_error(self):
+        s, _, _ = _sampler()
+        assert s.rates() == {
+            "samples": 0,
+            "window_seconds": None,
+            "tenants": {},
+            "hosts": {},
+            "total": {},
+        }
+        s.sample()
+        assert s.rates()["window_seconds"] is None
+
+    def test_counter_reset_clamps_to_zero_not_negative_burn(self):
+        s, clock, _ = _sampler(placement={"a": "0"})
+        _feed("a", n=10)
+        s.sample()
+        # a restarted host: the registry resets and comes back lower
+        obs_scope.reset()
+        _feed("a", n=2)
+        clock[0] += 1.0
+        s.sample()
+        assert s.rates()["tenants"]["a"]["updates_per_second"] == 0.0
+
+    def test_window_smoothing_widens_the_delta_base(self):
+        s, clock, _ = _sampler(placement={"a": "0"})
+        s.sample()  # t=0, updates=0
+        _feed("a", n=40)
+        clock[0] = 1.0
+        s.sample()  # t=1, updates=40
+        clock[0] = 2.0
+        s.sample()  # t=2, a quiet tick: still 40
+        # adjacent samples read the quiet tick as a rate collapse...
+        assert s.rates()["tenants"]["a"]["updates_per_second"] == 0.0
+        # ...the windowed base reaches back to t=0 and smooths it out
+        smoothed = s.rates(window=2.5)
+        assert smoothed["window_seconds"] == 2.0
+        assert smoothed["tenants"]["a"]["updates_per_second"] == 20.0
+        # skew passes the window straight through
+        assert s.skew(window=2.5)["hot_host"] == "0"
+
+    def test_ring_is_bounded_drop_oldest_but_lifetime_count_is_not(self):
+        s, clock, _ = _sampler(ring=4)
+        for i in range(10):
+            clock[0] = float(i)
+            s.sample()
+        assert s.ring == 4
+        assert s.rates()["samples"] == 4
+        assert s.samples_taken == 10
+        assert s.history()[0]["mono"] == 6.0  # the oldest retained
+
+
+class TestSkew:
+    def test_shares_imbalance_and_ratio(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "1"})
+        s.sample()
+        _feed("a", n=30)
+        _feed("b", n=10)
+        clock[0] = 2.0
+        s.sample()
+        skew = s.skew()
+        assert skew["hosts"]["0"]["share"] == 0.75
+        assert skew["hosts"]["1"]["share"] == 0.25
+        assert skew["imbalance"] == 0.5  # (0.75 - 0.5) / (1 - 0.5)
+        assert skew["max_min_ratio"] == 3.0
+        assert skew["hot_host"] == "0" and skew["cold_host"] == "1"
+
+    def test_idle_cold_host_has_unbounded_ratio_reported_as_none(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "1"})
+        s.sample()
+        _feed("a", n=30)
+        _feed("b", n=0)
+        clock[0] = 1.0
+        s.sample()
+        skew = s.skew()
+        assert skew["max_min_ratio"] is None
+        assert skew["imbalance"] == 1.0  # one host carries everything
+
+    def test_top_tenants_per_host_capped_at_top_k(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "0", "c": "0"}, top_k=2)
+        s.sample()
+        for tenant, n in (("a", 30), ("b", 20), ("c", 10)):
+            _feed(tenant, n=n)
+        clock[0] = 1.0
+        s.sample()
+        top = s.skew()["top_tenants"]["0"]
+        assert [row["tenant"] for row in top] == ["a", "b"]  # hottest first, K=2
+
+
+class TestRebalanceHints:
+    def test_hints_are_advisory_and_ranked_best_projection_first(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "0", "c": "1"})
+        s.sample()
+        for tenant, n in (("a", 30), ("b", 10), ("c", 0)):
+            _feed(tenant, n=n)
+        clock[0] = 1.0
+        s.sample()
+        hints = s.rebalance_hints()
+        assert hints["advisory"] is True and "nothing is executed" in hints["note"]
+        moves = hints["hints"]
+        assert [h["tenant"] for h in moves] == ["a", "b"]
+        assert all(h["from"] == "0" and h["to"] == "1" for h in moves)
+        assert moves[0]["projected_imbalance"] < s.skew()["imbalance"]
+
+    def test_counterproductive_whole_load_flip_is_not_advice(self):
+        # one tenant carries the whole hot host: moving it just flips hosts
+        s, clock, _ = _sampler(placement={"a": "0", "c": "1"})
+        s.sample()
+        _feed("a", n=30)
+        _feed("c", n=10)
+        clock[0] = 1.0
+        s.sample()
+        assert s.rebalance_hints()["hints"] == []
+
+
+# ----------------------------------------------------------- drivers & presets
+
+
+class TestDrivers:
+    def test_tick_honors_the_cadence(self):
+        s, clock, _ = _sampler(cadence_seconds=5.0)
+        assert s.tick() is not None  # empty ring: first tick always samples
+        clock[0] = 2.0
+        assert s.tick() is None  # cadence not elapsed
+        clock[0] = 6.0
+        assert s.tick() is not None
+        assert s.samples_taken == 2
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="cadence_seconds"):
+            fleet.FleetSampler(cadence_seconds=0)
+        with pytest.raises(ValueError, match="ring"):
+            fleet.FleetSampler(ring=1)
+
+    def test_install_returns_previous_for_restore(self):
+        s, _, _ = _sampler()
+        assert fleet.install_sampler(s) is None
+        assert fleet.get_sampler() is s
+        assert fleet.install_sampler(None) is s
+        assert fleet.get_sampler() is None
+
+    def test_imbalance_rule_preset_shape(self):
+        rule = fleet.imbalance_rule(above=0.6, for_seconds=3.0, severity="warn")
+        assert rule.name == "fleet_imbalance"
+        assert rule.series == "fleet.imbalance"
+        assert rule.above == 0.6
+        assert rule.for_seconds == 3.0
+        assert rule.severity == "warn"
+
+
+# --------------------------------------------------------------------- serving
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+
+
+@pytest.fixture()
+def server():
+    obs_server.stop()
+    srv = obs_server.IntrospectionServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+class TestFleetRoutes:
+    def _install_loaded_sampler(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "1"})
+        s.sample()
+        _feed("a", n=30)
+        _feed("b", n=10)
+        clock[0] = 2.0
+        s.sample()
+        fleet.install_sampler(s)
+        return s
+
+    def test_fleet_off_is_an_answer_not_a_404(self, server):
+        status, body = _get_json(server.url + "/fleet")
+        assert status == 200
+        assert body["enabled"] is False
+        assert "install_sampler" in body["error"]
+        status, body = _get_json(server.url + "/fleet/history")
+        assert status == 200
+        assert body["enabled"] is False and body["samples"] == []
+
+    def test_fleet_page_serves_rates_skew_and_hints(self, server):
+        self._install_loaded_sampler()
+        status, body = _get_json(server.url + "/fleet")
+        assert status == 200
+        assert body["enabled"] is True
+        assert body["sampler"]["samples"] == 2
+        assert body["tenants"]["a"]["updates_per_second"] == 15.0
+        assert body["skew"]["hot_host"] == "0"
+        assert body["rebalance"]["advisory"] is True
+
+    def test_fleet_tenant_filter_and_unknown_tenant_404(self, server):
+        self._install_loaded_sampler()
+        status, body = _get_json(server.url + "/fleet?tenant=a")
+        assert status == 200
+        assert set(body["tenants"]) == {"a"}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.url + "/fleet?tenant=nope")
+        assert err.value.code == 404
+
+    def test_fleet_history_window_and_bad_window_400(self, server):
+        s = self._install_loaded_sampler()
+        status, body = _get_json(server.url + "/fleet/history?window=600")
+        assert status == 200
+        assert body["n_samples"] == 2 and body["ring"] == s.ring
+        monos = [row["mono"] for row in body["samples"]]
+        assert monos == sorted(monos)  # oldest first: a plottable timeline
+        status, body = _get_json(server.url + "/fleet/history?window=1")
+        assert body["n_samples"] == 1  # only the newest is within 1s
+        for bad in ("0", "-3", "nan-ish"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get_json(server.url + f"/fleet/history?window={bad}")
+            assert err.value.code == 400
+
+    def test_metrics_scrape_ticks_the_installed_sampler(self, server):
+        s, _, _ = _sampler(cadence_seconds=3600.0)
+        fleet.install_sampler(s)
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+        assert s.samples_taken == 1  # empty ring: the scrape took the sample
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+        assert s.samples_taken == 1  # cadence not elapsed: the tick coalesced

@@ -444,7 +444,11 @@ class TestServeCLI:
         assert obs_server.get_server() is None  # stopped on exit
 
     def test_serve_main_demo_registers_metric(self):
+        from torchmetrics_tpu.obs import fleet as obs_fleet
         from torchmetrics_tpu.obs import serve
 
         rc = serve.main(["--port", "0", "--duration", "0", "--no-trace", "--demo"])
         assert rc == 0
+        # the demo's fleet sampler is scoped to the serve run: a leaked
+        # singleton would bleed fleet.* gauges into a library caller's process
+        assert obs_fleet.get_sampler() is None

@@ -878,6 +878,95 @@ class TestPrometheusExpositionAudit:
         finally:
             obs_scope.reset()
 
+    def test_fleet_families_survive_strict_parse(self):
+        """The fleet.* gauge families (obs/fleet.py) through the strict
+        parser: HELP on every family, type gauge, never `_total`, per-host
+        rows carry the host label and per-tenant rate rows the tenant label,
+        and the skew gauges carry the derived values (shares, imbalance,
+        max/min ratio) the imbalance alert rule consumes."""
+        from torchmetrics_tpu.obs import fleet as obs_fleet
+        from torchmetrics_tpu.obs import scope as obs_scope
+
+        obs_scope.reset()
+        try:
+            rec = trace.TraceRecorder()
+            clock = [100.0]
+            sampler = obs_fleet.FleetSampler(
+                cadence_seconds=1.0,
+                recorder=rec,
+                placement={"t-hot": "0", "t-cold": "1"},
+                clock=lambda: clock[0],
+                wall=lambda: 1.7e9 + clock[0],
+            )
+            sampler.sample()
+            with obs_scope.scope("t-hot"):
+                obs_scope.note_update(n=30)
+                obs_scope.note_compute()
+            with obs_scope.scope("t-cold"):
+                obs_scope.note_update(n=10)
+            clock[0] += 2.0
+            sampler.sample()
+            families, samples = _parse_exposition(export.prometheus_text(recorder=rec))
+            by_name = {}
+            for name, labels, value in samples:
+                by_name.setdefault(name, []).append((labels, value))
+            for family in (
+                "tm_tpu_fleet_hosts",
+                "tm_tpu_fleet_missing_hosts",
+                "tm_tpu_fleet_degraded",
+                "tm_tpu_fleet_samples",
+                "tm_tpu_fleet_degraded_samples",
+                "tm_tpu_fleet_sample_age_seconds",
+                "tm_tpu_fleet_imbalance",
+                "tm_tpu_fleet_host_ratio",
+                "tm_tpu_fleet_host_load_share",
+                "tm_tpu_fleet_host_updates_per_second",
+                "tm_tpu_fleet_updates_per_second",
+                "tm_tpu_fleet_computes_per_second",
+                "tm_tpu_fleet_flop_burn_per_second",
+                "tm_tpu_fleet_byte_burn_per_second",
+                "tm_tpu_fleet_checkpoint_bytes_per_second",
+            ):
+                assert families[family]["type"] == "gauge", family
+                assert families[family]["help"], family
+                assert not family.endswith("_total"), family
+                assert family in by_name, family
+            # per-host rows label by virtual host; shares derive 30:10 → 0.75/0.25
+            shares = {
+                labels["host"]: float(value)
+                for labels, value in by_name["tm_tpu_fleet_host_load_share"]
+            }
+            assert shares == {"0": 0.75, "1": 0.25}
+            assert float(by_name["tm_tpu_fleet_imbalance"][0][1]) == 0.5
+            assert float(by_name["tm_tpu_fleet_host_ratio"][0][1]) == 3.0
+            # the rate family carries both the untenanted total and tenant rows
+            rate_rows = {
+                labels.get("tenant", ""): float(value)
+                for labels, value in by_name["tm_tpu_fleet_updates_per_second"]
+            }
+            assert rate_rows[""] == 20.0  # 40 updates / 2s window
+            assert rate_rows["t-hot"] == 15.0 and rate_rows["t-cold"] == 5.0
+        finally:
+            obs_scope.reset()
+
+    def test_fleet_disabled_path_costs_nothing(self):
+        """obs/fleet.py imported but never installed/started: no singleton,
+        no fleet.* families in the exposition, and the ordinary render path
+        is unaffected — the disabled path must cost nothing."""
+        from torchmetrics_tpu.obs import fleet as obs_fleet
+        from torchmetrics_tpu.obs import scope as obs_scope
+
+        obs_scope.reset()
+        try:
+            assert obs_fleet.get_sampler() is None
+            rec = trace.TraceRecorder()
+            rec.inc("work.items", 1.0)
+            families, samples = _parse_exposition(export.prometheus_text(recorder=rec))
+            assert not any(name.startswith("tm_tpu_fleet_") for name in families)
+            assert "tm_tpu_work_items_total" in families
+        finally:
+            obs_scope.reset()
+
     def test_tenant_scoped_page_drops_other_tenants(self):
         from torchmetrics_tpu.obs import scope as obs_scope
 

@@ -85,4 +85,9 @@ def test_two_process_aggregate_battery(tmp_path):
         "worker_killed_without_drain_recovers": True,
         "lineage_flow_stitched_across_hosts": True,
         "hung_host_fenced_and_failed_over": True,
+        "fleet_rates_sum_across_hosts": True,
+        "fleet_skew_attributes_hot_host": True,
+        "fleet_degraded_sample_when_rank_wedges": True,
+        "sigstop_wedge_fenced_from_disk_stamp": True,
+        "sigcont_late_write_rejected_on_scan": True,
     }

@@ -548,6 +548,218 @@ def main() -> None:
         zombie_pipe.close()
     scope.reset()
 
+    # -- 13. fleet sampler over the REAL two-host world ------------------------
+    # (the fleet telemetry plane, 2-process-validated: both ranks run a
+    # FleetSampler whose sample() is a true collective — two samples bracket
+    # asymmetric per-tenant load, so the derived rates must SUM across hosts
+    # while per-host shares keep the attribution; then both ranks wedge the
+    # collective and each sampler must produce a LOUD degraded partial sample
+    # naming the missing peer, and recover on the next healthy gather.)
+    from torchmetrics_tpu.obs import fleet as fleet_mod
+
+    trace.enable()
+    sampler = fleet_mod.FleetSampler(cadence_seconds=0.01)
+    sampler.sample()  # the baseline both rates derive from
+    # asymmetric load between the samples: host 0 carries 30 updates/window
+    # (20 shared-tenant + 10 private), host 1 carries 10 (5 + 5)
+    with scope.scope("t-fleet-shared"):
+        scope.note_update(n=(20 if pid == 0 else 5))
+    with scope.scope(f"t-fleet-{pid}"):
+        scope.note_update(n=(10 if pid == 0 else 5))
+    loaded = sampler.sample()
+    assert loaded["n_hosts"] == 2 and loaded["degraded"] is False
+    rates = sampler.rates()
+    assert rates["window_seconds"] is not None and rates["window_seconds"] > 0
+    shared_row = rates["tenants"]["t-fleet-shared"]
+    assert shared_row["hosts"] == ["0", "1"]  # fed on both hosts
+    # the shared tenant's rate is the SUM of both hosts' contributions
+    window = rates["window_seconds"]
+    assert abs(shared_row["updates_per_second"] - 25.0 / window) < 1e-6
+    total = rates["total"]["updates_per_second"]
+    assert abs(total - 40.0 / window) < 1e-6
+    host_sum = sum(row["updates_per_second"] for row in rates["hosts"].values())
+    assert abs(total - host_sum) < 1e-6
+    results["fleet_rates_sum_across_hosts"] = True
+
+    skew = sampler.skew(rates)
+    assert skew["hot_host"] == "0" and skew["cold_host"] == "1"
+    assert abs(skew["hosts"]["0"]["share"] - 0.75) < 1e-6
+    assert abs(skew["hosts"]["1"]["share"] - 0.25) < 1e-6
+    assert abs(skew["imbalance"] - 0.5) < 1e-6  # (0.75 - 0.5) / (1 - 0.5)
+    assert abs(skew["max_min_ratio"] - 3.0) < 1e-6
+    results["fleet_skew_attributes_hot_host"] = True
+
+    # one rank wedging must degrade the sample LOUDLY, never stall: the fault
+    # raises before any real collective on both ranks, so each host's sampler
+    # returns its partial view naming the missing peer
+    with robust.sync_guard(timeout=0.5, retries=1):
+        with faults.inject_collective_fault(mode="hang", times=10):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                wedged = sampler.sample()
+    assert wedged["degraded"] is True and wedged["missing_hosts"] == [1 - pid]
+    page = sampler.current()
+    assert page["sampler"]["degraded"] is True
+    assert page["sampler"]["missing_hosts"] == [1 - pid]
+    # ...and the next healthy gather recovers the full fleet view
+    healthy_again = sampler.sample()
+    assert healthy_again["degraded"] is False and healthy_again["n_hosts"] == 2
+    results["fleet_degraded_sample_when_rank_wedges"] = True
+    scope.reset()
+
+    # -- 14. REAL SIGSTOP wedge: fenced from the on-disk stamp alone -----------
+    # (the hung host is genuinely STOPPED, not cooperatively idle: rank 0
+    # SIGSTOPs rank 1 mid-run — the kernel freezes it wherever it is — then
+    # proves the hang purely from the newest shared-disk bundle's lease stamp
+    # (scan_bundle_lease; no heartbeat, no RPC, the wedged process could not
+    # answer one), fences the epoch and fails the tenant over bit-identical.
+    # SIGCONT then wakes the zombie; its late bundle write LANDS on disk and
+    # the survivor's next recovery scan rejects it — counted, never selected.)
+    import signal
+    import time as time_mod
+
+    sig_dir = os.path.join(shared, "sigstop_stream")
+    sig_target_dir = os.path.join(shared, "sigstop_target_stream")
+    sig_oracle = os.path.join(shared, "sigstop_expected.json")
+    sig_go = os.path.join(shared, "sigstop_go.json")
+    sig_zombie = os.path.join(shared, "sigstop_zombie.json")
+    sig_rng = np.random.RandomState(23)
+    sig_batches = [
+        (
+            jnp.asarray(sig_rng.rand(16, 4).astype(np.float32)),
+            jnp.asarray(sig_rng.randint(0, 4, 16)),
+        )
+        for _ in range(10)
+    ]
+    sig_ttl = 0.6
+
+    def _wait_for(path: str, timeout: float = 60.0) -> None:
+        deadline = time_mod.time() + timeout
+        while not os.path.exists(path):
+            if time_mod.time() > deadline:
+                raise AssertionError(f"timed out waiting for {path}")
+            time_mod.sleep(0.02)
+
+    sig_zombie_pipe = None
+    if pid == 1:
+        control = mig_metric()
+        for p_, t_ in sig_batches:
+            control.update(p_, t_)
+        expected = np.asarray(control.compute())
+        sig_zombie_pipe = MetricPipeline(
+            mig_metric(),
+            PipelineConfig(
+                fuse=2,
+                tenant="t-sigstop",
+                lease_seconds=sig_ttl,
+                checkpoint=CheckpointPolicy(
+                    directory=sig_dir, every_batches=2, full_every=4, keep=8
+                ),
+            ),
+        )
+        for p_, t_ in sig_batches[:7]:
+            sig_zombie_pipe.feed(p_, t_)
+        tmp = sig_oracle + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(
+                {
+                    "dtype": str(expected.dtype),
+                    "hex": expected.tobytes().hex(),
+                    "epoch": sig_zombie_pipe.lineage_epoch,
+                    "os_pid": os.getpid(),
+                },
+                fh,
+            )
+        os.replace(tmp, sig_oracle)
+    # collective barrier: bundle stream + oracle + victim os pid on shared disk.
+    # Everything after this is FILE-synchronized — a frozen process cannot
+    # participate in a collective, so none may happen until both ranks resume.
+    aggregate()
+    if pid == 1:
+        # park in a plain poll loop; SIGSTOP freezes the process right here
+        # (or anywhere — that is the point), SIGCONT resumes the loop
+        _wait_for(sig_go)
+        sig_zombie_pipe.feed(*sig_batches[7])
+        late = sig_zombie_pipe.checkpoint_now()
+        assert late is not None and os.path.isdir(late), late
+        tmp = sig_zombie + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"bundle": os.path.basename(late)}, fh)
+        os.replace(tmp, sig_zombie)
+    if pid == 0:
+        with open(sig_oracle) as fh:
+            oracle = json.load(fh)
+        victim_pid = int(oracle["os_pid"])
+        os.kill(victim_pid, signal.SIGSTOP)
+        try:
+            # the kernel reports the victim truly stopped ('T'), not idling
+            deadline = time_mod.time() + 10.0
+            while True:
+                with open(f"/proc/{victim_pid}/stat") as fh:
+                    state = fh.read().rsplit(")", 1)[1].split()[0]
+                if state == "T":
+                    break
+                assert time_mod.time() < deadline, f"victim never stopped: {state}"
+                time_mod.sleep(0.02)
+            # prove the hang purely from the on-disk stamp: the newest
+            # bundle's lease expires unrenewed while its writer is frozen
+            deadline = time_mod.time() + 30.0
+            while True:
+                stamp = robust_fence.scan_bundle_lease(sig_dir)
+                assert stamp is not None, os.listdir(sig_dir)
+                if robust_fence.lease_expired(stamp, now=time_mod.time()):
+                    break
+                assert time_mod.time() < deadline, f"lease never expired: {stamp}"
+                time_mod.sleep(0.05)
+            assert stamp["epoch"] == oracle["epoch"]
+            pipe2, report = robust_fence.failover(
+                mig_metric(),
+                sig_dir,
+                tenant="t-sigstop",
+                checkpoint=CheckpointPolicy(
+                    directory=sig_target_dir, every_batches=2, full_every=4, keep=8
+                ),
+            )
+            assert report["fenced_epoch"] == oracle["epoch"]
+            assert report["new_epoch"] != report["fenced_epoch"]
+            cursor = report["restored_cursor"]
+            assert cursor == 6, report
+            for p_, t_ in sig_batches[cursor:]:
+                pipe2.feed(p_, t_)
+            survivor_metric = pipe2.metric
+            pipe2.close()
+            got = np.asarray(survivor_metric.compute())
+            assert str(got.dtype) == oracle["dtype"]
+            assert got.tobytes().hex() == oracle["hex"], (got.tolist(), oracle)
+        finally:
+            # always thaw the peer — a frozen rank 1 would hang the launcher
+            os.kill(victim_pid, signal.SIGCONT)
+        # wake the zombie: it writes its late bundle AFTER the fence landed
+        tmp = sig_go + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"fenced_epoch": report["fenced_epoch"]}, fh)
+        os.replace(tmp, sig_go)
+        _wait_for(sig_zombie)
+        import torchmetrics_tpu.obs.scope as scope_mod
+
+        with open(sig_zombie) as fh:
+            zombie_name = json.load(fh)["bundle"]
+        before = scope_mod.fenced_rejected_count()
+        selected = latest_valid_bundle(sig_dir)
+        assert selected is not None
+        assert os.path.basename(selected) != zombie_name, selected
+        assert scope_mod.fenced_rejected_count() >= before + 1
+        with pytest_like_raises(engine_migrate.FencedBundleError):
+            verify_bundle(os.path.join(sig_dir, zombie_name))
+    # collective barrier: both ranks are live again (the zombie wrote, the
+    # survivor scanned); resynchronize before the battery's shared epilogue
+    aggregate()
+    if pid == 1 and sig_zombie_pipe is not None:
+        sig_zombie_pipe.close()
+    results["sigstop_wedge_fenced_from_disk_stamp"] = True
+    results["sigcont_late_write_rejected_on_scan"] = True
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

@@ -31,6 +31,7 @@ from torchmetrics_tpu.chaos.schedule import (
     high_tenant_config,
     load,
     loads,
+    skewed_load_config,
 )
 from torchmetrics_tpu.chaos.replay import ReplayConfig, ReplayError, replay
 from torchmetrics_tpu.chaos.slo import (
@@ -41,6 +42,7 @@ from torchmetrics_tpu.chaos.slo import (
     hung_host_slo_spec,
     judge,
     rolling_deploy_slo_spec,
+    skewed_load_slo_spec,
 )
 
 __all__ = [
@@ -62,4 +64,6 @@ __all__ = [
     "loads",
     "replay",
     "rolling_deploy_slo_spec",
+    "skewed_load_config",
+    "skewed_load_slo_spec",
 ]

@@ -123,6 +123,28 @@ class ReplayConfig:
             selects it. Shadow controls prove end-of-run bit-identity — zero
             double-counting between zombie and successor. Incompatible with
             ``multiplex``, ``rolling_deploy`` and ``host_crash``.
+        skewed_load: simulate a fleet with **heavily skewed per-host load** —
+            the fleet-telemetry-plane scenario. A static placement maps every
+            tenant but one onto virtual host ``"0"`` (the hot host) and the
+            last tenant onto host ``"1"``; a
+            :class:`~torchmetrics_tpu.obs.fleet.FleetSampler` with that
+            placement is installed so the background scraper's ``/metrics``
+            pulls drive continuous fleet sampling, rate derivation and the
+            ``fleet.imbalance`` gauge, and the declarative
+            :func:`~torchmetrics_tpu.obs.fleet.imbalance_rule` must fire
+            through the standard pending→firing machinery — detection comes
+            from fleet samples alone, nothing is told where the skew is. At
+            two-thirds of the schedule the **hot spot shifts** (the placement
+            flips hosts — the load concentration moves), which the sampler
+            must track without stranding a stale firing series; right after
+            the shift one sample is taken under the hanging-collective fake,
+            proving a wedged host yields a LOUD degraded partial sample
+            (``missing_hosts``) instead of stalling the sampler. ``/fleet``
+            is scraped throughout and probed at end of run. Incompatible with
+            ``multiplex``, ``rolling_deploy``, ``host_crash`` and
+            ``hung_host``.
+        fleet_cadence_seconds: the fleet sampler's cadence (short, so a CI
+            run accumulates enough samples; production cadences are seconds).
         lease_seconds: the hung-host tenants' lease TTL (short, so detection
             fits a CI run; production leases are tens of seconds).
         scrape_interval_seconds: pause between scrape sweeps of the routes.
@@ -144,6 +166,8 @@ class ReplayConfig:
     checkpoint_every_batches: int = 4
     checkpoint_dir: Optional[str] = None
     hung_host: bool = False
+    skewed_load: bool = False
+    fleet_cadence_seconds: float = 0.1
     lease_seconds: float = 0.25
     scrape_interval_seconds: float = 0.05
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants", "/healthz")
@@ -173,6 +197,18 @@ class ReplayConfig:
                 "`hung_host` drives per-tenant leased pipeline sessions with"
                 " continuous checkpointing; it cannot be combined with"
                 " `multiplex`, `rolling_deploy` or `host_crash`"
+            )
+        if self.skewed_load and (
+            self.multiplex or self.rolling_deploy or self.host_crash or self.hung_host
+        ):
+            raise ValueError(
+                "`skewed_load` drives default per-tenant pipeline sessions under a"
+                " fleet sampler; it cannot be combined with `multiplex`,"
+                " `rolling_deploy`, `host_crash` or `hung_host`"
+            )
+        if self.fleet_cadence_seconds <= 0:
+            raise ValueError(
+                f"Expected positive `fleet_cadence_seconds`, got {self.fleet_cadence_seconds}"
             )
         if self.lease_seconds <= 0:
             raise ValueError(f"Expected positive `lease_seconds`, got {self.lease_seconds}")
@@ -210,6 +246,7 @@ class ReplayConfig:
 # rule names are part of the replay's contract with the SLO judge
 POISON_RULE = "chaos_poison_nonfinite"
 HANG_RULE = "chaos_hang_absent"
+IMBALANCE_RULE = "fleet_imbalance"  # minted by obs.fleet.imbalance_rule()
 
 
 class _Scraper(threading.Thread):
@@ -432,6 +469,7 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     import numpy as np
 
     from torchmetrics_tpu.obs import cost as _cost
+    from torchmetrics_tpu.obs import fleet as _fleet_mod
     from torchmetrics_tpu.obs import values as _values
     from torchmetrics_tpu.parallel import sync as _sync_mod
     from torchmetrics_tpu.robust import faults as _faults
@@ -484,18 +522,28 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             )
         ckpt_dir = config.checkpoint_dir or tempfile.mkdtemp(prefix="tm_tpu_ckpt_")
 
-    engine = AlertEngine(
-        rules=[
-            AlertRule(
-                name=POISON_RULE,
-                kind="non_finite",
-                metric="MeanSquaredError",
-                tenant=schedule.victim,
-                severity="critical",
+    rules = [
+        AlertRule(
+            name=POISON_RULE,
+            kind="non_finite",
+            metric="MeanSquaredError",
+            tenant=schedule.victim,
+            severity="critical",
+        )
+    ]
+    if config.skewed_load:
+        # the declarative preset, armed BEFORE any load lands: detection must
+        # come from the fleet samples alone through the standard pending→
+        # firing machinery (dwell = 2 cadences, so one noisy sample never
+        # pages). The rule name is obs.fleet's IMBALANCE_RULE contract.
+        rules.append(
+            _fleet_mod.imbalance_rule(
+                above=0.5,
+                for_seconds=2 * config.fleet_cadence_seconds,
+                severity="page",
             )
-        ],
-        history=config.alert_history,
-    )
+        )
+    engine = AlertEngine(rules=rules, history=config.alert_history)
     metrics, pipelines, mux, guarded_metric, crash_metric = _build_tenants(
         schedule,
         config,
@@ -590,6 +638,29 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     crash_at = len(schedule.events) // 2 if crash_tenants else None
     fence_info: Optional[Dict[str, Any]] = None
     wedge_at = len(schedule.events) // 2 if fence_tenants else None
+    # skewed load: a static placement concentrates every tenant but the last
+    # onto virtual host "0"; the installed sampler's ticks ride the scraper's
+    # /metrics pulls, so detection cadence IS the serving path's cadence. The
+    # hot spot shifts (placement flips) two-thirds in — late enough that the
+    # pre-shift skew had time to page, early enough to observe the re-point.
+    fleet_info: Optional[Dict[str, Any]] = None
+    fleet_sampler: Optional[Any] = None
+    fleet_placement: Dict[str, str] = {}
+    fleet_shift: Optional[Dict[str, Any]] = None
+    fleet_shift_at: Optional[int] = None
+    fleet_probe: Optional[Dict[str, Any]] = None
+    fleet_history_n: Optional[int] = None
+    if config.skewed_load:
+        cold = set(schedule.tenants[-1:])
+        fleet_placement = {
+            tenant: ("1" if tenant in cold else "0") for tenant in schedule.tenants
+        }
+        fleet_sampler = _fleet_mod.FleetSampler(
+            cadence_seconds=config.fleet_cadence_seconds,
+            placement=dict(fleet_placement),
+        )
+        _fleet_mod.install_sampler(fleet_sampler)
+        fleet_shift_at = (len(schedule.events) * 2) // 3
     # zombie sessions after the wedge (still live objects — a hung host is not
     # a dead one) and the failovers the scrape-driven watchdog completes
     # (appended from the scraper thread; list.append is atomic)
@@ -885,11 +956,60 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             "bundles": len(migrate_tenants),
         }
 
+    def shift_hot_spot() -> Dict[str, Any]:
+        """Mid-run hot-spot shift + wedged-gather probe (``skewed_load`` only).
+
+        The load concentration MOVES: every placement host label flips, so
+        the tenants that made host "0" hot now make host "1" hot. Nothing
+        tells the alert plane — ``fleet.imbalance`` is deliberately one
+        unlabeled series, so the already-firing page must follow the new hot
+        host (named live by ``/healthz`` from the skew block) instead of
+        stranding a stale per-host labelset. Immediately after the flip one
+        sample is forced under the hanging-collective fake — a claimed
+        2-host world (the ``_host_meta`` seam) whose allgather hangs — and
+        must come back as a LOUD degraded partial sample naming the missing
+        peer within the sync guard's budget, never a stalled sampler.
+        """
+        # hot-host verdicts smooth over ~10 cadences: adjacent-sample rates
+        # are twitchy (one quiet tick can momentarily crown the cold host)
+        before = fleet_sampler.skew(
+            window=10 * config.fleet_cadence_seconds
+        ).get("hot_host")
+        fleet_sampler.placement = {
+            tenant: ("0" if host == "1" else "1")
+            for tenant, host in fleet_sampler.placement.items()
+        }
+        shifted_at = time.time()
+        wedge_started = time.perf_counter()
+        with mock.patch.object(
+            _trace,
+            "_host_meta",
+            lambda: {"process_index": 0, "process_count": 2, "host_id": "chaos-host-a:0"},
+        ):
+            with mock.patch.object(_sync_mod, "distributed_available", lambda: True):
+                with sync_guard(timeout=config.sync_timeout_seconds, retries=0):
+                    with _faults.inject_collective_fault(mode="hang", times=99):
+                        degraded = fleet_sampler.sample()
+        return {
+            "hot_host_before": before,
+            "shifted_at": shifted_at,
+            "wedged_sample": {
+                "degraded": bool(degraded.get("degraded")),
+                "missing_hosts": list(degraded.get("missing_hosts") or []),
+                "sample_seconds": round(time.perf_counter() - wedge_started, 6),
+            },
+        }
+
     try:
         with _trace.observe(max_events=config.max_events):
             server.start()
+            scrape_routes = tuple(config.scrape_routes)
+            if config.skewed_load and "/fleet" not in scrape_routes:
+                # the control-plane read API is scraped throughout: /fleet
+                # latency rides the same per-route SLO stats as /metrics
+                scrape_routes += ("/fleet",)
             scraper = _Scraper(
-                server.url, config.scrape_routes, config.scrape_interval_seconds
+                server.url, scrape_routes, config.scrape_interval_seconds
             )
             scraper.start()
             wall_start, perf_start = time.time(), time.perf_counter()
@@ -907,6 +1027,9 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                     if wedge_at is not None and ev_index >= wedge_at:
                         fence_info = wedge_host_b()
                         wedge_at = None  # one hang per run
+                    if fleet_shift_at is not None and ev_index >= fleet_shift_at:
+                        fleet_shift = shift_hot_spot()
+                        fleet_shift_at = None  # one shift per run
                     kind = ev["kind"]
                     if kind == "batch":
                         tenant = ev["tenant"]
@@ -1151,6 +1274,26 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                     fence_info["zero_double_count"] = all(
                         row["bit_identical"] for row in fence_rows.values()
                     )
+                if fleet_sampler is not None:
+                    # one final forced sample (the scrape loop may have just
+                    # gone idle), then the end-of-run control-plane probes:
+                    # the /fleet payload an operator would actually read, and
+                    # the bounded-history depth — both over real HTTP
+                    fleet_sampler.sample()
+                    try:
+                        with urllib.request.urlopen(
+                            server.url + "/fleet", timeout=10
+                        ) as resp:
+                            fleet_probe = json.loads(resp.read())
+                    except Exception:
+                        fleet_probe = None  # visibility is judged; a missed probe fails the SLO
+                    try:
+                        with urllib.request.urlopen(
+                            server.url + "/fleet/history?window=600", timeout=10
+                        ) as resp:
+                            fleet_history_n = json.loads(resp.read()).get("n_samples")
+                    except Exception:
+                        fleet_history_n = None
             elapsed = time.perf_counter() - perf_start
             scraper.stop()
             driver_scrapes = scraper.summary()
@@ -1169,6 +1312,9 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             from torchmetrics_tpu.robust import fence as _fence_mod
 
             _fence_mod.install_watchdog(None)
+        if config.skewed_load:
+            # the installed sampler is process-global too: leave none behind
+            _fleet_mod.install_sampler(None)
         if scraper is not None:
             scraper.stop()
         server.stop()
@@ -1217,6 +1363,47 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     # outcome + a dump naming the id; the victim: the value watchdog its
     # commit fired, or an episode already covering its ingest)?
     episodes = engine.fire_resolve_times()
+    if fleet_sampler is not None:
+        # skew detection verdict: time from skew onset (the first batch — the
+        # static placement concentrates load from the very start) to the
+        # imbalance page's fired_at, measured off the standard episode stream
+        fired = [
+            ep["fired_at"]
+            for ep in episodes
+            if ep.get("rule") == IMBALANCE_RULE and ep.get("fired_at") is not None
+        ]
+        first_fired = min(fired) if fired else None
+        final_skew = fleet_sampler.skew(window=10 * config.fleet_cadence_seconds)
+        hot_after = final_skew.get("hot_host")
+        fleet_info = {
+            "cadence_seconds": config.fleet_cadence_seconds,
+            "placement": fleet_placement,
+            "samples": fleet_sampler.samples_taken,
+            "degraded_samples": fleet_sampler.degraded_samples,
+            "history_samples": fleet_history_n,
+            "alert_fired": first_fired is not None,
+            "time_to_detect_imbalance_seconds": (
+                round(max(0.0, first_fired - wall_start), 6)
+                if first_fired is not None
+                else None
+            ),
+            "imbalance": final_skew.get("imbalance"),
+            "hot_host": hot_after,
+            "shift": (
+                dict(
+                    fleet_shift,
+                    hot_host_after=hot_after,
+                    hot_host_shifted=bool(
+                        fleet_shift.get("hot_host_before") is not None
+                        and hot_after is not None
+                        and fleet_shift["hot_host_before"] != hot_after
+                    ),
+                )
+                if fleet_shift is not None
+                else None
+            ),
+            "probe": fleet_probe,
+        }
     causality_rows: List[Dict[str, Any]] = []
     for poisoned_tenant, poisoned_indices in schedule.poisoned().items():
         for poisoned_index in poisoned_indices:
@@ -1338,6 +1525,11 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         # driven watchdog, the zombie's rejected late bundle write, operator
         # visibility probes, and the zero-double-counting verdicts vs controls
         "fence": fence_info,
+        # fleet-telemetry accounting (None unless ReplayConfig.skewed_load):
+        # sample/degraded counts, time-to-detect for the imbalance page, the
+        # mid-run hot-spot shift + wedged-gather evidence, and the HTTP-probed
+        # /fleet payload an operator would read
+        "fleet": fleet_info,
         "health": health,
         "tenants": tenants_page,
         "pipelines": reports,

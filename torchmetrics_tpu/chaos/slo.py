@@ -58,6 +58,7 @@ __all__ = [
     "hung_host_slo_spec",
     "judge",
     "rolling_deploy_slo_spec",
+    "skewed_load_slo_spec",
 ]
 
 
@@ -120,6 +121,18 @@ class SLOSpec:
     require_zombie_writes_rejected: bool = False
     require_fence_zero_double_count: bool = False
     require_fence_visible: bool = False
+    # fleet-telemetry promises (the skewed-load scenario): the imbalance page
+    # must fire from fleet samples alone (the declarative imbalance_rule over
+    # the fleet.imbalance gauge — nothing is told where the skew is) inside
+    # the detection budget; /fleet must serve the per-tenant rate table, the
+    # skew block and ranked advisory rebalance hints derived from >= 2 real
+    # samples; the mid-run hot-spot shift must re-point the hot host; and a
+    # wedged gather must yield a LOUD degraded partial sample (missing hosts
+    # named), never a stalled sampler
+    max_time_to_detect_imbalance_seconds: Optional[float] = None
+    require_fleet_served: bool = False
+    require_fleet_shift_tracked: bool = False
+    require_fleet_degraded_loud: bool = False
     # routes whose scrape latency is judged (the driver may scrape more)
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants")
 
@@ -236,6 +249,41 @@ def hung_host_slo_spec() -> SLOSpec:
         require_zombie_writes_rejected=True,
         require_fence_zero_double_count=True,
         require_fence_visible=True,
+    )
+
+
+def skewed_load_slo_spec() -> SLOSpec:
+    """The SLO spec of the skewed-load scenario
+    (:func:`~torchmetrics_tpu.chaos.schedule.skewed_load_config` replayed with
+    ``ReplayConfig.skewed_load=True``): a static placement concentrates every
+    tenant but one onto one virtual host, and the fleet telemetry plane —
+    continuous sampling, rate derivation, skew signals, the ``/fleet`` read
+    API — must *notice*.
+
+    The promises: the ``fleet_imbalance`` page fires from fleet samples alone
+    (the declarative :func:`~torchmetrics_tpu.obs.fleet.imbalance_rule` over
+    the derived ``fleet.imbalance`` gauge, through the standard pending→firing
+    machinery) within the detection budget; ``/fleet`` serves the per-tenant
+    rate table, the skew block and ranked advisory rebalance hints from ≥ 2
+    real samples, and its scrape latency holds the same p95/p99 bounds as
+    ``/metrics``; the mid-run hot-spot shift re-points the hot host (the
+    unlabeled-series design: the firing page follows the load, no stale
+    labelset strands); one gather taken under a wedged 2-host fake degrades
+    LOUDLY — partial sample, missing host named — instead of stalling the
+    sampler; and the ordinary fault SLOs keep holding through it all, because
+    skew detection that only works in a sterile run is not detection.
+    Detection wall is sample-cadence + dwell + scrape-jitter dominated, so
+    (like the fencing walls) the recorded spread makes the absolute budget
+    the regression sentinel's cap.
+    """
+    return SLOSpec(
+        min_updates_per_second=5.0,
+        require_poisoned_named=True,
+        max_time_to_detect_imbalance_seconds=10.0,
+        require_fleet_served=True,
+        require_fleet_shift_tracked=True,
+        require_fleet_degraded_loud=True,
+        scrape_routes=("/metrics", "/alerts", "/tenants", "/fleet"),
     )
 
 
@@ -968,6 +1016,121 @@ def judge(
                     f"{fence.get('healthz_named_fenced')!r},"
                     f" leases_page_fences={fence.get('leases_page_fences')!r}"
                 )
+            ),
+        )
+
+    # ------------------------------------------------ fleet telemetry plane
+    fleet = result.get("fleet") or {}
+    if spec.max_time_to_detect_imbalance_seconds is not None:
+        seconds = fleet.get("time_to_detect_imbalance_seconds")
+        _row(
+            rows,
+            "time_to_detect_imbalance_seconds",
+            seconds,
+            spec.max_time_to_detect_imbalance_seconds,
+            "s",
+            "max",
+            detail=(
+                "skew onset (first batch under the hot placement) to the"
+                " fleet_imbalance page's fired_at, derived from"
+                f" {fleet.get('samples')} fleet sample(s) at"
+                f" {fleet.get('cadence_seconds')}s cadence — the rule read"
+                " only the fleet.imbalance gauge"
+                if fleet
+                else "replay result carries no fleet accounting"
+            ),
+        )
+        # the page lands wherever dwell + the next sample + the next scrape
+        # tick fall: any wall inside the budget is cadence + scheduler
+        # jitter, not a regression — the recorded spread makes the absolute
+        # budget the regression sentinel's cap
+        config(
+            f"{prefix}_time_to_detect_imbalance_seconds",
+            seconds,
+            "s",
+            spec.max_time_to_detect_imbalance_seconds,
+            spread={
+                "min": 0.0,
+                "max": spec.max_time_to_detect_imbalance_seconds,
+                "reps": 1,
+            },
+        )
+    if spec.require_fleet_served:
+        probe = fleet.get("probe") or {}
+        n_samples = int(((probe.get("sampler") or {}).get("samples")) or 0)
+        has_rates = bool(
+            any(
+                (row or {}).get("updates_per_second") is not None
+                for row in (probe.get("tenants") or {}).values()
+            )
+        )
+        skew_block = probe.get("skew") or {}
+        has_skew = skew_block.get("imbalance") is not None and bool(skew_block.get("hosts"))
+        rebalance = probe.get("rebalance") or {}
+        has_hints = bool(rebalance.get("advisory")) and "hints" in rebalance
+        ok = bool(probe.get("enabled")) and n_samples >= 2 and has_rates and has_skew and has_hints
+        _row(
+            rows,
+            "fleet_served",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"GET /fleet served the per-tenant rate table, the skew block"
+                f" and {len(rebalance.get('hints') or [])} advisory rebalance"
+                f" hint(s) from {n_samples} real samples"
+                f" ({fleet.get('history_samples')} in /fleet/history)"
+                if ok
+                else (
+                    "the /fleet probe did not serve a full report:"
+                    f" enabled={probe.get('enabled')!r} samples={n_samples}"
+                    f" rates={has_rates} skew={has_skew} hints={has_hints}"
+                )
+            ),
+        )
+        config(f"{prefix}_fleet_samples", float(fleet.get("samples") or 0), "samples", None)
+    if spec.require_fleet_shift_tracked:
+        shift = fleet.get("shift") or {}
+        ok = bool(shift.get("hot_host_shifted")) and bool(fleet.get("alert_fired"))
+        _row(
+            rows,
+            "fleet_shift_tracked",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                "the mid-run placement flip re-pointed the hot host"
+                f" ({shift.get('hot_host_before')!r} →"
+                f" {shift.get('hot_host_after')!r}) while the imbalance page"
+                " stayed on the single unlabeled fleet.imbalance series"
+                if ok
+                else (
+                    "hot-spot shift was not tracked:"
+                    f" before={shift.get('hot_host_before')!r}"
+                    f" after={shift.get('hot_host_after')!r}"
+                    f" alert_fired={fleet.get('alert_fired')!r}"
+                )
+            ),
+        )
+    if spec.require_fleet_degraded_loud:
+        wedged = (fleet.get("shift") or {}).get("wedged_sample") or {}
+        ok = bool(wedged.get("degraded")) and bool(wedged.get("missing_hosts"))
+        _row(
+            rows,
+            "fleet_degraded_loud",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                "the gather under a wedged 2-host fake degraded loudly in"
+                f" {wedged.get('sample_seconds')}s — partial sample, hosts"
+                f" {wedged.get('missing_hosts')} named missing — instead of"
+                " stalling the sampler"
+                if ok
+                else f"no loud degraded sample recorded: {wedged or 'no wedged-sample evidence'}"
             ),
         )
 

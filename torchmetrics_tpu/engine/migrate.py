@@ -1315,7 +1315,7 @@ def compact_chain(path: str, out_path: str) -> Dict[str, Any]:
     return new_manifest
 
 
-def sweep_bundles(directory: str, keep: int) -> List[str]:
+def sweep_bundles(directory: str, keep: int, gc_fenced: bool = True) -> List[str]:
     """Retention sweep: keep the newest ``keep`` bundles **plus every chain
     link they depend on**; remove the rest. Returns removed bundle paths.
 
@@ -1324,6 +1324,15 @@ def sweep_bundles(directory: str, keep: int) -> List[str]:
     through. Directories whose manifest cannot be read are left alone (they
     may be a concurrent writer's mid-install state; ``latest_valid_bundle``
     skips them loudly either way).
+
+    ``gc_fenced`` adds the zombie-GC mode: a bundle whose epoch is fenced AND
+    whose name is not in the fence-time ``known`` snapshot is a zombie host's
+    post-fence write — every recovery scan already rejects it
+    (:class:`FencedBundleError`), so retention garbage-collects it regardless
+    of recency instead of letting rejected garbage crowd the ``keep`` window.
+    Zombies never count toward the kept window, and a kept live chain's base
+    closure is never touched even if a link looks fenced. Each zombie GC'd is
+    counted into the ``fence.bundles_swept`` gauge.
     """
     if keep < 1:
         raise ValueError(f"Expected `keep` >= 1, got {keep}")
@@ -1342,11 +1351,24 @@ def sweep_bundles(directory: str, keep: int) -> List[str]:
             continue
         if isinstance(manifest, dict) and manifest.get("kind") == _BUNDLE_KIND:
             manifests[name] = manifest
+    zombies: set = set()
+    if gc_fenced:
+        fences = fenced_epochs(directory)
+        if fences:
+            for name, manifest in manifests.items():
+                epoch = _bundle_epoch(manifest)
+                record = fences.get(epoch) if epoch else None
+                if record is not None and name not in (record.get("known") or ()):
+                    zombies.add(name)
     ordered = sorted(
         manifests, key=lambda name: (float(manifests[name].get("ts_unix") or 0.0), name)
     )
-    kept = set(ordered[-keep:])
-    # close over chain dependencies: a kept delta keeps its whole base chain
+    # zombies are unrestorable garbage: they must not occupy the keep window
+    # (a wedged host's late writes would otherwise evict the real stream)
+    live_ordered = [name for name in ordered if name not in zombies]
+    kept = set(live_ordered[-keep:])
+    # close over chain dependencies: a kept delta keeps its whole base chain —
+    # even through a link the fence ledger flags, the live chain wins
     frontier = list(kept)
     while frontier:
         name = frontier.pop()
@@ -1356,12 +1378,21 @@ def sweep_bundles(directory: str, keep: int) -> List[str]:
             kept.add(base_name)
             frontier.append(base_name)
     removed = []
+    swept_zombies = 0
     for name in ordered:
         if name in kept:
             continue
         full = os.path.join(directory, name)
         shutil.rmtree(full, ignore_errors=True)
         removed.append(full)
+        if name in zombies:
+            swept_zombies += 1
+    if swept_zombies:
+        _scope.note_fenced_bundle_swept(swept_zombies)
+        if _trace.ENABLED:
+            _trace.event(
+                "engine.fence_sweep", directory=directory, swept=swept_zombies
+            )
     return removed
 
 
@@ -1492,7 +1523,12 @@ class ContinuousCheckpointer:
             _trace.set_gauge("checkpoint.bundle_bytes", float(nbytes), pipeline=self.label, kind=kind)
             _trace.set_gauge("checkpoint.write_seconds", float(seconds), pipeline=self.label)
         try:
-            sweep_bundles(policy.directory, policy.keep)
+            # the writer's own cadence sweep is recency-only: a fenced writer
+            # GC'ing its own just-landed bundle would erase the zombie-write
+            # evidence recovery scans reject and count. Zombie GC belongs to
+            # explicit sweeps — the survivor's failover cleanup, an operator's
+            # retention pass — where gc_fenced defaults on.
+            sweep_bundles(policy.directory, policy.keep, gc_fenced=False)
         except Exception:  # retention must never cost the stream
             pass
         return path

@@ -39,7 +39,19 @@ import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import alerts as _alerts
 
-__all__ = ["aggregate", "host_snapshot", "merge_snapshots", "summarize"]
+__all__ = [
+    "FLEET_SAMPLE_SCHEMA",
+    "aggregate",
+    "fleet_sample",
+    "gather_snapshots",
+    "host_snapshot",
+    "merge_snapshots",
+    "summarize",
+]
+
+# version stamp of the compact per-tick sample shape :func:`fleet_sample`
+# extracts from a merged aggregate (obs/fleet.py retains a ring of these)
+FLEET_SAMPLE_SCHEMA = 1
 
 # firing beats pending: a fleet row's state is the worst any host reports
 _ALERT_STATE_RANK = {"pending": 1, "firing": 2}
@@ -76,6 +88,19 @@ def host_snapshot(
     # merge can say "tenant acme is active on hosts 0 and 3" — and a degraded
     # partial aggregate keeps the surviving hosts' tenant attribution
     snap["tenants"] = _scope.get_registry().rows() if _scope.ENABLED else []
+    # control-plane liveness rides too (read-only copies): checkpoint
+    # freshness, leases and fences per host, so a fleet merge can join "who
+    # holds what, how stale" without a second collective (the /fleet per-host
+    # row join). Empty dicts when tenancy never engaged — one branch.
+    snap["scope_status"] = (
+        {
+            "checkpoints": _scope.checkpoint_status(),
+            "leases": _scope.lease_status(),
+            "fences": _scope.fence_status(),
+        }
+        if _scope.ENABLED
+        else {"checkpoints": {}, "leases": {}, "fences": {}}
+    )
     snap["n_events"] = len(snap["events"])
     # distinguishes "events were shipped (possibly zero)" from "events were
     # stripped for the cheap wire shape" — the merge keys host_snapshots (and
@@ -136,6 +161,10 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
             # build identity per host: a mixed-version fleet is visible in the
             # aggregate even before the schema gate would exclude anyone
             host_row["build_info"] = snap["build_info"]
+        if snap.get("scope_status"):
+            # the control-plane join: per-host checkpoint/lease/fence liveness
+            # stays attributed to the host that reported it
+            host_row["scope_status"] = snap["scope_status"]
         hosts.append(host_row)
         dropped_events += int(snap.get("dropped_events", 0))
         events_recorded += int(snap.get("n_events", len(snap.get("events", ()))))
@@ -290,27 +319,34 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
-def aggregate(
+def gather_snapshots(
     recorder: Optional[trace.TraceRecorder] = None,
     include_events: bool = False,
     description: str = "obs aggregate",
 ) -> Dict[str, Any]:
-    """Fleet-level aggregate of every host's telemetry (the distributed entry).
+    """Gather every host's snapshot over the guarded collective (the seam).
 
-    Single-process worlds merge the local snapshot with no collective. In a
-    multi-host world, each host JSON-encodes its snapshot and all snapshots
-    cross over the guarded eager collective path; with a ``robust.sync_guard``
-    configured, a hung or failing host turns into a **partial** aggregate —
-    ``aggregate_degraded=True``, a loud ``RuntimeWarning``, the unreachable
-    ranks listed in ``missing_hosts`` — rather than a hung job. Pass
-    ``include_events=True`` to also ship the span ring buffers (needed for the
-    cross-host Perfetto export; costs world-size × ring-buffer bytes).
+    The single gather-with-degrade step :func:`aggregate` and the fleet
+    sampler (:mod:`~torchmetrics_tpu.obs.fleet`) share. Returns::
+
+        {"snapshots": [...], "missing_hosts": [...],
+         "degraded_error": None | str, "corrupt_hosts": [...]}
+
+    Single-process worlds return the local snapshot with no collective. In a
+    multi-host world a hung or failing peer degrades to the local snapshot
+    plus ``missing_hosts`` and a loud ``RuntimeWarning`` — never a stall —
+    and a peer whose payload cannot be decoded lands in ``corrupt_hosts``.
     """
     local = host_snapshot(recorder, include_events=include_events)
     from torchmetrics_tpu.parallel import sync as sync_mod
 
     if not sync_mod.distributed_available():
-        return merge_snapshots([local])
+        return {
+            "snapshots": [local],
+            "missing_hosts": [],
+            "degraded_error": None,
+            "corrupt_hosts": [],
+        }
 
     from torchmetrics_tpu.robust.degraded import CollectiveError
 
@@ -327,14 +363,15 @@ def aggregate(
             RuntimeWarning,
             stacklevel=2,
         )
-        out = merge_snapshots([local])
-        out["aggregate_degraded"] = True
-        out["degraded_error"] = str(err)
         mine = local["host"]["process_index"]
-        out["missing_hosts"] = [
-            index for index in range(local["host"]["process_count"]) if index != mine
-        ]
-        return out
+        return {
+            "snapshots": [local],
+            "missing_hosts": [
+                index for index in range(local["host"]["process_count"]) if index != mine
+            ],
+            "degraded_error": str(err),
+            "corrupt_hosts": [],
+        }
 
     snaps: List[Dict[str, Any]] = []
     corrupt: List[int] = []
@@ -343,7 +380,38 @@ def aggregate(
             snaps.append(json.loads(raw.decode("utf-8")))
         except (UnicodeDecodeError, ValueError):
             corrupt.append(index)
-    out = merge_snapshots(snaps)
+    return {
+        "snapshots": snaps,
+        "missing_hosts": [],
+        "degraded_error": None,
+        "corrupt_hosts": corrupt,
+    }
+
+
+def aggregate(
+    recorder: Optional[trace.TraceRecorder] = None,
+    include_events: bool = False,
+    description: str = "obs aggregate",
+) -> Dict[str, Any]:
+    """Fleet-level aggregate of every host's telemetry (the distributed entry).
+
+    Single-process worlds merge the local snapshot with no collective. In a
+    multi-host world, each host JSON-encodes its snapshot and all snapshots
+    cross over the guarded eager collective path; with a ``robust.sync_guard``
+    configured, a hung or failing host turns into a **partial** aggregate —
+    ``aggregate_degraded=True``, a loud ``RuntimeWarning``, the unreachable
+    ranks listed in ``missing_hosts`` — rather than a hung job. Pass
+    ``include_events=True`` to also ship the span ring buffers (needed for the
+    cross-host Perfetto export; costs world-size × ring-buffer bytes).
+    """
+    gathered = gather_snapshots(recorder, include_events=include_events, description=description)
+    out = merge_snapshots(gathered["snapshots"])
+    if gathered["degraded_error"] is not None:
+        out["aggregate_degraded"] = True
+        out["degraded_error"] = gathered["degraded_error"]
+        out["missing_hosts"] = gathered["missing_hosts"]
+        return out
+    corrupt = gathered["corrupt_hosts"]
     if corrupt or out["schema_mismatch_hosts"]:
         # a peer that gathered but could not be merged still makes the
         # aggregate PARTIAL — aggregate_degraded is the one documented signal
@@ -351,7 +419,7 @@ def aggregate(
         out["aggregate_degraded"] = True
         if corrupt:
             out["corrupt_hosts"] = corrupt
-        expected = {index for index in range(len(payloads))}
+        expected = set(range(len(gathered["snapshots"]) + len(corrupt)))
         present = {h["process_index"] for h in out["hosts"]}
         out["missing_hosts"] = sorted(expected - present)
         warnings.warn(
@@ -363,6 +431,94 @@ def aggregate(
             stacklevel=2,
         )
     return out
+
+
+def fleet_sample(
+    merged: Dict[str, Any],
+    unix: Optional[float] = None,
+    mono: Optional[float] = None,
+) -> Dict[str, Any]:
+    """One compact, timestamped fleet sample extracted from a merged aggregate.
+
+    The sample schema the fleet sampler's ring retains: just the monotone
+    numerators rate derivation needs (per-tenant update/compute counts with
+    per-host attribution, cost-ledger flop/byte totals, checkpoint bytes) plus
+    the degradation facts (``missing_hosts``, ``degraded``) — NOT the full
+    aggregate, so a long history ring stays cheap. ``unix`` is the wall-clock
+    display stamp; ``mono`` the monotonic stamp rate deltas divide by (both
+    injectable for deterministic tests).
+
+    Pure function: no collective, no clock reads unless the stamps are left
+    ``None`` (then ``time.time()`` / ``time.monotonic()``).
+    """
+    import time as _time
+
+    tenants: Dict[str, Dict[str, Any]] = {}
+    for row in merged.get("tenants", ()):
+        tenants[str(row["tenant"])] = {
+            "updates": int(row.get("updates", 0) or 0),
+            "computes": int(row.get("computes", 0) or 0),
+            "active_pipelines": int(row.get("active_pipelines", 0) or 0),
+            "per_host": {
+                host: {
+                    "updates": int(sub.get("updates", 0) or 0),
+                    "computes": int(sub.get("computes", 0) or 0),
+                }
+                for host, sub in (row.get("per_host") or {}).items()
+            },
+        }
+    # cost-ledger burn numerators: the cumulative dispatch-weighted estimates
+    # (cost.estimated_flops / cost.estimated_bytes gauges, per metric class)
+    # summed across classes, keeping per-host attribution
+    cost: Dict[str, Any] = {
+        "flops": 0.0,
+        "bytes": 0.0,
+        "per_host": {},
+    }
+    _COST_FIELDS = {"cost.estimated_flops": "flops", "cost.estimated_bytes": "bytes"}
+    for gauge in merged.get("gauges", ()):
+        field = _COST_FIELDS.get(gauge.get("name"))
+        if field is None:
+            continue
+        for host, value in (gauge.get("per_host") or {}).items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            cost[field] += value
+            host_row = cost["per_host"].setdefault(host, {"flops": 0.0, "bytes": 0.0})
+            host_row[field] += value
+    # checkpoint-bytes numerators from the per-host scope_status join
+    # (cumulative full+delta bundle bytes per tenant)
+    checkpoint: Dict[str, Any] = {"bytes": 0.0, "per_host": {}, "per_tenant": {}}
+    hosts: List[int] = []
+    for host_row in merged.get("hosts", ()):
+        pidx = int(host_row.get("process_index", 0))
+        hosts.append(pidx)
+        rows = ((host_row.get("scope_status") or {}).get("checkpoints")) or {}
+        host_bytes = 0.0
+        for tenant, row in rows.items():
+            tenant_bytes = float(sum((row.get("bytes") or {}).values()))
+            host_bytes += tenant_bytes
+            checkpoint["per_tenant"][str(tenant)] = (
+                checkpoint["per_tenant"].get(str(tenant), 0.0) + tenant_bytes
+            )
+        if host_bytes:
+            checkpoint["per_host"][str(pidx)] = host_bytes
+        checkpoint["bytes"] += host_bytes
+    return {
+        "schema": FLEET_SAMPLE_SCHEMA,
+        "unix": float(unix if unix is not None else _time.time()),
+        "mono": float(mono if mono is not None else _time.monotonic()),
+        "n_hosts": int(merged.get("n_hosts", 0)),
+        "hosts": sorted(hosts),
+        "missing_hosts": list(merged.get("missing_hosts", ())),
+        "degraded": bool(merged.get("aggregate_degraded", False)),
+        "degraded_error": merged.get("degraded_error"),
+        "tenants": tenants,
+        "cost": cost,
+        "checkpoint": checkpoint,
+    }
 
 
 def summarize(agg: Dict[str, Any]) -> str:

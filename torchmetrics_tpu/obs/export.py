@@ -329,7 +329,25 @@ _GAUGE_HELP = {
     "lease.expired": "Leases past expiry that are neither released nor fenced (the watchdog's pending work)",
     "fence.fenced_epochs": "Session epochs fenced off as zombies (each one is a completed or pending failover)",
     "fence.bundles_rejected": "Post-fence zombie bundle writes rejected by recovery scans (counted, never restored)",
+    "fence.bundles_swept": "Post-fence zombie bundles garbage-collected from disk by retention sweeps",
     "checkpoint.torn_bundles": "Torn/corrupt checkpoint bundles recovery scans skipped while selecting a restore point",
+    # fleet telemetry plane families (obs/fleet.py): continuous cross-host
+    # sampling, rate derivation from consecutive samples, and skew signals
+    "fleet.hosts": "Hosts contributing to the newest merged fleet sample",
+    "fleet.missing_hosts": "Hosts absent from the newest fleet sample (hung or unreachable; degraded, not stalled)",
+    "fleet.degraded": "1 while the newest fleet sample is a degraded partial view, 0 when every host reported",
+    "fleet.samples": "Fleet samples currently retained in the bounded drop-oldest ring",
+    "fleet.degraded_samples": "Fleet samples taken degraded (partial gather) since the sampler was constructed",
+    "fleet.sample_age_seconds": "Seconds since the fleet sampler last completed a sample (staleness of the view)",
+    "fleet.imbalance": "Normalized fleet load-imbalance coefficient: 0 perfectly even, 1 all load on one host",
+    "fleet.host_ratio": "Hottest-host load divided by coldest-host load (absent while the coldest host is idle)",
+    "fleet.host_load_share": "This host's fraction of the fleet's update rate over the newest sample window",
+    "fleet.host_updates_per_second": "Metric updates per second attributed to this host over the newest sample window",
+    "fleet.updates_per_second": "Metric updates per second over the newest sample window (fleet total, or per tenant)",
+    "fleet.computes_per_second": "Fresh metric computes per second over the newest sample window (fleet total, or per tenant)",
+    "fleet.flop_burn_per_second": "Estimated cost-ledger flops per second burned fleet-wide over the newest sample window",
+    "fleet.byte_burn_per_second": "Estimated cost-ledger bytes-accessed per second fleet-wide over the newest sample window",
+    "fleet.checkpoint_bytes_per_second": "Checkpoint bundle bytes written per second over the newest sample window",
 }
 
 

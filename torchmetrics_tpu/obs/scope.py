@@ -76,6 +76,7 @@ __all__ = [
     "expired_leases",
     "fence_status",
     "fenced_rejected_count",
+    "fenced_swept_count",
     "fenced_tenants",
     "get_admission",
     "get_registry",
@@ -90,6 +91,7 @@ __all__ = [
     "note_compute",
     "note_fence",
     "note_fenced_bundle_rejected",
+    "note_fenced_bundle_swept",
     "note_lease",
     "note_lease_released",
     "note_torn_bundles",
@@ -343,7 +345,7 @@ def reset() -> None:
     so suites that exercise tenancy call this to leave the next suite the
     pristine one-branch disabled path.
     """
-    global ENABLED, _ADMISSION, _TORN_BUNDLES, _FENCED_REJECTED
+    global ENABLED, _ADMISSION, _TORN_BUNDLES, _FENCED_REJECTED, _FENCED_SWEPT
     _REGISTRY.clear()
     _REGISTRY.max_tenants = DEFAULT_MAX_TENANTS
     _ADMISSION = None
@@ -356,6 +358,7 @@ def reset() -> None:
         _FENCES.clear()
         _TORN_BUNDLES = 0
         _FENCED_REJECTED = 0
+        _FENCED_SWEPT = 0
     ENABLED = False
 
 
@@ -627,11 +630,14 @@ _LEASES: Dict[str, Dict[str, Any]] = {}
 # update post-fence.
 _FENCES: Dict[str, Dict[str, Any]] = {}
 _LEASE_LOCK = threading.Lock()
-# torn/corrupt bundles skipped by recovery scans, and post-fence zombie
-# bundles rejected by them — running process totals behind the
-# ``checkpoint.torn_bundles`` / ``fence.bundles_rejected`` gauges
+# torn/corrupt bundles skipped by recovery scans, post-fence zombie bundles
+# rejected by them, and post-fence zombie bundles garbage-collected by
+# retention sweeps — running process totals behind the
+# ``checkpoint.torn_bundles`` / ``fence.bundles_rejected`` /
+# ``fence.bundles_swept`` gauges
 _TORN_BUNDLES = 0
 _FENCED_REJECTED = 0
+_FENCED_SWEPT = 0
 
 
 def note_lease(
@@ -796,6 +802,19 @@ def note_fenced_bundle_rejected(n: int = 1) -> None:
 def fenced_rejected_count() -> int:
     with _LEASE_LOCK:
         return _FENCED_REJECTED
+
+
+def note_fenced_bundle_swept(n: int = 1) -> None:
+    """Count ``n`` post-fence zombie bundle(s) a retention sweep GC'd."""
+    global _FENCED_SWEPT
+    if n > 0:
+        with _LEASE_LOCK:
+            _FENCED_SWEPT += int(n)
+
+
+def fenced_swept_count() -> int:
+    with _LEASE_LOCK:
+        return _FENCED_SWEPT
 
 
 # --------------------------------------------------------------------- admission
@@ -1219,6 +1238,7 @@ def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
     fence_rows = fence_status()
     rec.set_gauge("fence.fenced_epochs", float(len(fence_rows)), tenant=None)
     rec.set_gauge("fence.bundles_rejected", float(fenced_rejected_count()), tenant=None)
+    rec.set_gauge("fence.bundles_swept", float(fenced_swept_count()), tenant=None)
     # torn/corrupt bundles skipped by recovery scans (satellite: previously
     # one warning, invisible to scrapes)
     rec.set_gauge("checkpoint.torn_bundles", float(torn_bundle_count()), tenant=None)

@@ -24,6 +24,7 @@ import sys
 import time
 from typing import List, Optional
 
+from torchmetrics_tpu.obs import fleet as _fleet
 from torchmetrics_tpu.obs import server as _server
 from torchmetrics_tpu.obs import trace as _trace
 
@@ -35,7 +36,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m torchmetrics_tpu.obs.serve",
         description=(
             "Serve the obs introspection endpoints (/metrics, /healthz, /readyz,"
-            " /snapshot, /memory, /costs, /alerts, /tenants) over HTTP until interrupted."
+            " /snapshot, /memory, /costs, /alerts, /tenants, /fleet) over HTTP"
+            " until interrupted."
         ),
     )
     parser.add_argument("--host", default="127.0.0.1", help="bind address (default: localhost)")
@@ -89,7 +91,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             _values.enable()
             _lineage.enable()
             engine = _alerts.configure(
-                _alerts.AlertRule(name="non_finite", kind="non_finite", metric="*")
+                _alerts.AlertRule(name="non_finite", kind="non_finite", metric="*"),
+                # sustained load skew (fleet.imbalance from the sampler below)
+                # fires through the same pending->firing machinery
+                _fleet.imbalance_rule(),
             )
             with _scope.scope("tenant-a"):
                 healthy = MeanMetric()
@@ -112,6 +117,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             with _scope.scope("tenant-b"):
                 poisoned.compute()
             metrics.extend([healthy, poisoned])
+            # the fleet telemetry plane: a short-cadence sampler whose ticks
+            # ride the /metrics scrape loop; a static placement maps the two
+            # demo tenants onto two virtual hosts so /fleet shows per-host
+            # shares, the skew block and advisory hints in one process
+            sampler = _fleet.FleetSampler(
+                cadence_seconds=1.0,
+                placement={"tenant-a": "0", "tenant-b": "1"},
+            )
+            _fleet.install_sampler(sampler)
+            sampler.sample()
         except Exception as err:  # demo is a convenience, never a hard failure
             sys.stderr.write(f"demo metrics unavailable: {err!r}\n")
 
@@ -127,6 +142,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"demo tenants: curl -s {server.url}/tenants | python -m json.tool;"
             f" scoped views: {server.url}/metrics?tenant=tenant-b,"
             f" {server.url}/alerts?tenant=tenant-b (non_finite fires there)",
+            flush=True,
+        )
+        print(
+            f"fleet plane: curl -s {server.url}/fleet | python -m json.tool;"
+            f" trend: {server.url}/fleet/history?window=60"
+            " (each /metrics scrape ticks the sampler)",
             flush=True,
         )
         if demo_trace_id is not None:
@@ -149,6 +170,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         pass
     finally:
         _server.stop()
+        if args.demo:
+            # the demo sampler is scoped to this serve run: leaving the
+            # singleton installed would leak it into a library caller's process
+            _fleet.install_sampler(None)
     return 0
 
 

@@ -38,6 +38,14 @@ dependencies, localhost by default:
   on an unknown/evicted id.
 - ``GET /traces`` — the live trace-id index (``?tenant=`` filter;
   ``?outliers=K`` seeds the K slowest batches from the histogram exemplars).
+- ``GET /fleet`` — the fleet telemetry plane (:mod:`~torchmetrics_tpu.obs.fleet`):
+  the current merged cross-host view — per-host rows with lease/fence/
+  checkpoint-freshness/alert status joined in, the per-tenant rate table,
+  the skew block (load shares, imbalance coefficient, hottest tenants) and
+  ADVISORY ranked rebalance hints; ``GET /fleet/history?window=`` the bounded
+  sample ring for trend inspection. Both accept ``?tenant=``; every
+  ``/metrics`` scrape ticks the installed sampler (the fence-watchdog
+  pattern), so scrape traffic alone keeps the ring warm.
 - ``GET /tenants`` — the tenant registry (:mod:`~torchmetrics_tpu.obs.scope`):
   per-tenant liveness, series cardinality, state-memory bytes, estimated cost,
   firing alerts and — with an admission controller installed — quota/burn
@@ -89,6 +97,7 @@ from torchmetrics_tpu.obs import aggregate as _aggregate
 from torchmetrics_tpu.obs import alerts as _alerts
 from torchmetrics_tpu.obs import cost as _cost
 from torchmetrics_tpu.obs import export as _export
+from torchmetrics_tpu.obs import fleet as _fleet
 from torchmetrics_tpu.obs import memory as _memory
 
 __all__ = [
@@ -116,12 +125,22 @@ ROUTES = (
     "/alerts",
     "/tenants",
     "/leases",
+    "/fleet",
+    "/fleet/history",
     "/traces",
     "/trace/<id>",
 )
 
 # routes that accept a ``?tenant=`` scoped view (unknown tenants 404)
-_TENANT_ROUTES = ("/metrics", "/alerts", "/memory", "/snapshot", "/traces")
+_TENANT_ROUTES = (
+    "/metrics",
+    "/alerts",
+    "/memory",
+    "/snapshot",
+    "/traces",
+    "/fleet",
+    "/fleet/history",
+)
 
 
 def _parse_top(query: Dict[str, list], default: int = 20) -> int:
@@ -254,6 +273,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(owner.tenants_report())
             elif route == "/leases":
                 self._send_json(owner.leases_report())
+            elif route == "/fleet":
+                self._send_json(owner.fleet_report(tenant=tenant))
+            elif route == "/fleet/history":
+                raw_window = query.get("window", [None])[0]
+                try:
+                    window = float(raw_window) if raw_window is not None else None
+                    if window is not None and window <= 0:
+                        raise ValueError(f"window must be a positive number, got {window:g}")
+                except ValueError as err:
+                    self._send_json({"error": str(err)}, status=400)
+                    return
+                self._send_json(owner.fleet_history_report(window=window, tenant=tenant))
             elif route.startswith("/trace/"):
                 trace_id = parsed.path[len("/trace/") :].strip("/")
                 payload = owner.trace_report(trace_id)
@@ -618,6 +649,69 @@ class IntrospectionServer:
             "fences": _scope.fence_status(),
         }
 
+    # ---------------------------------------------------------------------- fleet
+
+    def fleet_report(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The ``GET /fleet`` page: the current merged cross-host view.
+
+        Per-host rows (lease/fence/checkpoint-freshness joined from the host
+        snapshots, firing alerts joined from this process's engine), the
+        per-tenant rate table, the skew block and the ADVISORY rebalance
+        hints — all computed from the installed sampler's ring. With no
+        sampler installed the page says so instead of 404ing: "the plane is
+        off" is an answer, not a missing route.
+        """
+        sampler = _fleet.get_sampler()
+        if sampler is None:
+            return {
+                "enabled": False,
+                "error": "no fleet sampler installed (obs.fleet.install_sampler)",
+            }
+        payload = sampler.current(tenant=tenant)
+        # join firing alerts onto the named hosts: /fleet is the control
+        # plane's read side, so "host 1 is hot AND its imbalance alert is
+        # firing" must be one page, not two
+        engine = self.alert_engine()
+        if engine is not None:
+            try:
+                firing = engine.firing()
+                hot = (payload.get("skew") or {}).get("hot_host")
+                for row in payload.get("hosts", []):
+                    row["alerts_firing"] = [
+                        alert["rule"]
+                        for alert in firing
+                        if str(alert.get("series", "")).startswith("fleet.")
+                        and str(row.get("host_id")) == str(hot)
+                    ]
+            except Exception:
+                self._rec_inc("server.errors", route="/fleet(alerts)")
+        return {"enabled": True, **payload}
+
+    def fleet_history_report(
+        self, window: Optional[float] = None, tenant: Optional[str] = None
+    ) -> Dict[str, Any]:
+        """The ``GET /fleet/history`` page: the bounded sample ring.
+
+        ``?window=SECONDS`` keeps only samples within that horizon of the
+        newest; ``?tenant=`` narrows each sample's tenant table. Oldest
+        first, so a plotting client reads a timeline left to right.
+        """
+        sampler = _fleet.get_sampler()
+        if sampler is None:
+            return {
+                "enabled": False,
+                "error": "no fleet sampler installed (obs.fleet.install_sampler)",
+                "samples": [],
+            }
+        samples = sampler.history(window=window, tenant=tenant)
+        return {
+            "enabled": True,
+            "window_seconds": window,
+            "ring": sampler.ring,
+            "n_samples": len(samples),
+            "samples": samples,
+        }
+
     # -------------------------------------------------------------------- lineage
 
     def trace_report(self, trace_id: str) -> Dict[str, Any]:
@@ -781,6 +875,16 @@ class IntrospectionServer:
                 watchdog.tick()
         except Exception:  # failover errors must never break the scrape
             self._rec_inc("server.errors", route="/metrics(watchdog)")
+        try:
+            # the fleet sampler rides the scrape loop the same way: every
+            # /metrics pull doubles as a cadence check, so scrape traffic
+            # alone keeps the sample ring warm with no extra timer thread
+            sampler = _fleet.get_sampler()
+            if sampler is not None:
+                sampler.tick()
+                sampler.record_gauges(recorder=self.recorder)
+        except Exception:  # fleet sampling must never break the scrape
+            self._rec_inc("server.errors", route="/metrics(fleet)")
         if _lineage.ENABLED:
             try:
                 # trace-index cardinality gauges (lineage.* families)
@@ -881,11 +985,24 @@ class IntrospectionServer:
             tenant = alert.get("tenant")
             if tenant:
                 tenants_degraded.add(tenant)
-            reasons.append(
+            reason = (
                 f"alert {alert['rule']!r} ({alert['kind']}) firing on {alert['series']}"
                 + (f" [tenant {tenant}]" if tenant else "")
                 + f": {alert['detail']}"
             )
+            if str(alert.get("series", "")).startswith("fleet."):
+                # the fleet imbalance gauge is deliberately unlabeled (a
+                # host-labeled series would strand a stale firing labelset
+                # when the hot spot shifts) — so the hot host is named HERE,
+                # joined from the live skew view at read time
+                try:
+                    sampler = _fleet.get_sampler()
+                    hot = sampler.skew().get("hot_host") if sampler is not None else None
+                    if hot is not None:
+                        reason += f" (hot host: {hot})"
+                except Exception:
+                    self._rec_inc("server.errors", route="/healthz(fleet)")
+            reasons.append(reason)
         # live-session migrations in flight (engine/migrate.py, announced via
         # scope.migration): degraded-not-dead with the MIGRATING tenant named —
         # a rolling deploy's handoff window is an expected, visible state, not

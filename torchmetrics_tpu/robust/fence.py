@@ -255,6 +255,17 @@ def failover(
         "fenced_unix": fence_record.get("fenced_unix", t0),
         "known_bundles": list(fence_record.get("known", ())),
     }
+    # 3) survivor-side cleanup: the zombie's post-fence bundles are rejected
+    #    garbage from here on — GC them now (recency keep untouched: the new
+    #    session's own retention policy, or everything, stays)
+    try:
+        keep = getattr(getattr(pipe.config, "checkpoint", None), "keep", None)
+        swept = migrate.sweep_bundles(
+            directory, keep=int(keep) if keep else 1_000_000, gc_fenced=True
+        )
+        report["zombie_bundles_swept"] = len(swept)
+    except Exception:  # cleanup must never cost the failover
+        report["zombie_bundles_swept"] = 0
     return pipe, report
 
 
