@@ -1121,6 +1121,12 @@ def _chaos_main(argv) -> None:
         help="write the full SLO report JSON here (atomic write; the CI artifact)",
     )
     parser.add_argument(
+        "--chaos-flamegraph", default=None,
+        help="write the host profiler's collapsed-stack flamegraph file here"
+             " (flamegraph.pl input; only written when the scenario ran with"
+             " the profiler live, e.g. high_tenant)",
+    )
+    parser.add_argument(
         "--chaos-trace", default=None,
         help="write one stitched GET /trace/<id> JSON (an injected-NaN batch's full"
              " lineage story) here — the batch-lineage CI artifact",
@@ -1249,6 +1255,25 @@ def _chaos_main(argv) -> None:
         # (the `memory` passthrough pattern): size/minted/evicted trends
         # accumulate across rounds without gating anything
         line["lineage"] = {"index": result["lineage"]["index"]}
+    if isinstance(result.get("hostprof"), dict):
+        # the host profiler's attribution trend rides the history the same
+        # recorded-never-judged way: per-seam breakdown, the Python-floor
+        # split and the measured self-overhead accumulate across rounds (the
+        # bulky collapsed-stack text stays out — it ships as a file instead)
+        line["hostprof"] = {
+            key: value
+            for key, value in result["hostprof"].items()
+            if key != "collapsed"
+        }
+    if args.chaos_flamegraph:
+        collapsed = (result.get("hostprof") or {}).get("collapsed")
+        atomic_write_text(
+            args.chaos_flamegraph,
+            collapsed
+            if collapsed
+            else "# no host profiler samples captured (profiler not live for"
+            " this scenario — run with --chaos-scenario high_tenant)\n",
+        )
     if args.chaos_scenario == "host_crash":
         # the cadence-overhead probe rides the host-crash runs: checkpointing
         # on vs off on an identical stream, recorded-never-judged

@@ -47,6 +47,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.chaos.schedule import ROLE_VICTIM, TrafficSchedule
+from torchmetrics_tpu.obs import hostprof as _hostprof
 from torchmetrics_tpu.obs import lineage as _lineage
 from torchmetrics_tpu.obs import trace as _trace
 from torchmetrics_tpu.obs.alerts import AlertEngine, AlertRule
@@ -155,6 +156,13 @@ class ReplayConfig:
             replay, so dump-correctness checks see only this run's dumps).
         max_events: trace ring capacity while the replay records.
         alert_history: bounded transition-history size of the shared engine.
+        hostprof: host-profiler plane. ``None`` (default) auto-enables the
+            continuous sampler for the multiplexed scenario only;
+            ``True``/``False`` force it on/off for any scenario. While live,
+            the per-seam breakdown + floor report land in the run record
+            under ``hostprof`` and a mid-run ``GET /profile`` probe proves
+            the plane answers over HTTP during the fault window.
+        hostprof_rate_hz: sampling rate for the host profiler when live.
     """
 
     fuse: int = 2
@@ -171,6 +179,11 @@ class ReplayConfig:
     lease_seconds: float = 0.25
     scrape_interval_seconds: float = 0.05
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants", "/healthz")
+    # host-profiler plane: None = auto (live for the multiplexed/high-tenant
+    # scenario, where the Python floor under the mux path is the question the
+    # profiler exists to answer); True/False force it on/off for any scenario
+    hostprof: Optional[bool] = None
+    hostprof_rate_hz: float = 200.0
     sync_timeout_seconds: float = 0.05
     flight_dump_dir: Optional[str] = None
     max_events: int = 8192
@@ -179,6 +192,10 @@ class ReplayConfig:
     def __post_init__(self) -> None:
         if self.fuse < 1:
             raise ValueError(f"Expected `fuse` >= 1, got {self.fuse}")
+        if self.hostprof_rate_hz <= 0:
+            raise ValueError(
+                f"Expected positive `hostprof_rate_hz`, got {self.hostprof_rate_hz}"
+            )
         if self.mux_max_width < 1:
             raise ValueError(f"Expected `mux_max_width` >= 1, got {self.mux_max_width}")
         if self.rolling_deploy and self.multiplex:
@@ -1000,6 +1017,10 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             },
         }
 
+    profiler: Optional[_hostprof.HostProfiler] = None
+    profiler_prev: Optional[_hostprof.HostProfiler] = None
+    profile_probe: Optional[Dict[str, Any]] = None
+    profile_probe_at = max(1, len(schedule.events) // 2)
     try:
         with _trace.observe(max_events=config.max_events):
             server.start()
@@ -1012,6 +1033,14 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                 server.url, scrape_routes, config.scrape_interval_seconds
             )
             scraper.start()
+            if config.hostprof or (config.hostprof is None and config.multiplex):
+                # the continuous host profiler rides the replay: sampling is
+                # live through the fault window, the per-seam breakdown and
+                # floor report land in the run record, and GET /profile is
+                # probed MID-RUN below — live attribution, not a post-mortem
+                profiler = _hostprof.HostProfiler(rate_hz=config.hostprof_rate_hz)
+                profiler_prev = _hostprof.install(profiler)
+                profiler.start()
             wall_start, perf_start = time.time(), time.perf_counter()
             with warnings.catch_warnings():
                 # degrade/quarantine warnings are the *expected* output of a
@@ -1030,6 +1059,25 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                     if fleet_shift_at is not None and ev_index >= fleet_shift_at:
                         fleet_shift = shift_hot_spot()
                         fleet_shift_at = None  # one shift per run
+                    if profiler is not None and profile_probe is None and ev_index >= profile_probe_at:
+                        # the live mid-run GET /profile: the host-vs-XLA
+                        # floor split must be servable while the run is
+                        # still feeding, not only in the post-hoc record
+                        try:
+                            with urllib.request.urlopen(
+                                server.url + "/profile?top=5", timeout=10
+                            ) as resp:
+                                page = json.loads(resp.read())
+                            profile_probe = {
+                                "at_event": ev_index,
+                                "running": page.get("running"),
+                                "samples": page.get("samples"),
+                                "self_overhead_percent": page.get("self_overhead_percent"),
+                                "attributed_percent": page.get("attributed_percent"),
+                                "mux_floor": ((page.get("floor") or {}).get("paths") or {}).get("mux"),
+                            }
+                        except Exception:
+                            profile_probe = None  # retried at the next event
                     kind = ev["kind"]
                     if kind == "batch":
                         tenant = ev["tenant"]
@@ -1315,6 +1363,12 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         if config.skewed_load:
             # the installed sampler is process-global too: leave none behind
             _fleet_mod.install_sampler(None)
+        if profiler is not None:
+            # stop sampling and restore whatever profiler the caller had
+            # installed; the stopped profiler's tables stay readable for the
+            # run-record join below
+            profiler.stop()
+            _hostprof.install(profiler_prev)
         if scraper is not None:
             scraper.stop()
         server.stop()
@@ -1452,6 +1506,25 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         "sample_trace_id": sample_trace_id,
         "sample_trace": sample_trace,
     }
+    hostprof_info = None
+    if profiler is not None:
+        # the continuous profiler's verdict for this run: per-seam breakdown,
+        # the Python-floor report (incl. the mux-path host-vs-XLA split), the
+        # measured self-overhead, and the mid-run HTTP probe evidence
+        hostprof_info = {
+            "enabled": True,
+            "rate_hz": profiler.rate_hz,
+            "duration_seconds": round(profiler.duration_seconds(), 6),
+            "self_overhead_percent": round(profiler.self_overhead_percent(), 4),
+            "attributed_percent": round(profiler.attributed_percent(), 4),
+            "breakdown": profiler.breakdown(),
+            "floor": profiler.floor_report(),
+            "stats": profiler.stats(),
+            "probe": profile_probe,
+            # bounded collapsed-stack text (flamegraph.pl input) so the bench
+            # can ship the flamegraph as a CI artifact without re-sampling
+            "collapsed": profiler.collapsed(top=500),
+        }
     reports = {tenant: pipe.report().asdict() for tenant, pipe in pipelines.items()}
     sync_degraded = sorted(
         tenant for tenant, metric in metrics.items() if getattr(metric, "sync_degraded", False)
@@ -1510,6 +1583,11 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             if mux is not None
             else None
         ),
+        # continuous host-profiler accounting (None unless the profiler was
+        # live — auto for the multiplexed scenario): per-seam host-time
+        # breakdown, the Python-floor report with the mux-path host-vs-XLA
+        # split, sampler self-overhead, and the mid-run GET /profile probe
+        "hostprof": hostprof_info,
         "robust": {"sync_degraded": sync_degraded, "quarantined": quarantined},
         # rolling-deploy accounting (None unless ReplayConfig.rolling_deploy):
         # migrated tenants, handoff wall time, the mid-flight /healthz

@@ -1136,6 +1136,16 @@ class TenantMultiplexer:
                 return policy
         return None
 
+    def _stack_probe(self, rows: list) -> list:
+        # a named function, not an inline comprehension: the host-side numpy
+        # probe stack is a "stack-unstack" seam the sampling profiler
+        # (obs/hostprof.py) attributes by frame name — an anonymous
+        # comprehension would fold these samples into the dispatch seam
+        return [
+            np.stack([np.asarray(row[1][i]) for row in rows])
+            for i in range(len(rows[0][1]))
+        ]
+
     def _dispatch_sig(self, sig: tuple) -> None:
         group = self._groups.pop(sig, None)
         if group is None or not len(group):
@@ -1154,10 +1164,7 @@ class TenantMultiplexer:
             # per group by design), so stack with numpy instead of burning a
             # device op per leaf; scalar leaves stack to shape (n,) and are
             # screened like any other, matching the pipeline's chunk screen
-            stacked_probe = [
-                np.stack([np.asarray(row[1][i]) for row in rows])
-                for i in range(len(rows[0][1]))
-            ]
+            stacked_probe = self._stack_probe(rows)
             bad = [i for i in nonfinite_step_indices(stacked_probe) if i in guarded]
             if bad:
                 if _trace.ENABLED:

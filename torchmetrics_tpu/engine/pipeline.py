@@ -1241,6 +1241,14 @@ class MetricPipeline:
                 return policy
         return None
 
+    def _stack_rows(self, rows: list, n_cols: int) -> list:
+        # a named function, not an inline comprehension: host-side row
+        # stacking is one of the seams the sampling profiler
+        # (obs/hostprof.py) attributes, and it needs a stable frame name to
+        # classify these samples as "stack-unstack" instead of folding them
+        # into the surrounding dispatch
+        return [jnp.stack([row[i] for row in rows]) for i in range(n_cols)]
+
     def _dispatch_chunk(self) -> None:
         chunk, self._chunk = self._chunk, None
         cid = self._chunk_seq
@@ -1249,7 +1257,7 @@ class MetricPipeline:
         bucket = self._bucket_for(n)
         pad = bucket - n
         rows = chunk.traced + [chunk.traced[-1]] * pad  # repeat-last padding, masked out
-        stacked = [jnp.stack([row[i] for row in rows]) for i in range(len(chunk.traced[0]))]
+        stacked = self._stack_rows(rows, len(chunk.traced[0]))
         valid = jnp.asarray(np.arange(bucket) < n)
         policy = self._chunk_policy()
         if policy is not None:

@@ -55,6 +55,14 @@ recorder:
   poisoned-row replay, the multiplexer, migration tails and crash-recovery
   re-feeds; a bounded trace-id index behind ``GET /trace/<id>``, histogram
   exemplars, and Perfetto flow events.
+- :mod:`~torchmetrics_tpu.obs.hostprof` — continuous host-path sampling
+  profiler: a daemon thread walks ``sys._current_frames()`` at a configurable
+  rate, classifies every sample against the runtime's known seams (ingest,
+  admission, lineage, stack/unstack, ``device_put``, dispatch-wait, commit,
+  scrape) by joining ambient span/tenant context, and derives a **Python-floor
+  report** — sampled host seconds vs the cost ledger's XLA estimates — served
+  live on ``GET /profile`` and exported as ``hostprof.*`` gauges, collapsed
+  stacks and Perfetto counter tracks.
 - :mod:`~torchmetrics_tpu.obs.scope` — tenant/session attribution: a
   contextvar-based ``scope(tenant=...)`` context manager stamping every
   recorder write, value point, alert and cost entry with a bounded-cardinality
@@ -85,6 +93,7 @@ from torchmetrics_tpu.obs import (
     alerts,
     cost,
     export,
+    hostprof,
     lineage,
     memory,
     perfetto,
@@ -99,9 +108,16 @@ from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
 from torchmetrics_tpu.obs.alerts import AlertEngine, AlertRule
 from torchmetrics_tpu.obs.cost import get_ledger as cost_ledger
 from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
+from torchmetrics_tpu.obs.hostprof import HostProfiler
 from torchmetrics_tpu.obs.memory import device_memory_stats, footprint, record_gauges
 from torchmetrics_tpu.obs.perfetto import chrome_trace, write_trace
-from torchmetrics_tpu.obs.profile import annotate, profile_trace, start_trace, stop_trace
+from torchmetrics_tpu.obs.profile import (
+    annotate,
+    profile_session,
+    profile_trace,
+    start_trace,
+    stop_trace,
+)
 from torchmetrics_tpu.obs.scope import TenantRegistry
 from torchmetrics_tpu.obs.server import IntrospectionServer, start_server, stop_server
 from torchmetrics_tpu.obs.trace import (
@@ -122,6 +138,7 @@ from torchmetrics_tpu.obs.trace import (
 __all__ = [
     "AlertEngine",
     "AlertRule",
+    "HostProfiler",
     "IntrospectionServer",
     "TenantRegistry",
     "TraceRecorder",
@@ -140,6 +157,7 @@ __all__ = [
     "footprint",
     "get_recorder",
     "host_snapshot",
+    "hostprof",
     "inc",
     "is_enabled",
     "lineage",
@@ -149,6 +167,7 @@ __all__ = [
     "observe_duration",
     "perfetto",
     "profile",
+    "profile_session",
     "profile_trace",
     "prometheus_text",
     "record_gauges",

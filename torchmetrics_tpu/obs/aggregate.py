@@ -543,8 +543,11 @@ def summarize(agg: Dict[str, Any]) -> str:
     # everything else stays in the generic table
     memory_gauges = [g for g in agg["gauges"] if g["name"].startswith("memory.")]
     cost_gauges = [g for g in agg["gauges"] if g["name"].startswith("cost.")]
+    hostprof_gauges = [g for g in agg["gauges"] if g["name"].startswith("hostprof.")]
     other_gauges = [
-        g for g in agg["gauges"] if not g["name"].startswith(("memory.", "cost."))
+        g
+        for g in agg["gauges"]
+        if not g["name"].startswith(("memory.", "cost.", "hostprof."))
     ]
     if other_gauges:
         lines.append("-- gauges (per-host | max) --")
@@ -582,6 +585,24 @@ def summarize(agg: Dict[str, Any]) -> str:
             )
             lines.append(
                 f"  {gauge['name']:<{width}}  {per_host} | max={format_count(gauge['max'])}  {label}"
+            )
+    if hostprof_gauges:
+        # the host-profiler floor table: per-host per-seam sampled seconds
+        # plus the sampler health gauges, so a fleet view shows WHERE each
+        # host's Python floor sits (and how much the measurement itself cost)
+        lines.append("-- host profiler: Python-floor attribution (per-host | max) --")
+        width = max(len(g["name"]) for g in hostprof_gauges)
+        for gauge in sorted(
+            hostprof_gauges,
+            key=lambda g: (g["name"], str(sorted(g["labels"].items()))),
+        ):
+            label = " ".join(f"{k}={v}" for k, v in sorted(gauge["labels"].items()))
+            per_host = " ".join(
+                f"{h}:{v:g}"
+                for h, v in sorted(gauge["per_host"].items(), key=lambda kv: int(kv[0]))
+            )
+            lines.append(
+                f"  {gauge['name']:<{width}}  {per_host} | max={gauge['max']:g}  {label}"
             )
     if agg["histograms"]:
         from torchmetrics_tpu.obs.export import _quantile_cols

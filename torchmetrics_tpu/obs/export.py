@@ -348,6 +348,16 @@ _GAUGE_HELP = {
     "fleet.flop_burn_per_second": "Estimated cost-ledger flops per second burned fleet-wide over the newest sample window",
     "fleet.byte_burn_per_second": "Estimated cost-ledger bytes-accessed per second fleet-wide over the newest sample window",
     "fleet.checkpoint_bytes_per_second": "Checkpoint bundle bytes written per second over the newest sample window",
+    # continuous host-profiler families (obs/hostprof.py): the Python-floor
+    # attribution plane — all gauges (point-in-time sampler state), never _total
+    "hostprof.samples": "Host stack samples taken and attributed (serving/scrape-thread samples excluded)",
+    "hostprof.samples_serving": "Host stack samples landing in obs-server scrape threads (never billed to a tenant seam)",
+    "hostprof.dropped_stacks": "Distinct collapsed stacks refused past the bounded stack-table cap",
+    "hostprof.sample_errors": "Sampler iterations that raised and were swallowed (the sampler never kills the run)",
+    "hostprof.rate_hz": "Configured host-profiler sampling rate in Hz",
+    "hostprof.self_overhead_percent": "Measured sampler busy time as a percent of profiled wall time",
+    "hostprof.attributed_percent": "Percent of attributable host samples landing in a named runtime seam (not 'other')",
+    "hostprof.seam_seconds": "Sampled host seconds attributed to the labeled runtime seam",
 }
 
 

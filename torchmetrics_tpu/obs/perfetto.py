@@ -19,6 +19,10 @@ so host spans load *next to* device traces:
   hosts when an aggregate is exported, because flow ids are global while each
   host keeps its own pid;
 - counters and gauges → ``"C"`` counter tracks;
+- the live host profiler (:mod:`~torchmetrics_tpu.obs.hostprof`), when one is
+  installed → per-seam ``hostprof.samples{seam=...}`` counter tracks from its
+  wall-stamped timeline ring, so host-Python attribution renders directly
+  under the spans that were open while the time burned;
 - **one pid per host**: a single-host export uses the local process index; a
   multi-host aggregate (``obs.aggregate.aggregate(include_events=True)``)
   renders every host as its own named process, aligned on the shared
@@ -203,6 +207,34 @@ def chrome_trace(source: Source = None) -> Dict[str, Any]:
                     "args": {"value": gauge["value"]},
                 }
             )
+
+    # the live host profiler's per-seam sample timeline renders as counter
+    # tracks beside the spans: each bounded timeline bucket is wall-stamped,
+    # so aligning against the earliest recorder anchor puts "which seam was
+    # burning host time" directly under the span that was open while it
+    # burned. Live sources only — a deserialized snapshot carries no profiler
+    if source is None or isinstance(source, trace.TraceRecorder):
+        try:
+            from torchmetrics_tpu.obs import hostprof as _hostprof
+
+            profiler = _hostprof.get_profiler()
+        except Exception:
+            profiler = None
+        if profiler is not None and snaps:
+            pid = int(snaps[0].get("host", {}).get("process_index", 0))
+            for bucket in profiler.timeline():
+                ts = _us(max(0.0, bucket["wall"] - anchor0)) if anchors else 0
+                for seam, count in sorted(bucket["seams"].items()):
+                    events.append(
+                        {
+                            "ph": "C",
+                            "name": f"hostprof.samples{{seam={seam}}}",
+                            "pid": pid,
+                            "tid": 0,
+                            "ts": ts,
+                            "args": {"value": count},
+                        }
+                    )
 
     # one flow chain per trace id with at least two anchoring spans: the
     # first point starts the flow ("s"), intermediates step it ("t"), the

@@ -1,4 +1,4 @@
-"""Device-trace capture: thin, fault-tolerant wrappers over ``jax.profiler``.
+"""Profiler sessions: guarded ``jax.profiler`` device traces + the host sampler.
 
 ``start_trace``/``stop_trace`` bracket a region of the run with an XLA device
 trace (viewable in TensorBoard / Perfetto); the ``Metric`` runtime already
@@ -12,6 +12,12 @@ warning and a ``False`` return. Start/stop also land in the obs event log when
 tracing is enabled, so exported telemetry shows *when* a device trace was
 captured and where it was written.
 
+:func:`profile_session` is the combined capture: the device trace AND the
+continuous host sampler (:mod:`obs.hostprof`) started and stopped together,
+so one call covers both sides of a region. The original single-side names
+(``start_trace``/``stop_trace``/``profile_trace``/``annotate``) remain
+importable and unchanged.
+
 jax is imported lazily — importing :mod:`torchmetrics_tpu.obs` stays
 stdlib-only.
 """
@@ -23,7 +29,14 @@ from typing import Any, Iterator, Optional
 
 import torchmetrics_tpu.obs.trace as trace
 
-__all__ = ["annotate", "profile_trace", "reset", "start_trace", "stop_trace"]
+__all__ = [
+    "annotate",
+    "profile_session",
+    "profile_trace",
+    "reset",
+    "start_trace",
+    "stop_trace",
+]
 
 # path of the in-flight capture; None when no trace is active
 _ACTIVE: dict = {"log_dir": None}
@@ -106,6 +119,44 @@ def profile_trace(log_dir: str) -> Iterator[bool]:
     try:
         yield started
     finally:
+        if started:
+            stop_trace()
+
+
+@contextmanager
+def profile_session(
+    log_dir: Optional[str] = None,
+    host: bool = True,
+    rate_hz: float = 200.0,
+    **host_kwargs: Any,
+) -> Iterator[dict]:
+    """One scoped capture of BOTH sides: device trace + host sampler.
+
+    ``log_dir`` (optional) brackets the block with the guarded
+    ``jax.profiler`` device trace exactly like :func:`profile_trace`;
+    ``host=True`` (default) additionally installs and starts an
+    :class:`obs.hostprof.HostProfiler` at ``rate_hz`` for the same window, so
+    the XLA-side trace and the Python-floor attribution cover one identical
+    region. Yields ``{"device": started, "host": profiler_or_None}`` — the
+    host profiler's tables stay readable after the block (breakdown, floor
+    report, collapsed stacks). Either side degrades independently: a failed
+    device-trace start never blocks the host sampler, and vice versa.
+    """
+    from torchmetrics_tpu.obs import hostprof as _hostprof
+
+    started = start_trace(log_dir) if log_dir is not None else False
+    profiler = None
+    previous = None
+    if host:
+        profiler = _hostprof.HostProfiler(rate_hz=rate_hz, **host_kwargs)
+        previous = _hostprof.install(profiler)
+        profiler.start()
+    try:
+        yield {"device": started, "host": profiler}
+    finally:
+        if profiler is not None:
+            profiler.stop()
+            _hostprof.install(previous)
         if started:
             stop_trace()
 

@@ -198,6 +198,13 @@ def run_record(
         # predicted-vs-measured story accumulates across rounds, never judged
         # by check_regressions — same passthrough contract as memory/engine
         record["cost"] = cost
+    hostprof = result.get("hostprof")
+    if isinstance(hostprof, dict):
+        # continuous host-profiler attribution (per-seam breakdown, Python
+        # floor vs dispatch-wait split, self-overhead): the measured side of
+        # the zero-copy-ingest story accumulates across rounds, never judged
+        # by check_regressions — same passthrough contract as memory/engine
+        record["hostprof"] = hostprof
     lineage = result.get("lineage")
     if isinstance(lineage, dict):
         # batch-lineage trace-index cardinality (size/minted/evicted): the

@@ -46,6 +46,14 @@ dependencies, localhost by default:
   sample ring for trend inspection. Both accept ``?tenant=``; every
   ``/metrics`` scrape ticks the installed sampler (the fence-watchdog
   pattern), so scrape traffic alone keeps the ring warm.
+- ``GET /profile`` — the host profiler (:mod:`~torchmetrics_tpu.obs.hostprof`):
+  the live Python-floor attribution report — per-seam breakdown, the
+  host-vs-XLA floor split (whole-host, per-path, per-metric, per-tenant),
+  self-overhead and top collapsed stacks; ``?tenant=`` scopes (404 unknown),
+  ``?top=K`` caps the stack list (400 non-positive), ``?format=collapsed``
+  serves the flamegraph.pl input as ``text/plain``, ``?include_serving=1``
+  folds the scrape-serving bucket back in. No profiler installed answers
+  ``{"enabled": false}`` — an uninstalled plane is healthy, not a 404.
 - ``GET /tenants`` — the tenant registry (:mod:`~torchmetrics_tpu.obs.scope`):
   per-tenant liveness, series cardinality, state-memory bytes, estimated cost,
   firing alerts and — with an admission controller installed — quota/burn
@@ -98,6 +106,7 @@ from torchmetrics_tpu.obs import alerts as _alerts
 from torchmetrics_tpu.obs import cost as _cost
 from torchmetrics_tpu.obs import export as _export
 from torchmetrics_tpu.obs import fleet as _fleet
+from torchmetrics_tpu.obs import hostprof as _hostprof
 from torchmetrics_tpu.obs import memory as _memory
 
 __all__ = [
@@ -127,6 +136,7 @@ ROUTES = (
     "/leases",
     "/fleet",
     "/fleet/history",
+    "/profile",
     "/traces",
     "/trace/<id>",
 )
@@ -140,6 +150,7 @@ _TENANT_ROUTES = (
     "/traces",
     "/fleet",
     "/fleet/history",
+    "/profile",
 )
 
 
@@ -275,6 +286,41 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(owner.leases_report())
             elif route == "/fleet":
                 self._send_json(owner.fleet_report(tenant=tenant))
+            elif route == "/profile":
+                try:
+                    top_k = _parse_top(query)
+                except ValueError as err:
+                    self._send_json({"error": str(err)}, status=400)
+                    return
+                fmt = query.get("format", ["json"])[0]
+                if fmt not in ("json", "collapsed"):
+                    self._send_json(
+                        {
+                            "error": f"unknown format {fmt!r}",
+                            "formats": ["json", "collapsed"],
+                        },
+                        status=400,
+                    )
+                    return
+                include_serving = query.get("include_serving", ["0"])[0] not in ("0", "", "false")
+                if fmt == "collapsed":
+                    profiler = _hostprof.get_profiler()
+                    if profiler is None:
+                        self._send_json(
+                            {
+                                "enabled": False,
+                                "error": "no host profiler installed (obs.hostprof.install)",
+                            }
+                        )
+                        return
+                    body = profiler.collapsed(top=top_k)
+                    self._send(200, body.encode("utf-8"), "text/plain; charset=utf-8")
+                    return
+                self._send_json(
+                    owner.profile_report(
+                        tenant=tenant, top=top_k, include_serving=include_serving
+                    )
+                )
             elif route == "/fleet/history":
                 raw_window = query.get("window", [None])[0]
                 try:
@@ -687,6 +733,31 @@ class IntrospectionServer:
                 self._rec_inc("server.errors", route="/fleet(alerts)")
         return {"enabled": True, **payload}
 
+    def profile_report(
+        self,
+        tenant: Optional[str] = None,
+        top: int = 20,
+        include_serving: bool = False,
+    ) -> Dict[str, Any]:
+        """The ``GET /profile`` page: the live host-profiler breakdown.
+
+        Per-seam host-time split, self-overhead, the Python-floor report
+        (sampled host seconds vs the cost ledger) and the top collapsed
+        stacks — all live off the installed :mod:`obs.hostprof` sampler.
+        ``?include_serving=1`` opts the obs-server scrape threads back into
+        the breakdown (they are excluded by default so the floor report
+        never bills the profiler/scraper to a tenant seam). With no profiler
+        installed the page says so instead of 404ing — "the plane is off" is
+        an answer, not a missing route.
+        """
+        profiler = _hostprof.get_profiler()
+        if profiler is None:
+            return {
+                "enabled": False,
+                "error": "no host profiler installed (obs.hostprof.install)",
+            }
+        return profiler.report(tenant=tenant, top=top, include_serving=include_serving)
+
     def fleet_history_report(
         self, window: Optional[float] = None, tenant: Optional[str] = None
     ) -> Dict[str, Any]:
@@ -885,6 +956,15 @@ class IntrospectionServer:
                 sampler.record_gauges(recorder=self.recorder)
         except Exception:  # fleet sampling must never break the scrape
             self._rec_inc("server.errors", route="/metrics(fleet)")
+        try:
+            # the host profiler's hostprof.* gauge families refresh per
+            # scrape too (self-overhead %, samples, per-seam seconds), so
+            # /metrics always carries the sampler's current attribution
+            profiler = _hostprof.get_profiler()
+            if profiler is not None:
+                profiler.record_gauges(recorder=self.recorder)
+        except Exception:  # profiling must never break the scrape
+            self._rec_inc("server.errors", route="/metrics(hostprof)")
         if _lineage.ENABLED:
             try:
                 # trace-index cardinality gauges (lineage.* families)
