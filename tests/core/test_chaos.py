@@ -240,6 +240,15 @@ def _fake_result(**overrides):
                  "alert_linked": False, "linked": True},
             ],
         },
+        # conservation-audit evidence (the accounting_clean SLO's input): a
+        # clean ledger pass, so factory specs that require it judge green
+        "audit": {
+            "enabled": True,
+            "ticks": 12,
+            "sessions": 5,
+            "approximate": False,
+            "violations": [],
+        },
     }
     result.update(overrides)
     return result

@@ -90,4 +90,6 @@ def test_two_process_aggregate_battery(tmp_path):
         "fleet_degraded_sample_when_rank_wedges": True,
         "sigstop_wedge_fenced_from_disk_stamp": True,
         "sigcont_late_write_rejected_on_scan": True,
+        "audit_ledger_continues_across_restore": True,
+        "audit_zombie_rejection_is_event_not_violation": True,
     }

@@ -760,6 +760,173 @@ def main() -> None:
     results["sigcont_late_write_rejected_on_scan"] = True
     scope.reset()
 
+    # -- 15. conservation ledger across restore + fence/failover ---------------
+    # (the audit plane, 2-process-validated: both ranks run a live
+    # ConservationAuditor. Rank 1 serves a tenant session, drains and
+    # checkpoints it to shared disk; rank 0 restores it mid-stream and
+    # finishes the traffic — each side's ledger balances with ZERO
+    # violations, and the cross-host merge of the two rows max-merges within
+    # the shared epoch instead of summing, so no batch is counted twice.
+    # Then a second session hangs mid-stream on rank 1, rank 0 fences its
+    # epoch and fails the tenant over under a fresh epoch, and the woken
+    # zombie's late bundle is rejected by the recovery scan: the rejection
+    # surfaces in the audit report as an EVENT, with the violation list
+    # still empty on both ranks — correct fencing is not an accounting bug.)
+    import torchmetrics_tpu.obs.audit as audit_mod
+    import torchmetrics_tpu.obs.lineage as lineage_mod
+
+    trace.enable()
+    lineage_mod.enable()
+    auditor = audit_mod.ConservationAuditor(cadence_seconds=1e-6)
+    audit_mod.install_auditor(auditor)
+    aud_tick = [0.0]
+
+    def _audit_tick():
+        aud_tick[0] += 1.0
+        auditor.tick(now=aud_tick[0])
+        return auditor.report()
+
+    aud_bundle = os.path.join(shared, "aud_bundle")
+    aud_oracle = os.path.join(shared, "aud_expected.json")
+    aud_rng = np.random.RandomState(31)
+    aud_batches = [
+        (
+            jnp.asarray(aud_rng.rand(16, 4).astype(np.float32)),
+            jnp.asarray(aud_rng.randint(0, 4, 16)),
+        )
+        for _ in range(10)
+    ]
+
+    if pid == 1:
+        pipe = MetricPipeline(mig_metric(), PipelineConfig(fuse=4, tenant="t-aud"))
+        for p_, t_ in aud_batches[:6]:
+            pipe.feed(p_, t_)
+        engine_migrate.checkpoint_session(pipe, aud_bundle)
+        pipe.close()
+        report = _audit_tick()
+        assert report["violations"] == [], report["violations"]
+        origin_totals = report["tenants"]["t-aud"]["totals"]
+        assert origin_totals["fed"] == 6, origin_totals
+        tmp = aud_oracle + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"totals": origin_totals, "epoch": pipe.lineage_epoch}, fh)
+        os.replace(tmp, aud_oracle)
+    # collective barrier: the bundle + rank 1's frozen ledger row are on disk
+    aggregate()
+    if pid == 0:
+        pipe2, manifest = engine_migrate.restore_session(mig_metric(), aud_bundle)
+        for p_, t_ in aud_batches[6:]:
+            pipe2.feed(p_, t_)
+        pipe2.close()
+        report = _audit_tick()
+        assert report["violations"] == [], report["violations"]
+        survivor_totals = report["tenants"]["t-aud"]["totals"]
+        # the ledger CONTINUED: the restored generation adopted the origin's
+        # 6-batch cursor and extended it to the full stream
+        assert survivor_totals["fed"] == len(aud_batches), survivor_totals
+        with open(aud_oracle) as fh:
+            oracle = json.load(fh)
+        assert engine_migrate._bundle_epoch(manifest) == oracle["epoch"]
+        # cross-host merge discipline: both rows describe the SAME epoch, so
+        # the fleet truth is the furthest row (max-merge), never the sum —
+        # summing would count rank 1's six batches twice
+        merged_fed = max(survivor_totals["fed"], oracle["totals"]["fed"])
+        assert merged_fed == len(aud_batches)
+        assert merged_fed < survivor_totals["fed"] + oracle["totals"]["fed"]
+    results["audit_ledger_continues_across_restore"] = True
+
+    # phase 2: hang + fence + failover, ledger still clean on both sides
+    audf_dir = os.path.join(shared, "audf_stream")
+    audf_target_dir = os.path.join(shared, "audf_target_stream")
+    audf_oracle = os.path.join(shared, "audf_expected.json")
+    audf_zombie_path = os.path.join(shared, "audf_zombie.json")
+    audf_ttl = 0.6
+    audf_zombie_pipe = None
+    if pid == 1:
+        audf_zombie_pipe = MetricPipeline(
+            mig_metric(),
+            PipelineConfig(
+                fuse=2,
+                tenant="t-audf",
+                lease_seconds=audf_ttl,
+                checkpoint=CheckpointPolicy(
+                    directory=audf_dir, every_batches=2, full_every=4, keep=8
+                ),
+            ),
+        )
+        for p_, t_ in aud_batches[:7]:
+            audf_zombie_pipe.feed(p_, t_)
+        # the host wedges: no drain, no close, no lease release
+        tmp = audf_oracle + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"epoch": audf_zombie_pipe.lineage_epoch}, fh)
+        os.replace(tmp, audf_oracle)
+    # collective barrier: the hung stream is on shared disk
+    aggregate()
+    if pid == 0:
+        with open(audf_oracle) as fh:
+            audf_epoch = json.load(fh)["epoch"]
+        deadline = time_mod.time() + 30.0
+        while time_mod.time() < deadline:
+            stamp = robust_fence.scan_bundle_lease(audf_dir)
+            assert stamp is not None, os.listdir(audf_dir)
+            if robust_fence.lease_expired(stamp, now=time_mod.time()):
+                break
+            time_mod.sleep(0.05)
+        else:
+            raise AssertionError(f"lease never expired: {stamp}")
+        pipe3, fo_report = robust_fence.failover(
+            mig_metric(),
+            audf_dir,
+            tenant="t-audf",
+            checkpoint=CheckpointPolicy(
+                directory=audf_target_dir, every_batches=2, full_every=4, keep=8
+            ),
+        )
+        assert fo_report["fenced_epoch"] == audf_epoch
+        for p_, t_ in aud_batches[fo_report["restored_cursor"] :]:
+            pipe3.feed(p_, t_)
+        pipe3.close()
+        report = _audit_tick()
+        # the failover session runs a FRESH epoch: its ledger balances, the
+        # fenced zombie epoch is excluded from the totals, zero violations
+        assert report["violations"] == [], report["violations"]
+        assert report["events"]["fenced_epochs"] >= 1, report["events"]
+        assert report["tenants"]["t-audf"]["totals"]["fed"] == len(aud_batches)
+    # collective barrier: the fence + failover are durable
+    aggregate()
+    if pid == 1:
+        # the zombie wakes and writes a late bundle; locally its ledger still
+        # balances (the fence is rank 0's fact — rejection happens at scan)
+        audf_zombie_pipe.feed(*aud_batches[7])
+        late = audf_zombie_pipe.checkpoint_now()
+        assert late is not None and os.path.isdir(late), late
+        report = _audit_tick()
+        assert report["violations"] == [], report["violations"]
+        tmp = audf_zombie_path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"bundle": os.path.basename(late)}, fh)
+        os.replace(tmp, audf_zombie_path)
+    # collective barrier: the zombie's late bundle is on shared disk
+    aggregate()
+    if pid == 0:
+        with open(audf_zombie_path) as fh:
+            zombie_name = json.load(fh)["bundle"]
+        selected = latest_valid_bundle(audf_dir)
+        assert selected is not None
+        assert os.path.basename(selected) != zombie_name, selected
+        report = _audit_tick()
+        # the rejected zombie bundle is an audit EVENT — correct fencing at
+        # work — never a violation
+        assert report["events"]["fenced_bundles_rejected"] >= 1, report["events"]
+        assert report["violations"] == [], report["violations"]
+    results["audit_zombie_rejection_is_event_not_violation"] = True
+    if pid == 1 and audf_zombie_pipe is not None:
+        audf_zombie_pipe.close()
+    audit_mod.install_auditor(None)
+    lineage_mod.disable()
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

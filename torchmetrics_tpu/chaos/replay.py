@@ -47,6 +47,7 @@ from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Tuple
 
 from torchmetrics_tpu.chaos.schedule import ROLE_VICTIM, TrafficSchedule
+from torchmetrics_tpu.obs import audit as _audit
 from torchmetrics_tpu.obs import hostprof as _hostprof
 from torchmetrics_tpu.obs import lineage as _lineage
 from torchmetrics_tpu.obs import trace as _trace
@@ -163,6 +164,12 @@ class ReplayConfig:
             under ``hostprof`` and a mid-run ``GET /profile`` probe proves
             the plane answers over HTTP during the fault window.
         hostprof_rate_hz: sampling rate for the host profiler when live.
+        audit: conservation audit plane. ``None`` (default) enables the
+            continuous :class:`~torchmetrics_tpu.obs.audit.ConservationAuditor`
+            for every scenario — exactly-once accounting is part of what a
+            chaos run proves (the ``accounting_clean`` SLO) — and the final
+            ledger + invariant results land in the run record under
+            ``audit``; ``False`` forces it off.
     """
 
     fuse: int = 2
@@ -184,6 +191,7 @@ class ReplayConfig:
     # profiler exists to answer); True/False force it on/off for any scenario
     hostprof: Optional[bool] = None
     hostprof_rate_hz: float = 200.0
+    audit: Optional[bool] = None
     sync_timeout_seconds: float = 0.05
     flight_dump_dir: Optional[str] = None
     max_events: int = 8192
@@ -502,6 +510,17 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     # this run's ids collision-free either way.
     lineage_was_enabled = _lineage.ENABLED
     _lineage.enable(reset=not lineage_was_enabled)
+    # the conservation audit plane (obs/audit.py): live for the run unless
+    # forced off, so the accounting_clean SLO has evidence. Sessions register
+    # their ledger hooks at construction — install BEFORE _build_tenants. The
+    # caller's auditor (a serving process's) is restored on return.
+    auditor: Optional[_audit.ConservationAuditor] = None
+    auditor_prev: Optional[_audit.ConservationAuditor] = None
+    if config.audit is not False:
+        auditor = _audit.ConservationAuditor(
+            cadence_seconds=max(0.05, config.scrape_interval_seconds)
+        )
+        auditor_prev = _audit.install_auditor(auditor)
     # an auto-created dump dir is consumed (metas read into the result) and
     # removed before returning — repeated replays must not litter the tempdir;
     # a caller-provided directory is theirs to keep
@@ -1372,14 +1391,6 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         if scraper is not None:
             scraper.stop()
         server.stop()
-        # the zombies never serve again; closing them releases resources but
-        # NOT the successors' leases (close only releases a lease whose epoch
-        # still owns the scope row — the fenced epochs don't)
-        for zpipe in zombies.values():
-            try:
-                zpipe.close()
-            except Exception:
-                pass
         if not closed:
             for pipe in pipelines.values():
                 try:
@@ -1391,6 +1402,25 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                     mux.close()
                 except Exception:
                     pass
+        if auditor is not None:
+            # uninstall AFTER the live-session close loop (close() freezes
+            # each session's final ledger rows) but BEFORE the zombie closes
+            # below; the auditor object stays readable for the run-record
+            # join below
+            _audit.install_auditor(auditor_prev)
+        # the zombies never serve again; closing them releases resources but
+        # NOT the successors' leases (close only releases a lease whose epoch
+        # still owns the scope row — the fenced epochs don't). Closed with the
+        # audit plane already detached: a close-time flush of a wedge-split
+        # chunk would fold under the fenced epoch, and in the real deployment
+        # that fold happens on the DEAD host, outside the fencer's process —
+        # its audited footprint is the rejected late bundle (an event this
+        # run already recorded), not a local no_post_fence_fold violation
+        for zpipe in zombies.values():
+            try:
+                zpipe.close()
+            except Exception:
+                pass
 
     cost_delta = _cost.get_ledger().since(cost_mark)
     dump_paths = [path for pipe in pipelines.values() for path in pipe.flight_dumps]
@@ -1525,6 +1555,14 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             # can ship the flamegraph as a CI artifact without re-sampling
             "collapsed": profiler.collapsed(top=500),
         }
+    audit_info = None
+    if auditor is not None:
+        # one final audit pass over the frozen ledgers (the scrape-cadence
+        # gate has long passed by now), then the full /audit-shaped payload:
+        # per-tenant ledgers, invariant results, named violations, fence
+        # events — the accounting_clean SLO's evidence
+        auditor.tick()
+        audit_info = auditor.report()
     reports = {tenant: pipe.report().asdict() for tenant, pipe in pipelines.items()}
     sync_degraded = sorted(
         tenant for tenant, metric in metrics.items() if getattr(metric, "sync_degraded", False)
@@ -1570,6 +1608,10 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         # batch-lineage causality evidence + trace-index cardinality (the
         # fault_causality SLO's input and the recorded-never-judged bench key)
         "lineage": lineage_info,
+        # conservation-audit evidence (None when ReplayConfig.audit=False):
+        # the /audit-shaped payload — per-tenant flow ledgers, invariant
+        # results and named violations — the accounting_clean SLO's input
+        "audit": audit_info,
         # cross-tenant fused dispatch accounting (None when unmultiplexed):
         # the SLO judge's mux-engagement check and the before/after evidence
         # next to the compiled-variant delta above

@@ -133,6 +133,11 @@ class SLOSpec:
     require_fleet_served: bool = False
     require_fleet_shift_tracked: bool = False
     require_fleet_degraded_loud: bool = False
+    # conservation-audit promise (every scenario): the continuous auditor
+    # (obs/audit.py) must have balanced every tenant's flow ledger over the
+    # whole run — zero violations across admission, fusion, migration, crash
+    # recovery and fencing — judged as one strict boolean
+    require_accounting_clean: bool = False
     # routes whose scrape latency is judged (the driver may scrape more)
     scrape_routes: Tuple[str, ...] = ("/metrics", "/alerts", "/tenants")
 
@@ -163,6 +168,7 @@ def high_tenant_slo_spec() -> SLOSpec:
         require_poisoned_named=True,
         require_multiplexed=True,
         require_quarantine_attributed=True,
+        require_accounting_clean=True,
     )
 
 
@@ -187,6 +193,7 @@ def rolling_deploy_slo_spec() -> SLOSpec:
         require_migration_zero_loss=True,
         require_migration_visible=True,
         max_migration_seconds=30.0,
+        require_accounting_clean=True,
     )
 
 
@@ -218,6 +225,7 @@ def host_crash_slo_spec(cadence_batches: int = 4, fuse: int = 2) -> SLOSpec:
         require_crash_zero_loss=True,
         max_recovery_seconds=30.0,
         max_delta_full_ratio=0.8,
+        require_accounting_clean=True,
     )
 
 
@@ -249,6 +257,7 @@ def hung_host_slo_spec() -> SLOSpec:
         require_zombie_writes_rejected=True,
         require_fence_zero_double_count=True,
         require_fence_visible=True,
+        require_accounting_clean=True,
     )
 
 
@@ -283,6 +292,7 @@ def skewed_load_slo_spec() -> SLOSpec:
         require_fleet_served=True,
         require_fleet_shift_tracked=True,
         require_fleet_degraded_loud=True,
+        require_accounting_clean=True,
         scrape_routes=("/metrics", "/alerts", "/tenants", "/fleet"),
     )
 
@@ -1132,6 +1142,56 @@ def judge(
                 if ok
                 else f"no loud degraded sample recorded: {wedged or 'no wedged-sample evidence'}"
             ),
+        )
+
+    # ------------------------------------------------- conservation audit
+    if spec.require_accounting_clean:
+        audit = result.get("audit") or {}
+        violations = audit.get("violations") or []
+        ok = (
+            bool(audit.get("enabled"))
+            and int(audit.get("ticks") or 0) >= 1
+            and not violations
+        )
+        _row(
+            rows,
+            "accounting_clean",
+            float(ok),
+            1.0,
+            "bool",
+            "min",
+            detail=(
+                f"the conservation auditor balanced every flow ledger over"
+                f" {audit.get('ticks')} tick(s) across {audit.get('sessions')}"
+                " session(s): zero violations"
+                + (
+                    " (honest-approximate: lineage records evicted)"
+                    if audit.get("approximate")
+                    else ""
+                )
+                if ok
+                else (
+                    "conservation violations: "
+                    + "; ".join(
+                        f"{v.get('invariant')} [tenant {v.get('tenant')}"
+                        + (
+                            f", trace {v.get('trace_id')}"
+                            if v.get("trace_id")
+                            else ""
+                        )
+                        + f"]: {v.get('detail')}"
+                        for v in violations[:5]
+                    )
+                    if violations
+                    else (
+                        "no audit evidence recorded:"
+                        f" {audit or 'audit plane was off'}"
+                    )
+                )
+            ),
+        )
+        config(
+            f"{prefix}_audit_violations", float(len(violations)), "violations", None
         )
 
     failed = [row["slo"] for row in rows if not row["passed"]]

@@ -108,6 +108,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple, Union
 
 import numpy as np
 
+import torchmetrics_tpu.obs.audit as _audit
 import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
@@ -681,6 +682,10 @@ def _capture_mux_slice(
     log = _resolve_value_log(value_log, engine)
     if flush_pending:
         backlog = mux._deferred.pop(effective, None) or []
+        if _audit.ENABLED and backlog:
+            # the backlog leaves with the bundle: conserved as handed-off
+            # work, completed by the restoring session under its own ledger
+            _audit.note_handed_off(mux, "mux", effective, len(backlog))
     else:
         backlog = list(mux._deferred.get(effective) or [])
     tail_batches = [(tuple(a), dict(k), t) for a, k, t in backlog]

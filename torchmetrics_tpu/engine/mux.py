@@ -69,6 +69,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import torchmetrics_tpu.obs.audit as _audit
 import torchmetrics_tpu.obs.cost as _cost
 import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
@@ -230,10 +231,23 @@ class MuxReport:
             return None
         return self.host_dispatches() / landed
 
+    def processed_batches(self) -> int:
+        """Canonical processed count: every tenant-update that landed."""
+        return self.fused_updates + self.eager_updates + self.replayed_updates
+
     def asdict(self) -> Dict[str, Any]:
         out = {f.name: getattr(self, f.name) for f in fields(self)}
         out["host_dispatches"] = self.host_dispatches()
         out["dispatches_per_update"] = self.dispatches_per_update()
+        # canonical vocabulary shared with PipelineReport.asdict — the mux's
+        # historical `*_updates` / `padded_rows` / `order_flushes` names stay
+        # as back-compat aliases of the same quantities
+        out["processed_batches"] = self.processed_batches()
+        out["fused_batches"] = self.fused_updates
+        out["eager_batches"] = self.eager_updates
+        out["replayed_batches"] = self.replayed_updates
+        out["padded_steps"] = self.padded_rows
+        out["shape_flushes"] = self.order_flushes
         return out
 
 
@@ -389,6 +403,12 @@ class TenantMultiplexer:
         # and processed ordinals no longer line up — slice captures and the
         # covering-checkpoint join consult this (per tenant, not mux-global)
         self._tenant_detours: Dict[str, int] = {}
+        # per-tenant ledger splits of the detours (the conservation auditor's
+        # inputs — mux-global report counters can't attribute a shed row):
+        # sheds, defer decisions, and deferred rows later replayed
+        self._tenant_shed: Dict[str, int] = {}
+        self._tenant_deferred: Dict[str, int] = {}
+        self._tenant_deferred_replayed: Dict[str, int] = {}
         # per-tenant PROCESSED counts (fused commits + eager + replays): the
         # slice-checkpoint cursor — never counts a row still pending in an
         # open group, so every slice bundle is commit-consistent
@@ -441,6 +461,8 @@ class TenantMultiplexer:
             "renewed_unix": _lease_now,
         }
         self._lease_renew_at = _lease_now + config.lease_seconds / 4.0
+        if _audit.ENABLED:
+            _audit.track(self, "mux", self._label)
         for tenant, metric in (metrics or {}).items():
             self.adopt(tenant, metric)
         # persistent compile cache wiring is part of engine startup (no-op
@@ -736,6 +758,7 @@ class TenantMultiplexer:
                     backlog.append((args, kwargs, trace_id))
                     self._report.deferred_batches += 1
                     self._tenant_detours[tenant] = self._tenant_detours.get(tenant, 0) + 1
+                    self._tenant_deferred[tenant] = self._tenant_deferred.get(tenant, 0) + 1
                     if trace_id is not None:
                         _lineage.get_index().update(trace_id, outcome="deferred")
                     if _trace.ENABLED:
@@ -744,6 +767,7 @@ class TenantMultiplexer:
             if decision == _scope.SHED:
                 self._report.shed_batches += 1
                 self._tenant_detours[tenant] = self._tenant_detours.get(tenant, 0) + 1
+                self._tenant_shed[tenant] = self._tenant_shed.get(tenant, 0) + 1
                 if trace_id is not None:
                     _lineage.get_index().update(trace_id, outcome="shed")
                 if tenant not in self._shed_warned:
@@ -763,6 +787,9 @@ class TenantMultiplexer:
             if backlog:
                 for b_args, b_kwargs, b_trace_id in backlog:
                     self._report.deferred_replayed += 1
+                    self._tenant_deferred_replayed[tenant] = (
+                        self._tenant_deferred_replayed.get(tenant, 0) + 1
+                    )
                     controller.charge(tenant, updates=1)
                     self._ingest(tenant, b_args, b_kwargs, trace_id=b_trace_id)
             controller.charge(tenant, updates=1)
@@ -897,6 +924,9 @@ class TenantMultiplexer:
             for tenant, backlog in deferred.items():
                 for args, kwargs, trace_id in backlog:
                     self._report.deferred_replayed += 1
+                    self._tenant_deferred_replayed[tenant] = (
+                        self._tenant_deferred_replayed.get(tenant, 0) + 1
+                    )
                     self._ingest(tenant, args, kwargs, trace_id=trace_id)
                     drained += 1
             return drained
@@ -914,6 +944,9 @@ class TenantMultiplexer:
             backlog = self._deferred.pop(tenant, None) or []
             for args, kwargs, trace_id in backlog:
                 self._report.deferred_replayed += 1
+                self._tenant_deferred_replayed[tenant] = (
+                    self._tenant_deferred_replayed.get(tenant, 0) + 1
+                )
                 controller.charge(tenant, updates=1)
                 self._ingest(tenant, args, kwargs, trace_id=trace_id)
                 drained += 1
@@ -932,6 +965,9 @@ class TenantMultiplexer:
         for tenant, backlog in deferred.items():
             for args, kwargs, trace_id in backlog:
                 self._report.deferred_replayed += 1
+                self._tenant_deferred_replayed[tenant] = (
+                    self._tenant_deferred_replayed.get(tenant, 0) + 1
+                )
                 if controller is not None:
                     controller.charge(tenant, updates=1)
                 self._ingest(tenant, args, kwargs, trace_id=trace_id)
@@ -964,6 +1000,9 @@ class TenantMultiplexer:
                     # failed-over tenant's fresh lease must stay live
                     if lease_rows.get(tenant, {}).get("epoch") == self._lease["epoch"]:
                         _scope.note_lease_released(tenant)
+                if _audit.ENABLED:
+                    # freeze every tenant's final ledger rows for the merge
+                    _audit.note_close(self)
         return self.report()
 
     def __enter__(self) -> "TenantMultiplexer":
@@ -1368,6 +1407,8 @@ class TenantMultiplexer:
                 record["chunk_id"] = gid
                 record["path"] = "mux"
             tid = row[4] if len(row) > 4 else None
+            if _audit.ENABLED:
+                _audit.note_fold(self, "mux", tenant, self._lineage_epoch, tid)
             if tid is not None:
                 _lineage.get_index().update(tid, chunk_id=gid, path="mux", outcome="ok")
         self._report.dispatches += 1
@@ -1498,6 +1539,8 @@ class TenantMultiplexer:
         self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.eager_updates += 1
         self._report.eager_dispatches += 1
+        if _audit.ENABLED:
+            _audit.note_fold(self, "mux", tenant, self._lineage_epoch, trace_id)
         if _trace.ENABLED:
             _trace.inc("engine.mux_eager_updates", mux=self._label)
         self._mark_eager_fault(tenant, record, before, trace_id)
@@ -1536,6 +1579,8 @@ class TenantMultiplexer:
         self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.eager_updates += 1
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
+        if _audit.ENABLED:
+            _audit.note_fold(self, "mux", tenant, self._lineage_epoch, trace_id)
         self._mark_eager_fault(tenant, record, before, trace_id)
         self._maybe_checkpoint()
         self._evaluate_alerts(
@@ -1559,6 +1604,11 @@ class TenantMultiplexer:
         self._tenant_folded[tenant] = self._tenant_folded.get(tenant, 0) + 1
         self._report.replayed_updates += 1
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics(target)))
+        if _audit.ENABLED:
+            # the ambient trace context is set by _replay_rows around this call
+            _audit.note_fold(
+                self, "mux", tenant, self._lineage_epoch, _lineage.current_trace()
+            )
         if _trace.ENABLED:
             _trace.inc("engine.mux_replayed_updates", mux=self._label, tenant=tenant)
 

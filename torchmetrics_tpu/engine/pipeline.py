@@ -80,6 +80,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import torchmetrics_tpu.obs.audit as _audit
 import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
@@ -264,10 +265,15 @@ class PipelineReport:
             return None
         return self.host_dispatches() / self.batches
 
+    def processed_batches(self) -> int:
+        """Canonical processed count: every batch that reached a dispatch."""
+        return self.fused_batches + self.eager_batches + self.replayed_batches
+
     def asdict(self) -> Dict[str, Any]:
         out = {f.name: getattr(self, f.name) for f in fields(self)}
         out["host_dispatches"] = self.host_dispatches()
         out["dispatches_per_batch"] = self.dispatches_per_batch()
+        out["processed_batches"] = self.processed_batches()
         return out
 
 
@@ -550,6 +556,8 @@ class MetricPipeline:
             self._tenant, epoch=self._lineage_epoch, ttl_seconds=config.lease_seconds
         )
         self._lease_renew_at = time.time() + config.lease_seconds / 4.0
+        if _audit.ENABLED:
+            _audit.track(self, "pipeline", self._label)
         # wiring the persistent compile cache is part of engine startup: no-op
         # unless TM_TPU_COMPILE_CACHE (or an earlier explicit call) set a dir
         _warmup.configure_compile_cache()
@@ -826,6 +834,10 @@ class MetricPipeline:
             if _trace.ENABLED:
                 _trace.set_gauge("engine.in_flight", 0, pipeline=self._label, inst=self._instance)
             tail, self._deferred = self._deferred, []
+            if _audit.ENABLED:
+                # drained tail batches leave with the bundle: conserved as
+                # handed-off work, completed by the restoring session
+                _audit.note_handed_off(self, "pipeline", self._tenant, len(tail))
             return tail
 
     def replay_tail(self, batches: Iterable[tuple], deferred: int = 0) -> int:
@@ -914,6 +926,10 @@ class MetricPipeline:
                 self._tenant if self._tenant is not None else "__local__", {}
             ).get("epoch") == self._lease["epoch"]:
                 _scope.note_lease_released(self._tenant)
+            if _audit.ENABLED:
+                # freeze this generation's final ledger rows — they keep
+                # feeding the per-tenant merge after the object dies
+                _audit.note_close(self)
         return self.report()
 
     def compute(self) -> Any:
@@ -1327,6 +1343,9 @@ class MetricPipeline:
         self._report.padded_steps += pad
         self._report.max_chunk = max(self._report.max_chunk, n)
         self._report.last_chunk = n
+        if _audit.ENABLED:
+            for tid in chunk.trace_ids:
+                _audit.note_fold(self, "pipeline", self._tenant, self._lineage_epoch, tid)
         if _trace.ENABLED:
             _trace.inc("engine.dispatches", pipeline=self._label)
             _trace.inc("engine.fused_batches", n, pipeline=self._label)
@@ -1471,6 +1490,8 @@ class MetricPipeline:
                 self._suppressing_refault(lambda: self._target.update(*args, **kwargs))
         self._report.eager_batches += 1
         self._report.eager_dispatches += 1
+        if _audit.ENABLED:
+            _audit.note_fold(self, "pipeline", self._tenant, self._lineage_epoch, trace_id)
         if _trace.ENABLED:
             _trace.inc("engine.eager_batches", pipeline=self._label)
         waited = self._ticket(self._current_any_state())
@@ -1537,6 +1558,8 @@ class MetricPipeline:
         # one host dispatch per driven metric (multi-group collections issue
         # several updates per batch), matching _drive_eager_leaders' accounting
         self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
+        if _audit.ENABLED:
+            _audit.note_fold(self, "pipeline", self._tenant, self._lineage_epoch, trace_id)
         if attributed:
             if trace_id is not None:
                 _lineage.get_index().update(trace_id, path="eager", outcome="ok")
@@ -1611,6 +1634,8 @@ class MetricPipeline:
                 raise
             self._report.replayed_batches += 1
             self._report.eager_dispatches += max(1, len(self._per_batch_metrics()))
+            if _audit.ENABLED:
+                _audit.note_fold(self, "pipeline", self._tenant, self._lineage_epoch, tid)
             if _trace.ENABLED:
                 _trace.inc("engine.replayed_batches", pipeline=self._label)
             if attributed:

@@ -55,6 +55,15 @@ recorder:
   poisoned-row replay, the multiplexer, migration tails and crash-recovery
   re-feeds; a bounded trace-id index behind ``GET /trace/<id>``, histogram
   exemplars, and Perfetto flow events.
+- :mod:`~torchmetrics_tpu.obs.audit` — the conservation audit plane:
+  a continuous auditor deriving, per tenant and session, the flow ledger
+  ``fed = processed + shed + deferred_pending + quarantined + skipped +
+  in_flight`` from the lineage/admission/checkpoint/fence seams and checking
+  exactly-once invariants per scrape tick (no double folds, no post-fence
+  folds, coverage ≤ cursor, deferred drain-or-age, billed-vs-executed
+  reconciliation); served on ``GET /audit``, exported as ``audit.*`` gauges,
+  with an offline checkpoint-stream CLI
+  (``python -m torchmetrics_tpu.obs.audit``).
 - :mod:`~torchmetrics_tpu.obs.hostprof` — continuous host-path sampling
   profiler: a daemon thread walks ``sys._current_frames()`` at a configurable
   rate, classifies every sample against the runtime's known seams (ingest,
@@ -91,6 +100,7 @@ Typical use::
 from torchmetrics_tpu.obs import (
     aggregate,
     alerts,
+    audit,
     cost,
     export,
     hostprof,
@@ -106,6 +116,7 @@ from torchmetrics_tpu.obs import (
 )
 from torchmetrics_tpu.obs.aggregate import host_snapshot, merge_snapshots
 from torchmetrics_tpu.obs.alerts import AlertEngine, AlertRule
+from torchmetrics_tpu.obs.audit import ConservationAuditor
 from torchmetrics_tpu.obs.cost import get_ledger as cost_ledger
 from torchmetrics_tpu.obs.export import collect, prometheus_text, summary, write_jsonl
 from torchmetrics_tpu.obs.hostprof import HostProfiler
@@ -138,6 +149,7 @@ from torchmetrics_tpu.obs.trace import (
 __all__ = [
     "AlertEngine",
     "AlertRule",
+    "ConservationAuditor",
     "HostProfiler",
     "IntrospectionServer",
     "TenantRegistry",
@@ -145,6 +157,7 @@ __all__ = [
     "aggregate",
     "alerts",
     "annotate",
+    "audit",
     "chrome_trace",
     "collect",
     "cost",

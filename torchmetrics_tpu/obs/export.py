@@ -358,6 +358,15 @@ _GAUGE_HELP = {
     "hostprof.self_overhead_percent": "Measured sampler busy time as a percent of profiled wall time",
     "hostprof.attributed_percent": "Percent of attributable host samples landing in a named runtime seam (not 'other')",
     "hostprof.seam_seconds": "Sampled host seconds attributed to the labeled runtime seam",
+    # conservation-audit families (obs/audit.py): the exactly-once accounting
+    # plane — all gauges (point-in-time ledger state), never _total
+    "audit.sessions": "Pipeline/mux sessions the conservation auditor is tracking (live + frozen)",
+    "audit.approximate": "1 when the ledger is honest-approximate (lineage or fold-id eviction occurred), else 0",
+    "audit.fed": "Batches fed to the labeled tenant across non-fenced epochs (arrival-counter ledger total)",
+    "audit.processed": "Batches processed (folded minus quarantined/skipped) for the labeled tenant across non-fenced epochs",
+    "audit.shed": "Batches shed by admission for the labeled tenant across non-fenced epochs",
+    "audit.deferred_pending": "Deferred batches still awaiting replay for the labeled tenant",
+    "audit.violations": "Conservation-audit violations (per labeled invariant, plus the unlabeled total the audit_violation preset watches)",
 }
 
 

@@ -66,7 +66,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             " through a lineage-enabled pipeline) with values+alerts enabled, so"
             " /tenants, ?tenant= filters, a firing non_finite alert AND a"
             " curl-able GET /trace/<id> lineage story are demonstrable out of"
-            " the box"
+            " the box; a conservation auditor is installed with one deliberate"
+            " behind-the-auditor update seeded, so GET /audit shows a named"
+            " violation too"
         ),
     )
     args = parser.parse_args(argv)
@@ -83,6 +85,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             from torchmetrics_tpu.aggregation import MeanMetric
             from torchmetrics_tpu.engine.pipeline import MetricPipeline, PipelineConfig
             from torchmetrics_tpu.obs import alerts as _alerts
+            from torchmetrics_tpu.obs import audit as _audit
             from torchmetrics_tpu.obs import lineage as _lineage
             from torchmetrics_tpu.obs import scope as _scope
             from torchmetrics_tpu.obs import values as _values
@@ -95,6 +98,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                 # sustained load skew (fleet.imbalance from the sampler below)
                 # fires through the same pending->firing machinery
                 _fleet.imbalance_rule(),
+                # a conservation-audit violation degrades /healthz through the
+                # same pending->firing machinery
+                _audit.audit_violation_rule(),
+            )
+            # the conservation audit plane: installed BEFORE the demo pipeline
+            # so the session registers with the auditor at construction.
+            # confirm_ticks=1 — the demo is single-threaded, so the seeded
+            # violation below is visible on the very first /audit curl
+            _audit.install_auditor(
+                _audit.ConservationAuditor(cadence_seconds=0.5, confirm_ticks=1)
             )
             with _scope.scope("tenant-a"):
                 healthy = MeanMetric()
@@ -113,6 +126,15 @@ def main(argv: Optional[List[str]] = None) -> int:
             pipe.feed(jnp.asarray([1.0, 0.5]), jnp.zeros(2))
             pipe.feed(jnp.asarray([1.0, float("nan")]), jnp.zeros(2))
             demo_trace_id = pipe.trace_id_for(1)  # the injected-NaN batch
+            pipe.flush()
+            # the deliberate conservation violation: one update driven through
+            # the raw pure_update/commit seam — real work, executed and
+            # counted by the metric, but invisible to the auditor's fold
+            # hooks. The exec_reconcile invariant catches it (updates_ok >
+            # ledger folds) and names tenant-b plus the newest folded trace id
+            state = dict(poisoned.__dict__["_state_values"])
+            state = poisoned.pure_update(state, jnp.asarray([2.0, 1.0]), jnp.zeros(2))
+            poisoned._engine_commit_state(state, 1)
             pipe.close()
             with _scope.scope("tenant-b"):
                 poisoned.compute()
@@ -158,6 +180,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                 " | python -m json.tool",
                 flush=True,
             )
+        print(
+            f"conservation audit: curl -s {server.url}/audit | python -m json.tool"
+            " (one exec_reconcile violation seeded on tenant-b: an update"
+            " committed behind the auditor's back)",
+            flush=True,
+        )
     try:
         if args.duration is not None:
             deadline = time.monotonic() + args.duration
@@ -171,9 +199,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     finally:
         _server.stop()
         if args.demo:
-            # the demo sampler is scoped to this serve run: leaving the
-            # singleton installed would leak it into a library caller's process
+            # the demo sampler/auditor are scoped to this serve run: leaving
+            # the singletons installed would leak them into a library caller's
+            # process
             _fleet.install_sampler(None)
+            from torchmetrics_tpu.obs import audit as _audit
+
+            _audit.install_auditor(None)
     return 0
 
 

@@ -98,6 +98,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
+import torchmetrics_tpu.obs.audit as _audit
 import torchmetrics_tpu.obs.lineage as _lineage
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as trace
@@ -137,6 +138,7 @@ ROUTES = (
     "/fleet",
     "/fleet/history",
     "/profile",
+    "/audit",
     "/traces",
     "/trace/<id>",
 )
@@ -151,6 +153,7 @@ _TENANT_ROUTES = (
     "/fleet",
     "/fleet/history",
     "/profile",
+    "/audit",
 )
 
 
@@ -286,6 +289,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(owner.leases_report())
             elif route == "/fleet":
                 self._send_json(owner.fleet_report(tenant=tenant))
+            elif route == "/audit":
+                self._send_json(owner.audit_report(tenant=tenant))
             elif route == "/profile":
                 try:
                     top_k = _parse_top(query)
@@ -733,6 +738,29 @@ class IntrospectionServer:
                 self._rec_inc("server.errors", route="/fleet(alerts)")
         return {"enabled": True, **payload}
 
+    def audit_report(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The ``GET /audit`` page: the conservation auditor's ledger.
+
+        Per-tenant flow-ledger rows (fed = processed + shed + deferred_pending
+        + quarantined + skipped + in_flight), the per-invariant pass/violation
+        results and every named violation (tenant + invariant + trace id). A
+        fresh :meth:`~torchmetrics_tpu.obs.audit.ConservationAuditor.tick` runs
+        first so the page never serves a stale ledger. With no auditor
+        installed the page says so instead of 404ing — "the plane is off" is
+        an answer, not a missing route.
+        """
+        auditor = _audit.get_auditor()
+        if auditor is None:
+            return {
+                "enabled": False,
+                "error": "no conservation auditor installed (obs.audit.install_auditor)",
+            }
+        try:
+            auditor.tick()
+        except Exception:
+            self._rec_inc("server.errors", route="/audit(tick)")
+        return auditor.report(tenant=tenant)
+
     def profile_report(
         self,
         tenant: Optional[str] = None,
@@ -965,6 +993,17 @@ class IntrospectionServer:
                 profiler.record_gauges(recorder=self.recorder)
         except Exception:  # profiling must never break the scrape
             self._rec_inc("server.errors", route="/metrics(hostprof)")
+        try:
+            # the conservation auditor rides the scrape loop too (cadence
+            # gated + coalesced inside tick()): every /metrics pull doubles
+            # as an invariant check, and the audit.* gauge families always
+            # carry the current ledger
+            auditor = _audit.get_auditor()
+            if auditor is not None:
+                auditor.tick()
+                auditor.record_gauges(recorder=self.recorder)
+        except Exception:  # auditing must never break the scrape
+            self._rec_inc("server.errors", route="/metrics(audit)")
         if _lineage.ENABLED:
             try:
                 # trace-index cardinality gauges (lineage.* families)
@@ -1126,6 +1165,33 @@ class IntrospectionServer:
                 f" {row.get('holder')} silent for {row.get('age', 0.0):.1f}s"
                 " past expiry (hung host suspected, failover pending)"
             )
+        # conservation-audit violations (obs/audit.py): a broken exactly-once
+        # invariant degrades — not kills — with tenant + invariant + trace id
+        # named; distinct from quarantine (a poisoned batch, accounted) and
+        # fencing (a zombie holder, accounted) — THIS means the accounting
+        # itself stopped balancing
+        audit_violations: List[Dict[str, Any]] = []
+        auditor = _audit.get_auditor()
+        if auditor is not None:
+            try:
+                auditor.tick()  # cadence-gated: a no-op within the cadence
+                audit_violations = list(auditor.report().get("violations", []))
+            except Exception:
+                self._rec_inc("server.errors", route="/healthz(audit)")
+        for violation in audit_violations:
+            tenant = violation.get("tenant")
+            if tenant:
+                tenants_degraded.add(tenant)
+            reasons.append(
+                f"conservation audit violation {violation.get('invariant')!r}"
+                + (f" [tenant {tenant}]" if tenant else "")
+                + (
+                    f" (trace {violation['trace_id']})"
+                    if violation.get("trace_id")
+                    else ""
+                )
+                + f": {violation.get('detail')}"
+            )
         status = "degraded" if reasons else "ok"
         return {
             "status": status,
@@ -1145,6 +1211,9 @@ class IntrospectionServer:
             # leases: the fencing story in one page
             "tenants_fenced": tenants_fenced,
             "leases_stale": leases_stale,
+            # conservation-audit violations, each naming tenant + invariant +
+            # trace id (empty when the plane is off or the ledger balances)
+            "audit_violations": audit_violations,
             "n_metrics": len(self.metrics()),
             "trace_enabled": trace.is_enabled(),
         }
