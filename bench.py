@@ -1076,6 +1076,7 @@ def _chaos_main(argv) -> None:
             "host_crash",
             "hung_host",
             "skewed_load",
+            "flash_crowd",
         ),
         default="default",
         help="high_tenant: >=64 tenants with shared signatures and bursty arrivals,"
@@ -1106,7 +1107,17 @@ def _chaos_main(argv) -> None:
              " signals, GET /fleet) must page on the imbalance within budget"
              " from fleet samples alone, track a mid-run hot-spot shift, and"
              " degrade loudly when a gather wedges, judged against the"
-             " skewed-load SLO spec (configs prefixed chaos_sk_*)",
+             " skewed-load SLO spec (configs prefixed chaos_sk_*)."
+             " flash_crowd: the whole crowd lands on one of two provisioned"
+             " virtual hosts (two tenants running hot at a heavy factor, a"
+             " mid-run hot-spot shift); the placement controller"
+             " (torchmetrics_tpu/fleet/) must fix the measured skew with real"
+             " drain/checkpoint/restore session moves and re-converge after"
+             " the shift; a static-placement control arm replays the same"
+             " schedule first for the throughput-ratio floor; judged against"
+             " the flash-crowd SLO spec incl. convergence budget, zero-loss"
+             " bit-identity vs unmoved controls, durable table restore and"
+             " GET /placement service (configs prefixed chaos_fc_*)",
     )
     parser.add_argument(
         "--chaos-schedule", default=None,
@@ -1163,6 +1174,10 @@ def _chaos_main(argv) -> None:
         sched = chaos.generate(
             chaos.skewed_load_config(seed=args.chaos_seed, tenants=max(4, args.chaos_tenants))
         )
+    elif args.chaos_scenario == "flash_crowd":
+        sched = chaos.generate(
+            chaos.flash_crowd_config(seed=args.chaos_seed, tenants=max(12, args.chaos_tenants))
+        )
     else:
         sched = chaos.generate(
             chaos.ScheduleConfig(seed=args.chaos_seed, tenants=args.chaos_tenants)
@@ -1206,6 +1221,36 @@ def _chaos_main(argv) -> None:
         # mid-run hot-spot shift, and degrade loudly when a gather wedges
         result = chaos.replay(sched, chaos.ReplayConfig(skewed_load=True))
         report = chaos.judge(result, chaos.skewed_load_slo_spec(), prefix="chaos_sk")
+    elif args.chaos_scenario == "flash_crowd":
+        # the placement-control-plane scenario: every tenant lands on virtual
+        # host "0" under a LIVE PlacementController — reconcile ticks ride the
+        # /metrics scrape loop, moves are real drain→checkpoint→restore
+        # handoffs, and the hot spot shifts mid-run. The control arm replays
+        # the IDENTICAL schedule with the controller off first: the
+        # throughput-ratio floor proves the controller does not cost
+        # meaningful throughput (same-host virtual moves cannot prove it
+        # adds any — see PERF.md)
+        control = chaos.replay(
+            sched, chaos.ReplayConfig(flash_crowd=True, placement_enabled=False)
+        )
+        result = chaos.replay(sched, chaos.ReplayConfig(flash_crowd=True))
+        if result.get("placement") is not None:
+            result["placement"]["control_arm_updates_per_second"] = control.get(
+                "updates_per_second"
+            )
+            # the full sample the judge needs to compare both arms net of
+            # their own measured compile wall and scheduled idle (each arm
+            # pays a different compile bill: moves mint fresh programs)
+            result["placement"]["control_arm"] = {
+                "batches_fed": control.get("batches_fed"),
+                "wall_seconds": control.get("wall_seconds"),
+                "sleep_seconds": control.get("sleep_seconds"),
+                "compile_seconds": (control.get("cost") or {}).get(
+                    "compile_seconds"
+                ),
+                "updates_per_second": control.get("updates_per_second"),
+            }
+        report = chaos.judge(result, chaos.flash_crowd_slo_spec(), prefix="chaos_fc")
     else:
         result = chaos.replay(sched)
         report = chaos.judge(result)
@@ -1244,8 +1289,20 @@ def _chaos_main(argv) -> None:
             "crash": result.get("crash"),
             # hung-host fencing accounting (None unless hung_host)
             "fence": result.get("fence"),
-            # fleet-telemetry accounting (None unless skewed_load)
+            # fleet-telemetry accounting (None unless skewed_load/flash_crowd)
             "fleet": result.get("fleet"),
+            # placement-control-plane accounting (None unless flash_crowd);
+            # the bulky decision log + /placement probe payload stay out of
+            # the history line — the full detail lands in --chaos-report
+            "placement": (
+                {
+                    key: value
+                    for key, value in result["placement"].items()
+                    if key not in ("report", "probe")
+                }
+                if isinstance(result.get("placement"), dict)
+                else None
+            ),
             # batch-lineage causality rows (trace id → dump/alert links)
             "lineage_poisoned": (result.get("lineage") or {}).get("poisoned"),
         },

@@ -927,3 +927,162 @@ class TestObsRoutes:
                     pipe.close()
             finally:
                 lineage.disable()
+
+
+# -------------------------------------- failover election + placement target
+
+
+class TestFailoverElection:
+    def test_claim_winner_takes_the_epoch_same_epoch_losers_stand_down(self, tmp_path):
+        directory = str(tmp_path)
+        assert fence_mod.claim_failover(directory, "ep-1", by="host-a") is True
+        assert fence_mod.claim_failover(directory, "ep-1", by="host-b") is False
+        with open(os.path.join(directory, fence_mod.CLAIM_FILE), encoding="utf-8") as fh:
+            claim = json.load(fh)
+        assert claim["epoch"] == "ep-1" and claim["by"] == "host-a"
+
+    def test_stale_epoch_leftover_is_litter_not_a_leader(self, tmp_path):
+        directory = str(tmp_path)
+        assert fence_mod.claim_failover(directory, "ep-old", by="host-a")
+        # a NEW epoch's election clears the completed failover's claim and wins
+        assert fence_mod.claim_failover(directory, "ep-new", by="host-b") is True
+        with open(os.path.join(directory, fence_mod.CLAIM_FILE), encoding="utf-8") as fh:
+            claim = json.load(fh)
+        assert claim["epoch"] == "ep-new" and claim["by"] == "host-b"
+
+    def test_losing_watchdog_yields_counts_and_unwatches(self, tmp_path):
+        pipe = _cat_session(tmp_path, "el-t", lease_seconds=30.0)
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pipe.checkpoint_now()
+        dog = fence_mod.Watchdog()
+        dog.watch(
+            "el-t",
+            directory,
+            lambda: CatMetric(capacity=1 << 12, nan_strategy="disable"),
+            fence_mod.WatchdogConfig(
+                restore_overrides={
+                    "checkpoint": CheckpointPolicy(
+                        directory=directory, every_batches=1, segment_bytes=4096
+                    )
+                }
+            ),
+        )
+        # another survivor already owns THIS epoch's failover
+        assert fence_mod.claim_failover(directory, pipe.lineage_epoch, by="other-host")
+        before = obs_scope.failover_yielded_count()
+        with trace.observe():
+            assert dog.tick(now=time.time() + 999.0) == []
+            counters = trace.get_recorder()._counters
+        assert obs_scope.failover_yielded_count() == before + 1
+        assert any(key[0] == "fence.failover_yielded" for key in counters)
+        # the loser did NOT fence — the winner's fence is the tenant's truth
+        assert not obs_scope.is_fenced(pipe.lineage_epoch)
+        # and stood down for good: no racing restore on the next tick
+        assert "el-t" not in dog._watches
+        assert dog.tick(now=time.time() + 9999.0) == []
+        # the yield count rides the standard gauge surface
+        rec = trace.TraceRecorder()
+        obs_scope.record_gauges(recorder=rec)
+        page = obs_export.prometheus_text(recorder=rec)
+        match = re.search(r"^tm_tpu_fence_failover_yielded (\d+)(?:\.0)?$", page, re.M)
+        assert match is not None and int(match.group(1)) >= 1
+        pipe.close()
+
+
+class TestPlacementDelegation:
+    class _Loads:
+        """Duck-typed fleet sampler: host ``cold`` measurably the idle one."""
+
+        cadence_seconds = 1.0
+        placement = {}
+
+        def rates(self, window=None):
+            return {
+                "hosts": {
+                    "hot": {"updates_per_second": 30.0, "flops_per_second": 0.0},
+                    "cold": {"updates_per_second": 1.0, "flops_per_second": 0.0},
+                },
+                "tenants": {},
+            }
+
+        def skew(self, rates=None):
+            return {"imbalance": 0.0}
+
+        def rebalance_hints(self, rates=None, skew=None):
+            return {"hints": []}
+
+        def history(self):
+            return [{}]
+
+    def test_watchdog_restore_target_is_the_controllers_choice(self, tmp_path):
+        """Satellite regression: with a placement controller installed, the
+        watchdog's failover target is the controller's least-loaded live host,
+        not the fencer itself — and the choice lands in the fence record AND
+        the placement table."""
+        from torchmetrics_tpu import fleet as fleet_pkg
+
+        pipe = _cat_session(tmp_path, "del-t", lease_seconds=30.0)
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pipe.checkpoint_now()
+        controller = fleet_pkg.PlacementController(
+            fleet_pkg.PlacementConfig(hosts=("hot", "cold")), sampler=self._Loads()
+        )
+        controller.seed({"del-t": "hot"})
+        previous = fleet_pkg.install_controller(controller)
+        swaps = []
+        try:
+            dog = fence_mod.Watchdog(on_failover=lambda p, r: swaps.append(p))
+            dog.watch(
+                "del-t",
+                directory,
+                lambda: CatMetric(capacity=1 << 12, nan_strategy="disable"),
+                fence_mod.WatchdogConfig(
+                    restore_overrides={
+                        "checkpoint": CheckpointPolicy(
+                            directory=directory, every_batches=1, segment_bytes=4096
+                        )
+                    }
+                ),
+            )
+            produced = dog.tick(now=time.time() + 999.0)
+            assert len(produced) == 1
+            report = produced[0]
+            assert report["target"] == "cold"  # least loaded, never the origin
+            assert obs_scope.fence_status()[report["fenced_epoch"]]["target"] == "cold"
+            row = controller.assignments()["del-t"]
+            assert row["host"] == "cold" and row["source"] == "failover"
+        finally:
+            fleet_pkg.install_controller(previous)
+            for p in swaps:
+                p.close()
+            pipe.close()
+
+    def test_without_a_controller_the_target_defaults_to_the_fencer(self, tmp_path):
+        pipe = _cat_session(tmp_path, "nodel-t", lease_seconds=30.0)
+        directory = pipe.config.checkpoint.directory
+        _feed(pipe, 2)
+        pipe.checkpoint_now()
+        swaps = []
+        dog = fence_mod.Watchdog(on_failover=lambda p, r: swaps.append(p))
+        dog.watch(
+            "nodel-t",
+            directory,
+            lambda: CatMetric(capacity=1 << 12, nan_strategy="disable"),
+            fence_mod.WatchdogConfig(
+                restore_overrides={
+                    "checkpoint": CheckpointPolicy(
+                        directory=directory, every_batches=1, segment_bytes=4096
+                    )
+                }
+            ),
+        )
+        try:
+            produced = dog.tick(now=time.time() + 999.0)
+            assert len(produced) == 1
+            assert produced[0]["target"] == fence_mod.holder_id()
+        finally:
+            for p in swaps:
+                p.close()
+            pipe.close()

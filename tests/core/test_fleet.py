@@ -299,3 +299,58 @@ class TestFleetRoutes:
         with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
             assert resp.status == 200
         assert s.samples_taken == 1  # cadence not elapsed: the tick coalesced
+
+
+# ------------------------------------------------- hint hygiene + restore rows
+
+
+class TestHintsBusyFilter:
+    """Regression: hints must never advise moving a tenant already in motion.
+
+    A rebalance hint for a tenant mid-migration is a double-drain invitation,
+    and one for a fenced tenant points at a session that no longer exists —
+    both were previously ranked like any other row."""
+
+    def _loaded(self):
+        s, clock, _ = _sampler(placement={"a": "0", "b": "0", "c": "1"})
+        s.sample()
+        for tenant, n in (("a", 30), ("b", 10), ("c", 0)):
+            _feed(tenant, n=n)
+        clock[0] = 1.0
+        s.sample()
+        return s
+
+    def test_migrating_tenant_drops_out_of_the_advice(self):
+        s = self._loaded()
+        assert [h["tenant"] for h in s.rebalance_hints()["hints"]] == ["a", "b"]
+        with obs_scope.migration("a", "drain"):
+            assert [h["tenant"] for h in s.rebalance_hints()["hints"]] == ["b"]
+        # the filter releases with the migration: the advice returns
+        assert [h["tenant"] for h in s.rebalance_hints()["hints"]] == ["a", "b"]
+
+    def test_fenced_tenant_is_not_advice(self):
+        s = self._loaded()
+        obs_scope.note_fence("ep-busy", tenant="b")
+        assert [h["tenant"] for h in s.rebalance_hints()["hints"]] == ["a"]
+
+
+class TestRestoreRowMaxSemantics:
+    def test_same_process_restore_does_not_double_count(self):
+        _feed("m", n=40)
+        reg = obs_scope.get_registry()
+        # the in-process restore (a placement rebalance) carries totals this
+        # registry already counted: the merge is a high-water max, not an add
+        assert reg.restore_row("m", updates=40)["updates"] == 40
+        # a pristine-host restore still jumps to the carried total
+        assert reg.restore_row("m", updates=100)["updates"] == 100
+
+    def test_rate_consumer_sees_no_phantom_burst_across_a_move(self):
+        s, clock, _ = _sampler(placement={"m": "0"})
+        _feed("m", n=100)
+        s.sample()
+        clock[0] = 1.0
+        # the rebalance restore lands in the SAME process mid-window: the
+        # sampler must not read the carried total as an instant burst
+        obs_scope.get_registry().restore_row("m", updates=100)
+        s.sample()
+        assert s.rates()["tenants"]["m"]["updates_per_second"] == 0.0

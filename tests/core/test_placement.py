@@ -1,0 +1,595 @@
+"""Placement control plane battery: assignment, rebalancing, failover targets.
+
+Deterministic CPU-only unit tests of :mod:`torchmetrics_tpu.fleet.placement` —
+injectable clocks, a duck-typed stub sampler handing the controller exact
+``rates()``/``skew()``/``rebalance_hints()`` tables so each decision path is
+pinned in isolation from the derivation math (``test_fleet.py`` owns that),
+plus one integration pass through the REAL :class:`FleetSampler` and the
+``GET /placement`` read API on a live ephemeral-port server. The end-to-end
+move machinery (drain→checkpoint→restore over shared disk) is covered by the
+chaos ``flash_crowd`` scenario and ``tests/multiproc`` section 16; this file
+pins the controller's decision logic.
+"""
+
+import json
+import os
+import re
+import urllib.error
+import urllib.request
+
+import pytest
+
+from torchmetrics_tpu import fleet
+from torchmetrics_tpu.obs import export as obs_export
+from torchmetrics_tpu.obs import fleet as obs_fleet
+from torchmetrics_tpu.obs import scope as obs_scope
+from torchmetrics_tpu.obs import server as obs_server
+from torchmetrics_tpu.obs import trace
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _placement_clean():
+    obs_scope.reset()
+    prev_sampler = obs_fleet.install_sampler(None)
+    prev_controller = fleet.install_controller(None)
+    yield
+    fleet.install_controller(prev_controller)
+    obs_fleet.install_sampler(prev_sampler)
+    obs_server.stop()
+    obs_scope.reset()
+
+
+class _StubSampler:
+    """Duck-typed fleet sampler with canned public tables.
+
+    The controller's contract is that every scoring input is a number the
+    READ side (``GET /fleet``) already serves — so the stub hands it exact
+    tables and records what was asked for, and each decision path is tested
+    without the derivation math in the way.
+    """
+
+    def __init__(
+        self,
+        imbalance=0.0,
+        hints=(),
+        host_rates=None,
+        cadence_seconds=1.0,
+        missing_hosts=(),
+        placement=None,
+        tenant_count=0,
+    ):
+        self.imbalance = imbalance
+        self.hints = list(hints)
+        self.host_rates = dict(host_rates or {})
+        self.cadence_seconds = cadence_seconds
+        self.missing_hosts = list(missing_hosts)
+        self.placement = {} if placement is None else placement
+        self.tenant_count = tenant_count
+        self.rate_windows = []
+
+    def rates(self, window=None):
+        self.rate_windows.append(window)
+        return {
+            "hosts": {
+                host: {"updates_per_second": rate, "flops_per_second": 0.0}
+                for host, rate in self.host_rates.items()
+            },
+            "tenants": {f"pop-{i}": {} for i in range(self.tenant_count)},
+        }
+
+    def skew(self, rates=None, window=None):
+        return {"imbalance": self.imbalance}
+
+    def rebalance_hints(self, rates=None, skew=None):
+        return {"hints": [dict(h) for h in self.hints]}
+
+    def history(self):
+        return [{"missing_hosts": list(self.missing_hosts)}]
+
+
+def _controller(hosts=("0", "1"), sampler=None, mover=None, clock=None, **kwargs):
+    clock = clock if clock is not None else [0.0]
+    c = fleet.PlacementController(
+        fleet.PlacementConfig(hosts=hosts, **kwargs),
+        sampler=sampler,
+        mover=mover,
+        clock=lambda: clock[0],
+        wall=lambda: 1.7e9 + clock[0],
+        recorder=trace.TraceRecorder(),
+    )
+    return c, clock
+
+
+def _hash_tenant_on(controller, host, prefix="t"):
+    """A tenant name whose rendezvous choice is ``host`` (found, not assumed)."""
+    return next(
+        t for t in (f"{prefix}{i}" for i in range(256)) if controller.hash_host(t) == host
+    )
+
+
+# --------------------------------------------------------------------- config
+
+
+class TestConfigValidation:
+    def test_hosts_required_and_unique(self):
+        with pytest.raises(ValueError, match="at least one host"):
+            fleet.PlacementConfig(hosts=())
+        with pytest.raises(ValueError, match="unique"):
+            fleet.PlacementConfig(hosts=("0", "0"))
+
+    def test_hysteresis_band_must_be_a_band(self):
+        with pytest.raises(ValueError, match="hysteresis_low"):
+            fleet.PlacementConfig(hosts=("0",), hysteresis_high=0.3, hysteresis_low=0.3)
+        with pytest.raises(ValueError, match="hysteresis_high"):
+            fleet.PlacementConfig(hosts=("0",), hysteresis_high=1.5)
+
+    def test_knob_floors(self):
+        with pytest.raises(ValueError, match="cadence_seconds"):
+            fleet.PlacementConfig(hosts=("0",), cadence_seconds=0)
+        with pytest.raises(ValueError, match="max_concurrent_moves"):
+            fleet.PlacementConfig(hosts=("0",), max_concurrent_moves=0)
+        with pytest.raises(ValueError, match="smoothing_windows"):
+            fleet.PlacementConfig(hosts=("0",), smoothing_windows=0.5)
+        with pytest.raises(ValueError, match="decision_log"):
+            fleet.PlacementConfig(hosts=("0",), decision_log=0)
+
+
+# ----------------------------------------------------------- initial placement
+
+
+class TestHashPlacement:
+    def test_rendezvous_is_deterministic_and_host_order_free(self):
+        a, _ = _controller(hosts=("alpha", "beta", "gamma"))
+        b, _ = _controller(hosts=("gamma", "alpha", "beta"))
+        for i in range(32):
+            assert a.hash_host(f"t{i}") == b.hash_host(f"t{i}")
+
+    def test_adding_a_host_only_moves_tenants_onto_it(self):
+        # the rendezvous property the scheme is chosen for: growing the host
+        # set never shuffles a tenant between the SURVIVING hosts
+        before, _ = _controller(hosts=("0", "1"))
+        after, _ = _controller(hosts=("0", "1", "2"))
+        for i in range(64):
+            old, new = before.hash_host(f"t{i}"), after.hash_host(f"t{i}")
+            assert new == old or new == "2"
+
+    def test_assign_is_idempotent_first_placement_wins(self):
+        c, _ = _controller()
+        host = c.assign("t-a")
+        assert c.assign("t-a") == host == c.lookup("t-a")
+        row = c.assignments()["t-a"]
+        assert row["source"] == "hash" and row["moves"] == 0
+
+    def test_load_override_steers_off_the_measurably_hottest_host(self):
+        stub = _StubSampler(host_rates={"0": 30.0, "1": 0.0})
+        c, _ = _controller(sampler=stub)
+        tenant = _hash_tenant_on(c, "0")
+        assert c.assign(tenant) == "1"
+        assert c.assignments()[tenant]["source"] == "load"
+
+    def test_no_measured_load_keeps_the_pure_hash(self):
+        stub = _StubSampler(host_rates={"0": 0.0, "1": 0.0})
+        c, _ = _controller(sampler=stub)
+        tenant = _hash_tenant_on(c, "0")
+        assert c.assign(tenant) == "0"
+        assert c.assignments()[tenant]["source"] == "hash"
+
+    def test_assign_validates_the_tenant_name(self):
+        c, _ = _controller()
+        with pytest.raises(ValueError):
+            c.assign("")
+
+
+class TestSeed:
+    def test_seed_adopts_wholesale_and_updates_the_sampler_placement(self):
+        stub = _StubSampler()
+        c, _ = _controller(sampler=stub)
+        c.seed({"t-a": "0", "t-b": "1"})
+        assert c.lookup("t-a") == "0" and c.lookup("t-b") == "1"
+        assert c.assignments()["t-a"]["source"] == "seed"
+        assert stub.placement == {"t-a": "0", "t-b": "1"}
+        assert c.report()["decisions"][-1]["action"] == "seed"
+
+    def test_seed_onto_unmanaged_host_refuses_without_partial_state(self):
+        c, _ = _controller()
+        with pytest.raises(ValueError, match="unmanaged host"):
+            c.seed({"t-a": "0", "t-b": "9"})
+        assert c.assignments() == {}  # validated before any row landed
+
+
+# ----------------------------------------------------------------- durability
+
+
+class TestDurability:
+    def test_restart_inherits_the_table_and_counters(self, tmp_path):
+        path = str(tmp_path / "placement.json")
+        stub = _StubSampler(
+            imbalance=1.0,
+            hints=[{"tenant": "t-a", "from": "0", "to": "1", "projected_imbalance": 0.1}],
+        )
+        c, _ = _controller(sampler=stub, state_path=path)
+        c.seed({"t-a": "0", "t-b": "1"})
+        c.reconcile()  # completes one table-only move (no mover injected)
+        assert c.moves_completed == 1 and c.lookup("t-a") == "1"
+        reborn, _ = _controller(state_path=path)
+        assert reborn.lookup("t-a") == "1" and reborn.lookup("t-b") == "1"
+        assert reborn.assignments()["t-a"]["moves"] == 1
+        assert reborn.moves_started == 1 and reborn.moves_completed == 1
+
+    def test_schema_mismatch_refuses_loudly(self, tmp_path):
+        path = str(tmp_path / "placement.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"schema": 99, "assignments": {}}, fh)
+        with pytest.raises(ValueError, match="schema"):
+            _controller(state_path=path)
+
+    def test_rows_on_unmanaged_hosts_are_replaced_not_trusted(self, tmp_path):
+        path = str(tmp_path / "placement.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(
+                {
+                    "schema": fleet.PLACEMENT_SCHEMA,
+                    "assignments": {
+                        "t-gone": {"host": "9", "source": "hash", "moves": 0},
+                        "t-kept": {"host": "1", "source": "hash", "moves": 2},
+                    },
+                },
+                fh,
+            )
+        c, _ = _controller(state_path=path)
+        assert c.lookup("t-gone") is None  # re-placed on first sight
+        assert c.lookup("t-kept") == "1"
+        assert c.assignments()["t-kept"]["moves"] == 2
+
+
+# ------------------------------------------------------------------ reconcile
+
+
+class TestHysteresis:
+    def _hint(self, tenant, to="1", frm="0"):
+        return {"tenant": tenant, "from": frm, "to": to, "projected_imbalance": 0.1}
+
+    def test_episode_opens_above_high_moves_and_closes_below_low(self):
+        stub = _StubSampler(imbalance=1.0, hints=[self._hint("t-a")])
+        moves = []
+        c, clock = _controller(
+            sampler=stub, mover=lambda t, f, to: moves.append((t, f, to)) or True
+        )
+        summary = c.reconcile()
+        assert summary["engaged"] is True and summary["decision"] == "moved"
+        assert moves == [("t-a", "0", "1")]
+        row = c.assignments()["t-a"]
+        assert row["host"] == "1" and row["source"] == "rebalance" and row["moves"] == 1
+        assert stub.placement["t-a"] == "1"
+        # the fleet recovers: below the LOW threshold the episode closes and
+        # the open-to-close delta is the convergence time
+        stub.imbalance = 0.1
+        clock[0] = 3.0
+        summary = c.reconcile()
+        assert summary["engaged"] is False and summary["decision"] == "balanced"
+        convergence = c.report()["convergence"]
+        assert convergence["episodes_closed"] == 1
+        assert convergence["last_convergence_seconds"] == 3.0
+        actions = [d["action"] for d in c.report()["decisions"]]
+        assert actions == ["episode-open", "move", "episode-close"]
+
+    def test_band_between_thresholds_never_opens_an_episode(self):
+        stub = _StubSampler(imbalance=0.4, hints=[self._hint("t-a")])
+        c, _ = _controller(sampler=stub)  # high=0.5: 0.4 is inside the band
+        summary = c.reconcile()
+        assert summary["engaged"] is False and summary["decision"] == "balanced"
+        assert c.report()["decisions"] == []
+
+    def test_open_episode_keeps_working_inside_the_band(self):
+        # anti-thrash: once open, the episode only closes below LOW — an
+        # imbalance hovering between the thresholds keeps the moves coming
+        stub = _StubSampler(imbalance=1.0, hints=[self._hint("t-a")])
+        c, clock = _controller(sampler=stub)
+        c.reconcile()
+        stub.imbalance = 0.4
+        stub.hints = [self._hint("t-b")]
+        clock[0] = 1.0
+        summary = c.reconcile()
+        assert summary["engaged"] is True and summary["decision"] == "moved"
+        assert c.report()["convergence"]["episode_open"] is True
+
+    def test_moves_cap_at_max_concurrent_moves_per_pass(self):
+        stub = _StubSampler(imbalance=1.0, hints=[self._hint("t-a"), self._hint("t-b")])
+        c, clock = _controller(sampler=stub)  # max_concurrent_moves default 1
+        assert [m["tenant"] for m in c.reconcile()["moves"]] == ["t-a"]
+        clock[0] = 1.0
+        assert [m["tenant"] for m in c.reconcile()["moves"]] == ["t-b"]
+        wide, _ = _controller(sampler=stub, max_concurrent_moves=2)
+        assert [m["tenant"] for m in wide.reconcile()["moves"]] == ["t-a", "t-b"]
+
+    def test_pinned_tenants_are_never_moved(self):
+        stub = _StubSampler(imbalance=1.0, hints=[self._hint("t-pin"), self._hint("t-b")])
+        c, _ = _controller(sampler=stub, pinned=("t-pin",))
+        assert [m["tenant"] for m in c.reconcile()["moves"]] == ["t-b"]
+        assert c.lookup("t-pin") is None  # untouched however hot it reads
+
+    def test_migrating_and_fenced_tenants_are_skipped_by_the_executor(self):
+        # belt and braces over the hint-side filter: even a hint that names a
+        # busy tenant (a stale table, a racing fence) must not double-drain it
+        stub = _StubSampler(imbalance=1.0, hints=[self._hint("t-mig"), self._hint("t-b")])
+        c, _ = _controller(sampler=stub)
+        with obs_scope.migration("t-mig", "drain"):
+            assert [m["tenant"] for m in c.reconcile()["moves"]] == ["t-b"]
+        stub.hints = [self._hint("t-fen"), self._hint("t-c")]
+        obs_scope.note_fence("ep-busy", tenant="t-fen")
+        c2, _ = _controller(sampler=stub)
+        assert [m["tenant"] for m in c2.reconcile()["moves"]] == ["t-c"]
+
+    def test_self_moves_and_unknown_destinations_are_not_moves(self):
+        stub = _StubSampler(
+            imbalance=1.0,
+            hints=[self._hint("t-a", to="0", frm="0"), self._hint("t-b", to="9")],
+        )
+        c, _ = _controller(sampler=stub)
+        assert c.reconcile()["decision"] == "no-eligible-move"
+
+    def test_mover_false_and_mover_raise_both_count_failed_not_crash(self):
+        stub = _StubSampler(imbalance=1.0, hints=[self._hint("t-a")])
+        c, clock = _controller(sampler=stub, mover=lambda t, f, to: False)
+        move = c.reconcile()["moves"][0]
+        assert move["ok"] is False and c.moves_failed == 1
+        assert c.lookup("t-a") is None  # the table never adopted the move
+
+        def _explode(tenant, frm, to):
+            raise RuntimeError("drain torn")
+
+        boom, _ = _controller(sampler=stub, mover=_explode)
+        move = boom.reconcile()["moves"][0]
+        assert move["ok"] is False and "RuntimeError" in move["error"]
+        assert boom.moves_failed == 1 and boom.moves_completed == 0
+
+    def test_reconcile_reads_are_smoothed_over_the_sampler_cadence(self):
+        stub = _StubSampler(imbalance=0.0, cadence_seconds=2.0)
+        c, _ = _controller(sampler=stub, smoothing_windows=10.0)
+        c.reconcile()
+        assert stub.rate_windows[-1] == 20.0  # smoothing_windows × cadence
+
+
+class TestTickAndContract:
+    def test_tick_honors_the_cadence(self):
+        stub = _StubSampler()
+        c, _ = _controller(sampler=stub, cadence_seconds=5.0)
+        assert c.tick(now=0.0) is not None  # first tick always reconciles
+        assert c.tick(now=4.9) is None  # cadence not elapsed
+        assert c.tick(now=5.0) is not None
+
+    def test_no_sampler_is_the_one_branch_disabled_path(self):
+        c, _ = _controller()  # nothing injected, nothing installed
+        assert c.tick(now=0.0) is None
+        summary = c.reconcile()
+        assert summary["decision"] == "no-sampler" and summary["moves"] == []
+
+    def test_install_returns_previous_for_restore(self):
+        c, _ = _controller()
+        assert fleet.install_controller(c) is None
+        assert fleet.get_controller() is c
+        assert fleet.install_controller(None) is c
+        assert fleet.get_controller() is None
+
+    def test_decision_log_is_bounded_drop_oldest(self):
+        c, _ = _controller(decision_log=5)
+        for i in range(8):
+            c.note_failover("t-a", "1" if i % 2 else "0")
+        decisions = c.report()["decisions"]
+        assert len(decisions) == 5
+        assert all(d["action"] == "failover" for d in decisions)
+
+    def test_controller_consumes_only_the_samplers_public_tables(self):
+        # the fleet-data-only contract, asserted structurally: a stub exposing
+        # ONLY the /fleet read surface drives every decision path above — so
+        # reconcile against the real sampler and the stub agree on the verbs
+        s = obs_fleet.FleetSampler(
+            recorder=trace.TraceRecorder(),
+            placement={"a": "0", "b": "0", "c": "1"},
+            hosts=("0", "1"),
+            clock=lambda: clock[0],
+            wall=lambda: 1.7e9 + clock[0],
+        )
+        clock = [0.0]
+        s.sample()
+        for tenant, n in (("a", 30), ("b", 10), ("c", 0)):
+            with obs_scope.scope(tenant):
+                obs_scope.note_update(n=n)
+        clock[0] = 1.0
+        s.sample()
+        moves = []
+        c = fleet.PlacementController(
+            fleet.PlacementConfig(hosts=("0", "1"), max_concurrent_moves=2),
+            sampler=s,
+            mover=lambda t, f, to: moves.append((t, f, to)) or True,
+        )
+        summary = c.reconcile()
+        assert summary["decision"] == "moved"
+        assert [t for t, _, _ in moves] == ["a", "b"]  # the hints' own ranking
+        assert s.placement == {"a": "1", "b": "1", "c": "1"}
+        assert c.report()["convergence"]["episode_open"] is True
+
+
+# ------------------------------------------------------------------- failover
+
+
+class TestChooseRestoreHost:
+    def test_least_loaded_live_host_never_the_origin(self):
+        stub = _StubSampler(host_rates={"0": 30.0, "1": 5.0, "2": 10.0})
+        c, _ = _controller(hosts=("0", "1", "2"), sampler=stub)
+        c.seed({"t-a": "0"})
+        assert c.choose_restore_host("t-a") == "1"
+        # even when the origin is the coldest, it is presumed hung: excluded
+        stub.host_rates = {"0": 0.0, "1": 5.0, "2": 10.0}
+        assert c.choose_restore_host("t-a") == "1"
+
+    def test_explicit_exclude_overrides_the_assignment(self):
+        stub = _StubSampler(host_rates={"0": 30.0, "1": 5.0, "2": 10.0})
+        c, _ = _controller(hosts=("0", "1", "2"), sampler=stub)
+        assert c.choose_restore_host("t-a", exclude="1") == "2"
+
+    def test_hosts_missing_from_the_newest_sample_are_skipped(self):
+        stub = _StubSampler(
+            host_rates={"0": 30.0, "1": 5.0, "2": 10.0}, missing_hosts=("1",)
+        )
+        c, _ = _controller(hosts=("0", "1", "2"), sampler=stub)
+        c.seed({"t-a": "0"})
+        assert c.choose_restore_host("t-a") == "2"  # "1" is cold but dark
+
+    def test_no_rates_falls_back_to_deterministic_rendezvous(self):
+        c, _ = _controller(hosts=("alpha", "beta", "gamma"))
+        d, _ = _controller(hosts=("gamma", "beta", "alpha"))
+        pick = c.choose_restore_host("t-a", exclude="alpha")
+        assert pick in ("beta", "gamma")
+        assert pick == d.choose_restore_host("t-a", exclude="alpha")
+
+    def test_note_failover_commits_to_the_table(self):
+        c, _ = _controller()
+        c.seed({"t-a": "0"})
+        c.note_failover("t-a", "1")
+        row = c.assignments()["t-a"]
+        assert row["host"] == "1" and row["source"] == "failover" and row["moves"] == 1
+        last = c.report()["decisions"][-1]
+        assert last["action"] == "failover" and last["to"] == "1"
+
+
+# ----------------------------------------------------------------- mux tuning
+
+
+class TestWidthBuckets:
+    def test_ladder_covers_the_assigned_population(self):
+        c, _ = _controller()
+        for i in range(12):
+            c.assign(f"t{i}")
+        assert c.propose_width_buckets() == (1, 2, 4, 8, 16)
+
+    def test_empty_world_proposes_the_unit_ladder(self):
+        c, _ = _controller()
+        assert c.propose_width_buckets() == (1,)
+
+    def test_sampler_population_joins_the_table(self):
+        stub = _StubSampler(tenant_count=5)
+        c, _ = _controller(sampler=stub)
+        assert c.propose_width_buckets() == (1, 2, 4, 8)
+
+    def test_ladder_caps_at_max_width(self):
+        c, _ = _controller()
+        for i in range(12):
+            c.assign(f"t{i}")
+        assert c.propose_width_buckets(max_width=8) == (1, 2, 4, 8)
+        with pytest.raises(ValueError, match="max_width"):
+            c.propose_width_buckets(max_width=0)
+
+
+# -------------------------------------------------------------------- serving
+
+
+def _get_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read().decode("utf-8"))
+
+
+@pytest.fixture()
+def server():
+    obs_server.stop()
+    srv = obs_server.IntrospectionServer(port=0).start()
+    yield srv
+    srv.stop()
+
+
+class TestPlacementRoute:
+    def test_plane_off_is_an_answer_not_a_404(self, server):
+        status, body = _get_json(server.url + "/placement")
+        assert status == 200
+        assert body["enabled"] is False
+        assert "install_controller" in body["error"]
+        status, index = _get_json(server.url + "/")
+        assert "/placement" in index["routes"]
+
+    def test_placement_page_serves_the_live_table(self, server):
+        c, _ = _controller()
+        c.seed({"t-a": "0", "t-b": "1"})
+        fleet.install_controller(c)
+        status, body = _get_json(server.url + "/placement")
+        assert status == 200 and body["enabled"] is True
+        assert body["schema"] == fleet.PLACEMENT_SCHEMA
+        assert body["assignments"]["t-a"]["host"] == "0"
+        assert body["config"]["hosts"] == ["0", "1"]
+        assert body["moves"]["in_flight"] == 0
+        assert body["convergence"]["episode_open"] is False
+
+    def test_tenant_filter_and_unknown_tenant_404(self, server):
+        with obs_scope.scope("t-a"):
+            pass  # the shared pre-check 404s tenants the registry never saw
+        c, _ = _controller()
+        c.seed({"t-a": "0", "t-b": "1"})
+        fleet.install_controller(c)
+        status, body = _get_json(server.url + "/placement?tenant=t-a")
+        assert status == 200
+        assert set(body["assignments"]) == {"t-a"}
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get_json(server.url + "/placement?tenant=nope")
+        assert err.value.code == 404
+
+    def test_metrics_scrape_ticks_the_installed_controller(self, server):
+        stub = _StubSampler(imbalance=0.0)
+        c, _ = _controller(sampler=stub, cadence_seconds=3600.0)
+        c.seed({"t-a": "0"})
+        fleet.install_controller(c)
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
+            page = resp.read().decode("utf-8")
+            assert resp.status == 200
+        assert len(stub.rate_windows) == 1  # the scrape drove one reconcile
+        assert "tm_tpu_placement_assignments 1" in page
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
+            assert resp.status == 200
+        assert len(stub.rate_windows) == 1  # cadence not elapsed: tick coalesced
+
+    def test_no_controller_emits_no_placement_families(self, server):
+        trace.get_recorder().clear()  # gauges are sticky across scrapes
+        with urllib.request.urlopen(server.url + "/metrics", timeout=10) as resp:
+            page = resp.read().decode("utf-8")
+        assert "tm_tpu_placement_" not in page
+
+
+# --------------------------------------------------------------------- gauges
+
+
+class TestPlacementGauges:
+    def test_all_families_are_helped_gauges_with_samples(self):
+        stub = _StubSampler(
+            imbalance=1.0,
+            hints=[{"tenant": "t-a", "from": "0", "to": "1", "projected_imbalance": 0.1}],
+        )
+        c, clock = _controller(sampler=stub)
+        c.seed({"t-a": "0", "t-b": "1"})
+        c.reconcile()
+        stub.imbalance = 0.1
+        clock[0] = 2.0
+        c.reconcile()  # closes the episode: convergence_seconds goes live
+        rec = trace.TraceRecorder()
+        c.record_gauges(recorder=rec)
+        page = obs_export.prometheus_text(recorder=rec)
+        for family in (
+            "tm_tpu_placement_assignments",
+            "tm_tpu_placement_host_tenants",
+            "tm_tpu_placement_moves_in_flight",
+            "tm_tpu_placement_moves_started",
+            "tm_tpu_placement_moves_completed",
+            "tm_tpu_placement_moves_failed",
+            "tm_tpu_placement_rebalancing",
+            "tm_tpu_placement_convergence_seconds",
+            "tm_tpu_placement_decision_age_seconds",
+        ):
+            assert re.search(rf"^# HELP {family} .+$", page, re.M), family
+            assert re.search(rf"^# TYPE {family} gauge$", page, re.M), family
+            assert re.search(rf"^{family}(?:\{{[^}}]*\}})? ", page, re.M), family
+        # point-in-time state: gauges, never _total
+        assert "tm_tpu_placement_moves_started_total" not in page
+        # per-host counts carry the host label; t-a moved 0→1 so host 1 has 2
+        assert re.search(r'^tm_tpu_placement_host_tenants\{host="1"\} 2(?:\.0)?$', page, re.M)
+        assert re.search(r'^tm_tpu_placement_host_tenants\{host="0"\} 0(?:\.0)?$', page, re.M)
+        assert re.search(r"^tm_tpu_placement_rebalancing 0(?:\.0)?$", page, re.M)
+        assert re.search(r"^tm_tpu_placement_convergence_seconds 2(?:\.0)?$", page, re.M)

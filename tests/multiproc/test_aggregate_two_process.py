@@ -92,4 +92,7 @@ def test_two_process_aggregate_battery(tmp_path):
         "sigcont_late_write_rejected_on_scan": True,
         "audit_ledger_continues_across_restore": True,
         "audit_zombie_rejection_is_event_not_violation": True,
+        "placement_move_crosses_hosts_bit_identical": True,
+        "placement_table_durable_across_processes": True,
+        "placement_ledger_continuity_no_double_count": True,
     }

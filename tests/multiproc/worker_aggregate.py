@@ -927,6 +927,129 @@ def main() -> None:
     lineage_mod.disable()
     scope.reset()
 
+    # -- 16. placement-controller move crosses hosts over shared disk ----------
+    # (the placement control plane, 2-process-validated: rank 1 runs tenant
+    # t-place hot and checkpoints its half-finished session to shared disk; the
+    # REAL fleet sampler's collective samples attribute the load to host "1",
+    # and rank 0's PlacementController — scoring nothing but the sampler's
+    # public rates/skew/hints tables — orders the move. Its injected mover
+    # restores the bundle and finishes the stream bit-identically to a
+    # never-moved control; the durable assignment table is re-read cold from
+    # shared disk by the ORIGIN process; and the tenant registry's restore
+    # merge is a high-water max so the move double-counts nothing.)
+    from torchmetrics_tpu import fleet as fleet_pkg
+
+    plc_bundle = os.path.join(shared, "plc_bundle")
+    plc_state = os.path.join(shared, "plc_placement.json")
+    plc_oracle = os.path.join(shared, "plc_expected.json")
+    plc_rng = np.random.RandomState(47)
+    plc_batches = [
+        (
+            jnp.asarray(plc_rng.rand(16, 4).astype(np.float32)),
+            jnp.asarray(plc_rng.randint(0, 4, 16)),
+        )
+        for _ in range(10)
+    ]
+    if pid == 1:
+        control = mig_metric()
+        for p_, t_ in plc_batches:
+            control.update(p_, t_)
+        expected = np.asarray(control.compute())
+        pipe = MetricPipeline(mig_metric(), PipelineConfig(fuse=2, tenant="t-place"))
+        for p_, t_ in plc_batches[:6]:
+            pipe.feed(p_, t_)
+        engine_migrate.checkpoint_session(pipe, plc_bundle)
+        pipe.close()
+        tmp = plc_oracle + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump({"dtype": str(expected.dtype), "hex": expected.tobytes().hex()}, fh)
+        os.replace(tmp, plc_oracle)
+    # collective barrier: the drained bundle + never-moved oracle are on disk
+    aggregate()
+    # both ranks drive the sampler: sample() is a true collective here, and two
+    # samples bracket the asymmetric load so rates attribute it to host "1".
+    # The ballast tenant keeps the hot host non-empty after t-place leaves, so
+    # the hint engine has a projection that actually improves.
+    plc_sampler = fleet_mod.FleetSampler(cadence_seconds=1.0)
+    plc_sampler.sample()
+    if pid == 1:
+        with scope.scope("t-place"):
+            scope.note_update(n=30)
+        with scope.scope("t-ballast"):
+            scope.note_update(n=10)
+    plc_loaded = plc_sampler.sample()
+    assert plc_loaded["n_hosts"] == 2 and plc_loaded["degraded"] is False
+    if pid == 0:
+        # rates/skew/hints are pure ring reads (no collective): rank 0 alone
+        # runs the controller while rank 1 waits at the next barrier
+        plc_skew = plc_sampler.skew()
+        assert plc_skew["hot_host"] == "1", plc_skew
+        moved_compute = {}
+
+        def plc_mover(tenant, from_host, to_host):
+            assert (tenant, from_host, to_host) == ("t-place", "1", "0")
+            restored = mig_metric()
+            pipe2, _ = engine_migrate.restore_session(restored, plc_bundle)
+            for p_, t_ in plc_batches[6:]:
+                pipe2.feed(p_, t_)
+            pipe2.close()
+            got = np.asarray(restored.compute())
+            moved_compute["dtype"] = str(got.dtype)
+            moved_compute["hex"] = got.tobytes().hex()
+            return True
+
+        controller = fleet_pkg.PlacementController(
+            fleet_pkg.PlacementConfig(hosts=("0", "1"), state_path=plc_state),
+            sampler=plc_sampler,
+            mover=plc_mover,
+        )
+        controller.seed({"t-place": "1", "t-ballast": "1"})
+        summary = controller.reconcile()
+        assert summary["decision"] == "moved", summary
+        assert [m["tenant"] for m in summary["moves"]] == ["t-place"], summary
+        assert summary["moves"][0]["ok"] is True, summary
+        assert controller.lookup("t-place") == "0"
+        with open(plc_oracle) as fh:
+            oracle = json.load(fh)
+        assert moved_compute["dtype"] == oracle["dtype"]
+        assert moved_compute["hex"] == oracle["hex"], (moved_compute, oracle)
+        # ledger continuity: this pristine host's row adopted the carried
+        # 6-update cursor (not a newborn), the 4-batch tail extended it to 10 —
+        # and a replayed restore of the same carried row is a high-water max,
+        # never an add (an add would read 16 and the sampler would chase a
+        # phantom burst on the destination host)
+        plc_row = next(
+            r for r in scope.get_registry().rows() if r["tenant"] == "t-place"
+        )
+        assert plc_row["updates"] == 10, plc_row
+        again = scope.get_registry().restore_row("t-place", updates=6)
+        assert again["updates"] == 10, again
+    # collective barrier: the move + durable assignment table are on disk —
+    # and the fleet aggregate itself shows the host change: t-place served on
+    # host 1, then continued (restored by the controller's mover) on host 0
+    plc_fleet = aggregate()
+    plc_tenant_rows = {row["tenant"]: row for row in plc_fleet["tenants"]}
+    assert plc_tenant_rows["t-place"]["hosts"] == [0, 1], plc_tenant_rows
+    if pid == 1:
+        # cross-process durability: the ORIGIN host re-reads the shared table
+        # cold and learns its tenant now lives on host "0"
+        reread = fleet_pkg.PlacementController(
+            fleet_pkg.PlacementConfig(hosts=("0", "1"), state_path=plc_state)
+        )
+        assert reread.lookup("t-place") == "0"
+        plc_rows = reread.assignments()
+        assert plc_rows["t-place"]["source"] == "rebalance", plc_rows
+        assert plc_rows["t-ballast"]["host"] == "1", plc_rows
+        plc_report = reread.report()
+        assert plc_report["moves"]["completed"] == 1, plc_report["moves"]
+        assert plc_report["moves"]["failed"] == 0, plc_report["moves"]
+        with open(plc_state) as fh:
+            assert json.load(fh)["schema"] == fleet_pkg.PLACEMENT_SCHEMA
+    results["placement_move_crosses_hosts_bit_identical"] = True
+    results["placement_table_durable_across_processes"] = True
+    results["placement_ledger_continuity_no_double_count"] = True
+    scope.reset()
+
     trace.disable()
     if pid == 0:
         with open(out_path, "w") as fh:

@@ -27,6 +27,7 @@ from torchmetrics_tpu.chaos.schedule import (
     ScheduleConfig,
     ScheduleError,
     TrafficSchedule,
+    flash_crowd_config,
     generate,
     high_tenant_config,
     load,
@@ -36,6 +37,7 @@ from torchmetrics_tpu.chaos.schedule import (
 from torchmetrics_tpu.chaos.replay import ReplayConfig, ReplayError, replay
 from torchmetrics_tpu.chaos.slo import (
     SLOSpec,
+    flash_crowd_slo_spec,
     format_report,
     high_tenant_slo_spec,
     host_crash_slo_spec,
@@ -53,6 +55,8 @@ __all__ = [
     "ScheduleConfig",
     "ScheduleError",
     "TrafficSchedule",
+    "flash_crowd_config",
+    "flash_crowd_slo_spec",
     "format_report",
     "generate",
     "high_tenant_config",

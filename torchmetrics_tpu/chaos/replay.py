@@ -145,6 +145,35 @@ class ReplayConfig:
             is scraped throughout and probed at end of run. Incompatible with
             ``multiplex``, ``rolling_deploy``, ``host_crash`` and
             ``hung_host``.
+        flash_crowd: simulate a **flash crowd with a mid-run hot-spot shift**
+            — the placement-control-plane scenario. Every tenant is seeded
+            onto virtual host ``"0"`` (durably: the seeded table is written
+            to disk by a throwaway controller and the live
+            :class:`~torchmetrics_tpu.fleet.placement.PlacementController`
+            is reconstructed FROM that state file — the restart path runs
+            every replay), a :class:`~torchmetrics_tpu.obs.fleet.FleetSampler`
+            is installed, and the controller — ticked by the background
+            scraper's ``/metrics`` pulls — must notice the measured
+            imbalance, open a hysteresis episode and drain it with REAL
+            moves: drain → checkpoint → restore → swap, each under
+            ``scope.migration(tenant, "rebalance")``, targets chosen from
+            ``FleetSampler.rebalance_hints()`` alone. The schedule's hot-spot
+            shift (the ``repair`` event — hot set B takes over) invalidates
+            the converged table mid-run, forcing a second episode. A settle
+            loop keeps post-shift traffic flowing until the controller
+            converges (bounded) — decay-to-zero idle "convergence" is not
+            accepted. Every moved session's final ``compute()`` is proven
+            bit-identical to an unmoved shadow control rebuilt from the
+            retained stream. ``/placement`` is scraped throughout and probed
+            at end of run. Incompatible with every other scenario flag.
+        placement_enabled: ``False`` runs the flash-crowd **control arm**:
+            identical traffic, sampler installed, static all-on-"0"
+            placement, NO controller — the throughput baseline the
+            placement-overhead SLO compares against.
+        placement_cadence_seconds: the controller's reconcile cadence (short
+            so convergence fits a CI run; production cadences are tens of
+            seconds).
+        placement_max_moves: the controller's per-pass move budget.
         fleet_cadence_seconds: the fleet sampler's cadence (short, so a CI
             run accumulates enough samples; production cadences are seconds).
         lease_seconds: the hung-host tenants' lease TTL (short, so detection
@@ -182,6 +211,10 @@ class ReplayConfig:
     checkpoint_dir: Optional[str] = None
     hung_host: bool = False
     skewed_load: bool = False
+    flash_crowd: bool = False
+    placement_enabled: bool = True
+    placement_cadence_seconds: float = 0.15
+    placement_max_moves: int = 1
     fleet_cadence_seconds: float = 0.1
     lease_seconds: float = 0.25
     scrape_interval_seconds: float = 0.05
@@ -230,6 +263,28 @@ class ReplayConfig:
                 "`skewed_load` drives default per-tenant pipeline sessions under a"
                 " fleet sampler; it cannot be combined with `multiplex`,"
                 " `rolling_deploy`, `host_crash` or `hung_host`"
+            )
+        if self.flash_crowd and (
+            self.multiplex
+            or self.rolling_deploy
+            or self.host_crash
+            or self.hung_host
+            or self.skewed_load
+        ):
+            raise ValueError(
+                "`flash_crowd` drives default per-tenant pipeline sessions under a"
+                " fleet sampler + placement controller; it cannot be combined with"
+                " `multiplex`, `rolling_deploy`, `host_crash`, `hung_host` or"
+                " `skewed_load`"
+            )
+        if self.placement_cadence_seconds <= 0:
+            raise ValueError(
+                f"Expected positive `placement_cadence_seconds`, got"
+                f" {self.placement_cadence_seconds}"
+            )
+        if self.placement_max_moves < 1:
+            raise ValueError(
+                f"Expected `placement_max_moves` >= 1, got {self.placement_max_moves}"
             )
         if self.fleet_cadence_seconds <= 0:
             raise ValueError(
@@ -567,11 +622,14 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
             severity="critical",
         )
     ]
-    if config.skewed_load:
+    if config.skewed_load or config.flash_crowd:
         # the declarative preset, armed BEFORE any load lands: detection must
         # come from the fleet samples alone through the standard pending→
         # firing machinery (dwell = 2 cadences, so one noisy sample never
         # pages). The rule name is obs.fleet's IMBALANCE_RULE contract.
+        # The flash-crowd scenario arms it too: the page and the placement
+        # controller read the SAME samples — paging is not suppressed just
+        # because something is acting on the skew.
         rules.append(
             _fleet_mod.imbalance_rule(
                 above=0.5,
@@ -626,7 +684,19 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
     fence_set = set(fence_tenants)
     fence_history: Dict[str, List[tuple]] = {tenant: [] for tenant in fence_tenants}
 
+    # flash-crowd only: controller-ordered moves run on scrape handler
+    # threads (render_metrics ticks the controller), so a move's drain/swap
+    # must be serialized against the schedule's feed loop — the replay's
+    # stand-in for the serving process's per-session ownership
+    flash_lock: Optional[threading.Lock] = (
+        threading.Lock() if config.flash_crowd else None
+    )
+
     def feed_tenant(tenant: str, *args: Any) -> None:
+        if flash_lock is not None:
+            with flash_lock:
+                pipelines[tenant].feed(*args)
+            return
         if mux is not None and tenant not in pipelines:
             mux.feed(tenant, *args)
         else:
@@ -640,6 +710,10 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         return pipelines[tenant].trace_id_for(index)
 
     def flush_tenant(tenant: str) -> None:
+        if flash_lock is not None:
+            with flash_lock:
+                pipelines[tenant].flush()
+            return
         if mux is not None and tenant not in pipelines:
             mux.flush()
         else:
@@ -697,6 +771,99 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         )
         _fleet_mod.install_sampler(fleet_sampler)
         fleet_shift_at = (len(schedule.events) * 2) // 3
+    # flash crowd: every tenant seeded on host "0" under a live placement
+    # controller whose reconcile ticks ride the scraper's /metrics pulls —
+    # the controller must notice the measured skew and fix it with real
+    # session moves, twice (at `repair` the schedule shifts the hot spot AND
+    # the second wave of the crowd re-lands concentrated on host "0")
+    placement_info: Optional[Dict[str, Any]] = None
+    placement_controller: Optional[Any] = None
+    placement_prev: Optional[Any] = None
+    placement_probe: Optional[Dict[str, Any]] = None
+    placement_restored_from_disk = False
+    flash_streams: Dict[str, List[Tuple[Any, ...]]] = {}
+    flash_shift_wall: Optional[float] = None
+    flash_settle_sweeps = 0
+    # driver-side record of every tenant the mover physically relocated: the
+    # assignment table's per-row `moves` counters reset when the shift-time
+    # re-seed adopts the second wave's placement, so the zero-loss verdict
+    # keys off this set, not the table
+    flash_moved: set = set()
+    flash_dir: Optional[str] = None
+    if config.flash_crowd:
+        from torchmetrics_tpu import fleet as _placement_mod
+
+        # the flash crowd arrives concentrated: EVERY tenant starts on host
+        # "0", so the first measured imbalance is 1.0 and the seeded table is
+        # maximally wrong on purpose
+        fleet_placement = dict.fromkeys(schedule.tenants, "0")
+        fleet_sampler = _fleet_mod.FleetSampler(
+            cadence_seconds=config.fleet_cadence_seconds,
+            placement=dict(fleet_placement),
+            # the provisioned universe: host "1" is idle at t=0 (the whole
+            # crowd lands on "0") and must still count in the skew math —
+            # without it the concentrated fleet reads as balanced
+            hosts=("0", "1"),
+        )
+        _fleet_mod.install_sampler(fleet_sampler)
+        flash_dir = tempfile.mkdtemp(prefix="tm_tpu_rebalance_")
+
+        def flash_mover(tenant: str, from_host: str, to_host: str) -> bool:
+            """One controller-ordered move, executed on whatever scrape
+            handler thread ticked the controller: the live-session handoff
+            (drain → checkpoint → restore → swap the serving surface) —
+            the same sequence the rolling deploy runs, here chosen by the
+            control plane instead of an operator."""
+            from torchmetrics_tpu.engine import migrate as _migrate
+
+            with flash_lock:
+                old_pipe = pipelines.get(tenant)
+                if old_pipe is None:
+                    raise ReplayError(
+                        f"placement mover asked to move unknown tenant {tenant!r}"
+                    )
+                bundle = os.path.join(flash_dir, f"move-{tenant}-{len(os.listdir(flash_dir))}")
+                _migrate.checkpoint_session(old_pipe, bundle, alert_engine=engine)
+                old_pipe.close()
+                fresh = guarded_metric(tenant)
+                new_pipe, _manifest = _migrate.restore_session(
+                    fresh, bundle, alert_engine=engine
+                )
+                pipelines[tenant] = new_pipe
+                server.unregister(metrics[tenant])
+                metrics[tenant] = fresh
+                server.register(fresh)
+                flash_moved.add(tenant)
+            return True
+
+        placement_config = _placement_mod.PlacementConfig(
+            hosts=("0", "1"),
+            cadence_seconds=config.placement_cadence_seconds,
+            max_concurrent_moves=config.placement_max_moves,
+            state_path=os.path.join(flash_dir, "placement.json"),
+            # operator pins on the fault surfaces: the victim's session is a
+            # different metric class than the guarded factory restores, and
+            # the poisoned tenant's repair resets its state mid-run — both
+            # are exactly the "drain/restore known-unsafe" sessions the pin
+            # knob exists for
+            pinned=(victim,) + tuple(sorted(schedule.poisoned())),
+        )
+        if config.placement_enabled:
+            # the durable-restore proof is folded into every run: a throwaway
+            # controller seeds + persists the all-on-"0" table, then the LIVE
+            # controller reconstructs its assignment table from that state
+            # file — the restart path, not a fresh in-memory table
+            _placement_mod.PlacementController(placement_config).seed(fleet_placement)
+            placement_controller = _placement_mod.PlacementController(
+                placement_config, mover=flash_mover
+            )
+            placement_restored_from_disk = bool(
+                placement_controller.assignments()
+            ) and all(
+                placement_controller.lookup(tenant) == "0"
+                for tenant in schedule.tenants
+            )
+            placement_prev = _placement_mod.install_controller(placement_controller)
     # zombie sessions after the wedge (still live objects — a hung host is not
     # a dead one) and the failovers the scrape-driven watchdog completes
     # (appended from the scraper thread; list.append is atomic)
@@ -1044,10 +1211,15 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         with _trace.observe(max_events=config.max_events):
             server.start()
             scrape_routes = tuple(config.scrape_routes)
-            if config.skewed_load and "/fleet" not in scrape_routes:
+            if (config.skewed_load or config.flash_crowd) and "/fleet" not in scrape_routes:
                 # the control-plane read API is scraped throughout: /fleet
                 # latency rides the same per-route SLO stats as /metrics
                 scrape_routes += ("/fleet",)
+            if config.flash_crowd and "/placement" not in scrape_routes:
+                # the placement table/decision-log API is scraped throughout
+                # too — reading the control plane must stay cheap WHILE it is
+                # moving sessions, and its latency is judged like /metrics
+                scrape_routes += ("/placement",)
             scraper = _Scraper(
                 server.url, scrape_routes, config.scrape_interval_seconds
             )
@@ -1123,6 +1295,11 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                                 }
                             )
                         batch_args = make_batch(tenant, ev["size"], bool(ev.get("poison")))
+                        if config.flash_crowd and tenant != victim:
+                            # retained: a moved tenant's unmoved shadow
+                            # control is rebuilt from this exact stream at end
+                            # of run (the bit-identity side of zero-loss)
+                            flash_streams.setdefault(tenant, []).append(batch_args)
                         if tenant in crash_set:
                             # retained so the post-restore replay gap can be
                             # re-fed exactly (the stream is seeded — this IS
@@ -1208,8 +1385,81 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                         for fault in faults_injected:
                             if fault["tenant"] == fault_tenant and fault["fault"] == "poison":
                                 fault.setdefault("repaired_at", time.time())
+                        if config.flash_crowd and flash_shift_wall is None:
+                            # the schedule's hot-spot shift rides the repair
+                            # event: from here the drain traffic belongs to
+                            # hot set B — and the SECOND WAVE of the crowd
+                            # lands exactly like the first, concentrated on
+                            # host "0". The re-seed below is the operator
+                            # surface for that re-landing (a redeploy that
+                            # pins everything back to the primary): without
+                            # it, pre-shift convergence can happen to leave
+                            # hot set B already split across hosts, the
+                            # post-shift table is legitimately balanced, and
+                            # a correct controller would (rightly) never
+                            # move again — re-convergence must be FORCED to
+                            # be provable
+                            flash_shift_wall = time.time()
+                            if placement_controller is not None:
+                                placement_controller.seed(
+                                    dict.fromkeys(schedule.tenants, "0")
+                                )
                     else:  # pragma: no cover - generate()/loads() only emit known kinds
                         raise ReplayError(f"unknown schedule event kind {kind!r}")
+                if config.flash_crowd and placement_controller is not None:
+                    # settle loop: the schedule has ended but the controller
+                    # converges on its own cadence. Convergence is judged
+                    # UNDER LOAD, not during decay-to-idle — keep the
+                    # post-shift traffic shape flowing until the table has
+                    # answered the hot-spot shift with at least one clean
+                    # move and closed the imbalance episode, or the hard
+                    # deadline passes and the SLO judge flunks convergence
+                    settle_deadline = time.monotonic() + 30.0
+                    hot_b = set(schedule.hot_tenants_shifted)
+                    sweep_size = schedule.config.batch_sizes[0]
+                    while time.monotonic() < settle_deadline:
+                        rep = placement_controller.report()
+                        settled = (
+                            not rep["convergence"]["episode_open"]
+                            and not rep["moving"]
+                            and flash_shift_wall is not None
+                            and any(
+                                row.get("action") == "move"
+                                and row.get("ok")
+                                and row.get("unix", 0.0) >= flash_shift_wall
+                                for row in rep["decisions"]
+                            )
+                        )
+                        if settled:
+                            break
+                        # feed cap: past ~150 sweeps keep polling but stop
+                        # feeding — a run that hasn't settled by then is
+                        # already flunking convergence, and an unbounded
+                        # sweep flood would evict the poisoned batches'
+                        # records from the bounded lineage ring and take the
+                        # causality verdict down as collateral
+                        if flash_settle_sweeps < 150:
+                            for tenant in schedule.tenants:
+                                if tenant == victim:
+                                    continue
+                                repeats = (
+                                    schedule.config.hot_factor
+                                    if tenant in hot_b
+                                    else 1
+                                )
+                                for _ in range(repeats):
+                                    sweep_args = make_batch(
+                                        tenant, sweep_size, False
+                                    )
+                                    flash_streams.setdefault(tenant, []).append(
+                                        sweep_args
+                                    )
+                                    if tenant in controls:
+                                        controls[tenant].update(*sweep_args)
+                                    feed_tenant(tenant, *sweep_args)
+                                    batches_fed += 1
+                        flash_settle_sweeps += 1
+                        time.sleep(config.scrape_interval_seconds)
                 if fence_info is not None:
                     fence_info = finish_failover(fence_info)
                 for pipe in pipelines.values():
@@ -1361,6 +1611,116 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
                             fleet_history_n = json.loads(resp.read()).get("n_samples")
                     except Exception:
                         fleet_history_n = None
+                if config.flash_crowd:
+                    # the placement verdict. Three proofs are assembled here:
+                    # the read plane answered over real HTTP while the run was
+                    # still live; every controller-ordered move was zero-loss
+                    # (moved session bit-identical to an unmoved shadow fed
+                    # the exact retained stream); and the table converged —
+                    # including at least one clean move AFTER the hot-spot
+                    # shift, the re-convergence the scenario exists to test
+                    try:
+                        with urllib.request.urlopen(
+                            server.url + "/placement", timeout=10
+                        ) as resp:
+                            placement_probe = json.loads(resp.read())
+                    except Exception:
+                        placement_probe = None
+                    placement_rows: Dict[str, Any] = {}
+                    post_shift_moves = 0
+                    final_report: Optional[Dict[str, Any]] = None
+                    if placement_controller is not None:
+                        final_report = placement_controller.report()
+                        moved = sorted(
+                            flash_moved
+                            | {
+                                tenant
+                                for tenant, row in final_report[
+                                    "assignments"
+                                ].items()
+                                if row.get("moves", 0) > 0
+                            }
+                        )
+                        for tenant in moved:
+                            shadow = guarded_metric(tenant)
+                            for args in flash_streams.get(tenant, ()):
+                                shadow.update(*args)
+                            restored_val = np.asarray(metrics[tenant].compute())
+                            control_val = np.asarray(shadow.compute())
+                            placement_rows[tenant] = {
+                                "host": final_report["assignments"][tenant]["host"],
+                                "moves": final_report["assignments"][tenant]["moves"],
+                                "restored": float(restored_val),
+                                "control": float(control_val),
+                                "bit_identical": bool(
+                                    restored_val.dtype == control_val.dtype
+                                    and restored_val.tobytes() == control_val.tobytes()
+                                ),
+                            }
+                        post_shift_moves = sum(
+                            1
+                            for row in final_report["decisions"]
+                            if row.get("action") == "move"
+                            and row.get("ok")
+                            and flash_shift_wall is not None
+                            and row.get("unix", 0.0) >= flash_shift_wall
+                        )
+                    placement_info = {
+                        "enabled": bool(config.placement_enabled),
+                        "hosts": ["0", "1"],
+                        "initial_placement": dict(fleet_placement),
+                        "restored_from_disk": placement_restored_from_disk,
+                        "shift_wall_unix": flash_shift_wall,
+                        "settle_sweeps": flash_settle_sweeps,
+                        "moved": sorted(placement_rows),
+                        "controls": placement_rows,
+                        "zero_loss": (
+                            all(
+                                row["bit_identical"]
+                                for row in placement_rows.values()
+                            )
+                            if placement_rows
+                            else None
+                        ),
+                        "moves_completed": (
+                            final_report["moves"]["completed"]
+                            if final_report is not None
+                            else 0
+                        ),
+                        "moves_failed": (
+                            final_report["moves"]["failed"]
+                            if final_report is not None
+                            else 0
+                        ),
+                        "post_shift_moves": post_shift_moves,
+                        "converged": (
+                            final_report is not None
+                            and not final_report["convergence"]["episode_open"]
+                            and final_report["convergence"]["episodes_closed"] >= 1
+                        ),
+                        "episodes_closed": (
+                            final_report["convergence"]["episodes_closed"]
+                            if final_report is not None
+                            else 0
+                        ),
+                        "convergence_seconds": (
+                            final_report["convergence"]["last_convergence_seconds"]
+                            if final_report is not None
+                            else None
+                        ),
+                        "final_placement": (
+                            {
+                                tenant: row["host"]
+                                for tenant, row in final_report[
+                                    "assignments"
+                                ].items()
+                            }
+                            if final_report is not None
+                            else {}
+                        ),
+                        "report": final_report,
+                        "probe": placement_probe,
+                    }
             elapsed = time.perf_counter() - perf_start
             scraper.stop()
             driver_scrapes = scraper.summary()
@@ -1382,6 +1742,13 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         if config.skewed_load:
             # the installed sampler is process-global too: leave none behind
             _fleet_mod.install_sampler(None)
+        if config.flash_crowd:
+            # the sampler AND the controller are process-global: restore the
+            # caller's controller (usually none) and leave no sampler behind
+            from torchmetrics_tpu import fleet as _placement_mod
+
+            _fleet_mod.install_sampler(None)
+            _placement_mod.install_controller(placement_prev)
         if profiler is not None:
             # stop sampling and restore whatever profiler the caller had
             # installed; the stopped profiler's tables stay readable for the
@@ -1441,6 +1808,10 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         import shutil
 
         shutil.rmtree(ckpt_dir, ignore_errors=True)
+    if flash_dir is not None:
+        import shutil
+
+        shutil.rmtree(flash_dir, ignore_errors=True)
     # batch-lineage causality evidence (the fault_causality SLO's input): one
     # row per injected NaN batch — does its trace id resolve to a record, and
     # does that record link the full story (guarded tenants: quarantine
@@ -1650,6 +2021,12 @@ def replay(schedule: TrafficSchedule, config: Optional[ReplayConfig] = None) -> 
         # mid-run hot-spot shift + wedged-gather evidence, and the HTTP-probed
         # /fleet payload an operator would read
         "fleet": fleet_info,
+        # placement-control-plane accounting (None unless
+        # ReplayConfig.flash_crowd): durable-restore evidence, the controller's
+        # move ledger + decision log, zero-loss bit-identity verdicts for every
+        # moved session, convergence (including the post-shift re-convergence),
+        # and the HTTP-probed /placement payload an operator would read
+        "placement": placement_info,
         "health": health,
         "tenants": tenants_page,
         "pipelines": reports,

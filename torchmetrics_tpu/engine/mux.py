@@ -938,7 +938,12 @@ class TenantMultiplexer:
             return 0
         self._last_readmit_check = now
         drained = 0
-        for tenant in list(self._deferred):
+        # priority classes (TenantQuota.priority): recovered headroom reaches
+        # the latency-sensitive tenants first — backlogs drain highest class
+        # first, name-ordered within a class for determinism
+        order = getattr(controller, "drain_order", None)
+        tenants = order(list(self._deferred)) if callable(order) else list(self._deferred)
+        for tenant in tenants:
             if tenant == exclude or not probe(tenant):
                 continue
             backlog = self._deferred.pop(tenant, None) or []
@@ -959,10 +964,15 @@ class TenantMultiplexer:
     def flush_deferred(self) -> None:
         """Drain every tenant's deprioritized backlog (admission decisions
         bypassed — the work executes regardless — but executed updates are
-        still billed, same as an in-stream drain)."""
+        still billed, same as an in-stream drain). Highest priority class
+        drains first (``TenantQuota.priority``): at close, too, the
+        latency-sensitive tenants' held batches fold before batch tiers'."""
         controller = self._admission()
         deferred, self._deferred = self._deferred, {}
-        for tenant, backlog in deferred.items():
+        order = getattr(controller, "drain_order", None)
+        tenants = order(list(deferred)) if callable(order) else list(deferred)
+        for tenant in tenants:
+            backlog = deferred[tenant]
             for args, kwargs, trace_id in backlog:
                 self._report.deferred_replayed += 1
                 self._tenant_deferred_replayed[tenant] = (
@@ -1165,6 +1175,28 @@ class TenantMultiplexer:
             if b >= n:
                 return b
         return self._buckets[-1]
+
+    def retune_width_buckets(self, buckets) -> Tuple[int, ...]:
+        """Adopt a new width-bucket ladder (admission-driven tuning).
+
+        The placement controller proposes ladders sized to the observed tenant
+        population (``fleet.PlacementController.propose_width_buckets``); this
+        is the mux-side commit. The proposal is validated through the same
+        ``MuxConfig`` rules as a construction-time ladder — positive, deduped,
+        ascending, top bucket clamped to ``max_width`` (so the ladder stays
+        O(log W)) — and an invalid proposal raises without touching state.
+        Groups already open keep the padded width they were admitted under;
+        only future dispatch padding consults the new ladder. Compiled fused
+        variants are cached per padded width, so a retune adds at most
+        O(log W) new compilation entries and orphans none.
+        """
+        cfg = MuxConfig(max_width=self.config.max_width, width_buckets=tuple(buckets))
+        resolved = cfg.buckets()
+        # both the config (report/introspection surface) and the cached ladder
+        # (dispatch hot path) must move together — __init__ caches buckets()
+        self.config.width_buckets = resolved
+        self._buckets = resolved
+        return resolved
 
     def _row_policy(self, tenant: str):
         """The error policy guarding this tenant's row (any fused metric's,

@@ -1,0 +1,29 @@
+"""Fleet control plane: placement, load-scored rebalancing, failover targets.
+
+The WRITE side of the fleet story. :mod:`torchmetrics_tpu.obs.fleet`
+observes (continuous sampling, rates, skew, advisory hints on ``GET
+/fleet``); this package acts — the :class:`PlacementController` owns the
+tenant → host assignment table, reconciles measured imbalance against a
+hysteresis band with bounded drain→checkpoint→restore moves, chooses
+failover targets for the fence watchdog, and proposes mux width-bucket
+ladders from the measured tenant population. It consumes only the ``/fleet``
+plane's tables and never derives metrics of its own.
+
+Pure stdlib (engine machinery arrives via the injected mover callback).
+"""
+
+from torchmetrics_tpu.fleet.placement import (
+    PLACEMENT_SCHEMA,
+    PlacementConfig,
+    PlacementController,
+    get_controller,
+    install_controller,
+)
+
+__all__ = [
+    "PLACEMENT_SCHEMA",
+    "PlacementConfig",
+    "PlacementController",
+    "get_controller",
+    "install_controller",
+]

@@ -1,0 +1,757 @@
+"""Placement control plane: the WRITE side of the fleet telemetry plane.
+
+:mod:`torchmetrics_tpu.obs.fleet` *observes* — it samples every host, derives
+rates and skew, and serves ADVISORY rebalance hints on ``GET /fleet``. This
+module *acts* on those observations (which is why it lives beside ``obs/``,
+not inside it): a :class:`PlacementController` owns the tenant → host /
+mux-session assignment table and closes the loop the hints left open.
+
+Contract with the READ side — the controller **consumes** the installed
+:class:`~torchmetrics_tpu.obs.fleet.FleetSampler`'s ``rates()`` / ``skew()`` /
+``rebalance_hints()`` and derives **no metrics of its own**. Every scoring
+input the controller uses is a number ``GET /fleet`` already serves, so an
+operator can always reproduce a placement decision from the public plane.
+
+The pieces:
+
+- **Initial placement** is consistent-hash (rendezvous / highest-random-weight
+  over the configured hosts — minimal reshuffling when the host set changes)
+  with a load-scored override: when the hash-chosen host is measurably the
+  hottest in the fleet, the least-burning host takes the tenant instead.
+- **Reconcile loop**: :meth:`PlacementController.tick` is scrape-ticked like
+  the fence watchdog and the conservation auditor (cadence-gated, injectable
+  clock — wire-free: ``/metrics`` traffic drives it). Measured imbalance is
+  compared against a **hysteresis band**: reconciliation engages above
+  ``hysteresis_high``, keeps working until the coefficient drops below
+  ``hysteresis_low``, and stays idle in between — so a fleet hovering at the
+  threshold does not thrash tenants back and forth. At most
+  ``max_concurrent_moves`` moves execute per reconcile, each as a full
+  drain→checkpoint→restore→replay-tail move through the injected ``mover``
+  (the :mod:`torchmetrics_tpu.engine.migrate` machinery — injected, so this
+  module stays pure stdlib), each under
+  :func:`torchmetrics_tpu.obs.scope.migration` so ``/healthz`` answers
+  degraded-not-dead with the moving tenant named.
+- **Failover target choice**: :meth:`choose_restore_host` picks the
+  least-loaded live host for a fenced tenant — the
+  :class:`~torchmetrics_tpu.robust.fence.Watchdog` delegates here when a
+  controller is installed, instead of restoring onto whatever directory the
+  caller named.
+- **Width-bucket tuning**: :meth:`propose_width_buckets` derives a mux
+  ``width_buckets`` ladder from the measured tenant population, bounded by
+  the existing O(log W) powers-of-two discipline.
+- **Durability**: the assignment table is a schema-versioned atomic JSON file
+  (:func:`torchmetrics_tpu.utils.fileio.atomic_write_text`), restored on
+  construction — a controller restart inherits its placements instead of
+  re-hashing the world.
+
+Install the process singleton with :func:`install_controller`; every
+``/metrics`` scrape ticks it and refreshes the ``placement.*`` gauge
+families, and ``GET /placement`` serves :meth:`PlacementController.report`.
+With no controller installed every integration seam is one ``is None``
+branch — the disabled path costs nothing.
+
+Pure stdlib; the engine machinery arrives only through the injected mover.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import torchmetrics_tpu.obs.scope as _scope
+from torchmetrics_tpu.obs import fleet as _fleet
+from torchmetrics_tpu.utils.fileio import atomic_write_text
+
+__all__ = [
+    "PLACEMENT_SCHEMA",
+    "PlacementConfig",
+    "PlacementController",
+    "get_controller",
+    "install_controller",
+]
+
+# durable assignment-table schema: bump on any layout change, and refuse to
+# load a mismatched table loudly (the chaos schedule.loads() discipline — a
+# half-understood placement table is worse than no table)
+PLACEMENT_SCHEMA = 1
+
+DEFAULT_CADENCE_SECONDS = 5.0
+# the hysteresis band (normalized imbalance coefficient, [0, 1]): reconcile
+# engages above high, disengages below low. The defaults bracket the fleet
+# plane's paging threshold (fleet.DEFAULT_IMBALANCE_THRESHOLD = 0.5): moves
+# start exactly where the imbalance alert pages, and continue until the fleet
+# is measurably comfortable — not merely one hint below the trigger.
+DEFAULT_HYSTERESIS_HIGH = 0.5
+DEFAULT_HYSTERESIS_LOW = 0.25
+
+
+@dataclass
+class PlacementConfig:
+    """Tuning knobs for :class:`PlacementController`.
+
+    Args:
+        hosts: the host names placement assigns over (the virtual-host names
+            a single-process harness models, or real process indices as
+            strings). At least one; order is irrelevant (rendezvous hashing
+            is order-free).
+        cadence_seconds: min seconds between reconcile passes (``tick``
+            honors it — the scrape-tick driver calls far more often).
+        hysteresis_high: reconcile engages when measured imbalance exceeds
+            this.
+        hysteresis_low: reconcile disengages when imbalance drops below this
+            (must be < ``hysteresis_high`` — the gap is the anti-thrash
+            band).
+        max_concurrent_moves: ceiling on moves in flight per reconcile pass —
+            a rebalance is a drain+restore per tenant, and a controller that
+            moves half the fleet at once IS the incident it exists to
+            prevent.
+        state_path: durable JSON table location (``None`` disables
+            durability — tests, or callers that own persistence).
+        decision_log: bounded count of retained reconcile decisions (the
+            ``GET /placement`` decision log; oldest dropped).
+        smoothing_windows: how many sampler cadences of history the
+            controller's rate reads smooth over (``sampler.rates(window=
+            smoothing_windows * cadence)``). Adjacent-sample rates are
+            twitchy — one quiet tick reads as a rate collapse, crowns the
+            wrong hot host, and a controller scoring off that WOULD thrash
+            sessions back and forth. Must be >= 1 (1 = adjacent samples).
+        pinned: tenants the controller must never move (operator pin — a
+            session whose drain/restore is known-unsafe, or one an incident
+            response wants frozen in place). Pinned tenants keep their
+            assignment and are skipped by the hint loop; everything else
+            about them (lookup, report, gauges) is unchanged.
+    """
+
+    hosts: Tuple[str, ...] = ()
+    cadence_seconds: float = DEFAULT_CADENCE_SECONDS
+    hysteresis_high: float = DEFAULT_HYSTERESIS_HIGH
+    hysteresis_low: float = DEFAULT_HYSTERESIS_LOW
+    max_concurrent_moves: int = 1
+    state_path: Optional[str] = None
+    decision_log: int = 64
+    smoothing_windows: float = 10.0
+    pinned: Tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        hosts = tuple(str(h) for h in self.hosts)
+        if not hosts:
+            raise ValueError("Expected at least one host in `hosts`")
+        if len(set(hosts)) != len(hosts):
+            raise ValueError(f"Expected unique `hosts`, got {self.hosts}")
+        self.hosts = hosts
+        if self.cadence_seconds <= 0:
+            raise ValueError(f"Expected `cadence_seconds` > 0, got {self.cadence_seconds}")
+        if not 0.0 < self.hysteresis_high <= 1.0:
+            raise ValueError(
+                f"Expected `hysteresis_high` in (0, 1], got {self.hysteresis_high}"
+            )
+        if not 0.0 <= self.hysteresis_low < self.hysteresis_high:
+            raise ValueError(
+                "Expected 0 <= `hysteresis_low` < `hysteresis_high`, got"
+                f" low={self.hysteresis_low} high={self.hysteresis_high}"
+            )
+        if self.max_concurrent_moves < 1:
+            raise ValueError(
+                f"Expected `max_concurrent_moves` >= 1, got {self.max_concurrent_moves}"
+            )
+        if self.decision_log < 1:
+            raise ValueError(f"Expected `decision_log` >= 1, got {self.decision_log}")
+        if self.smoothing_windows < 1:
+            raise ValueError(
+                f"Expected `smoothing_windows` >= 1, got {self.smoothing_windows}"
+            )
+        self.pinned = tuple(str(t) for t in self.pinned)
+
+
+def _rendezvous_weight(tenant: str, host: str) -> int:
+    """Highest-random-weight score of (tenant, host) — stable across runs."""
+    digest = hashlib.sha256(f"{tenant}\x00{host}".encode("utf-8")).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+class PlacementController:
+    """The tenant → host assignment table plus the loop that keeps it balanced.
+
+    Args:
+        config: the :class:`PlacementConfig` knobs.
+        sampler: an explicit :class:`~torchmetrics_tpu.obs.fleet.FleetSampler`
+            to consume; default resolves the installed process singleton per
+            tick (:func:`~torchmetrics_tpu.obs.fleet.get_sampler`). All
+            scoring reads this sampler's public tables — the controller never
+            derives its own metrics.
+        mover: ``mover(tenant, from_host, to_host) -> bool`` executes one
+            real drain→checkpoint→restore→replay-tail move (the
+            :mod:`~torchmetrics_tpu.engine.migrate` machinery, injected so
+            this module stays stdlib-pure). ``None`` degrades moves to
+            table-only reassignment — correct for harnesses whose "hosts"
+            are the sampler's virtual placement map and nothing physical
+            moves.
+        clock: monotonic clock (injectable for deterministic tests).
+        wall: wall clock for display stamps.
+        recorder: where ``placement.*`` gauges land (default: process-global).
+    """
+
+    def __init__(
+        self,
+        config: PlacementConfig,
+        sampler: Optional[Any] = None,
+        mover: Optional[Callable[[str, str, str], bool]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        wall: Callable[[], float] = time.time,
+        recorder: Optional[Any] = None,
+    ) -> None:
+        self.config = config
+        self.mover = mover
+        self._sampler = sampler
+        self._clock = clock
+        self._wall = wall
+        self._recorder = recorder
+        self._lock = threading.RLock()
+        self._assignments: Dict[str, Dict[str, Any]] = {}
+        self._moving: Dict[str, Dict[str, Any]] = {}  # tenant -> in-flight move row
+        self._decisions: List[Dict[str, Any]] = []
+        self._last_reconcile: Optional[Dict[str, Any]] = None
+        self._last_tick_mono: Optional[float] = None
+        self.moves_started = 0
+        self.moves_completed = 0
+        self.moves_failed = 0
+        # convergence episode: opens when imbalance crosses above the high
+        # threshold, closes when it drops below the low one — the open-to-close
+        # wall delta IS the convergence time the SLO judges
+        self._episode_start: Optional[float] = None
+        self._last_convergence_seconds: Optional[float] = None
+        self._episodes_closed = 0
+        if config.state_path:
+            self._restore_table()
+
+    # ------------------------------------------------------------- durability
+
+    def _restore_table(self) -> None:
+        path = self.config.state_path
+        assert path is not None
+        if not os.path.exists(path):
+            return
+        with open(path, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        schema = payload.get("schema")
+        if schema != PLACEMENT_SCHEMA:
+            raise ValueError(
+                f"Placement table {path!r} has schema {schema!r}, this build expects"
+                f" {PLACEMENT_SCHEMA} — refusing to half-understand a placement table"
+            )
+        assignments = payload.get("assignments") or {}
+        for tenant, row in assignments.items():
+            host = str(row.get("host"))
+            if host not in self.config.hosts:
+                # a restored assignment onto a host this controller no longer
+                # manages is re-placed on first sight, not silently trusted
+                continue
+            self._assignments[str(tenant)] = {
+                "host": host,
+                "source": str(row.get("source", "restored")),
+                "assigned_unix": float(row.get("assigned_unix", 0.0)),
+                "moves": int(row.get("moves", 0)),
+            }
+        counters = payload.get("counters") or {}
+        self.moves_started = int(counters.get("moves_started", 0))
+        self.moves_completed = int(counters.get("moves_completed", 0))
+        self.moves_failed = int(counters.get("moves_failed", 0))
+
+    def _persist_table(self) -> None:
+        path = self.config.state_path
+        if not path:
+            return
+        with self._lock:
+            payload = {
+                "schema": PLACEMENT_SCHEMA,
+                "written_unix": self._wall(),
+                "hosts": list(self.config.hosts),
+                "assignments": {t: dict(row) for t, row in self._assignments.items()},
+                "counters": {
+                    "moves_started": self.moves_started,
+                    "moves_completed": self.moves_completed,
+                    "moves_failed": self.moves_failed,
+                },
+            }
+        atomic_write_text(path, json.dumps(payload, sort_keys=True, indent=1) + "\n")
+
+    # -------------------------------------------------------------- consuming
+
+    def _resolve_sampler(self) -> Optional[Any]:
+        return self._sampler if self._sampler is not None else _fleet.get_sampler()
+
+    def _host_loads(self, rates: Optional[Dict[str, Any]] = None) -> Dict[str, float]:
+        """Measured per-host burn over the configured hosts, /fleet-sourced.
+
+        Score preference order mirrors what the hints already rank: the
+        cost-ledger flop burn when the ledger priced anything this window,
+        else the measured update rate. Hosts the sampler has not seen load 0.
+        """
+        sampler = self._resolve_sampler()
+        loads = {host: 0.0 for host in self.config.hosts}
+        if sampler is None:
+            return loads
+        rates = sampler.rates() if rates is None else rates
+        hosts = rates.get("hosts") or {}
+        use_flops = any(float(row.get("flops_per_second", 0.0) or 0.0) > 0 for row in hosts.values())
+        for host, row in hosts.items():
+            if host not in loads:
+                continue
+            loads[host] = float(
+                row.get("flops_per_second", 0.0) if use_flops else row.get("updates_per_second", 0.0)
+            )
+        return loads
+
+    # ------------------------------------------------------------- assignment
+
+    def hash_host(self, tenant: str) -> str:
+        """The pure consistent-hash (rendezvous) choice for ``tenant``."""
+        return max(self.config.hosts, key=lambda host: (_rendezvous_weight(tenant, host), host))
+
+    def assign(self, tenant: str) -> str:
+        """Place ``tenant`` (idempotent): rendezvous hash, load-scored override.
+
+        The override consults only the sampler's measured per-host burn: when
+        the hash-chosen host is the fleet's measurably hottest (strictly above
+        every alternative), the least-burning host takes the tenant instead —
+        a flash crowd must not pile every hash-colliding arrival onto a host
+        that is already the skew signal's subject.
+        """
+        _scope.validate_tenant(tenant)
+        with self._lock:
+            row = self._assignments.get(tenant)
+            if row is not None:
+                return row["host"]
+        host = self.hash_host(tenant)
+        source = "hash"
+        if len(self.config.hosts) > 1:
+            loads = self._host_loads()
+            if any(loads.values()) and loads[host] >= max(loads.values()) and loads[host] > min(loads.values()):
+                host = min(self.config.hosts, key=lambda h: (loads[h], h))
+                source = "load"
+        with self._lock:
+            row = self._assignments.get(tenant)
+            if row is not None:  # lost a race: first placement wins
+                return row["host"]
+            self._assignments[tenant] = {
+                "host": host,
+                "source": source,
+                "assigned_unix": self._wall(),
+                "moves": 0,
+            }
+        self._persist_table()
+        return host
+
+    def seed(self, assignments: Dict[str, str]) -> None:
+        """Adopt a pre-existing placement wholesale (migration-in path).
+
+        A controller brought up over a fleet that already *has* a placement —
+        operator-assigned, inherited from a predecessor, or a chaos harness
+        modeling a skewed world — must start from that reality, not re-hash
+        it: rebalancing is the controller's job, silently shuffling a live
+        fleet at startup is not. Every host must be one this controller
+        manages (ValueError otherwise — a seed onto an unmanaged host is a
+        config mismatch, not an assignment). Seeded rows persist durably like
+        any other, and the sampler's placement map is updated so the READ
+        side attributes rates to the seeded hosts immediately.
+        """
+        rows: Dict[str, str] = {}
+        for tenant, host in assignments.items():
+            _scope.validate_tenant(tenant)
+            host = str(host)
+            if host not in self.config.hosts:
+                raise ValueError(
+                    f"Cannot seed tenant {tenant!r} onto unmanaged host {host!r};"
+                    f" this controller places over {self.config.hosts}"
+                )
+            rows[str(tenant)] = host
+        sampler = self._resolve_sampler()
+        with self._lock:
+            for tenant, host in rows.items():
+                self._assignments[tenant] = {
+                    "host": host,
+                    "source": "seed",
+                    "assigned_unix": self._wall(),
+                    "moves": 0,
+                }
+        if sampler is not None and getattr(sampler, "placement", None) is not None:
+            sampler.placement.update(rows)
+        self._persist_table()
+        self._decide("seed", tenants=len(rows))
+
+    def lookup(self, tenant: str) -> Optional[str]:
+        """The assigned host, or ``None`` for a never-placed tenant."""
+        with self._lock:
+            row = self._assignments.get(tenant)
+            return row["host"] if row is not None else None
+
+    def assignments(self) -> Dict[str, Dict[str, Any]]:
+        """The assignment table, copied: ``{tenant: {host, source, ...}}``."""
+        with self._lock:
+            return {tenant: dict(row) for tenant, row in self._assignments.items()}
+
+    def _reassign(self, tenant: str, host: str, source: str) -> None:
+        with self._lock:
+            row = self._assignments.setdefault(
+                tenant, {"host": host, "source": source, "assigned_unix": self._wall(), "moves": 0}
+            )
+            row["host"] = host
+            row["source"] = source
+            row["assigned_unix"] = self._wall()
+            row["moves"] = int(row.get("moves", 0)) + 1
+        sampler = self._resolve_sampler()
+        if sampler is not None and getattr(sampler, "placement", None) is not None:
+            # single-process harnesses model hosts through the sampler's
+            # static placement map — the move is not real until the READ side
+            # attributes the tenant's future rate to its new host
+            sampler.placement[tenant] = host
+        self._persist_table()
+
+    # -------------------------------------------------------------- failover
+
+    def choose_restore_host(self, tenant: str, exclude: Optional[str] = None) -> str:
+        """The restore host for a fenced tenant: least measured burn, live only.
+
+        ``exclude`` (default: the tenant's current assignment — the
+        presumed-hung origin) never wins; hosts missing from the newest fleet
+        sample are skipped when any live alternative exists. Falls back to
+        the rendezvous choice over the eligible set when the fleet plane has
+        no rates yet.
+        """
+        origin = exclude if exclude is not None else self.lookup(tenant)
+        candidates = [h for h in self.config.hosts if h != origin] or list(self.config.hosts)
+        sampler = self._resolve_sampler()
+        if sampler is not None:
+            try:
+                missing = {str(m) for m in (sampler.history() or [{}])[-1].get("missing_hosts", [])}
+            except Exception:
+                missing = set()
+            live = [h for h in candidates if h not in missing]
+            if live:
+                candidates = live
+            loads = self._host_loads()
+            if any(loads.get(h, 0.0) for h in candidates):
+                return min(candidates, key=lambda h: (loads.get(h, 0.0), h))
+        return max(candidates, key=lambda host: (_rendezvous_weight(tenant, host), host))
+
+    def note_failover(self, tenant: str, host: str) -> None:
+        """Record a watchdog-executed failover landing ``tenant`` on ``host``."""
+        self._reassign(tenant, host, source="failover")
+        self._decide("failover", tenant=tenant, to=host)
+
+    # ------------------------------------------------------------- reconcile
+
+    def _decide(self, action: str, **detail: Any) -> Dict[str, Any]:
+        row = {"action": action, "unix": self._wall(), **detail}
+        with self._lock:
+            self._decisions.append(row)
+            del self._decisions[: -self.config.decision_log]
+        return row
+
+    def tick(self, now: Optional[float] = None) -> Optional[Dict[str, Any]]:
+        """One cadence-gated reconcile pass; the scrape-tick driver's entry.
+
+        Returns the reconcile summary when a pass ran, ``None`` when the
+        cadence has not elapsed or no sampler is installed (the plane-off
+        one-branch path).
+        """
+        mono = float(now if now is not None else self._clock())
+        with self._lock:
+            if (
+                self._last_tick_mono is not None
+                and mono - self._last_tick_mono < self.config.cadence_seconds
+            ):
+                return None
+            self._last_tick_mono = mono
+        sampler = self._resolve_sampler()
+        if sampler is None:
+            return None
+        return self.reconcile(now=mono)
+
+    def reconcile(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Compare measured imbalance to the hysteresis band; move if needed.
+
+        Every scoring input is the sampler's: ``rates()`` → ``skew()`` →
+        ``rebalance_hints()`` — exactly the tables ``GET /fleet`` serves.
+        Moves cap at ``max_concurrent_moves`` per pass; a tenant currently
+        migrating or fenced is never moved (the hints already filter both,
+        and the executor re-checks — a double drain is state corruption).
+        """
+        mono = float(now if now is not None else self._clock())
+        sampler = self._resolve_sampler()
+        summary: Dict[str, Any] = {
+            "unix": self._wall(),
+            "imbalance": None,
+            "engaged": False,
+            "moves": [],
+        }
+        if sampler is None:
+            summary["decision"] = "no-sampler"
+            with self._lock:
+                self._last_reconcile = summary
+            return summary
+        # smoothed reads: adjacent-sample rates are twitchy (one quiet tick
+        # reads as a rate collapse and crowns the wrong hot host), so the
+        # controller scores over a few sampler cadences of history — the same
+        # public rates() table, wider delta base
+        window: Optional[float] = None
+        cadence = getattr(sampler, "cadence_seconds", None)
+        if cadence:
+            window = self.config.smoothing_windows * float(cadence)
+        rates = sampler.rates(window=window)
+        skew = sampler.skew(rates)
+        imbalance = float(skew.get("imbalance") or 0.0)
+        summary["imbalance"] = imbalance
+        # hysteresis: engage above high; once an episode is open, keep
+        # reconciling down to low — the band between is the no-thrash zone
+        with self._lock:
+            if self._episode_start is None and imbalance > self.config.hysteresis_high:
+                self._episode_start = mono
+                self._decide("episode-open", imbalance=imbalance)
+            engaged = self._episode_start is not None
+            if engaged and imbalance < self.config.hysteresis_low:
+                self._last_convergence_seconds = mono - self._episode_start
+                self._episodes_closed += 1
+                self._episode_start = None
+                engaged = False
+                self._decide(
+                    "episode-close",
+                    imbalance=imbalance,
+                    convergence_seconds=self._last_convergence_seconds,
+                )
+            in_flight = len(self._moving)
+        summary["engaged"] = engaged
+        if not engaged:
+            summary["decision"] = "balanced"
+            with self._lock:
+                self._last_reconcile = summary
+            return summary
+        budget = self.config.max_concurrent_moves - in_flight
+        if budget <= 0:
+            summary["decision"] = "move-cap"
+            with self._lock:
+                self._last_reconcile = summary
+            return summary
+        hints = (sampler.rebalance_hints(rates, skew) or {}).get("hints") or []
+        busy = set(_scope.migrating_tenants()) | set(_scope.fenced_tenants())
+        moved: List[Dict[str, Any]] = []
+        for hint in hints:
+            if budget <= 0:
+                break
+            tenant = str(hint["tenant"])
+            if tenant in self.config.pinned:
+                continue  # operator pin: never moved, however hot it reads
+            if tenant in busy:
+                continue  # belt and braces over the hint-side filter
+            with self._lock:
+                if tenant in self._moving:
+                    continue
+            to_host = str(hint["to"])
+            from_host = self.lookup(tenant) or str(hint["from"])
+            if to_host == from_host or to_host not in self.config.hosts:
+                continue
+            moved.append(self._execute_move(tenant, from_host, to_host, hint))
+            budget -= 1
+        summary["moves"] = moved
+        summary["decision"] = "moved" if moved else "no-eligible-move"
+        with self._lock:
+            self._last_reconcile = summary
+        return summary
+
+    def _execute_move(
+        self, tenant: str, from_host: str, to_host: str, hint: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        """One bounded move: announce, drain+restore via the mover, commit.
+
+        The whole move runs under ``scope.migration(tenant, "rebalance")`` so
+        ``/healthz`` names the moving tenant degraded-not-dead for its full
+        duration — including the mover's checkpoint/restore, which nests its
+        own migration phases (innermost wins in the report, the outer entry
+        keeps the window covered edge to edge).
+        """
+        start = self._clock()
+        row = {
+            "tenant": tenant,
+            "from": from_host,
+            "to": to_host,
+            "started_unix": self._wall(),
+            "projected_imbalance": hint.get("projected_imbalance"),
+        }
+        with self._lock:
+            self.moves_started += 1
+            self._moving[tenant] = row
+        ok = True
+        try:
+            with _scope.migration(tenant, "rebalance"):
+                if self.mover is not None:
+                    ok = bool(self.mover(tenant, from_host, to_host))
+        except Exception as err:  # noqa: BLE001 - a failed move must not kill the loop
+            ok = False
+            row["error"] = f"{type(err).__name__}: {err}"
+        finally:
+            with self._lock:
+                self._moving.pop(tenant, None)
+        row["seconds"] = self._clock() - start
+        row["ok"] = ok
+        if ok:
+            self._reassign(tenant, to_host, source="rebalance")
+            with self._lock:
+                self.moves_completed += 1
+        else:
+            with self._lock:
+                self.moves_failed += 1
+        # re-persist AFTER the outcome counters settle: the durable table's
+        # counters must cover this move, not lag one write behind it
+        self._persist_table()
+        self._decide("move", **{k: v for k, v in row.items() if k != "started_unix"})
+        return row
+
+    # ------------------------------------------------------------ mux tuning
+
+    def propose_width_buckets(self, max_width: int = 64) -> Tuple[int, ...]:
+        """A mux ``width_buckets`` ladder sized to the measured population.
+
+        Powers of two up to the smallest bucket covering the tenant
+        population this controller places (live sampler tenants joined with
+        the assignment table), capped at ``max_width`` — so a 12-tenant fleet
+        compiles a (1,2,4,8,16) ladder instead of padding into a 64-wide
+        program, and the ladder length stays O(log W) by construction.
+        ``MuxConfig(width_buckets=...)`` validates and tops the ladder.
+        """
+        if max_width < 1:
+            raise ValueError(f"Expected `max_width` >= 1, got {max_width}")
+        sampler = self._resolve_sampler()
+        population = len(self._assignments)
+        if sampler is not None:
+            try:
+                population = max(population, len(sampler.rates().get("tenants") or {}))
+            except Exception:
+                pass
+        population = max(1, min(int(population), int(max_width)))
+        ladder: List[int] = []
+        width = 1
+        while width < population:
+            ladder.append(width)
+            width *= 2
+        ladder.append(min(width, int(max_width)))
+        return tuple(ladder)
+
+    # --------------------------------------------------------------- serving
+
+    def report(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The ``GET /placement`` payload: table, moves, decisions, convergence."""
+        with self._lock:
+            assignments = {t: dict(row) for t, row in self._assignments.items()}
+            moving = {t: dict(row) for t, row in self._moving.items()}
+            decisions = [dict(row) for row in self._decisions]
+            last_reconcile = dict(self._last_reconcile) if self._last_reconcile else None
+            episode_open = self._episode_start is not None
+            convergence = self._last_convergence_seconds
+            episodes_closed = self._episodes_closed
+        if tenant is not None:
+            assignments = {t: row for t, row in assignments.items() if t == tenant}
+            moving = {t: row for t, row in moving.items() if t == tenant}
+            decisions = [row for row in decisions if row.get("tenant") == tenant]
+        return {
+            "schema": PLACEMENT_SCHEMA,
+            "config": {
+                "hosts": list(self.config.hosts),
+                "cadence_seconds": self.config.cadence_seconds,
+                "hysteresis_high": self.config.hysteresis_high,
+                "hysteresis_low": self.config.hysteresis_low,
+                "max_concurrent_moves": self.config.max_concurrent_moves,
+                "smoothing_windows": self.config.smoothing_windows,
+                "pinned": list(self.config.pinned),
+                "durable": bool(self.config.state_path),
+            },
+            "assignments": assignments,
+            "moving": moving,
+            "decisions": decisions,
+            "moves": {
+                "started": self.moves_started,
+                "completed": self.moves_completed,
+                "failed": self.moves_failed,
+                "in_flight": len(moving),
+            },
+            "convergence": {
+                "episode_open": episode_open,
+                "episodes_closed": episodes_closed,
+                "last_convergence_seconds": convergence,
+            },
+            "last_reconcile": last_reconcile,
+        }
+
+    def record_gauges(
+        self, recorder: Optional[Any] = None, now: Optional[float] = None
+    ) -> Dict[str, Any]:
+        """Write the ``placement.*`` gauge families into the recorder.
+
+        All point-in-time controller state, so every family is a gauge —
+        never ``_total``. Per-host assignment counts carry the ``host``
+        label; everything else is unlabeled (``tenant=None`` opts out of
+        ambient scope tagging, the fleet-gauge discipline).
+        """
+        import torchmetrics_tpu.obs.trace as trace  # lazy: placement stays cycle-free
+
+        rec = recorder if recorder is not None else (self._recorder or trace.get_recorder())
+        mono = float(now if now is not None else self._clock())
+        with self._lock:
+            per_host: Dict[str, int] = {host: 0 for host in self.config.hosts}
+            for row in self._assignments.values():
+                per_host[row["host"]] = per_host.get(row["host"], 0) + 1
+            n_assignments = len(self._assignments)
+            in_flight = len(self._moving)
+            convergence = self._last_convergence_seconds
+            episode_open = self._episode_start is not None
+            decision_age = (
+                None
+                if not self._decisions
+                else max(0.0, self._wall() - float(self._decisions[-1]["unix"]))
+            )
+        rec.set_gauge("placement.assignments", float(n_assignments), tenant=None)
+        for host, count in per_host.items():
+            rec.set_gauge("placement.host_tenants", float(count), host=host, tenant=None)
+        rec.set_gauge("placement.moves_in_flight", float(in_flight), tenant=None)
+        rec.set_gauge("placement.moves_started", float(self.moves_started), tenant=None)
+        rec.set_gauge("placement.moves_completed", float(self.moves_completed), tenant=None)
+        rec.set_gauge("placement.moves_failed", float(self.moves_failed), tenant=None)
+        rec.set_gauge("placement.rebalancing", 1.0 if episode_open else 0.0, tenant=None)
+        if convergence is not None:
+            rec.set_gauge("placement.convergence_seconds", float(convergence), tenant=None)
+        if decision_age is not None:
+            rec.set_gauge("placement.decision_age_seconds", float(decision_age), tenant=None)
+        return {
+            "assignments": n_assignments,
+            "in_flight": in_flight,
+            "mono": mono,
+        }
+
+
+# ------------------------------------------------------------ module singleton
+
+# the process singleton the /metrics render chain ticks and /placement serves —
+# the obs.fleet.install_sampler pattern exactly
+_CONTROLLER: Optional[PlacementController] = None
+
+
+def install_controller(
+    controller: Optional[PlacementController],
+) -> Optional[PlacementController]:
+    """Install (or clear, with ``None``) the process-wide placement controller.
+
+    Returns the previous singleton so callers can restore it (test hygiene).
+    """
+    global _CONTROLLER
+    previous = _CONTROLLER
+    _CONTROLLER = controller
+    return previous
+
+
+def get_controller() -> Optional[PlacementController]:
+    """The installed placement controller, or ``None`` (placement is static)."""
+    return _CONTROLLER

@@ -306,6 +306,7 @@ _GAUGE_HELP = {
     "tenant.quota_window_flops": "Estimated flops billed to this tenant in the current quota window (cost-ledger priced)",
     "tenant.quota_window_bytes": "Estimated bytes-accessed billed to this tenant in the current quota window",
     "tenant.quota_window_compile_seconds": "XLA compile wall-seconds billed to this tenant in the current quota window",
+    "tenant.quota_priority": "Admission priority class of this tenant's quota (higher drains first from deferred backlogs)",
     # cross-tenant multiplexer families (engine/mux.py): one fused vmap
     # dispatch folds many tenants' same-signature updates
     "engine.mux_width": "Tenant count of the multiplexer's last fused dispatch (pre-padding)",
@@ -330,6 +331,7 @@ _GAUGE_HELP = {
     "fence.fenced_epochs": "Session epochs fenced off as zombies (each one is a completed or pending failover)",
     "fence.bundles_rejected": "Post-fence zombie bundle writes rejected by recovery scans (counted, never restored)",
     "fence.bundles_swept": "Post-fence zombie bundles garbage-collected from disk by retention sweeps",
+    "fence.failover_yielded": "Failovers this process stood down from after losing the durable claim-file election",
     "checkpoint.torn_bundles": "Torn/corrupt checkpoint bundles recovery scans skipped while selecting a restore point",
     # fleet telemetry plane families (obs/fleet.py): continuous cross-host
     # sampling, rate derivation from consecutive samples, and skew signals
@@ -367,6 +369,18 @@ _GAUGE_HELP = {
     "audit.shed": "Batches shed by admission for the labeled tenant across non-fenced epochs",
     "audit.deferred_pending": "Deferred batches still awaiting replay for the labeled tenant",
     "audit.violations": "Conservation-audit violations (per labeled invariant, plus the unlabeled total the audit_violation preset watches)",
+    # placement control-plane families (fleet/placement.py): the tenant→host
+    # assignment table, rebalance moves and hysteresis-episode convergence —
+    # all gauges (point-in-time controller state), never _total
+    "placement.assignments": "Tenants currently assigned a host in the placement controller's table",
+    "placement.host_tenants": "Tenants the placement table currently assigns to the labeled host",
+    "placement.moves_in_flight": "Rebalance moves (drain->checkpoint->restore->replay) currently executing",
+    "placement.moves_started": "Rebalance moves the controller has started since construction",
+    "placement.moves_completed": "Rebalance moves completed successfully since construction",
+    "placement.moves_failed": "Rebalance moves that failed (tenant left on its origin host) since construction",
+    "placement.rebalancing": "1 while a hysteresis episode is open (imbalance above the high-water band), else 0",
+    "placement.convergence_seconds": "Wall seconds the last closed hysteresis episode took to converge below the low-water band",
+    "placement.decision_age_seconds": "Seconds since the placement controller last logged a decision",
 }
 
 

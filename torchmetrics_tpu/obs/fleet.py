@@ -47,7 +47,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Dict, List, Mapping, Optional
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence
 
 import torchmetrics_tpu.obs.trace as trace
 from torchmetrics_tpu.obs import aggregate as _aggregate
@@ -108,6 +108,14 @@ class FleetSampler:
             harnesses modeling a fleet — per-host shares and skew then group
             measured per-tenant rates by this static placement instead of by
             real process indices.
+        hosts: optional explicit host universe. Rate tables only contain
+            hosts that carried load, so a fully idle provisioned host is
+            invisible to them — and a fleet concentrated on one host would
+            read as a single-host fleet with nothing to balance. Naming the
+            provisioned hosts pads :meth:`skew` (and therefore
+            :meth:`rebalance_hints` and the ``fleet.imbalance`` gauge) with
+            zero-load entries for the idle ones, so concentration on one of
+            two provisioned hosts reads as imbalance 1.0, not 0.0.
         clock: monotonic clock rate deltas divide by (injectable).
         wall: wall clock for display stamps (injectable).
     """
@@ -119,6 +127,7 @@ class FleetSampler:
         top_k: int = DEFAULT_TOP_K,
         recorder: Optional[trace.TraceRecorder] = None,
         placement: Optional[Mapping[str, str]] = None,
+        hosts: Optional[Sequence[str]] = None,
         clock: Callable[[], float] = time.monotonic,
         wall: Callable[[], float] = time.time,
         description: str = "fleet sample",
@@ -130,6 +139,7 @@ class FleetSampler:
         self.cadence_seconds = float(cadence_seconds)
         self.top_k = max(1, int(top_k))
         self.placement = dict(placement) if placement else None
+        self.hosts = tuple(dict.fromkeys(str(h) for h in hosts)) if hosts else None
         self.description = description
         self._recorder = recorder
         self._clock = clock
@@ -382,6 +392,12 @@ class FleetSampler:
         rates = self.rates(window=window) if rates is None else rates
         hosts = rates.get("hosts") or {}
         loads = {host: float(row.get("updates_per_second", 0.0)) for host, row in hosts.items()}
+        if self.hosts is not None and loads:
+            # provisioned-but-idle hosts carried no load, so the rate table
+            # never mentions them — pad them in at zero or concentration on
+            # one provisioned host reads as a balanced single-host fleet
+            for host in self.hosts:
+                loads.setdefault(host, 0.0)
         total = sum(loads.values())
         n = len(loads)
         out: Dict[str, Any] = {
@@ -461,8 +477,17 @@ class FleetSampler:
             return max(0.0, (top / total - 1.0 / n) / (1.0 - 1.0 / n))
 
         current = coefficient(loads)
+        # a tenant mid-migration or fenced is not movable advice: its state is
+        # in flight (or its session is a zombie awaiting failover), and a
+        # controller acting on the hint would double-drain it — the hint
+        # ranking must join the control-plane busy set, not just the rates
+        from torchmetrics_tpu.obs import scope as _scope
+
+        busy = set(_scope.migrating_tenants()) | set(_scope.fenced_tenants())
         hints = []
         for tenant, row in (rates.get("tenants") or {}).items():
+            if tenant in busy:
+                continue
             if hot not in (row.get("hosts") or []):
                 continue
             rate = float(row.get("updates_per_second", 0.0))

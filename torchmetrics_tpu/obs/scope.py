@@ -56,7 +56,7 @@ import warnings
 from contextlib import contextmanager
 from contextvars import ContextVar
 from dataclasses import dataclass
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Tuple
 
 __all__ = [
     "ADMIT",
@@ -74,6 +74,7 @@ __all__ = [
     "configure",
     "current_tenant",
     "expired_leases",
+    "failover_yielded_count",
     "fence_status",
     "fenced_rejected_count",
     "fenced_swept_count",
@@ -89,6 +90,7 @@ __all__ = [
     "note_checkpoint_closed",
     "note_checkpoint_failure",
     "note_compute",
+    "note_failover_yielded",
     "note_fence",
     "note_fenced_bundle_rejected",
     "note_fenced_bundle_swept",
@@ -326,9 +328,16 @@ class TenantRegistry:
         a session restored on this host carries its origin host's update/compute
         totals, and the registry must keep counting from there — a tenant that
         served a million updates before the rolling deploy did not become a
-        newborn by moving. The earliest first-seen stamp wins; the restore
-        itself counts as activity (``last_seen`` moves). Returns a copy of the
-        merged row.
+        newborn by moving. The merge is a **high-water max**, not an add: the
+        restored totals are recovered state, not new work. On a pristine host
+        the row jumps to the carried total; when the restore lands in the SAME
+        process that already counted those updates (a placement-controller
+        rebalance, a supervisor restart in-process), adding would double-count
+        — and a rate consumer (the fleet sampler) would read every move as an
+        instant burst on the destination host, which is exactly the phantom
+        signal a load-balancing controller must not chase. The earliest
+        first-seen stamp wins; the restore itself counts as activity
+        (``last_seen`` moves). Returns a copy of the merged row.
         """
         with self._lock:
             self._step += 1
@@ -336,8 +345,8 @@ class TenantRegistry:
             row = self._rows.get(tenant)
             if row is None:
                 row = self._rows[tenant] = self._new_row(tenant, now)
-            row["updates"] += int(updates)
-            row["computes"] += int(computes)
+            row["updates"] = max(row["updates"], int(updates))
+            row["computes"] = max(row["computes"], int(computes))
             if first_seen_unix is not None:
                 row["first_seen_unix"] = min(row["first_seen_unix"], float(first_seen_unix))
             row["last_seen_unix"] = now
@@ -369,6 +378,7 @@ def reset() -> None:
     pristine one-branch disabled path.
     """
     global ENABLED, _ADMISSION, _TORN_BUNDLES, _FENCED_REJECTED, _FENCED_SWEPT
+    global _FAILOVER_YIELDED
     _REGISTRY.clear()
     _REGISTRY.max_tenants = DEFAULT_MAX_TENANTS
     _ADMISSION = None
@@ -382,6 +392,7 @@ def reset() -> None:
         _TORN_BUNDLES = 0
         _FENCED_REJECTED = 0
         _FENCED_SWEPT = 0
+        _FAILOVER_YIELDED = 0
     track_thread_tenants(False)
     ENABLED = False
 
@@ -682,6 +693,10 @@ _LEASE_LOCK = threading.Lock()
 _TORN_BUNDLES = 0
 _FENCED_REJECTED = 0
 _FENCED_SWEPT = 0
+# failover elections lost: watchdogs that detected a stale lease, raced the
+# durable FAILOVER_CLAIM.json, observed another survivor's claim and stood
+# down — the running total behind the ``fence.failover_yielded`` gauge
+_FAILOVER_YIELDED = 0
 
 
 def note_lease(
@@ -861,6 +876,19 @@ def fenced_swept_count() -> int:
         return _FENCED_SWEPT
 
 
+def note_failover_yielded(n: int = 1) -> None:
+    """Count ``n`` failover(s) this process stood down from (lost election)."""
+    global _FAILOVER_YIELDED
+    if n > 0:
+        with _LEASE_LOCK:
+            _FAILOVER_YIELDED += int(n)
+
+
+def failover_yielded_count() -> int:
+    with _LEASE_LOCK:
+        return _FAILOVER_YIELDED
+
+
 # --------------------------------------------------------------------- admission
 
 # admission decisions (AdmissionController.admit return values)
@@ -891,6 +919,14 @@ class TenantQuota:
             per tenant — the warn_skip pattern); ``"defer"`` deprioritizes
             them (held until the window rolls under quota or the stream
             closes).
+        priority: the tenant's latency class (higher = more
+            latency-sensitive; default 0). Priority does not change THIS
+            tenant's own quota math — it orders tenants *relative to each
+            other* under pressure: deferred backlogs drain
+            highest-class-first (:meth:`AdmissionController.drain_order`,
+            consumed by the multiplexer's re-admission sweeps), so when the
+            fleet recovers headroom the latency-sensitive tenants get it
+            first and batch tiers absorb the wait.
     """
 
     updates_per_window: Optional[float] = None
@@ -899,6 +935,7 @@ class TenantQuota:
     compile_seconds_per_window: Optional[float] = None
     window_seconds: float = 60.0
     over_quota: str = SHED
+    priority: int = 0
 
     # burn-dimension name -> the quota field bounding it
     _DIMENSIONS = (
@@ -915,6 +952,8 @@ class TenantQuota:
             )
         if self.window_seconds <= 0:
             raise ValueError(f"Expected positive `window_seconds`, got {self.window_seconds}")
+        if not isinstance(self.priority, int) or self.priority < 0:
+            raise ValueError(f"Expected non-negative integer `priority`, got {self.priority!r}")
         for _, field in self._DIMENSIONS:
             limit = getattr(self, field)
             if limit is not None and limit <= 0:
@@ -1054,6 +1093,20 @@ class AdmissionController:
                 )
         return decision
 
+    def priority_of(self, tenant: str) -> int:
+        """The tenant's latency class (its quota's ``priority``; 0 unmetered)."""
+        quota = self.quota_for(tenant)
+        return int(quota.priority) if quota is not None else 0
+
+    def drain_order(self, tenants: Iterable[str]) -> List[str]:
+        """``tenants`` sorted for backlog drains: highest class first.
+
+        Ties break by name for determinism. The multiplexer's deferred
+        re-admission sweeps walk this order, so recovered headroom reaches
+        latency-sensitive tenants before batch tiers.
+        """
+        return sorted(tenants, key=lambda t: (-self.priority_of(t), t))
+
     def would_admit(self, tenant: str) -> bool:
         """Read-only probe: would :meth:`admit` answer :data:`ADMIT` right now?
 
@@ -1128,6 +1181,7 @@ class AdmissionController:
                     "window_seconds": quota.window_seconds,
                     "window_age_seconds": age,
                     "over_quota_policy": quota.over_quota,
+                    "priority": int(quota.priority),
                     "shed": self._shed.get(tenant, 0),
                     "deferred": self._deferred.get(tenant, 0),
                     **self._burn(window, quota),
@@ -1153,6 +1207,7 @@ class AdmissionController:
             rec.set_gauge("tenant.quota_burn_ratio", float(row["burn_ratio"]), **labels)
             rec.set_gauge("tenant.quota_shed", float(row["shed"]), **labels)
             rec.set_gauge("tenant.quota_deferred", float(row["deferred"]), **labels)
+            rec.set_gauge("tenant.quota_priority", float(row["priority"]), **labels)
             for dim in ("updates", "flops", "bytes", "compile_seconds"):
                 rec.set_gauge(f"tenant.quota_window_{dim}", float(row["used"][dim]), **labels)
         return len(rows)
@@ -1283,6 +1338,7 @@ def record_gauges(recorder: Optional[Any] = None) -> Dict[str, Any]:
     rec.set_gauge("fence.fenced_epochs", float(len(fence_rows)), tenant=None)
     rec.set_gauge("fence.bundles_rejected", float(fenced_rejected_count()), tenant=None)
     rec.set_gauge("fence.bundles_swept", float(fenced_swept_count()), tenant=None)
+    rec.set_gauge("fence.failover_yielded", float(failover_yielded_count()), tenant=None)
     # torn/corrupt bundles skipped by recovery scans (satellite: previously
     # one warning, invisible to scrapes)
     rec.set_gauge("checkpoint.torn_bundles", float(torn_bundle_count()), tenant=None)

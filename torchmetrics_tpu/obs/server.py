@@ -137,6 +137,7 @@ ROUTES = (
     "/leases",
     "/fleet",
     "/fleet/history",
+    "/placement",
     "/profile",
     "/audit",
     "/traces",
@@ -152,6 +153,7 @@ _TENANT_ROUTES = (
     "/traces",
     "/fleet",
     "/fleet/history",
+    "/placement",
     "/profile",
     "/audit",
 )
@@ -289,6 +291,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(owner.leases_report())
             elif route == "/fleet":
                 self._send_json(owner.fleet_report(tenant=tenant))
+            elif route == "/placement":
+                self._send_json(owner.placement_report(tenant=tenant))
             elif route == "/audit":
                 self._send_json(owner.audit_report(tenant=tenant))
             elif route == "/profile":
@@ -738,6 +742,28 @@ class IntrospectionServer:
                 self._rec_inc("server.errors", route="/fleet(alerts)")
         return {"enabled": True, **payload}
 
+    def placement_report(self, tenant: Optional[str] = None) -> Dict[str, Any]:
+        """The ``GET /placement`` page: the placement controller's table.
+
+        The fleet plane's WRITE-side read API: current tenant→host
+        assignments, moves in flight, the bounded decision log and the
+        convergence block (hysteresis episode state, last convergence time) —
+        all off the installed :class:`~torchmetrics_tpu.fleet.PlacementController`.
+        ``?tenant=`` scopes to one tenant's assignment (unknown tenants 404
+        via the shared pre-check). With no controller installed the page says
+        so instead of 404ing — "the plane is off" is an answer, not a
+        missing route.
+        """
+        from torchmetrics_tpu import fleet as _placement
+
+        controller = _placement.get_controller()
+        if controller is None:
+            return {
+                "enabled": False,
+                "error": "no placement controller installed (fleet.install_controller)",
+            }
+        return {"enabled": True, **controller.report(tenant=tenant)}
+
     def audit_report(self, tenant: Optional[str] = None) -> Dict[str, Any]:
         """The ``GET /audit`` page: the conservation auditor's ledger.
 
@@ -984,6 +1010,19 @@ class IntrospectionServer:
                 sampler.record_gauges(recorder=self.recorder)
         except Exception:  # fleet sampling must never break the scrape
             self._rec_inc("server.errors", route="/metrics(fleet)")
+        try:
+            # the placement controller rides the scrape loop too (cadence
+            # gated inside tick()): every /metrics pull doubles as a
+            # reconcile check, so rebalancing needs no extra timer thread —
+            # and the tm_tpu_placement_* gauges always carry the live table
+            from torchmetrics_tpu import fleet as _placement
+
+            controller = _placement.get_controller()
+            if controller is not None:
+                controller.tick()
+                controller.record_gauges(recorder=self.recorder)
+        except Exception:  # placement must never break the scrape
+            self._rec_inc("server.errors", route="/metrics(placement)")
         try:
             # the host profiler's hostprof.* gauge families refresh per
             # scrape too (self-overhead %, samples, per-seam seconds), so

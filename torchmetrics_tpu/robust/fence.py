@@ -43,11 +43,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import torchmetrics_tpu.obs.scope as _scope
 import torchmetrics_tpu.obs.trace as _trace
+from torchmetrics_tpu.utils.fileio import exclusive_create_text
 from torchmetrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = [
+    "CLAIM_FILE",
     "Watchdog",
     "WatchdogConfig",
+    "claim_failover",
     "failover",
     "get_watchdog",
     "holder_id",
@@ -58,6 +61,10 @@ __all__ = [
     "scan_bundle_lease",
     "stale_leases",
 ]
+
+# the durable failover-election claim, beside FENCED.json in the bundle
+# directory: first exclusive creation wins the right to run the failover
+CLAIM_FILE = "FAILOVER_CLAIM.json"
 
 
 def holder_id() -> str:
@@ -173,6 +180,55 @@ def scan_bundle_lease(directory: str) -> Optional[Dict[str, Any]]:
 # ----------------------------------------------------------------- failover
 
 
+def claim_failover(
+    directory: str,
+    epoch: str,
+    *,
+    by: Optional[str] = None,
+    now: Optional[float] = None,
+) -> bool:
+    """Race the durable failover claim for ``epoch`` under ``directory``.
+
+    The leader election for shared-disk fleets: when several survivors detect
+    the same stale lease, each tries to exclusively create
+    ``FAILOVER_CLAIM.json`` beside the bundles
+    (:func:`~torchmetrics_tpu.utils.fileio.exclusive_create_text` —
+    ``O_CREAT | O_EXCL``, so exactly one creation succeeds across processes).
+    Returns ``True`` for the winner (run the failover) and ``False`` for
+    losers (stand down; the loss is counted via
+    :func:`~torchmetrics_tpu.obs.scope.note_failover_yielded` by the
+    watchdog). A leftover claim from an *earlier* epoch's completed failover
+    does not block the election: it is removed and the creation retried once
+    — a stale claim is litter, not a leader.
+    """
+    path = os.path.join(os.path.abspath(directory), CLAIM_FILE)
+    payload = json.dumps(
+        {
+            "epoch": str(epoch),
+            "by": by if by is not None else holder_id(),
+            "claimed_unix": time.time() if now is None else float(now),
+        },
+        sort_keys=True,
+    )
+    for _ in range(2):
+        if exclusive_create_text(path, payload + "\n"):
+            return True
+        try:
+            with open(path, encoding="utf-8") as fh:
+                existing = json.load(fh)
+        except (OSError, ValueError):
+            # torn or vanished mid-read: retry the creation once — either we
+            # win now or a well-formed winner's claim answers the next read
+            continue
+        if str(existing.get("epoch")) == str(epoch):
+            return False  # a live claim for THIS epoch: someone else leads
+        try:
+            os.remove(path)  # an older epoch's leftover: clear and re-race
+        except OSError:
+            pass
+    return False
+
+
 def failover(
     metric: Any,
     directory: str,
@@ -181,6 +237,7 @@ def failover(
     epoch: Optional[str] = None,
     holder: Optional[str] = None,
     by: Optional[str] = None,
+    target: Optional[str] = None,
     **restore_overrides: Any,
 ) -> Tuple[Any, Dict[str, Any]]:
     """Fence the stale holder's epoch and restore the tenant here.
@@ -213,9 +270,12 @@ def failover(
             " the scope registry or any bundle manifest — nothing to fence."
         )
     by = by if by is not None else holder_id()
+    # the restore target defaults to the fencer itself; a placement
+    # controller's delegation (Watchdog.tick) passes the load-chosen host
+    target = target if target is not None else by
     # 1) fence FIRST — from here on the zombie's epoch is dead on arrival
     fence_record = migrate.fence_epoch(
-        directory, epoch, tenant=tenant, holder=holder, by=by, target=by
+        directory, epoch, tenant=tenant, holder=holder, by=by, target=target
     )
     # 2) only now select the restore bundle: anything the zombie wrote after
     #    the fence record's snapshot is rejected, not selected
@@ -242,7 +302,7 @@ def failover(
         "fenced_epoch": str(epoch),
         "fenced_holder": holder,
         "by": by,
-        "target": by,
+        "target": target,
         "new_epoch": pipe.lineage_epoch,
         "bundle": bundle,
         "bundle_ts_unix": manifest.get("ts_unix"),
@@ -356,22 +416,67 @@ class Watchdog:
                     return None  # bundle stream is provably alive: not hung
         return dict(lease)
 
+    @staticmethod
+    def _placement_controller() -> Optional[Any]:
+        """The installed placement controller, if the fleet plane has one.
+
+        Lazy import (the fleet package imports obs modules at import time);
+        ``None`` keeps every delegation seam a one-branch fallback to the
+        caller-named-directory behavior.
+        """
+        try:
+            from torchmetrics_tpu import fleet as _placement
+
+            return _placement.get_controller()
+        except Exception:  # pragma: no cover - partial installs
+            return None
+
     def tick(self, now: Optional[float] = None) -> List[Dict[str, Any]]:
-        """One detection pass; returns the failover reports it produced."""
+        """One detection pass; returns the failover reports it produced.
+
+        Before running a failover the survivors race the durable
+        ``FAILOVER_CLAIM.json`` beside the bundles (:func:`claim_failover`) so
+        exactly one executes it; losers stand down, counted
+        (``fence.failover_yielded``), and stop watching the epoch — the
+        winner's fence is the tenant's new truth. With a placement controller
+        installed (:func:`torchmetrics_tpu.fleet.get_controller`) the restore
+        *target* is the controller's least-loaded live host instead of the
+        fencer itself; without one the caller-named-directory path is
+        unchanged.
+        """
         now = time.time() if now is None else now
         produced: List[Dict[str, Any]] = []
+        controller = self._placement_controller()
         for key, watch in list(self._watches.items()):
             stale = self._stale_lease(key, watch, now)
             if stale is None:
                 continue
             cfg: WatchdogConfig = watch["config"]
+            epoch = stale.get("epoch")
+            if epoch is not None and not claim_failover(
+                watch["directory"], str(epoch), now=now
+            ):
+                # lost the election: another survivor owns this failover —
+                # stand down loudly instead of running a racing restore
+                _scope.note_failover_yielded()
+                if _trace.ENABLED:
+                    _trace.inc("fence.failover_yielded", tenant=watch["tenant"])
+                self.unwatch(watch["tenant"])
+                continue
+            target = None
+            if controller is not None and watch["tenant"] is not None:
+                try:
+                    target = controller.choose_restore_host(watch["tenant"])
+                except Exception:  # noqa: BLE001 - delegation must not block failover
+                    target = None
             try:
                 pipe, report = failover(
                     watch["metric_factory"](),
                     watch["directory"],
                     tenant=watch["tenant"],
-                    epoch=stale.get("epoch"),
+                    epoch=epoch,
                     holder=stale.get("holder"),
+                    target=target,
                     **cfg.restore_overrides,
                 )
             except Exception as err:  # noqa: BLE001 - a watchdog must not die with its patient
@@ -381,6 +486,14 @@ class Watchdog:
                 )
                 continue
             report = {**report, "detected_unix": now}
+            if controller is not None and watch["tenant"] is not None and target is not None:
+                try:
+                    # commit the choice to the placement table (and, in the
+                    # virtual-host model, the sampler's placement map) so the
+                    # fleet aggregate shows the tenant's host change
+                    controller.note_failover(watch["tenant"], target)
+                except Exception:  # noqa: BLE001
+                    pass
             self.failovers.append(report)
             produced.append(report)
             # the restored session owns the tenant now; stop watching the

@@ -21,7 +21,7 @@ import tempfile
 from contextlib import contextmanager
 from typing import Callable, IO, Iterator, Optional
 
-__all__ = ["atomic_open", "atomic_write_bytes", "atomic_write_text"]
+__all__ = ["atomic_open", "atomic_write_bytes", "atomic_write_text", "exclusive_create_text"]
 
 
 @contextmanager
@@ -71,6 +71,43 @@ def atomic_write_text(path: str, text: str, encoding: str = "utf-8") -> str:
     with atomic_open(path, "w", encoding=encoding) as fh:
         fh.write(text)
     return path
+
+
+def exclusive_create_text(path: str, text: str, encoding: str = "utf-8") -> bool:
+    """Create ``path`` with ``text`` iff it does not already exist; win/lose.
+
+    The durable claim primitive (``O_CREAT | O_EXCL``): exactly one of N
+    concurrent callers — threads OR processes sharing the filesystem — gets
+    ``True``; everyone else gets ``False`` with the file untouched. Used by
+    the fence watchdog's failover leader election (``FAILOVER_CLAIM.json``):
+    a shared-disk fleet where several survivors detect the same stale lease
+    must elect exactly one to run the failover, and a lock that does not
+    survive the electing process's own crash is no lock at all. Unlike
+    :func:`atomic_open` the content lands after creation (creation IS the
+    atomic event here; the payload is advisory detail for operators), so the
+    file is fsynced before close. Any error other than "already exists"
+    propagates — a claim that silently failed to persist would elect two
+    leaders on the next crash.
+    """
+    path = os.path.abspath(path)
+    directory = os.path.dirname(path) or "."
+    os.makedirs(directory, exist_ok=True)
+    try:
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+    except FileExistsError:
+        return False
+    try:
+        with os.fdopen(fd, "w", encoding=encoding) as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())
+    except BaseException:
+        try:
+            os.remove(path)  # a torn claim must not permanently block election
+        except OSError:
+            pass
+        raise
+    return True
 
 
 def atomic_write_bytes(
